@@ -1,0 +1,33 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with the capabilities
+of Apache MXNet 0.12.1.
+
+Brand-new design (not a port): JAX/XLA is the compute substrate, PJRT the
+async engine, pjit/shard_map over device meshes the distributed backend.
+See SURVEY.md for the reference's structure this framework mirrors at the
+API level, and the per-module docstrings for the TPU-first design of each
+subsystem.
+
+Typical use matches the reference::
+
+    import mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu(0))
+    with mx.autograd.record():
+        y = (x * 2).sum()
+    y.backward()
+"""
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus
+from . import ops
+from . import imperative
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import autograd
+from . import random
+from .random import seed
+
+# re-export sampler conveniences onto mx.random (parity: mx.random.uniform)
+random.uniform = nd.random.uniform
+random.normal = nd.random.normal
+
+__version__ = "0.1.0"

@@ -1,0 +1,163 @@
+"""Autograd: imperative differentiation scopes.
+
+Parity: reference ``python/mxnet/autograd.py`` (record/pause/train_mode/
+predict_mode/mark_variables/backward/grad/Function) backed by
+``src/imperative/imperative.cc``. The tape lives in mxnet_tpu.imperative;
+each recorded op stores its ``jax.vjp`` residual instead of an nnvm node.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from . import imperative as _imp
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "mark_variables",
+           "backward", "grad", "is_recording", "is_training",
+           "set_recording", "set_training", "Function"]
+
+
+is_recording = _imp.is_recording
+is_training = _imp.is_training
+set_recording = _imp.set_recording
+set_training = _imp.set_training
+mark_variables = _imp.mark_variables
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode):
+        self._enter_record = is_record
+        self._enter_train = train_mode
+        self._prev_record = None
+        self._prev_train = None
+
+    def __enter__(self):
+        if self._enter_record is not None:
+            self._prev_record = set_recording(self._enter_record)
+        if self._enter_train is not None:
+            self._prev_train = set_training(self._enter_train)
+        return self
+
+    def __exit__(self, *exc):
+        if self._enter_record is not None:
+            set_recording(self._prev_record)
+        if self._enter_train is not None:
+            set_training(self._prev_train)
+
+
+def record(train_mode=True):
+    """Scope in which ops are recorded for backward (parity: autograd.record)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """(parity: autograd.backward)"""
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+    _imp.backward(list(heads), head_grads, retain_graph=retain_graph,
+                  train_mode=train_mode)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Return gradients of heads w.r.t. variables (parity: autograd.grad).
+
+    Gradients are returned rather than written into ``.grad``.
+    ``create_graph`` (higher-order) is not yet supported on the eager tape;
+    use jax.grad composition via gluon hybridized blocks for that.
+    """
+    if create_graph:
+        raise MXNetError("create_graph=True is not supported by the eager "
+                         "tape yet; compose jax.grad via hybridize instead")
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+    single = not isinstance(variables, (list, tuple))
+    varlist = [variables] if single else list(variables)
+
+    # stash existing grad state, attach temp buffers
+    saved = [(v._grad, v._tape) for v in varlist]
+    from .ndarray.ndarray import _wrap
+    grads = [_wrap(jnp.zeros(v.shape, v._data.dtype), v._ctx) for v in varlist]
+    for v, g in zip(varlist, grads):
+        if v._tape is None or not isinstance(v._tape[0], _imp.Leaf):
+            raise MXNetError("autograd.grad: variables must have attached grad "
+                             "(call attach_grad before record)")
+        v._grad = g
+    try:
+        _imp.backward(list(heads), head_grads,
+                      retain_graph=bool(retain_graph), train_mode=train_mode)
+        out = [v._grad for v in varlist]
+    finally:
+        for v, (g, t) in zip(varlist, saved):
+            v._grad = g
+            v._tape = t
+    return out[0] if single else out
+
+
+class Function:
+    """User-defined differentiable function (parity: autograd.Function:364).
+
+    Subclass and implement ``forward`` / ``backward`` over NDArrays.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray, _wrap
+
+        was_recording = is_recording()
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+
+        if was_recording:
+            func = self
+
+            def vjp_fn(out_cts):
+                cts = [_wrap(c) for c in out_cts]
+                with pause():
+                    in_grads = func.backward(*cts)
+                if not isinstance(in_grads, (list, tuple)):
+                    in_grads = [in_grads]
+                return tuple(g._data if isinstance(g, NDArray) else g
+                             for g in in_grads)
+
+            parents = [x._tape if isinstance(x, NDArray) and x._tape is not None
+                       else None for x in inputs]
+            node = _imp.TapeNode(
+                parents, vjp_fn,
+                [jax.ShapeDtypeStruct(o.shape, o._data.dtype) for o in outs],
+                type(self).__name__)
+            for i, o in enumerate(outs):
+                o._tape = (node, i)
+        return outputs

@@ -1,0 +1,111 @@
+"""Foundation utilities: errors, registries, env-var config.
+
+TPU-native re-design of what the reference gets from dmlc-core
+(logging, registry, GetEnv — see reference include/mxnet/base.h and
+SURVEY.md §2.1 "dmlc-core equivalent"). There is no C ABI boundary here:
+the Python layer talks straight to JAX/XLA, so the 159-function C API
+(reference src/c_api/) collapses into ordinary Python calls.
+"""
+from __future__ import annotations
+
+import os
+import string
+import threading
+
+__all__ = [
+    "MXNetError",
+    "get_env",
+    "registry_create",
+    "NameManager",
+]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (parity: reference python/mxnet/base.py MXNetError)."""
+
+
+def get_env(name, default=None, typ=None):
+    """Typed environment-variable lookup (parity: dmlc::GetEnv, SURVEY.md §5.6).
+
+    All reference ``MXNET_*`` runtime knobs route through here.
+    """
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    if typ is bool or isinstance(default, bool):
+        return val.lower() not in ("0", "false", "off", "")
+    if typ is int or isinstance(default, int):
+        return int(val)
+    if typ is float or isinstance(default, float):
+        return float(val)
+    return val
+
+
+def registry_create(kind):
+    """Create a tiny (register, alias, create, get) registry.
+
+    Parity: dmlc registry pattern used for optimizers, metrics,
+    initializers, data iterators in the reference.
+    """
+    entries = {}
+
+    def register(cls=None, name=None):
+        def _reg(cls):
+            key = (name or cls.__name__).lower()
+            entries[key] = cls
+            return cls
+
+        if cls is None:
+            return _reg
+        return _reg(cls)
+
+    def alias(name, cls):
+        entries[name.lower()] = cls
+
+    def create(name, *args, **kwargs):
+        key = name.lower()
+        if key not in entries:
+            raise MXNetError(
+                "%s %r is not registered (known: %s)"
+                % (kind, name, sorted(entries))
+            )
+        return entries[key](*args, **kwargs)
+
+    def get(name):
+        return entries.get(name.lower())
+
+    return register, alias, create, get
+
+
+class _NameManagerState(threading.local):
+    def __init__(self):
+        self.counts = {}
+
+
+class NameManager:
+    """Generates unique names for symbols/blocks.
+
+    Parity: reference python/mxnet/name.py NameManager.
+    """
+
+    _state = _NameManagerState()
+
+    @classmethod
+    def get(cls, hint):
+        hint = hint.lower()
+        idx = cls._state.counts.get(hint, 0)
+        cls._state.counts[hint] = idx + 1
+        return "%s%d" % (hint, idx)
+
+    @classmethod
+    def reset(cls):
+        cls._state.counts = {}
+
+
+_VALID_NAME_CHARS = set(string.ascii_letters + string.digits + "_-.")
+
+
+def check_name(name):
+    if not name or not set(name) <= _VALID_NAME_CHARS:
+        raise MXNetError("invalid name %r" % (name,))
+    return name

@@ -1,0 +1,286 @@
+"""Imperative runtime: eager op invocation + autograd tape.
+
+TPU-native re-design of the reference's imperative layer
+(``src/imperative/imperative.cc`` — Invoke/RecordOp/Backward) and its
+dependency engine. The reference needed a dataflow engine
+(``src/engine/threaded_engine*.cc``) to overlap async GPU kernels; on TPU,
+PJRT *is* that engine: every jax op dispatches asynchronously onto the
+device stream in program order, and ``block_until_ready`` is WaitToRead.
+So "engine push" collapses to a function call here, and what remains is
+the tape: when recording, each op invocation stores the ``jax.vjp``
+residual so ``backward()`` can walk the graph — the same role the
+reference's per-node ``AGInfo`` (``imperative.h:40-77``) plays.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+from .ops import registry as _registry
+from .ops import common as _common
+
+__all__ = ["invoke", "is_recording", "is_training", "set_recording",
+           "set_training", "backward", "mark_variables", "get_symbol"]
+
+
+# ---------------------------------------------------------------------------
+# Recording state (parity: Imperative::is_recording/is_training)
+# ---------------------------------------------------------------------------
+
+def is_recording():
+    return _common.state().recording
+
+
+def is_training():
+    return _common.state().train_mode
+
+
+def set_recording(flag):
+    prev = _common.state().recording
+    _common.state().recording = bool(flag)
+    return prev
+
+
+def set_training(flag):
+    prev = _common.state().train_mode
+    _common.state().train_mode = bool(flag)
+    return prev
+
+
+# ---------------------------------------------------------------------------
+# Tape graph
+# ---------------------------------------------------------------------------
+
+class TapeNode:
+    """One recorded op (parity: reference AGInfo node).
+
+    ``parents[i]`` is ``(TapeNode | Leaf | None, out_index)`` for input i.
+    ``vjp_fn`` maps output cotangents -> input cotangents.
+    """
+
+    __slots__ = ("parents", "vjp_fn", "out_avals", "op_name")
+
+    def __init__(self, parents, vjp_fn, out_avals, op_name):
+        self.parents = parents
+        self.vjp_fn = vjp_fn
+        self.out_avals = out_avals
+        self.op_name = op_name
+
+
+class Leaf:
+    """A marked variable (parity: mark_variables / attach_grad)."""
+
+    __slots__ = ("array", "grad_req")
+
+    def __init__(self, array, grad_req="write"):
+        self.array = array  # the NDArray owning this leaf
+        self.grad_req = grad_req
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers (parity: autograd.mark_variables,
+    reference imperative.cc MarkVariables)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, grad, req in zip(variables, gradients, grad_reqs):
+        var._grad = grad
+        var._tape = (Leaf(var, req), 0)
+
+
+# ---------------------------------------------------------------------------
+# Invoke
+# ---------------------------------------------------------------------------
+
+def _as_raw(x):
+    from .ndarray.ndarray import NDArray
+    if isinstance(x, NDArray):
+        return x._data
+    return x
+
+
+def invoke(op, inputs, kwargs, out=None, name=None):
+    """Execute one op eagerly (parity: Imperative::Invoke, imperative.cc:86).
+
+    ``inputs`` are NDArrays (or raw arrays); ``kwargs`` the op params.
+    Returns a single NDArray or a list, honouring op.visible_outputs.
+    """
+    from .ndarray.ndarray import NDArray, _wrap
+
+    params = dict(op.defaults)
+    params.update(kwargs)
+    # prune wrapper-only kwargs the op fns don't take
+    params.pop("name", None)
+    if op.nin != 0:
+        params.pop("ctx", None)
+
+    if op.takes_train:
+        params["_train"] = is_training()
+    if op.takes_rng:
+        params["_rng"] = _common.take_rng()
+
+    nds = [x if isinstance(x, NDArray) else None for x in inputs]
+    raw = [_as_raw(x) for x in inputs]
+
+    record = (is_recording() and not op.no_grad
+              and any(nd is not None and nd._tape is not None for nd in nds))
+
+    if record:
+        def _pure(*arrs):
+            outs = op.fn(*arrs, **params)
+            return outs if isinstance(outs, tuple) else (outs,)
+
+        outs, vjp_fn = jax.vjp(_pure, *raw)
+        parents = [nd._tape if (nd is not None and nd._tape is not None) else None
+                   for nd in nds]
+        node = TapeNode(parents, vjp_fn,
+                        [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs],
+                        op.name)
+    else:
+        outs = op.apply(raw, params)
+        node = None
+
+    # stateful aux updates (BatchNorm moving stats)
+    if op.stateful_update is not None:
+        updates = op.stateful_update(raw, outs, params)
+        for idx, val in updates.items():
+            if nds[idx] is not None:
+                nds[idx]._set_data(val)
+
+    # in-place mutation ops (optimizer updates): output j writes input mutate[j]
+    if op.mutate:
+        for j, idx in enumerate(op.mutate):
+            if j < len(outs) and nds[idx] is not None:
+                nds[idx]._set_data(outs[j])
+        primary = nds[op.mutate[0]]
+        if out is not None and out is not primary:
+            out._set_data(outs[0])
+            return out
+        return primary
+
+    n_visible = op.visible_outputs or len(outs)
+    results = []
+    for i in range(n_visible):
+        nd_out = _wrap(outs[i])
+        if node is not None:
+            nd_out._tape = (node, i)
+        results.append(nd_out)
+
+    if out is not None:
+        targets = out if isinstance(out, (list, tuple)) else [out]
+        for t, r in zip(targets, results):
+            t._set_data(r._data)
+            t._tape = r._tape
+        return out
+    if n_visible == 1:
+        return results[0]
+    return results
+
+
+# ---------------------------------------------------------------------------
+# Backward pass over the tape
+# ---------------------------------------------------------------------------
+
+def backward(outputs, head_grads=None, retain_graph=False, train_mode=True):
+    """Run reverse-mode over recorded ops (parity: Imperative::Backward,
+    reference imperative.cc:361).
+
+    outputs: list of NDArrays to differentiate; head_grads: matching list
+    of NDArrays or None (=> ones).
+    """
+    from .ndarray.ndarray import NDArray
+
+    if head_grads is None:
+        head_grads = [None] * len(outputs)
+
+    # cotangent accumulator keyed by (id(node), out_idx)
+    cotangents = {}
+    node_of = {}
+
+    def _acc(node, idx, val):
+        key = (id(node), idx)
+        node_of[id(node)] = node
+        if key in cotangents:
+            cotangents[key] = cotangents[key] + val
+        else:
+            cotangents[key] = val
+
+    roots = []
+    for y, hg in zip(outputs, head_grads):
+        if y._tape is None:
+            continue
+        node, idx = y._tape
+        g = hg._data if isinstance(hg, NDArray) else (
+            jnp.ones(y.shape, y.dtype) if hg is None else jnp.asarray(hg))
+        _acc(node, idx, g)
+        roots.append(node)
+    if not roots:
+        raise MXNetError("backward: outputs are not in a recorded graph "
+                         "(use autograd.record())")
+
+    # topological order over TapeNodes (DFS, iterative)
+    order = []
+    state = {}
+    stack = [(r, False) for r in dict.fromkeys(roots)]
+    while stack:
+        node, processed = stack.pop()
+        if isinstance(node, Leaf) or node is None:
+            continue
+        if processed:
+            order.append(node)
+            continue
+        if state.get(id(node)):
+            continue
+        state[id(node)] = True
+        stack.append((node, True))
+        for p in node.parents:
+            if p is not None and isinstance(p[0], TapeNode):
+                if not state.get(id(p[0])):
+                    stack.append((p[0], False))
+
+    # reverse topo: propagate; leaf cotangents accumulate here and are
+    # written out once at the end (a leaf may feed many ops).
+    leaf_cts = {}
+    for node in reversed(order):
+        outs_ct = []
+        for i, aval in enumerate(node.out_avals):
+            ct = cotangents.get((id(node), i))
+            if ct is None:
+                ct = jnp.zeros(aval.shape, aval.dtype)
+            outs_ct.append(ct)
+        in_cts = node.vjp_fn(tuple(outs_ct))
+        for parent, ct in zip(node.parents, in_cts):
+            if parent is None:
+                continue
+            if hasattr(ct, "dtype") and ct.dtype == jax.dtypes.float0:
+                continue
+            pnode, pidx = parent
+            if isinstance(pnode, Leaf):
+                key = id(pnode)
+                if key in leaf_cts:
+                    leaf_cts[key] = (pnode, leaf_cts[key][1] + ct)
+                else:
+                    leaf_cts[key] = (pnode, ct)
+            else:
+                _acc(pnode, pidx, ct)
+        if not retain_graph:
+            node.vjp_fn = None
+
+    for leaf, ct in leaf_cts.values():
+        _write_leaf(leaf, ct)
+
+
+def _write_leaf(leaf, cotangent):
+    var = leaf.array
+    if var._grad is None:
+        return
+    if leaf.grad_req == "add":
+        var._grad._set_data(var._grad._data + cotangent.astype(var._grad.dtype))
+    elif leaf.grad_req != "null":
+        var._grad._set_data(cotangent.astype(var._grad.dtype))
+
+
+def get_symbol(x):  # pragma: no cover - parity stub
+    raise MXNetError("autograd.get_symbol is not supported in the TPU build; "
+                     "use gluon.HybridBlock.hybridize for graph capture")

@@ -1,0 +1,16 @@
+"""The ``mx.nd`` namespace: NDArray + generated operator functions.
+
+Parity: reference ``python/mxnet/ndarray/__init__.py``.
+"""
+from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
+                      concatenate, moveaxis, waitall, onehot_encode)
+from .utils import save, load
+from . import register as _register
+
+# code-gen every registered op into this module (mx.nd.dot, mx.nd.Convolution…)
+_register.populate(globals())
+
+from . import random   # noqa: E402,F401
+from . import linalg   # noqa: E402,F401
+from . import sparse   # noqa: E402,F401
+from .sparse import RowSparseNDArray, CSRNDArray  # noqa: E402,F401

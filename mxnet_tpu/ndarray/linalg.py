@@ -1,0 +1,23 @@
+"""``mx.nd.linalg`` namespace (parity: python/mxnet/ndarray/linalg.py)."""
+from __future__ import annotations
+
+from .. import imperative as _imp
+from ..ops import registry as _registry
+
+
+def _make(name, opname):
+    def fn(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        return _imp.invoke(_registry.get_op(opname), list(args), kwargs, out=out)
+    fn.__name__ = name
+    return fn
+
+
+gemm = _make("gemm", "_linalg_gemm")
+gemm2 = _make("gemm2", "_linalg_gemm2")
+potrf = _make("potrf", "_linalg_potrf")
+potri = _make("potri", "_linalg_potri")
+trmm = _make("trmm", "_linalg_trmm")
+trsm = _make("trsm", "_linalg_trsm")
+sumlogdiag = _make("sumlogdiag", "_linalg_sumlogdiag")
+syrk = _make("syrk", "_linalg_syrk")

@@ -1,0 +1,536 @@
+"""NDArray — the imperative tensor.
+
+Parity: reference ``include/mxnet/ndarray.h`` + ``src/ndarray/ndarray.cc``
+and ``python/mxnet/ndarray/ndarray.py``. TPU-native design: an NDArray
+wraps a ``jax.Array`` living in HBM (or host memory for cpu contexts).
+The reference's engine-variable/versioning machinery is unnecessary —
+PJRT dispatch is already async and ordered, so:
+
+* every op call returns immediately with a future-backed buffer
+  (reference: Engine::PushAsync);
+* ``wait_to_read`` / ``asnumpy`` are ``block_until_ready`` sync points
+  (reference: WaitToRead, ndarray.h:340-348);
+* in-place mutation (``+=``, sliced assignment, optimizer updates)
+  rebinds the wrapped buffer — functionally pure underneath, mutable at
+  the API, which keeps the reference's aliasing semantics without its
+  RAW/WAR tracking.
+"""
+from __future__ import annotations
+
+import numbers
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context, current_context, cpu
+from ..ops import registry as _registry
+from ..ops.common import mx_dtype
+from .. import imperative as _imp
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "concatenate", "moveaxis", "waitall", "imresize", "onehot_encode"]
+
+
+class NDArray:
+    """Multi-dimensional, asynchronously-evaluated array on a device."""
+
+    __slots__ = ("_data", "_ctx", "_grad", "_tape", "_stype", "__weakref__")
+
+    __array_priority__ = 100.0  # beat numpy in mixed expressions
+
+    def __init__(self, data, ctx=None):
+        if ctx is None:
+            ctx = current_context()
+        self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
+        self._data = data
+        self._grad = None
+        self._tape = None
+        self._stype = "default"
+
+    # -- internal ----------------------------------------------------------
+    def _set_data(self, raw):
+        self._data = raw
+
+    @property
+    def data_(self):
+        return self._data
+
+    # -- basic properties --------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype) if self._data.dtype != jnp.bfloat16 \
+            else self._data.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return self._stype
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def T(self):
+        return transpose(self)
+
+    # -- sync points -------------------------------------------------------
+    def wait_to_read(self):
+        """Block until the value is computed (parity: NDArray::WaitToRead)."""
+        jax.block_until_ready(self._data)
+
+    wait_to_write = wait_to_read
+
+    def asnumpy(self):
+        """Copy to a numpy array; synchronises (parity: ndarray.py asnumpy)."""
+        out = np.asarray(jax.device_get(self._data))
+        return out
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("the array is not scalar-sized")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size != 1:
+            raise MXNetError("truth value of multi-element NDArray is ambiguous")
+        return bool(self.asscalar())
+
+    def __len__(self):
+        if not self.shape:
+            raise MXNetError("len() of 0-d array")
+        return self.shape[0]
+
+    def __array__(self, dtype=None):
+        out = self.asnumpy()
+        return out.astype(dtype) if dtype is not None else out
+
+    # -- conversion / movement --------------------------------------------
+    def astype(self, dtype, copy=True):
+        dt = mx_dtype(dtype)
+        if not copy and np.dtype(self._data.dtype) == np.dtype(dt):
+            return self
+        return _wrap(self._data.astype(dt), self._ctx)
+
+    def copy(self):
+        return _wrap(jnp.copy(self._data), self._ctx)
+
+    def copyto(self, other):
+        """Copy into another NDArray or to a Context (parity: CopyFromTo,
+        reference ndarray.cc:514-571 — PJRT issues the D2D/H2D transfer
+        asynchronously)."""
+        if isinstance(other, Context):
+            dev = other.jax_device()
+            return _wrap(jax.device_put(self._data, dev), other)
+        if isinstance(other, NDArray):
+            other._set_data(jax.device_put(self._data, other._ctx.jax_device())
+                            .astype(other._data.dtype))
+            return other
+        raise TypeError("copyto: expected NDArray or Context")
+
+    def as_in_context(self, ctx):
+        if ctx == self._ctx:
+            return self
+        return self.copyto(ctx)
+
+    def detach(self):
+        out = _wrap(self._data, self._ctx)
+        return out
+
+    # -- autograd ----------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):
+        """Allocate a gradient buffer (parity: gluon Parameter/autograd)."""
+        grad = _wrap(jnp.zeros(self.shape, self._data.dtype), self._ctx)
+        _imp.mark_variables([self], [grad], [grad_req])
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        _imp.backward([self], [out_grad], retain_graph=retain_graph,
+                      train_mode=train_mode)
+
+    # -- shape ops (delegate to registered operators) ----------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = kwargs.get("shape", shape)
+        return _invoke("Reshape", [self], {"shape": shape,
+                                           "reverse": kwargs.get("reverse", False)})
+
+    def flatten(self):
+        return _invoke("Flatten", [self], {})
+
+    def expand_dims(self, axis):
+        return _invoke("expand_dims", [self], {"axis": axis})
+
+    def squeeze(self, axis=None):
+        return _invoke("squeeze", [self], {"axis": axis})
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return _invoke("transpose", [self], {"axes": axes})
+
+    def swapaxes(self, dim1, dim2):
+        return _invoke("SwapAxis", [self], {"dim1": dim1, "dim2": dim2})
+
+    def broadcast_to(self, shape):
+        return _invoke("broadcast_to", [self], {"shape": shape})
+
+    def tile(self, reps):
+        return _invoke("tile", [self], {"reps": reps})
+
+    def slice_axis(self, axis, begin, end):
+        return _invoke("slice_axis", [self], {"axis": axis, "begin": begin,
+                                              "end": end})
+
+    # reductions
+    def sum(self, axis=None, keepdims=False, **kw):
+        return _invoke("sum", [self], {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False, **kw):
+        return _invoke("mean", [self], {"axis": axis, "keepdims": keepdims})
+
+    def max(self, axis=None, keepdims=False, **kw):
+        return _invoke("max", [self], {"axis": axis, "keepdims": keepdims})
+
+    def min(self, axis=None, keepdims=False, **kw):
+        return _invoke("min", [self], {"axis": axis, "keepdims": keepdims})
+
+    def prod(self, axis=None, keepdims=False, **kw):
+        return _invoke("prod", [self], {"axis": axis, "keepdims": keepdims})
+
+    def argmax(self, axis=None, keepdims=False):
+        return _invoke("argmax", [self], {"axis": axis, "keepdims": keepdims})
+
+    def argmin(self, axis=None, keepdims=False):
+        return _invoke("argmin", [self], {"axis": axis, "keepdims": keepdims})
+
+    def norm(self):
+        return _invoke("norm", [self], {})
+
+    def abs(self):
+        return _invoke("abs", [self], {})
+
+    def sqrt(self):
+        return _invoke("sqrt", [self], {})
+
+    def square(self):
+        return _invoke("square", [self], {})
+
+    def sign(self):
+        return _invoke("sign", [self], {})
+
+    def clip(self, a_min, a_max):
+        return _invoke("clip", [self], {"a_min": a_min, "a_max": a_max})
+
+    def round(self):
+        return _invoke("round", [self], {})
+
+    def log(self):
+        return _invoke("log", [self], {})
+
+    def exp(self):
+        return _invoke("exp", [self], {})
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self
+        from . import sparse as _sp
+        return _sp.cast_storage(self, stype)
+
+    # -- arithmetic --------------------------------------------------------
+    def _binary(self, other, op, scalar_op, reverse=False):
+        if isinstance(other, NDArray):
+            args = [other, self] if reverse else [self, other]
+            return _invoke(op, args, {})
+        if isinstance(other, numbers.Number):
+            name = scalar_op if not reverse else scalar_op.replace("_", "_r", 1) \
+                if not scalar_op.startswith("_r") else scalar_op
+            return _invoke(name, [self], {"scalar": other})
+        return NotImplemented
+
+    def __add__(self, other):
+        return self._binary(other, "broadcast_add", "_plus_scalar")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binary(other, "broadcast_sub", "_minus_scalar")
+
+    def __rsub__(self, other):
+        if isinstance(other, numbers.Number):
+            return _invoke("_rminus_scalar", [self], {"scalar": other})
+        return NotImplemented
+
+    def __mul__(self, other):
+        return self._binary(other, "broadcast_mul", "_mul_scalar")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binary(other, "broadcast_div", "_div_scalar")
+
+    def __rtruediv__(self, other):
+        if isinstance(other, numbers.Number):
+            return _invoke("_rdiv_scalar", [self], {"scalar": other})
+        return NotImplemented
+
+    __div__ = __truediv__
+    __rdiv__ = __rtruediv__
+
+    def __mod__(self, other):
+        return self._binary(other, "broadcast_mod", "_mod_scalar")
+
+    def __rmod__(self, other):
+        if isinstance(other, numbers.Number):
+            return _invoke("_rmod_scalar", [self], {"scalar": other})
+        return NotImplemented
+
+    def __pow__(self, other):
+        return self._binary(other, "broadcast_power", "_power_scalar")
+
+    def __rpow__(self, other):
+        if isinstance(other, numbers.Number):
+            return _invoke("_rpower_scalar", [self], {"scalar": other})
+        return NotImplemented
+
+    def __neg__(self):
+        return _invoke("negative", [self], {})
+
+    def __abs__(self):
+        return _invoke("abs", [self], {})
+
+    def __eq__(self, other):
+        if isinstance(other, NDArray):
+            return _invoke("broadcast_equal", [self, other], {})
+        if isinstance(other, numbers.Number):
+            return _invoke("_equal_scalar", [self], {"scalar": other})
+        return NotImplemented
+
+    def __ne__(self, other):
+        if isinstance(other, NDArray):
+            return _invoke("broadcast_not_equal", [self, other], {})
+        if isinstance(other, numbers.Number):
+            return _invoke("_not_equal_scalar", [self], {"scalar": other})
+        return NotImplemented
+
+    def __gt__(self, other):
+        if isinstance(other, NDArray):
+            return _invoke("broadcast_greater", [self, other], {})
+        return _invoke("_greater_scalar", [self], {"scalar": other})
+
+    def __ge__(self, other):
+        if isinstance(other, NDArray):
+            return _invoke("broadcast_greater_equal", [self, other], {})
+        return _invoke("_greater_equal_scalar", [self], {"scalar": other})
+
+    def __lt__(self, other):
+        if isinstance(other, NDArray):
+            return _invoke("broadcast_lesser", [self, other], {})
+        return _invoke("_lesser_scalar", [self], {"scalar": other})
+
+    def __le__(self, other):
+        if isinstance(other, NDArray):
+            return _invoke("broadcast_lesser_equal", [self, other], {})
+        return _invoke("_lesser_equal_scalar", [self], {"scalar": other})
+
+    def __hash__(self):
+        return id(self)
+
+    # in-place: rebind the buffer (functional underneath)
+    def __iadd__(self, other):
+        res = self.__add__(other)
+        self._set_data(res._data)
+        self._tape = res._tape
+        return self
+
+    def __isub__(self, other):
+        res = self.__sub__(other)
+        self._set_data(res._data)
+        self._tape = res._tape
+        return self
+
+    def __imul__(self, other):
+        res = self.__mul__(other)
+        self._set_data(res._data)
+        self._tape = res._tape
+        return self
+
+    def __itruediv__(self, other):
+        res = self.__truediv__(other)
+        self._set_data(res._data)
+        self._tape = res._tape
+        return self
+
+    __idiv__ = __itruediv__
+
+    # -- indexing ----------------------------------------------------------
+    def __getitem__(self, key):
+        if isinstance(key, NDArray):
+            key = key._data.astype(jnp.int32)
+        elif isinstance(key, tuple):
+            key = tuple(k._data.astype(jnp.int32) if isinstance(k, NDArray)
+                        else k for k in key)
+        return _wrap(self._data[key], self._ctx)
+
+    def __setitem__(self, key, value):
+        if isinstance(key, NDArray):
+            key = key._data.astype(jnp.int32)
+        elif isinstance(key, tuple):
+            key = tuple(k._data.astype(jnp.int32) if isinstance(k, NDArray)
+                        else k for k in key)
+        if isinstance(value, NDArray):
+            value = value._data
+        elif isinstance(value, np.ndarray):
+            value = jnp.asarray(value, self._data.dtype)
+        if key is Ellipsis or (isinstance(key, slice) and key == slice(None)):
+            self._set_data(jnp.broadcast_to(
+                jnp.asarray(value, self._data.dtype), self.shape).astype(self._data.dtype))
+        else:
+            self._set_data(self._data.at[key].set(value))
+
+    def __repr__(self):
+        return "\n%s\n<NDArray %s @%s>" % (
+            self.asnumpy(), "x".join(str(s) for s in self.shape), self._ctx)
+
+    # pickle / deepcopy support
+    def __getstate__(self):
+        return {"data": self.asnumpy(), "ctx_type": self._ctx.device_type,
+                "ctx_id": self._ctx.device_id}
+
+    def __setstate__(self, st):
+        ctx = Context(st["ctx_type"], st["ctx_id"])
+        self._ctx = ctx
+        self._data = _to_device(jnp.asarray(st["data"]), ctx)
+        self._grad = None
+        self._tape = None
+        self._stype = "default"
+
+
+# ---------------------------------------------------------------------------
+# helpers and creation functions
+# ---------------------------------------------------------------------------
+
+def _to_device(raw, ctx):
+    try:
+        return jax.device_put(raw, ctx.jax_device())
+    except Exception:
+        return jnp.asarray(raw)
+
+
+def _wrap(raw, ctx=None):
+    return NDArray(raw, ctx if ctx is not None else current_context())
+
+
+def _invoke(op_name, inputs, kwargs, out=None):
+    return _imp.invoke(_registry.get_op(op_name), inputs, kwargs, out=out)
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Create an NDArray from any array-like (parity: mx.nd.array)."""
+    if isinstance(source_array, NDArray):
+        src = source_array._data
+    elif isinstance(source_array, (np.ndarray, jax.Array)):
+        src = source_array
+    else:
+        src = np.asarray(source_array)
+        # python lists of floats default to float32 (MXNet convention)
+        if src.dtype == np.float64:
+            src = src.astype(np.float32)
+    dt = mx_dtype(dtype)
+    if dt is None:
+        # keep source dtype; JAX x64-off coerces float64->float32 like the
+        # reference's real_t default
+        dt = np.float32 if np.dtype(getattr(src, "dtype", np.float32)) == np.float64 \
+            else src.dtype
+    ctx = ctx or current_context()
+    return _wrap(_to_device(jnp.asarray(src, dt), ctx), ctx)
+
+
+def zeros(shape, ctx=None, dtype=None, stype=None, **kwargs):
+    ctx = ctx or current_context()
+    dt = mx_dtype(dtype) or np.float32
+    if isinstance(shape, numbers.Number):
+        shape = (int(shape),)
+    return _wrap(_to_device(jnp.zeros(shape, dt), ctx), ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs):
+    ctx = ctx or current_context()
+    dt = mx_dtype(dtype) or np.float32
+    if isinstance(shape, numbers.Number):
+        shape = (int(shape),)
+    return _wrap(_to_device(jnp.ones(shape, dt), ctx), ctx)
+
+
+def full(shape, val, ctx=None, dtype=None, **kwargs):
+    ctx = ctx or current_context()
+    dt = mx_dtype(dtype) or np.float32
+    if isinstance(shape, numbers.Number):
+        shape = (int(shape),)
+    return _wrap(_to_device(jnp.full(shape, val, dt), ctx), ctx)
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    return _invoke("_arange", [], {"start": start, "stop": stop, "step": step,
+                                   "repeat": repeat,
+                                   "dtype": dtype or "float32"})
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return _invoke("Concat", list(arrays), {"dim": axis})
+
+
+def moveaxis(tensor, source, destination):
+    return _wrap(jnp.moveaxis(tensor._data, source, destination), tensor._ctx)
+
+
+def transpose(data, axes=()):
+    return _invoke("transpose", [data], {"axes": axes})
+
+
+def waitall():
+    """Block until all async computation completes (parity: mx.nd.waitall)."""
+    jax.effects_barrier()
+
+
+def onehot_encode(indices, out):
+    depth = out.shape[1]
+    res = _invoke("one_hot", [indices], {"depth": depth})
+    out._set_data(res._data)
+    return out
+
+
+def imresize(*args, **kwargs):  # pragma: no cover
+    raise MXNetError("imresize requires the image pipeline (mxnet_tpu.image)")

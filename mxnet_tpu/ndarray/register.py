@@ -1,0 +1,59 @@
+"""Code-generation of the ``nd.*`` operator namespace from the registry.
+
+Parity: reference ``python/mxnet/ndarray/register.py:142-168`` which
+generates a Python function per C-registered op at import time. Here the
+registry is Python (ops/registry.py) so generation is direct; signatures
+accept tensor args positionally or by their reference kwarg names
+(``data=``, ``weight=`` …), plus ``out=`` like the reference.
+"""
+from __future__ import annotations
+
+from .. import imperative as _imp
+from ..ops import registry as _registry
+
+
+def make_op_func(op):
+    arg_names = op.arg_names
+
+    param_order = list(op.defaults)
+
+    def generic_op(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        from .ndarray import NDArray
+        # leading NDArray positionals are tensor inputs; trailing positional
+        # values map onto the op's params in declaration order (matching the
+        # reference's generated signatures, e.g. nd.clip(x, 0.0, 1.0)).
+        inputs = []
+        i = 0
+        while i < len(args) and isinstance(args[i], NDArray):
+            inputs.append(args[i])
+            i += 1
+        for j, val in enumerate(args[i:]):
+            if j < len(param_order):
+                kwargs.setdefault(param_order[j], val)
+        if op.nin == -1:
+            kwargs.pop("num_args", None)
+        else:
+            # named tensor args may come via kwargs
+            if len(inputs) < len(arg_names):
+                for name in arg_names[len(inputs):]:
+                    if name in kwargs and isinstance(kwargs[name], NDArray):
+                        inputs.append(kwargs.pop(name))
+                    else:
+                        break
+        return _imp.invoke(op, inputs, kwargs, out=out)
+
+    generic_op.__name__ = op.name
+    generic_op.__doc__ = op.doc or ("%s operator (see reference MXNet %s)" %
+                                    (op.name, op.name))
+    return generic_op
+
+
+def populate(namespace, include_internal=True):
+    """Install one function per registered op into ``namespace``."""
+    for name in _registry.list_ops():
+        op = _registry.get_op(name)
+        if not include_internal and name.startswith("_"):
+            continue
+        namespace[name] = make_op_func(op)
+    return namespace

@@ -1,0 +1,192 @@
+"""Sparse NDArrays: row_sparse and csr storage types.
+
+Parity: reference ``python/mxnet/ndarray/sparse.py`` (RowSparseNDArray,
+CSRNDArray) and ``include/mxnet/ndarray.h:59-63`` storage types.
+
+TPU-native design: TPUs have no native CSR kernels; sparse arrays keep
+their compressed representation on host/device as (data, indices[, indptr])
+jax arrays, and compute paths use gather/scatter + segment-sum (XLA lowers
+these well) or densify when the op has no sparse path — mirroring the
+reference's "storage fallback" (``src/common/utils.h``). The row_sparse
+gradient path for embeddings is the important one for parity
+(SURVEY.md §2.3 "sparse/large-embedding parallelism").
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..context import current_context
+from .ndarray import NDArray, _wrap, array as _dense_array
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
+           "zeros", "cast_storage", "dot"]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common base; ``_data`` always holds the dense view lazily."""
+
+    __slots__ = ()
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse array: (indices -> rows) pair, rest implicitly zero."""
+
+    __slots__ = ("_rsp_data", "_rsp_indices")
+
+    def __init__(self, data, indices, shape, ctx=None):
+        dense = jnp.zeros(shape, data.dtype).at[indices.astype(jnp.int32)].set(data)
+        super().__init__(dense, ctx or current_context())
+        self._rsp_data = data
+        self._rsp_indices = indices.astype(jnp.int64)
+        self._stype = "row_sparse"
+
+    @property
+    def data(self):
+        return _wrap(self._rsp_data, self._ctx)
+
+    @property
+    def indices(self):
+        return _wrap(self._rsp_indices, self._ctx)
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return _wrap(self._data, self._ctx)
+        if stype == "csr":
+            return cast_storage(_wrap(self._data, self._ctx), "csr")
+        raise MXNetError("unknown stype %r" % stype)
+
+    def copy(self):
+        return RowSparseNDArray(self._rsp_data, self._rsp_indices, self.shape,
+                                self._ctx)
+
+    def retain(self, row_ids):
+        """Keep only listed rows (parity: mx.nd.sparse.retain)."""
+        rows = row_ids.asnumpy().astype(np.int64) if isinstance(row_ids, NDArray) \
+            else np.asarray(row_ids, np.int64)
+        mask = np.isin(np.asarray(self._rsp_indices), rows)
+        idx = np.asarray(self._rsp_indices)[mask]
+        data = np.asarray(self._rsp_data)[mask]
+        return RowSparseNDArray(jnp.asarray(data), jnp.asarray(idx), self.shape,
+                                self._ctx)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix."""
+
+    __slots__ = ("_csr_data", "_csr_indices", "_csr_indptr")
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        data_np = np.asarray(data)
+        ind_np = np.asarray(indices, np.int64)
+        ptr_np = np.asarray(indptr, np.int64)
+        dense = np.zeros(shape, data_np.dtype)
+        for r in range(shape[0]):
+            lo, hi = ptr_np[r], ptr_np[r + 1]
+            dense[r, ind_np[lo:hi]] = data_np[lo:hi]
+        super().__init__(jnp.asarray(dense), ctx or current_context())
+        self._csr_data = jnp.asarray(data_np)
+        self._csr_indices = jnp.asarray(ind_np)
+        self._csr_indptr = jnp.asarray(ptr_np)
+        self._stype = "csr"
+
+    @property
+    def data(self):
+        return _wrap(self._csr_data, self._ctx)
+
+    @property
+    def indices(self):
+        return _wrap(self._csr_indices, self._ctx)
+
+    @property
+    def indptr(self):
+        return _wrap(self._csr_indptr, self._ctx)
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return _wrap(self._data, self._ctx)
+        if stype == "row_sparse":
+            return cast_storage(_wrap(self._data, self._ctx), "row_sparse")
+        raise MXNetError("unknown stype %r" % stype)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Create a RowSparseNDArray (parity: mx.nd.sparse.row_sparse_array)."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = _dense_array(data, dtype=dtype)._data
+        indices = np.asarray(indices, np.int64)
+        if shape is None:
+            raise MXNetError("row_sparse_array: shape required")
+        return RowSparseNDArray(data, jnp.asarray(indices), tuple(shape), ctx)
+    dense = _dense_array(arg1, dtype=dtype)
+    return cast_storage(dense, "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Create a CSRNDArray (parity: mx.nd.sparse.csr_matrix)."""
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = _dense_array(data, dtype=dtype)._data
+        if shape is None:
+            raise MXNetError("csr_matrix: shape required")
+        return CSRNDArray(data, indices, indptr, tuple(shape), ctx)
+    dense = _dense_array(arg1, dtype=dtype)
+    return cast_storage(dense, "csr")
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    dt = np.dtype(dtype or np.float32)
+    if stype == "row_sparse":
+        return RowSparseNDArray(jnp.zeros((0,) + tuple(shape[1:]), dt),
+                                jnp.zeros((0,), jnp.int64), tuple(shape), ctx)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dt), np.zeros((0,), np.int64),
+                          np.zeros((shape[0] + 1,), np.int64), tuple(shape), ctx)
+    from .ndarray import zeros as _dz
+    return _dz(shape, ctx=ctx, dtype=dtype)
+
+
+def cast_storage(arr, stype):
+    """Convert between storage types (parity: mx.nd.cast_storage,
+    reference src/operator/tensor/cast_storage.cc)."""
+    if arr.stype == stype:
+        return arr
+    dense = np.asarray(arr.asnumpy())
+    if stype == "default":
+        return _wrap(jnp.asarray(dense), arr.context)
+    if stype == "row_sparse":
+        nz_rows = np.where(np.any(dense != 0, axis=tuple(range(1, dense.ndim))))[0]
+        return RowSparseNDArray(jnp.asarray(dense[nz_rows]),
+                                jnp.asarray(nz_rows.astype(np.int64)),
+                                dense.shape, arr.context)
+    if stype == "csr":
+        if dense.ndim != 2:
+            raise MXNetError("csr requires 2-D")
+        indptr = [0]
+        indices = []
+        data = []
+        for r in range(dense.shape[0]):
+            nz = np.nonzero(dense[r])[0]
+            indices.extend(nz.tolist())
+            data.extend(dense[r, nz].tolist())
+            indptr.append(len(indices))
+        return CSRNDArray(np.asarray(data, dense.dtype),
+                          np.asarray(indices, np.int64),
+                          np.asarray(indptr, np.int64), dense.shape, arr.context)
+    raise MXNetError("unknown stype %r" % stype)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot: on TPU sparse operands compute via their dense view
+    (XLA) — the API-level contract (csr·dense, csr^T·dense used by the
+    sparse linear-classification example) is preserved."""
+    from . import dot as _dense_dot
+    return _dense_dot(_wrap(lhs._data, lhs.context) if isinstance(lhs, BaseSparseNDArray) else lhs,
+                      _wrap(rhs._data, rhs.context) if isinstance(rhs, BaseSparseNDArray) else rhs,
+                      transpose_a=transpose_a, transpose_b=transpose_b)

@@ -1,0 +1,54 @@
+"""NDArray serialization: save/load.
+
+Parity: reference ``python/mxnet/ndarray/utils.py:149-185`` and the C
+``MXNDArraySave/Load`` (``c_api.h:358-371``). Format: NPZ container
+(name->array), a TPU-native replacement for the dmlc::Stream binary blob —
+same semantics (dict or list of arrays round-trips), portable, and
+mmap-friendly for host-side loading before device_put.
+"""
+from __future__ import annotations
+
+import os
+import zipfile
+
+import numpy as np
+
+from ..base import MXNetError
+from .ndarray import NDArray, array
+
+_LIST_PREFIX = "__mx_list__:"
+
+
+def save(fname, data):
+    """Save a list or dict of NDArrays (parity: mx.nd.save)."""
+    if isinstance(data, NDArray):
+        data = [data]
+    arrays = {}
+    if isinstance(data, dict):
+        for k, v in data.items():
+            if not isinstance(v, NDArray):
+                raise MXNetError("save: values must be NDArrays")
+            arrays[k] = v.asnumpy()
+    elif isinstance(data, (list, tuple)):
+        for i, v in enumerate(data):
+            if not isinstance(v, NDArray):
+                raise MXNetError("save: values must be NDArrays")
+            arrays[_LIST_PREFIX + str(i)] = v.asnumpy()
+    else:
+        raise MXNetError("save: data must be NDArray, list, or dict")
+    np.savez(fname if fname.endswith(".npz") else fname, **arrays)
+    # np.savez appends .npz; rename back for exact-path semantics
+    if not fname.endswith(".npz") and os.path.exists(fname + ".npz"):
+        os.replace(fname + ".npz", fname)
+
+
+def load(fname):
+    """Load NDArrays saved by :func:`save` (parity: mx.nd.load)."""
+    if not os.path.exists(fname):
+        raise MXNetError("load: no such file %r" % fname)
+    with np.load(fname, allow_pickle=False) as npz:
+        keys = list(npz.keys())
+        if keys and all(k.startswith(_LIST_PREFIX) for k in keys):
+            items = sorted(keys, key=lambda k: int(k[len(_LIST_PREFIX):]))
+            return [array(npz[k]) for k in items]
+        return {k: array(npz[k]) for k in keys}

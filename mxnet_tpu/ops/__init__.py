@@ -1,0 +1,14 @@
+"""Operator library: importing this package registers every op.
+
+The registry (ops/registry.py) is the single source from which the
+``mx.nd.*`` and ``mx.sym.*`` namespaces are generated, mirroring how the
+reference generates Python functions from its C++ NNVM registry.
+"""
+from .registry import OpDef, register, get_op, list_ops, alias  # noqa: F401
+from . import elemwise    # noqa: F401
+from . import reduce      # noqa: F401
+from . import tensor      # noqa: F401
+from . import nn          # noqa: F401
+from . import random_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
+from . import linalg      # noqa: F401

@@ -1,0 +1,150 @@
+"""Shared helpers for operator implementations.
+
+Handles the reference's kwargs conventions (tuples serialized as strings
+via the C API — reference parses them in dmlc::Parameter; we accept both
+Python tuples and their string forms), plus the execution-context
+plumbing JAX needs that the reference kept implicit in global state:
+train/predict mode (reference: ``Imperative::is_training``) and PRNG
+(reference: per-device ``Resource`` kRandom pools,
+``include/mxnet/resource.h:37-185``).
+"""
+from __future__ import annotations
+
+import ast
+import threading
+
+import jax
+import numpy as np
+
+from ..base import MXNetError
+
+_DTYPE_MAP = {
+    "float32": np.float32, "float64": np.float64, "float16": np.float16,
+    "bfloat16": jax.numpy.bfloat16, "uint8": np.uint8, "int8": np.int8,
+    "int32": np.int32, "int64": np.int64, "bool": np.bool_,
+}
+
+
+def mx_dtype(dtype):
+    """Normalise an MXNet dtype spec (string or np dtype) to a numpy/jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _DTYPE_MAP:
+            raise MXNetError("unknown dtype %r" % dtype)
+        return _DTYPE_MAP[dtype]
+    return dtype
+
+
+def as_tuple(v, ndim=None, name="param"):
+    """Parse kernel/stride/pad style params: tuple, int, or '(2, 2)' string."""
+    if v is None:
+        return None
+    if isinstance(v, str):
+        v = ast.literal_eval(v)
+    if v == () or v == []:
+        return None
+    if isinstance(v, int):
+        v = (v,) * (ndim or 1)
+    v = tuple(int(x) for x in v)
+    if ndim is not None and len(v) == 1 < ndim:
+        v = v * ndim
+    if ndim is not None and len(v) != ndim:
+        raise MXNetError("%s must have %d elements, got %r" % (name, ndim, v))
+    return v
+
+
+def as_axis(axis):
+    """Normalise reduce-style axis params: None, int, tuple, or string forms."""
+    if axis is None or axis == "()" or axis == ():
+        return None
+    if isinstance(axis, str):
+        axis = ast.literal_eval(axis)
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def reduce_axes(axis, ndim, exclude=False):
+    """Resolve MXNet reduce semantics (axis + exclude) to a concrete axis tuple."""
+    axis = as_axis(axis)
+    if axis is None:
+        axes = tuple(range(ndim))
+        return () if exclude else axes
+    if isinstance(axis, int):
+        axis = (axis,)
+    axes = tuple(sorted(a % ndim for a in axis))
+    if exclude:
+        axes = tuple(a for a in range(ndim) if a not in axes)
+    return axes
+
+
+# ---------------------------------------------------------------------------
+# Execution context: train mode + PRNG threading.
+# ---------------------------------------------------------------------------
+
+class _ExecState(threading.local):
+    def __init__(self):
+        self.train_mode = False
+        self.rng_provider = None   # callable () -> jax PRNG key, set by executor/trace
+        self.recording = False
+
+
+_STATE = _ExecState()
+
+
+def state():
+    return _STATE
+
+
+def is_train():
+    return _STATE.train_mode
+
+
+def take_rng():
+    """Get a PRNG key for a random op in the current execution context.
+
+    Inside a traced graph the executor installs a fold_in-based provider so
+    the key is a traced value; in eager mode we split the global seed state
+    (mxnet_tpu.random).
+    """
+    if _STATE.rng_provider is not None:
+        return _STATE.rng_provider()
+    from .. import random as _random
+    return _random.take_key()
+
+
+class rng_scope:
+    """Install an RNG provider (used by executor/CachedOp when tracing)."""
+
+    def __init__(self, key):
+        self._key = key
+        self._count = 0
+        self._old = None
+
+    def _provide(self):
+        k = jax.random.fold_in(self._key, self._count)
+        self._count += 1
+        return k
+
+    def __enter__(self):
+        self._old = _STATE.rng_provider
+        _STATE.rng_provider = self._provide
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.rng_provider = self._old
+
+
+class train_scope:
+    def __init__(self, mode=True):
+        self._mode = mode
+        self._old = None
+
+    def __enter__(self):
+        self._old = _STATE.train_mode
+        _STATE.train_mode = self._mode
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.train_mode = self._old

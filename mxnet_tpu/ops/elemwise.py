@@ -1,0 +1,216 @@
+"""Elementwise operators: binary broadcast, scalar, unary, comparisons.
+
+Parity: reference ``src/operator/tensor/elemwise_binary_broadcast_op_*.cc``,
+``elemwise_binary_op_*.cc``, ``elemwise_binary_scalar_op_*.cc``,
+``elemwise_unary_op.cc`` (the ~40 unary math ops listed there) and
+``elemwise_sum.cc`` (add_n). On TPU these all lower to single VPU-fused
+XLA HLOs — no hand kernels needed; XLA fuses chains of these into
+neighbouring MXU ops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, alias
+
+# ---------------------------------------------------------------------------
+# Binary broadcast ops (reference: NNVM "broadcast_*" family)
+# ---------------------------------------------------------------------------
+
+_BINARY = {
+    "broadcast_add": jnp.add,
+    "broadcast_sub": jnp.subtract,
+    "broadcast_mul": jnp.multiply,
+    "broadcast_div": jnp.divide,
+    "broadcast_mod": jnp.mod,
+    "broadcast_power": jnp.power,
+    "broadcast_maximum": jnp.maximum,
+    "broadcast_minimum": jnp.minimum,
+    "broadcast_hypot": jnp.hypot,
+}
+_BINARY_ALIASES = {
+    "broadcast_add": ("broadcast_plus",),
+    "broadcast_sub": ("broadcast_minus",),
+}
+
+_CMP = {
+    "broadcast_equal": jnp.equal,
+    "broadcast_not_equal": jnp.not_equal,
+    "broadcast_greater": jnp.greater,
+    "broadcast_greater_equal": jnp.greater_equal,
+    "broadcast_lesser": jnp.less,
+    "broadcast_lesser_equal": jnp.less_equal,
+}
+
+
+def _make_binary(fn, cast_bool):
+    def op(lhs, rhs):
+        out = fn(lhs, rhs)
+        if cast_bool:
+            out = out.astype(lhs.dtype)
+        return out
+    return op
+
+
+for _name, _fn in _BINARY.items():
+    register(_name, nin=2, aliases=_BINARY_ALIASES.get(_name, ()))(_make_binary(_fn, False))
+for _name, _fn in _CMP.items():
+    # reference comparison ops return same-dtype 0/1 tensors, not bool
+    register(_name, nin=2, no_grad=True)(_make_binary(_fn, True))
+
+# elemwise_* are the no-broadcast variants; identical on XLA
+for _ew, _bc in [("elemwise_add", jnp.add), ("elemwise_sub", jnp.subtract),
+                 ("elemwise_mul", jnp.multiply), ("elemwise_div", jnp.divide)]:
+    register(_ew, nin=2)(_make_binary(_bc, False))
+alias("elemwise_add", "_add", "_plus", "_Plus")
+alias("elemwise_sub", "_sub", "_minus", "_Minus")
+alias("elemwise_mul", "_mul", "_Mul")
+alias("elemwise_div", "_div", "_Div")
+
+
+@register("add_n", nin=-1, arg_names=["args"], aliases=("ElementWiseSum", "_sum"))
+def add_n(*args):
+    """Sum of N tensors (reference src/operator/tensor/elemwise_sum.cc)."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Scalar ops (reference: "_plus_scalar" family backing NDArray operators)
+# ---------------------------------------------------------------------------
+
+def _scalar_op(name, fn, reverse=False, cast=False, aliases=()):
+    def op(data, scalar=1.0):
+        s = jnp.asarray(scalar, dtype=data.dtype)
+        out = fn(s, data) if reverse else fn(data, s)
+        if cast:
+            out = out.astype(data.dtype)
+        return out
+    register(name, nin=1, defaults={"scalar": 1.0}, no_grad=cast, aliases=aliases)(op)
+
+
+_scalar_op("_plus_scalar", jnp.add, aliases=("_PlusScalar",))
+_scalar_op("_minus_scalar", jnp.subtract, aliases=("_MinusScalar",))
+_scalar_op("_rminus_scalar", jnp.subtract, reverse=True, aliases=("_RMinusScalar",))
+_scalar_op("_mul_scalar", jnp.multiply, aliases=("_MulScalar",))
+_scalar_op("_div_scalar", jnp.divide, aliases=("_DivScalar",))
+_scalar_op("_rdiv_scalar", jnp.divide, reverse=True, aliases=("_RDivScalar",))
+_scalar_op("_mod_scalar", jnp.mod)
+_scalar_op("_rmod_scalar", jnp.mod, reverse=True)
+_scalar_op("_power_scalar", jnp.power, aliases=("_PowerScalar",))
+_scalar_op("_rpower_scalar", jnp.power, reverse=True, aliases=("_RPowerScalar",))
+_scalar_op("_maximum_scalar", jnp.maximum, aliases=("_MaximumScalar",))
+_scalar_op("_minimum_scalar", jnp.minimum, aliases=("_MinimumScalar",))
+_scalar_op("_hypot_scalar", jnp.hypot)
+_scalar_op("_equal_scalar", jnp.equal, cast=True)
+_scalar_op("_not_equal_scalar", jnp.not_equal, cast=True)
+_scalar_op("_greater_scalar", jnp.greater, cast=True)
+_scalar_op("_greater_equal_scalar", jnp.greater_equal, cast=True)
+_scalar_op("_lesser_scalar", jnp.less, cast=True)
+_scalar_op("_lesser_equal_scalar", jnp.less_equal, cast=True)
+
+
+# ---------------------------------------------------------------------------
+# Unary math ops (reference: elemwise_unary_op.cc)
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "abs": jnp.abs, "sign": jnp.sign, "round": jnp.round, "rint": jnp.rint,
+    "ceil": jnp.ceil, "floor": jnp.floor, "trunc": jnp.trunc,
+    "fix": jnp.trunc, "square": jnp.square, "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "cbrt": jnp.cbrt, "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp, "log": jnp.log, "log10": jnp.log10, "log2": jnp.log2,
+    "log1p": jnp.log1p, "expm1": jnp.expm1,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "arcsin": jnp.arcsin, "arccos": jnp.arccos, "arctan": jnp.arctan,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+    "erf": jax.scipy.special.erf,
+    "negative": jnp.negative,
+    "reciprocal": lambda x: 1.0 / x,
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+}
+_UNARY_NO_GRAD = {"sign", "round", "rint", "ceil", "floor", "trunc", "fix",
+                  "logical_not"}
+_UNARY_ALIASES = {"abs": ("_abs",), "negative": ("_negative",)}
+
+for _name, _fn in _UNARY.items():
+    register(_name, nin=1, no_grad=_name in _UNARY_NO_GRAD,
+             aliases=_UNARY_ALIASES.get(_name, ()))(_fn)
+
+
+@register("relu")
+def relu(data):
+    """Rectified linear unit (reference elemwise_unary_op.cc "relu")."""
+    return jnp.maximum(data, 0)
+
+
+@register("sigmoid")
+def sigmoid(data):
+    return jax.nn.sigmoid(data)
+
+
+@register("softsign")
+def softsign(data):
+    return data / (1 + jnp.abs(data))
+
+
+@register("clip", defaults={"a_min": 0.0, "a_max": 1.0})
+def clip(data, a_min=0.0, a_max=1.0):
+    return jnp.clip(data, a_min, a_max)
+
+
+@register("_copy", aliases=("identity",))
+def _copy(data):
+    return data
+
+
+@register("BlockGrad", aliases=("stop_gradient",))
+def block_grad(data):
+    """Stop gradient flow (reference elemwise_unary_op.cc BlockGrad)."""
+    return jax.lax.stop_gradient(data)
+
+
+@register("make_loss")
+def make_loss_op(data):
+    return data
+
+
+@register("_identity_with_attr_like_rhs", nin=2)
+def _identity_with_attr_like_rhs(lhs, rhs):
+    return lhs
+
+
+@register("Cast", defaults={"dtype": "float32"}, aliases=("cast",))
+def cast(data, dtype="float32"):
+    from .common import mx_dtype
+    return data.astype(mx_dtype(dtype))
+
+
+@register("zeros_like")
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like")
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register("smooth_l1", defaults={"scalar": 1.0})
+def smooth_l1(data, scalar=1.0):
+    """Smooth L1 (reference elemwise_binary_scalar_op_extended.cc; used by SSD).
+
+    f(x) = 0.5 (sigma x)^2 if |x| < 1/sigma^2 else |x| - 0.5/sigma^2
+    """
+    sigma2 = scalar * scalar
+    absx = jnp.abs(data)
+    return jnp.where(absx < 1.0 / sigma2, 0.5 * sigma2 * data * data,
+                     absx - 0.5 / sigma2)

@@ -1,0 +1,82 @@
+"""Linear-algebra operators (``linalg_*`` namespace).
+
+Parity: reference ``src/operator/tensor/la_op.cc`` (LAPACK-backed
+potrf/potri/trmm/trsm/gemm/gemm2/sumlogdiag via ``c_lapack_api.h``).
+XLA provides native TPU lowerings for all of these.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.linalg as jsl
+
+from .registry import register
+
+
+def _t(x, transpose):
+    return jnp.swapaxes(x, -1, -2) if transpose else x
+
+
+@register("_linalg_gemm", nin=3, arg_names=["A", "B", "C"],
+          defaults={"transpose_a": False, "transpose_b": False, "alpha": 1.0,
+                    "beta": 1.0}, aliases=("linalg_gemm",))
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, axis=-2):
+    return alpha * jnp.matmul(_t(A, transpose_a), _t(B, transpose_b)) + beta * C
+
+
+@register("_linalg_gemm2", nin=2, arg_names=["A", "B"],
+          defaults={"transpose_a": False, "transpose_b": False, "alpha": 1.0},
+          aliases=("linalg_gemm2",))
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0,
+                 axis=-2):
+    return alpha * jnp.matmul(_t(A, transpose_a), _t(B, transpose_b))
+
+
+@register("_linalg_potrf", aliases=("linalg_potrf",))
+def linalg_potrf(A):
+    """Cholesky factor L with zeroed upper triangle (reference la_op.cc potrf)."""
+    return jnp.linalg.cholesky(A)
+
+
+@register("_linalg_potri", aliases=("linalg_potri",))
+def linalg_potri(A):
+    """Inverse of A A^T given its Cholesky factor A=L (reference potri)."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    linv = jsl.solve_triangular(A, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register("_linalg_trmm", nin=2, arg_names=["A", "B"],
+          defaults={"transpose": False, "rightside": False, "alpha": 1.0},
+          aliases=("linalg_trmm",))
+def linalg_trmm(A, B, transpose=False, rightside=False, alpha=1.0, lower=True):
+    a = _t(A, transpose)
+    out = jnp.matmul(B, a) if rightside else jnp.matmul(a, B)
+    return alpha * out
+
+
+@register("_linalg_trsm", nin=2, arg_names=["A", "B"],
+          defaults={"transpose": False, "rightside": False, "alpha": 1.0},
+          aliases=("linalg_trsm",))
+def linalg_trsm(A, B, transpose=False, rightside=False, alpha=1.0, lower=True):
+    if rightside:
+        # solve X A = alpha B  ->  A^T X^T = alpha B^T
+        out = jsl.solve_triangular(_t(A, not transpose), _t(alpha * B, True),
+                                   lower=(lower != transpose))
+        return _t(out, True)
+    return jsl.solve_triangular(_t(A, transpose), alpha * B,
+                                lower=(lower != transpose))
+
+
+@register("_linalg_sumlogdiag", aliases=("linalg_sumlogdiag",))
+def linalg_sumlogdiag(A):
+    diag = jnp.diagonal(A, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(diag), axis=-1)
+
+
+@register("_linalg_syrk", defaults={"transpose": False, "alpha": 1.0},
+          aliases=("linalg_syrk",))
+def linalg_syrk(A, transpose=False, alpha=1.0):
+    a = _t(A, transpose)
+    return alpha * jnp.matmul(a, jnp.swapaxes(a, -1, -2))

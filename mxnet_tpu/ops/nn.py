@@ -1,0 +1,611 @@
+"""Neural-network operators.
+
+Parity: reference ``src/operator/`` legacy layer ops (fully_connected-inl.h,
+convolution-inl.h + cudnn_convolution, pooling-inl.h, batch_norm.cc,
+activation-inl.h, leaky_relu-inl.h, dropout-inl.h, lrn-inl.h,
+l2_normalization-inl.h, instance_norm-inl.h, upsampling-inl.h,
+softmax_output-inl.h, regression_output-inl.h, make_loss-inl.h) and
+``src/operator/nn/softmax-inl.h``.
+
+TPU-first notes: convs/matmuls map directly onto the MXU via
+``lax.conv_general_dilated`` / ``jnp.dot`` — XLA picks layouts and fuses
+the elementwise epilogues (bias, activation, BN scale) into them, which
+is what the reference needed cuDNN fused kernels for. Ops that behave
+differently in train vs inference (BatchNorm, Dropout) take a ``_train``
+flag injected by the execution layer; random ops take ``_rng``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .common import as_tuple, mx_dtype
+from .registry import register, get_op
+
+
+# ---------------------------------------------------------------------------
+# FullyConnected
+# ---------------------------------------------------------------------------
+
+@register("FullyConnected", nin=3, arg_names=["data", "weight", "bias"],
+          defaults={"num_hidden": 0, "no_bias": False, "flatten": True})
+def fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False,
+                    flatten=True):
+    """y = x W^T + b (reference fully_connected-inl.h:69-114, linalg_gemm).
+
+    Weight layout (num_hidden, in_units) matches the reference exactly.
+    """
+    if flatten and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    out = jnp.dot(data, weight.T)
+    if not no_bias and bias is not None:
+        out = out + bias
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution
+# ---------------------------------------------------------------------------
+
+def _conv_nd(data, weight, bias, kernel, stride, dilate, pad, num_group,
+             no_bias, transposed=False, adj=None, target_shape=None):
+    ndim = len(kernel)
+    stride = stride or (1,) * ndim
+    dilate = dilate or (1,) * ndim
+    pad = pad or (0,) * ndim
+    # NC + spatial dims; weight OIHW (deconv: IOHW in reference; we keep OIHW
+    # at this layer and the Deconvolution wrapper adapts).
+    lhs_spec = "NC" + "DHW"[3 - ndim:]
+    dn = jax.lax.conv_dimension_numbers(
+        data.shape, weight.shape,
+        (lhs_spec, "OI" + "DHW"[3 - ndim:], lhs_spec))
+    if not transposed:
+        out = jax.lax.conv_general_dilated(
+            data, weight, window_strides=stride,
+            padding=[(p, p) for p in pad],
+            rhs_dilation=dilate, dimension_numbers=dn,
+            feature_group_count=int(num_group))
+    else:
+        adj = adj or (0,) * ndim
+        k_eff = [(k - 1) * d + 1 for k, d in zip(kernel, dilate)]
+        padding = [(ke - 1 - p, ke - 1 - p + a)
+                   for ke, p, a in zip(k_eff, pad, adj)]
+        # transposed conv = lhs-dilated conv with flipped, transposed kernel
+        w = jnp.flip(weight, axis=tuple(range(2, 2 + ndim)))
+        w = jnp.swapaxes(w, 0, 1)
+        if int(num_group) > 1:
+            g = int(num_group)
+            # weight arrives as (in, out/g, ...) after swap when grouped
+            w = w.reshape((g, w.shape[0] // g) + w.shape[1:])
+            w = jnp.concatenate([w[i] for i in range(g)], axis=0)
+        out = jax.lax.conv_general_dilated(
+            data, w, window_strides=(1,) * ndim, padding=padding,
+            lhs_dilation=stride, dimension_numbers=dn,
+            feature_group_count=int(num_group))
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * ndim)
+    return out
+
+
+@register("Convolution", nin=3, arg_names=["data", "weight", "bias"],
+          defaults={"kernel": (), "stride": (), "dilate": (), "pad": (),
+                    "num_filter": 0, "num_group": 1, "no_bias": False,
+                    "workspace": 1024, "cudnn_tune": None, "cudnn_off": False,
+                    "layout": None})
+def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                pad=(), num_filter=0, num_group=1, no_bias=False,
+                workspace=1024, cudnn_tune=None, cudnn_off=False, layout=None):
+    """N-D convolution, NCHW/NCDHW layouts (reference convolution-inl.h).
+
+    workspace/cudnn_* knobs are accepted for API parity and ignored — XLA
+    owns algorithm choice and scratch on TPU.
+    """
+    kernel = as_tuple(kernel)
+    ndim = len(kernel)
+    return _conv_nd(data, weight, bias, kernel, as_tuple(stride, ndim),
+                    as_tuple(dilate, ndim), as_tuple(pad, ndim), num_group,
+                    no_bias)
+
+
+@register("Deconvolution", nin=3, arg_names=["data", "weight", "bias"],
+          defaults={"kernel": (), "stride": (), "dilate": (), "pad": (),
+                    "adj": (), "target_shape": (), "num_filter": 0,
+                    "num_group": 1, "no_bias": True, "workspace": 512,
+                    "cudnn_tune": None, "cudnn_off": False, "layout": None})
+def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                  pad=(), adj=(), target_shape=(), num_filter=0, num_group=1,
+                  no_bias=True, workspace=512, cudnn_tune=None,
+                  cudnn_off=False, layout=None):
+    """Transposed convolution (reference deconvolution-inl.h). Weight layout
+    (in_channels, num_filter/g, *kernel) as in the reference."""
+    kernel = as_tuple(kernel)
+    ndim = len(kernel)
+    return _conv_nd(data, jnp.swapaxes(weight, 0, 1), bias, kernel,
+                    as_tuple(stride, ndim), as_tuple(dilate, ndim),
+                    as_tuple(pad, ndim), num_group, no_bias, transposed=True,
+                    adj=as_tuple(adj, ndim) if adj else None)
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+
+@register("Pooling", defaults={"kernel": (), "pool_type": "max", "stride": (),
+                               "pad": (), "global_pool": False,
+                               "pooling_convention": "valid", "cudnn_off": False})
+def pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
+            global_pool=False, pooling_convention="valid", cudnn_off=False):
+    """Max/avg/sum pooling over NC+spatial input (reference pooling-inl.h).
+
+    'full' convention (ceil division of output size) is implemented by
+    right-padding up to what ceil needs, matching reference behaviour.
+    """
+    ndim = data.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, data.ndim))
+        if pool_type == "max":
+            out = jnp.max(data, axis=axes, keepdims=True)
+        elif pool_type in ("avg", "sum"):
+            out = jnp.sum(data, axis=axes, keepdims=True)
+            if pool_type == "avg":
+                out = out / np.prod(data.shape[2:])
+        else:
+            raise MXNetError("bad pool_type %r" % pool_type)
+        return out
+    kernel = as_tuple(kernel, ndim)
+    stride = as_tuple(stride, ndim) or (1,) * ndim
+    pad = as_tuple(pad, ndim) or (0,) * ndim
+
+    pads = []
+    for i in range(ndim):
+        lo = hi = pad[i]
+        if pooling_convention == "full":
+            size = data.shape[2 + i] + 2 * pad[i]
+            rem = (size - kernel[i]) % stride[i]
+            if rem:
+                hi += stride[i] - rem
+        pads.append((lo, hi))
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padding = [(0, 0), (0, 0)] + pads
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return jax.lax.reduce_window(data, init, jax.lax.max, window, strides, padding)
+    if pool_type in ("avg", "sum"):
+        out = jax.lax.reduce_window(data, 0.0, jax.lax.add, window, strides, padding)
+        if pool_type == "avg":
+            # reference avg pooling counts padded cells in the divisor only
+            # when pad>0 was explicit; MXNet divides by full kernel size.
+            out = out / np.prod(kernel)
+        return out
+    raise MXNetError("bad pool_type %r" % pool_type)
+
+
+# ---------------------------------------------------------------------------
+# Activations
+# ---------------------------------------------------------------------------
+
+@register("Activation", defaults={"act_type": "relu"})
+def activation(data, act_type="relu"):
+    """(reference activation-inl.h; act types relu/sigmoid/tanh/softrelu/softsign)"""
+    if act_type == "relu":
+        return jnp.maximum(data, 0)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jax.nn.softplus(data)
+    if act_type == "softsign":
+        return data / (1 + jnp.abs(data))
+    raise MXNetError("unknown act_type %r" % act_type)
+
+
+@register("LeakyReLU", nin=2, arg_names=["data", "gamma"],
+          defaults={"act_type": "leaky", "slope": 0.25, "lower_bound": 0.125,
+                    "upper_bound": 0.334})
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
+               lower_bound=0.125, upper_bound=0.334, _train=False, _rng=None):
+    """(reference leaky_relu-inl.h: leaky/prelu/elu/rrelu)"""
+    if act_type == "leaky":
+        return jnp.where(data >= 0, data, slope * data)
+    if act_type == "elu":
+        return jnp.where(data >= 0, data, slope * jnp.expm1(data))
+    if act_type == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2)) if gamma.ndim == 1 else gamma
+        return jnp.where(data >= 0, data, g * data)
+    if act_type == "rrelu":
+        if _train and _rng is not None:
+            s = jax.random.uniform(_rng, data.shape, dtype=data.dtype,
+                                   minval=lower_bound, maxval=upper_bound)
+        else:
+            s = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data >= 0, data, s * data)
+    raise MXNetError("unknown act_type %r" % act_type)
+
+
+# ---------------------------------------------------------------------------
+# Normalisation
+# ---------------------------------------------------------------------------
+
+@register("BatchNorm", nin=5,
+          arg_names=["data", "gamma", "beta", "moving_mean", "moving_var"],
+          nout=3,
+          defaults={"eps": 1e-3, "momentum": 0.9, "fix_gamma": True,
+                    "use_global_stats": False, "output_mean_var": False,
+                    "axis": 1, "cudnn_off": False})
+def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+               momentum=0.9, fix_gamma=True, use_global_stats=False,
+               output_mean_var=False, axis=1, cudnn_off=False, _train=False):
+    """Batch normalisation (reference batch_norm.cc / cudnn_batch_norm-inl.h).
+
+    Returns (out, mean, var): in training mode mean/var are the batch
+    statistics the executor uses to update the moving aux states
+    (moving = momentum*moving + (1-momentum)*batch, as the reference kernel
+    does in-place); in inference mode they echo the moving stats.
+    """
+    axis = int(axis) % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != axis)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    if _train and not use_global_stats:
+        mean = jnp.mean(data, axis=red)
+        var = jnp.var(data, axis=red)
+    else:
+        mean, var = moving_mean, moving_var
+        mean = jax.lax.stop_gradient(mean)
+        var = jax.lax.stop_gradient(var)
+    inv = jax.lax.rsqrt(var + eps)
+    out = (data - mean.reshape(shape)) * (inv * g).reshape(shape) + beta.reshape(shape)
+    return out, mean, var
+
+
+def _bn_stateful_update(raw_inputs, raw_outputs, params):
+    """Moving-stat update the reference BatchNorm kernel does in place."""
+    if not params.get("_train") or params.get("use_global_stats"):
+        return {}
+    momentum = params.get("momentum", 0.9)
+    _, mean, var = raw_outputs[:3]
+    new_mean = momentum * raw_inputs[3] + (1 - momentum) * mean
+    new_var = momentum * raw_inputs[4] + (1 - momentum) * var
+    return {3: new_mean, 4: new_var}
+
+
+_bn = get_op("BatchNorm")
+_bn.visible_outputs = 1
+_bn.aux_inputs = (3, 4)
+_bn.stateful_update = _bn_stateful_update
+
+
+@register("LRN", defaults={"alpha": 1e-4, "beta": 0.75, "knorm": 2.0, "nsize": 5})
+def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Local response norm across channels (reference lrn-inl.h)."""
+    nsize = int(nsize)
+    sq = jnp.square(data)
+    # sum over a window of nsize channels centred at each channel
+    pad = nsize // 2
+    sq_p = jnp.pad(sq, [(0, 0), (pad, pad)] + [(0, 0)] * (data.ndim - 2))
+    win = sum(sq_p[:, i:i + data.shape[1]] for i in range(nsize))
+    return data * jnp.power(knorm + alpha * win / nsize, -beta)
+
+
+@register("L2Normalization", defaults={"eps": 1e-10, "mode": "instance"})
+def l2_normalization(data, eps=1e-10, mode="instance"):
+    """(reference l2_normalization-inl.h; modes instance/channel/spatial)"""
+    if mode == "instance":
+        axes = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    elif mode == "spatial":
+        axes = tuple(range(2, data.ndim))
+    else:
+        raise MXNetError("unknown mode %r" % mode)
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=True) + eps)
+    return data / norm
+
+
+@register("InstanceNorm", nin=3, arg_names=["data", "gamma", "beta"],
+          defaults={"eps": 1e-3})
+def instance_norm(data, gamma, beta, eps=1e-3):
+    axes = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=axes, keepdims=True)
+    var = jnp.var(data, axis=axes, keepdims=True)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return (data - mean) * jax.lax.rsqrt(var + eps) * gamma.reshape(shape) \
+        + beta.reshape(shape)
+
+
+@register("LayerNorm", nin=3, arg_names=["data", "gamma", "beta"],
+          defaults={"axis": -1, "eps": 1e-5, "output_mean_var": False})
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    """Layer normalisation (new-framework addition; needed for attention)."""
+    axis = int(axis) % data.ndim
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    out = (data - mean) * jax.lax.rsqrt(var + eps)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# Dropout
+# ---------------------------------------------------------------------------
+
+@register("Dropout", defaults={"p": 0.5, "mode": "training", "axes": ()})
+def dropout(data, p=0.5, mode="training", axes=(), _train=False, _rng=None):
+    """(reference dropout-inl.h). Scales by 1/(1-p) at train time."""
+    if (not _train and mode != "always") or p <= 0 or _rng is None:
+        return data
+    shape = data.shape
+    axes = as_tuple(axes) or ()
+    if axes:
+        shape = tuple(1 if i in axes else s for i, s in enumerate(shape))
+    keep = jax.random.bernoulli(_rng, 1.0 - p, shape)
+    return jnp.where(keep, data / (1.0 - p), jnp.zeros_like(data))
+
+
+# ---------------------------------------------------------------------------
+# Softmax family
+# ---------------------------------------------------------------------------
+
+@register("softmax", defaults={"axis": -1, "temperature": None})
+def softmax(data, axis=-1, temperature=None):
+    """(reference src/operator/nn/softmax-inl.h)"""
+    if temperature is not None and temperature != 1.0:
+        data = data / temperature
+    return jax.nn.softmax(data, axis=int(axis))
+
+
+@register("log_softmax", defaults={"axis": -1, "temperature": None})
+def log_softmax(data, axis=-1, temperature=None):
+    if temperature is not None and temperature != 1.0:
+        data = data / temperature
+    return jax.nn.log_softmax(data, axis=int(axis))
+
+
+@register("SoftmaxActivation", defaults={"mode": "instance"})
+def softmax_activation(data, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+@register("softmax_cross_entropy", nin=2, arg_names=["data", "label"])
+def softmax_cross_entropy(data, label):
+    """(reference src/operator/loss_binary_op.cc): scalar summed CE."""
+    logp = jax.nn.log_softmax(data, axis=-1)
+    picked = jnp.take_along_axis(logp, label.astype(jnp.int32)[:, None], axis=-1)
+    return -jnp.sum(picked).reshape((1,))
+
+
+def _softmax_out_grad(prob, label, grad_scale, ignore_label, use_ignore,
+                      normalization, multi_output):
+    """Shared SoftmaxOutput backward: prob - one_hot(label)."""
+    if multi_output:
+        # prob: (n, k, d1...), label: (n, d1...)
+        oh = jax.nn.one_hot(label.astype(jnp.int32), prob.shape[1],
+                            dtype=prob.dtype, axis=1)
+    else:
+        oh = jax.nn.one_hot(label.astype(jnp.int32), prob.shape[-1],
+                            dtype=prob.dtype)
+    grad = prob - oh
+    valid = None
+    if use_ignore:
+        mask = (label.astype(jnp.int32) != int(ignore_label))
+        if multi_output:
+            grad = grad * mask[:, None].astype(prob.dtype)
+        else:
+            grad = grad * mask.reshape(mask.shape + (1,) * (grad.ndim - mask.ndim)).astype(prob.dtype)
+        valid = jnp.maximum(jnp.sum(mask.astype(prob.dtype)), 1.0)
+    if normalization == "valid" and valid is not None:
+        grad = grad / valid
+    elif normalization == "batch":
+        grad = grad / prob.shape[0]
+    return grad * grad_scale
+
+
+@register("SoftmaxOutput", nin=2, arg_names=["data", "label"],
+          defaults={"grad_scale": 1.0, "ignore_label": -1.0, "multi_output": False,
+                    "use_ignore": False, "preserve_shape": False,
+                    "normalization": "null", "out_grad": False,
+                    "smooth_alpha": 0.0},
+          aliases=("Softmax",))
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                   multi_output=False, use_ignore=False, preserve_shape=False,
+                   normalization="null", out_grad=False, smooth_alpha=0.0):
+    """Softmax with implicit cross-entropy gradient
+    (reference softmax_output-inl.h). Forward = softmax(data); backward
+    ignores the incoming head gradient (it is a loss layer) and emits
+    (p - onehot(label)) * grad_scale, exactly as the reference kernel.
+    Implemented with jax.custom_vjp since the gradient is not the vjp of
+    the forward function.
+    """
+    axis = 1 if multi_output else -1
+
+    @jax.custom_vjp
+    def _fwd(d, l):
+        return jax.nn.softmax(d, axis=axis)
+
+    def _fwd_fwd(d, l):
+        p = jax.nn.softmax(d, axis=axis)
+        return p, (p, l)
+
+    def _fwd_bwd(res, g):
+        p, l = res
+        grad = _softmax_out_grad(p, l, grad_scale, ignore_label, use_ignore,
+                                 normalization, multi_output)
+        return grad.astype(p.dtype), jnp.zeros_like(l)
+
+    _fwd.defvjp(_fwd_fwd, _fwd_bwd)
+    return _fwd(data, label)
+
+
+def _regression_output(transform, grad_fn):
+    def op(data, label, grad_scale=1.0):
+        @jax.custom_vjp
+        def _fwd(d, l):
+            return transform(d)
+
+        def _fwd_fwd(d, l):
+            out = transform(d)
+            return out, (out, l)
+
+        def _fwd_bwd(res, g):
+            out, l = res
+            grad = grad_fn(out, l.reshape(out.shape)) * grad_scale
+            return grad.astype(out.dtype), jnp.zeros_like(l)
+
+        _fwd.defvjp(_fwd_fwd, _fwd_bwd)
+        return _fwd(data, label)
+    return op
+
+
+register("LinearRegressionOutput", nin=2, arg_names=["data", "label"],
+         defaults={"grad_scale": 1.0})(
+    _regression_output(lambda d: d, lambda o, l: o - l))
+register("MAERegressionOutput", nin=2, arg_names=["data", "label"],
+         defaults={"grad_scale": 1.0})(
+    _regression_output(lambda d: d, lambda o, l: jnp.sign(o - l)))
+register("LogisticRegressionOutput", nin=2, arg_names=["data", "label"],
+         defaults={"grad_scale": 1.0})(
+    _regression_output(jax.nn.sigmoid, lambda o, l: o - l))
+
+
+@register("MakeLoss", defaults={"grad_scale": 1.0, "valid_thresh": 0.0,
+                                "normalization": "null"})
+def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    """(reference make_loss-inl.h): forward identity, backward = grad_scale."""
+    @jax.custom_vjp
+    def _fwd(d):
+        return d
+
+    def _fwd_fwd(d):
+        return d, d
+
+    def _fwd_bwd(d, g):
+        scale = grad_scale
+        if normalization == "batch":
+            scale = scale / d.shape[0]
+        elif normalization == "valid":
+            valid = jnp.maximum(jnp.sum((d > valid_thresh).astype(d.dtype)), 1.0)
+            return ((jnp.ones_like(d) * scale) / valid,)
+        return (jnp.full_like(d, scale),)
+
+    _fwd.defvjp(_fwd_fwd, _fwd_bwd)
+    return _fwd(data)
+
+
+@register("SVMOutput", nin=2, arg_names=["data", "label"],
+          defaults={"margin": 1.0, "regularization_coefficient": 1.0,
+                    "use_linear": False})
+def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False):
+    """(reference svm_output-inl.h). Forward identity; backward hinge-loss grad."""
+    @jax.custom_vjp
+    def _fwd(d, l):
+        return d
+
+    def _fwd_fwd(d, l):
+        return d, (d, l)
+
+    def _fwd_bwd(res, g):
+        d, l = res
+        oh = jax.nn.one_hot(l.astype(jnp.int32), d.shape[-1], dtype=d.dtype)
+        score_y = jnp.sum(d * oh, axis=-1, keepdims=True)
+        if use_linear:
+            viol = ((d - score_y + margin) > 0).astype(d.dtype) * (1 - oh)
+            grad = viol - oh * jnp.sum(viol, axis=-1, keepdims=True)
+        else:
+            dist = jnp.maximum(d - score_y + margin, 0) * (1 - oh)
+            grad = 2 * dist - oh * jnp.sum(2 * dist, axis=-1, keepdims=True)
+        return (grad * regularization_coefficient).astype(d.dtype), jnp.zeros_like(l)
+
+    _fwd.defvjp(_fwd_fwd, _fwd_bwd)
+    return _fwd(data, label)
+
+
+# ---------------------------------------------------------------------------
+# UpSampling
+# ---------------------------------------------------------------------------
+
+@register("UpSampling", nin=-1,
+          defaults={"scale": 1, "sample_type": "nearest", "num_filter": 0,
+                    "multi_input_mode": "concat", "num_args": 1, "workspace": 512})
+def upsampling(*args, scale=1, sample_type="nearest", num_filter=0,
+               multi_input_mode="concat", num_args=1, workspace=512):
+    """(reference upsampling-inl.h). nearest mode; bilinear mode uses the
+    deconvolution path like the reference."""
+    scale = int(scale)
+    if sample_type == "nearest":
+        outs = []
+        for d in args:
+            o = jnp.repeat(jnp.repeat(d, scale, axis=2), scale, axis=3)
+            outs.append(o)
+        if len(outs) == 1:
+            return outs[0]
+        h = max(o.shape[2] for o in outs)
+        outs = [o if o.shape[2] == h else
+                jnp.repeat(jnp.repeat(o, h // o.shape[2], axis=2),
+                           h // o.shape[3], axis=3) for o in outs]
+        if multi_input_mode == "sum":
+            return sum(outs)
+        return jnp.concatenate(outs, axis=1)
+    if sample_type == "bilinear":
+        data, weight = args
+        kernel = 2 * scale - scale % 2
+        pad = int(np.ceil((scale - 1) / 2.0))
+        return _conv_nd(data, jnp.swapaxes(weight, 0, 1), None,
+                        (kernel, kernel), (scale, scale), None, (pad, pad),
+                        num_group=data.shape[1], no_bias=True, transposed=True)
+    raise MXNetError("unknown sample_type %r" % sample_type)
+
+
+# ---------------------------------------------------------------------------
+# Sequence ops (reference src/operator/sequence_*.cc)
+# ---------------------------------------------------------------------------
+
+@register("SequenceLast", nin=2, arg_names=["data", "sequence_length"],
+          defaults={"use_sequence_length": False, "axis": 0})
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    axis = int(axis)
+    if not use_sequence_length or sequence_length is None:
+        return jnp.take(data, data.shape[axis] - 1, axis=axis)
+    idx = (sequence_length.astype(jnp.int32) - 1)
+    batch = jnp.arange(data.shape[1 - axis])
+    if axis == 0:
+        return data[idx, batch]
+    return data[batch, idx]
+
+
+@register("SequenceMask", nin=2, arg_names=["data", "sequence_length"],
+          defaults={"use_sequence_length": False, "value": 0.0, "axis": 0})
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    axis = int(axis)
+    T = data.shape[axis]
+    steps = jnp.arange(T)
+    mask = steps[:, None] < sequence_length.astype(jnp.int32)[None, :]  # (T, B)
+    if axis == 1:
+        mask = mask.T
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@register("SequenceReverse", nin=2, arg_names=["data", "sequence_length"],
+          defaults={"use_sequence_length": False, "axis": 0})
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    T = data.shape[0]
+    steps = jnp.arange(T)[:, None]
+    lens = sequence_length.astype(jnp.int32)[None, :]
+    src = jnp.where(steps < lens, lens - 1 - steps, steps)  # (T, B)
+    return jnp.take_along_axis(
+        data, src.reshape(src.shape + (1,) * (data.ndim - 2)), axis=0)

@@ -1,0 +1,140 @@
+"""Fused optimizer update operators.
+
+Parity: reference ``src/operator/optimizer_op.cc:39-299`` — sgd_update,
+sgd_mom_update, mp_sgd_* (fp16 weights with fp32 master copy), adam_update,
+rmsprop_update, rmspropalex_update, ftrl_update. Each op returns the new
+weight (plus new state tensors); the imperative wrapper writes them back
+into the input NDArrays (declared via ``mutate``), mirroring the
+reference's in-place kernels. Under jit the whole update fuses into one
+HBM-bandwidth-bound elementwise kernel per parameter — the same reason the
+reference fused these into single CUDA kernels.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _apply_wd_clip(weight, grad, wd, rescale_grad, clip_gradient):
+    grad = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        grad = jnp.clip(grad, -clip_gradient, clip_gradient)
+    return grad + wd * weight
+
+
+@register("sgd_update", nin=2, arg_names=["weight", "grad"],
+          defaults={"lr": 0.01, "wd": 0.0, "rescale_grad": 1.0,
+                    "clip_gradient": -1.0},
+          mutate=(0,))
+def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0):
+    g = _apply_wd_clip(weight, grad, wd, rescale_grad, clip_gradient)
+    return weight - lr * g
+
+
+@register("sgd_mom_update", nin=3, arg_names=["weight", "grad", "mom"],
+          defaults={"lr": 0.01, "momentum": 0.0, "wd": 0.0,
+                    "rescale_grad": 1.0, "clip_gradient": -1.0},
+          mutate=(0, 2), nout=2)
+def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd_clip(weight, grad, wd, rescale_grad, clip_gradient)
+    mom = momentum * mom - lr * g
+    return weight + mom, mom
+
+
+@register("mp_sgd_update", nin=3, arg_names=["weight", "grad", "weight32"],
+          defaults={"lr": 0.01, "wd": 0.0, "rescale_grad": 1.0,
+                    "clip_gradient": -1.0},
+          mutate=(0, 2), nout=2)
+def mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0):
+    """Multi-precision SGD: bf16/fp16 weight, fp32 master copy
+    (reference optimizer_op.cc MP_SGD)."""
+    g = _apply_wd_clip(weight32, grad.astype(jnp.float32), wd, rescale_grad,
+                       clip_gradient)
+    w32 = weight32 - lr * g
+    return w32.astype(weight.dtype), w32
+
+
+@register("mp_sgd_mom_update", nin=4,
+          arg_names=["weight", "grad", "mom", "weight32"],
+          defaults={"lr": 0.01, "momentum": 0.0, "wd": 0.0,
+                    "rescale_grad": 1.0, "clip_gradient": -1.0},
+          mutate=(0, 2, 3), nout=3)
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd_clip(weight32, grad.astype(jnp.float32), wd, rescale_grad,
+                       clip_gradient)
+    mom = momentum * mom - lr * g
+    w32 = weight32 + mom
+    return w32.astype(weight.dtype), mom, w32
+
+
+@register("adam_update", nin=4, arg_names=["weight", "grad", "mean", "var"],
+          defaults={"lr": 0.001, "beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8,
+                    "wd": 0.0, "rescale_grad": 1.0, "clip_gradient": -1.0},
+          mutate=(0, 2, 3), nout=3)
+def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd_clip(weight, grad, wd, rescale_grad, clip_gradient)
+    mean = beta1 * mean + (1 - beta1) * g
+    var = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - lr * mean / (jnp.sqrt(var) + epsilon)
+    return w, mean, var
+
+
+@register("rmsprop_update", nin=3, arg_names=["weight", "grad", "n"],
+          defaults={"lr": 0.001, "gamma1": 0.95, "epsilon": 1e-8, "wd": 0.0,
+                    "rescale_grad": 1.0, "clip_gradient": -1.0,
+                    "clip_weights": -1.0},
+          mutate=(0, 2), nout=2)
+def rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                   clip_weights=-1.0):
+    g = _apply_wd_clip(weight, grad, wd, rescale_grad, clip_gradient)
+    n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    w = weight - lr * g / jnp.sqrt(n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n
+
+
+@register("rmspropalex_update", nin=5,
+          arg_names=["weight", "grad", "n", "g", "delta"],
+          defaults={"lr": 0.001, "gamma1": 0.95, "gamma2": 0.9,
+                    "epsilon": 1e-8, "wd": 0.0, "rescale_grad": 1.0,
+                    "clip_gradient": -1.0, "clip_weights": -1.0},
+          mutate=(0, 2, 3, 4), nout=4)
+def rmspropalex_update(weight, grad, n, g, delta, lr=0.001, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    """RMSProp (Graves 2013 variant) — reference optimizer_op.cc."""
+    gr = _apply_wd_clip(weight, grad, wd, rescale_grad, clip_gradient)
+    n = gamma1 * n + (1 - gamma1) * jnp.square(gr)
+    g = gamma1 * g + (1 - gamma1) * gr
+    delta = gamma2 * delta - lr * gr / jnp.sqrt(n - jnp.square(g) + epsilon)
+    w = weight + delta
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n, g, delta
+
+
+@register("ftrl_update", nin=4, arg_names=["weight", "grad", "z", "n"],
+          defaults={"lr": 0.1, "lamda1": 0.01, "beta": 1.0, "wd": 0.0,
+                    "rescale_grad": 1.0, "clip_gradient": -1.0},
+          mutate=(0, 2, 3), nout=3)
+def ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    z = z + g - sigma * weight
+    w = jnp.where(
+        jnp.abs(z) <= lamda1,
+        jnp.zeros_like(weight),
+        -(z - jnp.sign(z) * lamda1) / ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return w, z, new_n

@@ -1,0 +1,149 @@
+"""Random sampling operators.
+
+Parity: reference ``src/operator/random/sample_op.cc`` (_random_* drawing a
+tensor from one distribution) and ``multisample_op.cc`` (sample_* drawing
+per-element from tensor-parameterised distributions). The reference uses
+per-device PRNG Resource pools; here each call gets a fresh key from the
+execution context (`_rng`, see ops/common.py) so the same ops are usable
+both eagerly and inside jitted graphs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .common import as_tuple, mx_dtype
+from .registry import register
+
+
+def _shape_dtype(shape, dtype):
+    return as_tuple(shape) or (), mx_dtype(dtype) or jnp.float32
+
+
+@register("_random_uniform", nin=0,
+          defaults={"low": 0.0, "high": 1.0, "shape": (), "dtype": "float32"},
+          no_grad=True, aliases=("uniform", "random_uniform"))
+def random_uniform(low=0.0, high=1.0, shape=(), dtype="float32", ctx=None, _rng=None):
+    shape, dtype = _shape_dtype(shape, dtype)
+    return jax.random.uniform(_rng, shape, dtype=dtype, minval=low, maxval=high)
+
+
+@register("_random_normal", nin=0,
+          defaults={"loc": 0.0, "scale": 1.0, "shape": (), "dtype": "float32"},
+          no_grad=True, aliases=("normal", "random_normal"))
+def random_normal(loc=0.0, scale=1.0, shape=(), dtype="float32", ctx=None, _rng=None):
+    shape, dtype = _shape_dtype(shape, dtype)
+    return loc + scale * jax.random.normal(_rng, shape, dtype=dtype)
+
+
+@register("_random_gamma", nin=0,
+          defaults={"alpha": 1.0, "beta": 1.0, "shape": (), "dtype": "float32"},
+          no_grad=True, aliases=("random_gamma",))
+def random_gamma(alpha=1.0, beta=1.0, shape=(), dtype="float32", ctx=None, _rng=None):
+    shape, dtype = _shape_dtype(shape, dtype)
+    return jax.random.gamma(_rng, alpha, shape, dtype=dtype) * beta
+
+
+@register("_random_exponential", nin=0,
+          defaults={"lam": 1.0, "shape": (), "dtype": "float32"},
+          no_grad=True, aliases=("random_exponential",))
+def random_exponential(lam=1.0, shape=(), dtype="float32", ctx=None, _rng=None):
+    shape, dtype = _shape_dtype(shape, dtype)
+    return jax.random.exponential(_rng, shape, dtype=dtype) / lam
+
+
+@register("_random_poisson", nin=0,
+          defaults={"lam": 1.0, "shape": (), "dtype": "float32"},
+          no_grad=True, aliases=("random_poisson",))
+def random_poisson(lam=1.0, shape=(), dtype="float32", ctx=None, _rng=None):
+    shape, dtype = _shape_dtype(shape, dtype)
+    return jax.random.poisson(_rng, lam, shape).astype(dtype)
+
+
+@register("_random_negative_binomial", nin=0,
+          defaults={"k": 1, "p": 1.0, "shape": (), "dtype": "float32"},
+          no_grad=True, aliases=("random_negative_binomial",))
+def random_negative_binomial(k=1, p=1.0, shape=(), dtype="float32", ctx=None, _rng=None):
+    shape, dtype = _shape_dtype(shape, dtype)
+    k1, k2 = jax.random.split(_rng)
+    lam = jax.random.gamma(k1, float(k), shape) * (1 - p) / p
+    return jax.random.poisson(k2, lam, shape).astype(dtype)
+
+
+@register("_random_generalized_negative_binomial", nin=0,
+          defaults={"mu": 1.0, "alpha": 1.0, "shape": (), "dtype": "float32"},
+          no_grad=True, aliases=("random_generalized_negative_binomial",))
+def random_gen_neg_binomial(mu=1.0, alpha=1.0, shape=(), dtype="float32",
+                            ctx=None, _rng=None):
+    shape, dtype = _shape_dtype(shape, dtype)
+    k1, k2 = jax.random.split(_rng)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    lam = jax.random.gamma(k1, r, shape) * (1 - p) / p
+    return jax.random.poisson(k2, lam, shape).astype(dtype)
+
+
+# -- sample_* family: tensor-parameterised, one draw per parameter element --
+
+def _multisample(draw):
+    def op(*params, shape=(), dtype=None, _rng=None):
+        shape = as_tuple(shape) or ()
+        p0 = params[0]
+        out_shape = p0.shape + shape
+        expanded = [p.reshape(p.shape + (1,) * len(shape)) for p in params]
+        return draw(_rng, expanded, out_shape,
+                    mx_dtype(dtype) or jnp.result_type(p0))
+    return op
+
+
+register("_sample_uniform", nin=2, arg_names=["low", "high"],
+         defaults={"shape": (), "dtype": None}, no_grad=True,
+         aliases=("sample_uniform",))(
+    _multisample(lambda k, p, s, d: p[0] + (p[1] - p[0]) * jax.random.uniform(k, s, dtype=d)))
+register("_sample_normal", nin=2, arg_names=["mu", "sigma"],
+         defaults={"shape": (), "dtype": None}, no_grad=True,
+         aliases=("sample_normal",))(
+    _multisample(lambda k, p, s, d: p[0] + p[1] * jax.random.normal(k, s, dtype=d)))
+register("_sample_gamma", nin=2, arg_names=["alpha", "beta"],
+         defaults={"shape": (), "dtype": None}, no_grad=True,
+         aliases=("sample_gamma",))(
+    _multisample(lambda k, p, s, d: jax.random.gamma(k, jnp.broadcast_to(p[0], s), s).astype(d) * p[1]))
+register("_sample_exponential", nin=1, arg_names=["lam"],
+         defaults={"shape": (), "dtype": None}, no_grad=True,
+         aliases=("sample_exponential",))(
+    _multisample(lambda k, p, s, d: jax.random.exponential(k, s, dtype=d) / p[0]))
+register("_sample_poisson", nin=1, arg_names=["lam"],
+         defaults={"shape": (), "dtype": None}, no_grad=True,
+         aliases=("sample_poisson",))(
+    _multisample(lambda k, p, s, d: jax.random.poisson(k, jnp.broadcast_to(p[0], s), s).astype(d)))
+
+
+@register("_sample_multinomial", nin=1, arg_names=["data"],
+          defaults={"shape": (), "get_prob": False, "dtype": "int32"},
+          no_grad=True, aliases=("sample_multinomial",))
+def sample_multinomial(data, shape=(), get_prob=False, dtype="int32", _rng=None):
+    """Categorical sampling (reference random/multisample_op.cc multinomial)."""
+    shape = as_tuple(shape) or ()
+    n = 1
+    for s in shape:
+        n *= s
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    batch = data.shape[:-1]
+    idx = jax.random.categorical(_rng, logits, axis=-1,
+                                 shape=(n,) + batch)
+    idx = jnp.moveaxis(idx, 0, -1).reshape(batch + shape)
+    out = idx.astype(mx_dtype(dtype))
+    if get_prob:
+        logp = jnp.log(jnp.maximum(data, 1e-30))
+        picked = jnp.take_along_axis(
+            logp.reshape(batch + (1,) * max(len(shape), 1) + (data.shape[-1],)),
+            idx.reshape(batch + shape[:max(len(shape), 1)] + (1,)).astype(jnp.int32)
+            if shape else idx.reshape(batch + (1, 1)).astype(jnp.int32)[..., 0, :],
+            axis=-1)
+        return out, picked.reshape(out.shape)
+    return out
+
+
+@register("_shuffle", no_grad=True, aliases=("shuffle",))
+def shuffle(data, _rng=None):
+    return jax.random.permutation(_rng, data, axis=0)

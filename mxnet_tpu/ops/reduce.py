@@ -1,0 +1,150 @@
+"""Reduction / ordering / broadcasting operators.
+
+Parity: reference ``src/operator/tensor/broadcast_reduce_op_value.cc``,
+``broadcast_reduce_op_index.cc``, ``ordering_op.cc``. MXNet reduce
+semantics: ``axis`` may be int/tuple/None, plus ``keepdims`` and
+``exclude`` (reduce over the complement).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .common import reduce_axes, as_axis
+from .registry import register
+
+
+def _reduce(fn, identity_empty=None):
+    def op(data, axis=None, keepdims=False, exclude=False):
+        axes = reduce_axes(axis, data.ndim, exclude)
+        if axes == ():
+            return data if not keepdims else data
+        return fn(data, axis=axes, keepdims=bool(keepdims))
+    return op
+
+
+register("sum", defaults={"axis": None, "keepdims": False, "exclude": False},
+         aliases=("sum_axis",))(_reduce(jnp.sum))
+register("mean", defaults={"axis": None, "keepdims": False, "exclude": False})(_reduce(jnp.mean))
+register("prod", defaults={"axis": None, "keepdims": False, "exclude": False})(_reduce(jnp.prod))
+register("nansum", defaults={"axis": None, "keepdims": False, "exclude": False})(_reduce(jnp.nansum))
+register("nanprod", defaults={"axis": None, "keepdims": False, "exclude": False})(_reduce(jnp.nanprod))
+register("max", defaults={"axis": None, "keepdims": False, "exclude": False},
+         aliases=("max_axis",))(_reduce(jnp.max))
+register("min", defaults={"axis": None, "keepdims": False, "exclude": False},
+         aliases=("min_axis",))(_reduce(jnp.min))
+
+
+@register("norm")
+def norm(data):
+    """L2 norm over all elements (reference 0.12 norm reduces everything)."""
+    return jnp.sqrt(jnp.sum(jnp.square(data))).reshape((1,))
+
+
+@register("argmax", defaults={"axis": None, "keepdims": False}, no_grad=True)
+def argmax(data, axis=None, keepdims=False):
+    axis = as_axis(axis)
+    out = jnp.argmax(data, axis=axis, keepdims=bool(keepdims))
+    return out.astype(jnp.float32)  # reference returns real_t indices
+
+
+@register("argmin", defaults={"axis": None, "keepdims": False}, no_grad=True)
+def argmin(data, axis=None, keepdims=False):
+    axis = as_axis(axis)
+    out = jnp.argmin(data, axis=axis, keepdims=bool(keepdims))
+    return out.astype(jnp.float32)
+
+
+@register("argmax_channel", no_grad=True)
+def argmax_channel(data):
+    """argmax over axis 1 (reference broadcast_reduce_op_index.cc)."""
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
+@register("pick", nin=2, arg_names=["data", "index"],
+          defaults={"axis": -1, "keepdims": False})
+def pick(data, index, axis=-1, keepdims=False):
+    """Pick elements along axis by index (reference broadcast_reduce_op_index.cc)."""
+    axis = int(axis) % data.ndim
+    idx = index.astype(jnp.int32)
+    if idx.ndim == data.ndim:
+        idx = jnp.squeeze(idx, axis=axis)
+    out = jnp.take_along_axis(data, jnp.expand_dims(idx, axis=axis), axis=axis)
+    if not keepdims:
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("broadcast_to", defaults={"shape": ()})
+def broadcast_to(data, shape=()):
+    from .common import as_tuple
+    shape = as_tuple(shape)
+    tgt = tuple(s if s != 0 else d for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@register("broadcast_axis", defaults={"axis": (), "size": ()},
+          aliases=("broadcast_axes",))
+def broadcast_axis(data, axis=(), size=()):
+    from .common import as_tuple
+    axes = as_tuple(axis) or ()
+    sizes = as_tuple(size) or ()
+    tgt = list(data.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a % data.ndim] = s
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+# ---------------------------------------------------------------------------
+# Ordering ops (reference src/operator/tensor/ordering_op.cc)
+# ---------------------------------------------------------------------------
+
+@register("sort", defaults={"axis": -1, "is_ascend": True}, no_grad=True)
+def sort(data, axis=-1, is_ascend=True):
+    axis = as_axis(axis)
+    out = jnp.sort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis if axis is not None else 0)
+    return out
+
+
+@register("argsort", defaults={"axis": -1, "is_ascend": True, "dtype": "float32"},
+          no_grad=True)
+def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    from .common import mx_dtype
+    axis = as_axis(axis)
+    out = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis if axis is not None else 0)
+    return out.astype(mx_dtype(dtype))
+
+
+@register("topk", nout=1,
+          defaults={"axis": -1, "k": 1, "ret_typ": "indices", "is_ascend": False,
+                    "dtype": "float32"}, no_grad=True)
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    """Top-k along axis (reference ordering_op.cc TopK).
+
+    ret_typ: "value" | "indices" | "mask" | "both".
+    """
+    from .common import mx_dtype
+    axis = -1 if axis is None else int(axis) % data.ndim
+    k = int(k) if int(k) > 0 else data.shape[axis]
+    sign = 1 if is_ascend else -1
+    order = jnp.argsort(sign * data, axis=axis, stable=True)
+    idx = jnp.take(order, jnp.arange(k), axis=axis)
+    if ret_typ == "indices":
+        return idx.astype(mx_dtype(dtype))
+    vals = jnp.take_along_axis(data, idx, axis=axis)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idx.astype(mx_dtype(dtype))
+    if ret_typ == "mask":
+        mask = jnp.zeros(data.shape, dtype=data.dtype)
+        ones = jnp.ones(idx.shape, dtype=data.dtype)
+        return _put_along_axis(mask, idx, ones, axis)
+    raise ValueError("unknown ret_typ %r" % ret_typ)
+
+
+def _put_along_axis(arr, idx, vals, axis):
+    return jnp.put_along_axis(arr, idx, vals, axis=axis, inplace=False)

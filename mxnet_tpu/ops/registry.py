@@ -1,0 +1,125 @@
+"""Operator registry — the single source of truth for the op surface.
+
+TPU-native re-design of the reference's NNVM op registry
+(``nnvm::Op`` + attr functors ``FCompute``/``FInferShape``, see reference
+``include/mxnet/op_attr_types.h:45-264`` and SURVEY.md §2.1). In the
+reference every op carries a C++ shape/type/storage-inference functor and
+per-backend kernels; here every op is ONE pure JAX function — XLA is the
+backend, shape/dtype inference falls out of ``jax.eval_shape``, and
+gradients fall out of ``jax.vjp``. The Python ``mx.nd.*`` / ``mx.sym.*``
+namespaces are code-generated from this registry exactly like the
+reference generates them from the C op registry
+(``python/mxnet/ndarray/register.py:142-168``).
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+
+from ..base import MXNetError
+
+__all__ = ["OpDef", "register", "get_op", "list_ops", "alias"]
+
+_OPS = {}
+
+
+class OpDef:
+    """One operator.
+
+    Parameters
+    ----------
+    name : canonical MXNet op name (e.g. ``"FullyConnected"``).
+    fn : pure function ``fn(*jax_arrays, **params) -> array | tuple``.
+    nin : number of tensor inputs; -1 = variadic (first arg is a list).
+    nout : number of outputs (static).
+    arg_names : names of the tensor inputs, in order (for symbol binding
+        and kwargs-style calls, e.g. ``data/weight/bias``).
+    mutate : indices of inputs mutated in place by the imperative wrapper
+        (optimizer update ops — reference ``optimizer_op.cc:39-299``).
+    no_grad : op is non-differentiable; tape records zero-grad.
+    """
+
+    def __init__(self, name, fn, nin=1, nout=1, arg_names=None, defaults=None,
+                 mutate=(), no_grad=False, doc=None):
+        self.name = name
+        self.fn = fn
+        self.nin = nin
+        self.nout = nout
+        self.arg_names = list(arg_names) if arg_names is not None else (
+            ["data"] if nin in (1, -1) else ["lhs", "rhs"] if nin == 2 else
+            ["arg%d" % i for i in range(max(nin, 0))])
+        self.defaults = dict(defaults or {})
+        self.mutate = tuple(mutate)
+        self.no_grad = no_grad
+        self.doc = doc or (fn.__doc__ if fn is not None else None)
+        # Execution-context needs, discovered from the signature: ops that
+        # behave differently at train time declare a `_train` kwarg, random
+        # ops a `_rng` kwarg (see ops/common.py).
+        try:
+            params = inspect.signature(fn).parameters
+            self.takes_train = "_train" in params
+            self.takes_rng = "_rng" in params
+        except (TypeError, ValueError):
+            self.takes_train = self.takes_rng = False
+        # How many outputs user code sees (reference: num_visible_outputs —
+        # e.g. BatchNorm computes 3 but exposes 1).
+        self.visible_outputs = None
+        # Indices of inputs that are auxiliary states (reference: aux states
+        # like BatchNorm moving_mean/var — not arguments, never differentiated).
+        self.aux_inputs = ()
+        # Optional hook(raw_inputs, raw_outputs, params) -> {input_idx: new
+        # raw value}; models reference ops that mutate aux states in place.
+        self.stateful_update = None
+
+    def __repr__(self):
+        return "OpDef(%s)" % self.name
+
+    def apply(self, arrays, params):
+        """Run the op on raw jax arrays. Returns a tuple of outputs."""
+        out = self.fn(*arrays, **params)
+        return out if isinstance(out, tuple) else (out,)
+
+
+def register(name, nin=1, nout=1, arg_names=None, defaults=None, mutate=(),
+             no_grad=False, aliases=()):
+    """Decorator registering a pure-jax function as an operator."""
+
+    def _reg(fn):
+        op = OpDef(name, fn, nin=nin, nout=nout, arg_names=arg_names,
+                   defaults=defaults, mutate=mutate, no_grad=no_grad)
+        if name in _OPS:
+            raise MXNetError("op %r already registered" % name)
+        _OPS[name] = op
+        for a in aliases:
+            _OPS[a] = op
+        return fn
+
+    return _reg
+
+
+def alias(existing, *names):
+    op = get_op(existing)
+    for n in names:
+        _OPS[n] = op
+
+
+def get_op(name):
+    if name not in _OPS:
+        raise MXNetError("operator %r is not registered" % (name,))
+    return _OPS[name]
+
+
+def list_ops():
+    return sorted(_OPS)
+
+
+def canonical_params(op, kwargs):
+    """Merge defaults, normalise unhashable values for cache keys."""
+    params = dict(op.defaults)
+    params.update(kwargs)
+    return params
+
+
+@functools.lru_cache(maxsize=None)
+def _noop():  # placeholder keeping functools imported for future caching
+    return None

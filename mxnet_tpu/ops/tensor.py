@@ -1,0 +1,343 @@
+"""Shape-manipulation and indexing operators.
+
+Parity: reference ``src/operator/tensor/matrix_op.cc`` (Reshape w/ special
+codes, transpose, slice, Concat, tile, repeat, reverse, …),
+``indexing_op.cc`` (take, batch_take, one_hot, Embedding, gather_nd),
+``src/operator/slice_channel.cc``, ``src/operator/pad.cc``,
+``src/operator/swapaxis.cc``, ``src/operator/crop.cc``.
+"""
+from __future__ import annotations
+
+import ast
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .common import as_tuple, as_axis, mx_dtype
+from .registry import register
+
+
+def infer_reshape(src_shape, target, reverse=False):
+    """Implement MXNet Reshape's special codes (reference matrix_op.cc ReshapeShape).
+
+    0 = copy dim; -1 = infer; -2 = copy rest; -3 = merge next two;
+    -4 = split (followed by two dims, one may be -1).
+    """
+    if isinstance(target, str):
+        target = ast.literal_eval(target)
+    target = list(int(x) for x in target)
+    src = list(src_shape)
+    if reverse:
+        src = src[::-1]
+        target = target[::-1]
+        # -4's two factor dims also reverse; handle by simple swap after parse
+    out = []
+    i = 0  # position in src
+    j = 0
+    while j < len(target):
+        t = target[j]
+        if t > 0:
+            out.append(t)
+            i += 1
+        elif t == 0:
+            if i >= len(src):
+                raise MXNetError("reshape: 0 with no corresponding src dim")
+            out.append(src[i])
+            i += 1
+        elif t == -1:
+            out.append(-1)
+            i += 1
+        elif t == -2:
+            out.extend(src[i:])
+            i = len(src)
+        elif t == -3:
+            if i + 1 >= len(src):
+                raise MXNetError("reshape: -3 needs two src dims")
+            out.append(src[i] * src[i + 1])
+            i += 2
+        elif t == -4:
+            d1, d2 = target[j + 1], target[j + 2]
+            j += 2
+            cur = src[i]
+            if d1 == -1:
+                d1 = cur // d2
+            if d2 == -1:
+                d2 = cur // d1
+            out.extend([d1, d2])
+            i += 1
+        else:
+            raise MXNetError("reshape: invalid code %d" % t)
+        j += 1
+    if out.count(-1) > 1:
+        raise MXNetError("reshape: more than one -1")
+    known = int(np.prod([d for d in out if d != -1], dtype=np.int64)) if out else 1
+    total = int(np.prod(src_shape, dtype=np.int64))
+    if -1 in out:
+        out[out.index(-1)] = total // max(known, 1)
+    if reverse:
+        out = out[::-1]
+    return tuple(out)
+
+
+@register("Reshape", defaults={"shape": (), "reverse": False}, aliases=("reshape",))
+def reshape(data, shape=(), reverse=False, **ignored):
+    return jnp.reshape(data, infer_reshape(data.shape, shape, reverse))
+
+
+@register("Flatten", aliases=("flatten",))
+def flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("transpose", defaults={"axes": ()})
+def transpose(data, axes=()):
+    axes = as_tuple(axes)
+    return jnp.transpose(data, axes if axes else None)
+
+
+@register("expand_dims", defaults={"axis": 0})
+def expand_dims(data, axis=0):
+    return jnp.expand_dims(data, int(axis))
+
+
+@register("squeeze", defaults={"axis": None})
+def squeeze(data, axis=None):
+    return jnp.squeeze(data, as_axis(axis))
+
+
+@register("slice", defaults={"begin": (), "end": (), "step": ()},
+          aliases=("crop",))
+def slice_op(data, begin=(), end=(), step=()):
+    """Slice with per-axis begin/end/step; None entries mean full range
+    (reference matrix_op.cc Slice)."""
+    def _parse(v):
+        if isinstance(v, str):
+            v = ast.literal_eval(v)
+        if v is None:
+            return ()
+        return tuple(v) if isinstance(v, (list, tuple)) else (v,)
+    begin, end, step = _parse(begin), _parse(end), _parse(step)
+    idx = []
+    for ax in range(data.ndim):
+        b = begin[ax] if ax < len(begin) else None
+        e = end[ax] if ax < len(end) else None
+        s = step[ax] if ax < len(step) and step[ax] is not None else 1
+        idx.append(slice(b, e, s))
+    return data[tuple(idx)]
+
+
+@register("slice_axis", defaults={"axis": 0, "begin": 0, "end": None})
+def slice_axis(data, axis=0, begin=0, end=None):
+    axis = int(axis) % data.ndim
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(int(begin), None if end in (None, "None") else int(end))
+    return data[tuple(idx)]
+
+
+@register("slice_like", nin=2, arg_names=["data", "shape_like"],
+          defaults={"axes": ()})
+def slice_like(data, shape_like, axes=()):
+    axes = as_tuple(axes) or tuple(range(data.ndim))
+    idx = [slice(None)] * data.ndim
+    for ax in axes:
+        idx[ax % data.ndim] = slice(0, shape_like.shape[ax % data.ndim])
+    return data[tuple(idx)]
+
+
+@register("Concat", nin=-1, defaults={"dim": 1}, aliases=("concat",))
+def concat(*args, dim=1, num_args=None):
+    return jnp.concatenate(args, axis=int(dim))
+
+
+@register("stack", nin=-1, defaults={"axis": 0})
+def stack(*args, axis=0, num_args=None):
+    return jnp.stack(args, axis=int(axis))
+
+
+@register("SliceChannel", nout=-1,
+          defaults={"num_outputs": 1, "axis": 1, "squeeze_axis": False},
+          aliases=("split",))
+def slice_channel(data, num_outputs=1, axis=1, squeeze_axis=False):
+    """Split along axis into num_outputs parts (reference slice_channel.cc)."""
+    parts = jnp.split(data, int(num_outputs), axis=int(axis))
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=int(axis)) for p in parts]
+    return tuple(parts) if len(parts) > 1 else parts[0]
+
+
+@register("tile", defaults={"reps": ()})
+def tile(data, reps=()):
+    return jnp.tile(data, as_tuple(reps))
+
+
+@register("repeat", defaults={"repeats": 1, "axis": None})
+def repeat(data, repeats=1, axis=None):
+    return jnp.repeat(data, int(repeats), axis=as_axis(axis))
+
+
+@register("reverse", defaults={"axis": ()}, aliases=("flip",))
+def reverse(data, axis=()):
+    return jnp.flip(data, as_tuple(axis))
+
+
+@register("SwapAxis", defaults={"dim1": 0, "dim2": 0}, aliases=("swapaxes",))
+def swapaxes(data, dim1=0, dim2=0):
+    return jnp.swapaxes(data, int(dim1), int(dim2))
+
+
+@register("Pad", defaults={"mode": "constant", "pad_width": (), "constant_value": 0.0},
+          aliases=("pad",))
+def pad(data, mode="constant", pad_width=(), constant_value=0.0):
+    """Pad 4-D/5-D input (reference src/operator/pad.cc); pad_width comes in
+    flattened (before, after) pairs per axis."""
+    pw = as_tuple(pad_width)
+    pairs = [(pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2)]
+    while len(pairs) < data.ndim:
+        pairs.append((0, 0))
+    if mode == "constant":
+        return jnp.pad(data, pairs, constant_values=constant_value)
+    return jnp.pad(data, pairs, mode={"edge": "edge", "reflect": "reflect"}[mode])
+
+
+@register("where", nin=3, arg_names=["condition", "x", "y"])
+def where(condition, x, y):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+# ---------------------------------------------------------------------------
+# Indexing ops (reference src/operator/tensor/indexing_op.cc)
+# ---------------------------------------------------------------------------
+
+@register("take", nin=2, arg_names=["a", "indices"],
+          defaults={"axis": 0, "mode": "clip"})
+def take(a, indices, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    n = a.shape[int(axis)]
+    if mode == "wrap":
+        idx = jnp.mod(idx, n)
+    else:
+        idx = jnp.clip(idx, 0, n - 1)
+    return jnp.take(a, idx, axis=int(axis))
+
+
+@register("batch_take", nin=2, arg_names=["a", "indices"])
+def batch_take(a, indices):
+    idx = jnp.clip(indices.astype(jnp.int32), 0, a.shape[1] - 1)
+    return jnp.take_along_axis(a, idx.reshape(-1, 1), axis=1).reshape(idx.shape)
+
+
+@register("one_hot", defaults={"depth": 1, "on_value": 1.0, "off_value": 0.0,
+                               "dtype": "float32"}, no_grad=True)
+def one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    d = mx_dtype(dtype)
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), int(depth), dtype=d)
+    return oh * jnp.asarray(on_value, d) + (1 - oh) * jnp.asarray(off_value, d)
+
+
+@register("Embedding", nin=2, arg_names=["data", "weight"],
+          defaults={"input_dim": 0, "output_dim": 0, "dtype": "float32",
+                    "sparse_grad": False})
+def embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
+              sparse_grad=False):
+    """Embedding lookup (reference indexing_op.cc Embedding). On TPU this is
+    a gather that XLA lowers efficiently; sharded variants live in
+    mxnet_tpu.parallel."""
+    idx = data.astype(jnp.int32)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register("gather_nd", nin=2, arg_names=["data", "indices"])
+def gather_nd(data, indices):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return data[tuple(idx[i] for i in range(m))]
+
+
+@register("scatter_nd", nin=2, arg_names=["data", "indices"],
+          defaults={"shape": ()})
+def scatter_nd(data, indices, shape=()):
+    shape = as_tuple(shape)
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(shape, dtype=data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].set(data)
+
+
+@register("_grad_add_nd", nin=2, arg_names=["data", "indices"],
+          defaults={"shape": ()})
+def _scatter_nd_acc(data, indices, shape=()):
+    shape = as_tuple(shape)
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(shape, dtype=data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].add(data)
+
+
+# ---------------------------------------------------------------------------
+# Creation ops (nin=0; reference tensor/init_op.cc)
+# ---------------------------------------------------------------------------
+
+def _creation_params(shape, dtype):
+    return as_tuple(shape) or (), mx_dtype(dtype) or jnp.float32
+
+
+@register("_zeros", nin=0, defaults={"shape": (), "dtype": "float32"}, no_grad=True)
+def _zeros(shape=(), dtype="float32", ctx=None):
+    shape, dtype = _creation_params(shape, dtype)
+    return jnp.zeros(shape, dtype)
+
+
+@register("_ones", nin=0, defaults={"shape": (), "dtype": "float32"}, no_grad=True)
+def _ones(shape=(), dtype="float32", ctx=None):
+    shape, dtype = _creation_params(shape, dtype)
+    return jnp.ones(shape, dtype)
+
+
+@register("_full", nin=0, defaults={"shape": (), "dtype": "float32", "value": 0.0},
+          no_grad=True)
+def _full(shape=(), dtype="float32", value=0.0, ctx=None):
+    shape, dtype = _creation_params(shape, dtype)
+    return jnp.full(shape, value, dtype)
+
+
+@register("_arange", nin=0,
+          defaults={"start": 0, "stop": None, "step": 1.0, "repeat": 1,
+                    "dtype": "float32"}, no_grad=True)
+def _arange(start=0, stop=None, step=1.0, repeat=1, dtype="float32", ctx=None,
+            infer_range=False):
+    out = jnp.arange(start, None if stop in (None, "None") else stop, step,
+                     dtype=mx_dtype(dtype))
+    if int(repeat) > 1:
+        out = jnp.repeat(out, int(repeat))
+    return out
+
+
+@register("_eye", nin=0, defaults={"N": 0, "M": 0, "k": 0, "dtype": "float32"},
+          no_grad=True)
+def _eye(N=0, M=0, k=0, dtype="float32", ctx=None):
+    M = int(M) or int(N)
+    return jnp.eye(int(N), M, int(k), dtype=mx_dtype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# Matrix products (reference tensor/dot.cc)
+# ---------------------------------------------------------------------------
+
+@register("dot", nin=2, defaults={"transpose_a": False, "transpose_b": False})
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, forward_stype=None):
+    """Generalised dot (reference dot.cc): contracts last axis of lhs with
+    first axis of rhs. Lowers straight onto the MXU."""
+    a = lhs.T if transpose_a else lhs
+    b = rhs.T if transpose_b else rhs
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b).reshape((1,))
+    return jnp.tensordot(a, b, axes=1)
+
+
+@register("batch_dot", nin=2, defaults={"transpose_a": False, "transpose_b": False})
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, forward_stype=None):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)

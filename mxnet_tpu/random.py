@@ -1,0 +1,35 @@
+"""Global PRNG state.
+
+Parity: reference ``python/mxnet/random.py`` (mx.random.seed) backed by
+per-device PRNG Resources. TPU-native design: a single splittable JAX key;
+eager ops split it (stateful convenience, like the reference), while
+jitted graphs receive an explicit key argument from the executor so the
+compiled computation stays pure (see ops/common.rng_scope).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_lock = threading.Lock()
+_key = jax.random.key(0)
+
+
+def seed(seed_state):
+    """Seed the global generator (parity: mx.random.seed)."""
+    global _key
+    with _lock:
+        _key = jax.random.key(int(seed_state))
+
+
+def take_key():
+    """Split off a fresh key (eager-mode random ops)."""
+    global _key
+    with _lock:
+        _key, sub = jax.random.split(_key)
+    return sub
+
+
+# re-exported sampling helpers (mx.random.uniform etc.) are installed by
+# mxnet_tpu/__init__.py from the generated nd namespace.

@@ -1,0 +1,20 @@
+"""Test configuration: run on a virtual 8-device CPU mesh.
+
+Mirrors the reference's strategy of exercising distributed code without a
+cluster (SURVEY.md §4: tools/launch.py --launcher local). Here the
+XLA host-platform device-count flag gives 8 virtual devices so sharding/
+collective tests run anywhere; the driver separately dry-runs the
+multi-chip path via __graft_entry__.dryrun_multichip.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# numeric parity tests compare against numpy float32; disable bf16 matmul
+jax.config.update("jax_default_matmul_precision", "highest")

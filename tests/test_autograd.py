@@ -1,0 +1,128 @@
+"""Autograd tests (parity model: reference tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_basic_backward():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_chain_and_reuse():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + x * 3  # x used twice: grads must accumulate
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2 * 2 + 3])
+
+
+def test_multi_variable():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = (a * b).sum()
+    c.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), b.asnumpy())
+    np.testing.assert_allclose(b.grad.asnumpy(), a.asnumpy())
+
+
+def test_head_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = 2 * x
+    y.backward(nd.array([10.0, 100.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [20.0, 200.0])
+
+
+def test_pause_and_stop_gradient():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        with autograd.pause():
+            z = y * 2  # not recorded
+        w = y + nd.BlockGrad(y)
+    w.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0])  # only one path
+    assert not autograd.is_recording()
+
+
+def test_train_vs_predict_mode():
+    assert not autograd.is_training()
+    with autograd.record(train_mode=True):
+        assert autograd.is_training()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    grad = nd.zeros((1,))
+    autograd.mark_variables([x], [grad], grad_reqs="add")
+    for _ in range(3):
+        with autograd.record():
+            y = x * 2
+        y.backward()
+    np.testing.assert_allclose(grad.asnumpy(), [6.0])
+
+
+def test_autograd_grad_api():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+    g = autograd.grad(y, x)
+    np.testing.assert_allclose(g.asnumpy(), [12.0])
+
+
+def test_nondiff_path():
+    x = nd.array([1.0, 5.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * nd.argmax(x).reshape((1,))).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [1.0, 1.0, 1.0])
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            y, = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array([0.0, 1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_deep_chain():
+    x = nd.array([1.001])
+    x.attach_grad()
+    with autograd.record():
+        y = x
+        for _ in range(50):
+            y = y * 1.01
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [1.01 ** 50], rtol=1e-4)

@@ -1,0 +1,209 @@
+"""NDArray tests (parity model: reference tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation():
+    a = nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.dtype == np.float32
+    assert (a.asnumpy() == 0).all()
+
+    b = nd.ones((2,), dtype="int32")
+    assert b.dtype == np.int32
+
+    c = nd.full((2, 2), 7.0)
+    assert (c.asnumpy() == 7).all()
+
+    d = nd.array([[1, 2], [3, 4]])
+    assert d.shape == (2, 2)
+
+    e = nd.arange(0, 10, 2)
+    np.testing.assert_allclose(e.asnumpy(), np.arange(0, 10, 2, dtype=np.float32))
+
+
+def test_arithmetic():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[10.0, 20.0], [30.0, 40.0]])
+    np.testing.assert_allclose((a + b).asnumpy(), [[11, 22], [33, 44]])
+    np.testing.assert_allclose((b - a).asnumpy(), [[9, 18], [27, 36]])
+    np.testing.assert_allclose((a * b).asnumpy(), [[10, 40], [90, 160]])
+    np.testing.assert_allclose((b / a).asnumpy(), [[10, 10], [10, 10]])
+    np.testing.assert_allclose((a + 1).asnumpy(), [[2, 3], [4, 5]])
+    np.testing.assert_allclose((1 - a).asnumpy(), [[0, -1], [-2, -3]])
+    np.testing.assert_allclose((2 * a).asnumpy(), [[2, 4], [6, 8]])
+    np.testing.assert_allclose((2 / a).asnumpy(), 2 / a.asnumpy())
+    np.testing.assert_allclose((a ** 2).asnumpy(), [[1, 4], [9, 16]], rtol=1e-5)
+    np.testing.assert_allclose((-a).asnumpy(), -a.asnumpy())
+
+
+def test_inplace():
+    a = nd.ones((2, 2))
+    aid = id(a)
+    a += 1
+    assert id(a) == aid
+    np.testing.assert_allclose(a.asnumpy(), 2 * np.ones((2, 2)))
+    a *= 3
+    np.testing.assert_allclose(a.asnumpy(), 6 * np.ones((2, 2)))
+
+
+def test_broadcast():
+    a = nd.ones((2, 1, 3))
+    b = nd.ones((1, 4, 3))
+    assert (a + b).shape == (2, 4, 3)
+    c = nd.ones((2, 1)).broadcast_to((2, 5))
+    assert c.shape == (2, 5)
+
+
+def test_comparisons():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    np.testing.assert_allclose((a > b).asnumpy(), [0, 0, 1])
+    np.testing.assert_allclose((a == b).asnumpy(), [0, 1, 0])
+    np.testing.assert_allclose((a <= 2).asnumpy(), [1, 1, 0])
+
+
+def test_indexing():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    np.testing.assert_allclose(a[1].asnumpy(), np.arange(12, 24).reshape(3, 4))
+    np.testing.assert_allclose(a[0, 1].asnumpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(a[:, 1:3].asnumpy(),
+                               np.arange(24).reshape(2, 3, 4)[:, 1:3])
+    a[0] = 0
+    assert (a.asnumpy()[0] == 0).all()
+    a[:] = 5
+    assert (a.asnumpy() == 5).all()
+
+
+def test_reshape_special_codes():
+    a = nd.ones((2, 3, 4, 5))
+    assert a.reshape((-1,)).shape == (120,)
+    assert a.reshape((0, -1)).shape == (2, 60)
+    assert a.reshape((0, 0, -1)).shape == (2, 3, 20)
+    assert a.reshape((-3, 0, 0)).shape == (6, 4, 5)
+    assert a.reshape((0, -4, -1, 1, 0, 0)).shape == (2, 3, 1, 4, 5)
+    assert a.reshape((0, 0, -2)).shape == (2, 3, 4, 5)
+
+
+def test_dtype_cast():
+    a = nd.ones((2, 2), dtype="float32")
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = nd.Cast(a, dtype="float16")
+    assert c.dtype == np.float16
+
+
+def test_copy_and_context():
+    a = nd.ones((2, 2))
+    b = a.copy()
+    b += 1
+    assert (a.asnumpy() == 1).all()
+    c = a.as_in_context(mx.cpu(0))
+    assert c.context.device_type == "cpu"
+    d = nd.zeros((2, 2))
+    a.copyto(d)
+    assert (d.asnumpy() == 1).all()
+
+
+def test_scalar_conversions():
+    a = nd.array([3.5])
+    assert float(a) == 3.5
+    assert a.asscalar() == np.float32(3.5)
+    assert len(nd.zeros((5, 2))) == 5
+
+
+def test_sync_api():
+    a = nd.ones((4, 4))
+    a.wait_to_read()
+    nd.waitall()
+
+
+def test_reductions():
+    x = np.random.uniform(-1, 1, (3, 4, 5)).astype(np.float32)
+    a = nd.array(x)
+    np.testing.assert_allclose(a.sum().asnumpy(), x.sum().reshape(()), rtol=1e-5)
+    np.testing.assert_allclose(nd.sum(a, axis=1).asnumpy(), x.sum(1), rtol=1e-5)
+    np.testing.assert_allclose(nd.sum(a, axis=(0, 2)).asnumpy(), x.sum((0, 2)), rtol=1e-4)
+    np.testing.assert_allclose(nd.sum(a, axis=1, exclude=True).asnumpy(),
+                               x.sum((0, 2)), rtol=1e-4)
+    np.testing.assert_allclose(nd.mean(a, axis=0, keepdims=True).asnumpy(),
+                               x.mean(0, keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(nd.max(a, axis=2).asnumpy(), x.max(2))
+    np.testing.assert_allclose(nd.argmax(a, axis=1).asnumpy(), x.argmax(1))
+    np.testing.assert_allclose(nd.norm(a).asnumpy(),
+                               [np.sqrt((x ** 2).sum())], rtol=1e-5)
+
+
+def test_dot():
+    A = np.random.normal(size=(3, 4)).astype(np.float32)
+    B = np.random.normal(size=(4, 5)).astype(np.float32)
+    np.testing.assert_allclose(nd.dot(nd.array(A), nd.array(B)).asnumpy(),
+                               A @ B, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        nd.dot(nd.array(A), nd.array(B.T), transpose_b=True).asnumpy(),
+        A @ B, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        nd.dot(nd.array(A.T), nd.array(B), transpose_a=True).asnumpy(),
+        A @ B, rtol=1e-4, atol=1e-5)
+    bA = np.random.normal(size=(2, 3, 4)).astype(np.float32)
+    bB = np.random.normal(size=(2, 4, 5)).astype(np.float32)
+    np.testing.assert_allclose(nd.batch_dot(nd.array(bA), nd.array(bB)).asnumpy(),
+                               bA @ bB, rtol=1e-4, atol=1e-5)
+
+
+def test_concat_split_stack():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.Concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    parts = nd.SliceChannel(nd.array(np.arange(12).reshape(2, 6)),
+                            num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 2)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_take_onehot():
+    w = nd.array(np.arange(20).reshape(10, 2))
+    idx = nd.array([0, 5, 9])
+    out = nd.take(w, idx)
+    np.testing.assert_allclose(out.asnumpy(),
+                               np.arange(20).reshape(10, 2)[[0, 5, 9]])
+    oh = nd.one_hot(nd.array([0, 2]), depth=4)
+    np.testing.assert_allclose(oh.asnumpy(), [[1, 0, 0, 0], [0, 0, 1, 0]])
+
+
+def test_where_clip():
+    cond = nd.array([1.0, 0.0, 1.0])
+    x = nd.array([1.0, 2.0, 3.0])
+    y = nd.array([-1.0, -2.0, -3.0])
+    np.testing.assert_allclose(nd.where(cond, x, y).asnumpy(), [1, -2, 3])
+    np.testing.assert_allclose(nd.clip(nd.array([-2.0, 0.5, 2.0]), 0.0, 1.0).asnumpy(),
+                               [0, 0.5, 1])
+
+
+def test_save_load_roundtrip(tmp_path):
+    fname = str(tmp_path / "arrs")
+    d = {"w": nd.array([[1.0, 2.0]]), "b": nd.zeros((3,))}
+    nd.save(fname, d)
+    loaded = nd.load(fname)
+    assert set(loaded) == {"w", "b"}
+    np.testing.assert_allclose(loaded["w"].asnumpy(), [[1, 2]])
+
+    lst = [nd.ones((2,)), nd.zeros((3,))]
+    nd.save(fname, lst)
+    loaded = nd.load(fname)
+    assert isinstance(loaded, list) and loaded[0].shape == (2,)
+
+
+def test_random_shapes_and_seed():
+    mx.random.seed(42)
+    a = nd.random.uniform(shape=(100,))
+    mx.random.seed(42)
+    b = nd.random.uniform(shape=(100,))
+    np.testing.assert_allclose(a.asnumpy(), b.asnumpy())
+    n = nd.random.normal(loc=5, scale=0.1, shape=(2000,))
+    assert abs(n.asnumpy().mean() - 5) < 0.1
