@@ -30,4 +30,9 @@ from .random import seed
 random.uniform = nd.random.uniform
 random.normal = nd.random.normal
 
+from . import symbol                 # noqa: E402
+from . import symbol as sym          # noqa: E402
+from .symbol import Symbol           # noqa: E402
+from .executor import Executor       # noqa: E402
+
 __version__ = "0.1.0"

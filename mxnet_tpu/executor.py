@@ -1,0 +1,428 @@
+"""Executor — compiled execution of Symbol graphs.
+
+Parity: reference ``src/executor/graph_executor.cc`` + ``python/mxnet/
+executor.py``. TPU-native design: instead of the reference's pipeline
+(nnvm Gradient pass → PlanMemory → per-node OpExecutors → engine pushes,
+graph_executor.cc:956-1490), the whole forward graph is ONE traced JAX
+function; ``jax.vjp`` over it is the Gradient pass; ``jax.jit`` is
+PlanMemory + op fusion + scheduling. One executor therefore makes at most
+three XLA programs: forward(train), forward(infer), forward+backward —
+each fully fused and memory-planned by XLA for the MXU/HBM.
+
+Random ops get their keys from an explicit key argument folded per-node
+(ops/common.rng_scope), keeping compiled programs pure. BatchNorm-style
+aux updates come back as extra outputs and are written into aux arrays,
+mirroring the reference's in-place aux mutation.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .context import current_context
+from .ops.common import rng_scope, mx_dtype
+from . import random as _random
+
+__all__ = ["Executor", "infer_graph_shapes"]
+
+
+# ---------------------------------------------------------------------------
+# Graph program: symbol -> pure jax function
+# ---------------------------------------------------------------------------
+
+class _GraphProgram:
+    """Caches the traced/jitted callables for one Symbol."""
+
+    def __init__(self, symbol):
+        self.symbol = symbol
+        self.nodes = symbol._topo_nodes()
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.output_entries = list(symbol._outputs)
+        self._jit_cache = {}
+
+    # ---- pure evaluation -------------------------------------------------
+    def eval_graph(self, arg_dict, aux_dict, rng_key, train):
+        """Evaluate the graph. Returns (outputs, aux_updates)."""
+        env = {}
+        aux_updates = {}
+        with rng_scope(rng_key):
+            for node in self.nodes:
+                if node.op is None:
+                    if node.name in arg_dict:
+                        env[id(node)] = (arg_dict[node.name],)
+                    elif node.name in aux_dict:
+                        env[id(node)] = (aux_dict[node.name],)
+                    else:
+                        raise MXNetError("unbound variable %r" % node.name)
+                    continue
+                raw_in = [env[id(c)][idx] for c, idx in node.inputs]
+                params = dict(node.op.defaults)
+                params.update(node.attrs)
+                params.pop("num_args", None)
+                params.pop("name", None)
+                if node.op.takes_train:
+                    params["_train"] = train
+                if node.op.takes_rng:
+                    from .ops.common import take_rng
+                    params["_rng"] = take_rng()
+                outs = node.op.apply(raw_in, params)
+                env[id(node)] = outs
+                if train and node.op.stateful_update is not None:
+                    ups = node.op.stateful_update(raw_in, outs, params)
+                    for in_idx, val in ups.items():
+                        child, _ = node.inputs[in_idx]
+                        if child.op is None and child.name in aux_dict:
+                            aux_updates[child.name] = val
+        outputs = [env[id(n)][idx] for n, idx in self.output_entries]
+        return outputs, aux_updates
+
+    # ---- jitted entry points --------------------------------------------
+    def forward_fn(self, train):
+        key = ("fwd", bool(train))
+        if key not in self._jit_cache:
+            def fn(args, aux, rng):
+                return self.eval_graph(args, aux, rng, train)
+            self._jit_cache[key] = jax.jit(fn)
+        return self._jit_cache[key]
+
+    def fwd_bwd_fn(self, train, grad_names):
+        key = ("fwdbwd", bool(train), tuple(grad_names))
+        if key not in self._jit_cache:
+            def fn(args, aux, rng, head_grads):
+                grad_args = {k: args[k] for k in grad_names}
+                rest = {k: v for k, v in args.items() if k not in grad_names}
+
+                def f(ga):
+                    outs, aux_up = self.eval_graph({**rest, **ga}, aux, rng,
+                                                   train)
+                    return tuple(outs), aux_up
+
+                outs, vjp, aux_up = jax.vjp(f, grad_args, has_aux=True)
+                hg = tuple(
+                    head_grads[i] if head_grads[i] is not None
+                    else jnp.ones(outs[i].shape, outs[i].dtype)
+                    for i in range(len(outs)))
+                grads = vjp(hg)[0]
+                return outs, grads, aux_up
+            self._jit_cache[key] = jax.jit(fn)
+        return self._jit_cache[key]
+
+
+# ---------------------------------------------------------------------------
+# Shape inference over the graph
+# ---------------------------------------------------------------------------
+
+def infer_graph_shapes(symbol, known_shapes, partial=False, default_dtype=np.float32):
+    """Infer all variable and output shapes (parity: InferShape pass,
+    reference src/executor/infer_graph_attr_pass.cc).
+
+    Strategy: forward topo walk; op param hooks (ops/shape_infer.py) fill
+    learnable-input shapes; jax.eval_shape computes output shapes without
+    running anything (XLA shape propagation = the reference's FInferShape).
+    """
+    nodes = symbol._topo_nodes()
+    var_shape = dict(known_shapes)
+    shapes = {}  # id(node) -> tuple of output shapes
+
+    for node in nodes:
+        if node.op is None:
+            shp = var_shape.get(node.name)
+            if shp is None and "__shape__" in node._extra_attrs:
+                import ast
+                shp = tuple(ast.literal_eval(node._extra_attrs["__shape__"]))
+                var_shape[node.name] = shp
+            shapes[id(node)] = (shp,)
+            continue
+        in_shapes = [shapes[id(c)][idx] for c, idx in node.inputs]
+        params = dict(node.op.defaults)
+        params.update(node.attrs)
+        params.pop("num_args", None)
+        # fill unknown learnable inputs
+        if node.op.param_shape_infer is not None and in_shapes[0] is not None:
+            fills = node.op.param_shape_infer(in_shapes, params)
+            for i, shp in fills.items():
+                if i < len(node.inputs) and in_shapes[i] is None:
+                    child, _ = node.inputs[i]
+                    if child.op is None:
+                        var_shape[child.name] = tuple(shp)
+                        shapes[id(child)] = (tuple(shp),)
+                        in_shapes[i] = tuple(shp)
+        if any(s is None for s in in_shapes):
+            if partial:
+                shapes[id(node)] = tuple([None] * node.num_outputs())
+                continue
+            missing = [node.inputs[i][0].name for i, s in enumerate(in_shapes)
+                       if s is None]
+            raise MXNetError("infer_shape: cannot infer %r (missing inputs %s)"
+                             % (node.name, missing))
+        # eval_shape through the op function
+        if node.op.takes_train:
+            params["_train"] = False
+        if node.op.takes_rng:
+            params["_rng"] = jax.random.key(0)
+        structs = [jax.ShapeDtypeStruct(s, default_dtype) for s in in_shapes]
+        try:
+            out = jax.eval_shape(lambda *a: node.op.fn(*a, **params), *structs)
+        except Exception as e:
+            if partial:
+                shapes[id(node)] = tuple([None] * node.num_outputs())
+                continue
+            raise MXNetError("infer_shape failed at %s(%s): %s"
+                             % (node.op.name, node.name, e))
+        outs = out if isinstance(out, tuple) else (out,)
+        shapes[id(node)] = tuple(tuple(o.shape) for o in outs)
+
+    arg_shapes = [var_shape.get(n) for n in symbol.list_arguments()]
+    aux_shapes = [var_shape.get(n) for n in symbol.list_auxiliary_states()]
+    out_shapes = []
+    for n, idx in symbol._outputs:
+        s = shapes.get(id(n))
+        out_shapes.append(None if s is None or idx >= len(s) else s[idx])
+    return arg_shapes, out_shapes, aux_shapes
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+
+class Executor:
+    """Bound, compiled graph (parity: python/mxnet/executor.py)."""
+
+    def __init__(self, symbol, ctx, arg_arrays, grad_arrays, grad_req,
+                 aux_arrays, program=None):
+        from .ndarray.ndarray import NDArray
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        self._prog = program or _GraphProgram(symbol)
+        self.arg_arrays = list(arg_arrays)
+        self.grad_arrays = list(grad_arrays)
+        self.aux_arrays = list(aux_arrays)
+        self._arg_names = self._prog.arg_names
+        self._aux_names = self._prog.aux_names
+        if isinstance(grad_req, str):
+            grad_req = {n: grad_req for n in self._arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            grad_req = dict(zip(self._arg_names, grad_req))
+        self._grad_req = {n: grad_req.get(n, "null") for n in self._arg_names}
+        self.outputs = []
+        self._monitor_callback = None
+
+    # -- dict views --------------------------------------------------------
+    @property
+    def arg_dict(self):
+        return dict(zip(self._arg_names, self.arg_arrays))
+
+    @property
+    def grad_dict(self):
+        return dict(zip(self._arg_names, self.grad_arrays))
+
+    @property
+    def aux_dict(self):
+        return dict(zip(self._aux_names, self.aux_arrays))
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    # -- binding helpers (called from Symbol) ------------------------------
+    @staticmethod
+    def _simple_bind(symbol, ctx, grad_req, type_dict, shape_kwargs):
+        from .ndarray import zeros
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shape_kwargs)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        type_dict = type_dict or {}
+        arg_arrays = [zeros(s, ctx=ctx, dtype=type_dict.get(n, "float32"))
+                      for n, s in zip(arg_names, arg_shapes)]
+        if isinstance(grad_req, str):
+            reqs = {n: grad_req for n in arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            reqs = dict(zip(arg_names, grad_req))
+        else:
+            reqs = {n: grad_req.get(n, "null") for n in arg_names}
+        grad_arrays = [zeros(s, ctx=ctx, dtype=type_dict.get(n, "float32"))
+                       if reqs.get(n, "null") != "null" else None
+                       for n, s in zip(arg_names, arg_shapes)]
+        aux_arrays = [zeros(s, ctx=ctx) for s in aux_shapes]
+        return Executor(symbol, ctx, arg_arrays, grad_arrays, reqs, aux_arrays)
+
+    @staticmethod
+    def _bind(symbol, ctx, args, args_grad, grad_req, aux_states):
+        from .ndarray.ndarray import NDArray
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+
+        def _as_list(spec, names, what):
+            if spec is None:
+                return [None] * len(names)
+            if isinstance(spec, dict):
+                return [spec.get(n) for n in names]
+            if isinstance(spec, (list, tuple)):
+                if len(spec) != len(names):
+                    raise MXNetError("%s length mismatch: %d vs %d"
+                                     % (what, len(spec), len(names)))
+                return list(spec)
+            raise MXNetError("%s must be list or dict" % what)
+
+        arg_arrays = _as_list(args, arg_names, "args")
+        if any(a is None for a in arg_arrays):
+            missing = [n for n, a in zip(arg_names, arg_arrays) if a is None]
+            raise MXNetError("bind: missing arguments %s" % missing)
+        grad_arrays = _as_list(args_grad, arg_names, "args_grad")
+        aux_arrays = _as_list(aux_states, aux_names, "aux_states")
+        if any(a is None for a in aux_arrays):
+            # allocate zeros for missing aux
+            from .ndarray import zeros as _z
+            shapes = {n: a.shape for n, a in zip(arg_names, arg_arrays)}
+            _, _, aux_shapes = symbol.infer_shape_partial(**shapes)
+            aux_arrays = [a if a is not None else _z(s, ctx=ctx)
+                          for a, s in zip(aux_arrays, aux_shapes)]
+        return Executor(symbol, ctx, arg_arrays, grad_arrays, grad_req,
+                        aux_arrays)
+
+    # -- execution ---------------------------------------------------------
+    def _raw_args(self):
+        return {n: a._data for n, a in zip(self._arg_names, self.arg_arrays)}
+
+    def _raw_aux(self):
+        return {n: a._data for n, a in zip(self._aux_names, self.aux_arrays)}
+
+    def forward(self, is_train=False, **kwargs):
+        """Run forward (parity: executor.py forward:113)."""
+        from .ndarray.ndarray import NDArray, _wrap
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._set_data(
+                    v._data if isinstance(v, NDArray) else jnp.asarray(v))
+        self._last_key = _random.take_key()
+        fn = self._prog.forward_fn(bool(is_train))
+        outs, aux_up = fn(self._raw_args(), self._raw_aux(), self._last_key)
+        self._write_aux(aux_up)
+        self.outputs = [_wrap(o, self._ctx) for o in outs]
+        if self._monitor_callback is not None:
+            for name, arr in zip(self._symbol.list_outputs(), self.outputs):
+                self._monitor_callback(name, arr)
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        """Run backward (parity: executor.py backward:154). Recomputes the
+        forward inside the fused fwd+bwd XLA program (rematerialisation is
+        cheaper than keeping all activations resident in HBM; XLA CSEs what
+        it can)."""
+        self._run_fwd_bwd(out_grads, is_train=is_train, update_outputs=False)
+
+    def forward_backward(self, out_grads=None, is_train=True, **kwargs):
+        """Fused forward+backward in one compiled call — the Module fast
+        path (one XLA program per train step)."""
+        from .ndarray.ndarray import NDArray
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._set_data(
+                    v._data if isinstance(v, NDArray) else jnp.asarray(v))
+        self._last_key = _random.take_key()
+        self._run_fwd_bwd(out_grads, is_train=is_train, update_outputs=True)
+        return self.outputs
+
+    def _run_fwd_bwd(self, out_grads, is_train, update_outputs):
+        from .ndarray.ndarray import NDArray, _wrap
+        grad_names = tuple(n for n in self._arg_names
+                           if self._grad_req[n] != "null")
+        if not grad_names:
+            if update_outputs:
+                self.forward(is_train=is_train)
+            return
+        key = getattr(self, "_last_key", None)
+        if key is None:
+            key = _random.take_key()
+        fn = self._prog.fwd_bwd_fn(bool(is_train), grad_names)
+        if out_grads is None:
+            hg = [None] * self.output_entries_len()
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            hg = [g._data if isinstance(g, NDArray) else
+                  (jnp.asarray(g) if g is not None else None)
+                  for g in out_grads]
+        # None head grads must be static for jit: substitute ones at trace
+        # time; pass a tuple with None markers replaced lazily
+        hg_concrete = []
+        for i, g in enumerate(hg):
+            hg_concrete.append(g)
+        outs, grads, aux_up = fn(self._raw_args(), self._raw_aux(), key,
+                                 tuple(hg_concrete))
+        self._write_aux(aux_up)
+        if update_outputs:
+            self.outputs = [_wrap(o, self._ctx) for o in outs]
+        gdict = dict(zip(self._arg_names, self.grad_arrays))
+        for n in grad_names:
+            garr = gdict[n]
+            if garr is None:
+                continue
+            if self._grad_req[n] == "add":
+                garr._set_data(garr._data + grads[n].astype(garr._data.dtype))
+            else:
+                garr._set_data(grads[n].astype(garr._data.dtype))
+
+    def output_entries_len(self):
+        return len(self._prog.output_entries)
+
+    def _write_aux(self, aux_up):
+        if not aux_up:
+            return
+        d = self.aux_dict
+        for name, val in aux_up.items():
+            if name in d:
+                d[name]._set_data(val)
+
+    # -- misc --------------------------------------------------------------
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        """(parity: executor.py copy_params_from)"""
+        for name, arr in (arg_params or {}).items():
+            if name in self.arg_dict:
+                arr.copyto(self.arg_dict[name])
+            elif not allow_extra_params:
+                raise MXNetError("unknown argument %r" % name)
+        for name, arr in (aux_params or {}).items():
+            if name in self.aux_dict:
+                arr.copyto(self.aux_dict[name])
+            elif not allow_extra_params:
+                raise MXNetError("unknown aux state %r" % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Return a new executor for new input shapes (parity: executor
+        reshape; on TPU this is simply a new jit signature — compilation is
+        cached per shape like CachedOp)."""
+        shapes = dict(kwargs)
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape_partial(**shapes)
+        from .ndarray import zeros
+        new_args = []
+        for name, arr, s in zip(self._arg_names, self.arg_arrays, arg_shapes):
+            if s is None or tuple(s) == arr.shape:
+                new_args.append(arr)
+            else:
+                new_args.append(zeros(s, ctx=self._ctx))
+        new_grads = []
+        for arr, s in zip(self.grad_arrays, arg_shapes):
+            if arr is None:
+                new_grads.append(None)
+            elif s is None or tuple(s) == arr.shape:
+                new_grads.append(arr)
+            else:
+                new_grads.append(zeros(s, ctx=self._ctx))
+        return Executor(self._symbol, self._ctx, new_args, new_grads,
+                        self._grad_req, self.aux_arrays, program=self._prog)
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self._monitor_callback = callback
+
+    def debug_str(self):
+        lines = ["Symbol outputs: %s" % self._symbol.list_outputs()]
+        for n in self._prog.nodes:
+            lines.append("%s%s" % (n.name, "" if n.op is None
+                                   else " = %s" % n.op.name))
+        return "\n".join(lines)

@@ -12,3 +12,6 @@ from . import nn          # noqa: F401
 from . import random_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import linalg      # noqa: F401
+
+from . import shape_infer as _shape_infer  # noqa: E402
+_shape_infer.install()

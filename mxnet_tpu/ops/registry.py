@@ -70,6 +70,9 @@ class OpDef:
         # Optional hook(raw_inputs, raw_outputs, params) -> {input_idx: new
         # raw value}; models reference ops that mutate aux states in place.
         self.stateful_update = None
+        # Optional hook(input_shapes, params) -> {input_idx: shape} filling
+        # learnable-input shapes (reference FInferShape; see ops/shape_infer.py).
+        self.param_shape_infer = None
 
     def __repr__(self):
         return "OpDef(%s)" % self.name

@@ -1,0 +1,97 @@
+"""Parameter-shape inference for weight-bearing ops.
+
+Parity: the reference's per-op ``FInferShape`` functors (e.g.
+``fully_connected-inl.h`` infers weight=(num_hidden, in_units) from data).
+Only ops with learnable inputs need hooks here — everything else gets its
+output shape from ``jax.eval_shape`` over the op function, which is the
+TPU-native replacement for hand-written inference code.
+
+Each hook: ``(input_shapes, params) -> {input_index: shape}`` filling in
+shapes for inputs whose shape is still unknown. input_shapes[0] (data) is
+always known by the time the executor calls these (forward topo order).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .common import as_tuple
+from .registry import get_op
+
+
+def _fc(shapes, params):
+    data = shapes[0]
+    num_hidden = int(params.get("num_hidden", 0))
+    flatten = params.get("flatten", True)
+    in_units = int(np.prod(data[1:])) if flatten else data[-1]
+    out = {1: (num_hidden, in_units)}
+    if not params.get("no_bias", False):
+        out[2] = (num_hidden,)
+    return out
+
+
+def _conv(shapes, params):
+    data = shapes[0]
+    kernel = as_tuple(params.get("kernel")) or ()
+    num_filter = int(params.get("num_filter", 0))
+    num_group = int(params.get("num_group", 1))
+    out = {1: (num_filter, data[1] // num_group) + kernel}
+    if not params.get("no_bias", False):
+        out[2] = (num_filter,)
+    return out
+
+
+def _deconv(shapes, params):
+    data = shapes[0]
+    kernel = as_tuple(params.get("kernel")) or ()
+    num_filter = int(params.get("num_filter", 0))
+    num_group = int(params.get("num_group", 1))
+    out = {1: (data[1], num_filter // num_group) + kernel}
+    if not params.get("no_bias", True):
+        out[2] = (num_filter,)
+    return out
+
+
+def _bn(shapes, params):
+    c = shapes[0][int(params.get("axis", 1)) % len(shapes[0])]
+    return {1: (c,), 2: (c,), 3: (c,), 4: (c,)}
+
+
+def _instance_norm(shapes, params):
+    c = shapes[0][1]
+    return {1: (c,), 2: (c,)}
+
+
+def _layer_norm(shapes, params):
+    c = shapes[0][int(params.get("axis", -1)) % len(shapes[0])]
+    return {1: (c,), 2: (c,)}
+
+
+def _embedding(shapes, params):
+    return {1: (int(params["input_dim"]), int(params["output_dim"]))}
+
+
+def _leaky_relu(shapes, params):
+    if params.get("act_type", "leaky") == "prelu":
+        return {1: (shapes[0][1],)}
+    return {}
+
+
+def _upsampling(shapes, params):
+    if params.get("sample_type") == "bilinear":
+        scale = int(params.get("scale", 1))
+        kernel = 2 * scale - scale % 2
+        c = shapes[0][1]
+        return {1: (c, 1, kernel, kernel)}
+    return {}
+
+
+def install():
+    get_op("FullyConnected").param_shape_infer = _fc
+    get_op("Convolution").param_shape_infer = _conv
+    get_op("Deconvolution").param_shape_infer = _deconv
+    get_op("BatchNorm").param_shape_infer = _bn
+    get_op("InstanceNorm").param_shape_infer = _instance_norm
+    get_op("LayerNorm").param_shape_infer = _layer_norm
+    get_op("Embedding").param_shape_infer = _embedding
+    get_op("LeakyReLU").param_shape_infer = _leaky_relu
+    get_op("UpSampling").param_shape_infer = _upsampling

@@ -1,0 +1,18 @@
+"""The ``mx.sym`` namespace (parity: python/mxnet/symbol/__init__.py)."""
+from .symbol import Symbol, Variable, var, Group, load, load_json
+from . import register as _register
+
+_register.populate(globals())
+
+# creation helpers mirroring mx.sym.zeros/ones (build graphs around consts)
+def zeros(shape, dtype="float32", **kwargs):
+    return globals()["_zeros"](shape=shape, dtype=dtype, **kwargs)
+
+
+def ones(shape, dtype="float32", **kwargs):
+    return globals()["_ones"](shape=shape, dtype=dtype, **kwargs)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, dtype="float32", **kwargs):
+    return globals()["_arange"](start=start, stop=stop, step=step,
+                                repeat=repeat, dtype=dtype, **kwargs)

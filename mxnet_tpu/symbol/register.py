@@ -1,0 +1,48 @@
+"""Code-generation of the ``sym.*`` operator namespace.
+
+Parity: reference ``python/mxnet/symbol/register.py``.
+"""
+from __future__ import annotations
+
+from ..ops import registry as _registry
+from .symbol import Symbol, _create
+
+
+def make_sym_func(op):
+    arg_names = op.arg_names
+
+    def generic_op(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        inputs = []
+        i = 0
+        while i < len(args) and isinstance(args[i], Symbol):
+            inputs.append(args[i])
+            i += 1
+        # trailing positional values map onto params in declaration order
+        param_order = list(op.defaults)
+        for j, val in enumerate(args[i:]):
+            if j < len(param_order):
+                kwargs.setdefault(param_order[j], val)
+        if op.nin != -1:
+            for an in arg_names[len(inputs):]:
+                if an in kwargs and isinstance(kwargs[an], Symbol):
+                    inputs.append(kwargs.pop(an))
+                elif any(isinstance(kwargs.get(a), Symbol)
+                         for a in arg_names[len(inputs) + 1:]):
+                    # a later named input is a Symbol: placeholder variable
+                    from .symbol import Variable
+                    inputs.append(Variable("%s_%s" % (name or op.name.lower(), an)))
+                else:
+                    break
+        return _create(op.name, inputs, kwargs, name=name)
+
+    generic_op.__name__ = op.name
+    generic_op.__doc__ = op.doc or ("%s symbolic operator" % op.name)
+    return generic_op
+
+
+def populate(namespace):
+    for name in _registry.list_ops():
+        op = _registry.get_op(name)
+        namespace[name] = make_sym_func(op)
+    return namespace

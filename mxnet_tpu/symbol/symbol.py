@@ -1,0 +1,466 @@
+"""Symbol — declarative graph construction.
+
+Parity: reference ``python/mxnet/symbol/symbol.py`` over nnvm::Symbol
+(SURVEY.md §2.1 "nnvm equivalent"). TPU-native design: a Symbol is a
+light Python DAG of op nodes; binding it compiles the WHOLE graph into a
+single jitted XLA computation (see executor.py) — the nnvm pass pipeline
+(PlanMemory, inplace detection, op fusion into engine bulks) is exactly
+what XLA's compiler does better on TPU, so there is no separate IR.
+
+JSON serialization keeps the reference's node-list format
+(``nodes``/``arg_nodes``/``heads``) so saved graphs look familiar and
+round-trip; op names and kwargs match the reference registry.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from ..base import MXNetError, NameManager
+from ..ops import registry as _registry
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
+
+
+class _SymNode:
+    """One graph node: an op application or a variable (op=None)."""
+
+    __slots__ = ("op", "name", "attrs", "inputs", "_extra_attrs")
+
+    def __init__(self, op, name, attrs, inputs):
+        self.op = op            # OpDef or None for variables
+        self.name = name
+        self.attrs = dict(attrs)        # op params (strings/values)
+        self.inputs = list(inputs)      # list of (node, out_index)
+        self._extra_attrs = {}          # user attrs (__shape__, lr_mult…)
+
+    def num_outputs(self):
+        if self.op is None:
+            return 1
+        if self.op.nout == -1:  # SliceChannel-style: from params
+            return int(self.attrs.get("num_outputs", 1))
+        return self.op.visible_outputs or self.op.nout
+
+
+class Symbol:
+    """An immutable handle on a list of node outputs."""
+
+    def __init__(self, outputs):
+        self._outputs = list(outputs)   # list of (node, out_index)
+
+    # -- composition helpers ----------------------------------------------
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    def __repr__(self):
+        names = ", ".join(n.name for n, _ in self._outputs)
+        return "<Symbol %s>" % names
+
+    def __iter__(self):
+        return (Symbol([o]) for o in self._outputs)
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise MXNetError("output %r not found in %s" % (index, names))
+            index = names.index(index)
+        if isinstance(index, slice):
+            return Symbol(self._outputs[index])
+        return Symbol([self._outputs[index]])
+
+    def __copy__(self):
+        return Symbol(list(self._outputs))
+
+    def __deepcopy__(self, memo):
+        return load_json(self.tojson())
+
+    # -- graph traversal ---------------------------------------------------
+    def _topo_nodes(self):
+        """All nodes in topological order."""
+        order, seen = [], set()
+        stack = [n for n, _ in self._outputs]
+        # iterative post-order
+        visit = [(n, False) for n in reversed(stack)]
+        while visit:
+            node, done = visit.pop()
+            if done:
+                order.append(node)
+                continue
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            visit.append((node, True))
+            for child, _ in reversed(node.inputs):
+                if id(child) not in seen:
+                    visit.append((child, False))
+        return order
+
+    def _aux_var_ids(self):
+        """Variables used only in aux positions (BatchNorm moving stats)."""
+        aux, non_aux = set(), set()
+        for node in self._topo_nodes():
+            if node.op is None:
+                continue
+            aux_idx = set(node.op.aux_inputs)
+            for i, (child, _) in enumerate(node.inputs):
+                if child.op is None:
+                    (aux if i in aux_idx else non_aux).add(id(child))
+        return aux - non_aux
+
+    def list_arguments(self):
+        """Input variable names, topo order (parity: Symbol.list_arguments)."""
+        aux_ids = self._aux_var_ids()
+        return [n.name for n in self._topo_nodes()
+                if n.op is None and id(n) not in aux_ids]
+
+    def list_auxiliary_states(self):
+        aux_ids = self._aux_var_ids()
+        return [n.name for n in self._topo_nodes()
+                if n.op is None and id(n) in aux_ids]
+
+    def list_outputs(self):
+        outs = []
+        for node, idx in self._outputs:
+            if node.op is None:
+                outs.append(node.name)
+            elif node.num_outputs() == 1:
+                outs.append(node.name + "_output")
+            else:
+                outs.append("%s_output%d" % (node.name, idx))
+        return outs
+
+    def get_internals(self):
+        """Symbol exposing every node's outputs (parity: get_internals)."""
+        outs = []
+        for node in self._topo_nodes():
+            for i in range(node.num_outputs()):
+                outs.append((node, i))
+        return Symbol(outs)
+
+    def get_children(self):
+        inputs = []
+        for node, _ in self._outputs:
+            inputs.extend(node.inputs)
+        return Symbol(inputs) if inputs else None
+
+    # -- attrs -------------------------------------------------------------
+    def attr(self, key):
+        node = self._outputs[0][0]
+        return node._extra_attrs.get(key)
+
+    def _set_attr(self, **kwargs):
+        node = self._outputs[0][0]
+        node._extra_attrs.update(kwargs)
+
+    def attr_dict(self):
+        out = {}
+        for node in self._topo_nodes():
+            d = {}
+            d.update({k: str(v) for k, v in node.attrs.items()})
+            d.update(node._extra_attrs)
+            if d:
+                out[node.name] = d
+        return out
+
+    # -- shape/type inference ---------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        """(parity: Symbol.infer_shape) returns (arg_shapes, out_shapes,
+        aux_shapes); unknown arg shapes are inferred via the op hooks +
+        jax.eval_shape (see executor._GraphProgram)."""
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except MXNetError:
+            raise
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        from ..executor import infer_graph_shapes
+        arg_names = self.list_arguments()
+        known = {}
+        if args:
+            for name, shape in zip(arg_names, args):
+                if shape is not None:
+                    known[name] = tuple(shape)
+        known.update({k: tuple(v) for k, v in kwargs.items() if v is not None})
+        return infer_graph_shapes(self, known, partial=partial)
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        dtype = np.float32
+        for v in list(args) + list(kwargs.values()):
+            if v is not None:
+                dtype = np.dtype(v)
+                break
+        return ([dtype] * len(arg_names),
+                [dtype] * len(self.list_outputs()),
+                [dtype] * len(self.list_auxiliary_states()))
+
+    # -- serialization -----------------------------------------------------
+    def tojson(self):
+        """Reference-compatible JSON node list (parity: nnvm SaveJSON)."""
+        nodes = self._topo_nodes()
+        node_id = {id(n): i for i, n in enumerate(nodes)}
+        out_nodes = []
+        for n in nodes:
+            entry = {
+                "op": "null" if n.op is None else n.op.name,
+                "name": n.name,
+                "inputs": [[node_id[id(c)], idx, 0] for c, idx in n.inputs],
+            }
+            attrs = {k: str(v) for k, v in n.attrs.items()}
+            attrs.update(n._extra_attrs)
+            if attrs:
+                entry["attrs"] = attrs
+            out_nodes.append(entry)
+        graph = {
+            "nodes": out_nodes,
+            "arg_nodes": [i for i, n in enumerate(nodes) if n.op is None],
+            "node_row_ptr": list(range(len(nodes) + 1)),
+            "heads": [[node_id[id(n)], idx, 0] for n, idx in self._outputs],
+            "attrs": {"mxnet_version": ["int", 1200]},
+        }
+        return json.dumps(graph, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- binding -----------------------------------------------------------
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    stype_dict=None, group2ctx=None, shared_arg_names=None,
+                    shared_exec=None, shared_buffer=None, **kwargs):
+        """Allocate arrays and bind (parity: symbol.py simple_bind:1254)."""
+        from ..executor import Executor
+        return Executor._simple_bind(self, ctx, grad_req, type_dict, kwargs)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        """Bind with existing arrays (parity: symbol.py bind:1518)."""
+        from ..executor import Executor
+        return Executor._bind(self, ctx, args, args_grad, grad_req, aux_states)
+
+    # -- eval / call -------------------------------------------------------
+    def eval(self, ctx=None, **kwargs):
+        ex = self.bind(ctx=ctx, args=kwargs)
+        return ex.forward()
+
+    def __call__(self, *args, **kwargs):
+        """Compose: replace free variables with given symbols (parity:
+        Symbol composition)."""
+        name = kwargs.pop("name", None)
+        mapping = {}
+        arg_names = self.list_arguments()
+        for n, s in zip(arg_names, args):
+            mapping[n] = s
+        mapping.update(kwargs)
+        for k, v in mapping.items():
+            if not isinstance(v, Symbol):
+                raise MXNetError("compose expects Symbols")
+        return self._compose(mapping)
+
+    def _compose(self, mapping):
+        memo = {}
+
+        def clone(node):
+            if id(node) in memo:
+                return memo[id(node)]
+            if node.op is None and node.name in mapping:
+                new = mapping[node.name]._outputs[0][0]
+            else:
+                new = _SymNode(node.op, node.name, node.attrs,
+                               [(clone(c), i) for c, i in node.inputs])
+                new._extra_attrs = dict(node._extra_attrs)
+            memo[id(node)] = new
+            return new
+
+        return Symbol([(clone(n), i) for n, i in self._outputs])
+
+    # -- operators ---------------------------------------------------------
+    def _binary(self, other, op_name, scalar_op, reverse=False):
+        if isinstance(other, Symbol):
+            lhs, rhs = (other, self) if reverse else (self, other)
+            return _create(op_name, [lhs, rhs], {})
+        if isinstance(other, (int, float)):
+            return _create(scalar_op, [self], {"scalar": other})
+        raise TypeError("unsupported operand %r" % (other,))
+
+    def __add__(self, other):
+        return self._binary(other, "elemwise_add" if isinstance(other, Symbol)
+                            else "broadcast_add", "_plus_scalar")
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __sub__(self, other):
+        return self._binary(other, "elemwise_sub", "_minus_scalar")
+
+    def __rsub__(self, other):
+        return _create("_rminus_scalar", [self], {"scalar": other})
+
+    def __mul__(self, other):
+        return self._binary(other, "elemwise_mul", "_mul_scalar")
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __truediv__(self, other):
+        return self._binary(other, "elemwise_div", "_div_scalar")
+
+    def __rtruediv__(self, other):
+        return _create("_rdiv_scalar", [self], {"scalar": other})
+
+    __div__ = __truediv__
+
+    def __pow__(self, other):
+        return self._binary(other, "broadcast_power", "_power_scalar")
+
+    def __neg__(self):
+        return _create("negative", [self], {})
+
+    # convenience mirrors of NDArray methods
+    def reshape(self, shape, **kw):
+        return _create("Reshape", [self], {"shape": shape, **kw})
+
+    def sum(self, **kw):
+        return _create("sum", [self], kw)
+
+    def mean(self, **kw):
+        return _create("mean", [self], kw)
+
+    def flatten(self):
+        return _create("Flatten", [self], {})
+
+    def transpose(self, axes=()):
+        return _create("transpose", [self], {"axes": axes})
+
+    def slice_axis(self, axis, begin, end):
+        return _create("slice_axis", [self], {"axis": axis, "begin": begin,
+                                              "end": end})
+
+    def astype(self, dtype):
+        return _create("Cast", [self], {"dtype": dtype})
+
+
+# ---------------------------------------------------------------------------
+# builders
+# ---------------------------------------------------------------------------
+
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
+             dtype=None, init=None, stype=None, **kwargs):
+    """Create a symbolic variable (parity: mx.sym.Variable)."""
+    node = _SymNode(None, name, {}, [])
+    extra = dict(attr or {})
+    if shape is not None:
+        extra["__shape__"] = str(tuple(shape))
+    if lr_mult is not None:
+        extra["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        extra["__wd_mult__"] = str(wd_mult)
+    if dtype is not None:
+        extra["__dtype__"] = str(np.dtype(dtype))
+    if init is not None:
+        extra["__init__"] = init if isinstance(init, str) else init.dumps()
+    node._extra_attrs = extra
+    return Symbol([(node, 0)])
+
+
+var = Variable
+
+
+def Group(symbols):
+    """Group symbols into one multi-output symbol (parity: mx.sym.Group)."""
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs)
+    return Symbol(outs)
+
+
+def _create(op_name, input_syms, kwargs, name=None):
+    """Create an op node (used by the generated sym.* functions)."""
+    op = _registry.get_op(op_name)
+    kwargs = dict(kwargs)
+    name = name or kwargs.pop("name", None)
+    kwargs.pop("out", None)
+    inputs = []
+    for s in input_syms:
+        if len(s._outputs) != 1:
+            if op.nin == -1:
+                inputs.extend(s._outputs)
+                continue
+            raise MXNetError("op %s expects single-output inputs" % op_name)
+        inputs.append(s._outputs[0])
+    if name is None:
+        name = NameManager.get(op.name.lower().lstrip("_"))
+    # auto-create variables for missing learnable inputs (e.g. weight/bias
+    # when calling sym.Convolution(data, kernel=..) without weight=)
+    if op.nin not in (-1, 0) and len(inputs) < op.nin:
+        needed = op.nin - len(inputs)
+        no_bias = kwargs.get("no_bias", op.defaults.get("no_bias", False))
+        for ai in range(len(inputs), op.nin):
+            arg_name = op.arg_names[ai] if ai < len(op.arg_names) else "arg%d" % ai
+            if no_bias and arg_name == "bias":
+                continue
+            if op.name == "LeakyReLU" and kwargs.get(
+                    "act_type", op.defaults.get("act_type")) != "prelu":
+                continue
+            if op.name in ("SequenceLast", "SequenceMask", "SequenceReverse") \
+                    and not kwargs.get("use_sequence_length", False):
+                continue
+            full = "%s_%s" % (name, arg_name)
+            inputs.append((Variable(full)._outputs[0]))
+    node = _SymNode(op, name, kwargs, inputs)
+    n_out = node.num_outputs()
+    return Symbol([(node, i) for i in range(n_out)])
+
+
+def load_json(json_str):
+    """Load a symbol from reference-format JSON (parity: sym.load_json,
+    reference src/nnvm/legacy_json_util.cc handles versioning)."""
+    graph = json.loads(json_str)
+    nodes = []
+    for entry in graph["nodes"]:
+        attrs = entry.get("attrs", entry.get("param", {}))
+        extra = {k: v for k, v in attrs.items() if k.startswith("__")}
+        params = {k: _parse_attr(v) for k, v in attrs.items()
+                  if not k.startswith("__")}
+        if entry["op"] == "null":
+            node = _SymNode(None, entry["name"], {}, [])
+            node._extra_attrs = extra
+        else:
+            op = _registry.get_op(entry["op"])
+            inputs = [(nodes[i], idx) for i, idx, *_ in entry["inputs"]]
+            node = _SymNode(op, entry["name"], params, inputs)
+            node._extra_attrs = extra
+        nodes.append(node)
+    heads = graph.get("heads", [[len(nodes) - 1, 0, 0]])
+    return Symbol([(nodes[i], idx) for i, idx, *_ in heads])
+
+
+def _parse_attr(v):
+    if not isinstance(v, str):
+        return v
+    low = v.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    if low in ("none", "null"):
+        return None
+    try:
+        import ast
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
