@@ -34,5 +34,18 @@ from . import symbol                 # noqa: E402
 from . import symbol as sym          # noqa: E402
 from .symbol import Symbol           # noqa: E402
 from .executor import Executor       # noqa: E402
+from . import initializer            # noqa: E402
+from .initializer import init_registry  # noqa: E402
+from . import optimizer              # noqa: E402
+from . import lr_scheduler           # noqa: E402
+from . import metric                 # noqa: E402
+from . import io                     # noqa: E402
+from . import recordio               # noqa: E402
+from . import kvstore                # noqa: E402
+from .kvstore import KVStore         # noqa: E402
+from . import callback               # noqa: E402
+from . import model                  # noqa: E402
+from . import module                 # noqa: E402
+from . import module as mod          # noqa: E402
 
 __version__ = "0.1.0"

@@ -296,8 +296,10 @@ class Executor:
         from .ndarray.ndarray import NDArray, _wrap
         for k, v in kwargs.items():
             if k in self.arg_dict:
-                self.arg_dict[k]._set_data(
-                    v._data if isinstance(v, NDArray) else jnp.asarray(v))
+                if isinstance(v, NDArray):
+                    v.copyto(self.arg_dict[k])
+                else:
+                    self.arg_dict[k][:] = np.asarray(v)
         self._last_key = _random.take_key()
         fn = self._prog.forward_fn(bool(is_train))
         outs, aux_up = fn(self._raw_args(), self._raw_aux(), self._last_key)
@@ -321,8 +323,10 @@ class Executor:
         from .ndarray.ndarray import NDArray
         for k, v in kwargs.items():
             if k in self.arg_dict:
-                self.arg_dict[k]._set_data(
-                    v._data if isinstance(v, NDArray) else jnp.asarray(v))
+                if isinstance(v, NDArray):
+                    v.copyto(self.arg_dict[k])
+                else:
+                    self.arg_dict[k][:] = np.asarray(v)
         self._last_key = _random.take_key()
         self._run_fwd_bwd(out_grads, is_train=is_train, update_outputs=True)
         return self.outputs

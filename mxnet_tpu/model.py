@@ -1,0 +1,69 @@
+"""Model helpers: checkpointing + the BatchEndParam plumbing.
+
+Parity: reference ``python/mxnet/model.py`` (save_checkpoint:366,
+load_checkpoint:396, BatchEndParam, _create_kvstore). The legacy
+FeedForward API is represented by Module (module/), which the reference
+itself recommends.
+
+Checkpoint format (parity: SURVEY.md §5.4's three artifacts):
+  prefix-symbol.json   — graph JSON (reference-compatible node list)
+  prefix-NNNN.params   — arg:/aux:-prefixed arrays (nd.save container)
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+from . import symbol as sym
+from .ndarray import save as _nd_save, load as _nd_load
+
+BatchEndParam = namedtuple("BatchEndParams",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """(parity: model._create_kvstore:58)"""
+    from . import kvstore as kvs
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, kvs.KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and "dist" not in kvstore:
+            kv = None
+        else:
+            kv = kvs.create(kvstore)
+            if kvstore == "local":
+                max_size = max(int(__import__("numpy").prod(p.shape))
+                               for p in arg_params.values()) if arg_params else 0
+                if max_size > 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise TypeError("kvstore must be KVStore, str, or None")
+    if kv is None:
+        update_on_kvstore = False
+    return kv, update_on_kvstore
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """(parity: model.save_checkpoint:366)"""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    _nd_save(param_name, save_dict)
+
+
+def load_checkpoint(prefix, epoch):
+    """(parity: model.load_checkpoint:396) -> (symbol, arg_params, aux_params)"""
+    symbol = sym.load("%s-symbol.json" % prefix)
+    save_dict = _nd_load("%s-%04d.params" % (prefix, epoch))
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return symbol, arg_params, aux_params

@@ -1,0 +1,6 @@
+"""Module API (parity: python/mxnet/module/__init__.py)."""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule
+from . import executor_group
+from .executor_group import DataParallelExecutorGroup

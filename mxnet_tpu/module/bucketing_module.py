@@ -1,0 +1,177 @@
+"""BucketingModule — variable-length sequence training.
+
+Parity: reference ``python/mxnet/module/bucketing_module.py:35``. The
+reference binds one executor per bucket sharing one memory pool; here each
+bucket is simply a distinct jit signature of the same weights — XLA caches
+one compiled program per bucket (the CachedOp per-signature re-plan,
+SURVEY.md §7 "Dynamic shapes"), and parameters are shared by reference.
+"""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from .base_module import BaseModule
+from .module import Module
+
+
+class BucketingModule(BaseModule):
+    """(parity: bucketing_module.BucketingModule)"""
+
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None, compression_params=None):
+        super().__init__(logger=logger)
+        assert default_bucket_key is not None
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._fixed_param_names = fixed_param_names
+        self._state_names = state_names
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+        self._params_dirty = False
+
+    @property
+    def data_names(self):
+        if self.binded:
+            return self._curr_module.data_names
+        _, data_names, _ = self._call_sym_gen(self._default_bucket_key)
+        return data_names
+
+    @property
+    def output_names(self):
+        if self.binded:
+            return self._curr_module.output_names
+        symbol, _, _ = self._call_sym_gen(self._default_bucket_key)
+        return symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._curr_module.data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._curr_module.label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return self._curr_module.output_shapes
+
+    @property
+    def symbol(self):
+        assert self.binded
+        return self._curr_module.symbol
+
+    def _call_sym_gen(self, bucket_key):
+        return self._sym_gen(bucket_key)
+
+    def _get_module(self, bucket_key, data_shapes=None, label_shapes=None):
+        if bucket_key not in self._buckets:
+            symbol, data_names, label_names = self._call_sym_gen(bucket_key)
+            module = Module(symbol, data_names, label_names,
+                            logger=self.logger, context=self._context,
+                            fixed_param_names=self._fixed_param_names,
+                            state_names=self._state_names)
+            self._buckets[bucket_key] = module
+        return self._buckets[bucket_key]
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        module = self._get_module(self._default_bucket_key)
+        module.bind(data_shapes, label_shapes, for_training, inputs_need_grad,
+                    force_rebind=force_rebind, grad_req=grad_req)
+        self._curr_module = module
+        self._curr_bucket_key = self._default_bucket_key
+        self.binded = True
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """(parity: bucketing_module.switch_bucket)"""
+        assert self.binded
+        module = self._get_module(bucket_key)
+        if not module.binded:
+            module.bind(data_shapes, label_shapes, self.for_training,
+                        self.inputs_need_grad)
+            if self._curr_module.params_initialized:
+                arg_p, aux_p = self._curr_module.get_params()
+                module.init_params(arg_params=arg_p, aux_params=aux_p,
+                                   allow_missing=False, force_init=True)
+                module.params_initialized = True
+            if self._curr_module.optimizer_initialized:
+                module._optimizer = self._curr_module._optimizer
+                module._updater = self._curr_module._updater
+                module._kvstore = self._curr_module._kvstore
+                module._update_on_kvstore = self._curr_module._update_on_kvstore
+                module.optimizer_initialized = True
+        else:
+            # share the latest params
+            if self._curr_module is not module and \
+                    self._curr_module.params_initialized:
+                arg_p, aux_p = self._curr_module.get_params()
+                module.init_params(arg_params=arg_p, aux_params=aux_p,
+                                   force_init=True)
+        self._curr_module = module
+        self._curr_bucket_key = bucket_key
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        assert self.binded
+        from ..initializer import Uniform
+        self._curr_module.init_params(
+            initializer=initializer if initializer is not None else Uniform(0.01),
+            arg_params=arg_params, aux_params=aux_params,
+            allow_missing=allow_missing, force_init=force_init,
+            allow_extra=allow_extra)
+        self.params_initialized = True
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        return self._curr_module.get_params()
+
+    def init_optimizer(self, **kwargs):
+        assert self.binded and self.params_initialized
+        self._curr_module.init_optimizer(**kwargs)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        self.switch_bucket(data_batch.bucket_key, data_batch.provide_data,
+                           data_batch.provide_label)
+        self._curr_module.forward(data_batch, is_train=is_train)
+
+    def forward_backward(self, data_batch):
+        assert self.binded and self.params_initialized
+        self.switch_bucket(data_batch.bucket_key, data_batch.provide_data,
+                           data_batch.provide_label)
+        self._curr_module.forward_backward(data_batch)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads=out_grads)
+
+    def update(self):
+        self._params_dirty = True
+        self._curr_module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._curr_module.get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        self._curr_module.update_metric(eval_metric, labels)
+
+    def install_monitor(self, mon):
+        for module in self._buckets.values():
+            if module.binded:
+                module.install_monitor(mon)

@@ -1,0 +1,69 @@
+"""DataParallelExecutorGroup — compatibility facade.
+
+Parity: reference ``python/mxnet/module/executor_group.py:128`` which
+splits each batch across GPU contexts and keeps one executor per device
+(decide_slices:266). TPU-native design: batch splitting across chips is a
+SHARDING of one executor's program, not N executors — XLA partitions the
+program over the mesh and inserts ICI collectives (see mxnet_tpu.parallel).
+This class keeps the reference API for code that instantiates it directly,
+delegating to a single Executor.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+
+def decide_slices(batch_size, work_load_list):
+    """Split a batch between workers proportionally (parity:
+    executor_group.decide_slices:266); retained for API compatibility."""
+    total = sum(work_load_list)
+    slices = []
+    start = 0
+    for w in work_load_list:
+        n = int(round(batch_size * w / total))
+        slices.append(slice(start, start + n))
+        start += n
+    if start != batch_size and slices:
+        last = slices[-1]
+        slices[-1] = slice(last.start, batch_size)
+    return slices
+
+
+class DataParallelExecutorGroup:
+    """(parity: executor_group.DataParallelExecutorGroup:128)"""
+
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad,
+                 shared_group=None, logger=None, fixed_param_names=None,
+                 grad_req="write", state_names=None):
+        self.symbol = symbol
+        self.contexts = contexts
+        self.param_names = param_names
+        self.for_training = for_training
+        shape_kwargs = {name: shape for name, shape in
+                        [(d[0], d[1]) for d in data_shapes]}
+        if label_shapes:
+            shape_kwargs.update({l[0]: l[1] for l in label_shapes})
+        reqs = {}
+        for name in symbol.list_arguments():
+            if name in (fixed_param_names or []):
+                reqs[name] = "null"
+            elif name in param_names:
+                reqs[name] = grad_req if for_training else "null"
+            else:
+                reqs[name] = "write" if inputs_need_grad else "null"
+        self.execs = [symbol.simple_bind(ctx=contexts[0], grad_req=reqs,
+                                         **shape_kwargs)]
+
+    def forward(self, data_batch, is_train=None):
+        ex = self.execs[0]
+        data = data_batch.data
+        for (name, _), arr in zip(ex._symbol.list_arguments(), data):
+            pass
+        ex.forward(is_train=bool(is_train))
+
+    def backward(self, out_grads=None):
+        self.execs[0].backward(out_grads=out_grads)
+
+    def get_outputs(self, merge_multi_context=True):
+        return self.execs[0].outputs

@@ -1,0 +1,380 @@
+"""Module — symbolic training on a bound executor.
+
+Parity: reference ``python/mxnet/module/module.py``. TPU-native design:
+where the reference builds a DataParallelExecutorGroup with one executor
+per GPU and reduces through KVStore (executor_group.py:128,
+model.py:106-138), this Module binds ONE executor whose compiled program
+covers the whole (possibly mesh-sharded) computation — multi-chip data
+parallelism is expressed as sharding on the same program
+(mxnet_tpu.parallel), not as replicated executors, because XLA then
+schedules the ICI all-reduce inside the step. The KVStore push/pull
+protocol is still honoured when a kvstore is provided
+(update_on_kvstore ≙ reference semantics).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..initializer import Uniform, InitDesc
+from ..model import _create_kvstore, save_checkpoint, load_checkpoint
+from .. import optimizer as opt
+from ..ndarray.ndarray import NDArray, zeros
+from .base_module import BaseModule, _as_list
+
+
+class Module(BaseModule):
+    """(parity: module.Module)"""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging, context=None,
+                 work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger=logger)
+        if context is None:
+            context = current_context()
+        if isinstance(context, Context):
+            context = [context]
+        self._context = context
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._state_names = list(state_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        input_names = self._data_names + self._label_names + self._state_names
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+        self._arg_params = None
+        self._aux_params = None
+        self._params_dirty = False
+        self._optimizer = None
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._updater = None
+        self._exec = None
+        self._data_shapes = None
+        self._label_shapes = None
+        self._grad_req = None
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return [(n, o.shape) for n, o in
+                zip(self._output_names, self._exec.outputs)] \
+            if self._exec.outputs else None
+
+    # -- bind --------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        """(parity: module.py bind:363)"""
+        if force_rebind:
+            self._exec = None
+            self.binded = False
+        if self.binded:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+
+        self._data_shapes = [_as_desc(d) for d in data_shapes]
+        self._label_shapes = [_as_desc(l) for l in label_shapes] \
+            if label_shapes else []
+
+        shape_kwargs = {d.name: d.shape for d in self._data_shapes}
+        shape_kwargs.update({l.name: l.shape for l in self._label_shapes})
+
+        reqs = {}
+        for name in self._symbol.list_arguments():
+            if name in self._data_names:
+                reqs[name] = "write" if inputs_need_grad else "null"
+            elif name in self._label_names or name in self._state_names:
+                reqs[name] = "null"
+            elif name in self._fixed_param_names:
+                reqs[name] = "null"
+            else:
+                reqs[name] = grad_req if for_training else "null"
+        self._grad_req = reqs
+        ctx = self._context[0]
+        self._exec = self._symbol.simple_bind(ctx=ctx, grad_req=reqs,
+                                              **shape_kwargs)
+        self.binded = True
+        if shared_module is not None and shared_module.params_initialized:
+            arg_p, aux_p = shared_module.get_params()
+            self.set_params(arg_p, aux_p)
+
+    # -- params ------------------------------------------------------------
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        """(parity: module.py init_params)"""
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before init_params"
+        if arg_params is None and self._arg_params is not None:
+            arg_params = self._arg_params
+        if aux_params is None and self._aux_params is not None:
+            aux_params = self._aux_params
+        attrs = self._symbol.attr_dict()
+
+        for name, arr in self._exec.arg_dict.items():
+            if name in self._data_names or name in self._label_names \
+                    or name in self._state_names:
+                continue
+            given = (arg_params or {}).get(name)
+            if given is not None:
+                given.copyto(arr) if isinstance(given, NDArray) \
+                    else arr.__setitem__(slice(None), given)
+            elif not allow_missing or initializer is not None:
+                if initializer is None:
+                    if not allow_missing:
+                        raise MXNetError("no initializer and no value for %r"
+                                         % name)
+                    continue
+                desc = InitDesc(name, attrs.get(name))
+                initializer(desc, arr)
+        for name, arr in self._exec.aux_dict.items():
+            given = (aux_params or {}).get(name)
+            if given is not None:
+                given.copyto(arr)
+            elif initializer is not None:
+                desc = InitDesc(name, attrs.get(name))
+                initializer(desc, arr)
+        self.params_initialized = True
+        self._params_dirty = False
+
+    def get_params(self):
+        """(parity: module.get_params) returns host copies."""
+        assert self.binded and self.params_initialized
+        arg_params = {n: arr.copy() for n, arr in self._exec.arg_dict.items()
+                      if n in self._param_names}
+        aux_params = {n: arr.copy() for n, arr in self._exec.aux_dict.items()}
+        return arg_params, aux_params
+
+    # -- optimizer ---------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        """(parity: module.py init_optimizer:472)"""
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        arg_dict = self._exec.arg_dict
+        kv, update_on_kvstore = _create_kvstore(
+            kvstore, len(self._context),
+            {n: arg_dict[n] for n in self._param_names})
+
+        if isinstance(optimizer, str):
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            optimizer_params = dict(optimizer_params)
+            optimizer_params.setdefault("rescale_grad", 1.0)
+            optimizer = opt.create(optimizer, sym=self._symbol,
+                                   param_idx2name=idx2name,
+                                   **optimizer_params)
+        self._optimizer = optimizer
+        self._kvstore = kv
+        self._update_on_kvstore = update_on_kvstore
+        self._updater = None
+        if kv is not None:
+            if kv.type == "dist_sync" or update_on_kvstore:
+                pass
+            for i, name in enumerate(self._param_names):
+                kv.init(i, arg_dict[name])
+            if update_on_kvstore:
+                kv.set_optimizer(self._optimizer)
+        if not update_on_kvstore:
+            self._updater = opt.get_updater(optimizer)
+        self.optimizer_initialized = True
+
+    # -- compute -----------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        """(parity: module.forward)"""
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        self._set_batch(data_batch)
+        self._exec.forward(is_train=is_train)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def forward_backward(self, data_batch):
+        """Fused single-XLA-program step (overrides the base two-call path)."""
+        assert self.binded and self.params_initialized
+        self._set_batch(data_batch)
+        self._exec.forward_backward()
+
+    def _set_batch(self, data_batch):
+        data = data_batch.data
+        if not isinstance(data, (list, tuple)):
+            data = [data]
+        arg_dict = self._exec.arg_dict
+        # variable batch shapes (e.g. eval batch != train batch): the
+        # reference reshapes its executors (executor.py reshape); here the
+        # same program simply jits a second signature, so just swap storage.
+        reshaped = False
+        for desc, arr in zip(self._data_shapes, data):
+            if tuple(arr.shape) != arg_dict[desc.name].shape:
+                arg_dict[desc.name]._set_data(
+                    np.zeros(arr.shape, dtype=np.float32))
+                reshaped = True
+        if reshaped and data_batch.label is not None:
+            labels = data_batch.label
+            if not isinstance(labels, (list, tuple)):
+                labels = [labels]
+            for desc, arr in zip(self._label_shapes, labels):
+                if tuple(arr.shape) != arg_dict[desc.name].shape:
+                    arg_dict[desc.name]._set_data(
+                        np.zeros(arr.shape, dtype=np.float32))
+        for desc, arr in zip(self._data_shapes, data):
+            if isinstance(arr, NDArray):
+                arr.copyto(arg_dict[desc.name])
+            else:
+                arg_dict[desc.name][:] = np.asarray(arr)
+        label = data_batch.label
+        if label is not None:
+            if not isinstance(label, (list, tuple)):
+                label = [label]
+            for desc, arr in zip(self._label_shapes, label):
+                if isinstance(arr, NDArray):
+                    arr.copyto(arg_dict[desc.name])
+                else:
+                    arg_dict[desc.name][:] = np.asarray(arr)
+
+    def update(self):
+        """Apply one optimizer step (parity: module.update →
+        model._update_params(_on_kvstore):106-138)."""
+        assert self.binded and self.params_initialized \
+            and self.optimizer_initialized
+        self._params_dirty = True
+        arg_dict = self._exec.arg_dict
+        grad_dict = self._exec.grad_dict
+        if self._kvstore is not None and self._update_on_kvstore:
+            for i, name in enumerate(self._param_names):
+                g = grad_dict.get(name)
+                if g is None:
+                    continue
+                self._kvstore.push(i, g)
+                self._kvstore.pull(i, out=arg_dict[name])
+        else:
+            if self._kvstore is not None:
+                for i, name in enumerate(self._param_names):
+                    g = grad_dict.get(name)
+                    if g is None:
+                        continue
+                    self._kvstore.push(i, g)
+                    self._kvstore.pull(i, out=g)
+            for i, name in enumerate(self._param_names):
+                g = grad_dict.get(name)
+                if g is None:
+                    continue
+                self._updater(i, g, arg_dict[name])
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded
+        return list(self._exec.outputs)
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.inputs_need_grad
+        gd = self._exec.grad_dict
+        return [gd[n] for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels if isinstance(labels, (list, tuple))
+                           else [labels], self.get_outputs())
+
+    # -- checkpoints -------------------------------------------------------
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """(parity: module.py save_checkpoint:164)"""
+        arg_params, aux_params = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg_params, aux_params)
+        if save_optimizer_states:
+            self.save_optimizer_states("%s-%04d.states" % (prefix, epoch))
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """(parity: module.py Module.load:126)"""
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        mod = Module(symbol, **kwargs)
+        mod._arg_params = arg_params
+        mod._aux_params = aux_params
+        mod.params_initialized = False
+        mod._preloaded_params = (arg_params, aux_params)
+        if load_optimizer_states:
+            mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
+        return mod
+
+    def save_optimizer_states(self, fname):
+        """(parity: module.save_optimizer_states:759)"""
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname)
+        else:
+            with open(fname, "wb") as f:
+                f.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as f:
+                self._updater.set_states(f.read())
+
+    def install_monitor(self, mon):
+        assert self.binded
+        mon.install(self._exec)
+
+    def reshape(self, data_shapes, label_shapes=None):
+        """(parity: module.reshape) — on TPU just a new jit signature."""
+        assert self.binded
+        arg_p, aux_p = self.get_params() if self.params_initialized else (None, None)
+        self.binded = False
+        self._exec = None
+        self.bind(data_shapes, label_shapes, self.for_training,
+                  self.inputs_need_grad, force_rebind=True)
+        if arg_p is not None:
+            self.set_params(arg_p, aux_p)
+
+    def init_params_from_preloaded(self):
+        if getattr(self, "_preloaded_params", None) and self.binded:
+            arg_p, aux_p = self._preloaded_params
+            self.set_params(arg_p, aux_p)
+
+
+def _as_desc(d):
+    from ..io import DataDesc
+    if isinstance(d, DataDesc):
+        return d
+    name, shape = d[0], d[1]
+    return DataDesc(name, shape)

@@ -411,8 +411,9 @@ class NDArray:
         elif isinstance(value, np.ndarray):
             value = jnp.asarray(value, self._data.dtype)
         if key is Ellipsis or (isinstance(key, slice) and key == slice(None)):
-            self._set_data(jnp.broadcast_to(
-                jnp.asarray(value, self._data.dtype), self.shape).astype(self._data.dtype))
+            new = jnp.broadcast_to(jnp.asarray(value, self._data.dtype),
+                                   self.shape).astype(self._data.dtype)
+            self._set_data(_to_device(new, self._ctx))
         else:
             self._set_data(self._data.at[key].set(value))
 

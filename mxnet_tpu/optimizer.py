@@ -1,0 +1,442 @@
+"""Optimizers.
+
+Parity: reference ``python/mxnet/optimizer.py`` (registry, lr/wd mult
+handling, num_update bookkeeping, Updater) with the update math delegated
+to the fused update ops (ops/optimizer_ops.py ≙ reference
+``src/operator/optimizer_op.cc``) so each parameter update compiles to a
+single fused XLA kernel.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+
+import numpy as np
+
+from .base import MXNetError, registry_create
+from .ndarray import ndarray as _nd
+from .ndarray import (sgd_update, sgd_mom_update, mp_sgd_update,
+                      mp_sgd_mom_update, adam_update, rmsprop_update,
+                      rmspropalex_update, ftrl_update, zeros)
+
+__all__ = ["Optimizer", "SGD", "NAG", "SGLD", "DCASGD", "Adam", "AdaGrad",
+           "RMSProp", "AdaDelta", "Ftrl", "Test", "Updater", "get_updater",
+           "create", "register"]
+
+register, _alias, _create, _get = registry_create("optimizer")
+
+
+class Optimizer:
+    """Base optimizer (parity: optimizer.Optimizer)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None, **kwargs):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        if param_idx2name is None:
+            param_idx2name = {}
+        self.idx2name = dict(param_idx2name)
+        self.sym_info = (sym.attr_dict(), sym.list_arguments()) if sym is not None \
+            else ({}, [])
+        self.param_dict = param_dict or {}
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    # -- registry ----------------------------------------------------------
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        return _create(name, **kwargs)
+
+    @staticmethod
+    def register(cls):
+        return register(cls)
+
+    # -- state -------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == np.float16:
+            w32 = weight.astype("float32")
+            return (self.create_state(index, w32), w32)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.update(index, weight, grad, state)
+
+    # -- lr / wd -----------------------------------------------------------
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        attr, arg_names = self.sym_info
+        for name in arg_names:
+            if name in attr and "__lr_mult__" in attr[name]:
+                self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            # reference rule: no weight decay on 1-D params
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        attr, arg_names = self.sym_info
+        for name in arg_names:
+            if name in attr and "__wd_mult__" in attr[name]:
+                self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def _common_kwargs(self):
+        kw = {"rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        return kw
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and optional fp16 master-weight multi-precision
+    (parity: optimizer.SGD backed by reference fused sgd ops)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == np.float16:
+            w32 = weight.astype("float32")
+            return (self.create_state(index, w32), w32)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = self._common_kwargs()
+        if isinstance(state, tuple):  # multi-precision
+            mom, w32 = state
+            if mom is not None:
+                mp_sgd_mom_update(weight, grad, mom, w32, lr=lr, wd=wd,
+                                  momentum=self.momentum, **kw)
+            else:
+                mp_sgd_update(weight, grad, w32, lr=lr, wd=wd, **kw)
+        elif state is not None:
+            sgd_mom_update(weight, grad, state, lr=lr, wd=wd,
+                           momentum=self.momentum, **kw)
+        else:
+            sgd_update(weight, grad, lr=lr, wd=wd, **kw)
+
+    update_multi_precision = update
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (parity: optimizer.NAG)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = _nd._invoke("clip", [grad], {"a_min": -self.clip_gradient,
+                                                "a_max": self.clip_gradient})
+        if state is not None:
+            state *= self.momentum
+            state += grad + wd * weight
+            grad += self.momentum * state
+            weight -= lr * grad
+        else:
+            weight -= lr * (grad + wd * weight)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (parity: optimizer.SGLD)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = _nd._invoke("clip", [grad], {"a_min": -self.clip_gradient,
+                                                "a_max": self.clip_gradient})
+        from .ndarray import random as _rnd
+        noise = _rnd.normal(0, math.sqrt(lr), shape=weight.shape)
+        weight -= lr / 2 * (grad + wd * weight)
+        weight += noise
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (parity: optimizer.DCASGD)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = _nd._invoke("clip", [grad], {"a_min": -self.clip_gradient,
+                                                "a_max": self.clip_gradient})
+        mom, previous_weight = state
+        if mom is not None:
+            mom *= self.momentum
+            mom += -lr * (grad + wd * weight + self.lamda * grad * grad *
+                          (weight - previous_weight))
+        else:
+            mom = -lr * (grad + wd * weight + self.lamda * grad * grad *
+                         (weight - previous_weight))
+        previous_weight._set_data(weight._data)
+        weight += mom
+
+
+@register
+class Adam(Optimizer):
+    """(parity: optimizer.Adam; fused adam_update op)"""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr = lr * math.sqrt(coef2) / coef1
+        mean, var = state
+        adam_update(weight, grad, mean, var, lr=lr, wd=wd, beta1=self.beta1,
+                    beta2=self.beta2, epsilon=self.epsilon,
+                    **self._common_kwargs())
+
+
+@register
+class AdaGrad(Optimizer):
+    """(parity: optimizer.AdaGrad)"""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = _nd._invoke("clip", [grad], {"a_min": -self.clip_gradient,
+                                                "a_max": self.clip_gradient})
+        history = state
+        history += grad * grad
+        weight -= lr * (grad / (history + self.float_stable_eps).sqrt()
+                        + wd * weight)
+
+
+@register
+class RMSProp(Optimizer):
+    """(parity: optimizer.RMSProp; centered=True uses Graves variant)"""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, ctx=weight.context),
+                    zeros(weight.shape, ctx=weight.context),
+                    zeros(weight.shape, ctx=weight.context))
+        return (zeros(weight.shape, ctx=weight.context),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = self._common_kwargs()
+        if self.clip_weights:
+            kw["clip_weights"] = self.clip_weights
+        if not self.centered:
+            (n,) = state
+            rmsprop_update(weight, grad, n, lr=lr, wd=wd, gamma1=self.gamma1,
+                           epsilon=self.epsilon, **kw)
+        else:
+            n, g, delta = state
+            rmspropalex_update(weight, grad, n, g, delta, lr=lr, wd=wd,
+                               gamma1=self.gamma1, gamma2=self.gamma2,
+                               epsilon=self.epsilon, **kw)
+
+
+@register
+class AdaDelta(Optimizer):
+    """(parity: optimizer.AdaDelta)"""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context),
+                zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = _nd._invoke("clip", [grad], {"a_min": -self.clip_gradient,
+                                                "a_max": self.clip_gradient})
+        acc_g, acc_delta = state
+        acc_g *= self.rho
+        acc_g += (1.0 - self.rho) * grad * grad
+        current_delta = ((acc_delta + self.epsilon).sqrt()
+                         / (acc_g + self.epsilon).sqrt()) * grad
+        acc_delta *= self.rho
+        acc_delta += (1.0 - self.rho) * current_delta * current_delta
+        weight -= current_delta + wd * weight
+
+
+@register
+class Ftrl(Optimizer):
+    """(parity: optimizer.Ftrl; fused ftrl_update op)"""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, ctx=weight.context),
+                zeros(weight.shape, ctx=weight.context))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        z, n = state
+        ftrl_update(weight, grad, z, n, lr=lr, wd=wd, lamda1=self.lamda1,
+                    beta=self.beta, **self._common_kwargs())
+
+
+@register
+class Test(Optimizer):
+    """(parity: optimizer.Test — used by unit tests)"""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, ctx=weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight += grad * self.rescale_grad
+        state._set_data(weight._data)
+
+
+create = Optimizer.create_optimizer
+
+
+class Updater:
+    """Applies an optimizer to (index, grad, weight) calls — the object a
+    KVStore runs server-side (parity: optimizer.get_updater/Updater)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(
+                index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def set_states(self, states):
+        states = pickle.loads(states)
+        if isinstance(states, tuple) and len(states) == 2:
+            self.states, self.optimizer = states
+        else:
+            self.states = states
+        self.states_synced = {k: False for k in self.states}
+
+    def get_states(self, dump_optimizer=False):
+        def _np(s):
+            if s is None:
+                return None
+            if isinstance(s, tuple):
+                return tuple(_np(x) for x in s)
+            return s.asnumpy() if hasattr(s, "asnumpy") else s
+        states = {k: _np(v) for k, v in self.states.items()}
+        return pickle.dumps((states, self.optimizer) if dump_optimizer
+                            else states)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

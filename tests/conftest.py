@@ -16,5 +16,8 @@ if "--xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+# the axon sitecustomize force-selects the TPU platform; tests run on the
+# virtual CPU mesh regardless
+jax.config.update("jax_platforms", "cpu")
 # numeric parity tests compare against numpy float32; disable bf16 matmul
 jax.config.update("jax_default_matmul_precision", "highest")

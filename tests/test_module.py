@@ -1,0 +1,158 @@
+"""Module API tests (parity model: reference tests/python/unittest/test_module.py
+and tests/python/train/test_mlp.py convergence gate)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, nd
+from mxnet_tpu.io import NDArrayIter, DataBatch
+
+
+def _toy_data(n=512, d=32, c=4, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.normal(0, 2, (c, d)).astype(np.float32)
+    y = rng.randint(0, c, n)
+    x = ((centers[y] + rng.normal(0, 0.5, (n, d))) / 3.0).astype(np.float32)
+    return x, y.astype(np.float32)
+
+
+def _mlp(c=4):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=c, name="fc2")
+    return sym.SoftmaxOutput(net, sym.Variable("softmax_label"), name="softmax")
+
+
+def test_bind_init_forward():
+    net = _mlp()
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 32))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.initializer.Xavier())
+    x, y = _toy_data(8)
+    mod.forward(DataBatch(data=[nd.array(x[:8])], label=[nd.array(y[:8])]),
+                is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (8, 4)
+    np.testing.assert_allclose(out.asnumpy().sum(1), np.ones(8), rtol=1e-5)
+
+
+def test_fit_convergence():
+    """The MNIST-MLP convergence gate of the reference, on synthetic data."""
+    x, y = _toy_data(512)
+    train = NDArrayIter(x, y, batch_size=64, shuffle=True)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(), num_epoch=5)
+    score = mod.score(NDArrayIter(x, y, batch_size=64), "acc")
+    assert score[0][1] > 0.95, "did not converge: %s" % score
+
+
+def test_eval_different_batch_size():
+    x, y = _toy_data(256)
+    train = NDArrayIter(x, y, batch_size=64, shuffle=True)
+    val = NDArrayIter(x[:112], y[:112], batch_size=56)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.initializer.Xavier(), num_epoch=2)
+
+
+def test_predict():
+    x, y = _toy_data(128)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    it = NDArrayIter(x, y, batch_size=32)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=False)
+    mod.init_params(mx.initializer.Xavier())
+    out = mod.predict(it)
+    assert out.shape == (128, 4)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    prefix = str(tmp_path / "model")
+    x, y = _toy_data(128)
+    train = NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.initializer.Xavier(), num_epoch=1)
+    mod.save_checkpoint(prefix, 1)
+
+    mod2 = mx.mod.Module.load(prefix, 1, context=mx.cpu())
+    mod2.bind(data_shapes=train.provide_data,
+              label_shapes=train.provide_label, for_training=False)
+    mod2.init_params()
+    # identical predictions
+    b = DataBatch(data=[nd.array(x[:32])], label=[nd.array(y[:32])])
+    mod.forward(b, is_train=False)
+    mod2.forward(b, is_train=False)
+    np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(),
+                               mod2.get_outputs()[0].asnumpy(), rtol=1e-5)
+
+
+def test_optimizer_state_save_load(tmp_path):
+    x, y = _toy_data(64)
+    train = NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(), num_epoch=1)
+    f = str(tmp_path / "opt.states")
+    mod.save_optimizer_states(f)
+    mod.load_optimizer_states(f)
+
+
+def test_fixed_params():
+    net = _mlp()
+    mod = mx.mod.Module(net, context=mx.cpu(),
+                        fixed_param_names=["fc1_weight", "fc1_bias"])
+    mod.bind(data_shapes=[("data", (8, 32))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+    x, y = _toy_data(8)
+    w_before = mod._exec.arg_dict["fc1_weight"].asnumpy().copy()
+    b = DataBatch(data=[nd.array(x[:8])], label=[nd.array(y[:8])])
+    mod.forward_backward(b)
+    mod.update()
+    np.testing.assert_array_equal(
+        mod._exec.arg_dict["fc1_weight"].asnumpy(), w_before)
+
+
+def test_update_on_kvstore():
+    x, y = _toy_data(256)
+    train = NDArrayIter(x, y, batch_size=64)
+    kv = mx.kvstore.create("device")
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd", kvstore=kv,
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(), num_epoch=3)
+    score = mod.score(NDArrayIter(x, y, batch_size=64), "acc")
+    assert score[0][1] > 0.9, score
+
+
+def test_bucketing_module():
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        net = sym.FullyConnected(data, num_hidden=8, name="fc_shared")
+        net = sym.SoftmaxOutput(net, sym.Variable("softmax_label"),
+                                name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=16,
+                                 context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 16))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd")
+    for key in (16, 16, 16):
+        b = DataBatch(data=[nd.ones((4, key))], label=[nd.zeros((4,))],
+                      bucket_key=key,
+                      provide_data=[("data", (4, key))],
+                      provide_label=[("softmax_label", (4,))])
+        mod.forward_backward(b)
+        mod.update()

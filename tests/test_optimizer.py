@@ -1,0 +1,143 @@
+"""Optimizer tests (parity model: reference test_optimizer.py — each
+optimizer is checked against a numpy reference implementation)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import optimizer as opt
+
+
+def _setup(seed=0, shape=(10, 4)):
+    rng = np.random.RandomState(seed)
+    w = rng.normal(size=shape).astype(np.float32)
+    g = rng.normal(size=shape).astype(np.float32)
+    return w, g
+
+
+def test_sgd_matches_numpy():
+    w, g = _setup()
+    weight, grad = nd.array(w), nd.array(g)
+    sgd = opt.SGD(learning_rate=0.1, wd=0.01, rescale_grad=0.5)
+    state = sgd.create_state(0, weight)
+    sgd.update(0, weight, grad, state)
+    expected = w - 0.1 * (0.5 * g + 0.01 * w)
+    np.testing.assert_allclose(weight.asnumpy(), expected, rtol=1e-5)
+
+
+def test_sgd_momentum():
+    w, g = _setup()
+    weight, grad = nd.array(w), nd.array(g)
+    sgd = opt.SGD(learning_rate=0.1, momentum=0.9)
+    state = sgd.create_state(0, weight)
+    mom_ref = np.zeros_like(w)
+    w_ref = w.copy()
+    for _ in range(3):
+        sgd.update(0, weight, grad, state)
+        mom_ref = 0.9 * mom_ref - 0.1 * g
+        w_ref = w_ref + mom_ref
+    np.testing.assert_allclose(weight.asnumpy(), w_ref, rtol=1e-5)
+
+
+def test_clip_gradient():
+    w, g = _setup()
+    g = g * 100
+    weight, grad = nd.array(w), nd.array(g)
+    sgd = opt.SGD(learning_rate=1.0, clip_gradient=1.0)
+    sgd.update(0, weight, grad, None)
+    expected = w - np.clip(g, -1, 1)
+    np.testing.assert_allclose(weight.asnumpy(), expected, rtol=1e-5)
+
+
+def test_adam_matches_numpy():
+    w, g = _setup()
+    weight, grad = nd.array(w), nd.array(g)
+    adam = opt.Adam(learning_rate=0.01)
+    state = adam.create_state(0, weight)
+    m_ref = np.zeros_like(w)
+    v_ref = np.zeros_like(w)
+    w_ref = w.copy()
+    for t in range(1, 4):
+        adam.update(0, weight, grad, state)
+        lr_t = 0.01 * np.sqrt(1 - 0.999 ** t) / (1 - 0.9 ** t)
+        m_ref = 0.9 * m_ref + 0.1 * g
+        v_ref = 0.999 * v_ref + 0.001 * g * g
+        w_ref = w_ref - lr_t * m_ref / (np.sqrt(v_ref) + 1e-8)
+    np.testing.assert_allclose(weight.asnumpy(), w_ref, rtol=1e-4)
+
+
+def test_rmsprop():
+    w, g = _setup()
+    weight, grad = nd.array(w), nd.array(g)
+    rms = opt.RMSProp(learning_rate=0.01, gamma1=0.9)
+    state = rms.create_state(0, weight)
+    rms.update(0, weight, grad, state)
+    n_ref = 0.1 * g * g
+    w_ref = w - 0.01 * g / np.sqrt(n_ref + 1e-8)
+    np.testing.assert_allclose(weight.asnumpy(), w_ref, rtol=1e-4)
+
+
+def test_adagrad():
+    w, g = _setup()
+    weight, grad = nd.array(w), nd.array(g)
+    ada = opt.AdaGrad(learning_rate=0.1)
+    state = ada.create_state(0, weight)
+    ada.update(0, weight, grad, state)
+    h = g * g
+    w_ref = w - 0.1 * g / np.sqrt(h + 1e-7)
+    np.testing.assert_allclose(weight.asnumpy(), w_ref, rtol=1e-4)
+
+
+def test_ftrl_runs():
+    w, g = _setup()
+    weight, grad = nd.array(w), nd.array(g)
+    f = opt.Ftrl(learning_rate=0.1)
+    state = f.create_state(0, weight)
+    f.update(0, weight, grad, state)
+    assert np.isfinite(weight.asnumpy()).all()
+
+
+@pytest.mark.parametrize("name", ["sgd", "adam", "adagrad", "rmsprop",
+                                  "adadelta", "ftrl", "nag", "sgld",
+                                  "dcasgd", "test"])
+def test_registry_create_and_run(name):
+    o = opt.create(name)
+    w, g = _setup()
+    weight, grad = nd.array(w), nd.array(g)
+    state = o.create_state(0, weight)
+    o.update(0, weight, grad, state)
+    assert np.isfinite(weight.asnumpy()).all()
+    assert not np.allclose(weight.asnumpy(), w)
+
+
+def test_lr_scheduler():
+    from mxnet_tpu.lr_scheduler import FactorScheduler, MultiFactorScheduler
+    s = FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(5) == 1.0
+    assert s(15) == 0.5
+    m = MultiFactorScheduler(step=[5, 10], factor=0.1, base_lr=1.0)
+    assert m(3) == 1.0
+    assert abs(m(7) - 0.1) < 1e-9
+    assert abs(m(12) - 0.01) < 1e-9
+
+
+def test_lr_wd_mult():
+    sgd = opt.SGD(learning_rate=1.0, param_idx2name={0: "w_weight", 1: "b_bias"},
+                  wd=0.1)
+    # bias gets wd_mult 0 by the reference's rule
+    assert sgd._get_wd(1) == 0.0
+    assert sgd._get_wd(0) == pytest.approx(0.1)
+    sgd.set_lr_mult({"w_weight": 0.5})
+    assert sgd._get_lr(0) == pytest.approx(0.5)
+
+
+def test_updater_states_roundtrip():
+    sgd = opt.SGD(learning_rate=0.1, momentum=0.9)
+    upd = opt.get_updater(sgd)
+    w, g = _setup()
+    weight, grad = nd.array(w), nd.array(g)
+    upd(0, grad, weight)
+    blob = upd.get_states()
+    upd2 = opt.get_updater(opt.SGD(learning_rate=0.1, momentum=0.9))
+    upd2.set_states(blob)
+    assert 0 in upd2.states

@@ -1,0 +1,135 @@
+"""Symbol + Executor tests (parity model: test_symbol.py / test_executor.py
+/ test_infer_shape.py in the reference suite)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, nd
+
+
+def _mlp():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return sym.SoftmaxOutput(net, sym.Variable("softmax_label"), name="softmax")
+
+
+def test_list_arguments_and_outputs():
+    net = _mlp()
+    assert net.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                    "fc2_weight", "fc2_bias", "softmax_label"]
+    assert net.list_outputs() == ["softmax_output"]
+
+
+def test_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(
+        data=(8, 10), softmax_label=(8,))
+    assert arg_shapes[1] == (16, 10)   # fc1_weight
+    assert arg_shapes[3] == (4, 16)    # fc2_weight
+    assert out_shapes == [(8, 4)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_conv_bn():
+    data = sym.Variable("data")
+    net = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                          name="conv")
+    net = sym.BatchNorm(net, name="bn")
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(2, 3, 8, 8))
+    assert arg_shapes[1] == (8, 3, 3, 3)
+    assert out_shapes[0] == (2, 8, 8, 8)
+    assert net.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+    assert aux_shapes == [(8,), (8,)]
+
+
+def test_group_and_index():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a + b
+    g = sym.Group([c, a * b])
+    assert len(g.list_outputs()) == 2
+    first = g[0]
+    assert len(first.list_outputs()) == 1
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    # still executable after round trip
+    ex = net2.simple_bind(ctx=mx.cpu(), data=(2, 6), softmax_label=(2,))
+    assert ex.forward()[0].shape == (2, 4)
+
+
+def test_symbol_arithmetic_exec():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = 2 * a + b ** 2 - 3
+    ex = c.bind(ctx=mx.cpu(), args={"a": nd.array([1.0, 2.0]),
+                                    "b": nd.array([3.0, 4.0])})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), [8.0, 17.0])
+
+
+def test_executor_backward():
+    a = sym.Variable("a")
+    loss = sym.MakeLoss((a * a).sum())
+    ex = loss.bind(ctx=mx.cpu(), args={"a": nd.array([1.0, 2.0, 3.0])},
+                   args_grad={"a": nd.zeros((3,))}, grad_req="write")
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), [2.0, 4.0, 6.0])
+
+
+def test_grad_req_add_and_null():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    loss = sym.MakeLoss((a * b).sum())
+    ag = nd.zeros((2,))
+    ex = loss.bind(ctx=mx.cpu(),
+                   args={"a": nd.array([1.0, 2.0]), "b": nd.array([3.0, 4.0])},
+                   args_grad={"a": ag},
+                   grad_req={"a": "add", "b": "null"})
+    for _ in range(2):
+        ex.forward(is_train=True)
+        ex.backward()
+    np.testing.assert_allclose(ag.asnumpy(), [6.0, 8.0])
+
+
+def test_get_internals():
+    net = _mlp()
+    internals = net.get_internals()
+    names = internals.list_outputs()
+    assert any("fc1" in n for n in names)
+    fc1 = internals["fc1_output"]
+    ash, osh, _ = fc1.infer_shape(data=(4, 10))
+    assert osh == [(4, 16)]
+
+
+def test_composition():
+    lhs = sym.Variable("lhs")
+    net1 = sym.FullyConnected(lhs, num_hidden=8, name="fca")
+    data2 = sym.Variable("d2")
+    net2 = sym.Activation(data2, act_type="relu")
+    composed = net1(lhs=net2, name="composed")
+    assert "d2" in composed.list_arguments()
+
+
+def test_variable_shape_attr():
+    v = sym.Variable("x", shape=(2, 3))
+    out = sym.Flatten(v)
+    _, osh, _ = out.infer_shape()
+    assert osh == [(2, 3)]
+
+
+def test_simple_bind_forward_with_kwargs():
+    net = _mlp()
+    ex = net.simple_bind(ctx=mx.cpu(), data=(4, 10), softmax_label=(4,))
+    for name, arr in ex.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = np.random.normal(0, 0.1, arr.shape)
+    out = ex.forward(is_train=False, data=np.random.normal(size=(4, 10)))
+    probs = out[0].asnumpy()
+    np.testing.assert_allclose(probs.sum(axis=1), np.ones(4), rtol=1e-5)
