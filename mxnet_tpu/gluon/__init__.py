@@ -1,0 +1,13 @@
+"""Gluon: the imperative-first neural-network API.
+
+Parity: reference ``python/mxnet/gluon/__init__.py``.
+"""
+from .parameter import Parameter, ParameterDict
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import rnn
+from . import loss
+from . import data
+from . import utils
+from . import model_zoo

@@ -1,0 +1,480 @@
+"""Gluon Blocks: imperative-first layers with optional graph capture.
+
+Parity: reference ``python/mxnet/gluon/block.py`` (Block:121,
+HybridBlock:319, hybridize:277 → CachedOp). TPU-native design: a
+hybridized block's forward is traced ONCE per input signature into a
+jitted XLA program (``_CachedOp``) — the exact contract of the
+reference's CachedOp (cached_op.cc:171-322, re-plan per signature), but
+the "graph" is XLA's, so fusion/memory planning come free. Under
+``autograd.record`` the whole cached program becomes ONE tape node whose
+backward is a second jitted program (forward rematerialised — HBM is the
+scarce resource on TPU, recompute is the standard trade).
+
+BatchNorm-style running-stat updates inside a traced program are
+collected as extra outputs and written back after execution
+(ops/common.aux_collector), keeping the compiled function pure.
+"""
+from __future__ import annotations
+
+import re
+import threading
+
+import numpy as np
+
+import jax
+
+from ..base import MXNetError, NameManager
+from ..context import current_context
+from .. import autograd
+from .. import imperative as _imp
+from ..imperative import TapeNode
+from ..ndarray.ndarray import NDArray, _wrap
+from ..ops import common as _common
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock"]
+
+
+class _BlockScope:
+    """Name scoping (parity: block._BlockScope)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                prefix = NameManager.get(hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            current._counter[hint] = count + 1
+            prefix = "%s%d_" % (hint, count)
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, *exc):
+        if self._block._empty_prefix:
+            return
+        _BlockScope._current.value = self._old_scope
+
+
+class Block:
+    """(parity: gluon.Block:121)"""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = {}
+        self._reg_params = {}
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join("  ({key}): {block}".format(
+            key=key, block=_indent(repr(block), 2))
+            for key, block in self._children.items())
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = getattr(self, "_children", None)
+            if existing is not None:
+                self._children[name] = value
+        elif isinstance(value, Parameter):
+            if hasattr(self, "_reg_params"):
+                self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None):
+        """(parity: Block.collect_params) including children, optionally
+        filtered by regex."""
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({k: v for k, v in self.params.items()
+                        if pattern.match(k)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select=select))
+        return ret
+
+    def register_child(self, block, name=None):
+        self._children[name or str(len(self._children))] = block
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        from ..initializer import Uniform
+        self.collect_params().initialize(init or Uniform(0.07), ctx=ctx,
+                                         force_reinit=force_reinit)
+
+    def save_params(self, filename):
+        self.collect_params().save(filename, strip_prefix=self.prefix)
+
+    save_parameters = save_params
+
+    def load_params(self, filename, ctx=None, allow_missing=False,
+                    ignore_extra=False):
+        self.collect_params().load(filename, ctx, allow_missing, ignore_extra,
+                                   restore_prefix=self.prefix)
+
+    load_parameters = load_params
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def __call__(self, *args):
+        return self.forward(*args)
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+
+class HybridBlock(Block):
+    """(parity: gluon.HybridBlock:319)"""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_op = None
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._cached_op = None
+        super().hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        self._cached_op = None
+        super().cast(dtype)
+
+    def infer_shape(self, *args):
+        self._deferred_infer(args)
+
+    def _deferred_infer(self, args):
+        """Run an abstract forward to fill deferred param shapes."""
+        try:
+            structs = [jax.ShapeDtypeStruct(a.shape, a._data.dtype)
+                       for a in args]
+
+            def probe(*raw):
+                nd_in = [_wrap(r) for r in raw]
+                with autograd.pause():
+                    out = self._forward_eager(*nd_in)
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                return tuple(o._data for o in outs)
+            with _common.rng_scope(jax.random.key(0)):
+                jax.eval_shape(probe, *structs)
+        except DeferredInitializationError:
+            raise
+        except Exception:
+            raise
+
+    def __call__(self, *args):
+        if self._active and not _common.state().graph_capturing:
+            return self._call_cached_op(*args)
+        return self._forward_eager(*args)
+
+    # -- eager path --------------------------------------------------------
+    def _forward_eager(self, x, *args):
+        params = {}
+        try:
+            for name, p in self._reg_params.items():
+                params[name] = p.data()
+        except DeferredInitializationError:
+            self._infer_param_shapes(x, *args)
+            for name, p in self._reg_params.items():
+                params[name] = p.data()
+        from .. import ndarray as F
+        return self.hybrid_forward(F, x, *args, **params)
+
+    def _infer_param_shapes(self, *args):
+        """Default deferred-shape inference hook; layers override
+        shape-specific logic via their own _update_shapes."""
+        self._shape_hook(*args)
+        for p in self._reg_params.values():
+            if p._deferred_init is not None:
+                p._finish_deferred_init()
+
+    def _shape_hook(self, *args):
+        raise DeferredInitializationError(
+            "Block %r has deferred parameters and no shape hook; specify "
+            "in_units/in_channels" % self.name)
+
+    def forward(self, x, *args):
+        return self.__call__(x, *args)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    # -- cached (jitted) path ---------------------------------------------
+    def _call_cached_op(self, *args):
+        if self._cached_op is None:
+            self._cached_op = _CachedOp(self)
+        return self._cached_op(*args)
+
+
+class _CachedOp:
+    """Trace-and-cache executor for a HybridBlock.
+
+    Parity: reference Imperative::CachedOp (src/imperative/cached_op.cc) —
+    one compiled program per input signature, gradient support, aux-state
+    writeback.
+    """
+
+    def __init__(self, block):
+        self.block = block
+        self._cache = {}
+
+    def __call__(self, *inputs):
+        block = self.block
+        # materialise params (triggers deferred init through one eager call)
+        try:
+            params = list(block.collect_params().values())
+            param_nds = [p.data() for p in params]
+        except DeferredInitializationError:
+            with autograd.pause():
+                block._forward_eager(*inputs)
+            params = list(block.collect_params().values())
+            param_nds = [p.data() for p in params]
+        train = autograd.is_training()
+        raw_inputs = [x._data for x in inputs]
+        key = (tuple((tuple(r.shape), str(r.dtype)) for r in raw_inputs),
+               train)
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build(key, train, inputs)
+        fwd, grads_fn, aux_targets, n_out, single = entry
+
+        rng = _take_rng_key()
+        raw_params = [p._data for p in param_nds]
+        out_raw, aux_raw = fwd(raw_params, raw_inputs, rng)
+        for target, val in zip(aux_targets, aux_raw):
+            target._set_data(val)
+
+        out_nds = [_wrap(r) for r in out_raw]
+        if autograd.is_recording():
+            all_in = param_nds + list(inputs)
+            parents = [nd._tape if nd._tape is not None else None
+                       for nd in all_in]
+            if any(p is not None for p in parents):
+                captured = (raw_params, raw_inputs, rng)
+
+                def vjp_fn(out_cts):
+                    p_cts, i_cts = grads_fn(captured[0], captured[1],
+                                            captured[2], tuple(out_cts))
+                    return tuple(p_cts) + tuple(i_cts)
+
+                node = TapeNode(parents, vjp_fn,
+                                [jax.ShapeDtypeStruct(o.shape, o.dtype)
+                                 for o in out_raw], "CachedOp")
+                for i, o in enumerate(out_nds):
+                    o._tape = (node, i)
+        return out_nds[0] if single else out_nds
+
+    def _build(self, key, train, example_inputs):
+        block = self.block
+        params = list(block.collect_params().values())
+        single_holder = [True]
+        aux_targets = []
+
+        def run_block(raw_params, raw_inputs, rng):
+            collector = []
+            originals = [p._data._data for p in params]
+            st = _common.state()
+            was_capturing = st.graph_capturing
+            try:
+                st.graph_capturing = True
+                with autograd.pause(train_mode=train), \
+                        _common.rng_scope(rng), \
+                        _aux_collect(collector):
+                    for p, r in zip(params, raw_params):
+                        p._data._set_data(r)
+                    nd_in = [_wrap(r) for r in raw_inputs]
+                    out = block._forward_eager(*nd_in)
+            finally:
+                st.graph_capturing = was_capturing
+                for p, orig in zip(params, originals):
+                    p._data._set_data(orig)
+            if isinstance(out, (list, tuple)):
+                single_holder[0] = False
+                outs = list(out)
+            else:
+                outs = [out]
+            aux_targets.clear()
+            aux_targets.extend(t for t, _ in collector)
+            return tuple(o._data for o in outs), tuple(v for _, v in collector)
+
+        fwd = jax.jit(run_block)
+
+        n_params = len(params)
+
+        def grads(raw_params, raw_inputs, rng, out_cts):
+            def f(ps, ins):
+                outs, _aux = run_block(ps, ins, rng)
+                return outs
+            outs, vjp = jax.vjp(f, raw_params, raw_inputs)
+            cts = tuple(
+                c if c is not None else _zeros_like_struct(o)
+                for c, o in zip(out_cts, outs))
+            p_cts, i_cts = vjp(cts)
+            return p_cts, i_cts
+
+        grads_fn = jax.jit(grads)
+
+        # trace once now to populate aux_targets/single
+        raw_inputs = [x._data for x in example_inputs]
+        raw_params = [p.data()._data for p in params]
+        _ = jax.eval_shape(lambda ps, ins, rng: run_block(ps, ins, rng),
+                           raw_params, raw_inputs, jax.random.key(0))
+        entry = (fwd, grads_fn, list(aux_targets), None, single_holder[0])
+        self._cache[key] = entry
+        return entry
+
+
+def _zeros_like_struct(o):
+    import jax.numpy as jnp
+    return jnp.zeros(o.shape, o.dtype)
+
+
+def _take_rng_key():
+    from .. import random as _random
+    return _random.take_key()
+
+
+class _aux_collect:
+    """Install the aux-update collector (see ops/common + imperative.invoke)."""
+
+    def __init__(self, collector):
+        self._collector = collector
+        self._old = None
+
+    def __enter__(self):
+        st = _common.state()
+        self._old = getattr(st, "aux_collector", None)
+        st.aux_collector = self._collector
+        return self
+
+    def __exit__(self, *exc):
+        _common.state().aux_collector = self._old
+
+
+def _indent(s, num_spaces):
+    lines = s.split("\n")
+    if len(lines) == 1:
+        return s
+    first = lines.pop(0)
+    return first + "\n" + "\n".join(" " * num_spaces + line for line in lines)
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol as a Block (parity: gluon.SymbolBlock) — used to load
+    Module-trained symbolic models into gluon code."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=params)
+        from ..symbol import Symbol, Group
+        if isinstance(outputs, (list, tuple)):
+            outputs = Group(outputs)
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        self._out_symbol = outputs
+        self._in_names = [i.name if isinstance(i, Symbol) else i
+                          for i in inputs]
+        arg_names = outputs.list_arguments()
+        aux_names = set(outputs.list_auxiliary_states())
+        for name in arg_names + sorted(aux_names):
+            if name not in self._in_names:
+                self._params.get(name, allow_deferred_init=True,
+                                 grad_req="null" if name in aux_names
+                                 else "write")
+        from ..executor import _GraphProgram
+        self._prog = _GraphProgram(outputs)
+
+    def forward(self, *args):
+        raw_args = {}
+        for name, arr in zip(self._in_names, args):
+            raw_args[name] = arr._data
+        shapes = {n: a.shape for n, a in zip(self._in_names, args)}
+        arg_shapes, _, aux_shapes = self._out_symbol.infer_shape_partial(**shapes)
+        all_names = self._out_symbol.list_arguments()
+        for n, s in zip(all_names, arg_shapes):
+            if n in self._params._params and s is not None:
+                p = self._params._params[n]
+                if p.shape is None or 0 in (p.shape or (0,)):
+                    p._update_shape(s)
+        aux_names = self._out_symbol.list_auxiliary_states()
+        for n, s in zip(aux_names, aux_shapes):
+            if n in self._params._params and s is not None:
+                p = self._params._params[n]
+                if p.shape is None or 0 in (p.shape or (0,)):
+                    p._update_shape(s)
+        arg_dict = dict(raw_args)
+        aux_dict = {}
+        for n, p in self._params._params.items():
+            if n in aux_names:
+                aux_dict[n] = p.data()._data
+            elif n not in arg_dict:
+                arg_dict[n] = p.data()._data
+        outs, aux_up = self._prog.eval_graph(
+            arg_dict, aux_dict, _take_rng_key(), autograd.is_training())
+        out_nds = [_wrap(o) for o in outs]
+        return out_nds[0] if len(out_nds) == 1 else out_nds
+
+    def hybrid_forward(self, F, *args, **kwargs):
+        raise MXNetError("SymbolBlock uses its stored symbol")

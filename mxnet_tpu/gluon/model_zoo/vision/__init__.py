@@ -1,0 +1,36 @@
+"""Vision model zoo (parity: python/mxnet/gluon/model_zoo/vision)."""
+from . import resnet as _resnet
+from . import alexnet as _alexnet
+from . import vgg as _vgg
+from . import squeezenet as _squeezenet
+from . import densenet as _densenet
+from . import inception as _inception
+from . import mobilenet as _mobilenet
+
+from .resnet import *  # noqa: F401,F403
+from .alexnet import *  # noqa: F401,F403
+from .vgg import *  # noqa: F401,F403
+from .squeezenet import *  # noqa: F401,F403
+from .densenet import *  # noqa: F401,F403
+from .inception import *  # noqa: F401,F403
+from .mobilenet import *  # noqa: F401,F403
+
+from ....base import MXNetError
+
+
+_models = {}
+for _mod in (_resnet, _alexnet, _vgg, _squeezenet, _densenet, _inception,
+             _mobilenet):
+    for _name in _mod.__all__:
+        _obj = getattr(_mod, _name)
+        if callable(_obj) and _name[0].islower() and not _name.startswith("get_"):
+            _models[_name] = _obj
+
+
+def get_model(name, **kwargs):
+    """(parity: model_zoo.vision.get_model)"""
+    name = name.lower()
+    if name not in _models:
+        raise MXNetError("model %r not in zoo (have: %s)"
+                         % (name, sorted(_models)))
+    return _models[name](**kwargs)

@@ -1,0 +1,297 @@
+"""Basic gluon layers.
+
+Parity: reference ``python/mxnet/gluon/nn/basic_layers.py`` (Sequential,
+HybridSequential, Dense, Dropout, BatchNorm, Embedding, Flatten,
+Activation, LeakyReLU, InstanceNorm, + LayerNorm as the attention-era
+addition).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from ..block import Block, HybridBlock
+from ..parameter import DeferredInitializationError
+
+__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
+           "Embedding", "Flatten", "Activation", "LeakyReLU", "InstanceNorm",
+           "LayerNorm"]
+
+
+class Sequential(Block):
+    """(parity: nn.Sequential)"""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def forward(self, x):
+        for block in self._children.values():
+            x = block(x)
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())[key]
+        if isinstance(layers, list):
+            net = type(self)(prefix=self._prefix)
+            with net.name_scope():
+                net.add(*layers)
+            return net
+        return layers
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+    def hybridize(self, active=True, **kwargs):
+        if self._children and all(isinstance(c, HybridBlock)
+                                  for c in self._children.values()):
+            import warnings
+            warnings.warn("All children are HybridBlocks; consider "
+                          "HybridSequential for one fused program.")
+        super().hybridize(active, **kwargs)
+
+
+class HybridSequential(HybridBlock):
+    """(parity: nn.HybridSequential)"""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def _forward_eager(self, x, *args):
+        for block in self._children.values():
+            x = block(x)
+        return x
+
+    def hybrid_forward(self, F, x):
+        for block in self._children.values():
+            x = block(x)
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())[key]
+        if isinstance(layers, list):
+            net = type(self)(prefix=self._prefix)
+            with net.name_scope():
+                net.add(*layers)
+            return net
+        return layers
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class Dense(HybridBlock):
+    """(parity: nn.Dense) — MXU-bound y = act(xW^T + b)."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype=np.float32, weight_initializer=None,
+                 bias_initializer="zeros", in_units=0, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self._units = units
+            self._flatten = flatten
+            self._act_type = activation
+            self.weight = self.params.get(
+                "weight", shape=(units, in_units), dtype=dtype,
+                init=weight_initializer, allow_deferred_init=True)
+            if use_bias:
+                from ... import initializer as _init
+                self.bias = self.params.get(
+                    "bias", shape=(units,), dtype=dtype,
+                    init=_init.create(bias_initializer)
+                    if isinstance(bias_initializer, str) else bias_initializer,
+                    allow_deferred_init=True)
+            else:
+                self.bias = None
+
+    def _shape_hook(self, x, *args):
+        in_units = int(np.prod(x.shape[1:])) if self._flatten else x.shape[-1]
+        self.weight._update_shape((self._units, in_units))
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        out = F.FullyConnected(x, weight, bias, num_hidden=self._units,
+                               no_bias=bias is None, flatten=self._flatten)
+        if self._act_type is not None:
+            out = F.Activation(out, act_type=self._act_type)
+        return out
+
+    def __repr__(self):
+        return "Dense(%s -> %d)" % (self.weight.shape[1] if self.weight.shape
+                                    else None, self._units)
+
+
+class Dropout(HybridBlock):
+    """(parity: nn.Dropout)"""
+
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._rate = rate
+        self._axes = axes
+
+    def hybrid_forward(self, F, x):
+        return F.Dropout(x, p=self._rate, axes=self._axes)
+
+
+class BatchNorm(HybridBlock):
+    """(parity: nn.BatchNorm) with running stats as null-grad params."""
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones", running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self._axis = axis
+            self._momentum = momentum
+            self._epsilon = epsilon
+            self._center = center
+            self._scale = scale
+            self._use_global_stats = use_global_stats
+            from ... import initializer as _init
+            self.gamma = self.params.get(
+                "gamma", grad_req="write" if scale else "null",
+                shape=(in_channels,), init=_init.create(gamma_initializer),
+                allow_deferred_init=True, differentiable=scale)
+            self.beta = self.params.get(
+                "beta", grad_req="write" if center else "null",
+                shape=(in_channels,), init=_init.create(beta_initializer),
+                allow_deferred_init=True, differentiable=center)
+            self.running_mean = self.params.get(
+                "running_mean", grad_req="null", shape=(in_channels,),
+                init=_init.create(running_mean_initializer),
+                allow_deferred_init=True, differentiable=False)
+            self.running_var = self.params.get(
+                "running_var", grad_req="null", shape=(in_channels,),
+                init=_init.create(running_variance_initializer),
+                allow_deferred_init=True, differentiable=False)
+
+    def _shape_hook(self, x, *args):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            p._update_shape((c,))
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        return F.BatchNorm(x, gamma, beta, running_mean, running_var,
+                           eps=self._epsilon, momentum=self._momentum,
+                           fix_gamma=not self._scale,
+                           use_global_stats=self._use_global_stats,
+                           axis=self._axis)
+
+
+class InstanceNorm(HybridBlock):
+    """(parity: nn.InstanceNorm)"""
+
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            from ... import initializer as _init
+            self._epsilon = epsilon
+            self.gamma = self.params.get(
+                "gamma", grad_req="write" if scale else "null",
+                shape=(in_channels,), init=_init.create(gamma_initializer),
+                allow_deferred_init=True)
+            self.beta = self.params.get(
+                "beta", grad_req="write" if center else "null",
+                shape=(in_channels,), init=_init.create(beta_initializer),
+                allow_deferred_init=True)
+
+    def _shape_hook(self, x, *args):
+        c = x.shape[1]
+        self.gamma._update_shape((c,))
+        self.beta._update_shape((c,))
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.InstanceNorm(x, gamma, beta, eps=self._epsilon)
+
+
+class LayerNorm(HybridBlock):
+    """Layer normalisation (new-framework addition for attention models)."""
+
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self._axis = axis
+            self._epsilon = epsilon
+            self.gamma = self.params.get("gamma", shape=(in_channels,),
+                                         allow_deferred_init=True,
+                                         differentiable=scale)
+            self.beta = self.params.get("beta", shape=(in_channels,),
+                                        allow_deferred_init=True,
+                                        differentiable=center)
+
+    def _shape_hook(self, x, *args):
+        c = x.shape[self._axis]
+        self.gamma._update_shape((c,))
+        self.beta._update_shape((c,))
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.LayerNorm(x, gamma, beta, axis=self._axis,
+                           eps=self._epsilon)
+
+
+class Embedding(HybridBlock):
+    """(parity: nn.Embedding) — sharded variants live in mxnet_tpu.parallel."""
+
+    def __init__(self, input_dim, output_dim, dtype=np.float32,
+                 weight_initializer=None, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self._input_dim = input_dim
+            self._output_dim = output_dim
+            self.weight = self.params.get(
+                "weight", shape=(input_dim, output_dim), dtype=dtype,
+                init=weight_initializer)
+
+    def hybrid_forward(self, F, x, weight):
+        return F.Embedding(x, weight, input_dim=self._input_dim,
+                           output_dim=self._output_dim)
+
+
+class Flatten(HybridBlock):
+    """(parity: nn.Flatten)"""
+
+    def hybrid_forward(self, F, x):
+        return F.Flatten(x)
+
+
+class Activation(HybridBlock):
+    """(parity: nn.Activation)"""
+
+    def __init__(self, activation, prefix=None, params=None):
+        self._act_type = activation
+        super().__init__(prefix=prefix, params=params)
+
+    def _alias(self):
+        return self._act_type
+
+    def hybrid_forward(self, F, x):
+        return F.Activation(x, act_type=self._act_type)
+
+
+class LeakyReLU(HybridBlock):
+    """(parity: nn.LeakyReLU)"""
+
+    def __init__(self, alpha, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._alpha = alpha
+
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="leaky", slope=self._alpha)

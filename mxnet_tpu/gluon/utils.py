@@ -1,0 +1,76 @@
+"""Gluon utilities (parity: python/mxnet/gluon/utils.py)."""
+from __future__ import annotations
+
+import math
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """(parity: utils.split_data)"""
+    size = data.shape[batch_axis]
+    if size < num_slice:
+        raise MXNetError("batch size %d < num_slice %d" % (size, num_slice))
+    if even_split and size % num_slice != 0:
+        raise MXNetError("uneven split of %d into %d" % (size, num_slice))
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """(parity: utils.split_and_load). On a mesh-sharded program the split
+    is logical; arrays stay whole and XLA shards them."""
+    from ..ndarray import array
+    if not isinstance(data, NDArray):
+        data = array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm):
+    """(parity: utils.clip_global_norm)"""
+    if not arrays:
+        raise MXNetError("arrays must be non-empty")
+    total = 0.0
+    for arr in arrays:
+        n = arr.norm().asscalar()
+        total += float(n) ** 2
+    total = math.sqrt(total)
+    if total > max_norm:
+        scale = max_norm / (total + 1e-8)
+        for arr in arrays:
+            arr *= scale
+    return total
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None):
+    """Gated: this build runs zero-egress; point `path` at a local file
+    (parity surface for code that calls gluon.utils.download)."""
+    import os
+    if path is not None and os.path.exists(path) and not overwrite:
+        return path
+    raise MXNetError("download is unavailable in the zero-egress TPU build; "
+                     "place the file at the target path manually")

@@ -141,12 +141,18 @@ def invoke(op, inputs, kwargs, out=None, name=None):
         outs = op.apply(raw, params)
         node = None
 
-    # stateful aux updates (BatchNorm moving stats)
+    # stateful aux updates (BatchNorm moving stats). During graph capture
+    # the values are tracers: collect them for writeback-after-execution
+    # instead of assigning (gluon/_CachedOp installs the collector).
     if op.stateful_update is not None:
         updates = op.stateful_update(raw, outs, params)
+        collector = _common.state().aux_collector
         for idx, val in updates.items():
             if nds[idx] is not None:
-                nds[idx]._set_data(val)
+                if collector is not None:
+                    collector.append((nds[idx], val))
+                else:
+                    nds[idx]._set_data(val)
 
     # in-place mutation ops (optimizer updates): output j writes input mutate[j]
     if op.mutate:
@@ -159,7 +165,11 @@ def invoke(op, inputs, kwargs, out=None, name=None):
             return out
         return primary
 
-    n_visible = op.visible_outputs or len(outs)
+    vis = op.visible_outputs
+    if callable(vis):
+        vis = vis(params)
+    n_visible = vis or len(outs)
+    n_visible = min(n_visible, len(outs))
     results = []
     for i in range(n_visible):
         nd_out = _wrap(outs[i])

@@ -12,6 +12,12 @@ from . import nn          # noqa: F401
 from . import random_ops  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import linalg      # noqa: F401
+from . import rnn         # noqa: F401
+from . import ctc         # noqa: F401
 
 from . import shape_infer as _shape_infer  # noqa: E402
 _shape_infer.install()
+
+# dynamic output counts
+from .registry import get_op as _g  # noqa: E402
+_g("topk").visible_outputs = lambda p: 2 if p.get("ret_typ") == "both" else 1

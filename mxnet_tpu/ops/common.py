@@ -88,6 +88,10 @@ class _ExecState(threading.local):
         self.train_mode = False
         self.rng_provider = None   # callable () -> jax PRNG key, set by executor/trace
         self.recording = False
+        self.aux_collector = None  # list collecting (ndarray, traced_value)
+        #   aux updates during graph capture (gluon _CachedOp)
+        self.graph_capturing = False  # inside a _CachedOp trace: child
+        #   hybridized blocks must inline rather than nest their own jit
 
 
 _STATE = _ExecState()

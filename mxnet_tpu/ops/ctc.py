@@ -1,0 +1,80 @@
+"""CTC loss operator.
+
+Parity: reference ``src/operator/contrib/ctc_loss-inl.h`` (vendored
+warp-ctc kernels). TPU-native design: the alpha recursion runs as a
+``lax.scan`` over time with the batch and extended-label dimensions
+vectorised — a static-shape log-domain dynamic program XLA maps onto the
+VPU. Blank label is index 0 ('first', the gluon default); label padding
+is any value < 1 when label_lengths is not given.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+_NEG_INF = -1e30
+
+
+@register("_ctc_loss", nin=-1, arg_names=["data", "label"],
+          aliases=("ctc_loss", "_contrib_ctc_loss"))
+def ctc_loss(data, label, data_lengths=None, label_lengths=None):
+    """data: (N, T, C) unnormalised activations; label: (N, L) with classes
+    in [1, C); returns per-sample negative log likelihood (N,)."""
+    N, T, C = data.shape
+    L = label.shape[1]
+    logp = jax.nn.log_softmax(data, axis=-1)  # (N, T, C)
+
+    lab = label.astype(jnp.int32)
+    if label_lengths is None:
+        lab_len = jnp.sum((lab > 0).astype(jnp.int32), axis=1)
+    else:
+        lab_len = label_lengths.astype(jnp.int32)
+    if data_lengths is None:
+        seq_len = jnp.full((N,), T, jnp.int32)
+    else:
+        seq_len = data_lengths.astype(jnp.int32)
+
+    # extended label sequence with interleaved blanks: length S = 2L+1
+    S = 2 * L + 1
+    ext = jnp.zeros((N, S), jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)                      # blanks at even pos
+    ext_len = 2 * lab_len + 1
+
+    pos = jnp.arange(S)
+    # can skip from s-2 when ext[s] != blank and ext[s] != ext[s-2]
+    ext_prev2 = jnp.concatenate(
+        [jnp.zeros((N, 2), jnp.int32), ext[:, :-2]], axis=1)
+    can_skip = (ext != 0) & (ext != ext_prev2) & (pos >= 2)[None, :]
+
+    # alpha_0
+    alpha0 = jnp.full((N, S), _NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(logp[:, 0, 0])
+    first_lab = jnp.take_along_axis(logp[:, 0, :], ext[:, 1:2], axis=1)[:, 0]
+    alpha0 = alpha0.at[:, 1].set(jnp.where(lab_len > 0, first_lab, _NEG_INF))
+
+    batch_idx = jnp.arange(N)[:, None]
+
+    def step(alpha, t):
+        lp_t = logp[:, t, :]                       # (N, C)
+        emit = lp_t[batch_idx, ext]                # (N, S)
+        shift1 = jnp.concatenate(
+            [jnp.full((N, 1), _NEG_INF), alpha[:, :-1]], axis=1)
+        shift2 = jnp.concatenate(
+            [jnp.full((N, 2), _NEG_INF), alpha[:, :-2]], axis=1)
+        shift2 = jnp.where(can_skip, shift2, _NEG_INF)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, shift1), shift2)
+        new_alpha = merged + emit
+        # freeze past each sample's sequence end
+        active = (t < seq_len)[:, None]
+        new_alpha = jnp.where(active, new_alpha, alpha)
+        return new_alpha, None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+
+    last = jnp.take_along_axis(alpha, (ext_len - 1)[:, None], axis=1)[:, 0]
+    last2 = jnp.take_along_axis(
+        alpha, jnp.maximum(ext_len - 2, 0)[:, None], axis=1)[:, 0]
+    ll = jnp.logaddexp(last, jnp.where(lab_len > 0, last2, _NEG_INF))
+    return -ll

@@ -1,0 +1,200 @@
+"""Fused multi-layer RNN operator (RNN/LSTM/GRU).
+
+Parity: reference ``src/operator/rnn-inl.h`` (native) /
+``cudnn_rnn-inl.h`` (fused cuDNN path) behind the single ``RNN`` op.
+TPU-native design: one ``lax.scan`` over time per layer+direction — the
+per-step matmuls batch onto the MXU and XLA pipelines the scan; this is
+the TPU replacement for cuDNN's fused kernels (SURVEY.md §5.7).
+
+Packed parameter layout (this framework's convention, produced by
+``gluon/rnn/rnn_layer.py`` and consumed here): for each layer, for each
+direction: W_ih (G*H, in), W_hh (G*H, H), b_ih (G*H,), b_hh (G*H,), all
+flattened and concatenated in order. Gate order: LSTM i,f,c,o; GRU r,z,n.
+
+Data layout TNC (seq, batch, feature), states (layers*dirs, batch, H) —
+matching the reference RNN op.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .registry import register
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(input_size, state_size, num_layers, mode,
+                   bidirectional=False):
+    """Total packed parameter count (used by gluon and shape inference)."""
+    g = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * dirs
+        for _ in range(dirs):
+            size += g * state_size * (in_sz + state_size + 2)
+    return size
+
+
+def _unpack(parameters, input_size, state_size, num_layers, mode, dirs):
+    g = _GATES[mode]
+    H = state_size
+    out = []
+    off = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else H * dirs
+        layer_params = []
+        for _ in range(dirs):
+            w_ih = parameters[off:off + g * H * in_sz].reshape(g * H, in_sz)
+            off += g * H * in_sz
+            w_hh = parameters[off:off + g * H * H].reshape(g * H, H)
+            off += g * H * H
+            b_ih = parameters[off:off + g * H]
+            off += g * H
+            b_hh = parameters[off:off + g * H]
+            off += g * H
+            layer_params.append((w_ih, w_hh, b_ih, b_hh))
+        out.append(layer_params)
+    return out
+
+
+def _cell_step(mode, H):
+    if mode == "lstm":
+        def step(carry, gates):
+            h, c = carry
+            i = jax.nn.sigmoid(gates[:, 0 * H:1 * H])
+            f = jax.nn.sigmoid(gates[:, 1 * H:2 * H])
+            g = jnp.tanh(gates[:, 2 * H:3 * H])
+            o = jax.nn.sigmoid(gates[:, 3 * H:4 * H])
+            c = f * c + i * g
+            h = o * jnp.tanh(c)
+            return (h, c)
+        return step
+    if mode == "gru":
+        return None  # handled specially (n gate needs r * (Whh h + bhh))
+    act = jnp.tanh if mode == "rnn_tanh" else (lambda x: jnp.maximum(x, 0))
+
+    def step(carry, gates):
+        (h,) = carry
+        return (act(gates),)
+    return step
+
+
+def _run_layer(x, h0, c0, w_ih, w_hh, b_ih, b_hh, mode, H, reverse=False):
+    """x: (T, N, in) -> outputs (T, N, H), final states."""
+    if reverse:
+        x = jnp.flip(x, axis=0)
+    xg = jnp.einsum("tni,gi->tng", x, w_ih) + b_ih  # precompute input gates
+
+    if mode == "gru":
+        def step(carry, xg_t):
+            (h,) = carry
+            hg = jnp.dot(h, w_hh.T) + b_hh
+            r = jax.nn.sigmoid(xg_t[:, 0 * H:1 * H] + hg[:, 0 * H:1 * H])
+            z = jax.nn.sigmoid(xg_t[:, 1 * H:2 * H] + hg[:, 1 * H:2 * H])
+            n = jnp.tanh(xg_t[:, 2 * H:3 * H] + r * hg[:, 2 * H:3 * H])
+            h = (1 - z) * n + z * h
+            return (h,), h
+        carry, ys = jax.lax.scan(step, (h0,), xg)
+        final = (carry[0], None)
+    elif mode == "lstm":
+        def step(carry, xg_t):
+            h, c = carry
+            gates = xg_t + jnp.dot(h, w_hh.T) + b_hh
+            i = jax.nn.sigmoid(gates[:, 0 * H:1 * H])
+            f = jax.nn.sigmoid(gates[:, 1 * H:2 * H])
+            g = jnp.tanh(gates[:, 2 * H:3 * H])
+            o = jax.nn.sigmoid(gates[:, 3 * H:4 * H])
+            c = f * c + i * g
+            h = o * jnp.tanh(c)
+            return (h, c), h
+        carry, ys = jax.lax.scan(step, (h0, c0), xg)
+        final = carry
+    else:
+        act = jnp.tanh if mode == "rnn_tanh" else (lambda v: jnp.maximum(v, 0))
+
+        def step(carry, xg_t):
+            (h,) = carry
+            h = act(xg_t + jnp.dot(h, w_hh.T) + b_hh)
+            return (h,), h
+        carry, ys = jax.lax.scan(step, (h0,), xg)
+        final = (carry[0], None)
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return ys, final
+
+
+@register("RNN", nin=4, arg_names=["data", "parameters", "state", "state_cell"],
+          nout=3,
+          defaults={"state_size": 0, "num_layers": 1, "mode": "lstm",
+                    "bidirectional": False, "p": 0.0, "state_outputs": False,
+                    "lstm_state_clip_min": None, "lstm_state_clip_max": None})
+def rnn(data, parameters, state, state_cell=None, state_size=0, num_layers=1,
+        mode="lstm", bidirectional=False, p=0.0, state_outputs=False,
+        lstm_state_clip_min=None, lstm_state_clip_max=None, _train=False,
+        _rng=None):
+    """Fused RNN (see module docstring for layout/parity notes)."""
+    if mode not in _GATES:
+        raise MXNetError("unknown RNN mode %r" % mode)
+    T, N, input_size = data.shape
+    H = int(state_size)
+    dirs = 2 if bidirectional else 1
+    layers = _unpack(parameters, input_size, H, int(num_layers), mode, dirs)
+
+    x = data
+    finals_h = []
+    finals_c = []
+    for li, layer_params in enumerate(layers):
+        outs = []
+        for d in range(dirs):
+            w_ih, w_hh, b_ih, b_hh = layer_params[d]
+            idx = li * dirs + d
+            h0 = state[idx]
+            c0 = state_cell[idx] if (mode == "lstm" and state_cell is not None) \
+                else jnp.zeros_like(h0)
+            ys, (hT, cT) = _run_layer(x, h0, c0, w_ih, w_hh, b_ih, b_hh,
+                                      mode, H, reverse=(d == 1))
+            outs.append(ys)
+            finals_h.append(hT)
+            if mode == "lstm":
+                finals_c.append(cT)
+        x = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)
+        if _train and p > 0 and li < len(layers) - 1 and _rng is not None:
+            keep = jax.random.bernoulli(
+                jax.random.fold_in(_rng, li), 1.0 - p, x.shape)
+            x = jnp.where(keep, x / (1.0 - p), jnp.zeros_like(x))
+
+    h_out = jnp.stack(finals_h, axis=0)
+    c_out = jnp.stack(finals_c, axis=0) if finals_c else jnp.zeros_like(h_out)
+    return x, h_out, c_out
+
+
+from .registry import get_op as _get_op
+_rnn_op = _get_op("RNN")
+
+
+def _rnn_visible(params):
+    if not params.get("state_outputs", False):
+        return 1
+    return 3 if params.get("mode", "lstm") == "lstm" else 2
+
+
+_rnn_op.visible_outputs = _rnn_visible
+
+
+def _rnn_shape_infer(shapes, params):
+    T, N, input_size = shapes[0]
+    H = int(params.get("state_size", 0))
+    L = int(params.get("num_layers", 1))
+    mode = params.get("mode", "lstm")
+    dirs = 2 if params.get("bidirectional", False) else 1
+    total = rnn_param_size(input_size, H, L, mode, dirs == 2)
+    out = {1: (total,), 2: (L * dirs, N, H)}
+    if mode == "lstm":
+        out[3] = (L * dirs, N, H)
+    return out
+
+
+_rnn_op.param_shape_infer = _rnn_shape_infer

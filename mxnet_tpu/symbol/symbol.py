@@ -40,7 +40,12 @@ class _SymNode:
             return 1
         if self.op.nout == -1:  # SliceChannel-style: from params
             return int(self.attrs.get("num_outputs", 1))
-        return self.op.visible_outputs or self.op.nout
+        vis = self.op.visible_outputs
+        if callable(vis):
+            params = dict(self.op.defaults)
+            params.update(self.attrs)
+            vis = vis(params)
+        return vis or self.op.nout
 
 
 class Symbol:
