@@ -1,0 +1,100 @@
+"""Benchmark: ResNet-50 ImageNet-shape training throughput (img/s).
+
+Baseline of record (BASELINE.md): the reference's published 109 img/s for
+ResNet-50 batch-32 training on 1x K80 (example/image-classification/
+README.md:147-155). This harness runs the same workload shape — forward
++ backward + SGD-momentum update, batch images at 224x224 — as ONE jitted
+XLA program on the local accelerator, bf16 matmul precision (MXU native),
+synthetic on-device data (compute-bound measurement, matching the
+reference's benchmark_score.py methodology).
+
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 109.0  # reference ResNet-50 1xK80 (BASELINE.md)
+BATCH = 128
+LR = 0.05
+MOMENTUM = 0.9
+# bf16 compute with fp32 master weights — the multi-precision scheme the
+# reference implements as mp_sgd_update (optimizer_op.cc), MXU-native here
+BF16 = True
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.gluon.block import make_pure_fn
+
+    np.random.seed(0)
+    net = vision.resnet50_v1()
+    net.initialize(mx.initializer.Xavier())
+    net(mx.nd.ones((1, 3, 32, 32)))  # complete deferred shapes
+    fn, raw_params, _ = make_pure_fn(net, train=True)
+
+    n_params = len(raw_params)
+
+    def train_step(params, mom, x, y, rng):
+        def loss_f(ps):
+            if BF16:
+                ps = [p.astype(jnp.bfloat16) for p in ps]
+                xc = x.astype(jnp.bfloat16)
+            else:
+                xc = x
+            (logits,), aux = fn(ps, [xc], rng)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+            return loss, aux
+
+        (loss, aux), grads = jax.value_and_grad(loss_f, has_aux=True)(params)
+        new_params = []
+        new_mom = []
+        for i in range(n_params):
+            if i in aux:  # BatchNorm running stats: direct writeback
+                new_params.append(aux[i].astype(params[i].dtype))
+                new_mom.append(mom[i])
+                continue
+            m = MOMENTUM * mom[i] - LR * grads[i].astype(params[i].dtype)
+            new_mom.append(m)
+            new_params.append(params[i] + m)
+        return new_params, new_mom, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+
+    x = jnp.asarray(np.random.uniform(-1, 1, (BATCH, 3, 224, 224))
+                    .astype(np.float32))
+    y = jnp.asarray(np.random.randint(0, 1000, BATCH).astype(np.int32))
+    rng = jax.random.key(0)
+    params = [jnp.asarray(p) for p in raw_params]
+    mom = [jnp.zeros_like(p) for p in params]
+
+    # warmup / compile. NOTE: the final sync is a scalar fetch —
+    # block_until_ready alone does not drain the execution queue on
+    # relayed PJRT backends.
+    for _ in range(3):
+        params, mom, loss = step(params, mom, x, y, rng)
+    float(loss)
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, mom, loss = step(params, mom, x, y, rng)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    img_s = BATCH * iters / dt
+    print(json.dumps({
+        "metric": "resnet50_train_throughput",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

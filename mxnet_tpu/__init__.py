@@ -48,5 +48,6 @@ from . import model                  # noqa: E402
 from . import module                 # noqa: E402
 from . import module as mod          # noqa: E402
 from . import gluon                  # noqa: E402
+from . import parallel               # noqa: E402
 
 __version__ = "0.1.0"

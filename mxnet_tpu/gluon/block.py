@@ -397,6 +397,45 @@ def _take_rng_key():
     return _random.take_key()
 
 
+def make_pure_fn(block, train=False):
+    """Extract a pure jax function from a (initialized) HybridBlock.
+
+    Returns (fn, raw_params, names) where
+    ``fn(raw_params_list, raw_inputs_list, rng) -> (outputs_tuple,
+    aux_updates)`` and ``aux_updates`` maps param-list index -> new value
+    (BatchNorm running stats). Used by bench/SPMD/graft entry to hand the
+    whole model to jax.jit / jax.value_and_grad directly.
+    """
+    params = list(block.collect_params().values())
+    names = [p.name for p in params]
+    id_to_idx = {id(p._data): i for i, p in enumerate(params)}
+
+    def fn(raw_params, raw_inputs, rng):
+        collector = []
+        originals = [p._data._data for p in params]
+        st = _common.state()
+        was_capturing = st.graph_capturing
+        try:
+            st.graph_capturing = True
+            with autograd.pause(train_mode=train), _common.rng_scope(rng), \
+                    _aux_collect(collector):
+                for p, r in zip(params, raw_params):
+                    p._data._set_data(r)
+                nd_in = [_wrap(r) for r in raw_inputs]
+                out = block._forward_eager(*nd_in)
+        finally:
+            st.graph_capturing = was_capturing
+            for p, orig in zip(params, originals):
+                p._data._set_data(orig)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        aux = {id_to_idx[id(t._data)]: v for t, v in collector
+               if id(t._data) in id_to_idx}
+        return tuple(o._data for o in outs), aux
+
+    raw_params = [p.data()._data for p in params]
+    return fn, raw_params, names
+
+
 class _aux_collect:
     """Install the aux-update collector (see ops/common + imperative.invoke)."""
 

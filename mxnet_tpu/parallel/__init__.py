@@ -1,0 +1,19 @@
+"""Distributed execution over TPU meshes.
+
+This package is the TPU-native replacement for the reference's entire
+distributed stack (SURVEY.md §5.8): KVStore comm trees, NCCL, and the
+ps-lite parameter server all become sharding annotations on ONE compiled
+program — XLA GSPMD inserts the ICI/DCN collectives (psum/all_gather/
+reduce_scatter) where the shardings require them.
+
+Components:
+- mesh:        device-mesh construction helpers
+- collectives: named wrappers over XLA collectives (the "comm backend")
+- spmd:        sharded train-step compiler (dp/tp batch+param sharding)
+- ring_attention: sequence-parallel blockwise attention over ppermute
+"""
+from .mesh import make_mesh, default_mesh, barrier
+from .collectives import (all_reduce, all_gather, reduce_scatter, ppermute,
+                          all_to_all)
+from .spmd import SPMDTrainer, shard_params_rule
+from .ring_attention import ring_attention, attention

@@ -1,0 +1,45 @@
+"""Named XLA collectives — the communication backend.
+
+Parity mapping (SURVEY.md §5.8): NCCL reduce+bcast / CommDevice P2P
+reduce → ``all_reduce`` (psum over ICI); row_sparse pull → sharded
+gather/``all_to_all``; ps-lite push/pull → nothing (sharding + psum in
+the compiled step). These functions are for use INSIDE shard_map-ped
+functions; at the jit level, shardings make XLA insert collectives
+automatically.
+"""
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+__all__ = ["all_reduce", "all_gather", "reduce_scatter", "ppermute",
+           "all_to_all"]
+
+
+def all_reduce(x, axis_name, op="sum"):
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "mean":
+        return lax.pmean(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    raise ValueError("unknown op %r" % op)
+
+
+def all_gather(x, axis_name, axis=0, tiled=True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, scatter_dimension=0):
+    return lax.psum_scatter(x, axis_name,
+                            scatter_dimension=scatter_dimension, tiled=True)
+
+
+def ppermute(x, axis_name, perm):
+    return lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x, axis_name, split_axis, concat_axis, tiled=True):
+    return lax.all_to_all(x, axis_name, split_axis, concat_axis, tiled=tiled)

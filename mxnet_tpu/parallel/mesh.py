@@ -1,0 +1,54 @@
+"""Device-mesh helpers.
+
+The mesh is the TPU-native analogue of the reference's device group /
+kvstore topology: within a slice the axes ride ICI, across slices DCN
+(jax handles the distinction; lay out the fastest-varying axis on ICI).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from ..base import MXNetError
+
+__all__ = ["make_mesh", "default_mesh", "barrier"]
+
+
+def make_mesh(axes, devices=None):
+    """Create a Mesh from {axis_name: size}. Sizes may include one -1 to
+    absorb remaining devices (like reshape)."""
+    devices = list(devices if devices is not None else jax.devices())
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if sizes.count(-1) > 1:
+        raise MXNetError("at most one axis may be -1")
+    known = int(np.prod([s for s in sizes if s != -1]))
+    if -1 in sizes:
+        if len(devices) % known != 0:
+            raise MXNetError("device count %d not divisible by %d"
+                             % (len(devices), known))
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise MXNetError("mesh needs %d devices, have %d"
+                         % (total, len(devices)))
+    arr = np.array(devices[:total]).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def default_mesh(data_axis="dp"):
+    """All visible devices on one data-parallel axis."""
+    return make_mesh({data_axis: -1})
+
+
+def barrier():
+    """Cross-device sync: a tiny psum everyone must join (the portable
+    replacement for ps::Postoffice::Barrier)."""
+    n = len(jax.devices())
+    if n <= 1:
+        return
+    import jax.numpy as jnp
+    x = jnp.ones((n,))
+    jax.block_until_ready(jnp.sum(x))

@@ -1,0 +1,108 @@
+"""Ring attention: sequence-parallel exact attention over a device ring.
+
+The long-context pillar (new-framework extension beyond the 2017
+reference, which predates attention — SURVEY.md §5.7). Design follows the
+blockwise/ring formulation (Liu et al., "Ring Attention with Blockwise
+Transformers"): Q stays put, K/V blocks rotate around the 'sp' mesh axis
+via ``ppermute`` while each device maintains an online-softmax
+accumulator (running max m, denominator l, numerator o). Communication
+is neighbour-to-neighbour so it rides ICI; compute of block t overlaps
+the transfer of block t+1 (XLA schedules the ppermute async).
+
+``attention`` is the single-chip reference implementation used for
+correctness tests and as the local block kernel.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["attention", "ring_attention"]
+
+
+def attention(q, k, v, causal=False, scale=None, q_offset=0, kv_offset=0):
+    """Plain scaled-dot-product attention, (B, H, S, D) layout.
+
+    ``q_offset``/``kv_offset`` give the global sequence positions of the
+    local blocks (used by ring attention's causal masking).
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        qpos = jnp.arange(q.shape[2]) + q_offset
+        kpos = jnp.arange(k.shape[2]) + kv_offset
+        mask = qpos[:, None] >= kpos[None, :]
+        scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # rows that are fully masked produce NaN from softmax(-inf); zero them
+    if causal:
+        probs = jnp.where(jnp.isfinite(scores).any(-1, keepdims=True), probs,
+                          0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def _ring_attention_local(q, k, v, axis_name, causal, scale):
+    """Per-device body under shard_map: q/k/v are the local sequence blocks
+    (B, H, S_local, D)."""
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    B, H, S, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    q_pos = my * S + jnp.arange(S)                      # global q positions
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(t, carry):
+        o, m, l, k_blk, v_blk = carry
+        src = (my - t) % n                              # owner of this block
+        k_pos = src * S + jnp.arange(S)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(mask, scores, -1e30)
+        blk_max = jnp.max(scores, axis=-1)              # (B,H,S)
+        new_m = jnp.maximum(m, blk_max)
+        correction = jnp.exp(m - new_m)
+        p = jnp.exp(scores - new_m[..., None])
+        l_new = l * correction + jnp.sum(p, axis=-1)
+        o_new = o * correction[..., None] + \
+            jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return (o_new, new_m, l_new, k_next, v_next)
+
+    # derive accumulators from q so they carry the same shard_map
+    # device-varying type as the loop outputs
+    o0 = jnp.zeros_like(q)
+    m0 = jnp.full_like(q[..., 0], -1e30)
+    l0 = jnp.zeros_like(q[..., 0])
+    o, m, l, _, _ = lax.fori_loop(0, n, step, (o0, m0, l0, k, v))
+    return o / jnp.maximum(l, 1e-30)[..., None]
+
+
+def ring_attention(q, k, v, mesh, axis_name="sp", batch_axis_name=None,
+                   causal=False, scale=None):
+    """Sequence-parallel attention: q/k/v (B, H, S, D) sharded along S over
+    ``axis_name`` (and optionally along B over ``batch_axis_name``).
+    Returns the attention output with the same sharding.
+
+    Accepts NDArrays or jax arrays; runs under shard_map on ``mesh``.
+    """
+    from ..ndarray.ndarray import NDArray, _wrap
+    wrap_out = isinstance(q, NDArray)
+    raw = [x._data if isinstance(x, NDArray) else x for x in (q, k, v)]
+
+    spec = P(batch_axis_name, None, axis_name, None)
+
+    fn = jax.shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    out = fn(*raw)
+    return _wrap(out) if wrap_out else out

@@ -1,0 +1,121 @@
+"""SPMD train-step compiler: dp/tp-sharded training as ONE XLA program.
+
+This is where the reference's data-parallel machinery
+(DataParallelExecutorGroup splitting batches + KVStore reducing grads,
+SURVEY.md §2.3) becomes TPU-native: parameters and batch get sharding
+annotations over a Mesh; ``jax.jit`` compiles forward+backward+optimizer
+into one program and XLA GSPMD inserts the gradient all-reduce over ICI.
+Scaling efficiency is then XLA's collective scheduling, which is the
+≥90% target regime (BASELINE.md north star).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+
+__all__ = ["SPMDTrainer", "shard_params_rule"]
+
+
+def shard_params_rule(params, mesh, tp_axis=None):
+    """Default parameter shardings: replicate 1-D params; shard the
+    largest divisible dim of matrices over ``tp_axis`` when given.
+
+    Any sharding is semantically valid under GSPMD — this rule is the
+    perf default (Megatron-style column split for weight matrices).
+    """
+    specs = {}
+    tp = mesh.shape[tp_axis] if tp_axis else 1
+    for name, arr in params.items():
+        shape = arr.shape
+        if tp_axis and len(shape) >= 2 and shape[0] % tp == 0 and shape[0] >= tp:
+            spec = [tp_axis] + [None] * (len(shape) - 1)
+            specs[name] = P(*spec)
+        elif tp_axis and len(shape) == 1 and shape[0] % tp == 0 and shape[0] >= 128:
+            specs[name] = P(tp_axis)
+        else:
+            specs[name] = P()
+    return specs
+
+
+class SPMDTrainer:
+    """Compile and run a sharded train step.
+
+    Parameters
+    ----------
+    apply_fn : pure fn(params_dict, *batch_arrays) -> loss (scalar jax)
+    params : dict name -> jax array (initial values, host or device)
+    mesh : jax.sharding.Mesh
+    data_axis : mesh axis name the batch is sharded over
+    tp_axis : optional mesh axis for tensor-parallel param sharding
+    optimizer : 'sgd' (momentum/wd supported) — the fused-update set can
+        be extended per ops/optimizer_ops.py
+    """
+
+    def __init__(self, apply_fn, params, mesh, data_axis="dp", tp_axis=None,
+                 optimizer="sgd", learning_rate=0.01, momentum=0.0, wd=0.0,
+                 param_specs=None, batch_specs=None, n_batch_args=2):
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self._apply = apply_fn
+        if optimizer != "sgd":
+            raise MXNetError("SPMDTrainer supports sgd in this build")
+        self.lr = learning_rate
+        self.momentum = momentum
+        self.wd = wd
+
+        if param_specs is None:
+            param_specs = shard_params_rule(params, mesh, tp_axis)
+        self.param_shardings = {k: NamedSharding(mesh, param_specs[k])
+                                for k in params}
+        if batch_specs is None:
+            batch_specs = [P(data_axis)] * n_batch_args
+        self.batch_shardings = [NamedSharding(mesh, s) for s in batch_specs]
+
+        # place params + momentum sharded
+        self.params = {k: jax.device_put(v, self.param_shardings[k])
+                       for k, v in params.items()}
+        self.mom = {k: jax.device_put(jnp.zeros_like(v),
+                                      self.param_shardings[k])
+                    for k, v in self.params.items()} if momentum else None
+
+        lr, mom_c, wd_c = self.lr, self.momentum, self.wd
+
+        def step(params, mom, *batch):
+            loss, grads = jax.value_and_grad(apply_fn)(params, *batch)
+            new_params = {}
+            new_mom = {}
+            for k, g in grads.items():
+                g = g + wd_c * params[k]
+                if mom is not None:
+                    m = mom_c * mom[k] - lr * g
+                    new_mom[k] = m
+                    new_params[k] = params[k] + m
+                else:
+                    new_params[k] = params[k] - lr * g
+            return new_params, (new_mom if mom is not None else None), loss
+
+        param_sh = self.param_shardings
+        self._step = jax.jit(
+            step,
+            in_shardings=(param_sh, param_sh if momentum else None,
+                          *self.batch_shardings),
+            out_shardings=(param_sh, param_sh if momentum else None, None),
+            donate_argnums=(0, 1))
+
+    def step(self, *batch):
+        """Run one sharded train step; returns the scalar loss."""
+        batch = [jax.device_put(np.asarray(b) if not isinstance(b, jax.Array)
+                                else b, s)
+                 for b, s in zip(batch, self.batch_shardings)]
+        self.params, self.mom, loss = self._step(self.params, self.mom,
+                                                 *batch)
+        return loss
+
+    def get_params(self):
+        return {k: np.asarray(jax.device_get(v))
+                for k, v in self.params.items()}
