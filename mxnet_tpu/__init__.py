@@ -51,3 +51,12 @@ from . import gluon                  # noqa: E402
 from . import parallel               # noqa: E402
 
 __version__ = "0.1.0"
+from . import operator               # noqa: E402
+from . import rnn                    # noqa: E402
+from . import profiler               # noqa: E402
+from . import monitor                # noqa: E402
+from .monitor import Monitor         # noqa: E402
+from . import visualization          # noqa: E402
+from . import visualization as viz   # noqa: E402
+from . import test_utils             # noqa: E402
+from . import image                  # noqa: E402

@@ -1,0 +1,3 @@
+"""Image pipeline (parity: python/mxnet/image/)."""
+from .image import *  # noqa: F401,F403
+from . import detection  # noqa: F401

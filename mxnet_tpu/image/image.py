@@ -1,0 +1,457 @@
+"""Pure-python image pipeline.
+
+Parity: reference ``python/mxnet/image/image.py`` (ImageIter:999 +
+augmenters:482). The reference decodes via OpenCV; this build uses PIL
+for JPEG/PNG decode + numpy for augmentation (the C++ RecordIO reader in
+src/ accelerates the record scan; decode stays host-side either way —
+on TPU the input pipeline budget is host CPU, SURVEY.md §7 "IO
+throughput").
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import random
+
+import numpy as np
+
+from ..base import MXNetError
+from ..io import DataIter, DataBatch, DataDesc
+from ..ndarray import array as nd_array
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["imdecode", "imresize", "fixed_crop", "random_crop",
+           "center_crop", "color_normalize", "random_size_crop",
+           "ResizeAug", "RandomCropAug", "RandomSizedCropAug", "CenterCropAug",
+           "HorizontalFlipAug", "CastAug", "ColorNormalizeAug",
+           "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
+           "LightingAug", "ColorJitterAug", "RandomOrderAug",
+           "CreateAugmenter", "ImageIter", "Augmenter"]
+
+
+def _pil():
+    try:
+        from PIL import Image
+        return Image
+    except ImportError:
+        raise MXNetError("image decode requires PIL in this build")
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an encoded image buffer to an HWC uint8 NDArray
+    (parity: mx.image.imdecode over cv2.imdecode)."""
+    Image = _pil()
+    img = Image.open(_io.BytesIO(bytes(buf)))
+    img = img.convert("RGB" if flag else "L")
+    arr = np.asarray(img, np.uint8)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if not to_rgb and arr.shape[2] == 3:
+        arr = arr[:, :, ::-1]
+    return nd_array(arr)
+
+
+def imresize(src, w, h, interp=1):
+    """(parity: mx.image.imresize)"""
+    Image = _pil()
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    img = Image.fromarray(arr.astype(np.uint8).squeeze())
+    img = img.resize((w, h), Image.BILINEAR if interp else Image.NEAREST)
+    out = np.asarray(img, np.uint8)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return nd_array(out)
+
+
+def resize_short(src, size, interp=2):
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    h, w = arr.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    out = arr[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        return imresize(nd_array(out), size[0], size[1], interp)
+    return nd_array(out)
+
+
+def random_crop(src, size, interp=2):
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = size
+    x0 = random.randint(0, max(w - new_w, 0))
+    y0 = random.randint(0, max(h - new_h, 0))
+    out = fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = size
+    x0 = max((w - new_w) // 2, 0)
+    y0 = max((h - new_h) // 2, 0)
+    out = fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    h, w = arr.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = random.uniform(*area) * src_area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        aspect = np.exp(random.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * aspect)))
+        new_h = int(round(np.sqrt(target_area / aspect)))
+        if new_w <= w and new_h <= h:
+            x0 = random.randint(0, w - new_w)
+            y0 = random.randint(0, h - new_h)
+            return fixed_crop(src, x0, y0, new_w, new_h, size, interp), \
+                (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    arr = src.asnumpy().astype(np.float32) if isinstance(src, NDArray) \
+        else np.asarray(src, np.float32)
+    arr = arr - np.asarray(mean)
+    if std is not None:
+        arr = arr / np.asarray(std)
+    return nd_array(arr)
+
+
+class Augmenter:
+    """(parity: image.Augmenter)"""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size = size
+        self.area = area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if random.random() < self.p:
+            return nd_array(src.asnumpy()[:, ::-1])
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = mean
+        self.std = std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + random.uniform(-self.brightness, self.brightness)
+        return nd_array(src.asnumpy().astype(np.float32) * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+        self.coef = np.array([[[0.299, 0.587, 0.114]]])
+
+    def __call__(self, src):
+        alpha = 1.0 + random.uniform(-self.contrast, self.contrast)
+        arr = src.asnumpy().astype(np.float32)
+        gray = (arr * self.coef).sum() * (3.0 / arr.size)
+        return nd_array(arr * alpha + gray * (1.0 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+        self.coef = np.array([[[0.299, 0.587, 0.114]]])
+
+    def __call__(self, src):
+        alpha = 1.0 + random.uniform(-self.saturation, self.saturation)
+        arr = src.asnumpy().astype(np.float32)
+        gray = (arr * self.coef).sum(axis=2, keepdims=True)
+        return nd_array(arr * alpha + gray * (1.0 - alpha))
+
+
+class LightingAug(Augmenter):
+    """AlexNet-style PCA noise (parity: image.LightingAug)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval)
+        self.eigvec = np.asarray(eigvec)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,))
+        rgb = np.dot(self.eigvec * alpha, self.eigval)
+        return nd_array(src.asnumpy().astype(np.float32) + rgb)
+
+
+class ColorJitterAug(Augmenter):
+    def __init__(self, brightness, contrast, saturation):
+        super().__init__(brightness=brightness, contrast=contrast,
+                         saturation=saturation)
+        self.augs = []
+        if brightness > 0:
+            self.augs.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            self.augs.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            self.augs.append(SaturationJitterAug(saturation))
+
+    def __call__(self, src):
+        random.shuffle(self.augs)
+        for aug in self.augs:
+            src = aug(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        random.shuffle(self.ts)
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
+    """(parity: image.CreateAugmenter)"""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        auglist.append(RandomSizedCropAug(crop_size, 0.08, (3.0 / 4.0,
+                                                            4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None and len(np.atleast_1d(mean)):
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Pure-python image iterator over RecordIO or an image list
+    (parity: image.ImageIter:999)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imglist=None, data_name="data", label_name="softmax_label",
+                 **kwargs):
+        super().__init__(batch_size)
+        if len(data_shape) != 3 or data_shape[0] not in (1, 3):
+            raise MXNetError("data_shape must be (C, H, W)")
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.imgrec = None
+        self.imglist = {}
+        self.seq = []
+        if path_imgrec:
+            from .. import recordio
+            idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+            if os.path.exists(idx_path):
+                self.imgrec = recordio.MXIndexedRecordIO(idx_path,
+                                                         path_imgrec, "r")
+                self.seq = list(self.imgrec.keys)
+            else:
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+                self._records = []
+                while True:
+                    s = self.imgrec.read()
+                    if s is None:
+                        break
+                    self._records.append(s)
+                self.seq = list(range(len(self._records)))
+        elif path_imglist or imglist is not None:
+            if path_imglist:
+                with open(path_imglist) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        label = np.array([float(x) for x in parts[1:-1]],
+                                         np.float32)
+                        self.imglist[int(parts[0])] = (label, parts[-1])
+            else:
+                for i, item in enumerate(imglist):
+                    self.imglist[i] = (np.array(item[0], np.float32).reshape(-1),
+                                       item[1])
+            self.seq = list(self.imglist.keys())
+        else:
+            raise MXNetError("need path_imgrec, path_imglist, or imglist")
+        self.path_root = path_root
+        self.shuffle = shuffle
+        if num_parts > 1:
+            self.seq = self.seq[part_index::num_parts]
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape, **{k: v for k, v in kwargs.items()
+                                           if k in ("resize", "rand_crop",
+                                                    "rand_resize",
+                                                    "rand_mirror", "mean",
+                                                    "std", "brightness",
+                                                    "contrast", "saturation",
+                                                    "pca_noise")})
+        self.cur = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [DataDesc("softmax_label", shape)]
+
+    def reset(self):
+        if self.shuffle:
+            random.shuffle(self.seq)
+        self.cur = 0
+
+    def next_sample(self):
+        if self.cur >= len(self.seq):
+            raise StopIteration
+        idx = self.seq[self.cur]
+        self.cur += 1
+        if self.imgrec is not None:
+            from .. import recordio
+            s = self.imgrec.read_idx(idx) if hasattr(self.imgrec, "read_idx") \
+                and getattr(self.imgrec, "idx", None) else self._records[idx]
+            header, img = recordio.unpack(s)
+            return header.label, img
+        label, fname = self.imglist[idx]
+        with open(os.path.join(self.path_root, fname), "rb") as f:
+            img = f.read()
+        return label, img
+
+    def next(self):
+        batch_data = np.zeros((self.batch_size,) + self.data_shape,
+                              np.float32)
+        lshape = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        batch_label = np.zeros(lshape, np.float32)
+        i = 0
+        while i < self.batch_size:
+            label, s = self.next_sample()
+            c, h, w = self.data_shape
+            raw = np.frombuffer(s, np.uint8)
+            if raw.size == c * h * w:          # packed raw tensor
+                img = nd_array(raw.reshape(h, w, c) if c != 1
+                               else raw.reshape(h, w, 1))
+            else:
+                img = imdecode(s)
+            for aug in self.auglist:
+                img = aug(img)
+            arr = img.asnumpy()
+            if arr.shape[:2] != (h, w):
+                arr = imresize(nd_array(arr.astype(np.uint8)), w, h).asnumpy()
+            batch_data[i] = arr.transpose(2, 0, 1)
+            batch_label[i] = label
+            i += 1
+        return DataBatch([nd_array(batch_data)], [nd_array(batch_label)],
+                         pad=0)

@@ -14,3 +14,5 @@ from . import random   # noqa: E402,F401
 from . import linalg   # noqa: E402,F401
 from . import sparse   # noqa: E402,F401
 from .sparse import RowSparseNDArray, CSRNDArray  # noqa: E402,F401
+
+from . import contrib  # noqa: E402,F401

@@ -1,0 +1,12 @@
+"""``mx.nd.contrib`` namespace (parity: python/mxnet/ndarray/contrib.py):
+exposes ops registered with the ``_contrib_`` prefix under short names."""
+from __future__ import annotations
+
+from ..ops import registry as _registry
+from . import register as _register
+
+for _name in _registry.list_ops():
+    if _name.startswith("_contrib_"):
+        _op = _registry.get_op(_name)
+        globals()[_name[len("_contrib_"):]] = _register.make_op_func(_op)
+        globals()[_name] = _register.make_op_func(_op)

@@ -1,0 +1,159 @@
+"""User-defined operators in Python.
+
+Parity: reference ``python/mxnet/operator.py`` (CustomOp:418,
+CustomOpProp:464, register:598; C side src/operator/custom/ runs the
+python callbacks on a dedicated thread, async-safe). TPU-native design:
+the python forward/backward run as host callbacks via
+``jax.pure_callback`` — so a Custom op works both eagerly AND inside
+jitted graphs/executors (XLA inserts the host round-trip), which is
+the same contract the reference's async custom-op thread provided.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ops.registry import register as _register_op, get_op
+from .ndarray.ndarray import NDArray, array as nd_array
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered"]
+
+_custom_props = {}
+
+
+class CustomOp:
+    """Base class for custom op implementations (parity: operator.CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """(parity: CustomOp.assign)"""
+        if req in ("null",):
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+
+
+class CustomOpProp:
+    """Metadata + factory for a custom op (parity: operator.CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, (in_shape[0],) * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+def register(reg_name):
+    """(parity: mx.operator.register) — also registers into the main op
+    registry so nd.Custom / sym.Custom dispatch by op_type."""
+
+    def do_register(prop_cls):
+        _custom_props[reg_name] = prop_cls
+        return prop_cls
+
+    return do_register
+
+
+def get_all_registered():
+    return dict(_custom_props)
+
+
+def _custom_impl(*inputs, op_type=None, **params):
+    """The 'Custom' op function: host-callback forward with custom-vjp
+    host-callback backward."""
+    if op_type not in _custom_props:
+        raise MXNetError("custom op %r is not registered" % op_type)
+    prop = _custom_props[op_type](**{k: str(v) for k, v in params.items()})
+    in_shapes = [tuple(x.shape) for x in inputs]
+    ishapes, oshapes, ashapes = prop.infer_shape([list(s) for s in in_shapes])
+    out_structs = tuple(jax.ShapeDtypeStruct(tuple(s), inputs[0].dtype)
+                        for s in oshapes)
+    n_out = len(out_structs)
+
+    def host_forward(*arrs):
+        in_nd = [nd_array(np.asarray(a)) for a in arrs]
+        out_nd = [nd_array(np.zeros(tuple(s), np.asarray(arrs[0]).dtype))
+                  for s in oshapes]
+        op = prop.create_operator(None, in_shapes, [a.dtype for a in arrs])
+        op.forward(is_train=True, req=["write"] * n_out, in_data=in_nd,
+                   out_data=out_nd, aux=[])
+        outs = tuple(o.asnumpy() for o in out_nd)
+        return outs if n_out > 1 else outs[0]
+
+    def host_backward(*arrs):
+        # arrs = out_grads + inputs + outputs
+        ogs = [nd_array(np.asarray(a)) for a in arrs[:n_out]]
+        ins = [nd_array(np.asarray(a)) for a in arrs[n_out:n_out + len(inputs)]]
+        outs = [nd_array(np.asarray(a)) for a in arrs[n_out + len(inputs):]]
+        igs = [nd_array(np.zeros(s, np.asarray(arrs[0]).dtype))
+               for s in in_shapes]
+        op = prop.create_operator(None, in_shapes,
+                                  [np.asarray(a).dtype for a in arrs])
+        op.backward(req=["write"] * len(inputs), out_grad=ogs, in_data=ins,
+                    out_data=outs, in_grad=igs, aux=[])
+        res = tuple(g.asnumpy() for g in igs)
+        return res if len(inputs) > 1 else res[0]
+
+    @jax.custom_vjp
+    def _run(*ins):
+        out = jax.pure_callback(host_forward, out_structs if n_out > 1
+                                else out_structs[0], *ins)
+        return out
+
+    def _run_fwd(*ins):
+        out = _run(*ins)
+        return out, (ins, out)
+
+    def _run_bwd(res, g):
+        ins, outs = res
+        outs_t = outs if isinstance(outs, tuple) else (outs,)
+        g_t = g if isinstance(g, tuple) else (g,)
+        in_structs = tuple(jax.ShapeDtypeStruct(tuple(s), ins[0].dtype)
+                           for s in in_shapes)
+        grads = jax.pure_callback(host_backward,
+                                  in_structs if len(ins) > 1 else in_structs[0],
+                                  *(tuple(g_t) + tuple(ins) + tuple(outs_t)))
+        return grads if isinstance(grads, tuple) else (grads,)
+
+    _run.defvjp(_run_fwd, _run_bwd)
+    return _run(*inputs)
+
+
+_register_op("Custom", nin=-1, defaults={"op_type": None})(_custom_impl)
+
+# inject into the already-generated nd/sym namespaces (this module imports
+# after they are populated)
+from . import ndarray as _nd_mod            # noqa: E402
+from .ndarray import register as _nd_reg    # noqa: E402
+from . import symbol as _sym_mod            # noqa: E402
+from .symbol import register as _sym_reg    # noqa: E402
+_nd_mod.Custom = _nd_reg.make_op_func(get_op("Custom"))
+_sym_mod.Custom = _sym_reg.make_sym_func(get_op("Custom"))

@@ -14,6 +14,8 @@ from . import optimizer_ops  # noqa: F401
 from . import linalg      # noqa: F401
 from . import rnn         # noqa: F401
 from . import ctc         # noqa: F401
+from . import contrib     # noqa: F401
+from . import spatial     # noqa: F401
 
 from . import shape_infer as _shape_infer  # noqa: E402
 _shape_infer.install()

@@ -1,0 +1,270 @@
+"""Contrib operators: SSD multibox family, ROIPooling, proposal ops.
+
+Parity: reference ``src/operator/contrib/multibox_prior.cc``,
+``multibox_target.cc``, ``multibox_detection.cc`` (the SSD-VGG16 baseline
+workload, SURVEY.md BASELINE config 4) and ``src/operator/roi_pooling.cc``.
+TPU-native design: all static-shape vectorised jax — anchor matching is a
+masked argmax instead of the reference's sequential bipartite loop, and
+NMS is a fixed-trip-count lax.fori_loop over score-sorted candidates
+(compiler-friendly; no dynamic shapes).
+"""
+from __future__ import annotations
+
+import ast
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .common import as_tuple
+from .registry import register
+
+
+def _parse_floats(v, default=()):
+    if v is None:
+        return tuple(default)
+    if isinstance(v, str):
+        v = ast.literal_eval(v)
+    if isinstance(v, (int, float)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
+@register("_contrib_MultiBoxPrior", nin=1,
+          defaults={"sizes": (1.0,), "ratios": (1.0,), "clip": False,
+                    "steps": (-1.0, -1.0), "offsets": (0.5, 0.5)},
+          no_grad=True, aliases=("MultiBoxPrior", "_contrib_multibox_prior"))
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Generate anchor boxes per feature-map cell (reference
+    multibox_prior.cc). Output (1, H*W*(S+R-1), 4) as cx-style corners."""
+    sizes = _parse_floats(sizes, (1.0,))
+    ratios = _parse_floats(ratios, (1.0,))
+    offsets = _parse_floats(offsets, (0.5, 0.5))
+    steps = _parse_floats(steps, (-1.0, -1.0))
+    H, W = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H) + offsets[0]) * step_y
+    cx = (jnp.arange(W) + offsets[1]) * step_x
+    cy, cx = jnp.meshgrid(cy, cx, indexing="ij")
+    # anchor list: (sizes[0], ratios[0]), (sizes[i>0], ratios[0]),
+    # (sizes[0], ratios[j>0]) — reference ordering
+    whs = []
+    for k, s in enumerate(sizes):
+        whs.append((s * np.sqrt(ratios[0]), s / np.sqrt(ratios[0])))
+    for r in ratios[1:]:
+        whs.append((sizes[0] * np.sqrt(r), sizes[0] / np.sqrt(r)))
+    whs = jnp.asarray(whs)  # (A, 2) w,h
+    A = whs.shape[0]
+    cxy = jnp.stack([cx, cy], axis=-1).reshape(H * W, 1, 2)
+    half = whs.reshape(1, A, 2) / 2.0
+    mins = cxy - half
+    maxs = cxy + half
+    out = jnp.concatenate([mins, maxs], axis=-1).reshape(1, H * W * A, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+def _iou(anchors, gt):
+    """anchors (N,4) corners; gt (M,4) corners -> (N,M)"""
+    ax1, ay1, ax2, ay2 = [anchors[:, i] for i in range(4)]
+    gx1, gy1, gx2, gy2 = [gt[:, i] for i in range(4)]
+    ix1 = jnp.maximum(ax1[:, None], gx1[None, :])
+    iy1 = jnp.maximum(ay1[:, None], gy1[None, :])
+    ix2 = jnp.minimum(ax2[:, None], gx2[None, :])
+    iy2 = jnp.minimum(ay2[:, None], gy2[None, :])
+    iw = jnp.maximum(ix2 - ix1, 0.0)
+    ih = jnp.maximum(iy2 - iy1, 0.0)
+    inter = iw * ih
+    area_a = jnp.maximum((ax2 - ax1) * (ay2 - ay1), 0.0)
+    area_g = jnp.maximum((gx2 - gx1) * (gy2 - gy1), 0.0)
+    union = area_a[:, None] + area_g[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _encode_boxes(anchors, gt, variances):
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    gw = jnp.maximum(gt[:, 2] - gt[:, 0], 1e-8)
+    gh = jnp.maximum(gt[:, 3] - gt[:, 1], 1e-8)
+    gcx = (gt[:, 0] + gt[:, 2]) / 2
+    gcy = (gt[:, 1] + gt[:, 3]) / 2
+    tx = (gcx - acx) / jnp.maximum(aw, 1e-8) / variances[0]
+    ty = (gcy - acy) / jnp.maximum(ah, 1e-8) / variances[1]
+    tw = jnp.log(gw / jnp.maximum(aw, 1e-8)) / variances[2]
+    th = jnp.log(gh / jnp.maximum(ah, 1e-8)) / variances[3]
+    return jnp.stack([tx, ty, tw, th], axis=-1)
+
+
+@register("_contrib_MultiBoxTarget", nin=3,
+          arg_names=["anchor", "label", "cls_pred"], nout=3,
+          defaults={"overlap_threshold": 0.5, "ignore_label": -1.0,
+                    "negative_mining_ratio": -1.0,
+                    "negative_mining_thresh": 0.5,
+                    "minimum_negative_samples": 0,
+                    "variances": (0.1, 0.1, 0.2, 0.2)},
+          no_grad=True,
+          aliases=("MultiBoxTarget", "_contrib_multibox_target"))
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """Assign training targets to anchors (reference multibox_target.cc).
+
+    anchor (1, N, 4); label (B, M, 5) [cls, x1, y1, x2, y2] padded with
+    cls=-1; cls_pred (B, C, N). Returns (loc_target (B, N*4),
+    loc_mask (B, N*4), cls_target (B, N)).
+    """
+    variances = _parse_floats(variances, (0.1, 0.1, 0.2, 0.2))
+    anchors = anchor.reshape(-1, 4)
+    N = anchors.shape[0]
+
+    def per_sample(lab, scores):
+        valid = lab[:, 0] >= 0                          # (M,)
+        iou = _iou(anchors, lab[:, 1:5])                # (N, M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)               # (N,)
+        best_iou = jnp.max(iou, axis=1)
+        # force-match: each gt claims its best anchor
+        best_anchor = jnp.argmax(iou, axis=0)           # (M,)
+        # .max accumulates: padded gts share argmax 0 and must not
+        # overwrite a real gt's forced match
+        forced = jnp.zeros((N,), bool).at[best_anchor].max(valid)
+        pos = forced | (best_iou >= overlap_threshold)
+        matched_gt = lab[best_gt]                       # (N, 5)
+        cls_t = jnp.where(pos, matched_gt[:, 0] + 1.0, 0.0)
+        loc_t = _encode_boxes(anchors, matched_gt[:, 1:5],
+                              variances) * pos[:, None]
+        mask = jnp.tile(pos[:, None], (1, 4)).astype(jnp.float32)
+        if negative_mining_ratio > 0:
+            # hard-negative mining by background confidence
+            max_pos = jnp.sum(pos)
+            n_neg = jnp.maximum(max_pos * negative_mining_ratio,
+                                minimum_negative_samples).astype(jnp.int32)
+            bg_score = scores[0]                        # (N,) bg confidence
+            neg_cand = (~pos) & (best_iou < negative_mining_thresh)
+            hardness = jnp.where(neg_cand, -bg_score, -jnp.inf)
+            order = jnp.argsort(-hardness)
+            rank = jnp.zeros((N,), jnp.int32).at[order].set(jnp.arange(N))
+            keep_neg = neg_cand & (rank < n_neg)
+            cls_t = jnp.where(pos, cls_t,
+                              jnp.where(keep_neg, 0.0, ignore_label))
+        return loc_t.reshape(-1), mask.reshape(-1), cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(per_sample)(label, cls_pred)
+    return loc_t, loc_m, cls_t
+
+
+def _decode_boxes(anchors, deltas, variances):
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    cx = deltas[:, 0] * variances[0] * aw + acx
+    cy = deltas[:, 1] * variances[1] * ah + acy
+    w = jnp.exp(jnp.clip(deltas[:, 2] * variances[2], -10, 10)) * aw
+    h = jnp.exp(jnp.clip(deltas[:, 3] * variances[3], -10, 10)) * ah
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+@register("_contrib_MultiBoxDetection", nin=3,
+          arg_names=["cls_prob", "loc_pred", "anchor"],
+          defaults={"clip": True, "threshold": 0.01, "background_id": 0,
+                    "nms_threshold": 0.5, "force_suppress": False,
+                    "variances": (0.1, 0.1, 0.2, 0.2), "nms_topk": -1},
+          no_grad=True,
+          aliases=("MultiBoxDetection", "_contrib_multibox_detection"))
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5,
+                       force_suppress=False, variances=(0.1, 0.1, 0.2, 0.2),
+                       nms_topk=-1):
+    """Decode predictions + NMS (reference multibox_detection.cc).
+
+    cls_prob (B, C, N), loc_pred (B, N*4), anchor (1, N, 4) ->
+    (B, N, 6) rows [cls_id, score, x1, y1, x2, y2], suppressed rows
+    cls_id=-1.
+    """
+    variances = _parse_floats(variances, (0.1, 0.1, 0.2, 0.2))
+    anchors = anchor.reshape(-1, 4)
+    N = anchors.shape[0]
+
+    def per_sample(scores, deltas):
+        boxes = _decode_boxes(anchors, deltas.reshape(-1, 4), variances)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        fg = jnp.concatenate([scores[:background_id],
+                              scores[background_id + 1:]], axis=0) \
+            if scores.shape[0] > 1 else scores
+        cls_id = jnp.argmax(fg, axis=0).astype(jnp.float32)  # (N,)
+        score = jnp.max(fg, axis=0)
+        keep = score > threshold
+        cls_id = jnp.where(keep, cls_id, -1.0)
+        order = jnp.argsort(-score)
+        cls_s = cls_id[order]
+        score_s = score[order]
+        boxes_s = boxes[order]
+        topk = nms_topk if nms_topk and nms_topk > 0 else N
+        iou = _iou(boxes_s, boxes_s)
+
+        def body(i, alive):
+            cur_alive = alive[i] & (cls_s[i] >= 0) & (i < topk)
+            same = (cls_s == cls_s[i]) | force_suppress
+            sup = (iou[i] > nms_threshold) & same & \
+                (jnp.arange(N) > i) & cur_alive
+            return alive & ~sup
+
+        alive = jax.lax.fori_loop(0, min(N, topk), body, jnp.ones((N,), bool))
+        cls_out = jnp.where(alive, cls_s, -1.0)
+        return jnp.concatenate([cls_out[:, None], score_s[:, None], boxes_s],
+                               axis=-1)
+
+    return jax.vmap(per_sample)(cls_prob, loc_pred)
+
+
+@register("ROIPooling", nin=2, arg_names=["data", "rois"],
+          defaults={"pooled_size": (), "spatial_scale": 1.0})
+def roi_pooling(data, rois, pooled_size=(), spatial_scale=1.0):
+    """Max-pool regions of interest (reference src/operator/roi_pooling.cc).
+
+    data (B, C, H, W); rois (R, 5) [batch_idx, x1, y1, x2, y2] in image
+    coords. Static-shape design: each output cell max-pools over the full
+    feature map with a membership mask (vectorised; no dynamic slicing).
+    """
+    ph, pw = as_tuple(pooled_size, 2)
+    B, C, H, W = data.shape
+
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        fmap = data[b]                                   # (C, H, W)
+
+        iy = jnp.arange(ph, dtype=jnp.float32)
+        ix = jnp.arange(pw, dtype=jnp.float32)
+        y_lo = jnp.floor(y1 + iy * bin_h)
+        y_hi = jnp.ceil(y1 + (iy + 1) * bin_h)
+        x_lo = jnp.floor(x1 + ix * bin_w)
+        x_hi = jnp.ceil(x1 + (ix + 1) * bin_w)
+        ymask = (ys[None, :] >= y_lo[:, None]) & (ys[None, :] < y_hi[:, None])
+        xmask = (xs[None, :] >= x_lo[:, None]) & (xs[None, :] < x_hi[:, None])
+        m = ymask[:, None, :, None] & xmask[None, :, None, :]  # (ph,pw,H,W)
+        vals = jnp.where(m[None], fmap[:, None, None, :, :], -jnp.inf)
+        out = jnp.max(vals, axis=(-2, -1))               # (C, ph, pw)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return jax.vmap(one_roi)(rois)

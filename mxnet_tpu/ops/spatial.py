@@ -1,0 +1,90 @@
+"""Spatial transform operators.
+
+Parity: reference ``src/operator/grid_generator.cc``,
+``bilinear_sampler.cc``, ``spatial_transformer.cc`` (+ cudnn paths).
+Bilinear interpolation is a gather+lerp — VPU-bound, XLA fuses it.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from .common import as_tuple
+from .registry import register
+
+
+def _bilinear_sample(data, grid_x, grid_y):
+    """data (C, H, W); grid_x/grid_y (Ho, Wo) in [-1, 1] -> (C, Ho, Wo)."""
+    C, H, W = data.shape
+    x = (grid_x + 1) * (W - 1) / 2
+    y = (grid_y + 1) * (H - 1) / 2
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    x1 = x0 + 1
+    y1 = y0 + 1
+    wx1 = x - x0
+    wy1 = y - y0
+    wx0 = 1 - wx1
+    wy0 = 1 - wy1
+
+    def gather(yy, xx):
+        inb = (xx >= 0) & (xx <= W - 1) & (yy >= 0) & (yy <= H - 1)
+        xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        vals = data[:, yi, xi]            # (C, Ho, Wo)
+        return jnp.where(inb[None], vals, 0.0)
+
+    return (gather(y0, x0) * (wy0 * wx0)[None]
+            + gather(y0, x1) * (wy0 * wx1)[None]
+            + gather(y1, x0) * (wy1 * wx0)[None]
+            + gather(y1, x1) * (wy1 * wx1)[None])
+
+
+@register("BilinearSampler", nin=2, arg_names=["data", "grid"])
+def bilinear_sampler(data, grid):
+    """(reference bilinear_sampler.cc) data (B,C,H,W); grid (B,2,Ho,Wo)
+    normalised to [-1,1]."""
+    def one(d, g):
+        return _bilinear_sample(d, g[0], g[1])
+    return jax.vmap(one)(data, grid)
+
+
+@register("GridGenerator", defaults={"transform_type": "affine",
+                                     "target_shape": ()})
+def grid_generator(data, transform_type="affine", target_shape=()):
+    """(reference grid_generator.cc) affine: data (B, 6) -> grid
+    (B, 2, H, W); warp: data (B, 2, H, W) flow -> grid."""
+    if transform_type == "affine":
+        H, W = as_tuple(target_shape, 2)
+        ys = jnp.linspace(-1, 1, H)
+        xs = jnp.linspace(-1, 1, W)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)  # (3, H*W)
+
+        def one(theta):
+            m = theta.reshape(2, 3)
+            out = m @ base                                # (2, H*W)
+            return out.reshape(2, H, W)
+        return jax.vmap(one)(data)
+    if transform_type == "warp":
+        B, _, H, W = data.shape
+        ys = jnp.arange(H, dtype=data.dtype)
+        xs = jnp.arange(W, dtype=data.dtype)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        x = (data[:, 0] + gx) * 2 / jnp.maximum(W - 1, 1) - 1
+        y = (data[:, 1] + gy) * 2 / jnp.maximum(H - 1, 1) - 1
+        return jnp.stack([x, y], axis=1)
+    raise MXNetError("unknown transform_type %r" % transform_type)
+
+
+@register("SpatialTransformer", nin=2, arg_names=["data", "loc"],
+          defaults={"target_shape": (), "transform_type": "affine",
+                    "sampler_type": "bilinear", "cudnn_off": False})
+def spatial_transformer(data, loc, target_shape=(), transform_type="affine",
+                        sampler_type="bilinear", cudnn_off=False):
+    """(reference spatial_transformer.cc) — affine grid + bilinear sample."""
+    grid = grid_generator(loc, transform_type="affine",
+                          target_shape=target_shape)
+    return bilinear_sampler(data, grid)

@@ -1,0 +1,98 @@
+"""Profiler — chrome-trace output of device execution.
+
+Parity: reference ``src/engine/profiler.{h,cc}`` + ``python/mxnet/
+profiler.py`` (SURVEY.md §5.1; chrome://tracing JSON output). TPU-native
+design: wraps the JAX/XLA profiler, which records real device op spans
+(the reference stamped engine-op spans). ``dump()`` writes a
+chrome-trace-compatible ``.trace.json.gz`` plus TensorBoard-compatible
+artifacts in the output directory.
+"""
+from __future__ import annotations
+
+import glob
+import os
+import time
+
+import jax
+
+from .base import MXNetError, get_env
+
+__all__ = ["profiler_set_config", "profiler_set_state", "set_config",
+           "set_state", "dump", "pause", "resume"]
+
+_state = {"running": False, "filename": "profile.json", "dir": None}
+
+
+def set_config(profile_all=None, profile_symbolic=None,
+               profile_imperative=None, profile_memory=None, profile_api=None,
+               filename="profile_output.json", **kwargs):
+    """(parity: mx.profiler.set_config / MXSetProcessProfilerConfig)"""
+    _state["filename"] = filename
+
+
+profiler_set_config = set_config
+
+
+def set_state(state="stop", profile_process="worker"):
+    """(parity: mx.profiler.set_state — 'run' starts tracing, 'stop' dumps)"""
+    if state == "run":
+        if not _state["running"]:
+            out_dir = os.path.splitext(_state["filename"])[0] + "_trace"
+            os.makedirs(out_dir, exist_ok=True)
+            jax.profiler.start_trace(out_dir)
+            _state["dir"] = out_dir
+            _state["running"] = True
+    elif state == "stop":
+        if _state["running"]:
+            jax.profiler.stop_trace()
+            _state["running"] = False
+            _link_chrome_trace()
+    else:
+        raise MXNetError("state must be 'run' or 'stop'")
+
+
+profiler_set_state = set_state
+
+
+def _link_chrome_trace():
+    """Surface the chrome trace file at the configured filename."""
+    out_dir = _state["dir"]
+    if not out_dir:
+        return
+    matches = glob.glob(os.path.join(out_dir, "**", "*.trace.json.gz"),
+                        recursive=True)
+    if matches:
+        target = _state["filename"]
+        if not target.endswith(".gz"):
+            target = target + ".gz"
+        import shutil
+        shutil.copyfile(sorted(matches)[-1], target)
+
+
+def dump(finished=True, profile_process="worker"):
+    """(parity: mx.profiler.dump)"""
+    if _state["running"]:
+        set_state("stop")
+
+
+def pause(profile_process="worker"):
+    pass
+
+
+def resume(profile_process="worker"):
+    pass
+
+
+class Scope:
+    """Annotate a region so it shows up in the device trace
+    (jax.profiler.TraceAnnotation under the hood)."""
+
+    def __init__(self, name):
+        self._ann = jax.profiler.TraceAnnotation(name)
+
+    def __enter__(self):
+        self._ann.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._ann.__exit__(*exc)

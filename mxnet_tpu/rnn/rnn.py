@@ -1,0 +1,31 @@
+"""RNN checkpoint helpers (parity: python/mxnet/rnn/rnn.py)."""
+from __future__ import annotations
+
+from ..model import save_checkpoint, load_checkpoint
+
+__all__ = ["save_rnn_checkpoint", "load_rnn_checkpoint", "do_rnn_checkpoint"]
+
+
+def _unpack_cells(cells):
+    if not isinstance(cells, (list, tuple)):
+        cells = [cells]
+    return cells
+
+
+def save_rnn_checkpoint(cells, prefix, epoch, symbol, arg_params, aux_params):
+    """(parity: rnn.save_rnn_checkpoint — fused/unfused param layouts are
+    identical here so no repacking is needed)"""
+    save_checkpoint(prefix, epoch, symbol, arg_params, aux_params)
+
+
+def load_rnn_checkpoint(cells, prefix, epoch):
+    return load_checkpoint(prefix, epoch)
+
+
+def do_rnn_checkpoint(cells, prefix, period=1):
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
+    return _callback

@@ -1,0 +1,420 @@
+"""Legacy symbolic RNN cells.
+
+Parity: reference ``python/mxnet/rnn/rnn_cell.py`` — Symbol-graph cells
+used by the bucketing LSTM example. Each cell emits Symbol ops;
+``FusedRNNCell`` maps to the fused RNN op (≙ cuDNN path).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError, NameManager
+from .. import symbol as sym_mod
+from ..symbol import Symbol, Variable
+
+__all__ = ["BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell", "FusedRNNCell",
+           "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
+           "ZoneoutCell", "ResidualCell", "RNNParams"]
+
+
+class RNNParams:
+    """(parity: rnn_cell.RNNParams) — shared weight container."""
+
+    def __init__(self, prefix=""):
+        self._prefix = prefix
+        self._params = {}
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        if name not in self._params:
+            self._params[name] = Variable(name, **kwargs)
+        return self._params[name]
+
+
+class BaseRNNCell:
+    """(parity: rnn_cell.BaseRNNCell)"""
+
+    def __init__(self, prefix="", params=None):
+        if params is None:
+            params = RNNParams(prefix)
+            self._own_params = True
+        else:
+            self._own_params = False
+        self._prefix = prefix
+        self._params = params
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self._params
+
+    @property
+    def state_info(self):
+        raise NotImplementedError
+
+    @property
+    def state_shape(self):
+        return [ele["shape"] for ele in self.state_info]
+
+    @property
+    def _gate_names(self):
+        return ()
+
+    def begin_state(self, func=None, **kwargs):
+        if func is None:
+            func = sym_mod.zeros
+        states = []
+        for info in self.state_info:
+            self._init_counter += 1
+            if info is not None:
+                info = dict(info)
+                shape = info.pop("shape", None)
+                state = func(name="%sbegin_state_%d" % (self._prefix,
+                                                        self._init_counter),
+                             shape=shape, **kwargs) if func is sym_mod.zeros \
+                    else func(**info, **kwargs)
+            else:
+                state = func(name="%sbegin_state_%d" % (self._prefix,
+                                                        self._init_counter),
+                             **kwargs)
+            states.append(state)
+        return states
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        """(parity: BaseRNNCell.unroll)"""
+        self.reset()
+        axis = layout.find("T")
+        if begin_state is None:
+            begin_state = self.begin_state()
+        states = begin_state
+        if isinstance(inputs, Symbol):
+            steps = sym_mod.SliceChannel(inputs, num_outputs=length,
+                                         axis=axis, squeeze_axis=True)
+            inputs = [steps[i] for i in range(length)]
+        outputs = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+        if merge_outputs is None or merge_outputs:
+            outputs = [sym_mod.expand_dims(o, axis=axis) for o in outputs]
+            outputs = sym_mod.Concat(*outputs, dim=axis)
+        return outputs, states
+
+    def _get_activation(self, inputs, activation, **kwargs):
+        if isinstance(activation, str):
+            return sym_mod.Activation(inputs, act_type=activation, **kwargs)
+        return activation(inputs, **kwargs)
+
+
+class RNNCell(BaseRNNCell):
+    """(parity: rnn_cell.RNNCell)"""
+
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_",
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._activation = activation
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = sym_mod.FullyConnected(inputs, weight=self._iW, bias=self._iB,
+                                     num_hidden=self._num_hidden,
+                                     name="%si2h" % name)
+        h2h = sym_mod.FullyConnected(states[0], weight=self._hW,
+                                     bias=self._hB,
+                                     num_hidden=self._num_hidden,
+                                     name="%sh2h" % name)
+        output = self._get_activation(i2h + h2h, self._activation,
+                                      name="%sout" % name)
+        return output, [output]
+
+
+class LSTMCell(BaseRNNCell):
+    """(parity: rnn_cell.LSTMCell; gates i,f,c,o)"""
+
+    def __init__(self, num_hidden, prefix="lstm_", params=None,
+                 forget_bias=1.0):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        from ..initializer import LSTMBias
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"},
+                {"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ["_i", "_f", "_c", "_o"]
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h = sym_mod.FullyConnected(inputs, weight=self._iW, bias=self._iB,
+                                     num_hidden=self._num_hidden * 4,
+                                     name="%si2h" % name)
+        h2h = sym_mod.FullyConnected(states[0], weight=self._hW,
+                                     bias=self._hB,
+                                     num_hidden=self._num_hidden * 4,
+                                     name="%sh2h" % name)
+        gates = i2h + h2h
+        slice_gates = sym_mod.SliceChannel(gates, num_outputs=4, axis=1,
+                                           name="%sslice" % name)
+        in_gate = sym_mod.Activation(slice_gates[0], act_type="sigmoid")
+        forget_gate = sym_mod.Activation(slice_gates[1], act_type="sigmoid")
+        in_transform = sym_mod.Activation(slice_gates[2], act_type="tanh")
+        out_gate = sym_mod.Activation(slice_gates[3], act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * sym_mod.Activation(next_c, act_type="tanh")
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(BaseRNNCell):
+    """(parity: rnn_cell.GRUCell)"""
+
+    def __init__(self, num_hidden, prefix="gru_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        prev_h = states[0]
+        i2h = sym_mod.FullyConnected(inputs, weight=self._iW, bias=self._iB,
+                                     num_hidden=self._num_hidden * 3,
+                                     name="%si2h" % name)
+        h2h = sym_mod.FullyConnected(prev_h, weight=self._hW, bias=self._hB,
+                                     num_hidden=self._num_hidden * 3,
+                                     name="%sh2h" % name)
+        i2h_s = sym_mod.SliceChannel(i2h, num_outputs=3, axis=1)
+        h2h_s = sym_mod.SliceChannel(h2h, num_outputs=3, axis=1)
+        reset_gate = sym_mod.Activation(i2h_s[0] + h2h_s[0],
+                                        act_type="sigmoid")
+        update_gate = sym_mod.Activation(i2h_s[1] + h2h_s[1],
+                                         act_type="sigmoid")
+        next_h_tmp = sym_mod.Activation(i2h_s[2] + reset_gate * h2h_s[2],
+                                        act_type="tanh")
+        next_h = (1.0 - update_gate) * next_h_tmp + update_gate * prev_h
+        return next_h, [next_h]
+
+
+class FusedRNNCell(BaseRNNCell):
+    """Fused multi-layer cell over the RNN op (parity: rnn_cell.FusedRNNCell
+    ≙ the cuDNN path; see ops/rnn.py for the TPU lax.scan design)."""
+
+    def __init__(self, num_hidden, num_layers=1, mode="lstm",
+                 bidirectional=False, dropout=0.0, get_next_state=False,
+                 forget_bias=1.0, prefix=None, params=None):
+        if prefix is None:
+            prefix = "%s_" % mode
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._dropout = dropout
+        self._get_next_state = get_next_state
+        self._parameter = self.params.get("parameters")
+        self._directions = 2 if bidirectional else 1
+
+    @property
+    def state_info(self):
+        b = self._directions * self._num_layers
+        n = 2 if self._mode == "lstm" else 1
+        return [{"shape": (b, 0, self._num_hidden), "__layout__": "LNC"}
+                for _ in range(n)]
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        if begin_state is None:
+            begin_state = self.begin_state()
+        if layout == "NTC":
+            inputs = sym_mod.swapaxes(inputs, dim1=0, dim2=1)
+        states = begin_state
+        rnn_args = dict(state_size=self._num_hidden,
+                        num_layers=self._num_layers, mode=self._mode,
+                        bidirectional=self._bidirectional, p=self._dropout,
+                        state_outputs=self._get_next_state)
+        if self._mode == "lstm":
+            rnn = sym_mod.RNN(inputs, self._parameter, states[0], states[1],
+                              name=self._prefix + "rnn", **rnn_args)
+        else:
+            rnn = sym_mod.RNN(inputs, self._parameter, states[0],
+                              name=self._prefix + "rnn", **rnn_args)
+        if not self._get_next_state:
+            outputs, states = rnn, []
+        elif self._mode == "lstm":
+            outputs, states = rnn[0], [rnn[1], rnn[2]]
+        else:
+            outputs, states = rnn[0], [rnn[1]]
+        if layout == "NTC":
+            outputs = sym_mod.swapaxes(outputs, dim1=0, dim2=1)
+        return outputs, states
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """(parity: rnn_cell.SequentialRNNCell)"""
+
+    def __init__(self, params=None):
+        super().__init__(prefix="", params=params)
+        self._cells = []
+        self._override_cell_params = params is not None
+
+    def add(self, cell):
+        self._cells.append(cell)
+
+    @property
+    def state_info(self):
+        info = []
+        for cell in self._cells:
+            info.extend(cell.state_info)
+        return info
+
+    def begin_state(self, **kwargs):
+        states = []
+        for cell in self._cells:
+            states.extend(cell.begin_state(**kwargs))
+        return states
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._cells:
+            n = len(cell.state_info)
+            state = states[p:p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.extend(state)
+        return inputs, next_states
+
+
+class DropoutCell(BaseRNNCell):
+    """(parity: rnn_cell.DropoutCell)"""
+
+    def __init__(self, dropout, prefix="dropout_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.dropout = dropout
+
+    @property
+    def state_info(self):
+        return []
+
+    def __call__(self, inputs, states):
+        if self.dropout > 0:
+            inputs = sym_mod.Dropout(inputs, p=self.dropout)
+        return inputs, states
+
+
+class ModifierCell(BaseRNNCell):
+    def __init__(self, base_cell):
+        super().__init__(prefix="", params=None)
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    @property
+    def state_info(self):
+        return self.base_cell.state_info
+
+    def begin_state(self, func=None, **kwargs):
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(func=func, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+
+class ZoneoutCell(ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self.prev_output = None
+
+    def __call__(self, inputs, states):
+        output, new_states = self.base_cell(inputs, states)
+        if self.zoneout_outputs > 0:
+            mask = sym_mod.Dropout(sym_mod.ones_like(output),
+                                   p=self.zoneout_outputs)
+            prev = self.prev_output if self.prev_output is not None \
+                else sym_mod.zeros_like(output)
+            output = sym_mod.where(mask, output, prev)
+        if self.zoneout_states > 0:
+            new_states = [sym_mod.where(
+                sym_mod.Dropout(sym_mod.ones_like(ns), p=self.zoneout_states),
+                ns, s) for ns, s in zip(new_states, states)]
+        self.prev_output = output
+        return output, new_states
+
+
+class ResidualCell(ModifierCell):
+    def __call__(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        return output + inputs, states
+
+
+class BidirectionalCell(BaseRNNCell):
+    """(parity: rnn_cell.BidirectionalCell)"""
+
+    def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
+        super().__init__(prefix="", params=params)
+        self._output_prefix = output_prefix
+        self._cells = [l_cell, r_cell]
+
+    @property
+    def state_info(self):
+        return self._cells[0].state_info + self._cells[1].state_info
+
+    def begin_state(self, **kwargs):
+        return (self._cells[0].begin_state(**kwargs)
+                + self._cells[1].begin_state(**kwargs))
+
+    def __call__(self, inputs, states):
+        raise MXNetError("BidirectionalCell supports only unroll()")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        axis = layout.find("T")
+        if begin_state is None:
+            begin_state = self.begin_state()
+        l_cell, r_cell = self._cells
+        n_l = len(l_cell.state_info)
+        l_out, l_states = l_cell.unroll(length, inputs, begin_state[:n_l],
+                                        layout, merge_outputs=True)
+        rev = sym_mod.reverse(inputs, axis=axis)
+        r_out, r_states = r_cell.unroll(length, rev, begin_state[n_l:],
+                                        layout, merge_outputs=True)
+        r_out = sym_mod.reverse(r_out, axis=axis)
+        outputs = sym_mod.Concat(l_out, r_out, dim=2,
+                                 name="%sout" % self._output_prefix)
+        return outputs, l_states + r_states

@@ -1,0 +1,218 @@
+"""Testing fixtures — the numeric-check engine.
+
+Parity: reference ``python/mxnet/test_utils.py`` (SURVEY.md §4): the
+key testing ideas are (1) forward-vs-numpy, (2) backward-vs-finite-
+difference (``check_numeric_gradient``), (3) cross-backend consistency
+(``check_consistency`` — here TPU-vs-CPU instead of GPU-vs-CPU), and
+(4) convergence smoke tests.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from .context import Context, cpu, current_context
+from .ndarray.ndarray import NDArray, array as nd_array, zeros as nd_zeros
+from . import ndarray as nd
+
+__all__ = ["default_context", "set_default_context", "assert_almost_equal",
+           "almost_equal", "rand_ndarray", "rand_shape_2d", "rand_shape_3d",
+           "random_arrays", "check_numeric_gradient", "check_symbolic_forward",
+           "check_symbolic_backward", "check_consistency", "simple_forward"]
+
+_default_ctx = [None]
+
+
+def default_context():
+    return _default_ctx[0] or current_context()
+
+
+def set_default_context(ctx):
+    _default_ctx[0] = ctx
+
+
+def _as_np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20):
+    return np.allclose(_as_np(a), _as_np(b), rtol=rtol, atol=atol)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b")):
+    """(parity: test_utils.assert_almost_equal:467)"""
+    a, b = _as_np(a), _as_np(b)
+    if not np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=True):
+        idx = np.unravel_index(np.argmax(np.abs(a - b)), a.shape) if a.shape \
+            else ()
+        raise AssertionError(
+            "%s and %s differ: max abs err %g at %s (rtol=%g atol=%g)"
+            % (names[0], names[1], float(np.max(np.abs(a - b))), idx, rtol,
+               atol))
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1),
+            np.random.randint(1, dim2 + 1))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype=np.float32,
+                 ctx=None):
+    """(parity: test_utils.rand_ndarray:336 — dense or sparse w/ density)"""
+    if stype == "default":
+        return nd_array(np.random.uniform(-1, 1, shape).astype(dtype),
+                        ctx=ctx)
+    density = 0.5 if density is None else density
+    dense = np.random.uniform(-1, 1, shape).astype(dtype)
+    mask = np.random.uniform(0, 1, shape) < density
+    dense = dense * mask
+    from .ndarray import sparse as sp
+    if stype == "row_sparse":
+        return sp.cast_storage(nd_array(dense), "row_sparse")
+    if stype == "csr":
+        return sp.cast_storage(nd_array(dense), "csr")
+    raise MXNetError("unknown stype %r" % stype)
+
+
+def random_arrays(*shapes):
+    arrays = [np.random.randn(*s).astype(np.float32) for s in shapes]
+    return arrays if len(arrays) > 1 else arrays[0]
+
+
+def simple_forward(sym, ctx=None, is_train=False, **inputs):
+    ex = sym.bind(ctx=ctx, args={k: nd_array(v) for k, v in inputs.items()})
+    outs = [o.asnumpy() for o in ex.forward(is_train=is_train)]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None,
+                           use_forward_train=True, ctx=None):
+    """Finite differences vs symbolic backward
+    (parity: test_utils.check_numeric_gradient:789)."""
+    ctx = ctx or default_context()
+    arg_names = sym.list_arguments()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(arg_names, location))
+    location = {k: np.asarray(v, np.float64).astype(np.float32)
+                for k, v in location.items()}
+    if grad_nodes is None:
+        grad_nodes = arg_names
+
+    args = {k: nd_array(v, ctx=ctx) for k, v in location.items()}
+    grads = {k: nd_zeros(v.shape, ctx=ctx) for k, v in location.items()
+             if k in grad_nodes}
+    ex = sym.bind(ctx=ctx, args=args, args_grad=grads,
+                  aux_states={k: nd_array(v) for k, v in
+                              (aux_states or {}).items()} or None)
+    out = ex.forward(is_train=True)
+    if len(out) > 1:
+        raise MXNetError("check_numeric_gradient expects single output")
+    # random head gradient projects multi-dim output to scalar
+    head = np.random.normal(0, 1, out[0].shape).astype(np.float32)
+    ex.backward(out_grads=nd_array(head, ctx=ctx))
+    sym_grads = {k: grads[k].asnumpy() for k in grads}
+
+    def f(loc):
+        ex2 = sym.bind(ctx=ctx, args={k: nd_array(v, ctx=ctx)
+                                      for k, v in loc.items()},
+                       aux_states={k: nd_array(v) for k, v in
+                                   (aux_states or {}).items()} or None)
+        o = ex2.forward(is_train=use_forward_train)[0].asnumpy()
+        return float(np.sum(o * head))
+
+    for name in grad_nodes:
+        base = location[name]
+        num_grad = np.zeros_like(base)
+        flat = base.reshape(-1)
+        ng_flat = num_grad.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + numeric_eps
+            fp = f(location)
+            flat[i] = orig - numeric_eps
+            fm = f(location)
+            flat[i] = orig
+            ng_flat[i] = (fp - fm) / (2 * numeric_eps)
+        assert_almost_equal(num_grad, sym_grads[name], rtol=rtol,
+                            atol=atol if atol is not None else 1e-4,
+                            names=("numeric_%s" % name, "symbolic_%s" % name))
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-5, atol=1e-20,
+                           aux_states=None, ctx=None):
+    """(parity: test_utils.check_symbolic_forward:921)"""
+    ctx = ctx or default_context()
+    arg_names = sym.list_arguments()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(arg_names, location))
+    args = {k: nd_array(v, ctx=ctx) for k, v in location.items()}
+    ex = sym.bind(ctx=ctx, args=args,
+                  aux_states={k: nd_array(v) for k, v in
+                              (aux_states or {}).items()} or None)
+    outputs = [o.asnumpy() for o in ex.forward()]
+    for out, exp in zip(outputs, expected):
+        assert_almost_equal(out, exp, rtol=rtol, atol=atol)
+    return outputs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
+                            atol=1e-20, aux_states=None, grad_req="write",
+                            ctx=None):
+    """(parity: test_utils.check_symbolic_backward)"""
+    ctx = ctx or default_context()
+    arg_names = sym.list_arguments()
+    if isinstance(location, (list, tuple)):
+        location = dict(zip(arg_names, location))
+    if isinstance(expected, (list, tuple)):
+        expected = dict(zip(arg_names, expected))
+    args = {k: nd_array(v, ctx=ctx) for k, v in location.items()}
+    grads = {k: nd_zeros(np.asarray(v).shape, ctx=ctx)
+             for k, v in location.items()}
+    ex = sym.bind(ctx=ctx, args=args, args_grad=grads, grad_req=grad_req)
+    ex.forward(is_train=True)
+    ex.backward(out_grads=[nd_array(g, ctx=ctx) for g in out_grads])
+    for name, exp in expected.items():
+        assert_almost_equal(grads[name].asnumpy(), exp, rtol=rtol, atol=atol,
+                            names=("grad_%s" % name, "expected_%s" % name))
+    return {k: v.asnumpy() for k, v in grads.items()}
+
+
+def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
+                      arg_params=None, rtol=1e-4, atol=1e-5):
+    """Run the same graph on several contexts and compare outputs+grads —
+    the cross-backend oracle (parity: test_utils.check_consistency; the
+    reference compares cpu vs gpu, here cpu vs tpu)."""
+    if len(ctx_list) < 2:
+        raise MXNetError("need at least two contexts")
+    results = []
+    np.random.seed(0)
+    arg_shapes = None
+    for spec in ctx_list:
+        ctx = spec["ctx"] if isinstance(spec, dict) else spec
+        shapes = {k: v for k, v in spec.items() if k != "ctx"} \
+            if isinstance(spec, dict) else {}
+        ex = sym.simple_bind(ctx=ctx, grad_req=grad_req, **shapes)
+        if arg_shapes is None:
+            arg_shapes = {k: a.shape for k, a in ex.arg_dict.items()}
+            arg_params = arg_params or {
+                k: np.random.normal(0, scale, s).astype(np.float32)
+                for k, s in arg_shapes.items()}
+        for k, v in arg_params.items():
+            ex.arg_dict[k][:] = v
+        outs = [o.asnumpy() for o in ex.forward(is_train=True)]
+        ex.backward()
+        grads = {k: g.asnumpy() for k, g in ex.grad_dict.items()
+                 if g is not None}
+        results.append((outs, grads))
+    ref_outs, ref_grads = results[0]
+    for outs, grads in results[1:]:
+        for o, r in zip(outs, ref_outs):
+            assert_almost_equal(o, r, rtol=rtol, atol=atol)
+        for k in ref_grads:
+            assert_almost_equal(grads[k], ref_grads[k], rtol=rtol, atol=atol)
+    return results
