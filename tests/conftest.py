@@ -21,3 +21,17 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 # numeric parity tests compare against numpy float32; disable bf16 matmul
 jax.config.update("jax_default_matmul_precision", "highest")
+
+
+import numpy as _np
+import pytest as _pytest
+
+
+@_pytest.fixture(autouse=True)
+def _deterministic_seed():
+    """Seed all RNG per test: initializer draws use np.random and eager
+    random ops use the mx global key — cross-test order must not matter."""
+    _np.random.seed(0)
+    import mxnet_tpu as _mx
+    _mx.random.seed(0)
+    yield

@@ -124,7 +124,6 @@ def test_fixed_params():
 
 
 def test_update_on_kvstore():
-    np.random.seed(7)  # deterministic initializer draws
     x, y = _toy_data(256)
     train = NDArrayIter(x, y, batch_size=64)
     kv = mx.kvstore.create("device")
