@@ -68,22 +68,29 @@ def _conv_nd(data, weight, bias, kernel, stride, dilate, pad, num_group,
             rhs_dilation=dilate, dimension_numbers=dn,
             feature_group_count=int(num_group))
     else:
+        # transposed conv = lhs-dilated conv with the flipped kernel.
+        # weight arrives in the reference Deconvolution layout
+        # (in_channels, num_filter/g, *kernel); the dilated conv needs
+        # (num_filter, in_channels/g, *kernel) OIHW.
         adj = adj or (0,) * ndim
+        g = int(num_group)
         k_eff = [(k - 1) * d + 1 for k, d in zip(kernel, dilate)]
         padding = [(ke - 1 - p, ke - 1 - p + a)
                    for ke, p, a in zip(k_eff, pad, adj)]
-        # transposed conv = lhs-dilated conv with flipped, transposed kernel
         w = jnp.flip(weight, axis=tuple(range(2, 2 + ndim)))
-        w = jnp.swapaxes(w, 0, 1)
-        if int(num_group) > 1:
-            g = int(num_group)
-            # weight arrives as (in, out/g, ...) after swap when grouped
-            w = w.reshape((g, w.shape[0] // g) + w.shape[1:])
-            w = jnp.concatenate([w[i] for i in range(g)], axis=0)
+        c_in = w.shape[0]
+        f_per_g = w.shape[1]
+        spatial = w.shape[2:]
+        w = w.reshape((g, c_in // g, f_per_g) + spatial)
+        w = jnp.swapaxes(w, 1, 2)                    # (g, F/g, C_in/g, ...)
+        w = w.reshape((g * f_per_g, c_in // g) + spatial)
+        dn_t = jax.lax.conv_dimension_numbers(
+            data.shape, w.shape,
+            (lhs_spec, "OI" + "DHW"[3 - ndim:], lhs_spec))
         out = jax.lax.conv_general_dilated(
             data, w, window_strides=(1,) * ndim, padding=padding,
-            lhs_dilation=stride, dimension_numbers=dn,
-            feature_group_count=int(num_group))
+            rhs_dilation=dilate, lhs_dilation=stride,
+            dimension_numbers=dn_t, feature_group_count=g)
     if not no_bias and bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * ndim)
     return out
@@ -122,7 +129,7 @@ def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     (in_channels, num_filter/g, *kernel) as in the reference."""
     kernel = as_tuple(kernel)
     ndim = len(kernel)
-    return _conv_nd(data, jnp.swapaxes(weight, 0, 1), bias, kernel,
+    return _conv_nd(data, weight, bias, kernel,
                     as_tuple(stride, ndim), as_tuple(dilate, ndim),
                     as_tuple(pad, ndim), num_group, no_bias, transposed=True,
                     adj=as_tuple(adj, ndim) if adj else None)
@@ -559,7 +566,7 @@ def upsampling(*args, scale=1, sample_type="nearest", num_filter=0,
         data, weight = args
         kernel = 2 * scale - scale % 2
         pad = int(np.ceil((scale - 1) / 2.0))
-        return _conv_nd(data, jnp.swapaxes(weight, 0, 1), None,
+        return _conv_nd(data, weight, None,
                         (kernel, kernel), (scale, scale), None, (pad, pad),
                         num_group=data.shape[1], no_bias=True, transposed=True)
     raise MXNetError("unknown sample_type %r" % sample_type)

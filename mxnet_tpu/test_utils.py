@@ -93,7 +93,11 @@ def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
                            rtol=1e-2, atol=None, grad_nodes=None,
                            use_forward_train=True, ctx=None):
     """Finite differences vs symbolic backward
-    (parity: test_utils.check_numeric_gradient:789)."""
+    (parity: test_utils.check_numeric_gradient:789). Like the reference,
+    the random projection is part of the graph (sum(out * proj) wrapped in
+    MakeLoss) so loss-style ops with fixed backward semantics are handled
+    uniformly."""
+    from . import symbol as _sym
     ctx = ctx or default_context()
     arg_names = sym.list_arguments()
     if isinstance(location, (list, tuple)):
@@ -103,27 +107,38 @@ def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
     if grad_nodes is None:
         grad_nodes = arg_names
 
+    if len(sym.list_outputs()) > 1:
+        raise MXNetError("check_numeric_gradient expects single output")
+    proj = _sym.Variable("__random_proj")
+    loss = _sym.MakeLoss(_sym.sum(sym * proj))
+
+    # shapes: forward once to get output shape for the projection
+    probe = sym.bind(ctx=ctx, args={k: nd_array(v, ctx=ctx)
+                                    for k, v in location.items()},
+                     aux_states={k: nd_array(v) for k, v in
+                                 (aux_states or {}).items()} or None)
+    out_shape = probe.forward()[0].shape
+    head = np.random.normal(0, 1, out_shape).astype(np.float32)
+    location = dict(location)
+    location["__random_proj"] = head
+
     args = {k: nd_array(v, ctx=ctx) for k, v in location.items()}
     grads = {k: nd_zeros(v.shape, ctx=ctx) for k, v in location.items()
              if k in grad_nodes}
-    ex = sym.bind(ctx=ctx, args=args, args_grad=grads,
-                  aux_states={k: nd_array(v) for k, v in
-                              (aux_states or {}).items()} or None)
-    out = ex.forward(is_train=True)
-    if len(out) > 1:
-        raise MXNetError("check_numeric_gradient expects single output")
-    # random head gradient projects multi-dim output to scalar
-    head = np.random.normal(0, 1, out[0].shape).astype(np.float32)
-    ex.backward(out_grads=nd_array(head, ctx=ctx))
+    ex = loss.bind(ctx=ctx, args=args, args_grad=grads,
+                   aux_states={k: nd_array(v) for k, v in
+                               (aux_states or {}).items()} or None)
+    ex.forward(is_train=True)
+    ex.backward()
     sym_grads = {k: grads[k].asnumpy() for k in grads}
 
     def f(loc):
-        ex2 = sym.bind(ctx=ctx, args={k: nd_array(v, ctx=ctx)
-                                      for k, v in loc.items()},
-                       aux_states={k: nd_array(v) for k, v in
-                                   (aux_states or {}).items()} or None)
+        ex2 = loss.bind(ctx=ctx, args={k: nd_array(v, ctx=ctx)
+                                       for k, v in loc.items()},
+                        aux_states={k: nd_array(v) for k, v in
+                                    (aux_states or {}).items()} or None)
         o = ex2.forward(is_train=use_forward_train)[0].asnumpy()
-        return float(np.sum(o * head))
+        return float(np.sum(o))
 
     for name in grad_nodes:
         base = location[name]

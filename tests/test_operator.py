@@ -1,0 +1,344 @@
+"""Operator tests: forward-vs-numpy + backward-vs-finite-difference
+(parity model: reference tests/python/unittest/test_operator.py, driven
+by the test_utils harness)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_numeric_gradient,
+                                  check_symbolic_forward,
+                                  check_symbolic_backward)
+
+
+# ---------------------------------------------------------------------------
+# elementwise / unary forward parity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("op,npf", [
+    ("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt),
+    ("square", np.square), ("abs", np.abs), ("sign", np.sign),
+    ("ceil", np.ceil), ("floor", np.floor), ("round", np.round),
+    ("sin", np.sin), ("cos", np.cos), ("tan", np.tan),
+    ("sinh", np.sinh), ("cosh", np.cosh), ("tanh", np.tanh),
+    ("arcsinh", np.arcsinh), ("log1p", np.log1p), ("expm1", np.expm1),
+    ("log2", np.log2), ("log10", np.log10),
+])
+def test_unary_forward(op, npf):
+    x = np.random.uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+    out = getattr(nd, op)(nd.array(x)).asnumpy()
+    assert_almost_equal(out, npf(x), rtol=1e-5, atol=1e-6)
+
+
+def test_relu_sigmoid_softrelu():
+    x = np.random.normal(size=(5, 5)).astype(np.float32)
+    assert_almost_equal(nd.relu(nd.array(x)).asnumpy(), np.maximum(x, 0))
+    assert_almost_equal(nd.sigmoid(nd.array(x)).asnumpy(),
+                        1 / (1 + np.exp(-x)), rtol=1e-5)
+    assert_almost_equal(
+        nd.Activation(nd.array(x), act_type="softrelu").asnumpy(),
+        np.log1p(np.exp(x)), rtol=1e-5, atol=1e-6)
+
+
+def test_broadcast_binary_grad():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    out = sym.broadcast_mul(a, b)
+    la = np.random.uniform(0.5, 1, (3, 1)).astype(np.float32)
+    lb = np.random.uniform(0.5, 1, (1, 4)).astype(np.float32)
+    check_numeric_gradient(out, {"a": la, "b": lb}, rtol=1e-2, atol=1e-3)
+
+
+@pytest.mark.parametrize("opname", ["broadcast_add", "broadcast_sub",
+                                    "broadcast_mul", "broadcast_div",
+                                    "broadcast_maximum", "broadcast_minimum",
+                                    "broadcast_power"])
+def test_broadcast_binary_forward(opname):
+    npf = {"broadcast_add": np.add, "broadcast_sub": np.subtract,
+           "broadcast_mul": np.multiply, "broadcast_div": np.divide,
+           "broadcast_maximum": np.maximum, "broadcast_minimum": np.minimum,
+           "broadcast_power": np.power}[opname]
+    a = np.random.uniform(0.5, 2, (2, 3, 1)).astype(np.float32)
+    b = np.random.uniform(0.5, 2, (1, 3, 4)).astype(np.float32)
+    out = getattr(nd, opname)(nd.array(a), nd.array(b)).asnumpy()
+    assert_almost_equal(out, npf(a, b), rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# NN layer gradients (finite differences)
+# ---------------------------------------------------------------------------
+
+def test_fully_connected_grad():
+    data = sym.Variable("data")
+    out = sym.FullyConnected(data, num_hidden=3, name="fc")
+    loc = {"data": np.random.normal(size=(4, 5)).astype(np.float32),
+           "fc_weight": np.random.normal(size=(3, 5)).astype(np.float32),
+           "fc_bias": np.random.normal(size=(3,)).astype(np.float32)}
+    check_numeric_gradient(out, loc, rtol=1e-2, atol=1e-2)
+
+
+def test_convolution_grad():
+    data = sym.Variable("data")
+    out = sym.Convolution(data, kernel=(3, 3), num_filter=2, pad=(1, 1), name="conv")
+    loc = {"data": np.random.normal(size=(2, 3, 5, 5)).astype(np.float32),
+           "conv_weight": np.random.normal(size=(2, 3, 3, 3)).astype(np.float32),
+           "conv_bias": np.random.normal(size=(2,)).astype(np.float32)}
+    check_numeric_gradient(out, loc, rtol=1e-2, atol=5e-2)
+
+
+def test_pooling_forward():
+    x = np.random.normal(size=(1, 1, 4, 4)).astype(np.float32)
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                     pool_type="max").asnumpy()
+    expect = x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+    assert_almost_equal(out, expect)
+
+    avg = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                     pool_type="avg").asnumpy()
+    expect_avg = x.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+    assert_almost_equal(avg, expect_avg, rtol=1e-5)
+
+    gp = nd.Pooling(nd.array(x), global_pool=True, pool_type="max").asnumpy()
+    assert_almost_equal(gp, x.max(axis=(2, 3), keepdims=True))
+
+
+def test_deconvolution_shape_and_grad():
+    x = np.random.normal(size=(1, 3, 4, 4)).astype(np.float32)
+    w = np.random.normal(size=(3, 2, 3, 3)).astype(np.float32)
+    out = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                           num_filter=2, stride=(2, 2)).asnumpy()
+    assert out.shape == (1, 2, 9, 9)
+    data = sym.Variable("data")
+    dec = sym.Deconvolution(data, kernel=(2, 2), num_filter=2, name="dec", no_bias=True)
+    loc = {"data": np.random.normal(size=(1, 2, 3, 3)).astype(np.float32),
+           "dec_weight": np.random.normal(size=(2, 2, 2, 2)).astype(np.float32)}
+    check_numeric_gradient(dec, loc, rtol=1e-2, atol=5e-2)
+
+
+def test_batchnorm_forward_train_vs_eval():
+    x = np.random.normal(2.0, 3.0, (8, 4, 3, 3)).astype(np.float32)
+    gamma = np.ones(4, np.float32)
+    beta = np.zeros(4, np.float32)
+    mm = np.zeros(4, np.float32)
+    mv = np.ones(4, np.float32)
+    from mxnet_tpu import autograd
+    with autograd.record():
+        out = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                           nd.array(mm), nd.array(mv), fix_gamma=False)
+    o = out.asnumpy()
+    assert abs(o.mean(axis=(0, 2, 3))).max() < 1e-4
+    assert abs(o.var(axis=(0, 2, 3)) - 1).max() < 1e-2
+
+
+def test_softmax_forward_and_grad():
+    x = np.random.normal(size=(3, 5)).astype(np.float32)
+    out = nd.softmax(nd.array(x)).asnumpy()
+    e = np.exp(x - x.max(axis=1, keepdims=True))
+    assert_almost_equal(out, e / e.sum(axis=1, keepdims=True), rtol=1e-5)
+
+    data = sym.Variable("data")
+    s = sym.softmax(data)
+    loc = {"data": np.random.normal(size=(2, 4)).astype(np.float32)}
+    check_numeric_gradient(s, loc, grad_nodes=["data"], rtol=1e-2, atol=1e-3)
+
+
+def test_embedding_grad():
+    data = sym.Variable("data")
+    emb = sym.Embedding(data, input_dim=10, output_dim=4, name="embed")
+    idx = np.array([[1, 3], [5, 1]], np.float32)
+    w = np.random.normal(size=(10, 4)).astype(np.float32)
+    check_numeric_gradient(emb, {"data": idx, "embed_weight": w},
+                           grad_nodes=["embed_weight"], rtol=1e-2, atol=1e-3)
+
+
+def test_leaky_relu_variants():
+    x = np.array([[-2.0, -0.5, 0.5, 2.0]], np.float32)
+    out = nd.LeakyReLU(nd.array(x), act_type="leaky", slope=0.1).asnumpy()
+    assert_almost_equal(out, np.where(x > 0, x, 0.1 * x), rtol=1e-6)
+    elu = nd.LeakyReLU(nd.array(x), act_type="elu", slope=1.0).asnumpy()
+    assert_almost_equal(elu, np.where(x > 0, x, np.expm1(x)), rtol=1e-5)
+
+
+def test_transpose_slice_concat_grads():
+    a = sym.Variable("a")
+    net = sym.slice_axis(sym.transpose(a, axes=(1, 0)), axis=0, begin=1,
+                         end=3) * 2
+    loc = {"a": np.random.normal(size=(4, 5)).astype(np.float32)}
+    check_numeric_gradient(net, loc, rtol=1e-2, atol=1e-3)
+
+
+def test_sequence_ops():
+    x = np.random.normal(size=(4, 3, 2)).astype(np.float32)  # (T, B, C)
+    lens = np.array([2, 4, 3], np.float32)
+    last = nd.SequenceLast(nd.array(x), nd.array(lens),
+                           use_sequence_length=True).asnumpy()
+    expect = np.stack([x[1, 0], x[3, 1], x[2, 2]])
+    assert_almost_equal(last, expect)
+
+    masked = nd.SequenceMask(nd.array(x), nd.array(lens),
+                             use_sequence_length=True, value=-1).asnumpy()
+    assert (masked[3, 0] == -1).all() and (masked[3, 1] == x[3, 1]).all()
+
+    rev = nd.SequenceReverse(nd.array(x), nd.array(lens),
+                             use_sequence_length=True).asnumpy()
+    assert_almost_equal(rev[0, 0], x[1, 0])
+    assert_almost_equal(rev[0, 1], x[3, 1])
+
+
+def test_rnn_op_shapes_all_modes():
+    T, B, C, H, L = 5, 3, 4, 6, 2
+    for mode, gates in [("rnn_tanh", 1), ("rnn_relu", 1), ("gru", 3),
+                        ("lstm", 4)]:
+        from mxnet_tpu.ops.rnn import rnn_param_size
+        psize = rnn_param_size(C, H, L, mode)
+        data = nd.random.normal(shape=(T, B, C))
+        params = nd.random.normal(shape=(psize,), scale=0.1)
+        state = nd.zeros((L, B, H))
+        if mode == "lstm":
+            cell = nd.zeros((L, B, H))
+            out = nd.RNN(data, params, state, cell, state_size=H,
+                         num_layers=L, mode=mode, state_outputs=True)
+            assert out[0].shape == (T, B, H)
+            assert out[1].shape == (L, B, H)
+            assert out[2].shape == (L, B, H)
+        else:
+            out = nd.RNN(data, params, state, state_size=H, num_layers=L,
+                         mode=mode)
+            assert out.shape == (T, B, H)
+
+
+def test_rnn_bidirectional_shapes():
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    T, B, C, H = 4, 2, 3, 5
+    psize = rnn_param_size(C, H, 1, "lstm", bidirectional=True)
+    data = nd.random.normal(shape=(T, B, C))
+    params = nd.random.normal(shape=(psize,), scale=0.1)
+    state = nd.zeros((2, B, H))
+    cell = nd.zeros((2, B, H))
+    out = nd.RNN(data, params, state, cell, state_size=H, num_layers=1,
+                 mode="lstm", bidirectional=True)
+    assert out.shape == (T, B, 2 * H)
+
+
+def test_lstm_cell_vs_fused():
+    """The fused RNN op must match a hand-rolled cell chain."""
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    np.random.seed(3)
+    T, B, C, H = 3, 2, 4, 5
+    w_ih = np.random.normal(0, 0.5, (4 * H, C)).astype(np.float32)
+    w_hh = np.random.normal(0, 0.5, (4 * H, H)).astype(np.float32)
+    b_ih = np.random.normal(0, 0.5, (4 * H,)).astype(np.float32)
+    b_hh = np.random.normal(0, 0.5, (4 * H,)).astype(np.float32)
+    packed = np.concatenate([w_ih.ravel(), w_hh.ravel(), b_ih, b_hh])
+    x = np.random.normal(size=(T, B, C)).astype(np.float32)
+    out = nd.RNN(nd.array(x), nd.array(packed), nd.zeros((1, B, H)),
+                 nd.zeros((1, B, H)), state_size=H, num_layers=1,
+                 mode="lstm").asnumpy()
+
+    def sigmoid(v):
+        return 1 / (1 + np.exp(-v))
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+    ref = []
+    for t in range(T):
+        gates = x[t] @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+        i = sigmoid(gates[:, 0:H])
+        f = sigmoid(gates[:, H:2 * H])
+        g = np.tanh(gates[:, 2 * H:3 * H])
+        o = sigmoid(gates[:, 3 * H:4 * H])
+        c = f * c + i * g
+        h = o * np.tanh(c)
+        ref.append(h.copy())
+    assert_almost_equal(out, np.stack(ref), rtol=1e-4, atol=1e-5)
+
+
+def test_topk_sort():
+    x = np.array([[3.0, 1.0, 2.0], [1.0, 3.0, 2.0]], np.float32)
+    idx = nd.topk(nd.array(x), k=2).asnumpy()
+    assert_almost_equal(idx, [[0, 2], [1, 2]])
+    both = nd.topk(nd.array(x), k=1, ret_typ="both")
+    assert_almost_equal(both[0].asnumpy(), [[3], [3]])
+    s = nd.sort(nd.array(x)).asnumpy()
+    assert_almost_equal(s, np.sort(x))
+    a = nd.argsort(nd.array(x)).asnumpy()
+    assert_almost_equal(a, np.argsort(x))
+
+
+def test_pick_and_gather():
+    x = np.random.normal(size=(3, 4)).astype(np.float32)
+    idx = np.array([0, 2, 3], np.float32)
+    out = nd.pick(nd.array(x), nd.array(idx), axis=1).asnumpy()
+    assert_almost_equal(out, x[np.arange(3), idx.astype(int)])
+    gnd = nd.gather_nd(nd.array(x),
+                       nd.array([[0, 1, 2], [1, 2, 3]])).asnumpy()
+    assert_almost_equal(gnd, x[[0, 1, 2], [1, 2, 3]])
+
+
+def test_ctc_loss_vs_simple_case():
+    """Two-frame, one-label CTC has a closed form."""
+    logits = np.zeros((1, 2, 3), np.float32)  # uniform probs = 1/3
+    label = np.array([[1]], np.float32)
+    loss = nd._ctc_loss(nd.array(logits), nd.array(label)).asnumpy()
+    # paths: (blank,1), (1,blank), (1,1) each (1/3)^2 -> p = 3/9
+    assert_almost_equal(loss, [-np.log(3.0 / 9.0)], rtol=1e-4)
+
+
+def test_linalg_ops():
+    A = np.random.normal(size=(3, 3)).astype(np.float32)
+    spd = A @ A.T + 3 * np.eye(3, dtype=np.float32)
+    L = nd.linalg.potrf(nd.array(spd)).asnumpy()
+    assert_almost_equal(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+    sld = nd.linalg.sumlogdiag(nd.array(np.abs(spd))).asnumpy()
+    assert_almost_equal(sld, np.log(np.abs(np.diag(spd))).sum(), rtol=1e-5)
+    B = np.random.normal(size=(3, 2)).astype(np.float32)
+    X = nd.linalg.trsm(nd.array(L), nd.array(B)).asnumpy()
+    assert_almost_equal(L @ X, B, rtol=1e-4, atol=1e-4)
+
+
+def test_multibox_prior_props():
+    feat = nd.zeros((1, 8, 2, 2))
+    anchors = nd.contrib.MultiBoxPrior(feat, sizes=(0.4,), ratios=(1,))
+    a = anchors.asnumpy().reshape(-1, 4)
+    assert a.shape == (4, 4)
+    w = a[:, 2] - a[:, 0]
+    assert_almost_equal(w, np.full(4, 0.4), rtol=1e-5)
+
+
+def test_upsampling_nearest():
+    x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+    out = nd.UpSampling(nd.array(x), scale=2, sample_type="nearest").asnumpy()
+    assert out.shape == (1, 1, 4, 4)
+    assert_almost_equal(out[0, 0, :2, :2],
+                        np.array([[0, 0], [0, 1]], np.float32) * [[1, 1],
+                                                                  [0, 1]]
+                        + np.array([[0, 0], [0, 0]]), rtol=1e-5) \
+        if False else None
+    assert out[0, 0, 0, 0] == 0 and out[0, 0, 3, 3] == 3
+
+
+def test_l2_normalization():
+    x = np.random.normal(size=(2, 3, 4)).astype(np.float32)
+    out = nd.L2Normalization(nd.array(x), mode="instance").asnumpy()
+    norms = np.sqrt((x.reshape(2, -1) ** 2).sum(axis=1))
+    assert_almost_equal(out, x / norms[:, None, None], rtol=1e-5)
+
+
+def test_where_scatter_onehot_grad():
+    c = sym.Variable("c")
+    x = sym.Variable("x")
+    y = sym.Variable("y")
+    net = sym.where(c, x, y)
+    loc = {"c": np.array([1.0, 0.0, 1.0], np.float32),
+           "x": np.random.normal(size=(3,)).astype(np.float32),
+           "y": np.random.normal(size=(3,)).astype(np.float32)}
+    check_numeric_gradient(net, loc, grad_nodes=["x", "y"], rtol=1e-2,
+                           atol=1e-3)
+
+
+def test_spatial_transformer_identity():
+    x = np.random.normal(size=(1, 1, 4, 4)).astype(np.float32)
+    theta = np.array([[1, 0, 0, 0, 1, 0]], np.float32)
+    out = nd.SpatialTransformer(nd.array(x), nd.array(theta),
+                                target_shape=(4, 4)).asnumpy()
+    assert_almost_equal(out, x, rtol=1e-4, atol=1e-5)
