@@ -1,0 +1,21 @@
+# Native runtime components (parity: the reference's C++ core build).
+# The compute path is JAX/XLA; these libs cover the host-side runtime the
+# reference implemented natively: RecordIO scan + threaded batch loading.
+
+CXX ?= g++
+CXXFLAGS ?= -O3 -std=c++17 -fPIC -pthread -Wall
+LIB_DIR := mxnet_tpu/_lib
+
+all: $(LIB_DIR)/libmxtpu_io.so
+
+$(LIB_DIR)/libmxtpu_io.so: src/recordio.cc
+	@mkdir -p $(LIB_DIR)
+	$(CXX) $(CXXFLAGS) -shared -o $@ $<
+
+test: all
+	python -m pytest tests/ -q
+
+clean:
+	rm -rf $(LIB_DIR)
+
+.PHONY: all test clean

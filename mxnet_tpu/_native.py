@@ -1,0 +1,108 @@
+"""ctypes bindings for the native runtime library (src/recordio.cc).
+
+Parity note: the reference binds its C++ core through a 159-function C
+API (include/mxnet/c_api.h). Here only the host-runtime pieces that stay
+native (RecordIO scan + threaded batch assembly) cross a C boundary; the
+compute path is JAX/XLA and needs no ABI. Builds with `make`; every
+consumer has a pure-Python fallback, so the library is optional.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+
+def lib():
+    """Load (once) and return the native library, or None."""
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    path = os.path.join(os.path.dirname(__file__), "_lib", "libmxtpu_io.so")
+    if not os.path.exists(path):
+        return None
+    try:
+        L = ctypes.CDLL(path)
+    except OSError:
+        return None
+    L.rio_open.restype = ctypes.c_void_p
+    L.rio_open.argtypes = [ctypes.c_char_p]
+    L.rio_num_records.restype = ctypes.c_long
+    L.rio_num_records.argtypes = [ctypes.c_void_p]
+    L.rio_record_size.restype = ctypes.c_long
+    L.rio_record_size.argtypes = [ctypes.c_void_p, ctypes.c_long]
+    L.rio_record_label.restype = ctypes.c_float
+    L.rio_record_label.argtypes = [ctypes.c_void_p, ctypes.c_long]
+    L.rio_record_copy.argtypes = [ctypes.c_void_p, ctypes.c_long,
+                                  ctypes.POINTER(ctypes.c_uint8)]
+    L.rio_close.argtypes = [ctypes.c_void_p]
+    L.loader_create.restype = ctypes.c_void_p
+    L.loader_create.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+                                ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                                ctypes.c_int, ctypes.c_uint64,
+                                ctypes.c_float,
+                                ctypes.POINTER(ctypes.c_float),
+                                ctypes.POINTER(ctypes.c_float)]
+    L.loader_num_batches.restype = ctypes.c_long
+    L.loader_num_batches.argtypes = [ctypes.c_void_p]
+    L.loader_next.restype = ctypes.c_int
+    L.loader_next.argtypes = [ctypes.c_void_p,
+                              ctypes.POINTER(ctypes.c_float),
+                              ctypes.POINTER(ctypes.c_float)]
+    L.loader_reset.argtypes = [ctypes.c_void_p]
+    L.loader_destroy.argtypes = [ctypes.c_void_p]
+    _LIB = L
+    return _LIB
+
+
+class NativeRecordLoader:
+    """Threaded native batch loader over a RecordIO file."""
+
+    def __init__(self, path, batch_size, data_shape, num_threads=4,
+                 shuffle=False, seed=0, scale=1.0, mean=(0, 0, 0),
+                 std=(1, 1, 1)):
+        L = lib()
+        if L is None:
+            raise RuntimeError("native library not built (run `make`)")
+        self._L = L
+        self._file = L.rio_open(path.encode())
+        if not self._file:
+            raise RuntimeError("cannot open RecordIO file %r" % path)
+        c, h, w = data_shape
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        mean_a = (ctypes.c_float * 3)(*[float(m) for m in mean])
+        std_a = (ctypes.c_float * 3)(*[float(s) for s in std])
+        self._loader = L.loader_create(self._file, batch_size, c, h, w,
+                                       num_threads, int(shuffle), seed,
+                                       float(scale), mean_a, std_a)
+        self.num_batches = L.loader_num_batches(self._loader)
+
+    def next(self):
+        c, h, w = self.data_shape
+        data = np.empty((self.batch_size, c, h, w), np.float32)
+        label = np.empty((self.batch_size,), np.float32)
+        ok = self._L.loader_next(
+            self._loader,
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            label.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if not ok:
+            raise StopIteration
+        return data, label
+
+    def reset(self):
+        self._L.loader_reset(self._loader)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_loader", None):
+                self._L.loader_destroy(self._loader)
+            if getattr(self, "_file", None):
+                self._L.rio_close(self._file)
+        except Exception:
+            pass

@@ -443,18 +443,32 @@ class ImageRecordIter(DataIter):
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
                  shuffle=False, rand_crop=False, rand_mirror=False,
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
-                 std_b=1.0, scale=1.0, preprocess_threads=4, **kwargs):
+                 std_b=1.0, scale=1.0, preprocess_threads=4, seed=0,
+                 **kwargs):
         super().__init__(int(batch_size))
         from . import recordio
         self.data_shape = tuple(int(x) for x in data_shape)
         self.label_width = int(label_width)
-        self.rec = recordio.MXRecordIO(path_imgrec, "r")
         self.shuffle = shuffle
         self.rand_crop = rand_crop
         self.rand_mirror = rand_mirror
         self.mean = np.array([mean_r, mean_g, mean_b], np.float32).reshape(3, 1, 1)
         self.std = np.array([std_r, std_g, std_b], np.float32).reshape(3, 1, 1)
         self.scale = scale
+        # fast path: native threaded loader (src/recordio.cc) when built and
+        # no python-side augmentation is requested
+        self._native = None
+        if not rand_crop and not rand_mirror and self.label_width == 1:
+            try:
+                from ._native import NativeRecordLoader
+                self._native = NativeRecordLoader(
+                    path_imgrec, int(batch_size), self.data_shape,
+                    num_threads=int(preprocess_threads),
+                    shuffle=bool(shuffle), seed=int(seed), scale=scale,
+                    mean=(mean_r, mean_g, mean_b), std=(std_r, std_g, std_b))
+            except Exception:
+                self._native = None
+        self.rec = recordio.MXRecordIO(path_imgrec, "r")
         self._records = []
         while True:
             s = self.rec.read()
@@ -476,8 +490,19 @@ class ImageRecordIter(DataIter):
 
     def reset(self):
         self.cursor = -self.batch_size
+        if self._native is not None:
+            self._native.reset()
         if self.shuffle:
             np.random.shuffle(self._order)
+
+    def next(self):
+        if self._native is not None:
+            try:
+                data, label = self._native.next()
+            except StopIteration:
+                raise
+            return DataBatch([_nd_array(data)], [_nd_array(label)], pad=0)
+        return super().next()
 
     def iter_next(self):
         self.cursor += self.batch_size

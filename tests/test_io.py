@@ -1,0 +1,167 @@
+"""IO tests (parity model: reference tests/python/unittest/test_io.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, recordio
+from mxnet_tpu.io import (NDArrayIter, ResizeIter, PrefetchingIter,
+                          ImageRecordIter, CSVIter)
+
+
+def test_ndarray_iter_basic():
+    data = np.arange(40).reshape(10, 4).astype(np.float32)
+    label = np.arange(10).astype(np.float32)
+    it = NDArrayIter(data, label, batch_size=4)
+    batches = list(it)
+    assert len(batches) == 3  # 10/4 padded
+    assert batches[0].data[0].shape == (4, 4)
+    assert batches[2].pad == 2
+    it.reset()
+    first = next(iter(it))
+    np.testing.assert_allclose(first.data[0].asnumpy(), data[:4])
+
+
+def test_ndarray_iter_discard_and_shuffle():
+    data = np.arange(20).reshape(10, 2).astype(np.float32)
+    it = NDArrayIter(data, np.zeros(10), batch_size=3,
+                     last_batch_handle="discard")
+    assert len(list(it)) == 3
+    it2 = NDArrayIter(data, np.arange(10), batch_size=5, shuffle=True)
+    b = next(iter(it2))
+    # shuffled but data/label stay aligned
+    d = b.data[0].asnumpy()
+    lbl = b.label[0].asnumpy()
+    np.testing.assert_allclose(d[:, 0] // 2, lbl)
+
+
+def test_ndarray_iter_dict_input():
+    it = NDArrayIter({"a": np.zeros((6, 2)), "b": np.ones((6, 3))},
+                     batch_size=2)
+    assert sorted(d.name for d in it.provide_data) == ["a", "b"]
+
+
+def test_resize_iter():
+    it = NDArrayIter(np.zeros((10, 2)), np.zeros(10), batch_size=2)
+    r = ResizeIter(it, 8)
+    assert len(list(r)) == 8
+
+
+def test_prefetching_iter():
+    it = NDArrayIter(np.arange(24).reshape(12, 2).astype(np.float32),
+                     np.zeros(12), batch_size=4)
+    p = PrefetchingIter(it)
+    batches = list(p)
+    assert len(batches) == 3
+    p.reset()
+    batches2 = list(p)
+    assert len(batches2) == 3
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(path, "w")
+    payloads = [b"hello", b"world!!", b"x" * 100]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    got = []
+    while True:
+        s = r.read()
+        if s is None:
+            break
+        got.append(bytes(s))
+    assert got == payloads
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "t.rec")
+    idx_path = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idx_path, path, "w")
+    for i in range(5):
+        w.write_idx(i, b"rec%d" % i)
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx_path, path, "r")
+    assert bytes(r.read_idx(3)) == b"rec3"
+    assert bytes(r.read_idx(0)) == b"rec0"
+
+
+def test_pack_unpack():
+    hdr = recordio.IRHeader(0, 2.5, 7, 0)
+    s = recordio.pack(hdr, b"payload")
+    h2, payload = recordio.unpack(s)
+    assert h2.label == 2.5 and h2.id == 7
+    assert bytes(payload) == b"payload"
+
+
+def _write_image_rec(path, n=8, shape=(3, 8, 8)):
+    w = recordio.MXRecordIO(path, "w")
+    imgs = []
+    for i in range(n):
+        img = np.random.randint(0, 255, shape, dtype=np.uint8)
+        imgs.append(img)
+        w.write(recordio.pack(recordio.IRHeader(0, float(i % 4), i, 0),
+                              img.tobytes()))
+    w.close()
+    return imgs
+
+
+def test_image_record_iter(tmp_path):
+    path = str(tmp_path / "imgs.rec")
+    imgs = _write_image_rec(path)
+    it = ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8), batch_size=4)
+    batch = it.next()
+    assert batch.data[0].shape == (4, 3, 8, 8)
+    np.testing.assert_allclose(batch.data[0].asnumpy()[0],
+                               imgs[0].astype(np.float32))
+    np.testing.assert_allclose(batch.label[0].asnumpy(), [0, 1, 2, 3])
+
+
+def test_image_record_iter_native_normalisation(tmp_path):
+    path = str(tmp_path / "imgs.rec")
+    imgs = _write_image_rec(path, n=4)
+    it = ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8), batch_size=2,
+                         mean_r=10.0, mean_g=20.0, mean_b=30.0, std_r=2.0,
+                         std_g=2.0, std_b=2.0)
+    batch = it.next()
+    expect = (imgs[0].astype(np.float32)
+              - np.array([10, 20, 30], np.float32).reshape(3, 1, 1)) / 2.0
+    np.testing.assert_allclose(batch.data[0].asnumpy()[0], expect, rtol=1e-5)
+
+
+def test_csv_iter(tmp_path):
+    data_csv = str(tmp_path / "d.csv")
+    label_csv = str(tmp_path / "l.csv")
+    data = np.random.uniform(size=(10, 3)).astype(np.float32)
+    labels = np.arange(10).astype(np.float32)
+    np.savetxt(data_csv, data, delimiter=",")
+    np.savetxt(label_csv, labels, delimiter=",")
+    it = CSVIter(data_csv=data_csv, data_shape=(3,), label_csv=label_csv,
+                 batch_size=5)
+    b = next(iter(it))
+    np.testing.assert_allclose(b.data[0].asnumpy(), data[:5], rtol=1e-5)
+    np.testing.assert_allclose(b.label[0].asnumpy(), labels[:5])
+
+
+def test_mnist_iter_from_idx_files(tmp_path):
+    """Write idx-format files and read them back (MNISTIter parity)."""
+    import gzip
+    import struct
+    img_path = str(tmp_path / "train-images-idx3-ubyte")
+    lbl_path = str(tmp_path / "train-labels-idx1-ubyte")
+    imgs = np.random.randint(0, 255, (20, 28, 28), dtype=np.uint8)
+    lbls = np.random.randint(0, 10, 20).astype(np.uint8)
+    with open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 20, 28, 28))
+        f.write(imgs.tobytes())
+    with open(lbl_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, 20))
+        f.write(lbls.tobytes())
+    from mxnet_tpu.io import MNISTIter
+    it = MNISTIter(image=img_path, label=lbl_path, batch_size=5,
+                   shuffle=False)
+    b = next(iter(it))
+    assert b.data[0].shape == (5, 1, 28, 28)
+    np.testing.assert_allclose(b.label[0].asnumpy(), lbls[:5])
