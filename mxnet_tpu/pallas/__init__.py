@@ -1,0 +1,11 @@
+"""Hand-written Pallas TPU kernels for the hot ops.
+
+The compute path of this framework is XLA; these kernels cover the spots
+where XLA's automatic fusion is not enough (blockwise attention with an
+online-softmax accumulator, quantised communication payloads). Every
+kernel has an ``interpret`` fallback so the suite runs on the virtual CPU
+mesh (tests/conftest.py) and compiles natively on TPU.
+"""
+from .flash_attention import flash_attention, flash_attention_carry
+
+__all__ = ["flash_attention", "flash_attention_carry"]

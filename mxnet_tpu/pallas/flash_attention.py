@@ -1,0 +1,263 @@
+"""Blockwise (flash) attention as a Pallas TPU kernel.
+
+New-framework extension beyond the 2017 reference (which predates
+attention, SURVEY.md §5.7); this is the single-chip building block that
+``parallel.ring_attention`` composes over the 'sp' mesh axis.
+
+Design (TPU-first):
+- grid over (batch*heads, q-blocks); each program owns a ``block_q``-row
+  Q tile in VMEM and the device's whole local K/V block (VMEM-resident —
+  ring attention keeps per-device K/V small, so one MXU matmul per tile
+  beats a DMA'd kv-chunk loop).
+- online softmax: running max ``m`` and denominator ``l`` per Q row, so
+  the kernel can be chained across ring steps: ``flash_attention_carry``
+  takes and returns the (o, m, l) accumulator, exactly the carry that
+  rotates with ``ppermute``.
+- causal masking by *global* positions (``q_offset``/``kv_offset``): the
+  same kernel serves both the single-chip and the sequence-sharded case.
+- ``interpret=True`` off-TPU so the unit suite runs on the CPU mesh.
+
+Backward for the plain entry is a custom VJP: recompute probabilities
+from the saved log-sum-exp one Q block at a time (lax.map), so peak
+memory stays O(block_q * S) instead of O(S^2) — the flash backward
+formulation, expressed in XLA.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention", "flash_attention_carry"]
+
+DEFAULT_BLOCK_Q = 128
+NEG_INF = -1e30
+
+
+def _use_interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _attn_kernel(scalars_ref, q_ref, k_ref, v_ref, o_in_ref, m_in_ref,
+                 l_in_ref, o_ref, m_ref, l_ref, *, causal, scale, block_q):
+    """One (bh, q-block) program: merge this K/V block into the online
+    accumulator. scalars = [q_offset, kv_offset, kv_len]."""
+    q_off = scalars_ref[0]
+    kv_off = scalars_ref[1]
+    kv_len = scalars_ref[2]
+
+    q = q_ref[0]                       # (block_q, D)
+    k = k_ref[0]                       # (S_kv, D)
+    v = v_ref[0]
+    s_kv = k.shape[0]
+
+    scores = jax.lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale     # (block_q, S_kv)
+
+    qi = pl.program_id(1)
+    q_pos = q_off + qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, s_kv), 0)
+    k_pos = kv_off + jax.lax.broadcasted_iota(jnp.int32, (block_q, s_kv), 1)
+    mask = k_pos < kv_len
+    if causal:
+        mask = jnp.logical_and(mask, q_pos >= k_pos)
+    scores = jnp.where(mask, scores, NEG_INF)
+
+    m_in = m_in_ref[0]                 # (block_q, 1)
+    l_in = l_in_ref[0]
+    o_in = o_in_ref[0]                 # (block_q, D)
+
+    blk_max = jnp.max(scores, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_in, blk_max)
+    corr = jnp.exp(m_in - m_new)
+    p = jnp.exp(scores - m_new)
+    p = jnp.where(mask, p, 0.0)
+    l_new = l_in * corr + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    o_new = o_in * corr + pv
+
+    o_ref[0] = o_new
+    m_ref[0] = m_new
+    l_ref[0] = l_new
+
+
+def _carry_call(q, k, v, o, m, l, q_offset, kv_offset, kv_len, causal,
+                scale, block_q, interpret):
+    """Raw pallas_call on padded (BH, S, D) tensors. Accumulators are
+    float32 (BH, Sq[, D])."""
+    bh, s_q, d = q.shape
+    n_q = s_q // block_q
+    # accumulator stats ride as (BH, Sq, 1): unit lane dim keeps the
+    # block shapes legal for Mosaic tiling
+    m3 = m[..., None]
+    l3 = l[..., None]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, *_: (b, i, 0)),
+            pl.BlockSpec((1, k.shape[1], d), lambda b, i, *_: (b, 0, 0)),
+            pl.BlockSpec((1, k.shape[1], d), lambda b, i, *_: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, *_: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, *_: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, *_: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, *_: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, *_: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, *_: (b, i, 0)),
+        ],
+    )
+    scalars = jnp.asarray([q_offset, kv_offset, kv_len], jnp.int32)
+    kernel = functools.partial(_attn_kernel, causal=causal, scale=scale,
+                               block_q=block_q)
+    s_kv = k.shape[1]
+    flops = 4 * bh * s_q * s_kv * d
+    o2, m2, l2 = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_q, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s_q, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s_q, 1), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=flops,
+            bytes_accessed=4 * (q.size + k.size + v.size + o.size),
+            transcendentals=bh * s_q * s_kv),
+        interpret=interpret,
+    )(scalars, q, k, v, o, m3, l3)
+    return o2, m2[..., 0], l2[..., 0]
+
+
+def _pad_q(x, block_q):
+    s = x.shape[1]
+    pad = (-s) % block_q
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    return x, s
+
+
+def flash_attention_carry(q, k, v, o, m, l, q_offset=0, kv_offset=0,
+                          causal=False, scale=None,
+                          block_q=DEFAULT_BLOCK_Q, interpret=None):
+    """Merge one K/V block into an online-softmax accumulator.
+
+    q: (BH, Sq, D); k/v: (BH, Skv, D); o: (BH, Sq, D) f32 numerator;
+    m/l: (BH, Sq) f32 running max / denominator. Returns updated
+    (o, m, l) — the caller normalises ``o / l`` after the last block.
+    """
+    if interpret is None:
+        interpret = _use_interpret()
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    block_q = min(block_q, max(q.shape[1], 1))
+    qp, s_q = _pad_q(q, block_q)
+    pad = qp.shape[1] - s_q
+    if pad:
+        o = jnp.pad(o, ((0, 0), (0, pad), (0, 0)))
+        m = jnp.pad(m, ((0, 0), (0, pad)), constant_values=NEG_INF)
+        l = jnp.pad(l, ((0, 0), (0, pad)))
+    o2, m2, l2 = _carry_call(qp, k, v, o, m, l, q_offset, kv_offset,
+                             kv_offset + k.shape[1], causal, scale,
+                             block_q, interpret)
+    if pad:
+        o2, m2, l2 = o2[:, :s_q], m2[:, :s_q], l2[:, :s_q]
+    return o2, m2, l2
+
+
+def _forward(q, k, v, causal, scale, block_q, interpret):
+    """(B, H, S, D) -> (out, lse). Single chip, whole sequence."""
+    b, h, s_q, d = q.shape
+    s_kv = k.shape[2]
+    qf = q.reshape(b * h, s_q, d)
+    kf = k.reshape(b * h, s_kv, d)
+    vf = v.reshape(b * h, s_kv, d)
+    o0 = jnp.zeros((b * h, s_q, d), jnp.float32)
+    m0 = jnp.full((b * h, s_q), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b * h, s_q), jnp.float32)
+    o, m, l = flash_attention_carry(qf, kf, vf, o0, m0, l0, 0, 0, causal,
+                                    scale, block_q, interpret)
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    return out.astype(q.dtype).reshape(b, h, s_q, d), lse.reshape(b, h, s_q)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=False, scale=None,
+                    block_q=DEFAULT_BLOCK_Q, interpret=None):
+    """Exact attention, (B, H, S, D) layout, O(block_q * S) memory.
+
+    Differentiable; the forward runs as a Pallas kernel on TPU (interpret
+    mode elsewhere), the backward recomputes probabilities blockwise from
+    the saved log-sum-exp.
+    """
+    out, _ = _forward(q, k, v, causal, scale if scale is not None
+                      else 1.0 / math.sqrt(q.shape[-1]), block_q, interpret)
+    return out
+
+
+def _fwd(q, k, v, causal, scale, block_q, interpret):
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    out, lse = _forward(q, k, v, causal, scale, block_q, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _bwd(causal, scale, block_q, interpret, res, g):
+    q, k, v, out, lse = res
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    b, h, s_q, d = q.shape
+    s_kv = k.shape[2]
+    block = min(block_q, s_q)
+    pad = (-s_q) % block
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    gp = jnp.pad(g, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    op = jnp.pad(out, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    # padded q rows get a large POSITIVE lse so p = exp(s - lse) -> 0
+    # (NEG_INF here would give exp(+inf) -> NaN folded into dk/dv)
+    lsep = jnp.pad(lse, ((0, 0), (0, 0), (0, pad)), constant_values=-NEG_INF)
+    n_blk = qp.shape[2] // block
+
+    # delta_i = rowsum(dO * O)
+    delta = jnp.sum(gp.astype(jnp.float32) * op.astype(jnp.float32), -1)
+
+    k_pos = jnp.arange(s_kv)
+
+    def blk(i):
+        def sl(x, ax=2):
+            return lax.dynamic_slice_in_dim(x, i * block, block, axis=ax)
+        qb, gb = sl(qp), sl(gp)
+        lb = sl(lsep)
+        db = sl(delta)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qb, k,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = i * block + jnp.arange(block)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lb[..., None])                  # (b,h,block,S)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gb, v,
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - db[..., None]) * scale
+        dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k)
+        dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qb)
+        dv = jnp.einsum("bhqk,bhqd->bhkd", p, gb)
+        return dq, dk, dv
+
+    dqs, dks, dvs = lax.map(blk, jnp.arange(n_blk))
+    dq = jnp.moveaxis(dqs, 0, 2).reshape(b, h, n_blk * block, d)[:, :, :s_q]
+    dk = jnp.sum(dks, axis=0)
+    dv = jnp.sum(dvs, axis=0)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention.defvjp(_fwd, _bwd)

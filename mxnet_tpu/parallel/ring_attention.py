@@ -47,7 +47,7 @@ def attention(q, k, v, causal=False, scale=None, q_offset=0, kv_offset=0):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
-def _ring_attention_local(q, k, v, axis_name, causal, scale):
+def _ring_attention_local(q, k, v, axis_name, causal, scale, use_pallas):
     """Per-device body under shard_map: q/k/v are the local sequence blocks
     (B, H, S_local, D)."""
     n = lax.psum(1, axis_name)
@@ -57,6 +57,9 @@ def _ring_attention_local(q, k, v, axis_name, causal, scale):
     q_pos = my * S + jnp.arange(S)                      # global q positions
 
     perm = [(i, (i + 1) % n) for i in range(n)]
+
+    if use_pallas:
+        return _ring_flash_local(q, k, v, axis_name, causal, scale)
 
     def step(t, carry):
         o, m, l, k_blk, v_blk = carry
@@ -86,23 +89,126 @@ def _ring_attention_local(q, k, v, axis_name, causal, scale):
     return o / jnp.maximum(l, 1e-30)[..., None]
 
 
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale):
+    """Pallas-kernel ring forward. Returns (out, lse) with lse (BH, S) —
+    the residual the ring backward needs."""
+    from ..pallas import flash_attention_carry
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    B, H, S, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    qf = q.reshape(B * H, S, D)
+
+    def step(t, carry):
+        o, m, l, k_blk, v_blk = carry
+        src = (my - t) % n                              # owner of this block
+        o, m, l = flash_attention_carry(
+            qf, k_blk.reshape(B * H, S, D), v_blk.reshape(B * H, S, D),
+            o, m, l, q_offset=my * S, kv_offset=src * S,
+            causal=causal, scale=scale)
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return (o, m, l, k_next, v_next)
+
+    o0 = jnp.zeros((B * H, S, D), jnp.float32)
+    m0 = jnp.full((B * H, S), -1e30, jnp.float32)
+    l0 = jnp.zeros((B * H, S), jnp.float32)
+    o, m, l, _, _ = lax.fori_loop(0, n, step, (o0, m0, l0, k, v))
+    l = jnp.maximum(l, 1e-30)
+    out = (o / l[..., None]).astype(q.dtype).reshape(B, H, S, D)
+    lse = m + jnp.log(l)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash_local(q, k, v, axis_name, causal, scale):
+    out, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale):
+    out, lse = _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, scale, res, g):
+    """Ring backward: K/V rotate again, and the dK/dV accumulators travel
+    WITH their blocks so each returns home after n hops carrying every
+    device's contribution."""
+    q, k, v, out, lse = res
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    B, H, S, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    BH = B * H
+    qf = q.reshape(BH, S, D).astype(jnp.float32)
+    gf = g.reshape(BH, S, D).astype(jnp.float32)
+    of = out.reshape(BH, S, D).astype(jnp.float32)
+    delta = jnp.sum(gf * of, axis=-1)                   # (BH, S)
+    q_pos = my * S + jnp.arange(S)
+
+    def step(t, carry):
+        dq, dk, dv, k_blk, v_blk = carry
+        src = (my - t) % n
+        kf = k_blk.reshape(BH, S, D).astype(jnp.float32)
+        vf = v_blk.reshape(BH, S, D).astype(jnp.float32)
+        k_pos = src * S + jnp.arange(S)
+        s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask, s, -1e30)
+        p = jnp.exp(s - lse[..., None])                 # (BH, S, S)
+        dp = jnp.einsum("bqd,bkd->bqk", gf, vf)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bqk,bkd->bqd", ds, kf)
+        dk_c = jnp.einsum("bqk,bqd->bkd", ds, qf).reshape(B, H, S, D)
+        dv_c = jnp.einsum("bqk,bqd->bkd", p, gf).reshape(B, H, S, D)
+        dk, dv = dk + dk_c, dv + dv_c
+        # rotate block + its accumulated grad together
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        dk = lax.ppermute(dk, axis_name, perm)
+        dv = lax.ppermute(dv, axis_name, perm)
+        return (dq, dk, dv, k_blk, v_blk)
+
+    dq0 = jnp.zeros((BH, S, D), jnp.float32)
+    z0 = jnp.zeros((B, H, S, D), jnp.float32)
+    dq, dk, dv, _, _ = lax.fori_loop(0, n, step, (dq0, z0, z0, k, v))
+    return (dq.reshape(B, H, S, D).astype(q.dtype), dk.astype(k.dtype),
+            dv.astype(v.dtype))
+
+
+_ring_flash_local.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
 def ring_attention(q, k, v, mesh, axis_name="sp", batch_axis_name=None,
-                   causal=False, scale=None):
+                   causal=False, scale=None, use_pallas=None):
     """Sequence-parallel attention: q/k/v (B, H, S, D) sharded along S over
     ``axis_name`` (and optionally along B over ``batch_axis_name``).
     Returns the attention output with the same sharding.
 
     Accepts NDArrays or jax arrays; runs under shard_map on ``mesh``.
+    ``use_pallas`` selects the Pallas flash kernel for the local block
+    compute (default: on real TPU backends only — interpret mode inside a
+    shard_map loop is needlessly slow on the CPU test mesh).
     """
     from ..ndarray.ndarray import NDArray, _wrap
     wrap_out = isinstance(q, NDArray)
     raw = [x._data if isinstance(x, NDArray) else x for x in (q, k, v)]
 
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+
     spec = P(batch_axis_name, None, axis_name, None)
 
     fn = jax.shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name,
-                          causal=causal, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+                          causal=causal, scale=scale, use_pallas=use_pallas),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        # pallas_call outputs carry no varying-mesh-axes annotation
+        check_vma=not use_pallas)
     out = fn(*raw)
     return _wrap(out) if wrap_out else out
