@@ -42,7 +42,10 @@ from . import metric                 # noqa: E402
 from . import io                     # noqa: E402
 from . import recordio               # noqa: E402
 from . import kvstore                # noqa: E402
+from . import kvstore as kv          # noqa: E402  (reference: mx.kv)
 from .kvstore import KVStore         # noqa: E402
+from . import gradient_compression  # noqa: E402
+from . import predictor              # noqa: E402
 from . import callback               # noqa: E402
 from . import model                  # noqa: E402
 from . import module                 # noqa: E402

@@ -1,0 +1,111 @@
+"""2-bit gradient compression with error feedback.
+
+Parity: reference ``src/kvstore/gradient_compression.{h,cc,cu}`` —
+threshold quantisation (values >= +thr -> +thr, <= -thr -> -thr, else 0)
+with the quantisation error kept in a per-key residual that is added to
+the next gradient, so the signal is preserved over steps.
+
+TPU-native design: the codes pack 16-per-uint32 with vectorised shift/or
+(XLA fuses the whole quantise+pack into one elementwise kernel — a
+hand-written Pallas pass adds nothing for a bandwidth-bound op). The
+compressed payload is what crosses the slow link: `compressed_psum`
+quantises per device, all-gathers the 16x-smaller packed words over the
+mesh axis, and dequantise-sums locally — the SPMD analogue of the
+reference's worker-quantise -> server-dequantise-aggregate path
+(``kvstore_dist_server.h:173`` kCompressedPushPull).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["GradientCompression", "quantize_2bit", "dequantize_2bit",
+           "compressed_psum"]
+
+_CODES_PER_WORD = 16  # 2 bits each in a uint32
+
+
+def _num_words(size):
+    return -(-size // _CODES_PER_WORD)
+
+
+def quantize_2bit(grad, residual, threshold=0.5):
+    """Quantise ``grad + residual`` to 2-bit codes.
+
+    Returns ``(packed, new_residual)`` where packed is uint32 of
+    ``ceil(size/16)`` words and new_residual has grad's shape/dtype.
+    Code values: 0 -> 0.0, 1 -> +threshold, 2 -> -threshold (reference
+    gradient_compression.cc quantize_2bit semantics).
+    """
+    g = grad.astype(jnp.float32) + residual.astype(jnp.float32)
+    q = jnp.where(g >= threshold, threshold,
+                  jnp.where(g <= -threshold, -threshold, 0.0))
+    new_residual = (g - q).astype(grad.dtype)
+    codes = jnp.where(g >= threshold, 1, jnp.where(g <= -threshold, 2, 0))
+    flat = codes.reshape(-1).astype(jnp.uint32)
+    size = flat.shape[0]
+    pad = (-size) % _CODES_PER_WORD
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    words = flat.reshape(-1, _CODES_PER_WORD)
+    shifts = (2 * jnp.arange(_CODES_PER_WORD, dtype=jnp.uint32))[None, :]
+    # disjoint bit positions: sum == bitwise-or, and jnp has no ufunc.reduce
+    packed = jnp.sum(words << shifts, axis=1, dtype=jnp.uint32)
+    return packed, new_residual
+
+
+def dequantize_2bit(packed, shape, threshold=0.5, dtype=jnp.float32):
+    """Inverse of :func:`quantize_2bit`."""
+    size = int(np.prod(shape))
+    shifts = (2 * jnp.arange(_CODES_PER_WORD, dtype=jnp.uint32))[None, :]
+    codes = (packed[:, None] >> shifts) & jnp.uint32(3)
+    flat = codes.reshape(-1)[:size]
+    vals = jnp.where(flat == 1, threshold,
+                     jnp.where(flat == 2, -threshold, 0.0))
+    return vals.reshape(shape).astype(dtype)
+
+
+class GradientCompression:
+    """Per-key stateful compressor (parity: reference
+    ``GradientCompression`` + python ``set_gradient_compression`` kwargs).
+    """
+
+    def __init__(self, type="2bit", threshold=0.5):
+        if type != "2bit":
+            raise MXNetError("unsupported compression type %r" % (type,))
+        if not threshold > 0:
+            raise MXNetError("threshold must be positive")
+        self.type = type
+        self.threshold = float(threshold)
+        self._residuals = {}
+
+    def compress(self, key, grad):
+        """Quantise one gradient (jax array), tracking the residual under
+        ``key`` (per device-shard keys: pass (name, shard_idx))."""
+        res = self._residuals.get(key)
+        if res is None:
+            res = jnp.zeros(grad.shape, grad.dtype)
+        packed, res = quantize_2bit(grad, res, self.threshold)
+        self._residuals[key] = res
+        return packed
+
+    def decompress(self, packed, shape, dtype=jnp.float32):
+        return dequantize_2bit(packed, shape, self.threshold, dtype)
+
+
+def compressed_psum(x, axis_name, compressor_state, threshold=0.5):
+    """All-reduce with a 2-bit payload inside shard_map.
+
+    ``compressor_state`` is the residual (same shape as x) carried by the
+    caller across steps. Returns ``(summed, new_residual)``. The packed
+    words (16x smaller than f32) are what travels over the mesh axis.
+    """
+    packed, new_res = quantize_2bit(x, compressor_state, threshold)
+    gathered = jax.lax.all_gather(packed, axis_name, axis=0)  # (n, words)
+    n = gathered.shape[0]
+    deq = jax.vmap(lambda p: dequantize_2bit(p, x.shape, threshold,
+                                             jnp.float32))(gathered)
+    return jnp.sum(deq, axis=0).astype(x.dtype), new_res

@@ -21,7 +21,7 @@ from ..context import current_context
 from .ndarray import NDArray, _wrap, array as _dense_array
 
 __all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
-           "zeros", "cast_storage", "dot"]
+           "zeros", "cast_storage", "dot", "add_n", "elemwise_add"]
 
 
 class BaseSparseNDArray(NDArray):
@@ -180,6 +180,32 @@ def cast_storage(arr, stype):
                           np.asarray(indices, np.int64),
                           np.asarray(indptr, np.int64), dense.shape, arr.context)
     raise MXNetError("unknown stype %r" % stype)
+
+
+def add_n(arrays):
+    """Sum row_sparse arrays without densifying (parity: reference
+    ElementwiseSum's row_sparse path, ndarray.cc:575): index-space union
+    on host (indices are tiny), one XLA segment-sum over the stacked
+    values — the aggregation kvstore uses for sparse gradient pushes."""
+    import jax
+    if not arrays:
+        raise MXNetError("add_n: empty list")
+    if not all(isinstance(a, RowSparseNDArray) for a in arrays):
+        raise MXNetError("add_n: all inputs must be row_sparse")
+    shape = arrays[0].shape
+    idx_list = [np.asarray(a._rsp_indices, np.int64) for a in arrays]
+    uniq, inv = np.unique(np.concatenate(idx_list), return_inverse=True)
+    data = jnp.concatenate([a._rsp_data for a in arrays], axis=0)
+    summed = jax.ops.segment_sum(data, jnp.asarray(inv),
+                                 num_segments=len(uniq))
+    return RowSparseNDArray(summed, jnp.asarray(uniq), shape,
+                            arrays[0].context)
+
+
+def elemwise_add(lhs, rhs):
+    """row_sparse + row_sparse -> row_sparse (reference
+    elemwise_binary_op_basic.cc sparse path)."""
+    return add_n([lhs, rhs])
 
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):

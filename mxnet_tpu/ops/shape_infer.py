@@ -85,7 +85,24 @@ def _upsampling(shapes, params):
     return {}
 
 
+def _softmax_output(shapes, params):
+    """Label shape from data (reference softmax_output-inl.h InferShape):
+    (N,) default, (N, d2, ...) with multi_output."""
+    data = shapes[0]
+    if params.get("multi_output", False):
+        return {1: (data[0],) + tuple(data[2:])}
+    return {1: (data[0],)}
+
+
+def _regression_output(shapes, params):
+    return {1: tuple(shapes[0])}
+
+
 def install():
+    get_op("SoftmaxOutput").param_shape_infer = _softmax_output
+    get_op("LinearRegressionOutput").param_shape_infer = _regression_output
+    get_op("MAERegressionOutput").param_shape_infer = _regression_output
+    get_op("LogisticRegressionOutput").param_shape_infer = _regression_output
     get_op("FullyConnected").param_shape_infer = _fc
     get_op("Convolution").param_shape_infer = _conv
     get_op("Deconvolution").param_shape_infer = _deconv

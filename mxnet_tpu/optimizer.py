@@ -133,6 +133,21 @@ class Optimizer:
             kw["clip_gradient"] = self.clip_gradient
         return kw
 
+    # -- sparse (lazy) update plumbing --------------------------------------
+    def _sparse_rows(self, grad):
+        """(rows, rows_grad) for a row_sparse gradient, with rescale/clip
+        applied (reference optimizer_op.cc row_sparse kernels)."""
+        import jax.numpy as jnp
+        g = grad._rsp_data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return grad._rsp_indices.astype(jnp.int32), g
+
+    @staticmethod
+    def _is_row_sparse(grad):
+        from .ndarray.sparse import RowSparseNDArray
+        return isinstance(grad, RowSparseNDArray)
+
 
 @register
 class SGD(Optimizer):
@@ -157,6 +172,9 @@ class SGD(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
+        if self._is_row_sparse(grad):
+            self._update_sparse(weight, grad, state, lr, wd)
+            return
         kw = self._common_kwargs()
         if isinstance(state, tuple):  # multi-precision
             mom, w32 = state
@@ -172,6 +190,30 @@ class SGD(Optimizer):
             sgd_update(weight, grad, lr=lr, wd=wd, **kw)
 
     update_multi_precision = update
+
+    def _update_sparse(self, weight, grad, state, lr, wd):
+        """Lazy SGD: only rows present in the gradient are touched
+        (reference optimizer_op.cc SGDUpdateRspRspImpl — momentum decay
+        is also lazy, matching the reference's row_sparse-state kernel).
+        Multi-precision state (mom, w32) updates the fp32 master rows and
+        casts back (reference MP_SGD row_sparse kernels)."""
+        rows, g = self._sparse_rows(grad)
+        master = weight
+        if isinstance(state, tuple):                    # multi-precision
+            state, master = state
+        w = master._data
+        wr = w.take(rows, axis=0)
+        g = g.astype(w.dtype) + wd * wr
+        if state is not None:
+            mom = state._data
+            m_new = self.momentum * mom.take(rows, axis=0) - lr * g
+            state._set_data(mom.at[rows].set(m_new))
+            w_new = w.at[rows].add(m_new)
+        else:
+            w_new = w.at[rows].add(-lr * g)
+        master._set_data(w_new)
+        if master is not weight:
+            weight._set_data(w_new.astype(weight._data.dtype))
 
 
 @register
@@ -269,6 +311,22 @@ class Adam(Optimizer):
         coef2 = 1.0 - self.beta2 ** t
         lr = lr * math.sqrt(coef2) / coef1
         mean, var = state
+        if self._is_row_sparse(grad):
+            # lazy Adam (reference AdamUpdateRspRspImpl): only gradient
+            # rows advance their moments
+            rows, g = self._sparse_rows(grad)
+            w, m, v = weight._data, mean._data, var._data
+            wr = w.take(rows, axis=0)
+            g = g + wd * wr
+            m_new = self.beta1 * m.take(rows, axis=0) + (1 - self.beta1) * g
+            v_new = self.beta2 * v.take(rows, axis=0) + \
+                (1 - self.beta2) * g * g
+            import jax.numpy as jnp
+            step = lr * m_new / (jnp.sqrt(v_new) + self.epsilon)
+            mean._set_data(m.at[rows].set(m_new))
+            var._set_data(v.at[rows].set(v_new))
+            weight._set_data(w.at[rows].add(-step))
+            return
         adam_update(weight, grad, mean, var, lr=lr, wd=wd, beta1=self.beta1,
                     beta2=self.beta2, epsilon=self.epsilon,
                     **self._common_kwargs())
@@ -288,6 +346,18 @@ class AdaGrad(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
+        if self._is_row_sparse(grad):
+            # lazy AdaGrad (reference AdagradUpdateRspRspImpl)
+            import jax.numpy as jnp
+            rows, g = self._sparse_rows(grad)
+            w, h = weight._data, state._data
+            wr = w.take(rows, axis=0)
+            h_new = h.take(rows, axis=0) + g * g
+            state._set_data(h.at[rows].set(h_new))
+            step = lr * (g / jnp.sqrt(h_new + self.float_stable_eps)
+                         + wd * wr)
+            weight._set_data(w.at[rows].add(-step))
+            return
         grad = grad * self.rescale_grad
         if self.clip_gradient is not None:
             grad = _nd._invoke("clip", [grad], {"a_min": -self.clip_gradient,

@@ -1,0 +1,160 @@
+"""Standalone inference predictor.
+
+Parity: reference ``src/c_api/c_predict_api.cc`` / ``include/mxnet/
+c_predict_api.h`` — the minimal deployment path (load symbol JSON +
+param blob, bind a forward-only executor, feed inputs, fetch outputs)
+used by the amalgamation mobile builds and
+``example/image-classification/predict-cpp``.
+
+TPU-native design: "bind" compiles the whole inference graph to one XLA
+executable via jit (the reference's static no-grad executor ≙ a jitted
+pure function with weights closed over as constants on device); repeated
+``forward`` calls hit the compile cache as long as input shapes hold.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from . import symbol as sym
+from . import ndarray as nd
+from .executor import Executor  # noqa: F401  (bind path)
+
+__all__ = ["Predictor", "create"]
+
+
+class Predictor:
+    """(parity: MXPredCreate/MXPredForward/MXPredGetOutput)
+
+    Parameters
+    ----------
+    symbol_json : str — graph JSON text (or a Symbol)
+    param_bytes : bytes | str | dict — ``.params`` blob path/bytes as
+        written by ``model.save_checkpoint`` (arg:/aux: prefixed), or a
+        plain {name: NDArray} dict
+    input_shapes : dict of name -> shape
+    """
+
+    def __init__(self, symbol_json, param_bytes, input_shapes, ctx=None,
+                 dtype=None):
+        if isinstance(symbol_json, sym.Symbol):
+            self._symbol = symbol_json
+        else:
+            self._symbol = sym.load_json(symbol_json)
+        arg_params, aux_params = _load_params(param_bytes)
+        self._input_names = list(input_shapes.keys())
+        self._input_shapes = {k: tuple(v) for k, v in input_shapes.items()}
+        self._auto_args = set()
+        self._ctx = ctx
+        self._dtype = dtype
+
+        arg_names = self._symbol.list_arguments()
+        aux_names = self._symbol.list_auxiliary_states()
+        # args absent from the blob (e.g. softmax_label at inference time)
+        # get zero arrays at their partially-inferred shapes — the
+        # reference's predictor likewise feeds dummy labels
+        known = dict(self._input_shapes)
+        known.update({k: tuple(v.shape) for k, v in arg_params.items()})
+        try:
+            inferred, _, _ = self._symbol.infer_shape_partial(**known)
+            inferred = dict(zip(arg_names, inferred))
+        except MXNetError:
+            inferred = {}
+        args = {}
+        for name in arg_names:
+            if name in self._input_shapes:
+                args[name] = nd.zeros(self._input_shapes[name],
+                                      dtype=dtype or "float32")
+            elif name in arg_params:
+                args[name] = arg_params[name]
+            elif name.endswith("_label") and inferred.get(name) is not None:
+                # inference-time dummy for loss-layer labels only — a
+                # missing *weight* must stay a hard error
+                args[name] = nd.zeros(inferred[name])
+                self._auto_args.add(name)
+            else:
+                raise MXNetError(
+                    "predictor: missing parameter %r (not an input, not in "
+                    "the param blob)" % name)
+        aux = {}
+        for name in aux_names:
+            if name not in aux_params:
+                raise MXNetError("predictor: missing aux state %r" % name)
+            aux[name] = aux_params[name]
+
+        self._executor = self._symbol.bind(
+            ctx, args, args_grad=None, grad_req="null", aux_states=aux)
+        self._outputs = None
+
+    # -- c_predict_api surface ---------------------------------------------
+    def set_input(self, name, data):
+        """(parity: MXPredSetInput)"""
+        if name not in self._input_shapes:
+            raise MXNetError("unknown input %r" % name)
+        arr = data if isinstance(data, nd.NDArray) else nd.array(data)
+        if tuple(arr.shape) != self._input_shapes[name]:
+            raise MXNetError(
+                "input %r shape %s != bound shape %s — use reshape()"
+                % (name, arr.shape, self._input_shapes[name]))
+        self._executor.arg_dict[name][:] = arr
+
+    def forward(self, **kwargs):
+        """(parity: MXPredForward) — kwargs are input name -> array."""
+        for name, data in kwargs.items():
+            self.set_input(name, data)
+        self._outputs = self._executor.forward(is_train=False)
+        return self._outputs
+
+    def get_output(self, index=0):
+        """(parity: MXPredGetOutput)"""
+        if self._outputs is None:
+            self.forward()
+        return self._outputs[index]
+
+    def reshape(self, new_input_shapes):
+        """(parity: MXPredReshape) — rebind for new input shapes; weights
+        are reused, XLA recompiles once per new signature."""
+        shapes = dict(self._input_shapes)
+        shapes.update({k: tuple(v) for k, v in new_input_shapes.items()})
+        arg_params = {("arg:%s" % k): v
+                      for k, v in self._executor.arg_dict.items()
+                      if k not in self._input_shapes
+                      and k not in self._auto_args}
+        arg_params.update({("aux:%s" % k): v
+                           for k, v in self._executor.aux_dict.items()})
+        return Predictor(self._symbol, arg_params, shapes, ctx=self._ctx,
+                         dtype=self._dtype)
+
+
+def _load_params(param_bytes):
+    """Accept a path, raw bytes, or a dict; split arg:/aux: prefixes."""
+    if isinstance(param_bytes, dict):
+        arg_params, aux_params = {}, {}
+        for k, v in param_bytes.items():
+            if k.startswith("arg:"):
+                arg_params[k[4:]] = v
+            elif k.startswith("aux:"):
+                aux_params[k[4:]] = v
+            else:
+                arg_params[k] = v
+        return arg_params, aux_params
+    if isinstance(param_bytes, (bytes, bytearray)):
+        import tempfile
+        import os
+        fd, path = tempfile.mkstemp(suffix=".params")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(param_bytes)
+            blob = nd.load(path)
+        finally:
+            os.unlink(path)
+    else:
+        blob = nd.load(param_bytes)
+    return _load_params(blob)
+
+
+def create(symbol_file, param_file, input_shapes, ctx=None):
+    """Convenience mirroring MXPredCreate's (file, file) signature."""
+    with open(symbol_file) as f:
+        js = f.read()
+    return Predictor(js, param_file, input_shapes, ctx=ctx)

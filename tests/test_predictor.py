@@ -1,0 +1,72 @@
+"""Predictor (c_predict_api parity) tests: checkpoint -> standalone
+inference round trip (reference model: c_predict_api.cc + amalgamation)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.predictor import Predictor, create as pred_create
+
+
+def _mlp_symbol():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _trained_params(symbol):
+    rng = np.random.RandomState(0)
+    shapes, _, _ = symbol.infer_shape_partial(data=(2, 5))
+    args = {}
+    for name, shape in zip(symbol.list_arguments(), shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        args[name] = mx.nd.array(rng.normal(0, 0.1, shape)
+                                 .astype(np.float32))
+    return args
+
+
+def test_predictor_matches_executor(tmp_path):
+    symbol = _mlp_symbol()
+    arg_params = _trained_params(symbol)
+    prefix = str(tmp_path / "model")
+    mx.model.save_checkpoint(prefix, 7, symbol, arg_params, {})
+
+    x = np.random.RandomState(1).normal(size=(2, 5)).astype(np.float32)
+
+    # ground truth through the training-side executor
+    args = dict(arg_params)
+    args["data"] = mx.nd.array(x)
+    args["softmax_label"] = mx.nd.zeros((2,))
+    ref = symbol.bind(None, args, grad_req="null").forward(is_train=False)
+
+    pred = pred_create(prefix + "-symbol.json", prefix + "-0007.params",
+                       {"data": (2, 5)})
+    pred.forward(data=x)
+    out = pred.get_output(0)
+    np.testing.assert_allclose(out.asnumpy(), ref[0].asnumpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_predictor_reshape(tmp_path):
+    symbol = _mlp_symbol()
+    arg_params = _trained_params(symbol)
+    pred = Predictor(symbol, {("arg:%s" % k): v
+                              for k, v in arg_params.items()},
+                     {"data": (2, 5)})
+    p2 = pred.reshape({"data": (4, 5)})
+    x = np.random.RandomState(2).normal(size=(4, 5)).astype(np.float32)
+    p2.forward(data=x)
+    assert p2.get_output(0).shape == (4, 3)
+
+
+def test_predictor_rejects_bad_shape():
+    symbol = _mlp_symbol()
+    arg_params = _trained_params(symbol)
+    pred = Predictor(symbol, arg_params, {"data": (2, 5)})
+    try:
+        pred.set_input("data", np.zeros((3, 5), np.float32))
+    except mx.MXNetError as e:
+        assert "reshape" in str(e)
+    else:
+        raise AssertionError("shape mismatch not caught")

@@ -1,0 +1,177 @@
+"""Sparse NDArray + sparse training path tests (parity model: reference
+tests/python/unittest/test_sparse_ndarray.py / test_sparse_operator.py /
+test_optimizer.py sparse sections)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse as sp
+
+
+def test_row_sparse_roundtrip():
+    data = np.array([[1., 2.], [3., 4.]], np.float32)
+    rsp = sp.row_sparse_array((data, [1, 3]), shape=(5, 2))
+    assert rsp.stype == "row_sparse"
+    dense = rsp.asnumpy()
+    expect = np.zeros((5, 2), np.float32)
+    expect[[1, 3]] = data
+    np.testing.assert_allclose(dense, expect)
+    back = sp.cast_storage(mx.nd.array(expect), "row_sparse")
+    np.testing.assert_allclose(back.data.asnumpy(), data)
+    np.testing.assert_allclose(back.indices.asnumpy(), [1, 3])
+
+
+def test_csr_roundtrip():
+    dense = np.array([[0, 1, 0], [2, 0, 3], [0, 0, 0]], np.float32)
+    csr = sp.cast_storage(mx.nd.array(dense), "csr")
+    assert csr.stype == "csr"
+    np.testing.assert_allclose(csr.asnumpy(), dense)
+    np.testing.assert_allclose(csr.data.asnumpy(), [1, 2, 3])
+    np.testing.assert_allclose(csr.indices.asnumpy(), [1, 0, 2])
+    np.testing.assert_allclose(csr.indptr.asnumpy(), [0, 1, 3, 3])
+    # tostype round trip
+    np.testing.assert_allclose(csr.tostype("row_sparse").asnumpy(), dense)
+
+
+def test_retain():
+    rsp = sp.row_sparse_array((np.ones((3, 2), np.float32), [0, 2, 4]),
+                              shape=(6, 2))
+    kept = rsp.retain([2, 4, 5])
+    np.testing.assert_allclose(kept.indices.asnumpy(), [2, 4])
+    assert kept.shape == (6, 2)
+
+
+def test_add_n_union_of_rows():
+    a = sp.row_sparse_array((np.array([[1., 1.], [2., 2.]]), [0, 2]),
+                            shape=(4, 2))
+    b = sp.row_sparse_array((np.array([[10., 10.], [20., 20.]]), [2, 3]),
+                            shape=(4, 2))
+    s = sp.add_n([a, b])
+    assert s.stype == "row_sparse"
+    np.testing.assert_allclose(s.indices.asnumpy(), [0, 2, 3])
+    expect = np.zeros((4, 2))
+    expect[0] = 1
+    expect[2] = [12, 12]
+    expect[3] = [20, 20]
+    np.testing.assert_allclose(s.asnumpy(), expect)
+
+
+def test_sparse_dot():
+    rng = np.random.RandomState(0)
+    dense = rng.normal(size=(4, 6)).astype(np.float32)
+    dense[dense < 0.5] = 0
+    rhs = rng.normal(size=(6, 3)).astype(np.float32)
+    csr = sp.cast_storage(mx.nd.array(dense), "csr")
+    out = sp.dot(csr, mx.nd.array(rhs))
+    np.testing.assert_allclose(out.asnumpy(), dense @ rhs, rtol=1e-5,
+                               atol=1e-5)
+    # transpose_a: csr^T . dense — the sparse-linear-regression grad path
+    out_t = sp.dot(csr, mx.nd.array(rng.normal(size=(4, 3))
+                                    .astype(np.float32)), transpose_a=True)
+    assert out_t.shape == (6, 3)
+
+
+def _lazy_rows_check(opt_name, **kwargs):
+    """Rows absent from a row_sparse grad must stay untouched."""
+    opt = mx.optimizer.create(opt_name, learning_rate=0.1, **kwargs)
+    w = mx.nd.array(np.ones((5, 3), np.float32))
+    state = opt.create_state(0, w)
+    grad = sp.row_sparse_array((np.full((2, 3), 0.5, np.float32), [1, 3]),
+                               shape=(5, 3))
+    w_before = w.asnumpy().copy()
+    opt.update(0, w, grad, state)
+    w_after = w.asnumpy()
+    untouched = [0, 2, 4]
+    np.testing.assert_allclose(w_after[untouched], w_before[untouched])
+    assert np.all(w_after[[1, 3]] != w_before[[1, 3]])
+    return w_after
+
+
+def test_sgd_lazy_update():
+    w = _lazy_rows_check("sgd", momentum=0.9)
+    # exact value: mom=0 -> m = -lr*g = -0.05; w = 1 - 0.05
+    np.testing.assert_allclose(w[[1, 3]], 0.95, rtol=1e-6)
+
+
+def test_sgd_lazy_no_momentum():
+    w = _lazy_rows_check("sgd")
+    np.testing.assert_allclose(w[[1, 3]], 0.95, rtol=1e-6)
+
+
+def test_adam_lazy_update():
+    _lazy_rows_check("adam")
+
+
+def test_adagrad_lazy_update():
+    _lazy_rows_check("adagrad")
+
+
+def test_kvstore_row_sparse_push_pull():
+    kv = mx.kv.create("device")
+    kv.init("emb", mx.nd.zeros((6, 2)))
+    g1 = sp.row_sparse_array((np.ones((2, 2), np.float32), [0, 2]),
+                             shape=(6, 2))
+    g2 = sp.row_sparse_array((np.full((1, 2), 3.0, np.float32), [2]),
+                             shape=(6, 2))
+    kv.push("emb", [g1, g2])
+    out = sp.zeros("row_sparse", (6, 2))
+    kv.row_sparse_pull("emb", out=out, row_ids=mx.nd.array([0, 2]))
+    expect = np.zeros((6, 2), np.float32)
+    expect[0] = 1
+    expect[2] = 4
+    np.testing.assert_allclose(out.asnumpy(), expect)
+
+
+def test_kvstore_mixed_sparse_dense_push():
+    """Mixed shard lists fall back to a dense sum keeping every
+    contribution."""
+    kv = mx.kv.create("local")
+    kv.init(0, mx.nd.zeros((4, 2)))
+    rsp = sp.row_sparse_array((np.ones((1, 2), np.float32), [1]),
+                              shape=(4, 2))
+    dense = mx.nd.ones((4, 2))
+    kv.push(0, [rsp, dense])
+    out = mx.nd.zeros((4, 2))
+    kv.pull(0, out=out)
+    expect = np.ones((4, 2), np.float32)
+    expect[1] += 1
+    np.testing.assert_allclose(out.asnumpy(), expect)
+
+
+def test_compression_rejects_sparse():
+    kv = mx.kv.create("local")
+    kv.set_gradient_compression({"type": "2bit"})
+    kv.init(0, mx.nd.zeros((4, 2)))
+    rsp = sp.row_sparse_array((np.ones((1, 2), np.float32), [1]),
+                              shape=(4, 2))
+    with pytest.raises(mx.MXNetError):
+        kv.push(0, [rsp])
+
+
+def test_sgd_multi_precision_sparse():
+    """fp16 weight + fp32 master copy with a row_sparse grad (reference
+    MP_SGD row_sparse kernels)."""
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9,
+                              multi_precision=True)
+    w = mx.nd.array(np.ones((5, 3)), dtype="float16")
+    state = opt.create_state_multi_precision(0, w)
+    assert isinstance(state, tuple)
+    grad = sp.row_sparse_array((np.full((2, 3), 0.5, np.float32), [1, 3]),
+                               shape=(5, 3))
+    opt.update_multi_precision(0, w, grad, state)
+    w_after = w.asnumpy()
+    assert w.dtype == np.float16
+    np.testing.assert_allclose(w_after[[0, 2, 4]], 1.0)
+    np.testing.assert_allclose(w_after[[1, 3]], 0.95, rtol=1e-3)
+    # master copy stays fp32 and matches
+    np.testing.assert_allclose(state[1].asnumpy()[[1, 3]], 0.95, rtol=1e-6)
+
+
+def test_sparse_grad_stays_sparse_through_kvstore():
+    """Aggregation must not densify (the merged store value is rsp)."""
+    kv = mx.kv.create("local")
+    kv.init(0, mx.nd.zeros((4, 2)))
+    g = sp.row_sparse_array((np.ones((1, 2), np.float32), [1]), shape=(4, 2))
+    kv.push(0, [g, g])
+    assert isinstance(kv._store[0], sp.RowSparseNDArray)
+    np.testing.assert_allclose(kv._store[0].data.asnumpy(), [[2., 2.]])
