@@ -1,14 +1,19 @@
 # Native runtime components (parity: the reference's C++ core build).
 # The compute path is JAX/XLA; these libs cover the host-side runtime the
-# reference implemented natively: RecordIO scan + threaded batch loading.
+# reference implemented natively: RecordIO scan + threaded batch loading,
+# and the dependency engine scheduling host-side async work.
 
 CXX ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -pthread -Wall
 LIB_DIR := mxnet_tpu/_lib
 
-all: $(LIB_DIR)/libmxtpu_io.so
+all: $(LIB_DIR)/libmxtpu_io.so $(LIB_DIR)/libmxtpu_engine.so
 
 $(LIB_DIR)/libmxtpu_io.so: src/recordio.cc
+	@mkdir -p $(LIB_DIR)
+	$(CXX) $(CXXFLAGS) -shared -o $@ $<
+
+$(LIB_DIR)/libmxtpu_engine.so: src/engine.cc
 	@mkdir -p $(LIB_DIR)
 	$(CXX) $(CXXFLAGS) -shared -o $@ $<
 

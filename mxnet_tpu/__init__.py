@@ -63,3 +63,20 @@ from . import visualization          # noqa: E402
 from . import visualization as viz   # noqa: E402
 from . import test_utils             # noqa: E402
 from . import image                  # noqa: E402
+from . import image as img           # noqa: E402
+from . import engine                 # noqa: E402
+from . import name                   # noqa: E402
+from .attribute import AttrScope     # noqa: E402
+from . import attribute              # noqa: E402
+from . import registry               # noqa: E402
+from . import log                    # noqa: E402
+from . import libinfo                # noqa: E402
+from . import rtc                    # noqa: E402
+from . import contrib                # noqa: E402
+from . import executor_manager       # noqa: E402
+from . import kvstore_server         # noqa: E402
+from . import torch                  # noqa: E402
+from . import torch as th            # noqa: E402
+from . import initializer as init    # noqa: E402
+from . import monitor as mon         # noqa: E402
+from . import random as rnd          # noqa: E402

@@ -1,0 +1,61 @@
+"""Attribute scoping for the symbolic API.
+
+Parity: reference ``python/mxnet/attribute.py`` (AttrScope). Symbols
+created inside ``with mx.AttrScope(ctx_group='dev1'):`` inherit the
+scope's attributes unless overridden per-symbol; nested scopes merge with
+inner-wins. The reference uses this for model parallelism (``ctx_group``)
+and per-layer ``lr_mult``/``wd_mult`` — here ``ctx_group`` additionally
+feeds the mesh-sharding annotations of the executor (an attribute naming
+a logical device group maps to a ``jax.sharding`` spec instead of an
+explicit device id; see parallel/spmd.py).
+"""
+from __future__ import annotations
+
+import threading
+
+from .name import _ScopedMeta
+
+__all__ = ["AttrScope"]
+
+
+class _Current(threading.local):
+    def __init__(self):
+        self.value = None
+
+
+class AttrScope(metaclass=_ScopedMeta):
+    """Attribute manager for scoping symbol attributes."""
+
+    _current = _Current()
+
+    @classmethod
+    def _default(cls):
+        return AttrScope()
+
+    def __init__(self, **kwargs):
+        self._old_scope = None
+        for value in kwargs.values():
+            if not isinstance(value, str):
+                raise ValueError("Attributes need to be string")
+        self._attr = kwargs
+
+    def get(self, attr):
+        """Merge the scope's attributes under the user's ``attr`` dict."""
+        if self._attr:
+            ret = self._attr.copy()
+            if attr:
+                ret.update(attr)
+            return ret
+        return attr if attr else {}
+
+    def __enter__(self):
+        self._old_scope = AttrScope.current
+        attr = self._old_scope._attr.copy()
+        attr.update(self._attr)
+        self._attr = attr
+        AttrScope._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        assert self._old_scope is not None
+        AttrScope._current.value = self._old_scope

@@ -77,29 +77,7 @@ def registry_create(kind):
     return register, alias, create, get
 
 
-class _NameManagerState(threading.local):
-    def __init__(self):
-        self.counts = {}
-
-
-class NameManager:
-    """Generates unique names for symbols/blocks.
-
-    Parity: reference python/mxnet/name.py NameManager.
-    """
-
-    _state = _NameManagerState()
-
-    @classmethod
-    def get(cls, hint):
-        hint = hint.lower()
-        idx = cls._state.counts.get(hint, 0)
-        cls._state.counts[hint] = idx + 1
-        return "%s%d" % (hint, idx)
-
-    @classmethod
-    def reset(cls):
-        cls._state.counts = {}
+from .name import NameManager  # noqa: E402  (re-export; see name.py)
 
 
 _VALID_NAME_CHARS = set(string.ascii_letters + string.digits + "_-.")

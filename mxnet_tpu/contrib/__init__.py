@@ -1,0 +1,5 @@
+"""Experimental contrib namespace (parity: python/mxnet/contrib/)."""
+from . import autograd
+from . import tensorboard
+from ..ndarray import contrib as ndarray
+from ..symbol import contrib as symbol

@@ -1,0 +1,232 @@
+"""Engine: host-side dependency scheduler + engine knobs.
+
+Parity: reference ``python/mxnet/engine.py`` (set_bulk_size / bulk) plus a
+Python face for the native dependency engine (src/engine.cc — the
+TPU-native re-design of ``src/engine/threaded_engine*.cc``).
+
+Division of labour on TPU:
+
+* **Device ops** are scheduled by PJRT/XLA — jax dispatches
+  asynchronously in program order, so the reference's per-device engine
+  worker threads have no equivalent to build; ``mx.nd.waitall`` is the
+  WaitForAll of that implicit engine.
+* **Host ops** (RecordIO prefetch, augmentation, async checkpoint
+  writes) still need real dataflow scheduling — that is this engine:
+  push callables with read/write variable sets; per-variable versioned
+  queues grant concurrent readers / exclusive writers in push order,
+  exactly the reference's ThreadedVar discipline
+  (threaded_engine.h:66-217).
+
+``MXNET_ENGINE_TYPE=NaiveEngine`` runs pushed work synchronously in the
+caller (the reference's prescribed debugging mode,
+threaded_engine.h:355-368); ``MXNET_CPU_WORKER_NTHREADS`` sizes the pool.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+from .base import MXNetError, get_env
+
+__all__ = ["Engine", "get", "set_bulk_size", "bulk", "NaiveEngine"]
+
+_lib_lock = threading.Lock()
+_LIB = None
+_TRIED = False
+
+
+def _lib():
+    global _LIB, _TRIED
+    with _lib_lock:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        path = os.path.join(os.path.dirname(__file__), "_lib",
+                            "libmxtpu_engine.so")
+        if not os.path.exists(path):
+            return None
+        try:
+            L = ctypes.CDLL(path)
+        except OSError:
+            return None
+        L.eng_create.restype = ctypes.c_void_p
+        L.eng_create.argtypes = [ctypes.c_int, ctypes.c_int]
+        L.eng_destroy.argtypes = [ctypes.c_void_p]
+        L.eng_new_var.restype = ctypes.c_int64
+        L.eng_new_var.argtypes = [ctypes.c_void_p]
+        L.eng_delete_var.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        L.eng_push.argtypes = [
+            ctypes.c_void_p, _CB, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int]
+        L.eng_wait_for_var.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        L.eng_wait_all.argtypes = [ctypes.c_void_p]
+        _LIB = L
+        return _LIB
+
+
+_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+class Var:
+    """An engine variable — names a unit of mutable host state."""
+
+    __slots__ = ("id", "_engine")
+
+    def __init__(self, vid, engine):
+        self.id = vid
+        self._engine = engine
+
+
+class Engine:
+    """Native threaded dependency engine over host worker threads.
+
+    ``push(fn, const_vars=[...], mutable_vars=[...])`` schedules ``fn``
+    once every read dependency's prior writers and every write
+    dependency's prior accessors have completed. Falls back to a pure-
+    Python synchronous engine when the native library isn't built.
+    """
+
+    def __init__(self, num_workers=None, naive=None):
+        if num_workers is None:
+            num_workers = get_env("MXNET_CPU_WORKER_NTHREADS",
+                                  os.cpu_count() or 4, int)
+        if naive is None:
+            naive = get_env("MXNET_ENGINE_TYPE", "") == "NaiveEngine"
+        self._naive = bool(naive)
+        self._L = _lib()
+        self._pending = {}          # token -> python fn, until it runs
+        self._pending_lock = threading.Lock()
+        self._next_token = 1        # 0 would arrive as NULL/None in C
+        self._h = None
+        self._py_var = 0
+        # ONE persistent CFUNCTYPE per engine; per-op fns are plain
+        # Python objects looked up by the token smuggled through the
+        # C `void* arg`. This sidesteps the closure-lifetime hazard of
+        # freeing a per-op CFUNCTYPE while C is still returning through
+        # its libffi trampoline.
+        self._cb = _CB(self._dispatch)
+        if self._L is not None:
+            self._h = ctypes.c_void_p(
+                self._L.eng_create(int(num_workers), int(self._naive)))
+
+    # -- vars -------------------------------------------------------------
+    def new_var(self):
+        if self._h:
+            return Var(self._L.eng_new_var(self._h), self)
+        self._py_var += 1
+        return Var(self._py_var, self)
+
+    def delete_var(self, var):
+        if self._h:
+            self._L.eng_delete_var(self._h, var.id)
+
+    # -- push -------------------------------------------------------------
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0):
+        """Schedule ``fn()`` with the given read/write sets.
+
+        Raises if the sets overlap (reference CheckDuplicate,
+        threaded_engine.h:409 — overlapping const/mutable vars would
+        self-deadlock the grant queues).
+        """
+        cids = [v.id for v in const_vars]
+        mids = [v.id for v in mutable_vars]
+        if set(cids) & set(mids):
+            raise MXNetError("const_vars and mutable_vars overlap")
+        if len(set(mids)) != len(mids):
+            raise MXNetError("duplicate mutable vars")
+        if self._h is None:
+            fn()  # pure-python fallback: synchronous
+            return
+        with self._pending_lock:
+            token = self._next_token
+            self._next_token += 1
+            self._pending[token] = fn
+        c_arr = (ctypes.c_int64 * max(len(cids), 1))(*(cids or [0]))
+        m_arr = (ctypes.c_int64 * max(len(mids), 1))(*(mids or [0]))
+        self._L.eng_push(self._h, self._cb, ctypes.c_void_p(token), c_arr,
+                         len(cids), m_arr, len(mids), int(priority))
+
+    def _dispatch(self, arg):
+        # runs on a native worker thread (ctypes acquires the GIL)
+        token = int(arg) if arg else 0
+        with self._pending_lock:
+            fn = self._pending.pop(token, None)
+        if fn is None:
+            return
+        try:
+            fn()
+        except Exception:  # never let an exception cross into C
+            import traceback
+            traceback.print_exc()
+
+    def wait_for_var(self, var):
+        if self._h:
+            self._L.eng_wait_for_var(self._h, var.id)
+
+    def wait_all(self):
+        if self._h:
+            self._L.eng_wait_all(self._h)
+
+    def __del__(self):
+        h, self._h = self._h, None
+        if h and self._L is not None:
+            try:
+                self._L.eng_destroy(h)
+            except Exception:
+                pass
+
+
+def NaiveEngine():
+    """Synchronous engine (parity: MXNET_ENGINE_TYPE=NaiveEngine)."""
+    return Engine(naive=True)
+
+
+_default = None
+_default_lock = threading.Lock()
+
+
+def get():
+    """The process-wide engine singleton (parity: Engine::Get)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = Engine()
+        return _default
+
+
+# ---------------------------------------------------------------------------
+# Bulk-execution knobs (parity: mx.engine.set_bulk_size / bulk).
+# On TPU "bulking" is jit scope: ops inside one jitted function compile
+# into ONE XLA program, which is a strictly stronger form of the
+# reference's engine-op bundling. The knob is kept for API parity and
+# read by the imperative layer as a hint only.
+# ---------------------------------------------------------------------------
+
+_bulk_size = 15  # reference default MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN-ish
+
+
+def set_bulk_size(size):
+    """Set size limit on bulk execution; returns the previous size."""
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, int(size)
+    return prev
+
+
+class _BulkScope:
+    def __init__(self, size):
+        self._size = size
+        self._old_size = None
+
+    def __enter__(self):
+        self._old_size = set_bulk_size(self._size)
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        set_bulk_size(self._old_size)
+
+
+def bulk(size):
+    """Scope for bundling many small ops (see module docstring)."""
+    return _BulkScope(size)

@@ -1,0 +1,216 @@
+"""Legacy executor manager (parity: python/mxnet/executor_manager.py).
+
+The reference's oldest data-parallel layer: FeedForward used
+``DataParallelExecutorManager`` to keep one executor per GPU and split
+each batch by ``_split_input_slice``. TPU-native: data parallelism is a
+sharding of ONE program over the mesh (mxnet_tpu.parallel), so this
+manager delegates to a single bound executor; the slicing helpers keep
+their exact reference semantics for callers that use them directly.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .base import MXNetError
+from . import ndarray as nd
+
+__all__ = ["DataParallelExecutorGroup", "DataParallelExecutorManager",
+           "_split_input_slice", "_check_arguments", "_load_data",
+           "_load_label", "_load_general"]
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Get input slice from the input shape (parity:
+    executor_manager.py:31).
+
+    Raises ValueError when there are two many slices such that some
+    slice can be empty.
+    """
+    total_work_load = sum(work_load_list)
+    batch_num_list = [round(batch_size * item / total_work_load)
+                      for item in work_load_list]
+    batch_num_sum = sum(batch_num_list)
+    if batch_num_sum < batch_size:
+        batch_num_list[-1] += batch_size - batch_num_sum
+    slices = []
+    end = 0
+    for batch_num in batch_num_list:
+        begin = int(min(end, batch_size))
+        end = int(min(begin + batch_num, batch_size))
+        if begin >= end:
+            raise ValueError("Too many slices. Some splits are empty.")
+        slices.append(slice(begin, end))
+    return slices
+
+
+def _check_arguments(symbol):
+    """Check the argument names of symbol: arguments and auxiliary states
+    must each be distinct (parity: executor_manager.py:68)."""
+    arg_set = set()
+    arg_names = symbol.list_arguments()
+    for name in arg_names:
+        if name in arg_set:
+            raise ValueError(
+                "Find duplicated argument name \"%s\", please make the "
+                "weight name non-duplicated(using name arguments), "
+                "arguments are %s" % (name, str(arg_names)))
+        arg_set.add(name)
+    aux_set = set()
+    aux_names = symbol.list_auxiliary_states()
+    for name in aux_names:
+        if name in aux_set:
+            raise ValueError(
+                "Find duplicated auxiliary param name \"%s\", please make "
+                "the weight name non-duplicated(using name arguments), "
+                "aux states are %s" % (name, str(aux_names)))
+        aux_set.add(name)
+
+
+def _load_general(data, targets):
+    """Load a list of arrays into a list of arrays specified by slices."""
+    for d_src, d_targets in zip(data, targets):
+        if isinstance(d_targets, nd.NDArray):
+            d_src.copyto(d_targets)
+        else:
+            for slice_idx, d_dst in d_targets:
+                d_src[slice_idx].copyto(d_dst)
+
+
+def _load_data(batch, targets):
+    _load_general(batch.data, targets)
+
+
+def _load_label(batch, targets):
+    _load_general(batch.label, targets)
+
+
+class DataParallelExecutorGroup:
+    """A group of executors living on one logical device set (parity:
+    executor_manager.py:204). On TPU this is one sharded executor."""
+
+    def __init__(self, sym, arg_names, param_names, ctx, slices, train_data,
+                 shared_group=None):
+        _check_arguments(sym)
+        self.ctx = ctx
+        self.param_names = param_names
+        self.arg_names = arg_names
+        shapes = {name: shape for name, shape in
+                  list(train_data.provide_data) +
+                  list(train_data.provide_label or [])}
+        grad_req = {name: ("write" if name in param_names else "null")
+                    for name in arg_names}
+        self.train_exec = sym.simple_bind(ctx=ctx[0], grad_req=grad_req,
+                                          **shapes)
+        self.data_names = [d[0] for d in train_data.provide_data]
+        self.label_names = [l[0] for l in (train_data.provide_label or [])]
+        self.param_arrays = [self.train_exec.arg_dict[name]
+                             for name in param_names]
+        self.grad_arrays = [self.train_exec.grad_dict[name]
+                            for name in param_names]
+        self.aux_arrays = list(self.train_exec.aux_arrays)
+        self.slices = slices
+
+    def load_data_batch(self, data_batch):
+        for name, arr in zip(self.data_names, data_batch.data):
+            arr.copyto(self.train_exec.arg_dict[name])
+        for name, arr in zip(self.label_names, data_batch.label or []):
+            arr.copyto(self.train_exec.arg_dict[name])
+
+    def forward(self, is_train=False):
+        self.train_exec.forward(is_train=is_train)
+
+    def backward(self):
+        self.train_exec.backward()
+
+    def update_metric(self, metric, labels):
+        metric.update(labels, self.train_exec.outputs)
+
+
+class DataParallelExecutorManager:
+    """Helper to manage data-parallel training (parity:
+    executor_manager.py:295). One sharded executor on TPU."""
+
+    def __init__(self, symbol, ctx, train_data, arg_names, param_names,
+                 aux_names, work_load_list=None, logger=None,
+                 sym_gen=None):
+        if logger is None:
+            logger = logging
+        num_device = len(ctx)
+        logger.info("Start training with %s", str(ctx))
+        if work_load_list is None:
+            work_load_list = [1] * num_device
+        assert isinstance(work_load_list, list) and \
+            len(work_load_list) == num_device, \
+            "Invalid settings for work load."
+        batch_size = train_data.batch_size
+        self.slices = _split_input_slice(batch_size, work_load_list)
+        self.arg_names = arg_names
+        self.param_names = param_names
+        self.aux_names = aux_names
+        self.ctx = ctx
+        self.execgrp = DataParallelExecutorGroup(
+            symbol, self.arg_names, self.param_names, self.ctx,
+            self.slices, train_data)
+        self.symbol = symbol
+        self.sym_gen = sym_gen
+        self.curr_execgrp = self.execgrp
+        self.execgrp_bucket = {}
+
+    def install_monitor(self, monitor):
+        monitor.install(self.curr_execgrp.train_exec)
+
+    def set_params(self, arg_params, aux_params):
+        exec_ = self.curr_execgrp.train_exec
+        for name, arr in arg_params.items():
+            if name in exec_.arg_dict:
+                arr.copyto(exec_.arg_dict[name])
+        for name, arr in aux_params.items():
+            if name in exec_.aux_dict:
+                arr.copyto(exec_.aux_dict[name])
+
+    def copy_to(self, arg_params, aux_params):
+        """Copy fitted parameters out (parity: executor_manager.py:374)."""
+        for name in self.param_names:
+            arg_params[name] = \
+                self.curr_execgrp.train_exec.arg_dict[name].copy()
+        for name in self.aux_names:
+            aux_params[name] = \
+                self.curr_execgrp.train_exec.aux_dict[name].copy()
+
+    @property
+    def param_arrays(self):
+        # wrap in a list-of-lists: reference keeps one array per device
+        return [[a] for a in self.curr_execgrp.param_arrays]
+
+    @property
+    def grad_arrays(self):
+        return [[g] for g in self.curr_execgrp.grad_arrays]
+
+    @property
+    def aux_arrays(self):
+        return [[a] for a in self.curr_execgrp.aux_arrays]
+
+    def load_data_batch(self, data_batch):
+        if self.sym_gen is not None:
+            key = getattr(data_batch, "bucket_key", None)
+            if key is not None and key not in self.execgrp_bucket:
+                symbol = self.sym_gen(key)
+                self.execgrp_bucket[key] = DataParallelExecutorGroup(
+                    symbol, self.arg_names, self.param_names, self.ctx,
+                    self.slices, data_batch)
+            if key is not None:
+                self.curr_execgrp = self.execgrp_bucket[key]
+        else:
+            self.curr_execgrp = self.execgrp
+        self.curr_execgrp.load_data_batch(data_batch)
+
+    def forward(self, is_train=False):
+        self.curr_execgrp.forward(is_train=is_train)
+
+    def backward(self):
+        self.curr_execgrp.backward()
+
+    def update_metric(self, metric, labels):
+        self.curr_execgrp.update_metric(metric, labels)

@@ -76,10 +76,15 @@ class GradientCompression:
     def __init__(self, type="2bit", threshold=0.5):
         if type != "2bit":
             raise MXNetError("unsupported compression type %r" % (type,))
+        try:
+            threshold = float(threshold)  # reference params arrive as strings
+        except (TypeError, ValueError):
+            raise MXNetError("threshold must be a number, got %r"
+                             % (threshold,))
         if not threshold > 0:
             raise MXNetError("threshold must be positive")
         self.type = type
-        self.threshold = float(threshold)
+        self.threshold = threshold
         self._residuals = {}
 
     def compress(self, key, grad):

@@ -242,7 +242,7 @@ def create(name="local"):
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     valid = ("local", "device", "nccl", "local_allreduce_cpu",
-             "local_allreduce_device", "dist_sync", "dist_async",
+             "local_allreduce_device", "dist", "dist_sync", "dist_async",
              "dist_sync_device", "dist_device_sync")
     if name not in valid:
         raise MXNetError("unknown kvstore type %r (valid: %s)" % (name, valid))

@@ -1,0 +1,82 @@
+"""Server node entry for distributed kvstore roles.
+
+Parity: reference ``python/mxnet/kvstore_server.py`` — in the reference a
+``DMLC_ROLE=server`` process enters ``KVStoreServer.run()`` and services
+ps-lite push/pull RPCs until ``kStopServer``. TPU-native training is
+single-program SPMD: every host runs the SAME program and gradients
+reduce via XLA collectives, so there is no separate server role to host.
+This module keeps the entry point so reference launch scripts work:
+
+* ``DMLC_ROLE=worker`` / unset — no-op, returns immediately.
+* ``DMLC_ROLE=server`` / ``scheduler`` — logs that the role is absorbed
+  by SPMD collectives and exits 0, letting legacy launchers (which spawn
+  worker+server+scheduler triples) run the worker processes unharmed.
+
+Commands the reference server accepted (kController, optimizer blobs) are
+decoded for diagnostics when received via ``send_command_to_servers``.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+
+from . import kvstore
+
+__all__ = ["KVStoreServer", "_init_kvstore_server_module"]
+
+
+class KVStoreServer:
+    """The compatibility shell of the reference's server run-loop."""
+
+    def __init__(self, kv):
+        self.kvstore = kv
+        self.handle = getattr(kv, "handle", None)
+        self.init_logging = False
+
+    def _controller(self):
+        """Return the server controller (parity: kvstore_server.py:41)."""
+        def server_controller(cmd_id, cmd_body, _):
+            if not self.init_logging:
+                header = "%(asctime)-15s Server[" + str(
+                    self.kvstore.rank) + "]"
+                logging.basicConfig(level=logging.DEBUG, format=header)
+                self.init_logging = True
+            if cmd_id == 0:
+                try:
+                    optimizer = pickle.loads(
+                        cmd_body if isinstance(cmd_body, bytes)
+                        else cmd_body.encode("latin1"))
+                except Exception:  # diagnostics only
+                    optimizer = cmd_body
+                logging.info("server optimizer (applied worker-side under "
+                             "SPMD): %s", optimizer)
+            else:
+                logging.info("server command %d ignored under SPMD", cmd_id)
+        return server_controller
+
+    def run(self):
+        """Run the server loop.
+
+        Under SPMD there are no RPCs to wait for — the method logs and
+        returns so launcher-spawned server processes exit cleanly.
+        """
+        logging.info(
+            "kvstore server role absorbed by XLA collectives (SPMD); "
+            "nothing to serve — exiting run loop")
+
+
+def _init_kvstore_server_module():
+    """Start the server when this process was launched with a server role
+    (parity: kvstore_server.py:75, called at import in the reference)."""
+    role = os.environ.get("DMLC_ROLE", "worker").lower()
+    if role in ("server", "scheduler"):
+        kv = kvstore.create("dist")
+        server = KVStoreServer(kv)
+        server.run()
+        raise SystemExit(0)
+
+
+# parity: the reference runs this at import so a DMLC_ROLE=server process
+# never reaches user training code
+_init_kvstore_server_module()

@@ -1,0 +1,37 @@
+"""Information about the native runtime libraries.
+
+Parity: reference ``python/mxnet/libinfo.py`` (find_lib_path locating
+libmxnet.so). Here the native pieces are the host-runtime libraries
+built by the top-level Makefile into ``mxnet_tpu/_lib`` (the compute
+path is JAX/XLA and ships no .so of its own).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["find_lib_path", "find_include_path", "__version__"]
+
+
+def find_lib_path():
+    """Find the paths to the native runtime libraries.
+
+    Returns
+    -------
+    lib_path : list(string)
+        List of all found library paths. May be empty when the native
+        libraries are not built — every consumer has a Python fallback.
+    """
+    lib_dir = os.path.join(os.path.dirname(os.path.abspath(
+        os.path.expanduser(__file__))), "_lib")
+    names = ["libmxtpu_io.so", "libmxtpu_engine.so"]
+    return [os.path.join(lib_dir, n) for n in names
+            if os.path.exists(os.path.join(lib_dir, n))]
+
+
+def find_include_path():
+    """Path to the native sources (headers are in-source, src/*.cc)."""
+    curr = os.path.dirname(os.path.abspath(os.path.expanduser(__file__)))
+    return os.path.join(os.path.dirname(curr), "src")
+
+
+__version__ = "0.12.1"

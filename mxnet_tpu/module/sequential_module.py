@@ -1,0 +1,226 @@
+"""SequentialModule — chain modules, output of one feeding the next.
+
+Parity: reference ``python/mxnet/module/sequential_module.py:28``. Each
+sub-module binds with the previous module's output shapes as its data
+shapes; ``META_TAKE_LABELS`` routes the batch labels to a chosen stage
+and ``META_AUTO_WIRING`` renames outputs onto the next stage's data
+names. Gradients flow backwards through the chain via each stage's
+``get_input_grads`` → previous stage's ``backward(out_grads)``.
+"""
+from __future__ import annotations
+
+import logging
+
+from .base_module import BaseModule
+from ..initializer import Uniform
+from ..io import DataBatch
+
+__all__ = ["SequentialModule"]
+
+
+class SequentialModule(BaseModule):
+    """A container module chaining several modules together."""
+
+    META_TAKE_LABELS = "take_labels"
+    META_AUTO_WIRING = "auto_wiring"
+
+    def __init__(self, logger=logging):
+        super().__init__(logger=logger)
+        self._modules = []
+        self._metas = []
+        self._label_shapes = None
+        self._data_shapes = None
+        self._meta_keys = set([getattr(SequentialModule, x)
+                               for x in dir(SequentialModule)
+                               if x.startswith("META_")])
+
+    def add(self, module, **kwargs):
+        """Add a module to the chain; returns self for chaining.
+
+        Keyword options: ``take_labels=True`` (this stage sees the batch
+        labels — typically the loss stage), ``auto_wiring=True`` (rename
+        the previous stage's outputs to this stage's data names in order).
+        """
+        self._modules.append(module)
+        for key in kwargs:
+            assert key in self._meta_keys, "Unknown meta %s" % key
+        self._metas.append(kwargs)
+        # after adding another module, the chain is no longer bound
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        return self
+
+    # -- properties --------------------------------------------------------
+    @property
+    def data_names(self):
+        if len(self._modules) > 0:
+            return self._modules[0].data_names
+        return []
+
+    @property
+    def output_names(self):
+        if len(self._modules) > 0:
+            return self._modules[-1].output_names
+        return []
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._modules[0].data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return self._modules[-1].output_shapes
+
+    # -- params ------------------------------------------------------------
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        arg_params = dict()
+        aux_params = dict()
+        for module in self._modules:
+            arg, aux = module.get_params()
+            arg_params.update(arg)
+            aux_params.update(aux)
+        return arg_params, aux_params
+
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before initializing the parameters"
+        for module in self._modules:
+            module.init_params(initializer=initializer,
+                               arg_params=arg_params, aux_params=aux_params,
+                               allow_missing=True, force_init=force_init)
+
+        # make sure we do not have duplicated parameter names
+        def _check_name(known_names, new_names, modules, i):
+            assert len(set(new_names)) == len(new_names), \
+                "Duplicated parameter names: %s" % new_names
+            for name in new_names:
+                assert name not in known_names, \
+                    "Duplicated parameter name %s in %s" % (
+                        name, modules[i])
+                known_names[name] = i
+
+        arg_names = dict()
+        aux_names = dict()
+        for i_layer, module in enumerate(self._modules):
+            arg, aux = module.get_params()
+            _check_name(arg_names, list(arg), self._modules, i_layer)
+            _check_name(aux_names, list(aux), self._modules, i_layer)
+        self.params_initialized = True
+
+    # -- binding -----------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        if inputs_need_grad:
+            assert for_training
+        assert shared_module is None, \
+            "Shared module is not supported by SequentialModule"
+        assert len(self._modules) > 0, "Attempting to bind an empty chain"
+
+        self.binded = True
+        self._label_shapes = label_shapes
+        my_data_shapes = data_shapes
+        anybody_ever_needs_label = False
+        for i_layer, module in enumerate(self._modules):
+            meta = self._metas[i_layer]
+            if SequentialModule.META_TAKE_LABELS in meta and \
+                    meta[SequentialModule.META_TAKE_LABELS]:
+                my_label_shapes = label_shapes
+                anybody_ever_needs_label = True
+            else:
+                my_label_shapes = None
+            my_inputs_need_grad = for_training and (
+                inputs_need_grad or i_layer > 0)
+            if meta.get(SequentialModule.META_AUTO_WIRING, False):
+                data_names = module.data_names
+                assert len(data_names) == len(my_data_shapes)
+                my_data_shapes = [(new_name, shape) for (new_name, (_, shape))
+                                  in zip(data_names, my_data_shapes)]
+            module.bind(data_shapes=my_data_shapes,
+                        label_shapes=my_label_shapes,
+                        for_training=for_training,
+                        inputs_need_grad=my_inputs_need_grad,
+                        force_rebind=force_rebind, grad_req=grad_req)
+            # the output of the previous module is the data of the next
+            my_data_shapes = module.output_shapes
+
+        if not anybody_ever_needs_label:
+            self._label_shapes = None
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning("optimizer already initialized, ignoring.")
+            return
+        for module in self._modules:
+            module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                                  optimizer_params=optimizer_params,
+                                  force_init=force_init)
+        self.optimizer_initialized = True
+
+    # -- computation -------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        batch = data_batch
+        for i_layer, module in enumerate(self._modules):
+            module.forward(batch, is_train=is_train)
+            if i_layer + 1 == len(self._modules):
+                break
+            out = module.get_outputs()
+            label = getattr(data_batch, "label", None)
+            batch = DataBatch(data=out, label=label)
+        # keep a handle for update_metric on the final stage
+        self._last_batch = data_batch
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        for i_layer, module in reversed(list(enumerate(self._modules))):
+            module.backward(out_grads=out_grads)
+            if i_layer == 0:
+                break
+            out_grads = module.get_input_grads()
+
+    def update(self):
+        assert self.binded and self.params_initialized \
+            and self.optimizer_initialized
+        for module in self._modules:
+            module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return self._modules[-1].get_outputs(
+            merge_multi_context=merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized \
+            and self.inputs_need_grad
+        return self._modules[0].get_input_grads(
+            merge_multi_context=merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        assert self.binded and self.params_initialized
+        for meta, module in zip(self._metas, self._modules):
+            if meta.get(SequentialModule.META_TAKE_LABELS, False):
+                module.update_metric(eval_metric, labels)
+
+    def install_monitor(self, mon):
+        assert self.binded
+        for module in self._modules:
+            module.install_monitor(mon)

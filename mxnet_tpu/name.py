@@ -1,0 +1,89 @@
+"""Automatic naming for the symbolic API.
+
+Parity: reference ``python/mxnet/name.py`` (NameManager / Prefix). The
+reference keeps a thread-global ``NameManager.current`` whose ``get``
+either honours a user-supplied name or counts per hint ("fullyconnected0",
+"fullyconnected1", ...); ``Prefix`` prepends a string — Gluon uses that to
+namespace parameters. Same contract here; scoping is per-thread so
+multi-threaded graph construction (e.g. data-loader workers building
+augmentation graphs) cannot interleave counters.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix"]
+
+
+class _Current(threading.local):
+    def __init__(self):
+        self.value = None
+
+
+class _ScopedMeta(type):
+    """Metaclass giving the class a thread-local ``current`` slot with a
+    lazily created per-thread default (assignment supported)."""
+
+    @property
+    def current(cls):
+        cur = cls._current.value
+        if cur is None:
+            cur = cls._default()
+            cls._current.value = cur
+        return cur
+
+    @current.setter
+    def current(cls, value):
+        cls._current.value = value
+
+
+class NameManager(metaclass=_ScopedMeta):
+    """Scoped automatic namer (``with NameManager(): ...``)."""
+
+    _current = _Current()
+
+    @classmethod
+    def _default(cls):
+        return NameManager()
+
+    def __init__(self):
+        self._counter = {}
+        self._old_manager = None
+
+    def get(self, name, hint):
+        """Return ``name`` if given, else ``hint%d`` with a per-scope count."""
+        if name:
+            return name
+        hint = hint.lower()
+        idx = self._counter.get(hint, 0)
+        self._counter[hint] = idx + 1
+        return "%s%d" % (hint, idx)
+
+    def __enter__(self):
+        self._old_manager = NameManager.current
+        NameManager._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        assert self._old_manager is not None
+        NameManager._current.value = self._old_manager
+
+
+class Prefix(NameManager):
+    """Name manager that attaches a prefix to every generated name.
+
+    Example::
+
+        data = mx.sym.Variable('data')
+        with mx.name.Prefix('mynet_'):
+            net = mx.sym.FullyConnected(data, num_hidden=10, name='fc1')
+        net.list_arguments()   # ['data', 'mynet_fc1_weight', ...]
+    """
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._prefix + name

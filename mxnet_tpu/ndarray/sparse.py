@@ -25,9 +25,38 @@ __all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
 
 
 class BaseSparseNDArray(NDArray):
-    """Common base; ``_data`` always holds the dense view lazily."""
+    """Common base. The dense view is built LAZILY: ``_data`` is a
+    property that materialises (and caches) on first dense access, so
+    sparse-native paths (kvstore row_sparse push/pull, add_n, retain)
+    never allocate the full weight-shape tensor — the point of the
+    reference's kRowSparsePushPull path (kvstore_dist.h:430-496)."""
 
-    __slots__ = ()
+    __slots__ = ("_dense_cache", "_sp_shape")
+
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            self._dense_cache = self._make_dense()
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, v):
+        self._dense_cache = v
+
+    @property
+    def shape(self):
+        return self._sp_shape
+
+    @property
+    def ndim(self):
+        return len(self._sp_shape)
+
+    @property
+    def size(self):
+        n = 1
+        for s in self._sp_shape:
+            n *= s
+        return n
 
 
 class RowSparseNDArray(BaseSparseNDArray):
@@ -36,11 +65,20 @@ class RowSparseNDArray(BaseSparseNDArray):
     __slots__ = ("_rsp_data", "_rsp_indices")
 
     def __init__(self, data, indices, shape, ctx=None):
-        dense = jnp.zeros(shape, data.dtype).at[indices.astype(jnp.int32)].set(data)
-        super().__init__(dense, ctx or current_context())
+        super().__init__(None, ctx or current_context())
+        self._sp_shape = tuple(shape)
         self._rsp_data = data
         self._rsp_indices = indices.astype(jnp.int64)
         self._stype = "row_sparse"
+
+    def _make_dense(self):
+        return jnp.zeros(self._sp_shape, self._rsp_data.dtype) \
+            .at[self._rsp_indices.astype(jnp.int32)].set(self._rsp_data)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._rsp_data.dtype) \
+            if self._rsp_data.dtype != jnp.bfloat16 else self._rsp_data.dtype
 
     @property
     def data(self):
@@ -80,18 +118,27 @@ class CSRNDArray(BaseSparseNDArray):
     __slots__ = ("_csr_data", "_csr_indices", "_csr_indptr")
 
     def __init__(self, data, indices, indptr, shape, ctx=None):
-        data_np = np.asarray(data)
-        ind_np = np.asarray(indices, np.int64)
-        ptr_np = np.asarray(indptr, np.int64)
-        dense = np.zeros(shape, data_np.dtype)
-        for r in range(shape[0]):
+        super().__init__(None, ctx or current_context())
+        self._sp_shape = tuple(shape)
+        self._csr_data = jnp.asarray(np.asarray(data))
+        self._csr_indices = jnp.asarray(np.asarray(indices, np.int64))
+        self._csr_indptr = jnp.asarray(np.asarray(indptr, np.int64))
+        self._stype = "csr"
+
+    def _make_dense(self):
+        data_np = np.asarray(self._csr_data)
+        ind_np = np.asarray(self._csr_indices)
+        ptr_np = np.asarray(self._csr_indptr)
+        dense = np.zeros(self._sp_shape, data_np.dtype)
+        for r in range(self._sp_shape[0]):
             lo, hi = ptr_np[r], ptr_np[r + 1]
             dense[r, ind_np[lo:hi]] = data_np[lo:hi]
-        super().__init__(jnp.asarray(dense), ctx or current_context())
-        self._csr_data = jnp.asarray(data_np)
-        self._csr_indices = jnp.asarray(ind_np)
-        self._csr_indptr = jnp.asarray(ptr_np)
-        self._stype = "csr"
+        return jnp.asarray(dense)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._csr_data.dtype) \
+            if self._csr_data.dtype != jnp.bfloat16 else self._csr_data.dtype
 
     @property
     def data(self):

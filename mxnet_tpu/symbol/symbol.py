@@ -17,7 +17,9 @@ import json
 
 import numpy as np
 
-from ..base import MXNetError, NameManager
+from ..base import MXNetError
+from ..name import NameManager
+from ..attribute import AttrScope
 from ..ops import registry as _registry
 
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
@@ -364,7 +366,7 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
              dtype=None, init=None, stype=None, **kwargs):
     """Create a symbolic variable (parity: mx.sym.Variable)."""
     node = _SymNode(None, name, {}, [])
-    extra = dict(attr or {})
+    extra = dict(AttrScope.current.get(attr))
     if shape is not None:
         extra["__shape__"] = str(tuple(shape))
     if lr_mult is not None:
@@ -395,6 +397,7 @@ def _create(op_name, input_syms, kwargs, name=None):
     op = _registry.get_op(op_name)
     kwargs = dict(kwargs)
     name = name or kwargs.pop("name", None)
+    attr = AttrScope.current.get(kwargs.pop("attr", None))
     kwargs.pop("out", None)
     inputs = []
     for s in input_syms:
@@ -404,8 +407,7 @@ def _create(op_name, input_syms, kwargs, name=None):
                 continue
             raise MXNetError("op %s expects single-output inputs" % op_name)
         inputs.append(s._outputs[0])
-    if name is None:
-        name = NameManager.get(op.name.lower().lstrip("_"))
+    name = NameManager.current.get(name, op.name.lower().lstrip("_"))
     # auto-create variables for missing learnable inputs (e.g. weight/bias
     # when calling sym.Convolution(data, kernel=..) without weight=)
     if op.nin not in (-1, 0) and len(inputs) < op.nin:
@@ -424,6 +426,8 @@ def _create(op_name, input_syms, kwargs, name=None):
             full = "%s_%s" % (name, arg_name)
             inputs.append((Variable(full)._outputs[0]))
     node = _SymNode(op, name, kwargs, inputs)
+    if attr:
+        node._extra_attrs.update(attr)
     n_out = node.num_outputs()
     return Symbol([(node, i) for i in range(n_out)])
 

@@ -1,0 +1,76 @@
+"""Torch interop bridge (parity: python/mxnet/torch.py + plugin/torch).
+
+The reference embeds Torch7 tensor math and NN modules as MXNet ops via
+a C plugin, exposing them as ``mx.th.*``. The modern equivalent bridges
+PyTorch: any ``torch.*`` function can be applied to NDArrays — arrays
+hop host-side through numpy (torch in this image is CPU-only; the TPU
+compute path stays JAX). Intended for glue/validation, not hot loops.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import MXNetError
+from . import ndarray as nd
+
+__all__ = ["function", "apply"]
+
+_torch = None
+_torch_tried = False
+
+
+def _require():
+    # lazy: PyTorch costs ~1s+ of import time and real memory — only pay
+    # on first bridge call, never at `import mxnet_tpu`
+    global _torch, _torch_tried
+    if not _torch_tried:
+        _torch_tried = True
+        try:
+            import torch as _t  # absolute import: the real PyTorch
+            _torch = _t
+        except ImportError:  # pragma: no cover - torch is in the image
+            _torch = None
+    if _torch is None:
+        raise MXNetError("PyTorch is not available in this environment")
+    return _torch
+
+
+def apply(fn_name, *args, **kwargs):
+    """Apply ``torch.<fn_name>`` to NDArray/scalar args, returning NDArrays.
+
+    Example::
+
+        y = mx.torch.apply('sigmoid', x)
+    """
+    _t = _require()
+    fn = getattr(_t, fn_name, None)
+    if fn is None:
+        raise MXNetError("torch has no function %r" % fn_name)
+    t_args = [
+        _t.from_numpy(np.array(a.asnumpy()))
+        if isinstance(a, nd.NDArray) else a for a in args]
+    out = fn(*t_args, **kwargs)
+    if isinstance(out, (tuple, list)):
+        return type(out)(
+            nd.array(o.numpy()) if _t.is_tensor(o) else o for o in out)
+    if _t.is_tensor(out):
+        return nd.array(out.numpy())
+    return out
+
+
+def function(fn_name):
+    """Return an NDArray-valued wrapper of ``torch.<fn_name>``."""
+    _require()
+
+    def wrapped(*args, **kwargs):
+        return apply(fn_name, *args, **kwargs)
+    wrapped.__name__ = fn_name
+    wrapped.__doc__ = "NDArray bridge of torch.%s" % fn_name
+    return wrapped
+
+
+def __getattr__(name):
+    # mx.torch.sigmoid(x) style access mirrors the reference's mx.th.*
+    if name.startswith("_"):
+        raise AttributeError(name)
+    return function(name)

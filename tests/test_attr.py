@@ -1,0 +1,59 @@
+"""Attribute/name scoping tests (parity: reference
+tests/python/unittest/test_attr.py)."""
+import mxnet_tpu as mx
+
+
+def test_attr_basic():
+    with mx.AttrScope(group="4", data="great"):
+        data = mx.sym.Variable("data", attr={"dtype": "data",
+                                             "group": "1",
+                                             "force_mirroring": "True"})
+        gdata = mx.sym.Variable("data2")
+    assert gdata.attr("group") == "4"
+    assert data.attr("group") == "1"
+    assert data.attr("force_mirroring") == "True"
+
+    data2 = mx.sym.Variable("data3")
+    assert data2.attr("group") is None
+
+
+def test_operator_attr():
+    data = mx.sym.Variable("data", attr={"group": "4"})
+    with mx.AttrScope(__group__="4", __lr_mult__="1"):
+        fc1 = mx.sym.Activation(data, act_type="relu")
+    assert fc1.attr("__group__") == "4"
+    assert fc1.attr("__lr_mult__") == "1"
+
+
+def test_attr_nested_scope():
+    with mx.AttrScope(x="1", y="a"):
+        with mx.AttrScope(y="b", z="2"):
+            v = mx.sym.Variable("v")
+        w = mx.sym.Variable("w")
+    assert v.attr("x") == "1" and v.attr("y") == "b" and v.attr("z") == "2"
+    assert w.attr("y") == "a" and w.attr("z") is None
+
+
+def test_name_manager_auto():
+    with mx.name.NameManager():
+        data = mx.sym.Variable("data")
+        a = mx.sym.FullyConnected(data, num_hidden=2)
+        b = mx.sym.FullyConnected(a, num_hidden=2)
+    assert a.name == "fullyconnected0"
+    assert b.name == "fullyconnected1"
+
+
+def test_name_prefix():
+    data = mx.sym.Variable("data")
+    with mx.name.Prefix("mynet_"):
+        net = mx.sym.FullyConnected(data, num_hidden=10, name="fc1")
+    args = net.list_arguments()
+    assert args == ["data", "mynet_fc1_weight", "mynet_fc1_bias"]
+
+
+def test_attr_dict_includes_scope_attrs():
+    data = mx.sym.Variable("data")
+    with mx.AttrScope(ctx_group="dev1"):
+        fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc1")
+    d = fc.attr_dict()
+    assert d["fc1"]["ctx_group"] == "dev1"
