@@ -41,13 +41,20 @@ def get_env(name, default=None, typ=None):
     return val
 
 
+# one entries dict per registry kind, shared with mxnet_tpu.registry so
+# mx.registry.get_create_func(Optimizer, "optimizer") sees the same
+# classes that @optimizer.register put in (the reference's mx.registry IS
+# the backing store for optimizer.create)
+_KIND_REGISTRIES = {}
+
+
 def registry_create(kind):
     """Create a tiny (register, alias, create, get) registry.
 
     Parity: dmlc registry pattern used for optimizers, metrics,
     initializers, data iterators in the reference.
     """
-    entries = {}
+    entries = _KIND_REGISTRIES.setdefault(kind, {})
 
     def register(cls=None, name=None):
         def _reg(cls):

@@ -81,7 +81,8 @@ def grad_and_loss(func, argnum=None):
         mark_variables(variables, grads)
         with train_section():
             outputs = func(*args)
-        backward([outputs] if not isinstance(outputs, list) else outputs)
+        backward(list(outputs) if isinstance(outputs, (list, tuple))
+                 else [outputs])
         return grads, outputs
     return wrapped
 

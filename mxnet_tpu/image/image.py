@@ -20,7 +20,8 @@ from ..io import DataIter, DataBatch, DataDesc
 from ..ndarray import array as nd_array
 from ..ndarray.ndarray import NDArray
 
-__all__ = ["imdecode", "imresize", "fixed_crop", "random_crop",
+__all__ = ["imdecode", "imresize", "resize_short", "fixed_crop",
+           "random_crop",
            "center_crop", "color_normalize", "random_size_crop",
            "ResizeAug", "RandomCropAug", "RandomSizedCropAug", "CenterCropAug",
            "HorizontalFlipAug", "CastAug", "ColorNormalizeAug",

@@ -2,5 +2,7 @@
 from .base_module import BaseModule
 from .module import Module
 from .bucketing_module import BucketingModule
+from .sequential_module import SequentialModule
+from .python_module import PythonModule, PythonLossModule
 from . import executor_group
 from .executor_group import DataParallelExecutorGroup

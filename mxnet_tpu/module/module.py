@@ -88,9 +88,17 @@ class Module(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        return [(n, o.shape) for n, o in
-                zip(self._output_names, self._exec.outputs)] \
-            if self._exec.outputs else None
+        if self._exec.outputs:
+            return [(n, o.shape) for n, o in
+                    zip(self._output_names, self._exec.outputs)]
+        # before the first forward, infer from the bound input shapes —
+        # the reference has these available right after bind (executor
+        # group infers at bind time), and SequentialModule.bind chains
+        # stages through this property
+        shape_kwargs = {d.name: d.shape for d in self._data_shapes}
+        shape_kwargs.update({l.name: l.shape for l in self._label_shapes})
+        _, out_shapes, _ = self._symbol.infer_shape(**shape_kwargs)
+        return list(zip(self._output_names, out_shapes))
 
     # -- bind --------------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,

@@ -55,18 +55,19 @@ profiler_set_state = set_state
 
 
 def _link_chrome_trace():
-    """Surface the chrome trace file at the configured filename."""
+    """Surface the chrome trace at the configured filename as plain JSON —
+    the reference emits an uncompressed chrome://tracing file (profiler.cc:161)."""
     out_dir = _state["dir"]
     if not out_dir:
         return
     matches = glob.glob(os.path.join(out_dir, "**", "*.trace.json.gz"),
                         recursive=True)
     if matches:
-        target = _state["filename"]
-        if not target.endswith(".gz"):
-            target = target + ".gz"
+        import gzip
         import shutil
-        shutil.copyfile(sorted(matches)[-1], target)
+        with gzip.open(sorted(matches)[-1], "rb") as src, \
+                open(_state["filename"], "wb") as dst:
+            shutil.copyfileobj(src, dst)
 
 
 def dump(finished=True, profile_process="worker"):

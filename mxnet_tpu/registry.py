@@ -12,18 +12,19 @@ from __future__ import annotations
 import json
 import logging
 
-from .base import MXNetError
-
-_REGISTRY = {}
+from .base import MXNetError, _KIND_REGISTRIES
 
 
-def _registry_for(base_class):
-    return _REGISTRY.setdefault(base_class, {})
+def _registry_for(base_class, nickname):
+    # shared with base.registry_create(nickname): optimizer/metric/
+    # initializer built-ins registered through those kind registries are
+    # visible here, and vice versa
+    return _KIND_REGISTRIES.setdefault(nickname, {})
 
 
 def get_register_func(base_class, nickname):
     """Make a ``register`` decorator for subclasses of ``base_class``."""
-    registry = _registry_for(base_class)
+    registry = _registry_for(base_class, nickname)
 
     def register(klass, name=None):
         assert issubclass(klass, base_class), \
@@ -65,7 +66,7 @@ def get_create_func(base_class, nickname):
     format kvstore uses to ship optimizers to servers), or an existing
     instance (returned as-is when no extra kwargs are given).
     """
-    registry = _registry_for(base_class)
+    registry = _registry_for(base_class, nickname)
 
     def create(*args, **kwargs):
         if len(args):

@@ -126,3 +126,12 @@ def test_deep_chain():
             y = y * 1.01
     y.backward()
     np.testing.assert_allclose(x.grad.asnumpy(), [1.01 ** 50], rtol=1e-4)
+
+
+def test_contrib_grad_and_loss_tuple_outputs():
+    # regression: functions returning tuples of outputs must work
+    from mxnet_tpu.contrib import autograd as cag
+    f = cag.grad_and_loss(lambda x: (x * x, x + 1))
+    grads, outs = f(mx.nd.array([3.0]))
+    assert len(outs) == 2
+    np.testing.assert_allclose(grads[0].asnumpy(), [7.0], rtol=1e-6)

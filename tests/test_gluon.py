@@ -1,0 +1,179 @@
+"""Gluon suite — parity with reference tests/python/unittest/test_gluon.py."""
+import os
+import tempfile
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_dense():
+    layer = nn.Dense(5, in_units=3)
+    layer.initialize()
+    x = mx.nd.uniform(shape=(4, 3))
+    y = layer(x)
+    assert y.shape == (4, 5)
+    w = layer.weight.data().asnumpy()
+    b = layer.bias.data().asnumpy()
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy().dot(w.T) + b,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_dense_deferred_init():
+    layer = nn.Dense(7)  # in_units deferred
+    layer.initialize()
+    y = layer(mx.nd.uniform(shape=(2, 6)))
+    assert y.shape == (2, 7)
+    assert layer.weight.shape == (7, 6)
+
+
+def test_sequential_and_hybrid_consistency():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"))
+        net.add(nn.Dropout(0.0))
+        net.add(nn.Dense(4))
+    net.initialize()
+    x = mx.nd.uniform(shape=(3, 8))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-5, atol=1e-6)
+    # second call hits the cached op
+    hybrid2 = net(x).asnumpy()
+    np.testing.assert_allclose(eager, hybrid2, rtol=1e-5, atol=1e-6)
+
+
+def test_batchnorm_train_vs_eval():
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    x = mx.nd.uniform(shape=(8, 4), low=-1, high=3)
+    with mx.autograd.record():
+        y_train = bn(x)
+    # training mode normalizes by batch stats
+    out = y_train.asnumpy()
+    assert abs(out.mean()) < 1e-2
+    y_eval = bn(x)  # eval mode uses running stats (initially mean0/var1)
+    assert not np.allclose(out, y_eval.asnumpy())
+
+
+def test_conv_block():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(8, kernel_size=3, padding=1, in_channels=3))
+        net.add(nn.MaxPool2D(pool_size=2))
+        net.add(nn.Flatten())
+        net.add(nn.Dense(6))
+    net.initialize()
+    y = net(mx.nd.uniform(shape=(2, 3, 8, 8)))
+    assert y.shape == (2, 6)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = mx.nd.array([1, 3, 1])
+    out = emb(idx)
+    assert out.shape == (3, 4)
+    w = emb.weight.data().asnumpy()
+    np.testing.assert_allclose(out.asnumpy(), w[[1, 3, 1]], rtol=1e-6)
+
+
+def test_trainer_step_decreases_loss():
+    np.random.seed(0)
+    net = nn.Dense(1, in_units=2)
+    net.initialize(mx.initializer.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.3})
+    x = mx.nd.uniform(shape=(16, 2))
+    w_true = np.array([[2.0], [-3.0]], dtype=np.float32)
+    y = mx.nd.array(x.asnumpy().dot(w_true))
+    l2 = gluon.loss.L2Loss()
+    losses = []
+    for _ in range(80):
+        with mx.autograd.record():
+            loss = l2(net(x), y)
+            total = loss.mean()
+        total.backward()
+        trainer.step(1)  # grads already averaged by the mean()
+        losses.append(float(total.asnumpy()))
+    assert losses[-1] < 0.05 * losses[0]
+
+
+def test_save_load_params():
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=3))
+        net.add(nn.Dense(2, in_units=4))
+    net.initialize()
+    x = mx.nd.uniform(shape=(2, 3))
+    y0 = net(x).asnumpy()
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "net.params")
+        net.save_params(path)
+        net2 = nn.HybridSequential(prefix="model_")
+        with net2.name_scope():
+            net2.add(nn.Dense(4, in_units=3))
+            net2.add(nn.Dense(2, in_units=4))
+        net2.load_params(path)
+        np.testing.assert_allclose(net2(x).asnumpy(), y0, rtol=1e-6)
+
+
+def test_parameter_dict_shared_scope():
+    shared = gluon.ParameterDict("shared_")
+    d1 = nn.Dense(4, in_units=4, params=shared.get_params()
+                  if hasattr(shared, "get_params") else shared)
+    assert d1 is not None
+
+
+def test_dataloader_and_dataset():
+    x = np.arange(20, dtype=np.float32).reshape(10, 2)
+    y = np.arange(10, dtype=np.float32)
+    ds = gluon.data.ArrayDataset(mx.nd.array(x), mx.nd.array(y))
+    assert len(ds) == 10
+    loader = gluon.data.DataLoader(ds, batch_size=4, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 3
+    bx, by = batches[0]
+    assert bx.shape == (4, 2) and by.shape == (4,)
+    np.testing.assert_allclose(bx.asnumpy(), x[:4])
+    loader = gluon.data.DataLoader(ds, batch_size=4, shuffle=False,
+                                   last_batch="discard")
+    assert len(list(loader)) == 2
+
+
+def test_dataset_transform():
+    ds = gluon.data.ArrayDataset(mx.nd.arange(10))
+    ds2 = ds.transform(lambda x: x * 2) if hasattr(ds, "transform") else None
+    if ds2 is not None:
+        assert float(ds2[3].asnumpy()) == 6.0
+
+
+def test_model_zoo_smoke():
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = vision.resnet18_v1()
+    net.initialize()
+    y = net(mx.nd.uniform(shape=(1, 3, 32, 32)))
+    assert y.shape == (1, 1000)
+
+
+def test_rnn_layer():
+    from mxnet_tpu.gluon import rnn
+    layer = rnn.LSTM(hidden_size=8, num_layers=1)
+    layer.initialize()
+    x = mx.nd.uniform(shape=(5, 2, 4))  # (T, N, C)
+    out = layer(x)
+    assert out.shape == (5, 2, 8)
+
+
+def test_block_apply_and_collect():
+    net = nn.Sequential()
+    net.add(nn.Dense(3, in_units=2))
+    net.add(nn.Dense(2, in_units=3))
+    names = list(net.collect_params().keys())
+    assert len(names) == 4  # two weights + two biases
+    seen = []
+    net.apply(lambda b: seen.append(b.name))
+    assert len(seen) >= 2

@@ -1,0 +1,89 @@
+"""Image pipeline suite — parity with reference tests/python/unittest/test_image.py."""
+import io as _io
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image
+
+PIL = pytest.importorskip("PIL.Image")
+
+
+def _jpeg_bytes(h=32, w=48):
+    # smooth gradient image: JPEG round-trips it near-losslessly (random
+    # noise would not), so decode accuracy is checkable
+    yy, xx = np.mgrid[0:h, 0:w]
+    arr = np.stack([255.0 * yy / h, 255.0 * xx / w,
+                    np.full((h, w), 128.0)], axis=2).astype(np.uint8)
+    img = PIL.fromarray(arr)
+    buf = _io.BytesIO()
+    img.save(buf, format="JPEG", quality=95)
+    return buf.getvalue(), arr
+
+
+def test_imdecode():
+    data, arr = _jpeg_bytes()
+    out = image.imdecode(data)
+    assert out.shape == (32, 48, 3)
+    # JPEG is lossy; mean error stays small
+    assert np.abs(out.asnumpy().astype(np.float32)
+                  - arr.astype(np.float32)).mean() < 3
+
+
+def test_imresize_and_resize_short():
+    data, _ = _jpeg_bytes()
+    img = image.imdecode(data)
+    out = image.imresize(img, 16, 8)
+    assert out.shape == (8, 16, 3)
+    out = image.resize_short(img, 24)
+    assert min(out.shape[:2]) == 24
+
+
+def test_crops():
+    data, _ = _jpeg_bytes()
+    img = image.imdecode(data)
+    out = image.fixed_crop(img, 4, 4, 20, 16)
+    assert out.shape == (16, 20, 3)
+    out, _ = image.center_crop(img, (20, 16))
+    assert out.shape == (16, 20, 3)
+    out, _ = image.random_crop(img, (20, 16))
+    assert out.shape == (16, 20, 3)
+
+
+def test_color_normalize():
+    src = mx.nd.ones((4, 4, 3)) * 128.0
+    mean = mx.nd.array([128.0, 128.0, 128.0])
+    std = mx.nd.array([2.0, 2.0, 2.0])
+    out = image.color_normalize(src, mean, std)
+    np.testing.assert_allclose(out.asnumpy(), np.zeros((4, 4, 3)), atol=1e-5)
+
+
+def test_augmenter_list():
+    augs = image.CreateAugmenter(data_shape=(3, 24, 24), resize=26,
+                                 rand_crop=True, rand_mirror=True,
+                                 mean=True, std=True)
+    data, _ = _jpeg_bytes(64, 64)
+    img = image.imdecode(data).astype("float32")
+    for aug in augs:
+        img = aug(img)
+    out = img.asnumpy() if hasattr(img, "asnumpy") else np.asarray(img)
+    assert out.shape[:2] == (24, 24)
+
+
+def test_imageiter_from_list(tmp_path):
+    # write a tiny .rec via recordio + pack, then iterate
+    from mxnet_tpu import recordio
+    rec_path = str(tmp_path / "data.rec")
+    idx_path = str(tmp_path / "data.idx")
+    record = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(8):
+        data, _ = _jpeg_bytes(40, 40)
+        header = recordio.IRHeader(0, float(i % 2), i, 0)
+        record.write_idx(i, recordio.pack(header, data))
+    record.close()
+    it = image.ImageIter(batch_size=4, data_shape=(3, 24, 24),
+                         path_imgrec=rec_path, path_imgidx=idx_path)
+    batch = next(iter([b for b in [next(it)]]))
+    assert batch.data[0].shape == (4, 3, 24, 24)
+    assert batch.label[0].shape == (4,)

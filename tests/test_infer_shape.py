@@ -1,0 +1,61 @@
+"""Shape-inference suite — parity with reference tests/python/unittest/test_infer_shape.py."""
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=128, name="fc1")
+    act = mx.sym.Activation(data=fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(data=act, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(data=fc2, name="softmax")
+
+
+def test_mlp_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(32, 784))
+    args = net.list_arguments()
+    d = dict(zip(args, arg_shapes))
+    assert d["fc1_weight"] == (128, 784)
+    assert d["fc1_bias"] == (128,)
+    assert d["fc2_weight"] == (10, 128)
+    assert out_shapes[0] == (32, 10)
+
+
+def test_conv_infer_shape():
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data=data, num_filter=16, kernel=(3, 3),
+                              pad=(1, 1), name="conv")
+    arg_shapes, out_shapes, _ = conv.infer_shape(data=(2, 3, 8, 8))
+    d = dict(zip(conv.list_arguments(), arg_shapes))
+    assert d["conv_weight"] == (16, 3, 3, 3)
+    assert out_shapes[0] == (2, 16, 8, 8)
+
+
+def test_partial_infer():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=4, name="fc")
+    # without data shape, partial infer must not raise
+    arg_shapes, out_shapes, _ = fc.infer_shape_partial()
+    assert out_shapes is None or len(out_shapes) == 1
+
+
+def test_elemwise_broadcast_infer():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    out = mx.sym.broadcast_add(a, b)
+    _, out_shapes, _ = out.infer_shape(a=(3, 1), b=(1, 4))
+    assert out_shapes[0] == (3, 4)
+
+
+def test_infer_type():
+    a = mx.sym.Variable("a")
+    out = mx.sym.exp(a)
+    arg_types, out_types, _ = out.infer_type(a="float32")
+    assert out_types[0] == "float32" or str(out_types[0]).endswith("float32")
+
+
+def test_reshape_transpose_chain():
+    data = mx.sym.Variable("data")
+    out = mx.sym.transpose(mx.sym.reshape(data, shape=(0, -1)))
+    _, out_shapes, _ = out.infer_shape(data=(4, 2, 3))
+    assert out_shapes[0] == (6, 4)

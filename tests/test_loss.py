@@ -1,0 +1,111 @@
+"""Gluon loss suite — parity with reference tests/python/unittest/test_loss.py."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import loss as gloss
+
+
+def _np(x):
+    return x.asnumpy()
+
+
+def test_l2_loss():
+    pred = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    label = mx.nd.array([[1.5, 2.0], [2.0, 4.0]])
+    l = gloss.L2Loss()(pred, label)
+    expected = 0.5 * ((np.array([[0.5, 0.0], [1.0, 0.0]]) ** 2).mean(axis=1))
+    np.testing.assert_allclose(_np(l), expected, rtol=1e-5)
+
+
+def test_l1_loss():
+    pred = mx.nd.array([[1.0, 2.0]])
+    label = mx.nd.array([[2.0, 0.0]])
+    l = gloss.L1Loss()(pred, label)
+    np.testing.assert_allclose(_np(l), [1.5], rtol=1e-5)
+
+
+def test_softmax_ce_loss():
+    pred = mx.nd.array([[1.0, 2.0, 3.0], [3.0, 2.0, 1.0]])
+    label = mx.nd.array([2, 0])
+    l = gloss.SoftmaxCrossEntropyLoss()(pred, label)
+    logits = _np(pred)
+    lse = np.log(np.exp(logits).sum(axis=1))
+    expected = lse - logits[np.arange(2), [2, 0]]
+    np.testing.assert_allclose(_np(l), expected, rtol=1e-5)
+
+
+def test_softmax_ce_sparse_vs_dense_label():
+    pred = mx.nd.uniform(shape=(4, 5))
+    label = mx.nd.array([0, 1, 2, 3])
+    onehot = mx.nd.one_hot(label, 5)
+    l1 = gloss.SoftmaxCrossEntropyLoss(sparse_label=True)(pred, label)
+    l2 = gloss.SoftmaxCrossEntropyLoss(sparse_label=False)(pred, onehot)
+    np.testing.assert_allclose(_np(l1), _np(l2), rtol=1e-5)
+
+
+def test_sigmoid_bce():
+    pred = mx.nd.array([[0.5, -0.5]])
+    label = mx.nd.array([[1.0, 0.0]])
+    l = gloss.SigmoidBinaryCrossEntropyLoss()(pred, label)
+    p = 1.0 / (1.0 + np.exp(-np.array([0.5, -0.5])))
+    expected = -(np.log(p[0]) + np.log(1 - p[1])) / 2.0
+    np.testing.assert_allclose(_np(l), [expected], rtol=1e-5)
+
+
+def test_kl_div():
+    pred = mx.nd.log(mx.nd.array([[0.3, 0.7]]))
+    label = mx.nd.array([[0.5, 0.5]])
+    l = gloss.KLDivLoss(from_logits=True)(pred, label)
+    expected = (0.5 * (np.log(0.5) - np.log(0.3))
+                + 0.5 * (np.log(0.5) - np.log(0.7))) / 2.0
+    np.testing.assert_allclose(_np(l), [expected], rtol=1e-4)
+
+
+def test_huber_loss():
+    pred = mx.nd.array([[0.0, 3.0]])
+    label = mx.nd.array([[0.5, 0.0]])
+    l = gloss.HuberLoss(rho=1.0)(pred, label)
+    expected = (0.5 * 0.25 + (3.0 - 0.5)) / 2.0
+    np.testing.assert_allclose(_np(l), [expected], rtol=1e-5)
+
+
+def test_hinge_loss():
+    pred = mx.nd.array([[0.3], [-2.0]])
+    label = mx.nd.array([[1.0], [-1.0]])
+    l = gloss.HingeLoss()(pred, label)
+    np.testing.assert_allclose(_np(l), [0.7, 0.0], rtol=1e-5, atol=1e-6)
+
+
+def test_loss_weight_and_sample_weight():
+    pred = mx.nd.array([[1.0, 1.0], [1.0, 1.0]])
+    label = mx.nd.zeros((2, 2))
+    base = _np(gloss.L2Loss()(pred, label))
+    weighted = _np(gloss.L2Loss(weight=2.0)(pred, label))
+    np.testing.assert_allclose(weighted, 2.0 * base, rtol=1e-6)
+    sw = mx.nd.array([[1.0], [0.0]])
+    sampled = _np(gloss.L2Loss()(pred, label, sw))
+    np.testing.assert_allclose(sampled, base * np.array([1.0, 0.0]), rtol=1e-6)
+
+
+def test_loss_is_differentiable():
+    pred = mx.nd.uniform(shape=(3, 4))
+    label = mx.nd.array([0, 1, 2])
+    pred.attach_grad()
+    with mx.autograd.record():
+        l = gloss.SoftmaxCrossEntropyLoss()(pred, label)
+        total = l.sum()
+    total.backward()
+    g = pred.grad.asnumpy()
+    assert g.shape == (3, 4)
+    assert np.abs(g).sum() > 0
+    # rows of softmax-CE grad sum to zero
+    np.testing.assert_allclose(g.sum(axis=1), np.zeros(3), atol=1e-5)
+
+
+def test_ctc_loss_runs():
+    pred = mx.nd.uniform(shape=(2, 10, 5))  # (N, T, C) — default layout
+    label = mx.nd.array([[1, 2, 3, 0], [2, 2, 0, 0]])
+    l = gloss.CTCLoss()(pred, label)
+    out = _np(l)
+    assert out.shape == (2,)
+    assert np.all(out > 0)

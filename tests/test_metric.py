@@ -1,0 +1,102 @@
+"""Metric suite — parity with reference tests/python/unittest/test_metric.py."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_accuracy():
+    pred = mx.nd.array([[0.3, 0.7], [0.0, 1.0], [0.4, 0.6]])
+    label = mx.nd.array([0, 1, 1])
+    m = mx.metric.Accuracy()
+    m.update([label], [pred])
+    name, val = m.get()
+    assert name == "accuracy"
+    assert abs(val - 2.0 / 3.0) < 1e-6
+
+
+def test_top_k_accuracy():
+    pred = mx.nd.array([[0.1, 0.2, 0.7], [0.5, 0.4, 0.1]])
+    label = mx.nd.array([1, 1])
+    m = mx.metric.TopKAccuracy(top_k=2)
+    m.update([label], [pred])
+    _, val = m.get()
+    assert abs(val - 1.0) < 1e-6
+    m = mx.metric.TopKAccuracy(top_k=1)
+    m.update([label], [pred])
+    _, val = m.get()
+    assert abs(val - 0.0) < 1e-6
+
+
+def test_f1():
+    pred = mx.nd.array([[0.3, 0.7], [0.8, 0.2], [0.1, 0.9], [0.6, 0.4]])
+    label = mx.nd.array([1, 0, 0, 1])
+    m = mx.metric.F1()
+    m.update([label], [pred])
+    _, val = m.get()
+    # tp=1 (idx0), fp=1 (idx2), fn=1 (idx3) -> precision=recall=0.5, f1=0.5
+    assert abs(val - 0.5) < 1e-6
+
+
+def test_regression_metrics():
+    pred = mx.nd.array([[1.0], [2.0], [3.0]])
+    label = mx.nd.array([[1.5], [2.0], [2.0]])
+    mae = mx.metric.MAE()
+    mae.update([label], [pred])
+    assert abs(mae.get()[1] - (0.5 + 0.0 + 1.0) / 3.0) < 1e-6
+    mse = mx.metric.MSE()
+    mse.update([label], [pred])
+    assert abs(mse.get()[1] - (0.25 + 0.0 + 1.0) / 3.0) < 1e-6
+    rmse = mx.metric.RMSE()
+    rmse.update([label], [pred])
+    assert abs(rmse.get()[1] - np.sqrt((0.25 + 0.0 + 1.0) / 3.0)) < 1e-5
+
+
+def test_perplexity():
+    pred = mx.nd.array([[0.25, 0.75], [0.5, 0.5]])
+    label = mx.nd.array([1, 0])
+    m = mx.metric.Perplexity(ignore_label=None)
+    m.update([label], [pred])
+    expected = np.exp(-(np.log(0.75) + np.log(0.5)) / 2.0)
+    assert abs(m.get()[1] - expected) < 1e-5
+
+
+def test_cross_entropy_nll():
+    pred = mx.nd.array([[0.2, 0.8], [0.9, 0.1]])
+    label = mx.nd.array([1, 0])
+    ce = mx.metric.CrossEntropy()
+    ce.update([label], [pred])
+    expected = -(np.log(0.8) + np.log(0.9)) / 2.0
+    assert abs(ce.get()[1] - expected) < 1e-5
+
+
+def test_custom_and_np():
+    def feval(label, pred):
+        return float(np.abs(label - pred).mean())
+    m = mx.metric.np(feval, name="mymae")
+    m.update([mx.nd.array([1.0, 2.0])], [mx.nd.array([1.5, 2.5])])
+    name, val = m.get()
+    assert "mymae" in name
+    assert abs(val - 0.5) < 1e-6
+
+
+def test_composite():
+    m = mx.metric.CompositeEvalMetric([mx.metric.Accuracy(), mx.metric.MAE()])
+    pred = mx.nd.array([[0.3, 0.7]])
+    label = mx.nd.array([1])
+    m.update([label], [pred])
+    names, vals = m.get()
+    assert len(names) == 2 and len(vals) == 2
+
+
+def test_create_by_name():
+    m = mx.metric.create("acc")
+    assert isinstance(m, mx.metric.Accuracy)
+    m = mx.metric.create("mse")
+    assert isinstance(m, mx.metric.MSE)
+
+
+def test_reset():
+    m = mx.metric.Accuracy()
+    m.update([mx.nd.array([1])], [mx.nd.array([[0.3, 0.7]])])
+    m.reset()
+    assert m.num_inst == 0

@@ -156,3 +156,47 @@ def test_bucketing_module():
                       provide_label=[("softmax_label", (4,))])
         mod.forward_backward(b)
         mod.update()
+
+
+def test_module_output_shapes_before_forward():
+    # regression: SequentialModule chains stages through output_shapes at
+    # bind time, before any forward has run
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=4, name="fc")
+    m = mx.mod.Module(fc, label_names=[])
+    m.bind(data_shapes=[("data", (2, 3))], label_shapes=None,
+           for_training=False)
+    assert m.output_shapes == [("fc_output", (2, 4))]
+
+
+def test_sequential_module_chain():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    stage1 = mx.mod.Module(fc1, label_names=[])
+
+    data2 = mx.sym.Variable("data")
+    net2 = mx.sym.FullyConnected(data=data2, num_hidden=2, name="fc2")
+    net2 = mx.sym.SoftmaxOutput(net2, name="softmax")
+    stage2 = mx.mod.Module(net2)
+
+    seq = mx.mod.SequentialModule()
+    seq.add(stage1).add(stage2, take_labels=True, auto_wiring=True)
+    seq.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    seq.init_params(mx.initializer.Xavier())
+    seq.init_optimizer(optimizer="sgd")
+    batch = DataBatch(data=[nd.ones((4, 6))], label=[nd.zeros((4,))])
+    seq.forward_backward(batch)
+    seq.update()
+    out = seq.get_outputs()[0]
+    assert out.shape == (4, 2)
+
+
+def test_registry_shared_with_builtin_factories():
+    # regression: mx.registry must see classes registered via
+    # optimizer/metric/initializer @register (shared backing store)
+    create = mx.registry.get_create_func(mx.optimizer.Optimizer, "optimizer")
+    assert type(create("sgd")).__name__ == "SGD"
+    import json
+    opt = create(json.dumps(["adam", {"learning_rate": 0.1}]))
+    assert type(opt).__name__ == "Adam"
