@@ -1,0 +1,49 @@
+"""Inference scoring harness — fps for the model zoo (mirrors reference
+example/image-classification/benchmark_score.py:41-50)."""
+import argparse
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def score(network, batch_size, image_shape=(3, 224, 224), num_batches=10,
+          num_layers=None):
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(__file__))
+    import symbols
+    kwargs = {}
+    if num_layers:
+        kwargs["num_layers"] = num_layers
+    sym = symbols.get_symbol(network, 1000, **kwargs)
+    data_shape = (batch_size,) + image_shape
+    mod = mx.mod.Module(sym, label_names=["softmax_label"])
+    mod.bind(data_shapes=[("data", data_shape)],
+             label_shapes=[("softmax_label", (batch_size,))],
+             for_training=False)
+    mod.init_params(mx.initializer.Xavier())
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(np.random.rand(*data_shape))],
+        label=[mx.nd.zeros((batch_size,))])
+    # warmup (first call compiles)
+    mod.forward(batch, is_train=False)
+    mod.get_outputs()[0].wait_to_read()
+    tic = time.time()
+    for _ in range(num_batches):
+        mod.forward(batch, is_train=False)
+    mod.get_outputs()[0].wait_to_read()
+    return num_batches * batch_size / (time.time() - tic)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--networks", type=str, default="alexnet,resnet")
+    parser.add_argument("--batch-size", type=int, default=32)
+    args = parser.parse_args()
+    for net in args.networks.split(","):
+        kwargs = {"num_layers": 50} if net == "resnet" else {}
+        fps = score(net, args.batch_size, **kwargs)
+        print("network: %-10s batch: %d  %.1f images/sec"
+              % (net, args.batch_size, fps))

@@ -1,0 +1,93 @@
+"""Shared training-loop driver for the image-classification examples.
+
+Mirrors the reference's example/image-classification/common/fit.py:113-210
+(kvstore creation, optimizer wiring, LR schedule, checkpoint callbacks,
+Speedometer) on the TPU-native stack.
+"""
+import logging
+import os
+
+import mxnet_tpu as mx
+
+
+def add_fit_args(parser):
+    parser.add_argument("--network", type=str, default="mlp")
+    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--num-epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--momentum", type=float, default=0.9)
+    parser.add_argument("--wd", type=float, default=1e-4)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--optimizer", type=str, default="sgd")
+    parser.add_argument("--kv-store", type=str, default="local")
+    parser.add_argument("--lr-factor", type=float, default=0.1)
+    parser.add_argument("--lr-step-epochs", type=str, default="")
+    parser.add_argument("--disp-batches", type=int, default=20)
+    parser.add_argument("--model-prefix", type=str, default=None)
+    parser.add_argument("--load-epoch", type=int, default=None)
+    parser.add_argument("--synthetic", action="store_true", default=False)
+    return parser
+
+
+def _lr_scheduler(args, epoch_size):
+    if not args.lr_step_epochs:
+        return args.lr, None
+    epochs = [int(e) for e in args.lr_step_epochs.split(",") if e]
+    begin = args.load_epoch or 0
+    lr = args.lr
+    for e in epochs:
+        if begin >= e:
+            lr *= args.lr_factor
+    steps = [epoch_size * (e - begin) for e in epochs if e > begin]
+    if not steps:
+        return lr, None
+    return lr, mx.lr_scheduler.MultiFactorScheduler(step=steps,
+                                                    factor=args.lr_factor)
+
+
+def fit(args, network, data_loader):
+    """Train `network` (a Symbol) on the iterators from `data_loader(args)`."""
+    logging.basicConfig(level=logging.INFO)
+    kv = mx.kvstore.create(args.kv_store)
+    train, val = data_loader(args)
+
+    arg_params, aux_params = None, None
+    if args.model_prefix and args.load_epoch is not None:
+        _, arg_params, aux_params = mx.model.load_checkpoint(
+            args.model_prefix, args.load_epoch)
+
+    epoch_size = max(train.num_data // args.batch_size, 1) \
+        if hasattr(train, "num_data") else 100
+    lr, sched = _lr_scheduler(args, epoch_size)
+
+    optimizer_params = {
+        "learning_rate": lr,
+        "wd": args.wd,
+        "rescale_grad": 1.0 / args.batch_size,
+    }
+    if args.optimizer in ("sgd", "nag"):
+        optimizer_params["momentum"] = args.momentum
+    if sched is not None:
+        optimizer_params["lr_scheduler"] = sched
+
+    checkpoint = None
+    if args.model_prefix:
+        os.makedirs(os.path.dirname(args.model_prefix) or ".", exist_ok=True)
+        checkpoint = mx.callback.do_checkpoint(args.model_prefix)
+
+    mod = mx.mod.Module(network, context=mx.current_context())
+    mod.fit(train,
+            eval_data=val,
+            eval_metric=["acc"],
+            optimizer=args.optimizer,
+            optimizer_params=optimizer_params,
+            arg_params=arg_params,
+            aux_params=aux_params,
+            initializer=mx.initializer.Xavier(magnitude=2.0),
+            num_epoch=args.num_epochs,
+            begin_epoch=args.load_epoch or 0,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       args.disp_batches),
+            epoch_end_callback=checkpoint,
+            kvstore=kv)
+    return mod

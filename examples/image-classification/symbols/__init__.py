@@ -1,0 +1,12 @@
+"""Symbol model zoo for the image-classification examples
+(mirrors reference example/image-classification/symbols/)."""
+from . import mlp, lenet, alexnet, resnet
+
+
+def get_symbol(network, num_classes, **kwargs):
+    return {
+        "mlp": mlp,
+        "lenet": lenet,
+        "alexnet": alexnet,
+        "resnet": resnet,
+    }[network].get_symbol(num_classes=num_classes, **kwargs)

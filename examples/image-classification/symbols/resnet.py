@@ -1,0 +1,85 @@
+"""ResNet symbol (mirrors reference symbols/resnet.py — v1 bottleneck/basic
+units, configurable depth; BN+relu pre-activation omitted for the v1 form)."""
+import mxnet_tpu as mx
+
+
+def residual_unit(data, num_filter, stride, dim_match, name,
+                  bottle_neck=True):
+    if bottle_neck:
+        body = mx.sym.Convolution(data=data, num_filter=num_filter // 4,
+                                  kernel=(1, 1), stride=stride, no_bias=True,
+                                  name=name + "_conv1")
+        body = mx.sym.BatchNorm(data=body, fix_gamma=False, eps=2e-5,
+                                momentum=0.9, name=name + "_bn1")
+        body = mx.sym.Activation(data=body, act_type="relu")
+        body = mx.sym.Convolution(data=body, num_filter=num_filter // 4,
+                                  kernel=(3, 3), pad=(1, 1), no_bias=True,
+                                  name=name + "_conv2")
+        body = mx.sym.BatchNorm(data=body, fix_gamma=False, eps=2e-5,
+                                momentum=0.9, name=name + "_bn2")
+        body = mx.sym.Activation(data=body, act_type="relu")
+        body = mx.sym.Convolution(data=body, num_filter=num_filter,
+                                  kernel=(1, 1), no_bias=True,
+                                  name=name + "_conv3")
+        body = mx.sym.BatchNorm(data=body, fix_gamma=False, eps=2e-5,
+                                momentum=0.9, name=name + "_bn3")
+    else:
+        body = mx.sym.Convolution(data=data, num_filter=num_filter,
+                                  kernel=(3, 3), stride=stride, pad=(1, 1),
+                                  no_bias=True, name=name + "_conv1")
+        body = mx.sym.BatchNorm(data=body, fix_gamma=False, eps=2e-5,
+                                momentum=0.9, name=name + "_bn1")
+        body = mx.sym.Activation(data=body, act_type="relu")
+        body = mx.sym.Convolution(data=body, num_filter=num_filter,
+                                  kernel=(3, 3), pad=(1, 1), no_bias=True,
+                                  name=name + "_conv2")
+        body = mx.sym.BatchNorm(data=body, fix_gamma=False, eps=2e-5,
+                                momentum=0.9, name=name + "_bn2")
+    if dim_match:
+        shortcut = data
+    else:
+        shortcut = mx.sym.Convolution(data=data, num_filter=num_filter,
+                                      kernel=(1, 1), stride=stride,
+                                      no_bias=True, name=name + "_sc")
+        shortcut = mx.sym.BatchNorm(data=shortcut, fix_gamma=False, eps=2e-5,
+                                    momentum=0.9, name=name + "_sc_bn")
+    return mx.sym.Activation(data=body + shortcut, act_type="relu")
+
+
+def get_symbol(num_classes=1000, num_layers=18, image_shape="3,224,224",
+               **kwargs):
+    configs = {
+        18: ([2, 2, 2, 2], False),
+        34: ([3, 4, 6, 3], False),
+        50: ([3, 4, 6, 3], True),
+        101: ([3, 4, 23, 3], True),
+        152: ([3, 8, 36, 3], True),
+    }
+    units, bottle_neck = configs[num_layers]
+    filter_list = [256, 512, 1024, 2048] if bottle_neck \
+        else [64, 128, 256, 512]
+
+    data = mx.sym.Variable("data")
+    body = mx.sym.Convolution(data=data, num_filter=64, kernel=(7, 7),
+                              stride=(2, 2), pad=(3, 3), no_bias=True,
+                              name="conv0")
+    body = mx.sym.BatchNorm(data=body, fix_gamma=False, eps=2e-5,
+                            momentum=0.9, name="bn0")
+    body = mx.sym.Activation(data=body, act_type="relu")
+    body = mx.sym.Pooling(data=body, kernel=(3, 3), stride=(2, 2),
+                          pad=(1, 1), pool_type="max")
+
+    for i, (n_units, n_filter) in enumerate(zip(units, filter_list)):
+        stride = (1, 1) if i == 0 else (2, 2)
+        body = residual_unit(body, n_filter, stride, False,
+                             "stage%d_unit1" % (i + 1), bottle_neck)
+        for j in range(n_units - 1):
+            body = residual_unit(body, n_filter, (1, 1), True,
+                                 "stage%d_unit%d" % (i + 1, j + 2),
+                                 bottle_neck)
+
+    pool = mx.sym.Pooling(data=body, global_pool=True, kernel=(7, 7),
+                          pool_type="avg")
+    flat = mx.sym.Flatten(data=pool)
+    fc1 = mx.sym.FullyConnected(data=flat, num_hidden=num_classes, name="fc1")
+    return mx.sym.SoftmaxOutput(data=fc1, name="softmax")

@@ -1,0 +1,67 @@
+"""Train an MLP/LeNet on MNIST — the reference's first baseline workload
+(example/image-classification/train_mnist.py).
+
+Uses mx.io.MNISTIter when the idx-ubyte files are present; otherwise
+generates synthetic MNIST-shaped data so the script runs without
+downloads.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(__file__))
+import mxnet_tpu as mx  # noqa: E402
+from common import fit  # noqa: E402
+import symbols  # noqa: E402
+
+
+def _synthetic_mnist(n=2048, seed=0):
+    """MNIST-shaped, linearly separable-ish digit blobs."""
+    rng = np.random.RandomState(seed)
+    protos = rng.rand(10, 1, 28, 28).astype(np.float32)
+    y = rng.randint(0, 10, n)
+    x = protos[y] + 0.3 * rng.rand(n, 1, 28, 28).astype(np.float32)
+    return x, y.astype(np.float32)
+
+
+def get_mnist_iter(args):
+    flat = args.network == "mlp"
+    data_dir = getattr(args, "data_dir", "data")
+    train_img = os.path.join(data_dir, "train-images-idx3-ubyte")
+    if not args.synthetic and os.path.exists(train_img):
+        train = mx.io.MNISTIter(
+            image=train_img,
+            label=os.path.join(data_dir, "train-labels-idx1-ubyte"),
+            batch_size=args.batch_size, shuffle=True, flat=flat)
+        val = mx.io.MNISTIter(
+            image=os.path.join(data_dir, "t10k-images-idx3-ubyte"),
+            label=os.path.join(data_dir, "t10k-labels-idx1-ubyte"),
+            batch_size=args.batch_size, flat=flat)
+        return train, val
+    x, y = _synthetic_mnist()
+    if flat:
+        x = x.reshape(len(x), -1)
+    split = int(0.9 * len(x))
+    train = mx.io.NDArrayIter(x[:split], y[:split], args.batch_size,
+                              shuffle=True)
+    val = mx.io.NDArrayIter(x[split:], y[split:], args.batch_size)
+    return train, val
+
+
+def main():
+    parser = argparse.ArgumentParser(description="train mnist")
+    parser.add_argument("--data-dir", type=str, default="data")
+    fit.add_fit_args(parser)
+    args = parser.parse_args()
+    net = symbols.get_symbol(args.network, args.num_classes)
+    mod = fit.fit(args, net, get_mnist_iter)
+    train, val = get_mnist_iter(args)
+    acc = dict(mod.score(val, mx.metric.Accuracy()))["accuracy"]
+    print("final validation accuracy: %.4f" % acc)
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+"""Smoke-run every example in fast/synthetic mode.
+
+Each example runs in its own subprocess (clean JAX state). Used by
+tests/test_examples.py and handy as a one-shot sanity sweep.
+"""
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+EXAMPLES = [
+    ("image-classification/train_mnist.py",
+     ["--synthetic", "--num-epochs", "2", "--network", "mlp"]),
+    ("image-classification/benchmark_score.py",
+     ["--networks", "alexnet", "--batch-size", "4"]),
+    ("gluon/word_language_model/train.py",
+     ["--epochs", "1", "--vocab-size", "60", "--nhid", "32",
+      "--emsize", "16", "--bptt", "8", "--batch-size", "8"]),
+    ("rnn/bucketing_lstm.py",
+     ["--num-epochs", "1", "--num-hidden", "32", "--batch-size", "8"]),
+    ("sparse/linear_classification.py",
+     ["--num-epochs", "2", "--num-features", "200"]),
+    ("ssd/train_ssd.py", ["--iters", "2", "--batch-size", "4"]),
+    ("model-parallel/lstm_stages.py", ["--num-stages", "4"]),
+]
+
+
+def run_one(rel, extra, force_cpu=True):
+    env = dict(os.environ)
+    repo_root = os.path.dirname(HERE)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    if force_cpu:
+        env["MXNET_TPU_FORCE_CPU"] = "1"
+        env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    script = os.path.join(HERE, rel)
+    return subprocess.run([sys.executable, script] + extra, env=env,
+                          capture_output=True, text=True, timeout=900)
+
+
+def main():
+    failures = []
+    for rel, extra in EXAMPLES:
+        print("== %s" % rel, flush=True)
+        proc = run_one(rel, extra)
+        tail = "\n".join(proc.stdout.strip().splitlines()[-3:])
+        print(tail)
+        if proc.returncode != 0:
+            failures.append(rel)
+            print(proc.stderr[-2000:])
+    if failures:
+        print("FAILED: %s" % ", ".join(failures))
+        sys.exit(1)
+    print("all examples passed")
+
+
+if __name__ == "__main__":
+    main()

@@ -15,6 +15,15 @@ Typical use matches the reference::
         y = (x * 2).sum()
     y.backward()
 """
+import os as _os
+
+if _os.environ.get("MXNET_TPU_FORCE_CPU", "") in ("1", "true"):
+    # debugging/CI escape hatch (the reference's MXNET_ENGINE_TYPE=
+    # NaiveEngine analogue): force the host platform before any backend
+    # init, overriding site-level accelerator selection
+    import jax as _jax
+    _jax.config.update("jax_platforms", "cpu")
+
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus
 from . import ops

@@ -126,11 +126,17 @@ def invoke(op, inputs, kwargs, out=None, name=None):
     record = (is_recording() and not op.no_grad
               and any(nd is not None and nd._tape is not None for nd in nds))
 
-    if record:
+    if op.jit_cache:
+        jfn, dyn = op.jitted(params)
+
+        def _pure(*arrs):
+            return jfn(arrs, dyn)
+    else:
         def _pure(*arrs):
             outs = op.fn(*arrs, **params)
             return outs if isinstance(outs, tuple) else (outs,)
 
+    if record:
         outs, vjp_fn = jax.vjp(_pure, *raw)
         parents = [nd._tape if (nd is not None and nd._tape is not None) else None
                    for nd in nds]
@@ -138,7 +144,7 @@ def invoke(op, inputs, kwargs, out=None, name=None):
                         [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs],
                         op.name)
     else:
-        outs = op.apply(raw, params)
+        outs = _pure(*raw)
         node = None
 
     # stateful aux updates (BatchNorm moving stats). During graph capture

@@ -17,7 +17,7 @@ from .registry import register
 _NEG_INF = -1e30
 
 
-@register("_ctc_loss", nin=-1, arg_names=["data", "label"],
+@register("_ctc_loss", nin=-1, jit=True, arg_names=["data", "label"],
           aliases=("ctc_loss", "_contrib_ctc_loss"))
 def ctc_loss(data, label, data_lengths=None, label_lengths=None):
     """data: (N, T, C) unnormalised activations; label: (N, L) with classes

@@ -96,7 +96,7 @@ def _conv_nd(data, weight, bias, kernel, stride, dilate, pad, num_group,
     return out
 
 
-@register("Convolution", nin=3, arg_names=["data", "weight", "bias"],
+@register("Convolution", nin=3, jit=True, arg_names=["data", "weight", "bias"],
           defaults={"kernel": (), "stride": (), "dilate": (), "pad": (),
                     "num_filter": 0, "num_group": 1, "no_bias": False,
                     "workspace": 1024, "cudnn_tune": None, "cudnn_off": False,
@@ -116,7 +116,7 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
                     no_bias)
 
 
-@register("Deconvolution", nin=3, arg_names=["data", "weight", "bias"],
+@register("Deconvolution", nin=3, jit=True, arg_names=["data", "weight", "bias"],
           defaults={"kernel": (), "stride": (), "dilate": (), "pad": (),
                     "adj": (), "target_shape": (), "num_filter": 0,
                     "num_group": 1, "no_bias": True, "workspace": 512,
@@ -237,7 +237,7 @@ def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
 # Normalisation
 # ---------------------------------------------------------------------------
 
-@register("BatchNorm", nin=5,
+@register("BatchNorm", nin=5, jit=True,
           arg_names=["data", "gamma", "beta", "moving_mean", "moving_var"],
           nout=3,
           defaults={"eps": 1e-3, "momentum": 0.9, "fix_gamma": True,

@@ -40,7 +40,7 @@ class OpDef:
     """
 
     def __init__(self, name, fn, nin=1, nout=1, arg_names=None, defaults=None,
-                 mutate=(), no_grad=False, doc=None):
+                 mutate=(), no_grad=False, doc=None, jit=False):
         self.name = name
         self.fn = fn
         self.nin = nin
@@ -51,6 +51,14 @@ class OpDef:
         self.defaults = dict(defaults or {})
         self.mutate = tuple(mutate)
         self.no_grad = no_grad
+        # Composite ops (scan-heavy RNN/CTC, conv, per-step optimizer
+        # updates) re-trace their whole Python body on every eager call;
+        # jit=True caches one compiled program per (static-params, avals)
+        # signature — the eager analogue of the reference's cached engine
+        # ops (graph_executor.cc InitCachedOps). Off by default: ops fed
+        # varying shapes (image augmenters) would thrash the cache.
+        self.jit_cache = jit
+        self._jit_fns = {}
         self.doc = doc or (fn.__doc__ if fn is not None else None)
         # Execution-context needs, discovered from the signature: ops that
         # behave differently at train time declare a `_train` kwarg, random
@@ -82,14 +90,46 @@ class OpDef:
         out = self.fn(*arrays, **params)
         return out if isinstance(out, tuple) else (out,)
 
+    def jitted(self, params):
+        """Return (jitted_fn, dynamic_params) for this op.
+
+        ``jitted_fn(arrays_tuple, dynamic_params_dict)`` runs the cached
+        compiled program; hashable params are baked in as statics,
+        array-valued ones (the rng key) stay traced operands.
+        """
+        import jax
+        static, dynamic = [], {}
+        for k, v in params.items():
+            if isinstance(v, (list, tuple)):
+                v = tuple(v)
+            try:
+                hash(v)
+                static.append((k, v))
+            except TypeError:
+                dynamic[k] = v
+        key = (tuple(sorted(static)), tuple(sorted(dynamic)))
+        fn = self._jit_fns.get(key)
+        if fn is None:
+            static_params = dict(static)
+            op_fn = self.fn
+
+            def _pure(arrs, dyn):
+                out = op_fn(*arrs, **static_params, **dyn)
+                return out if isinstance(out, tuple) else (out,)
+
+            fn = jax.jit(_pure)
+            self._jit_fns[key] = fn
+        return fn, dynamic
+
 
 def register(name, nin=1, nout=1, arg_names=None, defaults=None, mutate=(),
-             no_grad=False, aliases=()):
+             no_grad=False, aliases=(), jit=False):
     """Decorator registering a pure-jax function as an operator."""
 
     def _reg(fn):
         op = OpDef(name, fn, nin=nin, nout=nout, arg_names=arg_names,
-                   defaults=defaults, mutate=mutate, no_grad=no_grad)
+                   defaults=defaults, mutate=mutate, no_grad=no_grad,
+                   jit=jit)
         if name in _OPS:
             raise MXNetError("op %r already registered" % name)
         _OPS[name] = op

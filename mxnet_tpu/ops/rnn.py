@@ -126,7 +126,7 @@ def _run_layer(x, h0, c0, w_ih, w_hh, b_ih, b_hh, mode, H, reverse=False):
     return ys, final
 
 
-@register("RNN", nin=4, arg_names=["data", "parameters", "state", "state_cell"],
+@register("RNN", nin=4, jit=True, arg_names=["data", "parameters", "state", "state_cell"],
           nout=3,
           defaults={"state_size": 0, "num_layers": 1, "mode": "lstm",
                     "bidirectional": False, "p": 0.0, "state_outputs": False,

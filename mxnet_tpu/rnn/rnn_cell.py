@@ -75,8 +75,7 @@ class BaseRNNCell:
                 shape = info.pop("shape", None)
                 state = func(name="%sbegin_state_%d" % (self._prefix,
                                                         self._init_counter),
-                             shape=shape, **kwargs) if func is sym_mod.zeros \
-                    else func(**info, **kwargs)
+                             shape=shape, **kwargs)
             else:
                 state = func(name="%sbegin_state_%d" % (self._prefix,
                                                         self._init_counter),
@@ -87,18 +86,54 @@ class BaseRNNCell:
     def __call__(self, inputs, states):
         raise NotImplementedError
 
+    def _symbolic_begin_state(self, ref, reduce_axes):
+        """Default zero states whose batch dim comes from ``ref``.
+
+        The reference writes ``sym.zeros(shape=(0, H))`` and relies on
+        MXNet's 0=unknown bidirectional shape inference; forward-only XLA
+        inference can't see through that, so the unknown dim is instead
+        taken from the input symbol: a zeroed batch vector (ref summed
+        over ``reduce_axes``) broadcast against a zeros literal. XLA
+        constant-folds the whole expression to a plain zeros buffer."""
+        zero_vec = sym_mod.sum(ref * 0, axis=reduce_axes)  # shape (N,)
+
+        def _zeros_from_ref(name=None, shape=None, **kwargs):
+            if not shape or 0 not in shape:
+                return sym_mod.zeros(name=name, shape=shape, **kwargs)
+            shape = tuple(shape)
+            i = shape.index(0)
+            col = sym_mod.reshape(
+                zero_vec, shape=(1,) * i + (-1,) + (1,) * (len(shape) - i - 1))
+            base = sym_mod.zeros(shape=tuple(1 if d == 0 else d
+                                             for d in shape))
+            return sym_mod.broadcast_add(base, col)
+
+        return self.begin_state(func=_zeros_from_ref)
+
+    def _default_begin_state(self, inputs, layout):
+        """begin_state for unroll when the caller gave none: symbolic
+        inputs get batch-inferred zeros, arrays get plain zeros."""
+        if isinstance(inputs, Symbol):
+            n_axis = layout.find("N")
+            return self._symbolic_begin_state(
+                inputs, tuple(i for i in range(3) if i != n_axis))
+        if isinstance(inputs, (list, tuple)) and inputs \
+                and isinstance(inputs[0], Symbol):
+            return self._symbolic_begin_state(inputs[0], (1,))
+        return self.begin_state()
+
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         """(parity: BaseRNNCell.unroll)"""
         self.reset()
         axis = layout.find("T")
         if begin_state is None:
-            begin_state = self.begin_state()
-        states = begin_state
+            begin_state = self._default_begin_state(inputs, layout)
         if isinstance(inputs, Symbol):
             steps = sym_mod.SliceChannel(inputs, num_outputs=length,
                                          axis=axis, squeeze_axis=True)
             inputs = [steps[i] for i in range(length)]
+        states = begin_state
         outputs = []
         for i in range(length):
             output, states = self(inputs[i], states)
@@ -257,7 +292,7 @@ class FusedRNNCell(BaseRNNCell):
                merge_outputs=None):
         self.reset()
         if begin_state is None:
-            begin_state = self.begin_state()
+            begin_state = self._default_begin_state(inputs, layout)
         if layout == "NTC":
             inputs = sym_mod.swapaxes(inputs, dim1=0, dim2=1)
         states = begin_state
@@ -406,7 +441,7 @@ class BidirectionalCell(BaseRNNCell):
         self.reset()
         axis = layout.find("T")
         if begin_state is None:
-            begin_state = self.begin_state()
+            begin_state = self._default_begin_state(inputs, layout)
         l_cell, r_cell = self._cells
         n_l = len(l_cell.state_info)
         l_out, l_states = l_cell.unroll(length, inputs, begin_state[:n_l],

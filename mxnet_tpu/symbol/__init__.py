@@ -18,3 +18,4 @@ def arange(start, stop=None, step=1.0, repeat=1, dtype="float32", **kwargs):
                                 repeat=repeat, dtype=dtype, **kwargs)
 
 from . import contrib  # noqa: E402,F401
+from . import sparse   # noqa: E402,F401

@@ -1,0 +1,44 @@
+"""Examples smoke tests — each example runs end-to-end in a subprocess
+(mirrors the reference's nightly test_tutorial.py approach of executing
+the shipped example scripts)."""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
+import run_all  # noqa: E402
+
+
+def _run(rel, extra):
+    proc = run_all.run_one(rel, extra)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc.stdout
+
+
+def test_train_mnist_example():
+    out = _run("image-classification/train_mnist.py",
+               ["--synthetic", "--num-epochs", "2", "--network", "mlp"])
+    acc = float(out.strip().splitlines()[-1].split()[-1])
+    assert acc > 0.9
+
+
+def test_model_parallel_example():
+    out = _run("model-parallel/lstm_stages.py", ["--num-stages", "4"])
+    assert "PartitionSpec('stage',)" in out
+
+
+def test_ssd_example():
+    out = _run("ssd/train_ssd.py", ["--iters", "2", "--batch-size", "2"])
+    assert "detection output" in out
+
+
+@pytest.mark.slow
+def test_all_examples():
+    """Full sweep; run explicitly with -m slow (CI nightly analogue)."""
+    failures = []
+    for rel, extra in run_all.EXAMPLES:
+        proc = run_all.run_one(rel, extra)
+        if proc.returncode != 0:
+            failures.append((rel, proc.stderr[-500:]))
+    assert not failures, failures

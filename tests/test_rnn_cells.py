@@ -1,0 +1,88 @@
+"""RNN cell suite — parity with reference tests/python/unittest/test_rnn.py
+(cell unroll, fused cell, bidirectional, sequential stacks; default
+begin_state must bind without explicit batch shapes)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.rnn import (RNNCell, LSTMCell, GRUCell, FusedRNNCell,
+                           SequentialRNNCell, BidirectionalCell, DropoutCell)
+
+
+def _bind_run(outputs, batch=4, seq=5, feat=6):
+    exe = outputs.simple_bind(ctx=mx.current_context(),
+                              data=(batch, seq, feat))
+    for name, arr in exe.arg_dict.items():
+        if name != "data":
+            arr[:] = np.random.uniform(-0.1, 0.1, arr.shape)
+    exe.arg_dict["data"][:] = np.random.uniform(size=(batch, seq, feat))
+    return exe.forward()[0]
+
+
+def test_lstm_cell_unroll_default_state():
+    cell = LSTMCell(num_hidden=8, prefix="l_")
+    outputs, states = cell.unroll(5, inputs=mx.sym.Variable("data"),
+                                  merge_outputs=True)
+    out = _bind_run(outputs)
+    assert out.shape == (4, 5, 8)
+    assert len(states) == 2
+
+
+def test_rnn_gru_cells_unroll():
+    for cell in (RNNCell(num_hidden=8, prefix="r_"),
+                 GRUCell(num_hidden=8, prefix="g_")):
+        outputs, _ = cell.unroll(5, inputs=mx.sym.Variable("data"),
+                                 merge_outputs=True)
+        assert _bind_run(outputs).shape == (4, 5, 8)
+
+
+def test_fused_cell_unroll_default_state():
+    # regression: FusedRNNCell's (layers, 0, H) default state must bind
+    cell = FusedRNNCell(num_hidden=8, num_layers=2, mode="lstm",
+                        prefix="f_")
+    outputs, _ = cell.unroll(5, inputs=mx.sym.Variable("data"),
+                             merge_outputs=True)
+    assert _bind_run(outputs).shape == (4, 5, 8)
+
+
+def test_bidirectional_cell_default_state():
+    # regression: Bidirectional's concatenated default states must bind
+    cell = BidirectionalCell(LSTMCell(num_hidden=8, prefix="lf_"),
+                             LSTMCell(num_hidden=8, prefix="rb_"))
+    outputs, _ = cell.unroll(5, inputs=mx.sym.Variable("data"),
+                             merge_outputs=True)
+    assert _bind_run(outputs).shape == (4, 5, 16)
+
+
+def test_sequential_stack_with_dropout():
+    stack = SequentialRNNCell()
+    stack.add(LSTMCell(num_hidden=8, prefix="s0_"))
+    stack.add(DropoutCell(0.0, prefix="sd_"))
+    stack.add(LSTMCell(num_hidden=6, prefix="s1_"))
+    outputs, states = stack.unroll(5, inputs=mx.sym.Variable("data"),
+                                   merge_outputs=True)
+    assert _bind_run(outputs).shape == (4, 5, 6)
+
+
+def test_cell_explicit_begin_state_matches_zeros():
+    cell = LSTMCell(num_hidden=8, prefix="e_")
+    data = mx.sym.Variable("data")
+    out_default, _ = cell.unroll(3, inputs=data, merge_outputs=True)
+    cell2 = LSTMCell(num_hidden=8, prefix="e_", params=cell.params)
+    explicit = [mx.sym.Variable("h0"), mx.sym.Variable("c0")]
+    out_explicit, _ = cell2.unroll(3, inputs=data,
+                                   begin_state=explicit,
+                                   merge_outputs=True)
+    def fill(exe):
+        for name, arr in exe.arg_dict.items():
+            # name-deterministic values so both executors agree per-param
+            arr[:] = (np.arange(arr.size).reshape(arr.shape) % 7 - 3) * 0.03
+    exe1 = out_default.simple_bind(ctx=mx.current_context(), data=(2, 3, 4))
+    fill(exe1)
+    r1 = exe1.forward()[0].asnumpy()
+    exe2 = out_explicit.simple_bind(ctx=mx.current_context(),
+                                    data=(2, 3, 4), h0=(2, 8), c0=(2, 8))
+    fill(exe2)
+    for name in ("h0", "c0"):
+        exe2.arg_dict[name][:] = 0
+    r2 = exe2.forward()[0].asnumpy()
+    np.testing.assert_allclose(r1, r2, rtol=1e-5, atol=1e-6)
