@@ -30,11 +30,9 @@ class RNNModel(gluon.Block):
 
     def forward(self, inputs, hidden=None):
         emb = self.drop(self.encoder(inputs))
-        if hidden is not None:
-            output, hidden = self.rnn(emb, hidden)
-        else:
-            output = self.rnn(emb)
-            hidden = None
+        if hidden is None:
+            hidden = self.rnn.begin_state(batch_size=inputs.shape[1])
+        output, hidden = self.rnn(emb, hidden)
         output = self.drop(output)
         decoded = self.decoder(output.reshape((-1, self.hidden_dim)))
         return decoded, hidden
@@ -82,12 +80,16 @@ def main():
 
     for epoch in range(args.epochs):
         total_loss, n_batches = 0.0, 0
+        hidden = None
         tic = time.time()
         for i in range(0, data.shape[0] - 1 - args.bptt, args.bptt):
             x = mx.nd.array(data[i:i + args.bptt])
             y = mx.nd.array(data[i + 1:i + 1 + args.bptt].reshape(-1))
+            if hidden is not None:
+                # truncated BPTT: carry state across chunks, cut the graph
+                hidden = [h.detach() for h in hidden]
             with mx.autograd.record():
-                out, _ = model(x)
+                out, hidden = model(x, hidden)
                 loss = loss_fn(out, y).sum()
             loss.backward()
             grads = [p.grad() for p in model.collect_params().values()

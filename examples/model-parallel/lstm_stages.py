@@ -18,8 +18,10 @@ def main():
     parser.add_argument("--hidden", type=int, default=64)
     parser.add_argument("--seq-len", type=int, default=16)
     parser.add_argument("--batch-size", type=int, default=8)
-    parser.add_argument("--cpu-mesh", action="store_true", default=True,
-                        help="run on a virtual CPU mesh (no pod attached)")
+    parser.add_argument("--cpu-mesh", action=argparse.BooleanOptionalAction,
+                        default=True,
+                        help="run on a virtual CPU mesh; --no-cpu-mesh uses "
+                             "the attached accelerator devices")
     args = parser.parse_args()
 
     if args.cpu_mesh:

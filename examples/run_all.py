@@ -42,7 +42,12 @@ def main():
     failures = []
     for rel, extra in EXAMPLES:
         print("== %s" % rel, flush=True)
-        proc = run_one(rel, extra)
+        try:
+            proc = run_one(rel, extra)
+        except subprocess.TimeoutExpired:
+            failures.append(rel)
+            print("TIMED OUT")
+            continue
         tail = "\n".join(proc.stdout.strip().splitlines()[-3:])
         print(tail)
         if proc.returncode != 0:

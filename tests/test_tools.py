@@ -1,0 +1,86 @@
+"""tools/ suite — im2rec packing, parse_log, launch.py multi-process SPMD
+(parity model: the reference exercised tools/launch.py --launcher local in
+tests/nightly/dist_sync_kvstore.py)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+TOOLS = os.path.join(REPO, "tools")
+
+
+def _run(cmd, **kw):
+    env = dict(os.environ)
+    env["MXNET_TPU_FORCE_CPU"] = "1"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run([sys.executable] + cmd, capture_output=True,
+                          text=True, timeout=300, env=env, **kw)
+
+
+def test_im2rec_roundtrip(tmp_path):
+    PIL = pytest.importorskip("PIL.Image")
+    for cls in ("a", "b"):
+        os.makedirs(tmp_path / cls)
+        for i in range(2):
+            arr = (np.random.rand(16, 16, 3) * 255).astype(np.uint8)
+            PIL.fromarray(arr).save(str(tmp_path / cls / ("%d.jpg" % i)))
+    prefix = str(tmp_path / "data")
+    p = _run([os.path.join(TOOLS, "im2rec.py"), prefix, str(tmp_path),
+              "--list", "--recursive"])
+    assert p.returncode == 0, p.stderr
+    p = _run([os.path.join(TOOLS, "im2rec.py"), prefix, str(tmp_path)])
+    assert p.returncode == 0, p.stderr
+    assert os.path.exists(prefix + ".rec") and os.path.exists(prefix + ".idx")
+
+    from mxnet_tpu import recordio
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    header, img = recordio.unpack(rec.read_idx(0))
+    assert len(img) > 0
+    assert header.label in (0.0, 1.0)
+
+
+def test_parse_log():
+    log = ("INFO:root:Epoch[0] Batch [20]\tSpeed: 100.5 samples/sec\t"
+           "accuracy=0.5\n"
+           "INFO:root:Epoch[0] Train-accuracy=0.9\n"
+           "INFO:root:Epoch[0] Validation-accuracy=0.8\n")
+    p = subprocess.run([sys.executable, os.path.join(TOOLS, "parse_log.py"),
+                        "-", "--format", "tsv"], input=log,
+                       capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0, p.stderr
+    lines = p.stdout.strip().splitlines()
+    assert lines[0].split("\t") == ["epoch", "speed", "train-accuracy",
+                                    "validation-accuracy"]
+    assert lines[1].split("\t") == ["0", "100.5", "0.9", "0.8"]
+
+
+def test_launch_local_two_process_spmd(tmp_path):
+    """launch.py forks 2 workers that form one jax.distributed job and
+    run a cross-process allgather (the dist_sync smoke)."""
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import sys; sys.path.insert(0, %r)\n" % REPO +
+        "import mxnet_tpu as mx\n"
+        "import jax, jax.numpy as jnp\n"
+        "from jax.experimental import multihost_utils\n"
+        "assert jax.process_count() == 2\n"
+        "kv = mx.kv.create('dist_sync')\n"
+        "v = multihost_utils.process_allgather("
+        "jnp.array([float(kv.rank + 1)]))\n"
+        "assert float(v.sum()) == 3.0\n"
+        "print('OK rank', kv.rank)\n")
+    p = _run([os.path.join(TOOLS, "launch.py"), "-n", "2",
+              "--force-cpu", "--port", "9411",
+              sys.executable, str(script)])
+    assert p.returncode == 0, p.stderr
+    assert p.stdout.count("OK rank") == 2
+
+
+def test_bandwidth_probe():
+    p = _run([os.path.join(TOOLS, "bandwidth", "measure.py"),
+              "--force-cpu", "--size-mb", "1", "--rounds", "2"])
+    assert p.returncode == 0, p.stderr
+    assert "GB/s" in p.stdout

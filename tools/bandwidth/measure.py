@@ -1,0 +1,59 @@
+"""KVStore bandwidth probe (parity: reference tools/bandwidth/measure.py):
+times push(grad)/pull(weight) rounds over the device mesh and reports
+effective all-reduce GB/s — the number the reference measured for its
+CommCPU/CommDevice/NCCL backends, here for XLA collectives over ICI.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--kvstore", type=str, default="device")
+    parser.add_argument("--num-shards", type=int, default=4,
+                        help="simulated devices pushing per key")
+    parser.add_argument("--size-mb", type=float, default=16.0)
+    parser.add_argument("--rounds", type=int, default=10)
+    parser.add_argument("--force-cpu", action="store_true")
+    args = parser.parse_args()
+
+    if args.force_cpu:
+        os.environ["MXNET_TPU_FORCE_CPU"] = "1"
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+    import numpy as np
+    import mxnet_tpu as mx
+
+    n = int(args.size_mb * 1024 * 1024 / 4)
+    shape = (n,)
+    kv = mx.kv.create(args.kvstore)
+    kv.init(0, mx.nd.zeros(shape))
+    shards = [mx.nd.array(np.full(shape, i + 1, np.float32))
+              for i in range(args.num_shards)]
+    out = mx.nd.zeros(shape)
+
+    # warmup
+    kv.push(0, shards)
+    kv.pull(0, out=out)
+    out.wait_to_read()
+
+    tic = time.time()
+    for _ in range(args.rounds):
+        kv.push(0, shards)
+        kv.pull(0, out=out)
+    out.wait_to_read()
+    dt = (time.time() - tic) / args.rounds
+    # bytes moved per round: each shard in + result out
+    gb = args.size_mb * (args.num_shards + 1) / 1024.0
+    print("kvstore=%s shards=%d size=%.0fMB: %.2f ms/round, %.2f GB/s"
+          % (args.kvstore, args.num_shards, args.size_mb, dt * 1e3,
+             gb / dt))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,147 @@
+"""im2rec — pack an image dataset into RecordIO (parity: reference
+tools/im2rec.py / im2rec.cc).
+
+Two modes, same CLI surface as the reference:
+  --list : walk an image directory and write a .lst index
+           (``index\tlabel\trelpath`` lines)
+  (pack) : read a .lst + image root and write .rec/.idx pair via
+           MXIndexedRecordIO, optionally resizing/re-encoding (PIL here;
+           the reference used OpenCV)
+"""
+import argparse
+import io
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+# a host-side packing tool never needs the accelerator; skip TPU init
+os.environ.setdefault("MXNET_TPU_FORCE_CPU", "1")
+from mxnet_tpu import recordio  # noqa: E402
+
+EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def list_images(root, recursive=True):
+    cat = {}
+    entries = []
+    for dirpath, dirnames, filenames in sorted(os.walk(root)):
+        dirnames.sort()
+        for fname in sorted(filenames):
+            if os.path.splitext(fname)[1].lower() in EXTS:
+                rel = os.path.relpath(os.path.join(dirpath, fname), root)
+                label_dir = os.path.dirname(rel) or "."
+                if label_dir not in cat:
+                    cat[label_dir] = len(cat)
+                entries.append((rel, cat[label_dir]))
+        if not recursive:
+            break
+    return entries
+
+
+def write_list(args):
+    entries = list_images(args.root, recursive=args.recursive)
+    if args.shuffle:
+        random.Random(100).shuffle(entries)
+    chunks = max(args.chunks, 1)
+    per = (len(entries) + chunks - 1) // chunks if entries else 0
+    for c in range(chunks):
+        suffix = "" if chunks == 1 else "_%d" % c
+        path = args.prefix + suffix + ".lst"
+        with open(path, "w") as f:
+            for i, (rel, label) in enumerate(
+                    entries[c * per:(c + 1) * per]):
+                f.write("%d\t%f\t%s\n" % (c * per + i, float(label), rel))
+        print("wrote %s" % path)
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            labels = [float(x) for x in parts[1:-1]]
+            yield idx, labels, parts[-1]
+
+
+def _encode(path, args):
+    with open(path, "rb") as f:
+        data = f.read()
+    # pass raw bytes through unless the user asked for a transform —
+    # re-encoding losslessly-stored images unprompted would degrade them
+    if args.resize <= 0 and args.quality is None:
+        return data
+    try:
+        from PIL import Image
+    except ImportError:
+        return data
+    img = Image.open(io.BytesIO(data)).convert("RGB")
+    if args.resize > 0:
+        w, h = img.size
+        scale = args.resize / min(w, h)
+        img = img.resize((max(1, int(w * scale)), max(1, int(h * scale))))
+    buf = io.BytesIO()
+    img.save(buf, format="JPEG",
+             quality=args.quality if args.quality else 95)
+    return buf.getvalue()
+
+
+def write_record(args, lst_path):
+    prefix = os.path.splitext(lst_path)[0]
+    record = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    count = 0
+    for idx, labels, rel in read_list(lst_path):
+        fullpath = os.path.join(args.root, rel)
+        try:
+            data = _encode(fullpath, args)
+        except Exception as e:  # noqa: BLE001 — reference also skips+logs
+            print("skipping %s: %s" % (rel, e))
+            continue
+        if len(labels) == 1:
+            header = recordio.IRHeader(0, labels[0], idx, 0)
+        else:
+            header = recordio.IRHeader(0, labels, idx, 0)
+        record.write_idx(idx, recordio.pack(header, data))
+        count += 1
+        if count % 1000 == 0:
+            print("packed %d images" % count)
+    record.close()
+    print("wrote %s.rec (%d images)" % (prefix, count))
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Create an image list or RecordIO file")
+    parser.add_argument("prefix", help="prefix of .lst/.rec files")
+    parser.add_argument("root", help="image root directory")
+    parser.add_argument("--list", action="store_true",
+                        help="create an image list instead of a record")
+    parser.add_argument("--recursive", action=argparse.BooleanOptionalAction,
+                        default=False,
+                        help="walk subdirectories, labelling by directory "
+                             "(reference default: off)")
+    parser.add_argument("--shuffle", type=int, default=1)
+    parser.add_argument("--chunks", type=int, default=1)
+    parser.add_argument("--resize", type=int, default=0,
+                        help="resize shorter edge to this (0 = keep raw "
+                             "bytes untouched)")
+    parser.add_argument("--quality", type=int, default=None,
+                        help="JPEG re-encode quality (default: no "
+                             "re-encode unless --resize is set)")
+    args = parser.parse_args()
+
+    if args.list:
+        write_list(args)
+    else:
+        lst = args.prefix if args.prefix.endswith(".lst") \
+            else args.prefix + ".lst"
+        if not os.path.exists(lst):
+            raise SystemExit("list file %s not found (run --list first)"
+                             % lst)
+        write_record(args, lst)
+
+
+if __name__ == "__main__":
+    main()

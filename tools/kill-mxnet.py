@@ -1,0 +1,37 @@
+"""Kill stray distributed-training processes on this host
+(parity: reference tools/kill-mxnet.py)."""
+import argparse
+import os
+import signal
+import subprocess
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("pattern", nargs="?", default="mxnet_tpu",
+                        help="substring of the command line to match")
+    parser.add_argument("--signal", type=int, default=signal.SIGTERM)
+    args = parser.parse_args()
+
+    me = os.getpid()
+    out = subprocess.run(["ps", "-eo", "pid,args"], capture_output=True,
+                         text=True).stdout
+    killed = 0
+    for line in out.splitlines()[1:]:
+        parts = line.strip().split(None, 1)
+        if len(parts) != 2:
+            continue
+        pid, cmd = int(parts[0]), parts[1]
+        if args.pattern in cmd and "python" in cmd and pid != me \
+                and "kill-mxnet" not in cmd:
+            try:
+                os.kill(pid, args.signal)
+                killed += 1
+                print("killed %d: %s" % (pid, cmd[:80]))
+            except ProcessLookupError:
+                pass
+    print("%d processes signalled" % killed)
+
+
+if __name__ == "__main__":
+    main()

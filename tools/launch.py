@@ -1,0 +1,118 @@
+"""Distributed job launcher (parity: reference tools/launch.py, which drove
+the dmlc tracker to spawn scheduler/server/worker processes over
+ssh/mpi/yarn/sge/local).
+
+TPU-native design: training is single-program SPMD — there are no
+parameter-server roles. The launcher spawns N identical worker processes
+wired together through ``jax.distributed`` (coordinator address +
+process id), exactly how multi-host TPU pods are driven. ``--launcher
+local`` forks the N processes on this host (the reference's localhost
+test mode, used by tests/nightly/dist_sync_kvstore.py); ``--launcher
+ssh`` prints/executes per-host commands.
+
+Role env vars are still exported (DMLC_ROLE=worker, DMLC_NUM_WORKER,
+DMLC_WORKER_ID) so reference launch scripts keep working; servers
+(``-s``) are accepted and ignored with a note, since all-reduce replaces
+the parameter server.
+"""
+import argparse
+import os
+import shlex
+import signal
+import subprocess
+import sys
+
+
+def build_env(rank, args):
+    env = dict(os.environ)
+    env.update({
+        "DMLC_ROLE": "worker",
+        "DMLC_NUM_WORKER": str(args.num_workers),
+        "DMLC_WORKER_ID": str(rank),
+        "MXNET_TPU_COORDINATOR": "%s:%d" % (args.host, args.port),
+        "MXNET_TPU_NUM_PROCESSES": str(args.num_workers),
+        "MXNET_TPU_PROCESS_ID": str(rank),
+    })
+    if args.force_cpu:
+        env["MXNET_TPU_FORCE_CPU"] = "1"
+        env.setdefault("XLA_FLAGS",
+                       "--xla_force_host_platform_device_count=%d"
+                       % args.devices_per_worker)
+    return env
+
+
+def launch_local(args, command):
+    procs = []
+    for rank in range(args.num_workers):
+        procs.append(subprocess.Popen(command,
+                                      env=build_env(rank, args)))
+
+    def _terminate(*_):
+        for p in procs:
+            p.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, _terminate)
+    signal.signal(signal.SIGTERM, _terminate)
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    return rc
+
+
+def launch_ssh(args, command):
+    hosts = [h.strip() for h in open(args.hostfile) if h.strip()]
+    procs = []
+    for rank in range(args.num_workers):
+        host = hosts[rank % len(hosts)]
+        env = build_env(rank, args)
+        exports = " ".join("%s=%s" % (k, shlex.quote(v))
+                           for k, v in env.items()
+                           if k.startswith(("DMLC_", "MXNET_TPU_", "XLA_")))
+        dst = shlex.quote(args.sync_dst_dir) if args.sync_dst_dir else "~"
+        remote = "cd %s && env %s %s" % (
+            dst, exports, " ".join(shlex.quote(c) for c in command))
+        procs.append(subprocess.Popen(["ssh", "-o",
+                                       "StrictHostKeyChecking=no", host,
+                                       remote]))
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    return rc
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Launch a distributed job",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("-s", "--num-servers", type=int, default=0,
+                        help="accepted for CLI parity; all-reduce replaces "
+                             "parameter servers, so this is ignored")
+    parser.add_argument("--launcher", type=str, default="local",
+                        choices=["local", "ssh"])
+    parser.add_argument("-H", "--hostfile", type=str, default=None)
+    parser.add_argument("--host", type=str, default="127.0.0.1",
+                        help="coordinator address")
+    parser.add_argument("--port", type=int, default=9357)
+    parser.add_argument("--sync-dst-dir", type=str, default=None)
+    parser.add_argument("--force-cpu", action="store_true",
+                        help="run workers on virtual CPU devices (testing)")
+    parser.add_argument("--devices-per-worker", type=int, default=1)
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+
+    if not args.command:
+        parser.error("no command given")
+    if args.num_servers:
+        print("note: -s/--num-servers ignored — gradients are all-reduced "
+              "over the device mesh, no parameter-server processes exist")
+    if args.launcher == "ssh" and not args.hostfile:
+        parser.error("ssh launcher needs -H hostfile")
+
+    launch = launch_local if args.launcher == "local" else launch_ssh
+    sys.exit(launch(args, args.command))
+
+
+if __name__ == "__main__":
+    main()

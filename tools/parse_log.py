@@ -1,0 +1,70 @@
+"""Parse training logs into a table (parity: reference tools/parse_log.py).
+
+Reads the fit() logging format::
+
+    INFO:root:Epoch[0] Batch [20]  Speed: 16470.55 samples/sec  accuracy=1.0
+    INFO:root:Epoch[0] Train-accuracy=0.95
+    INFO:root:Epoch[0] Time cost=1.744
+    INFO:root:Epoch[0] Validation-accuracy=0.93
+
+and prints per-epoch train/validation metric + mean speed, markdown or
+tsv.
+"""
+import argparse
+import re
+import sys
+from collections import defaultdict
+
+RE_EPOCH_METRIC = re.compile(
+    r"Epoch\[(\d+)\]\s+(Train|Validation)-([\w-]+)=([0-9.eE+-]+)")
+RE_SPEED = re.compile(r"Epoch\[(\d+)\].*Speed:\s*([0-9.]+)")
+RE_TIME = re.compile(r"Epoch\[(\d+)\]\s+Time cost=([0-9.]+)")
+
+
+def parse(lines):
+    rows = defaultdict(dict)
+    speeds = defaultdict(list)
+    for line in lines:
+        m = RE_EPOCH_METRIC.search(line)
+        if m:
+            epoch, kind, metric, val = m.groups()
+            rows[int(epoch)]["%s-%s" % (kind.lower(), metric)] = float(val)
+        m = RE_SPEED.search(line)
+        if m:
+            speeds[int(m.group(1))].append(float(m.group(2)))
+        m = RE_TIME.search(line)
+        if m:
+            rows[int(m.group(1))]["time"] = float(m.group(2))
+    for epoch, s in speeds.items():
+        rows[epoch]["speed"] = sum(s) / len(s)
+    return dict(rows)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("logfile", nargs="?", default="-")
+    parser.add_argument("--format", choices=["markdown", "tsv"],
+                        default="markdown")
+    args = parser.parse_args()
+
+    f = sys.stdin if args.logfile == "-" else open(args.logfile)
+    rows = parse(f)
+    if not rows:
+        print("no epochs found")
+        return
+    cols = sorted({k for r in rows.values() for k in r})
+    if args.format == "markdown":
+        print("| epoch | " + " | ".join(cols) + " |")
+        print("| --- | " + " | ".join("---" for _ in cols) + " |")
+        fmt = "| %d | " + " | ".join("%s" for _ in cols) + " |"
+    else:
+        print("epoch\t" + "\t".join(cols))
+        fmt = "%d\t" + "\t".join("%s" for _ in cols)
+    for epoch in sorted(rows):
+        vals = tuple(("%.6g" % rows[epoch][c]) if c in rows[epoch] else "-"
+                     for c in cols)
+        print(fmt % ((epoch,) + vals))
+
+
+if __name__ == "__main__":
+    main()
