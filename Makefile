@@ -7,7 +7,12 @@ CXX ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -pthread -Wall
 LIB_DIR := mxnet_tpu/_lib
 
-all: $(LIB_DIR)/libmxtpu_io.so $(LIB_DIR)/libmxtpu_engine.so
+all: $(LIB_DIR)/libmxtpu_io.so $(LIB_DIR)/libmxtpu_engine.so \
+     $(LIB_DIR)/libmxtpu_storage.so
+
+$(LIB_DIR)/libmxtpu_storage.so: src/storage.cc
+	@mkdir -p $(LIB_DIR)
+	$(CXX) $(CXXFLAGS) -shared -o $@ $<
 
 $(LIB_DIR)/libmxtpu_io.so: src/recordio.cc
 	@mkdir -p $(LIB_DIR)

@@ -89,6 +89,8 @@ from . import test_utils             # noqa: E402
 from . import image                  # noqa: E402
 from . import image as img           # noqa: E402
 from . import engine                 # noqa: E402
+from . import storage                # noqa: E402
+from . import resource               # noqa: E402
 from . import name                   # noqa: E402
 from .attribute import AttrScope     # noqa: E402
 from . import attribute              # noqa: E402

@@ -431,8 +431,13 @@ class ImageIter(DataIter):
         return label, img
 
     def next(self):
-        batch_data = np.zeros((self.batch_size,) + self.data_shape,
-                              np.float32)
+        from ..resource import request as _request
+        # batch buffers come from the pooled host storage manager and are
+        # reused across batches (parity: the reference assembles batches
+        # into pooled pinned staging memory before the h2d copy)
+        data_shape = (self.batch_size,) + self.data_shape
+        batch_data = _request(req="temp_space").get_space(data_shape,
+                                                          np.float32)
         lshape = (self.batch_size,) if self.label_width == 1 else \
             (self.batch_size, self.label_width)
         batch_label = np.zeros(lshape, np.float32)

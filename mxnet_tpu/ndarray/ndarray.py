@@ -472,7 +472,15 @@ def array(source_array, ctx=None, dtype=None):
         dt = np.float32 if np.dtype(getattr(src, "dtype", np.float32)) == np.float64 \
             else src.dtype
     ctx = ctx or current_context()
-    return _wrap(_to_device(jnp.asarray(src, dt), ctx), ctx)
+    if isinstance(src, np.ndarray):
+        # MUST copy: the CPU backend zero-copies 64-byte-aligned host
+        # buffers, and the reference's NDArray construction semantics are
+        # always-copy — without this, callers reusing a staging buffer
+        # (pooled ImageIter batches) would mutate live arrays
+        converted = jnp.array(src, dt)
+    else:
+        converted = jnp.asarray(src, dt)
+    return _wrap(_to_device(converted, ctx), ctx)
 
 
 def zeros(shape, ctx=None, dtype=None, stype=None, **kwargs):

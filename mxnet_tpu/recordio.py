@@ -162,8 +162,15 @@ class MXIndexedRecordIO(MXRecordIO):
 
 
 def pack(header, s):
-    """Pack an IRHeader + payload (parity: recordio.pack)."""
+    """Pack an IRHeader + payload (parity: recordio.pack). A list/array
+    label becomes flag=len(label) with the float32 labels prepended to
+    the payload — the multi-label wire format unpack expects."""
     header = IRHeader(*header)
+    label = header.label
+    if isinstance(label, (list, tuple, np.ndarray)):
+        labels = np.asarray(label, np.float32)
+        header = header._replace(flag=labels.size, label=0.0)
+        s = labels.tobytes() + s
     return struct.pack(_IR_FORMAT, header.flag, header.label, header.id,
                        header.id2) + s
 

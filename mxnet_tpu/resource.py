@@ -1,0 +1,111 @@
+"""Resource manager — per-device temp-space and PRNG resources.
+
+Parity: reference ``include/mxnet/resource.h:37-185`` + ``src/
+resource.cc``: ops request ``kTempSpace`` scratch buffers or ``kRandom``
+PRNG states via ``ResourceManager::Get()->Request(ctx, req)``;
+``MXNET_EXEC_NUM_TEMP`` bounds concurrent scratch copies.
+
+TPU-native design: XLA allocates fused-kernel scratch itself, so
+``temp_space`` exists for *host-visible* scratch (custom ops, IO) and is
+a pooled Storage allocation; ``random`` hands out split jax PRNG keys
+from the per-device stream — the functional analogue of the reference's
+per-device PRNG state pool (seeded globally by ``mx.random.seed``, same
+contract as ``resource.h`` kRandom).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .base import MXNetError
+from .context import current_context
+from . import random as _random
+from .storage import Storage
+
+__all__ = ["Resource", "ResourceManager", "request"]
+
+
+class Resource:
+    """One granted resource (parity: struct Resource)."""
+
+    def __init__(self, kind, ctx):
+        self.kind = kind
+        self.ctx = ctx
+        self._handle = None
+        self._retired = []
+
+    # -- kTempSpace --------------------------------------------------------
+    def get_space(self, shape, dtype=np.float32):
+        """Scratch numpy buffer, reused across requests of the same slot
+        (parity: Resource::get_space — like the reference, a later larger
+        request invalidates earlier views logically, but the old buffer is
+        parked until release() so stale views never alias a re-issued
+        pool buffer)."""
+        if self.kind != "temp_space":
+            raise MXNetError("get_space on a %r resource" % self.kind)
+        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        if self._handle is None or self._handle.size < nbytes:
+            if self._handle is not None:
+                self._retired.append(self._handle)
+            self._handle = Storage.get().alloc(nbytes)
+        return self._handle.array(shape, dtype)
+
+    # -- kRandom -----------------------------------------------------------
+    def get_key(self):
+        """Fresh jax PRNG key split off the global stream
+        (parity: Resource::get_random's per-call state)."""
+        if self.kind != "random":
+            raise MXNetError("get_key on a %r resource" % self.kind)
+        return _random.take_key()
+
+    def release(self):
+        for h in self._retired:
+            Storage.get().free(h)
+        self._retired = []
+        if self._handle is not None:
+            Storage.get().free(self._handle)
+            self._handle = None
+
+
+class ResourceManager:
+    """(parity: ResourceManager::Get()->Request)"""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    @staticmethod
+    def get():
+        with ResourceManager._lock:
+            if ResourceManager._instance is None:
+                ResourceManager._instance = ResourceManager()
+        return ResourceManager._instance
+
+    def __init__(self):
+        from .base import get_env
+        # number of temp-space slots handed out round-robin per device
+        # (parity: MXNET_EXEC_NUM_TEMP, resource.cc)
+        self._num_temp = int(get_env("MXNET_EXEC_NUM_TEMP", 1))
+        self._temp = {}
+        self._next = {}
+
+    def request(self, ctx=None, req="temp_space"):
+        ctx = ctx or current_context()
+        key = (ctx.device_type, ctx.device_id)
+        if req == "random":
+            return Resource("random", ctx)
+        if req != "temp_space":
+            raise MXNetError("unknown resource request %r" % req)
+        with ResourceManager._lock:
+            slots = self._temp.setdefault(key, [])
+            if len(slots) < self._num_temp:
+                slots.append(Resource("temp_space", ctx))
+                return slots[-1]
+            i = self._next.get(key, 0)
+            self._next[key] = (i + 1) % self._num_temp
+            return slots[i]
+
+
+def request(ctx=None, req="temp_space"):
+    """Module-level convenience (parity: op FResourceRequest grants)."""
+    return ResourceManager.get().request(ctx, req)

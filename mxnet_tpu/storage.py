@@ -1,0 +1,167 @@
+"""Storage manager facade.
+
+Parity: reference ``include/mxnet/storage.h`` (``Storage::Get()->
+Alloc/Free/DirectFree(Handle)``) + ``src/storage/`` (SURVEY.md §2.1
+"Storage manager"). TPU-native split of responsibilities:
+
+* **Device (HBM) memory** is owned by the PJRT allocator — XLA plans and
+  pools device buffers itself, so the framework does not (and must not)
+  run its own HBM free-list. This facade surfaces PJRT's per-device
+  stats (``device_stats``) where the reference exposed pool counters.
+* **Host staging memory** (IO batch assembly, h2d staging) IS framework-
+  managed: a native size-bucketed pooled allocator (src/storage.cc, the
+  ``GPUPooledStorageManager`` design applied to the host side) with a
+  pure-numpy fallback when the library isn't built.
+
+``alloc`` returns a ``Handle`` whose ``.array(shape, dtype)`` view is a
+numpy array backed by the pooled buffer, so producers can fill it in
+place and hand it to ``mx.nd.array`` for the device copy.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["Handle", "Storage"]
+
+_lib = None
+_lib_tried = False
+_lock = threading.Lock()
+
+
+def _native():
+    global _lib, _lib_tried
+    with _lock:
+        if _lib_tried:
+            return _lib
+        _lib_tried = True
+        path = os.path.join(os.path.dirname(__file__), "_lib",
+                            "libmxtpu_storage.so")
+        if not os.path.exists(path):
+            return None
+        try:
+            L = ctypes.CDLL(path)
+        except OSError:
+            return None
+        L.sto_alloc.restype = ctypes.c_void_p
+        L.sto_alloc.argtypes = [ctypes.c_size_t]
+        L.sto_free.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+        L.sto_direct_free.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+        L.sto_stats.argtypes = [ctypes.POINTER(ctypes.c_size_t)] * 3
+        L.sto_release_all.argtypes = []
+        _lib = L
+        return _lib
+
+
+class Handle:
+    """One allocation (parity: Storage::Handle — ptr, size, ctx)."""
+
+    __slots__ = ("ptr", "size", "_np", "_freed")
+
+    def __init__(self, ptr, size, np_fallback=None):
+        self.ptr = ptr
+        self.size = size
+        self._np = np_fallback
+        self._freed = False
+
+    def array(self, shape, dtype=np.float32):
+        """Numpy view over the buffer (fill in place, then ship to device)."""
+        if self._freed:
+            raise MXNetError("use-after-free of a storage handle")
+        n = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        if n > self.size:
+            raise MXNetError("view of %d bytes exceeds allocation of %d"
+                             % (n, self.size))
+        if self._np is not None:
+            return self._np[:n].view(dtype).reshape(shape)
+        buf = (ctypes.c_uint8 * n).from_address(self.ptr)
+        return np.frombuffer(buf, dtype=dtype).reshape(shape)
+
+
+class Storage:
+    """Singleton facade (parity: Storage::Get())."""
+
+    _instance = None
+
+    @staticmethod
+    def get():
+        with _lock:
+            if Storage._instance is None:
+                Storage._instance = Storage()
+        return Storage._instance
+
+    def __init__(self):
+        self._fallback_allocated = 0
+        self._fallback_peak = 0
+
+    @property
+    def native(self):
+        return _native() is not None
+
+    def alloc(self, nbytes):
+        """(parity: Storage::Alloc) pooled host buffer of >= nbytes."""
+        L = _native()
+        if L is None:
+            arr = np.empty(nbytes, np.uint8)
+            self._fallback_allocated += nbytes
+            self._fallback_peak = max(self._fallback_peak,
+                                      self._fallback_allocated)
+            return Handle(arr.ctypes.data, nbytes, np_fallback=arr)
+        ptr = L.sto_alloc(nbytes)
+        if not ptr:
+            raise MXNetError("host storage allocation of %d bytes failed"
+                             % nbytes)
+        return Handle(ptr, nbytes)
+
+    def free(self, handle):
+        """(parity: Storage::Free) return the buffer to the pool."""
+        if handle._freed:
+            return
+        handle._freed = True
+        if handle._np is not None:
+            self._fallback_allocated -= handle.size
+            handle._np = None
+            return
+        _native().sto_free(handle.ptr, handle.size)
+
+    def direct_free(self, handle):
+        """(parity: Storage::DirectFree) bypass the pool."""
+        if handle._freed:
+            return
+        handle._freed = True
+        if handle._np is not None:
+            self._fallback_allocated -= handle.size
+            handle._np = None
+            return
+        _native().sto_direct_free(handle.ptr, handle.size)
+
+    def release_all(self):
+        L = _native()
+        if L is not None:
+            L.sto_release_all()
+
+    def stats(self):
+        """Host-pool counters: allocated / pooled / peak bytes."""
+        L = _native()
+        if L is None:
+            return {"allocated": self._fallback_allocated, "pooled": 0,
+                    "peak": self._fallback_peak}
+        a, p, k = (ctypes.c_size_t(), ctypes.c_size_t(), ctypes.c_size_t())
+        L.sto_stats(ctypes.byref(a), ctypes.byref(p), ctypes.byref(k))
+        return {"allocated": a.value, "pooled": p.value, "peak": k.value}
+
+    @staticmethod
+    def device_stats(device=None):
+        """Per-device HBM stats from PJRT (parity: the reference's pool
+        counters / MXNET_GPU_MEM_POOL_RESERVE visibility)."""
+        import jax
+        d = device or jax.devices()[0]
+        try:
+            return dict(d.memory_stats() or {})
+        except (AttributeError, RuntimeError):
+            return {}

@@ -1,0 +1,122 @@
+// Native host-side storage manager: size-bucketed pooled allocator.
+//
+// TPU-native re-design of the reference's storage layer
+// (src/storage/storage.cc dispatch + pooled_storage_manager.h:48-132's
+// GPUPooledStorageManager: a free-list over cudaMalloc keyed by rounded
+// size, with an environment-controlled reserve). On TPU the device (HBM)
+// side is owned by the PJRT allocator, so the native pool manages the
+// HOST staging side: batch-assembly and IO buffers that are written by
+// C++/Python producers and then DMA'd to the device. Buckets are
+// power-of-two from 4 KB; freed buffers park in the pool until the pooled
+// total exceeds MXNET_HOST_MEM_POOL_MB (then they release to the OS),
+// mirroring MXNET_GPU_MEM_POOL_RESERVE's role.
+//
+// C ABI (ctypes-bound in mxnet_tpu/storage.py; pure-Python fallback
+// exists, the library is optional):
+//   sto_alloc / sto_free / sto_direct_free / sto_stats / sto_release_all
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+
+namespace {
+
+struct Pool {
+  std::mutex mu;
+  // rounded bucket size -> parked buffer (multimap: many per bucket)
+  std::multimap<size_t, void*> free_list;
+  size_t allocated_bytes = 0;  // currently handed out
+  size_t pooled_bytes = 0;     // parked in the free list
+  size_t peak_bytes = 0;       // high-water mark of handed-out bytes
+  size_t pool_limit;
+
+  Pool() {
+    const char* env = std::getenv("MXNET_HOST_MEM_POOL_MB");
+    long mb = env ? std::atol(env) : 1024;
+    pool_limit = static_cast<size_t>(mb < 0 ? 0 : mb) << 20;
+  }
+
+  static size_t RoundSize(size_t nbytes) {
+    size_t b = 4096;
+    while (b < nbytes) b <<= 1;
+    return b;
+  }
+};
+
+Pool* pool() {
+  static Pool* p = new Pool();  // leaked intentionally: outlive atexit
+  return p;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* sto_alloc(size_t nbytes) {
+  Pool* p = pool();
+  size_t bucket = Pool::RoundSize(nbytes);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    auto it = p->free_list.find(bucket);
+    if (it != p->free_list.end()) {
+      void* buf = it->second;
+      p->free_list.erase(it);
+      p->pooled_bytes -= bucket;
+      p->allocated_bytes += bucket;
+      if (p->allocated_bytes > p->peak_bytes)
+        p->peak_bytes = p->allocated_bytes;
+      return buf;
+    }
+  }
+  void* buf = std::aligned_alloc(64, bucket);
+  if (buf == nullptr) return nullptr;
+  std::lock_guard<std::mutex> lk(p->mu);
+  p->allocated_bytes += bucket;
+  if (p->allocated_bytes > p->peak_bytes) p->peak_bytes = p->allocated_bytes;
+  return buf;
+}
+
+// Return a buffer to the pool (or the OS once the pool is over its limit).
+void sto_free(void* buf, size_t nbytes) {
+  if (buf == nullptr) return;
+  Pool* p = pool();
+  size_t bucket = Pool::RoundSize(nbytes);
+  std::lock_guard<std::mutex> lk(p->mu);
+  p->allocated_bytes -= bucket;
+  if (p->pooled_bytes + bucket > p->pool_limit) {
+    std::free(buf);
+    return;
+  }
+  p->free_list.emplace(bucket, buf);
+  p->pooled_bytes += bucket;
+}
+
+// Bypass the pool (parity: Storage::DirectFree).
+void sto_direct_free(void* buf, size_t nbytes) {
+  if (buf == nullptr) return;
+  Pool* p = pool();
+  std::lock_guard<std::mutex> lk(p->mu);
+  p->allocated_bytes -= Pool::RoundSize(nbytes);
+  std::free(buf);
+}
+
+void sto_stats(size_t* allocated, size_t* pooled, size_t* peak) {
+  Pool* p = pool();
+  std::lock_guard<std::mutex> lk(p->mu);
+  if (allocated) *allocated = p->allocated_bytes;
+  if (pooled) *pooled = p->pooled_bytes;
+  if (peak) *peak = p->peak_bytes;
+}
+
+// Drop every parked buffer (parity: ReleaseAll on shutdown/OOM retry).
+void sto_release_all() {
+  Pool* p = pool();
+  std::lock_guard<std::mutex> lk(p->mu);
+  for (auto& kv : p->free_list) std::free(kv.second);
+  p->free_list.clear();
+  p->pooled_bytes = 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,95 @@
+"""Storage + resource manager suite (parity model: reference
+tests/cpp/storage/storage_test.cc semantics exercised from Python)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.storage import Storage
+from mxnet_tpu.resource import ResourceManager, request
+
+
+def test_alloc_view_free():
+    sto = Storage.get()
+    h = sto.alloc(1024)
+    arr = h.array((16, 16), np.float32)
+    arr[:] = 3.0
+    assert arr.sum() == 3.0 * 256
+    sto.free(h)
+    # double free is a no-op
+    sto.free(h)
+
+
+def test_use_after_free_rejected():
+    sto = Storage.get()
+    h = sto.alloc(64)
+    sto.free(h)
+    try:
+        h.array((4,), np.float32)
+        raise AssertionError("expected use-after-free error")
+    except mx.MXNetError:
+        pass
+
+
+def test_pool_reuses_buffers():
+    sto = Storage.get()
+    if not sto.native:
+        return  # fallback path has no pool
+    h1 = sto.alloc(5000)
+    ptr = h1.ptr
+    sto.free(h1)
+    h2 = sto.alloc(6000)  # same 8KB bucket -> same buffer back
+    assert h2.ptr == ptr
+    sto.free(h2)
+
+
+def test_stats_track_allocation():
+    sto = Storage.get()
+    before = sto.stats()["allocated"]
+    h = sto.alloc(4096)
+    during = sto.stats()["allocated"]
+    assert during >= before + 4096
+    sto.free(h)
+    assert sto.stats()["allocated"] <= before + (during - before) - 4096 + 1
+
+
+def test_direct_free_bypasses_pool():
+    sto = Storage.get()
+    if not sto.native:
+        return
+    sto.release_all()
+    h = sto.alloc(4096)
+    sto.direct_free(h)
+    assert sto.stats()["pooled"] == 0
+
+
+def test_view_larger_than_alloc_rejected():
+    sto = Storage.get()
+    h = sto.alloc(64)
+    try:
+        h.array((1024,), np.float32)
+        raise AssertionError("expected oversize view error")
+    except mx.MXNetError:
+        pass
+    finally:
+        sto.free(h)
+
+
+def test_resource_temp_space_reuse():
+    r1 = request(req="temp_space")
+    a = r1.get_space((8, 8))
+    a[:] = 1.0
+    r2 = request(req="temp_space")  # MXNET_EXEC_NUM_TEMP=1 -> same slot
+    b = r2.get_space((8, 8))
+    assert a.ctypes.data == b.ctypes.data
+
+
+def test_resource_random_keys_differ():
+    import jax
+    r = request(req="random")
+    k1, k2 = r.get_key(), r.get_key()
+    assert not np.array_equal(np.asarray(jax.random.key_data(k1)),
+                              np.asarray(jax.random.key_data(k2)))
+
+
+def test_device_stats_dict():
+    stats = Storage.device_stats()
+    assert isinstance(stats, dict)

@@ -84,3 +84,28 @@ def test_bandwidth_probe():
               "--force-cpu", "--size-mb", "1", "--rounds", "2"])
     assert p.returncode == 0, p.stderr
     assert "GB/s" in p.stdout
+
+
+def test_recordio_multilabel_pack_roundtrip():
+    from mxnet_tpu import recordio
+    header = recordio.IRHeader(0, [1.0, 2.5, -3.0], 7, 0)
+    s = recordio.pack(header, b"payload")
+    back, payload = recordio.unpack(s)
+    assert payload == b"payload"
+    np.testing.assert_allclose(np.asarray(back.label), [1.0, 2.5, -3.0])
+    assert back.id == 7
+
+
+def test_im2rec_chunked_pack(tmp_path):
+    PIL = pytest.importorskip("PIL.Image")
+    for i in range(4):
+        arr = (np.random.rand(8, 8, 3) * 255).astype(np.uint8)
+        PIL.fromarray(arr).save(str(tmp_path / ("%d.jpg" % i)))
+    prefix = str(tmp_path / "data")
+    p = _run([os.path.join(TOOLS, "im2rec.py"), prefix, str(tmp_path),
+              "--list", "--chunks", "2"])
+    assert p.returncode == 0, p.stderr
+    p = _run([os.path.join(TOOLS, "im2rec.py"), prefix, str(tmp_path)])
+    assert p.returncode == 0, p.stderr
+    assert os.path.exists(prefix + "_0.rec")
+    assert os.path.exists(prefix + "_1.rec")

@@ -76,7 +76,8 @@ def _encode(path, args):
     try:
         from PIL import Image
     except ImportError:
-        return data
+        raise SystemExit("--resize/--quality need Pillow, which is not "
+                         "installed; rerun without them to pack raw bytes")
     img = Image.open(io.BytesIO(data)).convert("RGB")
     if args.resize > 0:
         w, h = img.size
@@ -135,12 +136,16 @@ def main():
     if args.list:
         write_list(args)
     else:
-        lst = args.prefix if args.prefix.endswith(".lst") \
-            else args.prefix + ".lst"
-        if not os.path.exists(lst):
-            raise SystemExit("list file %s not found (run --list first)"
-                             % lst)
-        write_record(args, lst)
+        import glob
+        prefix = args.prefix[:-4] if args.prefix.endswith(".lst") \
+            else args.prefix
+        lsts = [prefix + ".lst"] if os.path.exists(prefix + ".lst") \
+            else sorted(glob.glob(prefix + "_*.lst"))
+        if not lsts:
+            raise SystemExit("no list file %s.lst or %s_*.lst found "
+                             "(run --list first)" % (prefix, prefix))
+        for lst in lsts:
+            write_record(args, lst)
 
 
 if __name__ == "__main__":
