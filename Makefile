@@ -7,8 +7,16 @@ CXX ?= g++
 CXXFLAGS ?= -O3 -std=c++17 -fPIC -pthread -Wall
 LIB_DIR := mxnet_tpu/_lib
 
+PY_INCLUDES := $(shell python3-config --includes)
+PY_LDFLAGS := $(shell python3-config --embed --ldflags 2>/dev/null || \
+                      python3-config --ldflags)
+
 all: $(LIB_DIR)/libmxtpu_io.so $(LIB_DIR)/libmxtpu_engine.so \
-     $(LIB_DIR)/libmxtpu_storage.so
+     $(LIB_DIR)/libmxtpu_storage.so $(LIB_DIR)/libmxtpu_predict.so
+
+$(LIB_DIR)/libmxtpu_predict.so: src/c_predict_api.cc
+	@mkdir -p $(LIB_DIR)
+	$(CXX) $(CXXFLAGS) $(PY_INCLUDES) -shared -o $@ $< $(PY_LDFLAGS)
 
 $(LIB_DIR)/libmxtpu_storage.so: src/storage.cc
 	@mkdir -p $(LIB_DIR)

@@ -1,0 +1,67 @@
+"""Python-side helpers for the native C predict API (src/c_predict_api.cc).
+
+Parity: reference ``include/mxnet/c_predict_api.h`` / ``src/c_api/
+c_predict_api.cc`` — the standalone inference ABI used by amalgamation
+mobile builds. TPU-native design: the C library embeds CPython and calls
+these primitive-typed helpers (strings, ints, raw addresses) so the C++
+side needs no numpy/Python C API beyond object calls; the compute itself
+is the same XLA executor the rest of the framework uses.
+"""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from .predictor import Predictor
+
+__all__ = ["create", "set_input", "forward", "output_ndim", "output_shape",
+           "output_size", "copy_output", "num_outputs"]
+
+
+def create(symbol_json, param_bytes, dev_type, dev_id, names, shapes):
+    """(parity: MXPredCreate) names/shapes describe the input nodes."""
+    from .context import Context
+    ctx = Context(Context.devtype2str.get(dev_type, "cpu"), dev_id) \
+        if isinstance(dev_type, int) else None
+    input_shapes = {n: tuple(int(d) for d in s)
+                    for n, s in zip(names, shapes)}
+    return Predictor(symbol_json, bytes(param_bytes), input_shapes, ctx=ctx)
+
+
+def set_input(pred, name, addr, size):
+    """(parity: MXPredSetInput) size = number of float32 elements."""
+    buf = (ctypes.c_float * size).from_address(addr)
+    arr = np.frombuffer(buf, np.float32).copy()
+    shape = pred._input_shapes[name]
+    pred.set_input(name, arr.reshape(shape))
+
+
+def forward(pred):
+    pred.forward()
+
+
+def num_outputs(pred):
+    return len(pred._executor.outputs)
+
+
+def output_ndim(pred, index):
+    return len(pred.get_output(index).shape)
+
+
+def output_shape(pred, index):
+    return [int(d) for d in pred.get_output(index).shape]
+
+
+def output_size(pred, index):
+    return int(np.prod(pred.get_output(index).shape))
+
+
+def copy_output(pred, index, addr, size):
+    """(parity: MXPredGetOutput) copy float32 output into caller memory."""
+    out = pred.get_output(index).asnumpy().astype(np.float32, copy=False)
+    flat = np.ascontiguousarray(out).ravel()
+    if size < flat.size:
+        raise ValueError("output buffer too small: %d < %d"
+                         % (size, flat.size))
+    ctypes.memmove(addr, flat.ctypes.data, flat.size * 4)

@@ -44,9 +44,13 @@ class Context:
 
     # -- JAX mapping --------------------------------------------------
     def jax_device(self):
-        """Resolve this context to a concrete jax.Device."""
+        """Resolve this context to a concrete jax.Device. Always a LOCAL
+        (process-addressable) device: under multi-process SPMD,
+        jax.devices() lists the whole job's devices and rank r must not
+        resolve cpu(0) to rank 0's device."""
         if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
-            devs = jax.devices("cpu") if _has_platform("cpu") else jax.devices()
+            devs = jax.local_devices(backend="cpu") if _has_platform("cpu") \
+                else jax.local_devices()
         else:
             # tpu and the gpu alias both mean "the accelerator"
             devs = _accelerator_devices()
@@ -88,8 +92,9 @@ def _has_platform(name):
 
 
 def _accelerator_devices():
-    """Non-CPU devices if any; else all devices (CPU-only test runs)."""
-    devs = jax.devices()
+    """Local non-CPU devices if any; else all local devices (CPU-only
+    test runs)."""
+    devs = jax.local_devices()
     accel = [d for d in devs if d.platform != "cpu"]
     return accel or devs
 

@@ -102,12 +102,60 @@ class KVStore:
                     merged = dense[0].copy()
                     for v in dense[1:]:
                         merged += v
+            merged = self._global_reduce(merged)
             if self._updater is not None:
                 if k not in self._store:
                     raise MXNetError("push: key %r was not init()ed" % k)
                 self._updater(_int_key(k), merged, self._store[k])
             else:
                 self._store[k] = merged.copy()
+
+    def _global_reduce(self, merged):
+        """dist_*: sum the locally-merged value across worker processes
+        (parity: the ps-lite server aggregating every worker's push,
+        kvstore_dist_server.h:261-312 sync mode). Implemented as an
+        allgather+sum over the process group — the KVStore facade is the
+        API-parity route; pod-scale training should shard with pjit and
+        let XLA psum over ICI (SURVEY.md §5.8).
+
+        Collective discipline: every worker must push the same keys in
+        the same order (true for SPMD training loops — each process runs
+        the same program). ``dist_async`` is emulated synchronously under
+        the same rule; true per-arrival async application needs a server
+        process, which this all-reduce design intentionally has none of
+        (SURVEY.md §2.3 "Async SGD").
+
+        Row-sparse gradients are gathered via their dense view (shapes
+        must match across processes), then re-sparsified to the union of
+        touched rows so lazy-row optimizer semantics survive dist mode.
+        """
+        if not self.type.startswith("dist"):
+            return merged
+        import jax
+        if jax.process_count() <= 1:
+            return merged
+        from jax.experimental import multihost_utils
+        from .ndarray import sparse as _sp
+        from .ndarray.ndarray import _wrap
+        was_row_sparse = isinstance(merged, _sp.RowSparseNDArray)
+        if isinstance(merged, _sp.BaseSparseNDArray):
+            merged = merged.tostype("default")
+        import jax.numpy as jnp
+        import numpy as np
+        gathered = np.asarray(multihost_utils.process_allgather(merged._data))
+        out = _wrap(jnp.asarray(gathered.sum(axis=0)), merged.context)
+        if was_row_sparse:
+            out = _sp.cast_storage(out, "row_sparse")
+        return out
+
+    def barrier(self):
+        """Block until every worker reaches this point (parity:
+        KVStore::Barrier via ps-lite Postoffice)."""
+        if self.type.startswith("dist"):
+            import jax
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+                multihost_utils.sync_global_devices("kvstore_barrier")
 
     def pull(self, key, out=None, priority=0, row_ids=None):
         """Broadcast current value into out arrays (parity: kvstore.pull)."""
@@ -189,16 +237,6 @@ class KVStore:
         return _wrap(deq) if isinstance(v, NDArray) else deq
 
     # -- sync / lifecycle --------------------------------------------------
-    def barrier(self):
-        if self.type.startswith("dist"):
-            try:
-                import jax
-                # a tiny collective is the portable barrier
-                from .parallel import barrier as _barrier
-                _barrier()
-            except Exception:
-                pass
-
     def send_command_to_servers(self, head, body):
         pass
 

@@ -1,0 +1,194 @@
+// C predict API — standalone inference ABI.
+//
+// TPU-native re-design of the reference's predict-only C API
+// (include/mxnet/c_predict_api.h, src/c_api/c_predict_api.cc, consumed
+// by amalgamation/ mobile builds and example/image-classification/
+// predict-cpp). The reference linked a full C++ inference engine; here
+// the library EMBEDS CPython and drives the framework's own XLA
+// executor through mxnet_tpu/c_predict.py — one inference stack, one
+// ABI. Works both from a standalone C program (initializes the
+// interpreter; set PYTHONPATH to the package) and from inside an
+// existing Python process (uses PyGILState).
+//
+// Exported surface mirrors the reference's names and call shapes:
+//   MXPredCreate, MXPredSetInput, MXPredForward, MXPredGetOutputShape,
+//   MXPredGetOutput, MXPredFree, MXGetLastError.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+typedef unsigned int mx_uint;
+typedef void* PredictorHandle;
+
+namespace {
+
+thread_local std::string g_last_error;
+
+struct Pred {
+  PyObject* obj;                 // mxnet_tpu.predictor.Predictor
+  std::vector<mx_uint> shape_buf;  // backing for MXPredGetOutputShape
+};
+
+// Ensure the interpreter is up; returns a held GIL state. The embedded
+// interpreter is never finalized: predictor handles may outlive any one
+// call, and XLA client teardown at interpreter shutdown is not safe from
+// an arbitrary unload point.
+PyGILState_STATE EnsurePython() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // Py_InitializeEx leaves the GIL held by this thread; release it so
+    // PyGILState_Ensure below behaves uniformly.
+    PyEval_SaveThread();
+  }
+  return PyGILState_Ensure();
+}
+
+PyObject* HelperModule() {
+  static PyObject* mod = nullptr;
+  if (mod == nullptr) {
+    mod = PyImport_ImportModule("mxnet_tpu.c_predict");
+  }
+  return mod;
+}
+
+// Capture the pending Python exception into g_last_error.
+void CaptureError() {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  PyErr_NormalizeException(&type, &value, &trace);
+  g_last_error = "unknown python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* MXGetLastError() { return g_last_error.c_str(); }
+
+int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char** input_keys,
+                 const mx_uint* input_shape_indptr,
+                 const mx_uint* input_shape_data, PredictorHandle* out) {
+  PyGILState_STATE gil = EnsurePython();
+  int rc = -1;
+  PyObject* mod = HelperModule();
+  if (mod == nullptr) {
+    CaptureError();
+    PyGILState_Release(gil);
+    return -1;
+  }
+  PyObject* names = PyList_New(num_input_nodes);
+  PyObject* shapes = PyList_New(num_input_nodes);
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    PyList_SetItem(names, i, PyUnicode_FromString(input_keys[i]));
+    mx_uint lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject* shp = PyList_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j)
+      PyList_SetItem(shp, j - lo, PyLong_FromLong(input_shape_data[j]));
+    PyList_SetItem(shapes, i, shp);
+  }
+  PyObject* params = PyBytes_FromStringAndSize(
+      static_cast<const char*>(param_bytes), param_size);
+  PyObject* pred = PyObject_CallMethod(
+      mod, "create", "sOiiOO", symbol_json_str, params, dev_type, dev_id,
+      names, shapes);
+  Py_DECREF(params);
+  Py_DECREF(names);
+  Py_DECREF(shapes);
+  if (pred == nullptr) {
+    CaptureError();
+  } else {
+    Pred* p = new Pred();
+    p->obj = pred;
+    *out = p;
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char* key,
+                   const float* data, mx_uint size) {
+  Pred* p = static_cast<Pred*>(handle);
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* r = PyObject_CallMethod(
+      HelperModule(), "set_input", "OsLI", p->obj, key,
+      static_cast<long long>(reinterpret_cast<intptr_t>(data)), size);
+  int rc = r != nullptr ? 0 : (CaptureError(), -1);
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  Pred* p = static_cast<Pred*>(handle);
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* r = PyObject_CallMethod(HelperModule(), "forward", "O", p->obj);
+  int rc = r != nullptr ? 0 : (CaptureError(), -1);
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint** shape_data, mx_uint* shape_ndim) {
+  Pred* p = static_cast<Pred*>(handle);
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* shp = PyObject_CallMethod(HelperModule(), "output_shape", "OI",
+                                      p->obj, index);
+  if (shp == nullptr) {
+    CaptureError();
+    PyGILState_Release(gil);
+    return -1;
+  }
+  Py_ssize_t n = PyList_Size(shp);
+  p->shape_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    p->shape_buf[i] =
+        static_cast<mx_uint>(PyLong_AsLong(PyList_GetItem(shp, i)));
+  Py_DECREF(shp);
+  *shape_data = p->shape_buf.data();
+  *shape_ndim = static_cast<mx_uint>(n);
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, float* data,
+                    mx_uint size) {
+  Pred* p = static_cast<Pred*>(handle);
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* r = PyObject_CallMethod(
+      HelperModule(), "copy_output", "OILI", p->obj, index,
+      static_cast<long long>(reinterpret_cast<intptr_t>(data)), size);
+  int rc = r != nullptr ? 0 : (CaptureError(), -1);
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  Pred* p = static_cast<Pred*>(handle);
+  PyGILState_STATE gil = EnsurePython();
+  Py_XDECREF(p->obj);
+  PyGILState_Release(gil);
+  delete p;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,157 @@
+"""C predict API suite (parity model: reference c_predict_api usage in
+example/image-classification/predict-cpp and amalgamation)."""
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+LIB = os.path.join(REPO, "mxnet_tpu", "_lib", "libmxtpu_predict.so")
+
+
+def _save_tiny_model(tmp_path):
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=3, name="fc")
+    out = mx.sym.SoftmaxOutput(fc, name="softmax")
+    mod = mx.mod.Module(out, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 4))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params(mx.initializer.Xavier())
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 0)
+    arg_params, _ = mod.get_params()
+    return prefix, arg_params
+
+
+def _expected(arg_params, x):
+    w = arg_params["fc_weight"].asnumpy()
+    b = arg_params["fc_bias"].asnumpy()
+    logits = x.dot(w.T) + b
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    return e / e.sum(axis=1, keepdims=True)
+
+
+@pytest.mark.skipif(not os.path.exists(LIB), reason="native lib not built")
+def test_c_predict_in_process(tmp_path):
+    prefix, arg_params = _save_tiny_model(tmp_path)
+    with open(prefix + "-symbol.json", "rb") as f:
+        sym_json = f.read()
+    with open(prefix + "-0000.params", "rb") as f:
+        params = f.read()
+
+    L = ctypes.CDLL(LIB)
+    L.MXPredCreate.restype = ctypes.c_int
+    L.MXGetLastError.restype = ctypes.c_char_p
+
+    handle = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint * 2)(0, 2)
+    shape = (ctypes.c_uint * 2)(2, 4)
+    rc = L.MXPredCreate(sym_json, params, len(params), 1, 0, 1, keys,
+                        indptr, shape, ctypes.byref(handle))
+    assert rc == 0, L.MXGetLastError()
+
+    x = np.random.RandomState(0).uniform(size=(2, 4)).astype(np.float32)
+    buf = (ctypes.c_float * x.size)(*x.ravel())
+    assert L.MXPredSetInput(handle, b"data", buf, x.size) == 0, \
+        L.MXGetLastError()
+    assert L.MXPredForward(handle) == 0, L.MXGetLastError()
+
+    shape_data = ctypes.POINTER(ctypes.c_uint)()
+    ndim = ctypes.c_uint()
+    assert L.MXPredGetOutputShape(handle, 0, ctypes.byref(shape_data),
+                                  ctypes.byref(ndim)) == 0
+    out_shape = tuple(shape_data[i] for i in range(ndim.value))
+    assert out_shape == (2, 3)
+
+    out = (ctypes.c_float * 6)()
+    assert L.MXPredGetOutput(handle, 0, out, 6) == 0, L.MXGetLastError()
+    got = np.array(out[:6], np.float32).reshape(2, 3)
+    np.testing.assert_allclose(got, _expected(arg_params, x), rtol=1e-4,
+                               atol=1e-5)
+    assert L.MXPredFree(handle) == 0
+
+
+@pytest.mark.skipif(not os.path.exists(LIB), reason="native lib not built")
+def test_c_predict_standalone_program(tmp_path):
+    """Compile and run a real C driver against the library — the
+    amalgamation/predict-cpp deployment story, no Python host process."""
+    import shutil
+    cc = shutil.which("gcc") or shutil.which("cc")
+    if cc is None:
+        pytest.skip("no C compiler")
+    prefix, arg_params = _save_tiny_model(tmp_path)
+
+    driver = tmp_path / "driver.c"
+    driver.write_text(r'''
+#include <stdio.h>
+#include <stdlib.h>
+typedef unsigned int mx_uint;
+typedef void* PredictorHandle;
+extern int MXPredCreate(const char*, const void*, int, int, int, mx_uint,
+                        const char**, const mx_uint*, const mx_uint*,
+                        PredictorHandle*);
+extern int MXPredSetInput(PredictorHandle, const char*, const float*,
+                          mx_uint);
+extern int MXPredForward(PredictorHandle);
+extern int MXPredGetOutput(PredictorHandle, mx_uint, float*, mx_uint);
+extern int MXPredFree(PredictorHandle);
+extern const char* MXGetLastError();
+
+static char* slurp(const char* path, long* size) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return NULL;
+    fseek(f, 0, SEEK_END); *size = ftell(f); fseek(f, 0, SEEK_SET);
+    char* buf = (char*)malloc(*size + 1);
+    if (fread(buf, 1, *size, f) != (size_t)*size) { fclose(f); return NULL; }
+    buf[*size] = 0; fclose(f);
+    return buf;
+}
+
+int main(int argc, char** argv) {
+    long json_size, param_size;
+    char* json = slurp(argv[1], &json_size);
+    char* params = slurp(argv[2], &param_size);
+    if (!json || !params) { printf("io error\n"); return 2; }
+    const char* keys[] = {"data"};
+    mx_uint indptr[] = {0, 2};
+    mx_uint shape[] = {2, 4};
+    PredictorHandle h;
+    if (MXPredCreate(json, params, (int)param_size, 1, 0, 1, keys, indptr,
+                     shape, &h)) {
+        printf("create failed: %s\n", MXGetLastError()); return 1;
+    }
+    float x[8];
+    for (int i = 0; i < 8; ++i) x[i] = 0.1f * (float)i;
+    if (MXPredSetInput(h, "data", x, 8)) { printf("set failed\n"); return 1; }
+    if (MXPredForward(h)) { printf("fwd failed: %s\n", MXGetLastError());
+                            return 1; }
+    float out[6];
+    if (MXPredGetOutput(h, 0, out, 6)) { printf("out failed\n"); return 1; }
+    float rowsum = out[0] + out[1] + out[2];
+    printf("PRED_OK %.4f %.4f %.4f rowsum=%.4f\n", out[0], out[1], out[2],
+           rowsum);
+    MXPredFree(h);
+    return 0;
+}
+''')
+    exe = str(tmp_path / "driver")
+    subprocess.run([cc, str(driver), "-o", exe,
+                    "-L" + os.path.dirname(LIB), "-lmxtpu_predict",
+                    "-Wl,-rpath," + os.path.dirname(LIB)], check=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.abspath(REPO) + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    env["MXNET_TPU_FORCE_CPU"] = "1"
+    p = subprocess.run([exe, prefix + "-symbol.json",
+                        prefix + "-0000.params"], capture_output=True,
+                       text=True, timeout=300, env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "PRED_OK" in p.stdout
+    rowsum = float(p.stdout.split("rowsum=")[1].split()[0])
+    assert abs(rowsum - 1.0) < 1e-3  # softmax row sums to 1

@@ -109,3 +109,27 @@ def test_im2rec_chunked_pack(tmp_path):
     assert p.returncode == 0, p.stderr
     assert os.path.exists(prefix + "_0.rec")
     assert os.path.exists(prefix + "_1.rec")
+
+
+def test_launch_dist_sync_kvstore(tmp_path):
+    """2-worker dist_sync push/pull exactness (parity model: reference
+    tests/nightly/dist_sync_kvstore.py run via launch.py local mode)."""
+    script = tmp_path / "dist_kv.py"
+    script.write_text(
+        "import sys; sys.path.insert(0, %r)\n" % REPO +
+        "import numpy as np\n"
+        "import mxnet_tpu as mx\n"
+        "kv = mx.kv.create('dist_sync')\n"
+        "kv.init(3, mx.nd.zeros((4, 2)))\n"
+        "kv.barrier()\n"
+        "kv.push(3, mx.nd.ones((4, 2)) * (kv.rank + 1))\n"
+        "out = mx.nd.zeros((4, 2))\n"
+        "kv.pull(3, out=out)\n"
+        "np.testing.assert_allclose(out.asnumpy(), 3.0)\n"  # 1 + 2
+        "kv.barrier()\n"
+        "print('DIST_KV_OK rank', kv.rank)\n")
+    p = _run([os.path.join(TOOLS, "launch.py"), "-n", "2",
+              "--force-cpu", "--port", "9413",
+              sys.executable, str(script)])
+    assert p.returncode == 0, p.stderr
+    assert p.stdout.count("DIST_KV_OK") == 2
