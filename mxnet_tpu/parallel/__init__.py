@@ -17,3 +17,4 @@ from .collectives import (all_reduce, all_gather, reduce_scatter, ppermute,
                           all_to_all)
 from .spmd import SPMDTrainer, shard_params_rule
 from .ring_attention import ring_attention, attention
+from .ulysses import ulysses_attention

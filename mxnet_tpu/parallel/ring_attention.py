@@ -203,6 +203,9 @@ def ring_attention(q, k, v, mesh, axis_name="sp", batch_axis_name=None,
         use_pallas = jax.default_backend() == "tpu"
 
     spec = P(batch_axis_name, None, axis_name, None)
+    # inputs committed to one device (NDArrays) must be laid out over the
+    # mesh before shard_map will accept them
+    raw = [jax.device_put(x, NamedSharding(mesh, spec)) for x in raw]
 
     fn = jax.shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name,

@@ -1,0 +1,89 @@
+"""Ulysses (all-to-all) sequence parallelism.
+
+The second long-context strategy alongside ring attention (SURVEY.md
+§5.7 extension; design follows DeepSpeed-Ulysses, Jacobs et al. 2023):
+inputs arrive sharded along the SEQUENCE axis; an ``all_to_all`` over
+the 'sp' mesh axis re-shards them along the HEAD axis so every device
+computes full-sequence attention for its subset of heads; a second
+all_to_all restores sequence sharding. Two collectives per attention
+call, each moving S·H·D/n elements — on TPU they ride ICI.
+
+Trade-off vs ring attention: Ulysses needs num_heads % n_devices == 0
+and moves activations twice, but each device sees the FULL sequence so
+the local kernel is a plain (flash) attention with no online-softmax
+accumulation across steps; ring keeps memory strictly local but
+serializes K/V rotation. Both are exact.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .ring_attention import attention as _plain_attention
+
+__all__ = ["ulysses_attention"]
+
+
+def _ulysses_local(q, k, v, axis_name, causal, scale, use_pallas):
+    """Local body under shard_map: q/k/v are (B, H, S_local, D)."""
+    n = lax.psum(1, axis_name)
+
+    def seq_to_heads(x):
+        # (B, H, S/n, D) -> (B, H/n, S, D): split heads, concat sequence
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if use_pallas:
+        from ..pallas.flash_attention import flash_attention
+        out = flash_attention(qh, kh, vh, causal=causal, scale=scale)
+    else:
+        out = _plain_attention(qh, kh, vh, causal=causal, scale=scale)
+    return heads_to_seq(out)
+
+
+def ulysses_attention(q, k, v, mesh, axis_name="sp", batch_axis_name=None,
+                      causal=False, scale=None, use_pallas=None):
+    """All-to-all sequence-parallel attention.
+
+    q/k/v: (B, H, S, D) sharded along S over ``axis_name`` (optionally
+    along B over ``batch_axis_name``); H must divide evenly by the 'sp'
+    axis size. Returns output with the same sharding. Accepts NDArrays
+    or jax arrays.
+    """
+    from ..ndarray.ndarray import NDArray, _wrap
+    from ..base import MXNetError
+    wrap_out = isinstance(q, NDArray)
+    raw = [x._data if isinstance(x, NDArray) else x for x in (q, k, v)]
+
+    n = mesh.shape[axis_name]
+    H = raw[0].shape[1]
+    if H % n != 0:
+        raise MXNetError(
+            "ulysses_attention: num_heads (%d) must be divisible by the "
+            "'%s' axis size (%d) — use ring_attention for uneven heads"
+            % (H, axis_name, n))
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+
+    spec = P(batch_axis_name, None, axis_name, None)
+    from jax.sharding import NamedSharding
+    # inputs committed to one device (NDArrays) must be laid out over the
+    # mesh before shard_map will accept them
+    raw = [jax.device_put(x, NamedSharding(mesh, spec)) for x in raw]
+    fn = jax.shard_map(
+        functools.partial(_ulysses_local, axis_name=axis_name,
+                          causal=causal, scale=scale,
+                          use_pallas=use_pallas),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=not use_pallas)
+    out = fn(*raw)
+    return _wrap(out) if wrap_out else out
