@@ -431,13 +431,20 @@ class ImageIter(DataIter):
         return label, img
 
     def next(self):
-        from ..resource import request as _request
         # batch buffers come from the pooled host storage manager and are
         # reused across batches (parity: the reference assembles batches
-        # into pooled pinned staging memory before the h2d copy)
+        # into pooled pinned staging memory before the h2d copy). Each
+        # iterator owns a private Resource: the shared round-robin
+        # temp_space slots (MXNET_EXEC_NUM_TEMP defaults to 1) could be
+        # handed to another consumer mid-assembly. NOTE the buffer is not
+        # zeroed; every row [0, batch_size) is written below before use —
+        # any future pad-batch support must clear the tail rows itself.
+        if getattr(self, "_batch_space", None) is None:
+            from ..resource import Resource
+            from ..context import current_context
+            self._batch_space = Resource("temp_space", current_context())
         data_shape = (self.batch_size,) + self.data_shape
-        batch_data = _request(req="temp_space").get_space(data_shape,
-                                                          np.float32)
+        batch_data = self._batch_space.get_space(data_shape, np.float32)
         lshape = (self.batch_size,) if self.label_width == 1 else \
             (self.batch_size, self.label_width)
         batch_label = np.zeros(lshape, np.float32)

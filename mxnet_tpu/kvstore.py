@@ -73,8 +73,11 @@ class KVStore:
 
     def push(self, key, value, priority=0):
         """Aggregate gradients (parity: kvstore.push). A list value is the
-        per-device shard list; reduction = sum, as CommDevice does."""
+        per-device shard list; reduction = sum, as CommDevice does. A list
+        of KEYS is one batched push: in dist mode all their cross-process
+        reductions run as a single jitted collective."""
         keys, values = _key_value(key, value, allow_list_value=True)
+        merged_list = []
         for k, vlist in zip(keys, values):
             if not isinstance(vlist, (list, tuple)):
                 vlist = [vlist]
@@ -102,7 +105,9 @@ class KVStore:
                     merged = dense[0].copy()
                     for v in dense[1:]:
                         merged += v
-            merged = self._global_reduce(merged)
+            merged_list.append(merged)
+        merged_list = self._global_reduce_batch(merged_list)
+        for k, merged in zip(keys, merged_list):
             if self._updater is not None:
                 if k not in self._store:
                     raise MXNetError("push: key %r was not init()ed" % k)
@@ -110,13 +115,28 @@ class KVStore:
             else:
                 self._store[k] = merged.copy()
 
-    def _global_reduce(self, merged):
-        """dist_*: sum the locally-merged value across worker processes
-        (parity: the ps-lite server aggregating every worker's push,
-        kvstore_dist_server.h:261-312 sync mode). Implemented as an
-        allgather+sum over the process group — the KVStore facade is the
-        API-parity route; pod-scale training should shard with pjit and
-        let XLA psum over ICI (SURVEY.md §5.8).
+    # one reduction device per process: the first local device of each,
+    # a consistent choice on every rank
+    @staticmethod
+    def _proc_mesh():
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+        by_proc = {}
+        for d in jax.devices():
+            by_proc.setdefault(d.process_index, d)
+        devs = [by_proc[i] for i in sorted(by_proc)]
+        return Mesh(np.array(devs), ("proc",))
+
+    def _global_reduce_batch(self, merged_list):
+        """dist_*: sum every locally-merged value across worker processes
+        in ONE jitted XLA computation (parity: the ps-lite server
+        aggregating every worker's push, kvstore_dist_server.h:261-312
+        sync mode). Each process's contribution stays on device: the
+        values are assembled into global arrays sharded over a one-
+        device-per-process mesh and a single compiled program sums them
+        with the collective riding ICI/DCN — no device→host→device round
+        trip, no per-key dispatch (the round-1 host allgather did both).
 
         Collective discipline: every worker must push the same keys in
         the same order (true for SPMD training loops — each process runs
@@ -125,28 +145,96 @@ class KVStore:
         process, which this all-reduce design intentionally has none of
         (SURVEY.md §2.3 "Async SGD").
 
-        Row-sparse gradients are gathered via their dense view (shapes
-        must match across processes), then re-sparsified to the union of
-        touched rows so lazy-row optimizer semantics survive dist mode.
+        Row-sparse gradients reduce via their dense view (shapes must
+        match across processes) plus a row-indicator vector, so the
+        result keeps the UNION of rows any worker touched — a pushed row
+        whose global sum is exactly zero still reaches the optimizer
+        (reference dist-server semantics: every pushed row is updated).
         """
-        if not self.type.startswith("dist"):
-            return merged
+        if not self.type.startswith("dist") or not merged_list:
+            return merged_list
         import jax
         if jax.process_count() <= 1:
-            return merged
-        from jax.experimental import multihost_utils
-        from .ndarray import sparse as _sp
-        from .ndarray.ndarray import _wrap
-        was_row_sparse = isinstance(merged, _sp.RowSparseNDArray)
-        if isinstance(merged, _sp.BaseSparseNDArray):
-            merged = merged.tostype("default")
+            return merged_list
         import jax.numpy as jnp
         import numpy as np
-        gathered = np.asarray(multihost_utils.process_allgather(merged._data))
-        out = _wrap(jnp.asarray(gathered.sum(axis=0)), merged.context)
-        if was_row_sparse:
-            out = _sp.cast_storage(out, "row_sparse")
-        return out
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from .ndarray import sparse as _sp
+        from .ndarray.ndarray import _wrap
+
+        mesh = self._proc_mesh()
+        nproc = mesh.devices.size
+        local_dev = next(d for d in mesh.devices.flat
+                         if d.process_index == jax.process_index())
+        shard = NamedSharding(mesh, P("proc"))
+        repl = NamedSharding(mesh, P())
+
+        # flatten: dense view per value (+ row indicator for row_sparse)
+        flat = []          # jax arrays to reduce
+        recipe = []        # (kind, ctx, extra) per merged value
+        for m in merged_list:
+            if isinstance(m, _sp.RowSparseNDArray):
+                dense = m.tostype("default")
+                ind = jnp.zeros((m.shape[0],), jnp.float32)
+                if m._rsp_indices is not None and m._rsp_indices.size:
+                    ind = ind.at[m._rsp_indices].set(1.0)
+                flat.append(dense._data)
+                flat.append(ind)
+                recipe.append(("row_sparse", m.context, None))
+            elif isinstance(m, _sp.BaseSparseNDArray):
+                flat.append(m.tostype("default")._data)
+                recipe.append(("csr", m.context, None))
+            else:
+                flat.append(m._data)
+                recipe.append(("dense", m.context, None))
+
+        garrs = []
+        for a in flat:
+            local = jax.device_put(a, local_dev)
+            garrs.append(jax.make_array_from_single_device_arrays(
+                (nproc,) + tuple(a.shape), shard, [local[None]]))
+
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in flat)
+        cache = getattr(self, "_reduce_cache", None)
+        if cache is None:
+            cache = self._reduce_cache = {}
+        fn = cache.get(sig)
+        if fn is None:
+            fn = cache[sig] = jax.jit(
+                lambda ts: [t.sum(axis=0) for t in ts],
+                out_shardings=repl)
+        outs = fn(garrs)
+        # replicated outputs: read this process's addressable copy
+        outs = [o.addressable_data(0) for o in outs]
+
+        result = []
+        i = 0
+        for kind, ctx, _ in recipe:
+            if kind == "row_sparse":
+                dense, ind = outs[i], outs[i + 1]
+                i += 2
+                rows = np.flatnonzero(np.asarray(ind) > 0).astype(np.int64)
+                result.append(self._rows_to_rsp(dense, rows, ctx))
+            elif kind == "csr":
+                result.append(_sp.cast_storage(
+                    _wrap(jnp.asarray(outs[i]), ctx), "csr"))
+                i += 1
+            else:
+                result.append(_wrap(jnp.asarray(outs[i]), ctx))
+                i += 1
+        return result
+
+    @staticmethod
+    def _rows_to_rsp(dense, rows, ctx):
+        """Build a RowSparseNDArray holding exactly ``rows`` (the cross-
+        worker union), including rows whose summed value is zero."""
+        import jax.numpy as jnp
+        from .ndarray import sparse as _sp
+        dense = jnp.asarray(dense)
+        rows_j = jnp.asarray(rows, jnp.int64)
+        data = jnp.take(dense, rows_j.astype(jnp.int32), axis=0) \
+            if rows_j.size else jnp.zeros((0,) + dense.shape[1:], dense.dtype)
+        return _sp.RowSparseNDArray(data, rows_j, dense.shape, ctx)
 
     def barrier(self):
         """Block until every worker reaches this point (parity:

@@ -61,6 +61,9 @@ class Module(BaseModule):
         self._data_shapes = None
         self._label_shapes = None
         self._grad_req = None
+        self._mesh = None
+        self._data_sharding = None
+        self._repl_sharding = None
 
     # -- introspection -----------------------------------------------------
     @property
@@ -135,10 +138,52 @@ class Module(BaseModule):
         ctx = self._context[0]
         self._exec = self._symbol.simple_bind(ctx=ctx, grad_req=reqs,
                                               **shape_kwargs)
+        if len(self._context) > 1:
+            self._init_mesh()
         self.binded = True
         if shared_module is not None and shared_module.params_initialized:
             arg_p, aux_p = shared_module.get_params()
             self.set_params(arg_p, aux_p)
+
+    # -- multi-device mesh (TPU-native DataParallelExecutorGroup) ----------
+    def _init_mesh(self):
+        """N contexts = a dp mesh over N chips: the reference builds one
+        executor per device and reduces grads through KVStore
+        (executor_group.py:128, comm.h:102-720); here the SAME single
+        program is GSPMD-sharded — batch over the ``dp`` axis, params
+        replicated — so XLA inserts the gradient all-reduce over ICI
+        inside the fused fwd+bwd step."""
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        devs = [c.jax_device() for c in self._context]
+        if len(set(devs)) != len(devs):
+            raise MXNetError("duplicate devices in context list %s"
+                             % (self._context,))
+        for d in self._data_shapes + self._label_shapes:
+            if d.shape and d.shape[0] % len(devs) != 0:
+                raise MXNetError(
+                    "batch size %d not divisible by %d devices"
+                    % (d.shape[0], len(devs)))
+        self._mesh = Mesh(np.array(devs), ("dp",))
+        self._data_sharding = NamedSharding(self._mesh, P("dp"))
+        self._repl_sharding = NamedSharding(self._mesh, P())
+        self._shard_exec_arrays()
+
+    def _shard_exec_arrays(self):
+        """Commit shardings: data/label batch-sharded, params/grads/aux
+        replicated. GSPMD propagates from these committed placements."""
+        import jax
+        input_names = set(self._data_names) | set(self._label_names) \
+            | set(self._state_names)
+        for name, arr in self._exec.arg_dict.items():
+            sh = self._data_sharding if name in input_names \
+                else self._repl_sharding
+            arr._set_data(jax.device_put(arr._data, sh))
+        for arr in self._exec.grad_arrays:
+            if arr is not None:
+                arr._set_data(jax.device_put(arr._data, self._repl_sharding))
+        for arr in self._exec.aux_arrays:
+            arr._set_data(jax.device_put(arr._data, self._repl_sharding))
 
     # -- params ------------------------------------------------------------
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
@@ -179,6 +224,9 @@ class Module(BaseModule):
                 initializer(desc, arr)
         self.params_initialized = True
         self._params_dirty = False
+        if self._mesh is not None:
+            # re-commit: initializer writes land on the default device
+            self._shard_exec_arrays()
 
     def get_params(self):
         """(parity: module.get_params) returns host copies."""
@@ -265,19 +313,31 @@ class Module(BaseModule):
                     arg_dict[desc.name]._set_data(
                         np.zeros(arr.shape, dtype=np.float32))
         for desc, arr in zip(self._data_shapes, data):
-            if isinstance(arr, NDArray):
-                arr.copyto(arg_dict[desc.name])
-            else:
-                arg_dict[desc.name][:] = np.asarray(arr)
+            self._write_input(arg_dict[desc.name], arr)
         label = data_batch.label
         if label is not None:
             if not isinstance(label, (list, tuple)):
                 label = [label]
             for desc, arr in zip(self._label_shapes, label):
-                if isinstance(arr, NDArray):
-                    arr.copyto(arg_dict[desc.name])
-                else:
-                    arg_dict[desc.name][:] = np.asarray(arr)
+                self._write_input(arg_dict[desc.name], arr)
+
+    def _write_input(self, dst, src):
+        if self._mesh is not None:
+            # commit the batch sharded over dp so GSPMD splits the step;
+            # keep the bound placeholder's dtype (as copyto/setitem do)
+            import jax
+            dt = dst._data.dtype
+            raw = src._data if isinstance(src, NDArray) else np.asarray(src)
+            if isinstance(raw, np.ndarray):
+                raw = jax.device_put(raw.astype(dt, copy=False),
+                                     self._data_sharding)
+            else:
+                raw = jax.device_put(raw, self._data_sharding).astype(dt)
+            dst._set_data(raw)
+        elif isinstance(src, NDArray):
+            src.copyto(dst)
+        else:
+            dst[:] = np.asarray(src)
 
     def update(self):
         """Apply one optimizer step (parity: module.update →
@@ -287,26 +347,23 @@ class Module(BaseModule):
         self._params_dirty = True
         arg_dict = self._exec.arg_dict
         grad_dict = self._exec.grad_dict
+        # push/pull whole key LISTS: in dist mode kvstore then reduces all
+        # keys in one jitted collective instead of one dispatch per param
+        live = [(i, name) for i, name in enumerate(self._param_names)
+                if grad_dict.get(name) is not None]
+        if not live:
+            return
+        keys = [i for i, _ in live]
+        grads = [grad_dict[name] for _, name in live]
         if self._kvstore is not None and self._update_on_kvstore:
-            for i, name in enumerate(self._param_names):
-                g = grad_dict.get(name)
-                if g is None:
-                    continue
-                self._kvstore.push(i, g)
-                self._kvstore.pull(i, out=arg_dict[name])
+            self._kvstore.push(keys, grads)
+            self._kvstore.pull(keys, out=[arg_dict[name] for _, name in live])
         else:
             if self._kvstore is not None:
-                for i, name in enumerate(self._param_names):
-                    g = grad_dict.get(name)
-                    if g is None:
-                        continue
-                    self._kvstore.push(i, g)
-                    self._kvstore.pull(i, out=g)
-            for i, name in enumerate(self._param_names):
-                g = grad_dict.get(name)
-                if g is None:
-                    continue
-                self._updater(i, g, arg_dict[name])
+                self._kvstore.push(keys, grads)
+                self._kvstore.pull(keys, out=grads)
+            for i, name in live:
+                self._updater(i, grad_dict[name], arg_dict[name])
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded

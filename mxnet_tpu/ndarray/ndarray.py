@@ -151,7 +151,12 @@ class NDArray:
             dev = other.jax_device()
             return _wrap(jax.device_put(self._data, dev), other)
         if isinstance(other, NDArray):
-            other._set_data(jax.device_put(self._data, other._ctx.jax_device())
+            # a destination committed to a multi-device sharding (mesh-DP
+            # Module state) keeps that sharding — the reference's CopyFromTo
+            # also copies into the destination's existing placement
+            target = _multi_device_sharding(other._data) \
+                or other._ctx.jax_device()
+            other._set_data(jax.device_put(self._data, target)
                             .astype(other._data.dtype))
             return other
         raise TypeError("copyto: expected NDArray or Context")
@@ -413,7 +418,9 @@ class NDArray:
         if key is Ellipsis or (isinstance(key, slice) and key == slice(None)):
             new = jnp.broadcast_to(jnp.asarray(value, self._data.dtype),
                                    self.shape).astype(self._data.dtype)
-            self._set_data(_to_device(new, self._ctx))
+            sh = _multi_device_sharding(self._data)
+            self._set_data(jax.device_put(new, sh) if sh is not None
+                           else _to_device(new, self._ctx))
         else:
             self._set_data(self._data.at[key].set(value))
 
@@ -444,6 +451,15 @@ def _to_device(raw, ctx):
         return jax.device_put(raw, ctx.jax_device())
     except Exception:
         return jnp.asarray(raw)
+
+
+def _multi_device_sharding(raw):
+    """The committed sharding of ``raw`` if it spans >1 device (mesh-
+    sharded/replicated state under the DP Module), else None."""
+    sh = getattr(raw, "sharding", None)
+    if sh is not None and len(getattr(sh, "device_set", ())) > 1:
+        return sh
+    return None
 
 
 def _wrap(raw, ctx=None):

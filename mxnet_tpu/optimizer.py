@@ -17,11 +17,25 @@ from .base import MXNetError, registry_create
 from .ndarray import ndarray as _nd
 from .ndarray import (sgd_update, sgd_mom_update, mp_sgd_update,
                       mp_sgd_mom_update, adam_update, rmsprop_update,
-                      rmspropalex_update, ftrl_update, zeros)
+                      rmspropalex_update, ftrl_update, zeros)  # noqa: F401 (zeros: API re-export)
 
 __all__ = ["Optimizer", "SGD", "NAG", "SGLD", "DCASGD", "Adam", "AdaGrad",
            "RMSProp", "AdaDelta", "Ftrl", "Test", "Updater", "get_updater",
            "create", "register"]
+
+
+def _state_zeros(weight, dtype=None):
+    """Optimizer state shaped AND placed like the weight: under the
+    mesh-DP Module weights are committed replicated over the device mesh,
+    and states must share that placement or the fused update ops would
+    mix single-device and mesh-committed operands."""
+    import jax
+    import jax.numpy as jnp
+    raw = jnp.zeros(weight.shape, dtype or weight._data.dtype)
+    sh = _nd._multi_device_sharding(weight._data)
+    raw = jax.device_put(raw, sh) if sh is not None \
+        else _nd._to_device(raw, weight.context)
+    return _nd._wrap(raw, weight.context)
 
 register, _alias, _create, _get = registry_create("optimizer")
 
@@ -161,7 +175,7 @@ class SGD(Optimizer):
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return None
-        return zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return _state_zeros(weight)
 
     def create_state_multi_precision(self, index, weight):
         if self.multi_precision and weight.dtype == np.float16:
@@ -266,7 +280,7 @@ class DCASGD(Optimizer):
     def create_state(self, index, weight):
         if self.momentum == 0.0:
             return (None, weight.copy())
-        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+        return (_state_zeros(weight),
                 weight.copy())
 
     def update(self, index, weight, grad, state):
@@ -300,8 +314,8 @@ class Adam(Optimizer):
         self.epsilon = epsilon
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
-                zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+        return (_state_zeros(weight),
+                _state_zeros(weight))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -341,7 +355,7 @@ class AdaGrad(Optimizer):
         self.float_stable_eps = eps
 
     def create_state(self, index, weight):
-        return zeros(weight.shape, ctx=weight.context)
+        return _state_zeros(weight, dtype=np.float32)
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -383,10 +397,10 @@ class RMSProp(Optimizer):
 
     def create_state(self, index, weight):
         if self.centered:
-            return (zeros(weight.shape, ctx=weight.context),
-                    zeros(weight.shape, ctx=weight.context),
-                    zeros(weight.shape, ctx=weight.context))
-        return (zeros(weight.shape, ctx=weight.context),)
+            return (_state_zeros(weight, dtype=np.float32),
+                    _state_zeros(weight, dtype=np.float32),
+                    _state_zeros(weight, dtype=np.float32))
+        return (_state_zeros(weight, dtype=np.float32),)
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -415,8 +429,8 @@ class AdaDelta(Optimizer):
         self.epsilon = epsilon
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, ctx=weight.context),
-                zeros(weight.shape, ctx=weight.context))
+        return (_state_zeros(weight, dtype=np.float32),
+                _state_zeros(weight, dtype=np.float32))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -445,8 +459,8 @@ class Ftrl(Optimizer):
         self.beta = beta
 
     def create_state(self, index, weight):
-        return (zeros(weight.shape, ctx=weight.context),
-                zeros(weight.shape, ctx=weight.context))
+        return (_state_zeros(weight, dtype=np.float32),
+                _state_zeros(weight, dtype=np.float32))
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -461,7 +475,7 @@ class Test(Optimizer):
     """(parity: optimizer.Test — used by unit tests)"""
 
     def create_state(self, index, weight):
-        return zeros(weight.shape, ctx=weight.context)
+        return _state_zeros(weight, dtype=np.float32)
 
     def update(self, index, weight, grad, state):
         weight += grad * self.rescale_grad

@@ -58,15 +58,31 @@ class SPMDTrainer:
 
     def __init__(self, apply_fn, params, mesh, data_axis="dp", tp_axis=None,
                  optimizer="sgd", learning_rate=0.01, momentum=0.0, wd=0.0,
-                 param_specs=None, batch_specs=None, n_batch_args=2):
+                 param_specs=None, batch_specs=None, n_batch_args=2,
+                 **optimizer_kwargs):
+        from . import opt_kernels
         self.mesh = mesh
         self.data_axis = data_axis
         self._apply = apply_fn
-        if optimizer != "sgd":
-            raise MXNetError("SPMDTrainer supports sgd in this build")
-        self.lr = learning_rate
-        self.momentum = momentum
-        self.wd = wd
+
+        # any registered optimizer: an Optimizer instance (or name, built
+        # via the optimizer registry so per-optimizer defaults apply) maps
+        # onto its pure kernel
+        from .. import optimizer as opt_mod
+        if not isinstance(optimizer, opt_mod.Optimizer):
+            okw = dict(optimizer_kwargs)
+            okw.setdefault("learning_rate", learning_rate)
+            okw.setdefault("wd", wd)
+            if momentum:
+                okw.setdefault("momentum", momentum)
+            optimizer = opt_mod.create(optimizer, **okw)
+        kname, hyper = opt_kernels.hyper_from_optimizer(optimizer)
+        init_fn, update_fn = opt_kernels.get_kernel(kname)
+        self.lr = hyper["lr"]
+        self.momentum = hyper.get("momentum", 0.0)
+        self.wd = hyper["wd"]
+        self._hyper = hyper
+        self._num_update = 0
 
         if param_specs is None:
             param_specs = shard_params_rule(params, mesh, tp_axis)
@@ -76,44 +92,55 @@ class SPMDTrainer:
             batch_specs = [P(data_axis)] * n_batch_args
         self.batch_shardings = [NamedSharding(mesh, s) for s in batch_specs]
 
-        # place params + momentum sharded
+        # place params + per-param optimizer state sharded like the param
         self.params = {k: jax.device_put(v, self.param_shardings[k])
                        for k, v in params.items()}
-        self.mom = {k: jax.device_put(jnp.zeros_like(v),
-                                      self.param_shardings[k])
-                    for k, v in self.params.items()} if momentum else None
+        self.opt_state = {
+            k: tuple(jax.device_put(s, self.param_shardings[k])
+                     for s in init_fn(v))
+            for k, v in self.params.items()}
 
-        lr, mom_c, wd_c = self.lr, self.momentum, self.wd
+        # static hyperparams fold into the program; lr and t stay traced
+        # so schedules/bias-correction never trigger a recompile
+        static_h = dict(hyper)
 
-        def step(params, mom, *batch):
+        def step(params, opt_state, lr, t, *batch):
             loss, grads = jax.value_and_grad(apply_fn)(params, *batch)
+            h = dict(static_h)
+            h["lr"] = lr
             new_params = {}
-            new_mom = {}
+            new_state = {}
             for k, g in grads.items():
-                g = g + wd_c * params[k]
-                if mom is not None:
-                    m = mom_c * mom[k] - lr * g
-                    new_mom[k] = m
-                    new_params[k] = params[k] + m
-                else:
-                    new_params[k] = params[k] - lr * g
-            return new_params, (new_mom if mom is not None else None), loss
+                new_params[k], new_state[k] = update_fn(
+                    params[k], g, opt_state[k], t, h)
+            return new_params, new_state, loss
 
         param_sh = self.param_shardings
+        state_sh = {k: tuple(param_sh[k] for _ in self.opt_state[k])
+                    for k in self.opt_state}
         self._step = jax.jit(
             step,
-            in_shardings=(param_sh, param_sh if momentum else None,
+            in_shardings=(param_sh, state_sh, None, None,
                           *self.batch_shardings),
-            out_shardings=(param_sh, param_sh if momentum else None, None),
+            out_shardings=(param_sh, state_sh, None),
             donate_argnums=(0, 1))
+
+    # back-compat: round-1 callers read .mom for sgd momentum state
+    @property
+    def mom(self):
+        if not self.momentum:
+            return None
+        return {k: s[0] for k, s in self.opt_state.items()}
 
     def step(self, *batch):
         """Run one sharded train step; returns the scalar loss."""
         batch = [jax.device_put(np.asarray(b) if not isinstance(b, jax.Array)
                                 else b, s)
                  for b, s in zip(batch, self.batch_shardings)]
-        self.params, self.mom, loss = self._step(self.params, self.mom,
-                                                 *batch)
+        self._num_update += 1
+        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state,
+            jnp.float32(self.lr), jnp.float32(self._num_update), *batch)
         return loss
 
     def get_params(self):

@@ -87,7 +87,16 @@ def get_create_func(base_class, nickname):
             raise MXNetError(
                 "%s is not registered. Known %ss: %s"
                 % (name, nickname, ", ".join(sorted(registry))))
-        return registry[name](*args, **kwargs)
+        klass = registry[name]
+        # the kind registry is shared by nickname (so built-ins registered
+        # via base.registry_create stay visible); guard against a
+        # same-nickname registry for an unrelated base handing back a
+        # non-subclass
+        if not issubclass(klass, base_class):
+            raise MXNetError(
+                "%s %r resolves to %s which is not a subclass of %s"
+                % (nickname, name, klass.__name__, base_class.__name__))
+        return klass(*args, **kwargs)
 
     create.__doc__ = "Create a %s instance from config" % nickname
     return create

@@ -29,6 +29,8 @@ __all__ = ["Resource", "ResourceManager", "request"]
 class Resource:
     """One granted resource (parity: struct Resource)."""
 
+    _MAX_RETIRED = 4
+
     def __init__(self, kind, ctx):
         self.kind = kind
         self.ctx = ctx
@@ -39,15 +41,23 @@ class Resource:
     def get_space(self, shape, dtype=np.float32):
         """Scratch numpy buffer, reused across requests of the same slot
         (parity: Resource::get_space — like the reference, a later larger
-        request invalidates earlier views logically, but the old buffer is
-        parked until release() so stale views never alias a re-issued
-        pool buffer)."""
+        request invalidates earlier views logically; the old buffer is
+        parked — up to _MAX_RETIRED of them, then freed oldest-first —
+        so recently-invalidated views never alias a re-issued pool
+        buffer)."""
         if self.kind != "temp_space":
             raise MXNetError("get_space on a %r resource" % self.kind)
         nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
         if self._handle is None or self._handle.size < nbytes:
             if self._handle is not None:
                 self._retired.append(self._handle)
+                # park outgrown buffers so recent stale views never alias a
+                # re-issued pool buffer, but bound the parking lot: views
+                # older than the last _MAX_RETIRED grows are invalidated
+                # (long-lived resources like an ImageIter slot never call
+                # release(), and unbounded parking is a leak)
+                while len(self._retired) > self._MAX_RETIRED:
+                    Storage.get().free(self._retired.pop(0))
             self._handle = Storage.get().alloc(nbytes)
         return self._handle.array(shape, dtype)
 

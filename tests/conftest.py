@@ -8,17 +8,21 @@ multi-chip path via __graft_entry__.dryrun_multichip.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "--xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+_TPU_LANE = os.environ.get("MXTPU_TEST_PLATFORM") == "tpu"
+
+if not _TPU_LANE:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
-# the axon sitecustomize force-selects the TPU platform; tests run on the
-# virtual CPU mesh regardless
-jax.config.update("jax_platforms", "cpu")
+if not _TPU_LANE:
+    # the axon sitecustomize force-selects the TPU platform; tests run on
+    # the virtual CPU mesh regardless (the tests/tpu lane lifts this)
+    jax.config.update("jax_platforms", "cpu")
 # numeric parity tests compare against numpy float32; disable bf16 matmul
 jax.config.update("jax_default_matmul_precision", "highest")
 

@@ -1,0 +1,92 @@
+"""Multi-device data-parallel Module: one GSPMD-sharded program.
+
+Parity model: reference multi-GPU DataParallelExecutorGroup + KVStore
+reduction (tests/python/unittest/test_multi_device_exec.py and
+nightly/multi_lenet.py) — validated here the TPU-native way: a Module
+bound on N contexts shards the batch over a dp mesh and must produce the
+SAME losses/params as the single-device Module, because the gradient
+all-reduce happens inside the compiled step.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, nd
+from mxnet_tpu.io import NDArrayIter, DataBatch
+
+import jax
+
+
+def _toy_data(n=256, d=16, c=4, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.normal(0, 2, (c, d)).astype(np.float32)
+    y = rng.randint(0, c, n)
+    x = ((centers[y] + rng.normal(0, 0.5, (n, d))) / 3.0).astype(np.float32)
+    return x, y.astype(np.float32)
+
+
+def _mlp(c=4):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=c, name="fc2")
+    return sym.SoftmaxOutput(net, sym.Variable("softmax_label"), name="softmax")
+
+
+def _fit(contexts, nbatch=4, batch_size=64):
+    np.random.seed(0)
+    mx.random.seed(0)
+    x, y = _toy_data()
+    mod = mx.mod.Module(_mlp(), context=contexts)
+    mod.bind(data_shapes=[("data", (batch_size, 16))],
+             label_shapes=[("softmax_label", (batch_size,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(kvstore="local", optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9})
+    losses = []
+    for i in range(nbatch):
+        xs = x[i * batch_size:(i + 1) * batch_size]
+        ys = y[i * batch_size:(i + 1) * batch_size]
+        batch = DataBatch(data=[nd.array(xs)], label=[nd.array(ys)])
+        mod.forward_backward(batch)
+        out = mod.get_outputs()[0].asnumpy()
+        nll = -np.log(np.maximum(
+            out[np.arange(batch_size), ys.astype(int)], 1e-8)).mean()
+        losses.append(nll)
+        mod.update()
+    arg_p, _ = mod.get_params()
+    return losses, {k: v.asnumpy() for k, v in arg_p.items()}
+
+
+def test_dp_module_matches_single_device():
+    n_dev = min(8, jax.device_count())
+    assert n_dev >= 2, "conftest sets an 8-device virtual CPU mesh"
+    ref_losses, ref_params = _fit([mx.cpu(0)])
+    dp_losses, dp_params = _fit([mx.cpu(i) for i in range(n_dev)])
+    np.testing.assert_allclose(dp_losses, ref_losses, rtol=1e-5, atol=1e-6)
+    for k in ref_params:
+        np.testing.assert_allclose(dp_params[k], ref_params[k],
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+def test_dp_module_fit_loop():
+    """Module.fit end-to-end over 8 virtual devices (convergence gate)."""
+    x, y = _toy_data(512)
+    n_dev = min(8, jax.device_count())
+    train = NDArrayIter(x, y, batch_size=64, shuffle=True)
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu(i) for i in range(n_dev)])
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+            initializer=mx.initializer.Xavier(), num_epoch=4)
+    score = mod.score(NDArrayIter(x, y, batch_size=64), "acc")
+    assert score[0][1] > 0.9, "did not converge: %s" % score
+
+
+def test_dp_batch_not_divisible_raises():
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu(i) for i in range(3)])
+    try:
+        mod.bind(data_shapes=[("data", (62, 16))],
+                 label_shapes=[("softmax_label", (62,))])
+    except mx.base.MXNetError:
+        return
+    raise AssertionError("expected divisibility error")

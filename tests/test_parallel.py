@@ -178,3 +178,66 @@ def test_ulysses_differentiable():
     g = jax.grad(loss)(q, q, q)
     assert g.shape == q.shape
     assert np.isfinite(np.asarray(g)).all()
+
+
+def test_spmd_trainer_adam_matches_eager():
+    """dp/tp Adam in the sharded step must match the eager mx.optimizer
+    Adam applied to the same grads (VERDICT r1 #9 done-criterion)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    np.random.seed(0)
+    W = np.random.normal(0, 0.1, (8, 8)).astype(np.float32)
+    X = np.random.normal(size=(16, 8)).astype(np.float32)
+    Y = np.random.randint(0, 8, 16).astype(np.int32)
+
+    def apply_fn(params, x, y):
+        logits = x @ params["w"].T
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+    mesh = parallel.make_mesh({"dp": 4, "tp": 2})
+    opt = mx.optimizer.Adam(learning_rate=0.05)
+    tr = parallel.SPMDTrainer(apply_fn, {"w": W.copy()}, mesh,
+                              data_axis="dp", tp_axis="tp", optimizer=opt)
+    for _ in range(3):
+        tr.step(X, Y)
+
+    # eager reference: same grads through mx.optimizer.Adam
+    eager_opt = mx.optimizer.Adam(learning_rate=0.05)
+    weight = nd.array(W.copy())
+    state = eager_opt.create_state(0, weight)
+    params = {"w": jnp.asarray(W)}
+    for _ in range(3):
+        _, grads = jax.value_and_grad(apply_fn)(params, jnp.asarray(X),
+                                                jnp.asarray(Y))
+        eager_opt.update(0, weight, nd.array(np.asarray(grads["w"])), state)
+        params = {"w": weight._data}
+    np.testing.assert_allclose(tr.get_params()["w"], weight.asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_spmd_trainer_rmsprop_and_adagrad_run():
+    np.random.seed(0)
+    W = np.random.normal(0, 0.1, (4, 8)).astype(np.float32)
+    X = np.random.normal(size=(8, 8)).astype(np.float32)
+    Y = np.random.randint(0, 4, 8).astype(np.int32)
+
+    def apply_fn(params, x, y):
+        logits = x @ params["w"].T
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+    mesh = parallel.make_mesh({"dp": 8})
+    for name, kw in [("rmsprop", {"gamma1": 0.9, "epsilon": 1e-8}),
+                     ("adagrad", {"eps": 1e-7}),
+                     ("adagrad", {}),          # registry defaults path
+                     ("nag", {"momentum": 0.9})]:
+        tr = parallel.SPMDTrainer(apply_fn, {"w": W.copy()}, mesh,
+                                  data_axis="dp", optimizer=name,
+                                  learning_rate=0.05, **kw)
+        l0 = float(tr.step(X, Y))
+        l1 = float(tr.step(X, Y))
+        l2 = float(tr.step(X, Y))
+        assert np.isfinite(l1) and np.isfinite(l2) and l2 < l0, \
+            (name, l0, l1, l2)

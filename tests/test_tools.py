@@ -79,6 +79,53 @@ def test_launch_local_two_process_spmd(tmp_path):
     assert p.stdout.count("OK rank") == 2
 
 
+def test_launch_local_dist_kvstore_push_pull(tmp_path):
+    """2-process dist_sync kvstore: batched dense push reduces on device
+    across processes; row_sparse keeps the union of pushed rows even when
+    the global sum of a row is zero (reference dist-server semantics,
+    kvstore_dist_server.h:261-312)."""
+    script = tmp_path / "worker_kv.py"
+    script.write_text(
+        "import sys; sys.path.insert(0, %r)\n" % REPO +
+        "import numpy as np\n"
+        "import mxnet_tpu as mx\n"
+        "from mxnet_tpu import nd\n"
+        "from mxnet_tpu.ndarray import sparse as sp\n"
+        "import jax\n"
+        "assert jax.process_count() == 2\n"
+        "kv = mx.kv.create('dist_sync')\n"
+        "r = kv.rank\n"
+        "kv.init(['a', 'b'], [nd.zeros((2, 3)), nd.zeros((4,))])\n"
+        "# batched push of two keys at once -> one jitted collective\n"
+        "kv.push(['a', 'b'], [nd.ones((2, 3)) * (r + 1),\n"
+        "                     nd.ones((4,)) * (10 * (r + 1))])\n"
+        "oa, ob = nd.zeros((2, 3)), nd.zeros((4,))\n"
+        "kv.pull(['a', 'b'], out=[oa, ob])\n"
+        "np.testing.assert_allclose(oa.asnumpy(), np.full((2, 3), 3.0))\n"
+        "np.testing.assert_allclose(ob.asnumpy(), np.full((4,), 30.0))\n"
+        "# row_sparse: rank0 pushes +1 on row 1, rank1 pushes -1 on row 1\n"
+        "# (sum 0) and +2 on row 3; union must keep BOTH rows 1 and 3\n"
+        "val = np.array([[1.0, 1.0]]) if r == 0 else np.array([[-1.0, -1.0]])\n"
+        "rows = [1] if r == 0 else [1, 3]\n"
+        "if r == 1:\n"
+        "    val = np.array([[-1.0, -1.0], [2.0, 2.0]])\n"
+        "g = sp.row_sparse_array((val.astype(np.float32), rows), shape=(5, 2))\n"
+        "kv.init('c', sp.zeros('row_sparse', (5, 2)))\n"
+        "kv.push('c', g)\n"
+        "got = kv._store['c']\n"
+        "assert sorted(np.asarray(got._rsp_indices).tolist()) == [1, 3], \\\n"
+        "    np.asarray(got._rsp_indices)\n"
+        "dense = got.tostype('default').asnumpy()\n"
+        "np.testing.assert_allclose(dense[3], [2.0, 2.0])\n"
+        "np.testing.assert_allclose(dense[1], [0.0, 0.0])\n"
+        "print('KV OK rank', r)\n")
+    p = _run([os.path.join(TOOLS, "launch.py"), "-n", "2",
+              "--force-cpu", "--port", "9413",
+              sys.executable, str(script)])
+    assert p.returncode == 0, p.stderr + p.stdout
+    assert p.stdout.count("KV OK rank") == 2
+
+
 def test_bandwidth_probe():
     p = _run([os.path.join(TOOLS, "bandwidth", "measure.py"),
               "--force-cpu", "--size-mb", "1", "--rounds", "2"])

@@ -1,0 +1,31 @@
+"""TPU test lane: runs on the REAL chip, skipped on CPU-only runs.
+
+The reference validates its second backend by consistency against the
+first (tests/python/gpu/test_operator_gpu.py + test_utils.check_consistency
+at python/mxnet/test_utils.py:1267); this lane is the TPU analogue.
+
+Run with:
+    MXTPU_TEST_PLATFORM=tpu python -m pytest tests/tpu -q
+
+Under the default test run (`pytest tests/`) the root conftest pins the
+cpu platform and everything here skips.
+"""
+import os
+
+import pytest
+
+
+def _on_accelerator():
+    import jax
+    try:
+        return any(d.platform != "cpu" for d in jax.devices())
+    except RuntimeError:
+        return False
+
+
+def pytest_collection_modifyitems(config, items):
+    if os.environ.get("MXTPU_TEST_PLATFORM") != "tpu" or not _on_accelerator():
+        skip = pytest.mark.skip(
+            reason="TPU lane: set MXTPU_TEST_PLATFORM=tpu with a chip attached")
+        for item in items:
+            item.add_marker(skip)

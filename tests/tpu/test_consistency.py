@@ -1,0 +1,79 @@
+"""TPU-vs-CPU operator consistency (the reference's second-backend oracle,
+tests/python/gpu/test_operator_gpu.py + check_consistency).
+
+Each case binds the same symbol on cpu and tpu contexts and compares
+forward outputs AND gradients. TPU matmuls default to bf16-ish passes;
+tolerances are set for fp32-highest (conftest of the root suite does not
+apply here, so set matmul precision explicitly).
+"""
+import numpy as np
+import pytest
+
+import jax
+
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import mxnet_tpu as mx                    # noqa: E402
+from mxnet_tpu import sym                 # noqa: E402
+from mxnet_tpu.test_utils import check_consistency  # noqa: E402
+
+
+def _pair(shape_kwargs):
+    return [dict(ctx=mx.cpu(), **shape_kwargs),
+            dict(ctx=mx.tpu(), **shape_kwargs)]
+
+
+def test_fully_connected_consistency():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc")
+    check_consistency(net, _pair({"data": (8, 32)}))
+
+
+def test_convolution_consistency():
+    data = sym.Variable("data")
+    net = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                          name="conv")
+    check_consistency(net, _pair({"data": (2, 3, 16, 16)}), rtol=1e-3,
+                      atol=1e-4)
+
+
+def test_pooling_consistency():
+    data = sym.Variable("data")
+    net = sym.Pooling(data, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    check_consistency(net, _pair({"data": (2, 4, 16, 16)}))
+
+
+def test_batchnorm_consistency():
+    data = sym.Variable("data")
+    net = sym.BatchNorm(data, fix_gamma=False, name="bn")
+    check_consistency(net, _pair({"data": (4, 8, 8, 8)}), rtol=1e-3,
+                      atol=1e-4)
+
+
+def test_activation_softmax_consistency():
+    data = sym.Variable("data")
+    net = sym.Activation(data, act_type="tanh")
+    net = sym.SoftmaxOutput(net, sym.Variable("softmax_label"))
+    check_consistency(net, _pair({"data": (8, 10),
+                                  "softmax_label": (8,)}))
+
+
+def test_elemwise_reduce_consistency():
+    a = sym.Variable("a")
+    net = sym.sum(sym.broadcast_mul(a, a) + a, axis=1)
+    check_consistency(net, _pair({"a": (6, 7)}))
+
+
+def test_deconv_consistency():
+    data = sym.Variable("data")
+    net = sym.Deconvolution(data, kernel=(2, 2), stride=(2, 2), num_filter=4,
+                            name="deconv")
+    check_consistency(net, _pair({"data": (2, 3, 8, 8)}), rtol=1e-3,
+                      atol=1e-4)
+
+
+def test_dot_transpose_consistency():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    net = sym.dot(a, b, transpose_b=True)
+    check_consistency(net, _pair({"a": (5, 9), "b": (7, 9)}))
