@@ -198,10 +198,17 @@ def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
 
 
 def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
-                      arg_params=None, rtol=1e-4, atol=1e-5):
+                      arg_params=None, rtol=1e-3, atol=1e-4):
     """Run the same graph on several contexts and compare outputs+grads —
     the cross-backend oracle (parity: test_utils.check_consistency; the
-    reference compares cpu vs gpu, here cpu vs tpu)."""
+    reference compares cpu vs gpu, here cpu vs tpu).
+
+    Default tolerance matches the reference's fp32 cross-backend tol of
+    1e-3 (reference python/mxnet/test_utils.py:1267 `tol[np.float32]`).
+    TPU transcendental units (tanh/exp are polynomial/exp2 hardware
+    approximations) differ from CPU libm by up to a few e-5 absolute for
+    O(1) inputs — correct behavior, not a precision bug — so the round-2
+    atol of 1e-5 was miscalibrated for a cross-backend oracle."""
     if len(ctx_list) < 2:
         raise MXNetError("need at least two contexts")
     results = []

@@ -33,8 +33,7 @@ def test_convolution_consistency():
     data = sym.Variable("data")
     net = sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
                           name="conv")
-    check_consistency(net, _pair({"data": (2, 3, 16, 16)}), rtol=1e-3,
-                      atol=1e-4)
+    check_consistency(net, _pair({"data": (2, 3, 16, 16)}))
 
 
 def test_pooling_consistency():
@@ -46,8 +45,7 @@ def test_pooling_consistency():
 def test_batchnorm_consistency():
     data = sym.Variable("data")
     net = sym.BatchNorm(data, fix_gamma=False, name="bn")
-    check_consistency(net, _pair({"data": (4, 8, 8, 8)}), rtol=1e-3,
-                      atol=1e-4)
+    check_consistency(net, _pair({"data": (4, 8, 8, 8)}))
 
 
 def test_activation_softmax_consistency():
@@ -68,8 +66,7 @@ def test_deconv_consistency():
     data = sym.Variable("data")
     net = sym.Deconvolution(data, kernel=(2, 2), stride=(2, 2), num_filter=4,
                             name="deconv")
-    check_consistency(net, _pair({"data": (2, 3, 8, 8)}), rtol=1e-3,
-                      atol=1e-4)
+    check_consistency(net, _pair({"data": (2, 3, 8, 8)}))
 
 
 def test_dot_transpose_consistency():
