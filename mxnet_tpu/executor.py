@@ -116,17 +116,24 @@ class _GraphProgram:
 # Shape inference over the graph
 # ---------------------------------------------------------------------------
 
-def infer_graph_shapes(symbol, known_shapes, partial=False, default_dtype=np.float32):
-    """Infer all variable and output shapes (parity: InferShape pass,
-    reference src/executor/infer_graph_attr_pass.cc).
+def infer_graph_attrs(symbol, known_shapes, known_types=None, partial=False,
+                      default_dtype=np.float32):
+    """Joint shape+dtype inference (parity: the reference's InferShape AND
+    InferType passes, src/executor/infer_graph_attr_pass.cc — one walk
+    here because jax.eval_shape propagates both attributes at once).
 
-    Strategy: forward topo walk; op param hooks (ops/shape_infer.py) fill
-    learnable-input shapes; jax.eval_shape computes output shapes without
-    running anything (XLA shape propagation = the reference's FInferShape).
+    Variable dtypes resolve in priority order: ``known_types`` (the
+    simple_bind ``type_dict``) > a ``__dtype__`` attr on the Variable >
+    dtype filled by the consuming op (learnable inputs follow the op's
+    first float input — the reference's per-op InferType rule — unless
+    the op has a ``param_dtype_infer`` hook, e.g. BatchNorm pins its
+    scale/shift/moving stats to fp32) > ``default_dtype``.
     """
     nodes = symbol._topo_nodes()
     var_shape = dict(known_shapes)
+    var_type = {k: np.dtype(v) for k, v in (known_types or {}).items()}
     shapes = {}  # id(node) -> tuple of output shapes
+    types = {}   # id(node) -> tuple of output dtypes
 
     for node in nodes:
         if node.op is None:
@@ -135,13 +142,19 @@ def infer_graph_shapes(symbol, known_shapes, partial=False, default_dtype=np.flo
                 import ast
                 shp = tuple(ast.literal_eval(node._extra_attrs["__shape__"]))
                 var_shape[node.name] = shp
+            dt = var_type.get(node.name)
+            if dt is None and "__dtype__" in node._extra_attrs:
+                dt = np.dtype(node._extra_attrs["__dtype__"])
+                var_type[node.name] = dt
             shapes[id(node)] = (shp,)
+            types[id(node)] = (dt,)
             continue
         in_shapes = [shapes[id(c)][idx] for c, idx in node.inputs]
+        in_types = [types[id(c)][idx] for c, idx in node.inputs]
         params = dict(node.op.defaults)
         params.update(node.attrs)
         params.pop("num_args", None)
-        # fill unknown learnable inputs
+        # fill unknown learnable-input shapes
         if node.op.param_shape_infer is not None and in_shapes[0] is not None:
             fills = node.op.param_shape_infer(in_shapes, params)
             for i, shp in fills.items():
@@ -151,38 +164,90 @@ def infer_graph_shapes(symbol, known_shapes, partial=False, default_dtype=np.flo
                         var_shape[child.name] = tuple(shp)
                         shapes[id(child)] = (tuple(shp),)
                         in_shapes[i] = tuple(shp)
+        # fill unknown input dtypes: per-op hook first, then the op's
+        # first known float input, then the session default
+        dtype_fills = {}
+        if node.op.param_dtype_infer is not None:
+            dtype_fills = node.op.param_dtype_infer(in_types, params)
+        # jnp.issubdtype, not np: bfloat16 is an ml_dtypes extension type
+        # that numpy does not classify under np.floating
+        anchor = next((t for t in in_types
+                       if t is not None and jnp.issubdtype(t, jnp.floating)),
+                      np.dtype(default_dtype))
+        for i in range(len(in_types)):
+            if in_types[i] is None:
+                dt = np.dtype(dtype_fills.get(i, anchor))
+                child, idx = node.inputs[i]
+                if child.op is None:
+                    var_type[child.name] = dt
+                    types[id(child)] = (dt,)
+                in_types[i] = dt
         if any(s is None for s in in_shapes):
             if partial:
                 shapes[id(node)] = tuple([None] * node.num_outputs())
+                # dtype-only propagation still works without shapes (the
+                # reference InferType pass is shape-independent): outputs
+                # follow the promoted float input dtype; Cast follows its
+                # param.
+                if node.op.name == "Cast":
+                    dt = np.dtype(params.get("dtype", "float32"))
+                elif node.op.param_dtype_infer is not None:
+                    # ops that pin param dtypes (BatchNorm's fp32 stats)
+                    # still emit the DATA dtype — don't promote across the
+                    # pinned fp32 params
+                    dt = anchor
+                else:
+                    floats = [t for t in in_types
+                              if t is not None
+                              and jnp.issubdtype(t, jnp.floating)]
+                    dt = np.dtype(jnp.result_type(*floats)) if floats else None
+                types[id(node)] = tuple([dt] * node.num_outputs())
                 continue
             missing = [node.inputs[i][0].name for i, s in enumerate(in_shapes)
                        if s is None]
             raise MXNetError("infer_shape: cannot infer %r (missing inputs %s)"
                              % (node.name, missing))
-        # eval_shape through the op function
+        # eval_shape through the op function: XLA's abstract evaluation is
+        # both FInferShape and FInferType
         if node.op.takes_train:
             params["_train"] = False
         if node.op.takes_rng:
             params["_rng"] = jax.random.key(0)
-        structs = [jax.ShapeDtypeStruct(s, default_dtype) for s in in_shapes]
+        structs = [jax.ShapeDtypeStruct(s, t)
+                   for s, t in zip(in_shapes, in_types)]
         try:
             out = jax.eval_shape(lambda *a: node.op.fn(*a, **params), *structs)
         except Exception as e:
             if partial:
                 shapes[id(node)] = tuple([None] * node.num_outputs())
+                types[id(node)] = tuple([None] * node.num_outputs())
                 continue
             raise MXNetError("infer_shape failed at %s(%s): %s"
                              % (node.op.name, node.name, e))
         outs = out if isinstance(out, tuple) else (out,)
         shapes[id(node)] = tuple(tuple(o.shape) for o in outs)
+        types[id(node)] = tuple(np.dtype(o.dtype) for o in outs)
 
     arg_shapes = [var_shape.get(n) for n in symbol.list_arguments()]
     aux_shapes = [var_shape.get(n) for n in symbol.list_auxiliary_states()]
-    out_shapes = []
+    arg_types = [var_type.get(n) for n in symbol.list_arguments()]
+    aux_types = [var_type.get(n) for n in symbol.list_auxiliary_states()]
+    out_shapes, out_types = [], []
     for n, idx in symbol._outputs:
         s = shapes.get(id(n))
+        t = types.get(id(n))
         out_shapes.append(None if s is None or idx >= len(s) else s[idx])
-    return arg_shapes, out_shapes, aux_shapes
+        out_types.append(None if t is None or idx >= len(t) else t[idx])
+    return (arg_shapes, out_shapes, aux_shapes,
+            arg_types, out_types, aux_types)
+
+
+def infer_graph_shapes(symbol, known_shapes, partial=False,
+                       default_dtype=np.float32):
+    """Shape-only view of infer_graph_attrs (kept for existing callers)."""
+    res = infer_graph_attrs(symbol, known_shapes, partial=partial,
+                            default_dtype=default_dtype)
+    return res[0], res[1], res[2]
 
 
 # ---------------------------------------------------------------------------
@@ -232,22 +297,24 @@ class Executor:
     @staticmethod
     def _simple_bind(symbol, ctx, grad_req, type_dict, shape_kwargs):
         from .ndarray import zeros
-        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shape_kwargs)
+        (arg_shapes, _, aux_shapes, arg_types, _, aux_types) = \
+            infer_graph_attrs(symbol, shape_kwargs, known_types=type_dict)
         arg_names = symbol.list_arguments()
-        aux_names = symbol.list_auxiliary_states()
-        type_dict = type_dict or {}
-        arg_arrays = [zeros(s, ctx=ctx, dtype=type_dict.get(n, "float32"))
-                      for n, s in zip(arg_names, arg_shapes)]
+        arg_arrays = [zeros(s, ctx=ctx, dtype=t if t is not None else "float32")
+                      for s, t in zip(arg_shapes, arg_types)]
         if isinstance(grad_req, str):
             reqs = {n: grad_req for n in arg_names}
         elif isinstance(grad_req, (list, tuple)):
             reqs = dict(zip(arg_names, grad_req))
         else:
             reqs = {n: grad_req.get(n, "null") for n in arg_names}
-        grad_arrays = [zeros(s, ctx=ctx, dtype=type_dict.get(n, "float32"))
+        # gradients carry the dtype of their argument (reference InferType:
+        # grad entries share the arg entry's dtype)
+        grad_arrays = [zeros(s, ctx=ctx, dtype=t if t is not None else "float32")
                        if reqs.get(n, "null") != "null" else None
-                       for n, s in zip(arg_names, arg_shapes)]
-        aux_arrays = [zeros(s, ctx=ctx) for s in aux_shapes]
+                       for n, s, t in zip(arg_names, arg_shapes, arg_types)]
+        aux_arrays = [zeros(s, ctx=ctx, dtype=t if t is not None else "float32")
+                      for s, t in zip(aux_shapes, aux_types)]
         return Executor(symbol, ctx, arg_arrays, grad_arrays, reqs, aux_arrays)
 
     @staticmethod

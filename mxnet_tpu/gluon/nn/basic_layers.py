@@ -149,13 +149,18 @@ class Dropout(HybridBlock):
 class BatchNorm(HybridBlock):
     """(parity: nn.BatchNorm) with running stats as null-grad params."""
 
-    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+    def __init__(self, axis=None, momentum=0.9, epsilon=1e-5, center=True,
                  scale=True, use_global_stats=False, beta_initializer="zeros",
                  gamma_initializer="ones", running_mean_initializer="zeros",
                  running_variance_initializer="ones", in_channels=0,
                  prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         with self.name_scope():
+            if axis is None:
+                # reference default is axis=1 (NCHW); under a channels-last
+                # default layout (mxnet_tpu.layout) the channel dim is last
+                from ... import layout as _layout
+                axis = -1 if _layout.default_is_channels_last() else 1
             self._axis = axis
             self._momentum = momentum
             self._epsilon = epsilon

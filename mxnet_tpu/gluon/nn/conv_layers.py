@@ -9,6 +9,7 @@ from __future__ import annotations
 import numpy as np
 
 from ...base import MXNetError
+from ... import layout as _layout
 from ..block import HybridBlock
 
 __all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
@@ -28,7 +29,7 @@ class _Conv(HybridBlock):
     def __init__(self, channels, kernel_size, strides, padding, dilation,
                  groups, in_channels, activation, use_bias,
                  weight_initializer, bias_initializer, transposed=False,
-                 output_padding=0, prefix=None, params=None):
+                 output_padding=0, layout=None, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         with self.name_scope():
             ndim = len(kernel_size)
@@ -42,8 +43,17 @@ class _Conv(HybridBlock):
             self._act_type = activation
             self._transposed = transposed
             self._output_padding = _tuple(output_padding, ndim)
+            self._layout = _layout.resolve(layout, ndim)
+            self._channels_last = bool(self._layout) and \
+                self._layout.endswith("C")
             if transposed:
+                if self._channels_last:
+                    raise MXNetError(
+                        "transposed conv supports channels-first layouts only")
                 wshape = (in_channels, channels // groups) + kernel_size
+            elif self._channels_last:
+                wshape = (channels,) + kernel_size + \
+                    (in_channels // groups if in_channels else 0,)
             else:
                 wshape = (channels, in_channels // groups if in_channels
                           else 0) + kernel_size
@@ -60,13 +70,16 @@ class _Conv(HybridBlock):
                 self.bias = None
 
     def _shape_hook(self, x, *args):
-        c = x.shape[1]
         if self._transposed:
             self.weight._update_shape(
-                (c, self._channels // self._groups) + self._kernel)
+                (x.shape[1], self._channels // self._groups) + self._kernel)
+        elif self._channels_last:
+            self.weight._update_shape(
+                (self._channels,) + self._kernel +
+                (x.shape[-1] // self._groups,))
         else:
             self.weight._update_shape(
-                (self._channels, c // self._groups) + self._kernel)
+                (self._channels, x.shape[1] // self._groups) + self._kernel)
 
     def hybrid_forward(self, F, x, weight, bias=None):
         if self._transposed:
@@ -82,7 +95,8 @@ class _Conv(HybridBlock):
                                 stride=self._strides, pad=self._padding,
                                 dilate=self._dilation,
                                 num_filter=self._channels,
-                                num_group=self._groups, no_bias=bias is None)
+                                num_group=self._groups, no_bias=bias is None,
+                                layout=self._layout)
         if self._act_type is not None:
             out = F.Activation(out, act_type=self._act_type)
         return out
@@ -96,13 +110,12 @@ def _make_conv(name, ndim, transposed=False):
                      bias_initializer="zeros", in_channels=0, prefix=None,
                      params=None):
             kernel_size = _tuple(kernel_size, ndim)
-            kwargs = {}
             super().__init__(channels, kernel_size, strides, padding,
                              dilation, groups, in_channels, activation,
                              use_bias, weight_initializer, bias_initializer,
                              transposed=transposed,
-                             output_padding=output_padding, prefix=prefix,
-                             params=params)
+                             output_padding=output_padding, layout=layout,
+                             prefix=prefix, params=params)
     Conv.__name__ = name
     Conv.__qualname__ = name
     return Conv
@@ -118,7 +131,7 @@ Conv3DTranspose = _make_conv("Conv3DTranspose", 3, transposed=True)
 
 class _Pooling(HybridBlock):
     def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
-                 pool_type, prefix=None, params=None):
+                 pool_type, layout=None, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self._pool_size = pool_size
         self._strides = strides if strides is not None else pool_size
@@ -126,6 +139,7 @@ class _Pooling(HybridBlock):
         self._global_pool = global_pool
         self._pool_type = pool_type
         self._ceil_mode = ceil_mode
+        self._layout = _layout.resolve(layout, len(pool_size))
 
     def _alias(self):
         return "pool"
@@ -135,7 +149,8 @@ class _Pooling(HybridBlock):
             x, kernel=self._pool_size, stride=self._strides,
             pad=self._padding, pool_type=self._pool_type,
             global_pool=self._global_pool,
-            pooling_convention="full" if self._ceil_mode else "valid")
+            pooling_convention="full" if self._ceil_mode else "valid",
+            layout=self._layout)
 
 
 def _make_pool(name, ndim, pool_type, global_pool=False):
@@ -150,8 +165,8 @@ def _make_pool(name, ndim, pool_type, global_pool=False):
                 strides = _tuple(strides, ndim) if strides is not None else None
                 padding = _tuple(padding, ndim)
             super().__init__(pool_size, strides, padding, ceil_mode,
-                             global_pool, pool_type, prefix=prefix,
-                             params=params)
+                             global_pool, pool_type, layout=layout,
+                             prefix=prefix, params=params)
     Pool.__name__ = name
     Pool.__qualname__ = name
     return Pool

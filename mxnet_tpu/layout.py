@@ -1,0 +1,69 @@
+"""Default image-tensor layout for Gluon conv/pool/norm layers.
+
+TPU-first extension with no reference counterpart: the reference is NCHW
+throughout (convolution-inl.h layouts); on TPU the MXU-native layout is
+channels-last (channel dim lands in the lane dimension, no relayout
+copies). Rather than thread a ``layout=`` argument through every model-zoo
+constructor, the Gluon layers resolve their default layout here — models
+built under ``set_default_layout("NHWC")`` (or with
+``MXTPU_IMAGE_LAYOUT=NHWC`` in the environment) run channels-last end to
+end. Explicit per-layer ``layout=``/``axis=`` arguments always win.
+
+The op-level API is unchanged: ``layout=None`` on Convolution/Pooling
+still means the reference's NC+spatial.
+"""
+from __future__ import annotations
+
+import os
+
+from .base import MXNetError
+
+_CHANNELS_LAST = {1: "NWC", 2: "NHWC", 3: "NDHWC"}
+_CHANNELS_FIRST = {1: "NCW", 2: "NCHW", 3: "NCDHW"}
+
+_default = None
+
+
+def set_default_layout(layout):
+    """Set the process default: "NHWC"/"NWC"/"NDHWC" (channels-last),
+    "NCHW"/"NCW"/"NCDHW" (channels-first), or None (reference default)."""
+    global _default
+    if layout is not None:
+        layout = str(layout).upper()
+        if layout not in list(_CHANNELS_LAST.values()) + \
+                list(_CHANNELS_FIRST.values()):
+            raise MXNetError("unknown layout %r" % layout)
+    _default = layout
+
+
+# env path goes through the validating setter so typos raise instead of
+# silently picking a layout
+if os.environ.get("MXTPU_IMAGE_LAYOUT"):
+    set_default_layout(os.environ["MXTPU_IMAGE_LAYOUT"])
+
+
+def get_default_layout():
+    return _default
+
+
+def default_is_channels_last():
+    return bool(_default) and _default.endswith("C") and _default != "NC"
+
+
+def resolve(layout, ndim):
+    """Layer-construction helper: explicit layout wins; otherwise the
+    process default (adapted to ndim); otherwise None (reference NC+spatial)."""
+    if layout is not None:
+        return str(layout).upper()
+    if _default is None:
+        return None
+    table = _CHANNELS_LAST if _default.endswith("C") and _default != "NC" \
+        else _CHANNELS_FIRST
+    return table.get(ndim)
+
+
+def channel_axis(layout, ndim):
+    """Channel axis index for a resolved layout (None -> reference's 1)."""
+    if layout is None:
+        return 1
+    return len(layout) - 1 if str(layout).upper().endswith("C") else 1

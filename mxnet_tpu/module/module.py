@@ -123,6 +123,14 @@ class Module(BaseModule):
 
         shape_kwargs = {d.name: d.shape for d in self._data_shapes}
         shape_kwargs.update({l.name: l.shape for l in self._label_shapes})
+        # input dtypes flow from DataDesc into the joint InferShape/Type
+        # pass, so a bf16 data desc binds a bf16 executor end to end.
+        # Labels included: without an explicit entry the inference pass
+        # would anchor the label var to the data dtype (bf16 truncates
+        # class indices > 256).
+        type_dict = {d.name: d.dtype
+                     for d in self._data_shapes + self._label_shapes
+                     if getattr(d, "dtype", None) is not None}
 
         reqs = {}
         for name in self._symbol.list_arguments():
@@ -137,6 +145,7 @@ class Module(BaseModule):
         self._grad_req = reqs
         ctx = self._context[0]
         self._exec = self._symbol.simple_bind(ctx=ctx, grad_req=reqs,
+                                              type_dict=type_dict,
                                               **shape_kwargs)
         if len(self._context) > 1:
             self._init_mesh()
@@ -302,7 +311,7 @@ class Module(BaseModule):
         for desc, arr in zip(self._data_shapes, data):
             if tuple(arr.shape) != arg_dict[desc.name].shape:
                 arg_dict[desc.name]._set_data(
-                    np.zeros(arr.shape, dtype=np.float32))
+                    np.zeros(arr.shape, dtype=arg_dict[desc.name].dtype))
                 reshaped = True
         if reshaped and data_batch.label is not None:
             labels = data_batch.label
@@ -311,7 +320,7 @@ class Module(BaseModule):
             for desc, arr in zip(self._label_shapes, labels):
                 if tuple(arr.shape) != arg_dict[desc.name].shape:
                     arg_dict[desc.name]._set_data(
-                        np.zeros(arr.shape, dtype=np.float32))
+                        np.zeros(arr.shape, dtype=arg_dict[desc.name].dtype))
         for desc, arr in zip(self._data_shapes, data):
             self._write_input(arg_dict[desc.name], arr)
         label = data_batch.label

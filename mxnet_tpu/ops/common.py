@@ -36,6 +36,21 @@ def mx_dtype(dtype):
     return dtype
 
 
+def channels_last(layout, ndim):
+    """True for NWC/NHWC/NDHWC-style layouts; layout=None means the
+    reference default (channels-first, NC+spatial). Validates the string
+    so a bad layout fails here, not as a wrong shape downstream."""
+    if not layout:
+        return False
+    layout = str(layout).upper()
+    if layout not in ("NCW", "NWC", "NCHW", "NHWC", "NCDHW", "NDHWC"):
+        raise MXNetError("unsupported layout %r" % layout)
+    if len(layout) != ndim + 2:
+        raise MXNetError("layout %r does not match %dD kernel"
+                         % (layout, ndim))
+    return layout.endswith("C")
+
+
 def as_tuple(v, ndim=None, name="param"):
     """Parse kernel/stride/pad style params: tuple, int, or '(2, 2)' string."""
     if v is None:

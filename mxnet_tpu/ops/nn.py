@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..base import MXNetError
-from .common import as_tuple, mx_dtype
+from .common import as_tuple, channels_last, mx_dtype
 from .registry import register, get_op
 
 
@@ -50,47 +50,55 @@ def fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False,
 # ---------------------------------------------------------------------------
 
 def _conv_nd(data, weight, bias, kernel, stride, dilate, pad, num_group,
-             no_bias, transposed=False, adj=None, target_shape=None):
+             no_bias, transposed=False, adj=None, target_shape=None,
+             layout=None):
     ndim = len(kernel)
     stride = stride or (1,) * ndim
     dilate = dilate or (1,) * ndim
     pad = pad or (0,) * ndim
-    # NC + spatial dims; weight OIHW (deconv: IOHW in reference; we keep OIHW
-    # at this layer and the Deconvolution wrapper adapts).
-    lhs_spec = "NC" + "DHW"[3 - ndim:]
-    dn = jax.lax.conv_dimension_numbers(
-        data.shape, weight.shape,
-        (lhs_spec, "OI" + "DHW"[3 - ndim:], lhs_spec))
+    spatial = "DHW"[3 - ndim:]
+    is_cl = channels_last(layout, ndim)
+    # Channels-first: NC+spatial data, OIHW weight (deconv: IOHW in the
+    # reference; we keep OIHW at this layer and Deconvolution adapts).
+    # Channels-last (the MXU-native layout — channels land in the lane
+    # dimension with no relayout): N+spatial+C data, O+spatial+I weight.
+    lhs_spec = ("N" + spatial + "C") if is_cl else ("NC" + spatial)
+    rhs_spec = ("O" + spatial + "I") if is_cl else ("OI" + spatial)
     if not transposed:
         out = jax.lax.conv_general_dilated(
             data, weight, window_strides=stride,
             padding=[(p, p) for p in pad],
-            rhs_dilation=dilate, dimension_numbers=dn,
+            rhs_dilation=dilate,
+            dimension_numbers=(lhs_spec, rhs_spec, lhs_spec),
             feature_group_count=int(num_group))
-    else:
-        # transposed conv = lhs-dilated conv with the flipped kernel.
-        # weight arrives in the reference Deconvolution layout
-        # (in_channels, num_filter/g, *kernel); the dilated conv needs
-        # (num_filter, in_channels/g, *kernel) OIHW.
-        adj = adj or (0,) * ndim
-        g = int(num_group)
-        k_eff = [(k - 1) * d + 1 for k, d in zip(kernel, dilate)]
-        padding = [(ke - 1 - p, ke - 1 - p + a)
-                   for ke, p, a in zip(k_eff, pad, adj)]
-        w = jnp.flip(weight, axis=tuple(range(2, 2 + ndim)))
-        c_in = w.shape[0]
-        f_per_g = w.shape[1]
-        spatial = w.shape[2:]
-        w = w.reshape((g, c_in // g, f_per_g) + spatial)
-        w = jnp.swapaxes(w, 1, 2)                    # (g, F/g, C_in/g, ...)
-        w = w.reshape((g * f_per_g, c_in // g) + spatial)
-        dn_t = jax.lax.conv_dimension_numbers(
-            data.shape, w.shape,
-            (lhs_spec, "OI" + "DHW"[3 - ndim:], lhs_spec))
-        out = jax.lax.conv_general_dilated(
-            data, w, window_strides=(1,) * ndim, padding=padding,
-            rhs_dilation=dilate, lhs_dilation=stride,
-            dimension_numbers=dn_t, feature_group_count=g)
+        if not no_bias and bias is not None:
+            out = out + (bias if is_cl
+                         else bias.reshape((1, -1) + (1,) * ndim))
+        return out
+    if is_cl:
+        raise MXNetError("Deconvolution supports channels-first layouts only")
+    # transposed conv = lhs-dilated conv with the flipped kernel.
+    # weight arrives in the reference Deconvolution layout
+    # (in_channels, num_filter/g, *kernel); the dilated conv needs
+    # (num_filter, in_channels/g, *kernel) OIHW.
+    adj = adj or (0,) * ndim
+    g = int(num_group)
+    k_eff = [(k - 1) * d + 1 for k, d in zip(kernel, dilate)]
+    padding = [(ke - 1 - p, ke - 1 - p + a)
+               for ke, p, a in zip(k_eff, pad, adj)]
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + ndim)))
+    c_in = w.shape[0]
+    f_per_g = w.shape[1]
+    wspatial = w.shape[2:]
+    w = w.reshape((g, c_in // g, f_per_g) + wspatial)
+    w = jnp.swapaxes(w, 1, 2)                    # (g, F/g, C_in/g, ...)
+    w = w.reshape((g * f_per_g, c_in // g) + wspatial)
+    dn_t = jax.lax.conv_dimension_numbers(
+        data.shape, w.shape, (lhs_spec, "OI" + spatial, lhs_spec))
+    out = jax.lax.conv_general_dilated(
+        data, w, window_strides=(1,) * ndim, padding=padding,
+        rhs_dilation=dilate, lhs_dilation=stride,
+        dimension_numbers=dn_t, feature_group_count=g)
     if not no_bias and bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * ndim)
     return out
@@ -104,7 +112,10 @@ def _conv_nd(data, weight, bias, kernel, stride, dilate, pad, num_group,
 def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
                 pad=(), num_filter=0, num_group=1, no_bias=False,
                 workspace=1024, cudnn_tune=None, cudnn_off=False, layout=None):
-    """N-D convolution, NCHW/NCDHW layouts (reference convolution-inl.h).
+    """N-D convolution (reference convolution-inl.h). layout=None means the
+    reference NCHW/NCDHW; NWC/NHWC/NDHWC run channels-last — the MXU-native
+    layout (weight is then (num_filter, *kernel, in_channels/g), matching
+    the reference's NHWC cuDNN convention).
 
     workspace/cudnn_* knobs are accepted for API parity and ignored — XLA
     owns algorithm choice and scratch on TPU.
@@ -113,7 +124,7 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
     ndim = len(kernel)
     return _conv_nd(data, weight, bias, kernel, as_tuple(stride, ndim),
                     as_tuple(dilate, ndim), as_tuple(pad, ndim), num_group,
-                    no_bias)
+                    no_bias, layout=layout)
 
 
 @register("Deconvolution", nin=3, jit=True, arg_names=["data", "weight", "bias"],
@@ -141,23 +152,28 @@ def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
 
 @register("Pooling", defaults={"kernel": (), "pool_type": "max", "stride": (),
                                "pad": (), "global_pool": False,
-                               "pooling_convention": "valid", "cudnn_off": False})
+                               "pooling_convention": "valid", "cudnn_off": False,
+                               "layout": None})
 def pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
-            global_pool=False, pooling_convention="valid", cudnn_off=False):
-    """Max/avg/sum pooling over NC+spatial input (reference pooling-inl.h).
+            global_pool=False, pooling_convention="valid", cudnn_off=False,
+            layout=None):
+    """Max/avg/sum pooling (reference pooling-inl.h). layout=None means the
+    reference NC+spatial; channels-last layouts window over the middle dims.
 
     'full' convention (ceil division of output size) is implemented by
     right-padding up to what ceil needs, matching reference behaviour.
     """
     ndim = data.ndim - 2
+    is_cl = channels_last(layout, ndim)
+    sp0 = 1 if is_cl else 2  # first spatial dim index
     if global_pool:
-        axes = tuple(range(2, data.ndim))
+        axes = tuple(range(sp0, sp0 + ndim))
         if pool_type == "max":
             out = jnp.max(data, axis=axes, keepdims=True)
         elif pool_type in ("avg", "sum"):
             out = jnp.sum(data, axis=axes, keepdims=True)
             if pool_type == "avg":
-                out = out / np.prod(data.shape[2:])
+                out = out / np.prod([data.shape[a] for a in axes])
         else:
             raise MXNetError("bad pool_type %r" % pool_type)
         return out
@@ -169,14 +185,19 @@ def pooling(data, kernel=(), pool_type="max", stride=(), pad=(),
     for i in range(ndim):
         lo = hi = pad[i]
         if pooling_convention == "full":
-            size = data.shape[2 + i] + 2 * pad[i]
+            size = data.shape[sp0 + i] + 2 * pad[i]
             rem = (size - kernel[i]) % stride[i]
             if rem:
                 hi += stride[i] - rem
         pads.append((lo, hi))
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
-    padding = [(0, 0), (0, 0)] + pads
+    if is_cl:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        padding = [(0, 0)] + pads + [(0, 0)]
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        padding = [(0, 0), (0, 0)] + pads
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
         return jax.lax.reduce_window(data, init, jax.lax.max, window, strides, padding)
@@ -260,14 +281,29 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
 
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     if _train and not use_global_stats:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
+        # Batch statistics accumulate in fp32 even for bf16 activations
+        # (the convert fuses into the reduce — same HBM reads, exact sums);
+        # this is the reference's cudnn BN behaviour for fp16 inputs.
+        stat_in = data.astype(jnp.float32) \
+            if data.dtype in (jnp.bfloat16, jnp.float16) else data
+        mean = jnp.mean(stat_in, axis=red).astype(moving_mean.dtype)
+        var = jnp.var(stat_in, axis=red).astype(moving_var.dtype)
     else:
         mean, var = moving_mean, moving_var
         mean = jax.lax.stop_gradient(mean)
         var = jax.lax.stop_gradient(var)
-    inv = jax.lax.rsqrt(var + eps)
-    out = (data - mean.reshape(shape)) * (inv * g).reshape(shape) + beta.reshape(shape)
+    if data.dtype in (jnp.bfloat16, jnp.float16):
+        # scale/offset in fp32, one fused multiply-add over the activations
+        # in their own dtype (no fp32 upcast of the big tensor).
+        inv = jax.lax.rsqrt(var.astype(jnp.float32) + eps)
+        s = inv * g.astype(jnp.float32)
+        b = beta.astype(jnp.float32) - mean.astype(jnp.float32) * s
+        out = data * s.astype(data.dtype).reshape(shape) \
+            + b.astype(data.dtype).reshape(shape)
+    else:
+        inv = jax.lax.rsqrt(var + eps)
+        out = (data - mean.reshape(shape)) * (inv * g).reshape(shape) \
+            + beta.reshape(shape)
     return out, mean, var
 
 
@@ -282,10 +318,17 @@ def _bn_stateful_update(raw_inputs, raw_outputs, params):
     return {3: new_mean, 4: new_var}
 
 
+def _bn_param_dtypes(in_types, params):
+    """gamma/beta/moving stats stay fp32 under bf16/fp16 data (reference
+    cudnn_batch_norm-inl.h keeps scale/bias/saved stats in fp32)."""
+    return {1: np.float32, 2: np.float32, 3: np.float32, 4: np.float32}
+
+
 _bn = get_op("BatchNorm")
 _bn.visible_outputs = 1
 _bn.aux_inputs = (3, 4)
 _bn.stateful_update = _bn_stateful_update
+_bn.param_dtype_infer = _bn_param_dtypes
 
 
 @register("LRN", defaults={"alpha": 1e-4, "beta": 0.75, "knorm": 2.0, "nsize": 5})

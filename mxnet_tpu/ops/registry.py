@@ -81,6 +81,11 @@ class OpDef:
         # Optional hook(input_shapes, params) -> {input_idx: shape} filling
         # learnable-input shapes (reference FInferShape; see ops/shape_infer.py).
         self.param_shape_infer = None
+        # Optional hook(input_dtypes, params) -> {input_idx: dtype} for ops
+        # whose learnable inputs do NOT follow the data dtype (reference
+        # FInferType; e.g. BatchNorm pins scale/shift/moving stats to fp32
+        # under low-precision data, the cudnn_batch_norm behaviour).
+        self.param_dtype_infer = None
 
     def __repr__(self):
         return "OpDef(%s)" % self.name

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .common import as_tuple
+from .common import as_tuple, channels_last
 from .registry import get_op
 
 
@@ -34,7 +34,11 @@ def _conv(shapes, params):
     kernel = as_tuple(params.get("kernel")) or ()
     num_filter = int(params.get("num_filter", 0))
     num_group = int(params.get("num_group", 1))
-    out = {1: (num_filter, data[1] // num_group) + kernel}
+    if channels_last(params.get("layout"), len(kernel)):
+        # channels-last: OHWI weight
+        out = {1: (num_filter,) + kernel + (data[-1] // num_group,)}
+    else:
+        out = {1: (num_filter, data[1] // num_group) + kernel}
     if not params.get("no_bias", False):
         out[2] = (num_filter,)
     return out

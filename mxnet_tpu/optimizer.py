@@ -13,6 +13,12 @@ import pickle
 
 import numpy as np
 
+try:  # bf16 is the TPU-native low precision; fp16 kept for API parity
+    import ml_dtypes as _ml
+    _LOW_PRECISION = (np.dtype(np.float16), np.dtype(_ml.bfloat16))
+except ImportError:  # pragma: no cover
+    _LOW_PRECISION = (np.dtype(np.float16),)
+
 from .base import MXNetError, registry_create
 from .ndarray import ndarray as _nd
 from .ndarray import (sgd_update, sgd_mom_update, mp_sgd_update,
@@ -83,7 +89,7 @@ class Optimizer:
         return None
 
     def create_state_multi_precision(self, index, weight):
-        if self.multi_precision and weight.dtype == np.float16:
+        if self.multi_precision and weight.dtype in _LOW_PRECISION:
             w32 = weight.astype("float32")
             return (self.create_state(index, w32), w32)
         return self.create_state(index, weight)
@@ -178,7 +184,7 @@ class SGD(Optimizer):
         return _state_zeros(weight)
 
     def create_state_multi_precision(self, index, weight):
-        if self.multi_precision and weight.dtype == np.float16:
+        if self.multi_precision and weight.dtype in _LOW_PRECISION:
             w32 = weight.astype("float32")
             return (self.create_state(index, w32), w32)
         return self.create_state(index, weight)

@@ -202,15 +202,23 @@ class Symbol:
         return infer_graph_shapes(self, known, partial=partial)
 
     def infer_type(self, *args, **kwargs):
+        """(parity: Symbol.infer_type / reference InferType pass) returns
+        (arg_types, out_types, aux_types). Dtypes propagate through the
+        graph via the joint attr-inference pass (executor.infer_graph_attrs);
+        shapes come from Variable ``__shape__`` attrs where present — ops
+        whose shapes stay unknown report None, as infer_shape_partial does.
+        """
         arg_names = self.list_arguments()
-        dtype = np.float32
-        for v in list(args) + list(kwargs.values()):
-            if v is not None:
-                dtype = np.dtype(v)
-                break
-        return ([dtype] * len(arg_names),
-                [dtype] * len(self.list_outputs()),
-                [dtype] * len(self.list_auxiliary_states()))
+        known = {}
+        if args:
+            for name, dt in zip(arg_names, args):
+                if dt is not None:
+                    known[name] = np.dtype(dt)
+        known.update({k: np.dtype(v) for k, v in kwargs.items()
+                      if v is not None})
+        from ..executor import infer_graph_attrs
+        res = infer_graph_attrs(self, {}, known_types=known, partial=True)
+        return res[3], res[4], res[5]
 
     # -- serialization -----------------------------------------------------
     def tojson(self):

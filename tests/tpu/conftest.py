@@ -30,6 +30,8 @@ def pytest_collection_modifyitems(config, items):
     # pytest hands EVERY conftest the whole session's item list — only mark
     # items that actually live under tests/tpu/, or `pytest tests/` would
     # skip the entire suite (round-2 regression).
+    if os.environ.get("MXTPU_SWEEP_SELF") == "1":
+        return  # cpu-vs-cpu case-spec debugging (test_op_sweep.SELF_MODE)
     if os.environ.get("MXTPU_TEST_PLATFORM") != "tpu" or not _on_accelerator():
         skip = pytest.mark.skip(
             reason="TPU lane: set MXTPU_TEST_PLATFORM=tpu with a chip attached")

@@ -1,0 +1,501 @@
+"""TPU-vs-CPU consistency sweep over the ENTIRE op registry.
+
+The reference validates its second backend by importing the whole unittest
+op suite under the gpu context (tests/python/gpu/test_operator_gpu.py);
+this is the TPU analogue at registry granularity: every registered op def
+either has at least one case here (forward compared CPU-vs-TPU, plus
+gradients for differentiable ops) or an entry in SKIP with a written
+reason. ``test_registry_fully_covered`` enforces the invariant, so a
+newly registered op fails the lane until it is covered or skip-listed.
+
+Run (chip): MXTPU_TEST_PLATFORM=tpu python -m pytest tests/tpu/test_op_sweep.py
+Case-spec debugging without a chip: MXTPU_SWEEP_SELF=1 compares cpu-vs-cpu.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import mxnet_tpu as mx                              # noqa: E402
+from mxnet_tpu.ops.registry import _OPS, get_op     # noqa: E402
+
+SELF_MODE = os.environ.get("MXTPU_SWEEP_SELF") == "1"
+
+# Default tolerance: the cross-backend oracle tolerance (see
+# mxnet_tpu/test_utils.py check_consistency — reference fp32 tol 1e-3).
+RTOL, ATOL = 1e-3, 1e-4
+
+_rs = np.random.RandomState(0)
+
+
+def F(shape, lo=-2.0, hi=2.0):
+    return _rs.uniform(lo, hi, shape).astype(np.float32)
+
+
+def P(shape, eps=0.5):  # strictly positive
+    return (_rs.uniform(0, 1.5, shape) + eps).astype(np.float32)
+
+
+def I(shape, hi, lo=0):  # integer indices
+    return _rs.randint(lo, hi, shape).astype(np.int32)
+
+
+def SPD(n):
+    a = _rs.uniform(-1, 1, (n, n)).astype(np.float32)
+    return (a @ a.T + n * np.eye(n)).astype(np.float32)
+
+
+CASES = {}
+SKIP = {
+    "Custom": "python-callback op; dispatch is backend-independent "
+              "(exercised by tests/test_operator.py on CPU)",
+    "_random_gamma": "rejection sampler (while_loop); distribution-level "
+                     "checks live in tests/test_random.py",
+    "_random_poisson": "rejection/iterative sampler; see tests/test_random.py",
+    "_random_negative_binomial": "composed iterative sampler; "
+                                 "see tests/test_random.py",
+    "_random_generalized_negative_binomial": "composed iterative sampler; "
+                                             "see tests/test_random.py",
+    "_sample_gamma": "rejection sampler; see tests/test_random.py",
+    "_sample_poisson": "rejection sampler; see tests/test_random.py",
+    "_sample_multinomial": "search-based sampler; see tests/test_random.py",
+    "_shuffle": "random permutation; order is PRNG-path dependent, "
+                "distribution checked in tests/test_random.py",
+}
+
+
+def case(name, arrays, params=None, grad=True, rtol=None, atol=None,
+         train=True, label=None):
+    CASES.setdefault(name, []).append({
+        "arrays": arrays, "params": params or {}, "grad": grad,
+        "rtol": RTOL if rtol is None else rtol,
+        "atol": ATOL if atol is None else atol,
+        "train": train, "label": label or str(len(CASES.get(name, []))),
+    })
+
+
+# ---------------------------------------------------------------------------
+# unary elementwise
+# ---------------------------------------------------------------------------
+for n in ["sin", "cos", "tan", "sinh", "cosh", "tanh", "erf", "exp",
+          "expm1", "sigmoid", "relu", "softsign", "square", "negative",
+          "degrees", "radians", "abs", "cbrt", "smooth_l1"]:
+    case(n, [F((3, 4))])
+for n in ["log", "log10", "log2", "sqrt", "rsqrt", "rcbrt", "reciprocal",
+          "gamma", "gammaln"]:
+    case(n, [P((3, 4))])
+for n in ["arcsin", "arccos", "arctanh"]:
+    case(n, [F((3, 4), -0.8, 0.8)])
+for n in ["arctan", "arcsinh"]:
+    case(n, [F((3, 4))])
+case("arccosh", [P((3, 4), eps=1.1)])
+case("log1p", [F((3, 4), -0.5, 2.0)])
+for n in ["sign", "floor", "ceil", "round", "rint", "fix", "trunc",
+          "logical_not"]:
+    case(n, [F((3, 4))], grad=False)
+case("clip", [F((3, 4))], {"a_min": -0.5, "a_max": 0.5})
+case("Cast", [F((3, 4))], {"dtype": "int32"}, grad=False)
+case("Cast", [I((3, 4), 5)], {"dtype": "float32"}, grad=False,
+     label="int2float")
+case("BlockGrad", [F((3, 4))], grad=False)
+case("_copy", [F((3, 4))])
+case("ones_like", [F((3, 4))], grad=False)
+case("zeros_like", [F((3, 4))], grad=False)
+case("_identity_with_attr_like_rhs", [F((3, 4)), F((3, 4))])
+
+# ---------------------------------------------------------------------------
+# binary / scalar elementwise
+# ---------------------------------------------------------------------------
+A, B = F((2, 3, 4)), F((2, 1, 4))
+for n in ["broadcast_add", "broadcast_sub", "broadcast_mul",
+          "broadcast_maximum", "broadcast_minimum", "broadcast_hypot"]:
+    case(n, [A, B])
+case("broadcast_div", [A, P((2, 1, 4))])
+case("broadcast_power", [P((2, 3, 4)), F((2, 1, 4), -1.5, 1.5)])
+case("broadcast_mod", [A, P((2, 1, 4))], grad=False)
+for n in ["broadcast_equal", "broadcast_not_equal", "broadcast_greater",
+          "broadcast_greater_equal", "broadcast_lesser",
+          "broadcast_lesser_equal"]:
+    case(n, [I((2, 3, 4), 3).astype(np.float32),
+             I((2, 1, 4), 3).astype(np.float32)], grad=False)
+case("elemwise_add", [F((3, 4)), F((3, 4))])
+case("elemwise_sub", [F((3, 4)), F((3, 4))])
+case("elemwise_mul", [F((3, 4)), F((3, 4))])
+case("elemwise_div", [F((3, 4)), P((3, 4))])
+case("add_n", [F((3, 4)), F((3, 4)), F((3, 4))], {})
+
+for n in ["_plus_scalar", "_minus_scalar", "_rminus_scalar", "_mul_scalar",
+          "_div_scalar", "_rdiv_scalar", "_maximum_scalar",
+          "_minimum_scalar", "_hypot_scalar"]:
+    case(n, [P((3, 4))], {"scalar": 0.7})
+case("_power_scalar", [P((3, 4))], {"scalar": 1.3})
+case("_rpower_scalar", [F((3, 4), -1.5, 1.5)], {"scalar": 1.3})
+case("_mod_scalar", [F((3, 4))], {"scalar": 0.7}, grad=False)
+case("_rmod_scalar", [P((3, 4))], {"scalar": 0.7}, grad=False)
+for n in ["_equal_scalar", "_not_equal_scalar", "_greater_scalar",
+          "_greater_equal_scalar", "_lesser_scalar",
+          "_lesser_equal_scalar"]:
+    case(n, [I((3, 4), 3).astype(np.float32)], {"scalar": 1.0}, grad=False)
+
+# ---------------------------------------------------------------------------
+# reductions / sorting / argmax
+# ---------------------------------------------------------------------------
+for n in ["sum", "mean", "max", "min", "prod"]:
+    case(n, [F((2, 3, 4))], {"axis": 1})
+    case(n, [F((2, 3, 4))], {"axis": (0, 2), "keepdims": True},
+         label="multiaxis")
+_nan = F((2, 3, 4))
+_nan[0, 1, 2] = np.nan
+case("nansum", [_nan], {"axis": 1}, grad=False)
+case("nanprod", [_nan], {"axis": 1}, grad=False)
+case("norm", [F((3, 4))])
+for n in ["argmax", "argmin"]:
+    case(n, [F((2, 3, 4))], {"axis": 1}, grad=False)
+case("argmax_channel", [F((3, 4))], grad=False)
+case("argsort", [F((3, 5))], {"axis": 1}, grad=False)
+case("sort", [F((3, 5))], {"axis": 1}, grad=False)
+case("topk", [F((3, 5))], {"axis": 1, "k": 2}, grad=False)
+case("topk", [F((3, 5))], {"axis": 1, "k": 2, "ret_typ": "value"},
+     grad=False, label="values")
+case("pick", [F((3, 5)), I((3,), 5).astype(np.float32)], {"axis": 1},
+     grad=False)
+
+# ---------------------------------------------------------------------------
+# shape / movement / indexing
+# ---------------------------------------------------------------------------
+case("Reshape", [F((2, 3, 4))], {"shape": (6, -1)})
+case("Flatten", [F((2, 3, 4))])
+case("expand_dims", [F((3, 4))], {"axis": 1})
+case("squeeze", [F((3, 1, 4))], {"axis": 1})
+case("transpose", [F((2, 3, 4))], {"axes": (1, 0, 2)})
+case("SwapAxis", [F((2, 3, 4))], {"dim1": 0, "dim2": 2})
+case("slice", [F((4, 5))], {"begin": (0, 1), "end": (2, 4)})
+case("slice_axis", [F((4, 5))], {"axis": 1, "begin": 1, "end": 4})
+case("slice_like", [F((4, 5)), F((2, 3))], {})
+case("tile", [F((2, 3))], {"reps": (2, 2)})
+case("repeat", [F((2, 3))], {"repeats": 2, "axis": 1})
+case("reverse", [F((3, 4))], {"axis": 1})
+case("broadcast_to", [F((1, 4))], {"shape": (3, 4)})
+case("broadcast_axis", [F((2, 1, 4))], {"axis": 1, "size": 3})
+case("Pad", [F((2, 2, 3, 3))],
+     {"mode": "constant", "pad_width": (0, 0, 0, 0, 1, 1, 2, 2)})
+case("Pad", [F((2, 2, 3, 3))],
+     {"mode": "edge", "pad_width": (0, 0, 0, 0, 1, 1, 2, 2)}, label="edge")
+case("Concat", [F((2, 3)), F((2, 4))], {"num_args": 2, "dim": 1})
+case("SliceChannel", [F((2, 6))], {"num_outputs": 2, "axis": 1})
+case("stack", [F((2, 3)), F((2, 3))], {"num_args": 2, "axis": 1})
+case("one_hot", [I((5,), 4)], {"depth": 4}, grad=False)
+case("take", [F((5, 3)), I((4,), 5)], {})
+case("batch_take", [F((4, 3)), I((4,), 3)], {})
+case("gather_nd", [F((4, 5)), I((2, 3), 4)], {})
+case("scatter_nd", [F((3,)), I((1, 3), 4)], {"shape": (4,)})
+case("_grad_add_nd", [F((3,)), I((1, 3), 4)], {"shape": (4,)}, grad=False)
+case("where", [I((3, 4), 2).astype(np.float32), F((3, 4)), F((3, 4))],
+     {})
+case("Embedding", [I((2, 3), 10), F((10, 4))],
+     {"input_dim": 10, "output_dim": 4})
+
+# ---------------------------------------------------------------------------
+# creation ops (no tensor inputs)
+# ---------------------------------------------------------------------------
+case("_zeros", [], {"shape": (2, 3)}, grad=False)
+case("_ones", [], {"shape": (2, 3)}, grad=False)
+case("_full", [], {"shape": (2, 3), "value": 1.5}, grad=False)
+case("_eye", [], {"N": 4, "M": 5, "k": 1}, grad=False)
+case("_arange", [], {"start": 0.0, "stop": 5.0, "step": 0.5}, grad=False)
+
+# ---------------------------------------------------------------------------
+# linear algebra
+# ---------------------------------------------------------------------------
+case("dot", [F((3, 4)), F((4, 5))], {})
+case("dot", [F((4, 3)), F((4, 5))], {"transpose_a": True}, label="tA")
+case("batch_dot", [F((2, 3, 4)), F((2, 4, 5))], {})
+case("_linalg_gemm", [F((3, 4)), F((4, 5)), F((3, 5))],
+     {"alpha": 1.5, "beta": 0.5})
+case("_linalg_gemm2", [F((3, 4)), F((4, 5))], {"alpha": 2.0})
+case("_linalg_syrk", [F((3, 4))], {"alpha": 1.0})
+case("_linalg_potrf", [SPD(4)], {}, grad=False)
+case("_linalg_potri", [SPD(4)], {}, grad=False, rtol=5e-3, atol=5e-4)
+case("_linalg_sumlogdiag", [SPD(4)], {})
+_tri = np.linalg.cholesky(SPD(4)).astype(np.float32)
+case("_linalg_trmm", [_tri, F((4, 3))], {})
+case("_linalg_trsm", [_tri, F((4, 3))], {}, grad=False)
+case("FullyConnected", [F((4, 6)), F((5, 6)), F((5,))], {"num_hidden": 5})
+case("FullyConnected", [F((2, 3, 4)), F((5, 12))],
+     {"num_hidden": 5, "no_bias": True}, label="nobias_flatten")
+
+# ---------------------------------------------------------------------------
+# neural-network layers
+# ---------------------------------------------------------------------------
+for act in ["relu", "sigmoid", "tanh", "softrelu", "softsign"]:
+    case("Activation", [F((3, 4))], {"act_type": act}, label=act)
+case("LeakyReLU", [F((3, 4))], {"act_type": "leaky", "slope": 0.3},
+     label="leaky")
+case("LeakyReLU", [F((3, 4))], {"act_type": "elu", "slope": 0.3},
+     label="elu")
+case("LeakyReLU", [F((2, 3, 4, 4)), P((3,), eps=0.1)],
+     {"act_type": "prelu"}, label="prelu")
+case("LeakyReLU", [F((3, 4))], {"act_type": "rrelu"}, train=False,
+     label="rrelu_eval")
+CONV_TOL = dict(rtol=1e-3, atol=1e-3)
+case("Convolution", [F((2, 3, 8, 8)), F((4, 3, 3, 3)), F((4,))],
+     {"kernel": (3, 3), "num_filter": 4, "pad": (1, 1)}, **CONV_TOL)
+case("Convolution", [F((2, 8, 8, 3)), F((4, 3, 3, 3)), F((4,))],
+     {"kernel": (3, 3), "num_filter": 4, "pad": (1, 1), "layout": "NHWC"},
+     label="nhwc", **CONV_TOL)
+case("Convolution", [F((2, 4, 8)), F((6, 2, 3))],
+     {"kernel": (3,), "num_filter": 6, "num_group": 2, "no_bias": True},
+     label="1d_grouped", **CONV_TOL)
+case("Convolution", [F((1, 2, 4, 5, 5)), F((3, 2, 2, 2, 2))],
+     {"kernel": (2, 2, 2), "num_filter": 3, "no_bias": True,
+      "stride": (1, 2, 2)}, label="3d", **CONV_TOL)
+case("Deconvolution", [F((2, 3, 6, 6)), F((3, 4, 2, 2))],
+     {"kernel": (2, 2), "num_filter": 4, "stride": (2, 2)}, **CONV_TOL)
+case("Pooling", [F((2, 3, 8, 8))],
+     {"kernel": (2, 2), "stride": (2, 2), "pool_type": "max"})
+case("Pooling", [F((2, 3, 8, 8))],
+     {"kernel": (3, 3), "stride": (2, 2), "pool_type": "avg",
+      "pooling_convention": "full"}, label="avg_full")
+case("Pooling", [F((2, 3, 8, 8))],
+     {"kernel": (2, 2), "pool_type": "sum"}, label="sum")
+case("Pooling", [F((2, 3, 8, 8))],
+     {"global_pool": True, "pool_type": "max", "kernel": (1, 1)},
+     label="global")
+case("Pooling", [F((2, 8, 8, 3))],
+     {"kernel": (2, 2), "stride": (2, 2), "pool_type": "max",
+      "layout": "NHWC"}, label="nhwc")
+case("BatchNorm",
+     [F((2, 3, 4, 4)), P((3,)), F((3,)), F((3,)), P((3,))],
+     {"fix_gamma": False}, rtol=1e-3, atol=1e-3)
+case("BatchNorm",
+     [F((2, 3, 4, 4)), P((3,)), F((3,)), F((3,)), P((3,))],
+     {"use_global_stats": True, "fix_gamma": False}, label="globalstats",
+     rtol=1e-3, atol=1e-3)
+case("BatchNorm",
+     [F((2, 4, 4, 3)), P((3,)), F((3,)), F((3,)), P((3,))],
+     {"fix_gamma": False, "axis": -1}, label="axis_last",
+     rtol=1e-3, atol=1e-3)
+case("LRN", [F((2, 6, 4, 4))], {"nsize": 3})
+case("L2Normalization", [F((2, 3, 4, 4))], {"mode": "instance"})
+case("L2Normalization", [F((2, 3, 4, 4))], {"mode": "channel"},
+     label="channel")
+case("L2Normalization", [F((2, 3, 4, 4))], {"mode": "spatial"},
+     label="spatial")
+case("InstanceNorm", [F((2, 3, 4, 4)), P((3,)), F((3,))], {})
+case("LayerNorm", [F((2, 3, 4)), P((4,)), F((4,))], {})
+case("Dropout", [F((3, 4))], {"p": 0.0})
+case("Dropout", [F((64, 64))], {"p": 0.5}, label="p05_train")
+case("softmax", [F((3, 4))], {"axis": -1})
+case("log_softmax", [F((3, 4))], {"temperature": 2.0})
+case("SoftmaxActivation", [F((3, 4))], {})
+case("SoftmaxActivation", [F((2, 3, 4, 4))], {"mode": "channel"},
+     label="channel")
+case("softmax_cross_entropy", [F((4, 5)), I((4,), 5).astype(np.float32)],
+     {})
+case("SoftmaxOutput", [F((4, 5)), I((4,), 5).astype(np.float32)], {})
+case("SoftmaxOutput", [F((4, 5)), I((4,), 5).astype(np.float32)],
+     {"use_ignore": True, "ignore_label": 0, "normalization": "valid"},
+     label="ignore")
+case("LinearRegressionOutput", [F((4, 3)), F((4, 3))], {})
+case("MAERegressionOutput", [F((4, 3)), F((4, 3))], {})
+case("LogisticRegressionOutput", [F((4, 3)), F((4, 3))], {})
+case("MakeLoss", [F((4, 3))], {})
+case("make_loss", [F((4, 3))], {})
+case("SVMOutput", [F((4, 5)), I((4,), 5).astype(np.float32)], {})
+case("UpSampling", [F((2, 3, 4, 4))], {"scale": 2, "sample_type": "nearest"})
+case("UpSampling", [F((1, 2, 4, 4)), F((2, 1, 4, 4))],
+     {"scale": 2, "sample_type": "bilinear", "num_filter": 2,
+      "num_args": 2}, label="bilinear", **CONV_TOL)
+_seqlen = np.array([3, 2], dtype=np.float32)
+case("SequenceLast", [F((4, 2, 3)), _seqlen], {"use_sequence_length": True})
+case("SequenceMask", [F((4, 2, 3)), _seqlen],
+     {"use_sequence_length": True, "value": -1.0})
+case("SequenceReverse", [F((4, 2, 3)), _seqlen],
+     {"use_sequence_length": True})
+
+# fused RNN: parameter vector sized per mode (reference rnn-inl.h layout)
+_T, _B, _I, _H = 5, 2, 3, 4
+
+
+def _rnn_nparams(mode_gates):
+    return mode_gates * _H * (_I + _H) + mode_gates * 2 * _H
+
+
+case("RNN", [F((_T, _B, _I)), F((_rnn_nparams(4),)), F((1, _B, _H)),
+             F((1, _B, _H))],
+     {"state_size": _H, "num_layers": 1, "mode": "lstm"}, rtol=1e-3,
+     atol=1e-3)
+case("RNN", [F((_T, _B, _I)), F((_rnn_nparams(3),)), F((1, _B, _H))],
+     {"state_size": _H, "num_layers": 1, "mode": "gru"}, label="gru",
+     rtol=1e-3, atol=1e-3)
+case("RNN", [F((_T, _B, _I)), F((_rnn_nparams(1),)), F((1, _B, _H))],
+     {"state_size": _H, "num_layers": 1, "mode": "rnn_tanh"},
+     label="rnn_tanh", rtol=1e-3, atol=1e-3)
+
+_ctc_label = np.zeros((2, 3), np.float32)
+_ctc_label[0, :2] = [1, 2]
+_ctc_label[1, :3] = [2, 1, 2]
+case("_ctc_loss", [F((2, 6, 4)), _ctc_label], {}, rtol=1e-3, atol=1e-3)
+
+# spatial ops
+_rois = np.array([[0, 0, 0, 6, 6], [0, 2, 2, 7, 7]], np.float32)
+case("ROIPooling", [F((1, 2, 8, 8)), _rois],
+     {"pooled_size": (2, 2), "spatial_scale": 1.0})
+_theta = np.array([[1.0, 0.1, 0.0, -0.1, 1.0, 0.0]], np.float32)
+case("SpatialTransformer", [F((1, 2, 6, 6)), _theta],
+     {"target_shape": (4, 4), "transform_type": "affine",
+      "sampler_type": "bilinear"}, rtol=1e-3, atol=1e-3)
+case("GridGenerator", [_theta],
+     {"transform_type": "affine", "target_shape": (4, 4)})
+case("GridGenerator", [F((1, 2, 4, 4), -0.2, 0.2)],
+     {"transform_type": "warp"}, label="warp")
+case("BilinearSampler", [F((1, 2, 5, 5)), F((1, 2, 4, 4), -0.9, 0.9)], {},
+     rtol=1e-3, atol=1e-3)
+
+# SSD contrib ops
+case("_contrib_MultiBoxPrior", [F((1, 3, 8, 8))],
+     {"sizes": (0.5, 0.25), "ratios": (1.0, 2.0)}, grad=False)
+_anchors = np.clip(_rs.uniform(0, 1, (1, 8, 4)), 0, 1).astype(np.float32)
+_anchors[:, :, 2:] = np.clip(_anchors[:, :, :2] + 0.3, 0, 1)
+_mb_label = np.full((1, 2, 6), -1, np.float32)
+_mb_label[0, 0] = [1, 0.1, 0.1, 0.5, 0.5, 0]
+_mb_label[0, 1] = [0, 0.4, 0.4, 0.9, 0.9, 0]
+case("_contrib_MultiBoxTarget",
+     [_anchors, _mb_label, F((1, 3, 8))], {}, grad=False)
+_cls_prob = np.abs(F((1, 3, 8)))
+_cls_prob = _cls_prob / _cls_prob.sum(axis=1, keepdims=True)
+case("_contrib_MultiBoxDetection",
+     [_cls_prob, F((1, 32)), _anchors], {}, grad=False)
+
+# ---------------------------------------------------------------------------
+# optimizer update kernels (mutating; compared on outputs, no autograd)
+# ---------------------------------------------------------------------------
+_W, _G = F((4, 5)), F((4, 5))
+case("sgd_update", [_W, _G], {"lr": 0.1, "wd": 0.01}, grad=False)
+case("sgd_mom_update", [_W, _G, F((4, 5))],
+     {"lr": 0.1, "momentum": 0.9}, grad=False)
+case("mp_sgd_update", [_W.astype(np.float16).astype(np.float32), _G,
+                       F((4, 5))], {"lr": 0.1}, grad=False)
+case("mp_sgd_mom_update", [_W, _G, F((4, 5)), F((4, 5))],
+     {"lr": 0.1, "momentum": 0.9}, grad=False)
+case("adam_update", [_W, _G, F((4, 5)), P((4, 5))],
+     {"lr": 0.01}, grad=False)
+case("rmsprop_update", [_W, _G, P((4, 5))], {"lr": 0.01}, grad=False)
+case("rmspropalex_update", [_W, _G, P((4, 5)), F((4, 5)), F((4, 5))],
+     {"lr": 0.01}, grad=False)
+case("ftrl_update", [_W, _G, F((4, 5)), P((4, 5))], {"lr": 0.1},
+     grad=False)
+
+# ---------------------------------------------------------------------------
+# random ops with transform-based samplers (threefry bits are
+# platform-invariant; float transforms compared at oracle tolerance)
+# ---------------------------------------------------------------------------
+case("_random_uniform", [], {"shape": (64,), "low": -1.0, "high": 2.0},
+     grad=False)
+case("_random_normal", [], {"shape": (64,), "loc": 1.0, "scale": 2.0},
+     grad=False)
+case("_random_exponential", [], {"shape": (64,), "lam": 2.0}, grad=False)
+case("_sample_uniform", [np.array([0.0, 1.0], np.float32),
+                         np.array([1.0, 4.0], np.float32)],
+     {"shape": (8,)}, grad=False)
+case("_sample_normal", [np.array([0.0, 1.0], np.float32),
+                        np.array([1.0, 2.0], np.float32)],
+     {"shape": (8,)}, grad=False)
+case("_sample_exponential", [np.array([1.0, 2.0], np.float32)],
+     {"shape": (8,)}, grad=False)
+
+
+# ---------------------------------------------------------------------------
+# harness
+# ---------------------------------------------------------------------------
+
+def _unique_def_names():
+    return sorted({op.name for op in _OPS.values()})
+
+
+def _backends():
+    cpu = jax.devices("cpu")[0]
+    if SELF_MODE:
+        return cpu, cpu
+    acc = [d for d in jax.devices() if d.platform != "cpu"]
+    return cpu, acc[0]
+
+
+def _run_case(op, spec, dev):
+    arrays = [jax.device_put(np.asarray(a), dev) for a in spec["arrays"]]
+    params = dict(spec["params"])
+
+    def call(*arrs):
+        kw = dict(params)
+        if op.takes_train:
+            kw["_train"] = spec["train"]
+        if op.takes_rng:
+            kw["_rng"] = jax.random.key(7)
+        out = op.fn(*arrs, **kw)
+        return out if isinstance(out, tuple) else (out,)
+
+    grad_args = [i for i, a in enumerate(arrays)
+                 if spec["grad"] and jnp.issubdtype(a.dtype, jnp.floating)]
+
+    def fwd_and_grad(*arrs):
+        outs = call(*arrs)
+        if not grad_args:
+            return outs, ()
+
+        def loss(*ga):
+            full = list(arrs)
+            for i, g in zip(grad_args, ga):
+                full[i] = g
+            os_ = call(*full)
+            return sum(jnp.sum(o.astype(jnp.float32)) for o in os_
+                       if jnp.issubdtype(o.dtype, jnp.floating))
+
+        grads = jax.grad(loss, argnums=tuple(range(len(grad_args))))(
+            *[arrs[i] for i in grad_args])
+        return outs, grads
+
+    with jax.default_device(dev):
+        outs, grads = jax.jit(fwd_and_grad)(*arrays)
+    return ([np.asarray(o) for o in outs], [np.asarray(g) for g in grads])
+
+
+_ALL_PARAMS = [(name, i) for name in sorted(CASES)
+               for i in range(len(CASES[name]))]
+
+
+@pytest.mark.parametrize(
+    "name,idx", _ALL_PARAMS,
+    ids=["%s:%s" % (n, CASES[n][i]["label"]) for n, i in _ALL_PARAMS])
+def test_op_consistency(name, idx):
+    op = get_op(name)
+    spec = CASES[name][idx]
+    cpu, acc = _backends()
+    ref_outs, ref_grads = _run_case(op, spec, cpu)
+    got_outs, got_grads = _run_case(op, spec, acc)
+    assert len(ref_outs) == len(got_outs)
+    for k, (r, g) in enumerate(zip(ref_outs, got_outs)):
+        np.testing.assert_allclose(
+            g, r, rtol=spec["rtol"], atol=spec["atol"], equal_nan=True,
+            err_msg="%s output %d" % (name, k))
+    for k, (r, g) in enumerate(zip(ref_grads, got_grads)):
+        np.testing.assert_allclose(
+            g, r, rtol=spec["rtol"], atol=max(spec["atol"], 1e-4),
+            equal_nan=True, err_msg="%s grad %d" % (name, k))
+
+
+def test_registry_fully_covered():
+    """Every registered op def is either swept or skip-listed with a reason."""
+    names = set(_unique_def_names())
+    covered = set(CASES) | set(SKIP)
+    missing = sorted(names - covered)
+    assert not missing, "ops with no sweep case and no skip reason: %s" \
+        % missing
+    stale = sorted((set(CASES) | set(SKIP)) - names)
+    assert not stale, "sweep entries for unregistered ops: %s" % stale
+    overlap = sorted(set(CASES) & set(SKIP))
+    assert not overlap, "ops both swept and skip-listed: %s" % overlap
