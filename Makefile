@@ -12,11 +12,19 @@ PY_LDFLAGS := $(shell python3-config --embed --ldflags 2>/dev/null || \
                       python3-config --ldflags)
 
 all: $(LIB_DIR)/libmxtpu_io.so $(LIB_DIR)/libmxtpu_engine.so \
-     $(LIB_DIR)/libmxtpu_storage.so $(LIB_DIR)/libmxtpu_predict.so
+     $(LIB_DIR)/libmxtpu_storage.so $(LIB_DIR)/libmxtpu_predict.so \
+     $(LIB_DIR)/libmxtpu_c_api.so
 
-$(LIB_DIR)/libmxtpu_predict.so: src/c_predict_api.cc
+$(LIB_DIR)/libmxtpu_predict.so: src/c_predict_api.cc src/embed_common.cc
 	@mkdir -p $(LIB_DIR)
-	$(CXX) $(CXXFLAGS) $(PY_INCLUDES) -shared -o $@ $< $(PY_LDFLAGS)
+	$(CXX) $(CXXFLAGS) $(PY_INCLUDES) -shared -o $@ $^ $(PY_LDFLAGS)
+
+# full ABI in one library (like the reference's single libmxnet.so):
+# general C API + predict API + shared embed machinery
+$(LIB_DIR)/libmxtpu_c_api.so: src/c_api.cc src/c_predict_api.cc \
+                              src/embed_common.cc
+	@mkdir -p $(LIB_DIR)
+	$(CXX) $(CXXFLAGS) $(PY_INCLUDES) -shared -o $@ $^ $(PY_LDFLAGS)
 
 $(LIB_DIR)/libmxtpu_storage.so: src/storage.cc
 	@mkdir -p $(LIB_DIR)

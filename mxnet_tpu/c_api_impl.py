@@ -1,0 +1,207 @@
+"""Python side of the general C API (src/c_api.cc).
+
+Parity: the reference's src/c_api/{c_api.cc,c_api_ndarray.cc,
+c_api_symbolic.cc,c_api_executor.cc} — the 159-function MXNET_DLL ABI
+(include/mxnet/c_api.h). The C library embeds CPython (the same design
+as c_predict: one inference/training stack, one ABI) and calls the
+helpers here; handles on the C side are owned PyObject* of the framework
+objects themselves, so every language binding drives the exact code path
+Python users do.
+
+All pointer arguments arrive as integer addresses; ctypes does the raw
+memory traffic so the C side stays a thin marshalling layer.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+# Standalone C programs (no Python host) must not grab the TPU: the
+# embedded interpreter is usually a deployment/inference context.
+if os.environ.get("MXNET_TPU_FORCE_CPU") == "1":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.ndarray.ndarray import NDArray
+from mxnet_tpu.ops.registry import get_op, list_ops
+
+# reference dtype codes (include/mxnet/base.h TypeFlag)
+_DTYPE_BY_CODE = {0: np.float32, 1: np.float64, 2: np.float16,
+                  3: np.uint8, 4: np.int32, 5: np.int8, 6: np.int64}
+_CODE_BY_DTYPE = {np.dtype(v): k for k, v in _DTYPE_BY_CODE.items()}
+
+_GRAD_REQ_BY_CODE = {0: "null", 1: "write", 2: "write", 3: "add"}
+
+
+def _ctx(dev_type, dev_id):
+    # reference dev_type: 1=cpu, 2=gpu, 3=cpu_pinned; the accelerator is
+    # mx.tpu here (gpu maps onto it for source compatibility)
+    if dev_type == 1:
+        return mx.cpu(dev_id)
+    if dev_type == 3:
+        return mx.cpu_pinned(dev_id)
+    return mx.tpu(dev_id)
+
+
+# -- NDArray ----------------------------------------------------------------
+
+def ndarray_create(shape, dev_type, dev_id, delay_alloc, dtype_code):
+    dt = _DTYPE_BY_CODE[int(dtype_code)]
+    return mx.nd.zeros(tuple(int(s) for s in shape),
+                       ctx=_ctx(dev_type, dev_id), dtype=dt)
+
+
+def ndarray_sync_copy_from(nd, ptr, size):
+    n = int(size)
+    buf = (ctypes.c_char * (n * nd.dtype.itemsize)).from_address(int(ptr))
+    arr = np.frombuffer(buf, dtype=nd.dtype, count=n).reshape(nd.shape)
+    nd[:] = arr.copy()
+
+
+def ndarray_sync_copy_to(nd, ptr, size):
+    src = np.ascontiguousarray(nd.asnumpy())
+    n = int(size)
+    if n != src.size:
+        raise MXNetError("copy size %d != ndarray size %d" % (n, src.size))
+    ctypes.memmove(int(ptr), src.ctypes.data, n * src.dtype.itemsize)
+
+
+def ndarray_shape(nd):
+    return [int(s) for s in nd.shape]
+
+
+def ndarray_dtype(nd):
+    return _CODE_BY_DTYPE[np.dtype(nd.dtype)]
+
+
+def ndarray_wait(nd):
+    nd.wait_to_read()
+
+
+def wait_all():
+    mx.nd.waitall()
+
+
+def ndarray_save(fname, nds, keys):
+    if keys:
+        mx.nd.save(fname, dict(zip(keys, nds)))
+    else:
+        mx.nd.save(fname, list(nds))
+
+
+def ndarray_load(fname):
+    data = mx.nd.load(fname)
+    if isinstance(data, dict):
+        keys = list(data.keys())
+        return [data[k] for k in keys], keys
+    return list(data), []
+
+
+# -- operators --------------------------------------------------------------
+
+def op_names():
+    return list_ops()
+
+
+def op_exists(name):
+    """Handle-creation validation (the reference's NNGetOpHandle errors on
+    unknown names rather than letting arbitrary attributes be invoked)."""
+    return name in list_ops()
+
+
+def imperative_invoke(op_name, inputs, keys, vals, outputs):
+    """(parity: MXImperativeInvoke / c_api_ndarray.cc). ``outputs`` is
+    either None (op allocates) or a list of existing NDArrays to write
+    into — the reference's in-place output contract."""
+    if not op_exists(op_name):
+        raise MXNetError("operator %r is not registered" % op_name)
+    from mxnet_tpu import nd
+    fn = getattr(nd, op_name, None)
+    params = {k: _parse_val(v) for k, v in zip(keys, vals)}
+    if fn is not None:
+        res = fn(*inputs, **params)
+    else:
+        op = get_op(op_name)
+        from mxnet_tpu.imperative import invoke
+        res = invoke(op, list(inputs), params)
+    if not isinstance(res, (list, tuple)):
+        res = [res]
+    if outputs:
+        if len(outputs) != len(res):
+            raise MXNetError(
+                "%s produces %d outputs but %d output handles were given"
+                % (op_name, len(res), len(outputs)))
+        for dst, src in zip(outputs, res):
+            if src is not dst:  # mutating ops already wrote in place
+                src.copyto(dst)
+        return list(outputs)
+    return list(res)
+
+
+def _parse_val(v):
+    """Parse a C-string param value the way the reference's dmlc parameter
+    parser does (kwargs always arrive as strings over the C ABI)."""
+    import ast
+    if not isinstance(v, str):
+        return v
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+# -- symbols ----------------------------------------------------------------
+
+def symbol_from_json(json_str):
+    return mx.sym.load_json(json_str)
+
+
+def symbol_from_file(path):
+    return mx.sym.load(path)
+
+
+def symbol_arguments(sym):
+    return sym.list_arguments()
+
+
+def symbol_outputs(sym):
+    return sym.list_outputs()
+
+
+def symbol_aux(sym):
+    return sym.list_auxiliary_states()
+
+
+def symbol_infer_shape(sym, names, shapes):
+    known = dict(zip(names, [tuple(s) for s in shapes]))
+    arg_shapes, out_shapes, aux_shapes = sym.infer_shape(**known)
+    def clean(lst):
+        return [list(s) if s is not None else [] for s in lst]
+    complete = all(s is not None for s in arg_shapes + out_shapes + aux_shapes)
+    return clean(arg_shapes), clean(out_shapes), clean(aux_shapes), complete
+
+
+# -- executor ---------------------------------------------------------------
+
+def executor_bind(sym, dev_type, dev_id, arg_nds, grad_nds, req_codes,
+                  aux_nds):
+    reqs = [_GRAD_REQ_BY_CODE[int(c)] for c in req_codes]
+    grads = list(grad_nds)  # NULL C handles already arrive as None
+    return sym.bind(ctx=_ctx(dev_type, dev_id), args=list(arg_nds),
+                    args_grad=grads, grad_req=reqs,
+                    aux_states=list(aux_nds) if aux_nds else None)
+
+
+def executor_forward(ex, is_train):
+    ex.forward(is_train=bool(is_train))
+
+
+def executor_backward(ex, head_grads):
+    ex.backward(out_grads=list(head_grads) if head_grads else None)
+
+
+def executor_outputs(ex):
+    return list(ex.outputs)

@@ -1,0 +1,562 @@
+// General C API — the language-binding ABI.
+//
+// TPU-native re-design of the reference's src/c_api/{c_api.cc,
+// c_api_ndarray.cc,c_api_symbolic.cc,c_api_executor.cc} slice of the
+// 159-function MXNET_DLL surface (include/mxnet/c_api.h) that powers
+// cpp-package/scala/R/perl frontends. Same design as c_predict_api.cc:
+// the library embeds CPython and drives the framework's own executor
+// through mxnet_tpu/c_api_impl.py, so a C driver trains/infers on the
+// exact XLA path Python users run. Handles are owned PyObject* of the
+// framework objects.
+//
+// Exported surface (reference names and call shapes):
+//   MXGetLastError, MXNDArrayCreate/CreateEx/Free,
+//   MXNDArraySyncCopyFromCPU/SyncCopyToCPU, MXNDArrayGetShape/GetDType,
+//   MXNDArrayWaitToRead/WaitToWrite/WaitAll, MXNDArraySave/Load,
+//   MXListAllOpNames, NNGetOpHandle, MXImperativeInvoke,
+//   MXSymbolCreateFromJSON/CreateFromFile/Free,
+//   MXSymbolListArguments/ListOutputs/ListAuxiliaryStates,
+//   MXSymbolInferShape, MXExecutorBind/Forward/Backward/Outputs/Free.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "embed_common.h"
+
+typedef unsigned int mx_uint;
+typedef void* NDArrayHandle;
+typedef void* SymbolHandle;
+typedef void* ExecutorHandle;
+typedef void* AtomicSymbolCreator;
+
+namespace {
+
+// String/shape buffers whose pointers we hand out must stay alive until
+// the next API call on the same thread (the reference uses thread-local
+// return buffers, c_api.h "callee keeps ownership").
+thread_local std::vector<std::string> g_str_store;
+thread_local std::vector<const char*> g_str_ptrs;
+thread_local std::vector<mx_uint> g_shape_buf;
+thread_local std::vector<std::vector<mx_uint>> g_shape_store;
+thread_local std::vector<const mx_uint*> g_shape_ptrs;
+thread_local std::vector<mx_uint> g_ndim_buf;
+thread_local std::vector<void*> g_handle_buf;
+
+PyGILState_STATE EnsurePython() { return MXTPUEnsurePython(); }
+
+PyObject* Impl() {
+  static PyObject* mod = nullptr;
+  if (mod == nullptr) {
+    mod = PyImport_ImportModule("mxnet_tpu.c_api_impl");
+  }
+  return mod;
+}
+
+void CaptureError() { MXTPUCaptureError(); }
+
+// Call impl helper `name` with pre-built args tuple; returns new ref or
+// nullptr with g_last_error set.
+PyObject* CallImpl(const char* name, PyObject* args) {
+  PyObject* mod = Impl();
+  if (mod == nullptr) {
+    CaptureError();
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* fn = PyObject_GetAttrString(mod, name);
+  if (fn == nullptr) {
+    CaptureError();
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* res = PyObject_CallObject(fn, args);
+  Py_DECREF(fn);
+  Py_XDECREF(args);
+  if (res == nullptr) CaptureError();
+  return res;
+}
+
+PyObject* StrList(const char** arr, mx_uint n) {
+  PyObject* lst = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i)
+    PyList_SetItem(lst, i, PyUnicode_FromString(arr[i] ? arr[i] : ""));
+  return lst;
+}
+
+PyObject* HandleList(NDArrayHandle* arr, mx_uint n) {
+  PyObject* lst = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i) {
+    PyObject* o = arr && arr[i] ? static_cast<PyObject*>(arr[i]) : Py_None;
+    Py_INCREF(o);
+    PyList_SetItem(lst, i, o);
+  }
+  return lst;
+}
+
+// Store a python list of str into thread-local storage; out gets char**.
+int ReturnStrList(PyObject* lst, mx_uint* out_size, const char*** out_array) {
+  Py_ssize_t n = PyList_Size(lst);
+  g_str_store.clear();
+  g_str_ptrs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* c = PyUnicode_AsUTF8(PyList_GetItem(lst, i));
+    g_str_store.emplace_back(c ? c : "");
+  }
+  for (auto& s : g_str_store) g_str_ptrs.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(n);
+  *out_array = g_str_ptrs.data();
+  return 0;
+}
+
+int ReturnHandleList(PyObject* lst, mx_uint* out_size,
+                     NDArrayHandle** out_array) {
+  Py_ssize_t n = PyList_Size(lst);
+  g_handle_buf.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* o = PyList_GetItem(lst, i);
+    Py_INCREF(o);  // handle owns a reference; freed by MXNDArrayFree
+    g_handle_buf.push_back(o);
+  }
+  *out_size = static_cast<mx_uint>(n);
+  *out_array = g_handle_buf.data();
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// MXGetLastError is exported by embed_common.cc
+
+// ---- NDArray --------------------------------------------------------------
+
+int MXNDArrayCreateEx(const mx_uint* shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle* out) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* shp = PyList_New(ndim);
+  for (mx_uint i = 0; i < ndim; ++i)
+    PyList_SetItem(shp, i, PyLong_FromUnsignedLong(shape[i]));
+  PyObject* args = Py_BuildValue("(Oiiii)", shp, dev_type, dev_id,
+                                 delay_alloc, dtype);
+  Py_DECREF(shp);
+  PyObject* nd = CallImpl("ndarray_create", args);
+  int rc = -1;
+  if (nd != nullptr) {
+    *out = nd;  // transfer ownership to the handle
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXNDArrayCreate(const mx_uint* shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle* out) {
+  return MXNDArrayCreateEx(shape, ndim, dev_type, dev_id, delay_alloc, 0,
+                           out);
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  PyGILState_STATE gil = EnsurePython();
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void* data,
+                             size_t size) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* args = Py_BuildValue(
+      "(OLn)", static_cast<PyObject*>(handle),
+      static_cast<long long>(reinterpret_cast<intptr_t>(data)),
+      static_cast<Py_ssize_t>(size));
+  PyObject* r = CallImpl("ndarray_sync_copy_from", args);
+  int rc = r != nullptr ? 0 : -1;
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void* data, size_t size) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* args = Py_BuildValue(
+      "(OLn)", static_cast<PyObject*>(handle),
+      static_cast<long long>(reinterpret_cast<intptr_t>(data)),
+      static_cast<Py_ssize_t>(size));
+  PyObject* r = CallImpl("ndarray_sync_copy_to", args);
+  int rc = r != nullptr ? 0 : -1;
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint* out_dim,
+                      const mx_uint** out_pdata) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* shp = CallImpl("ndarray_shape", args);
+  if (shp == nullptr) {
+    PyGILState_Release(gil);
+    return -1;
+  }
+  Py_ssize_t n = PyList_Size(shp);
+  g_shape_buf.resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i)
+    g_shape_buf[i] =
+        static_cast<mx_uint>(PyLong_AsLong(PyList_GetItem(shp, i)));
+  Py_DECREF(shp);
+  *out_dim = static_cast<mx_uint>(n);
+  *out_pdata = g_shape_buf.data();
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int* out_dtype) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* r = CallImpl("ndarray_dtype", args);
+  int rc = -1;
+  if (r != nullptr) {
+    *out_dtype = static_cast<int>(PyLong_AsLong(r));
+    Py_DECREF(r);
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* args = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* r = CallImpl("ndarray_wait", args);
+  int rc = r != nullptr ? 0 : -1;
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXNDArrayWaitToWrite(NDArrayHandle handle) {
+  return MXNDArrayWaitToRead(handle);
+}
+
+int MXNDArrayWaitAll() {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* r = CallImpl("wait_all", PyTuple_New(0));
+  int rc = r != nullptr ? 0 : -1;
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXNDArraySave(const char* fname, mx_uint num_args, NDArrayHandle* args,
+                  const char** keys) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* nds = HandleList(args, num_args);
+  PyObject* ks = keys != nullptr ? StrList(keys, num_args) : PyList_New(0);
+  PyObject* a = Py_BuildValue("(sOO)", fname, nds, ks);
+  Py_DECREF(nds);
+  Py_DECREF(ks);
+  PyObject* r = CallImpl("ndarray_save", a);
+  int rc = r != nullptr ? 0 : -1;
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXNDArrayLoad(const char* fname, mx_uint* out_size,
+                  NDArrayHandle** out_arr, mx_uint* out_name_size,
+                  const char*** out_names) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* a = Py_BuildValue("(s)", fname);
+  PyObject* r = CallImpl("ndarray_load", a);
+  if (r == nullptr) {
+    PyGILState_Release(gil);
+    return -1;
+  }
+  PyObject* nds = PyTuple_GetItem(r, 0);
+  PyObject* names = PyTuple_GetItem(r, 1);
+  ReturnHandleList(nds, out_size, out_arr);
+  ReturnStrList(names, out_name_size, out_names);
+  Py_DECREF(r);
+  PyGILState_Release(gil);
+  return 0;
+}
+
+// ---- operators ------------------------------------------------------------
+
+int MXListAllOpNames(mx_uint* out_size, const char*** out_array) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* r = CallImpl("op_names", PyTuple_New(0));
+  if (r == nullptr) {
+    PyGILState_Release(gil);
+    return -1;
+  }
+  ReturnStrList(r, out_size, out_array);
+  Py_DECREF(r);
+  PyGILState_Release(gil);
+  return 0;
+}
+
+// Op handles are name strings validated against the registry (the
+// reference hands out nnvm::Op* and errors on unknown names).
+int NNGetOpHandle(const char* name, AtomicSymbolCreator* out) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* r = CallImpl("op_exists", Py_BuildValue("(s)", name));
+  int rc = -1;
+  if (r != nullptr) {
+    if (PyObject_IsTrue(r)) {
+      *out = new std::string(name);  // leaked by design: handles live forever
+      rc = 0;
+    } else {
+      mxtpu_last_error = std::string("operator not registered: ") + name;
+    }
+    Py_DECREF(r);
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                       NDArrayHandle* inputs, int* num_outputs,
+                       NDArrayHandle** outputs, int num_params,
+                       const char** param_keys, const char** param_vals) {
+  PyGILState_STATE gil = EnsurePython();
+  std::string* name = static_cast<std::string*>(creator);
+  PyObject* ins = HandleList(inputs, num_inputs);
+  PyObject* keys = StrList(param_keys, num_params);
+  PyObject* vals = StrList(param_vals, num_params);
+  PyObject* outs;
+  if (*num_outputs > 0 && *outputs != nullptr) {
+    outs = HandleList(*outputs, *num_outputs);
+  } else {
+    outs = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject* a = Py_BuildValue("(sOOOO)", name->c_str(), ins, keys, vals,
+                              outs);
+  Py_DECREF(ins);
+  Py_DECREF(keys);
+  Py_DECREF(vals);
+  Py_DECREF(outs);
+  PyObject* r = CallImpl("imperative_invoke", a);
+  if (r == nullptr) {
+    PyGILState_Release(gil);
+    return -1;
+  }
+  if (*num_outputs <= 0 || *outputs == nullptr) {
+    mx_uint n = 0;
+    ReturnHandleList(r, &n, outputs);
+    *num_outputs = static_cast<int>(n);
+  }
+  Py_DECREF(r);
+  PyGILState_Release(gil);
+  return 0;
+}
+
+// ---- symbols --------------------------------------------------------------
+
+int MXSymbolCreateFromJSON(const char* json, SymbolHandle* out) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* r = CallImpl("symbol_from_json", Py_BuildValue("(s)", json));
+  int rc = -1;
+  if (r != nullptr) {
+    *out = r;
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXSymbolCreateFromFile(const char* fname, SymbolHandle* out) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* r = CallImpl("symbol_from_file", Py_BuildValue("(s)", fname));
+  int rc = -1;
+  if (r != nullptr) {
+    *out = r;
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXSymbolFree(SymbolHandle handle) {
+  PyGILState_STATE gil = EnsurePython();
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  PyGILState_Release(gil);
+  return 0;
+}
+
+static int SymStrList(SymbolHandle sym, const char* fn, mx_uint* out_size,
+                      const char*** out_array) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* r = CallImpl(fn, Py_BuildValue("(O)",
+                                           static_cast<PyObject*>(sym)));
+  if (r == nullptr) {
+    PyGILState_Release(gil);
+    return -1;
+  }
+  ReturnStrList(r, out_size, out_array);
+  Py_DECREF(r);
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXSymbolListArguments(SymbolHandle sym, mx_uint* out_size,
+                          const char*** out_array) {
+  return SymStrList(sym, "symbol_arguments", out_size, out_array);
+}
+
+int MXSymbolListOutputs(SymbolHandle sym, mx_uint* out_size,
+                        const char*** out_array) {
+  return SymStrList(sym, "symbol_outputs", out_size, out_array);
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, mx_uint* out_size,
+                                const char*** out_array) {
+  return SymStrList(sym, "symbol_aux", out_size, out_array);
+}
+
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args, const char** keys,
+                       const mx_uint* arg_ind_ptr, const mx_uint* arg_shape_data,
+                       mx_uint* in_shape_size, const mx_uint** in_shape_ndim,
+                       const mx_uint*** in_shape_data, mx_uint* out_shape_size,
+                       const mx_uint** out_shape_ndim,
+                       const mx_uint*** out_shape_data, mx_uint* aux_shape_size,
+                       const mx_uint** aux_shape_ndim,
+                       const mx_uint*** aux_shape_data, int* complete) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* names = StrList(keys, num_args);
+  PyObject* shapes = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    mx_uint lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+    PyObject* s = PyList_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j)
+      PyList_SetItem(s, j - lo, PyLong_FromUnsignedLong(arg_shape_data[j]));
+    PyList_SetItem(shapes, i, s);
+  }
+  PyObject* a = Py_BuildValue("(OOO)", static_cast<PyObject*>(sym), names,
+                              shapes);
+  Py_DECREF(names);
+  Py_DECREF(shapes);
+  PyObject* r = CallImpl("symbol_infer_shape", a);
+  if (r == nullptr) {
+    PyGILState_Release(gil);
+    return -1;
+  }
+  // unpack three shape-list groups into thread-local storage
+  g_shape_store.clear();
+  g_shape_ptrs.clear();
+  g_ndim_buf.clear();
+  mx_uint sizes[3];
+  size_t offsets[4] = {0, 0, 0, 0};
+  for (int grp = 0; grp < 3; ++grp) {
+    PyObject* lst = PyTuple_GetItem(r, grp);
+    Py_ssize_t n = PyList_Size(lst);
+    sizes[grp] = static_cast<mx_uint>(n);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* s = PyList_GetItem(lst, i);
+      Py_ssize_t nd = PyList_Size(s);
+      std::vector<mx_uint> v(nd);
+      for (Py_ssize_t j = 0; j < nd; ++j)
+        v[j] = static_cast<mx_uint>(PyLong_AsLong(PyList_GetItem(s, j)));
+      g_shape_store.push_back(std::move(v));
+      g_ndim_buf.push_back(static_cast<mx_uint>(nd));
+    }
+    offsets[grp + 1] = g_shape_store.size();
+  }
+  for (auto& v : g_shape_store) g_shape_ptrs.push_back(v.data());
+  *in_shape_size = sizes[0];
+  *in_shape_ndim = g_ndim_buf.data() + offsets[0];
+  *in_shape_data = g_shape_ptrs.data() + offsets[0];
+  *out_shape_size = sizes[1];
+  *out_shape_ndim = g_ndim_buf.data() + offsets[1];
+  *out_shape_data = g_shape_ptrs.data() + offsets[1];
+  *aux_shape_size = sizes[2];
+  *aux_shape_ndim = g_ndim_buf.data() + offsets[2];
+  *aux_shape_data = g_shape_ptrs.data() + offsets[2];
+  *complete = PyObject_IsTrue(PyTuple_GetItem(r, 3));
+  Py_DECREF(r);
+  PyGILState_Release(gil);
+  return 0;
+}
+
+// ---- executor ---------------------------------------------------------------
+
+int MXExecutorBind(SymbolHandle sym, int dev_type, int dev_id, mx_uint len,
+                   NDArrayHandle* in_args, NDArrayHandle* arg_grad_store,
+                   mx_uint* grad_req_type, mx_uint aux_states_len,
+                   NDArrayHandle* aux_states, ExecutorHandle* out) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* args = HandleList(in_args, len);
+  PyObject* grads = HandleList(arg_grad_store, len);
+  PyObject* reqs = PyList_New(len);
+  for (mx_uint i = 0; i < len; ++i)
+    PyList_SetItem(reqs, i, PyLong_FromUnsignedLong(grad_req_type[i]));
+  PyObject* aux = HandleList(aux_states, aux_states_len);
+  PyObject* a = Py_BuildValue("(OiiOOOO)", static_cast<PyObject*>(sym),
+                              dev_type, dev_id, args, grads, reqs, aux);
+  Py_DECREF(args);
+  Py_DECREF(grads);
+  Py_DECREF(reqs);
+  Py_DECREF(aux);
+  PyObject* r = CallImpl("executor_bind", a);
+  int rc = -1;
+  if (r != nullptr) {
+    *out = r;
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXExecutorForward(ExecutorHandle handle, int is_train) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* a = Py_BuildValue("(Oi)", static_cast<PyObject*>(handle),
+                              is_train);
+  PyObject* r = CallImpl("executor_forward", a);
+  int rc = r != nullptr ? 0 : -1;
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                       NDArrayHandle* head_grads) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* hg = HandleList(head_grads, len);
+  PyObject* a = Py_BuildValue("(OO)", static_cast<PyObject*>(handle), hg);
+  Py_DECREF(hg);
+  PyObject* r = CallImpl("executor_backward", a);
+  int rc = r != nullptr ? 0 : -1;
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint* out_size,
+                      NDArrayHandle** out) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* a = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* r = CallImpl("executor_outputs", a);
+  if (r == nullptr) {
+    PyGILState_Release(gil);
+    return -1;
+  }
+  ReturnHandleList(r, out_size, out);
+  Py_DECREF(r);
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXExecutorFree(ExecutorHandle handle) {
+  PyGILState_STATE gil = EnsurePython();
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  PyGILState_Release(gil);
+  return 0;
+}
+
+}  // extern "C"

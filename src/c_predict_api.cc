@@ -16,6 +16,8 @@
 
 #include <Python.h>
 
+#include "embed_common.h"
+
 #include <cstdint>
 #include <cstring>
 #include <string>
@@ -26,26 +28,15 @@ typedef void* PredictorHandle;
 
 namespace {
 
-thread_local std::string g_last_error;
-
 struct Pred {
   PyObject* obj;                 // mxnet_tpu.predictor.Predictor
   std::vector<mx_uint> shape_buf;  // backing for MXPredGetOutputShape
 };
 
-// Ensure the interpreter is up; returns a held GIL state. The embedded
-// interpreter is never finalized: predictor handles may outlive any one
-// call, and XLA client teardown at interpreter shutdown is not safe from
-// an arbitrary unload point.
-PyGILState_STATE EnsurePython() {
-  if (!Py_IsInitialized()) {
-    Py_InitializeEx(0);
-    // Py_InitializeEx leaves the GIL held by this thread; release it so
-    // PyGILState_Ensure below behaves uniformly.
-    PyEval_SaveThread();
-  }
-  return PyGILState_Ensure();
-}
+// The embedded interpreter is never finalized: predictor handles may
+// outlive any one call, and XLA client teardown at interpreter shutdown
+// is not safe from an arbitrary unload point.
+PyGILState_STATE EnsurePython() { return MXTPUEnsurePython(); }
 
 PyObject* HelperModule() {
   static PyObject* mod = nullptr;
@@ -55,30 +46,13 @@ PyObject* HelperModule() {
   return mod;
 }
 
-// Capture the pending Python exception into g_last_error.
-void CaptureError() {
-  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
-  PyErr_Fetch(&type, &value, &trace);
-  PyErr_NormalizeException(&type, &value, &trace);
-  g_last_error = "unknown python error";
-  if (value != nullptr) {
-    PyObject* s = PyObject_Str(value);
-    if (s != nullptr) {
-      const char* c = PyUnicode_AsUTF8(s);
-      if (c != nullptr) g_last_error = c;
-      Py_DECREF(s);
-    }
-  }
-  Py_XDECREF(type);
-  Py_XDECREF(value);
-  Py_XDECREF(trace);
-}
+void CaptureError() { MXTPUCaptureError(); }
 
 }  // namespace
 
 extern "C" {
 
-const char* MXGetLastError() { return g_last_error.c_str(); }
+// MXGetLastError is exported by embed_common.cc
 
 int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
                  int param_size, int dev_type, int dev_id,

@@ -1,0 +1,26 @@
+// Shared CPython-embedding machinery for the C ABI libraries
+// (c_api.cc, c_predict_api.cc). One definition of the error buffer and
+// MXGetLastError lives in embed_common.cc; when several of these
+// libraries are loaded into one process the dynamic linker unifies the
+// globals, so errors raised through one library are readable through
+// another (the reference ships one libmxnet.so — this keeps the split
+// build observably equivalent).
+#ifndef MXTPU_EMBED_COMMON_H_
+#define MXTPU_EMBED_COMMON_H_
+
+#include <Python.h>
+
+#include <string>
+
+// thread-local last error; written by CaptureError, read by MXGetLastError
+extern thread_local std::string mxtpu_last_error;
+
+// Bring the interpreter up (thread-safe, at-most-once) and take the GIL.
+PyGILState_STATE MXTPUEnsurePython();
+
+// Capture the pending Python exception into mxtpu_last_error.
+void MXTPUCaptureError();
+
+extern "C" const char* MXGetLastError();
+
+#endif  // MXTPU_EMBED_COMMON_H_

@@ -1,0 +1,288 @@
+"""General C API suite (parity model: reference include/mxnet/c_api.h as
+consumed by cpp-package — NDArray create/copy/wait, imperative invoke,
+symbol load + infer shape, executor bind/forward/backward)."""
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+LIB = os.path.join(REPO, "mxnet_tpu", "_lib", "libmxtpu_c_api.so")
+
+pytestmark = pytest.mark.skipif(not os.path.exists(LIB),
+                                reason="native lib not built")
+
+
+def _lib():
+    L = ctypes.CDLL(LIB)
+    L.MXGetLastError.restype = ctypes.c_char_p
+    # Explicit argtypes throughout: bare python ints (e.g. a dereferenced
+    # handle `outs[0]`) otherwise marshal as 32-bit c_int, truncating
+    # 64-bit pointers/size_t.
+    vp, u, i = ctypes.c_void_p, ctypes.c_uint, ctypes.c_int
+    P = ctypes.POINTER
+    L.MXNDArrayCreateEx.argtypes = [P(u), u, i, i, i, i, P(vp)]
+    L.MXNDArrayFree.argtypes = [vp]
+    L.MXNDArraySyncCopyFromCPU.argtypes = [vp, vp, ctypes.c_size_t]
+    L.MXNDArraySyncCopyToCPU.argtypes = [vp, vp, ctypes.c_size_t]
+    L.MXNDArrayGetShape.argtypes = [vp, P(u), P(P(u))]
+    L.MXNDArrayGetDType.argtypes = [vp, P(i)]
+    L.MXNDArrayWaitToRead.argtypes = [vp]
+    L.MXImperativeInvoke.argtypes = [vp, i, P(vp), P(i), P(P(vp)), i,
+                                     P(ctypes.c_char_p),
+                                     P(ctypes.c_char_p)]
+    return L
+
+
+def test_ndarray_roundtrip_and_invoke():
+    L = _lib()
+    shape = (ctypes.c_uint * 2)(2, 3)
+    h = ctypes.c_void_p()
+    assert L.MXNDArrayCreateEx(shape, 2, 1, 0, 0, 0, ctypes.byref(h)) == 0, \
+        L.MXGetLastError()
+
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    buf = (ctypes.c_float * 6)(*x.ravel())
+    assert L.MXNDArraySyncCopyFromCPU(h, buf, 6) == 0, L.MXGetLastError()
+    assert L.MXNDArrayWaitToRead(h) == 0
+
+    ndim = ctypes.c_uint()
+    pdata = ctypes.POINTER(ctypes.c_uint)()
+    assert L.MXNDArrayGetShape(h, ctypes.byref(ndim),
+                               ctypes.byref(pdata)) == 0
+    assert tuple(pdata[i] for i in range(ndim.value)) == (2, 3)
+    dt = ctypes.c_int()
+    assert L.MXNDArrayGetDType(h, ctypes.byref(dt)) == 0 and dt.value == 0
+
+    # imperative invoke: exp(x), op allocates outputs
+    op = ctypes.c_void_p()
+    assert L.NNGetOpHandle(b"exp", ctypes.byref(op)) == 0
+    n_out = ctypes.c_int(0)
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    ins = (ctypes.c_void_p * 1)(h)
+    assert L.MXImperativeInvoke(op, 1, ins, ctypes.byref(n_out),
+                                ctypes.byref(outs), 0, None, None) == 0, \
+        L.MXGetLastError()
+    assert n_out.value == 1
+    got = (ctypes.c_float * 6)()
+    assert L.MXNDArraySyncCopyToCPU(outs[0], got, 6) == 0, L.MXGetLastError()
+    np.testing.assert_allclose(np.array(got[:6]).reshape(2, 3), np.exp(x),
+                               rtol=1e-5)
+    assert L.MXNDArrayFree(outs[0]) == 0
+    assert L.MXNDArrayFree(h) == 0
+
+
+def test_list_op_names():
+    L = _lib()
+    n = ctypes.c_uint()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    assert L.MXListAllOpNames(ctypes.byref(n), ctypes.byref(arr)) == 0
+    names = {arr[i].decode() for i in range(n.value)}
+    assert n.value > 200
+    assert {"Convolution", "FullyConnected", "sgd_update"} <= names
+
+
+def _save_lenet_json(tmp_path):
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                             name="conv1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=16, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, mx.sym.Variable("softmax_label"),
+                               name="softmax", normalization="batch")
+    path = str(tmp_path / "lenet-symbol.json")
+    net.save(path)
+    return path
+
+
+DRIVER_SRC = r'''
+// cpp-package-style LeNet training driver over the general C API.
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+typedef unsigned int mx_uint;
+typedef void* NDArrayHandle;
+typedef void* SymbolHandle;
+typedef void* ExecutorHandle;
+typedef void* AtomicSymbolCreator;
+extern const char* MXGetLastError();
+extern int MXNDArrayCreateEx(const mx_uint*, mx_uint, int, int, int, int,
+                             NDArrayHandle*);
+extern int MXNDArraySyncCopyFromCPU(NDArrayHandle, const void*, size_t);
+extern int MXNDArraySyncCopyToCPU(NDArrayHandle, void*, size_t);
+extern int MXNDArrayWaitAll();
+extern int NNGetOpHandle(const char*, AtomicSymbolCreator*);
+extern int MXImperativeInvoke(AtomicSymbolCreator, int, NDArrayHandle*,
+                              int*, NDArrayHandle**, int, const char**,
+                              const char**);
+extern int MXSymbolCreateFromFile(const char*, SymbolHandle*);
+extern int MXSymbolListArguments(SymbolHandle, mx_uint*, const char***);
+extern int MXSymbolInferShape(SymbolHandle, mx_uint, const char**,
+                              const mx_uint*, const mx_uint*, mx_uint*,
+                              const mx_uint**, const mx_uint***, mx_uint*,
+                              const mx_uint**, const mx_uint***, mx_uint*,
+                              const mx_uint**, const mx_uint***, int*);
+extern int MXExecutorBind(SymbolHandle, int, int, mx_uint, NDArrayHandle*,
+                          NDArrayHandle*, mx_uint*, mx_uint,
+                          NDArrayHandle*, ExecutorHandle*);
+extern int MXExecutorForward(ExecutorHandle, int);
+extern int MXExecutorBackward(ExecutorHandle, mx_uint, NDArrayHandle*);
+extern int MXExecutorOutputs(ExecutorHandle, mx_uint*, NDArrayHandle**);
+
+#define CHECK(x) do { if ((x) != 0) { \
+    printf("FAIL %s: %s\n", #x, MXGetLastError()); exit(1); } } while (0)
+
+#define B 32
+static unsigned int seed = 7;
+static float frand() { /* deterministic LCG in [0,1) */
+    seed = seed * 1103515245u + 12345u;
+    return (float)((seed >> 8) & 0xffffff) / (float)0x1000000;
+}
+
+/* synthetic separable task: class 1 iff left half brighter than right */
+static void make_batch(float* x, float* y) {
+    for (int b = 0; b < B; ++b) {
+        int label = (b % 2);
+        for (int i = 0; i < 64; ++i) {
+            int col = i % 8;
+            float base = frand() * 0.5f;
+            if (label == 1 && col < 4) base += 0.8f;
+            if (label == 0 && col >= 4) base += 0.8f;
+            x[b * 64 + i] = base;
+        }
+        y[b] = (float)label;
+    }
+}
+
+int main(int argc, char** argv) {
+    SymbolHandle sym;
+    CHECK(MXSymbolCreateFromFile(argv[1], &sym));
+
+    mx_uint n_args; const char** arg_names;
+    CHECK(MXSymbolListArguments(sym, &n_args, &arg_names));
+
+    /* infer all shapes from data/label */
+    const char* keys[] = {"data", "softmax_label"};
+    mx_uint indptr[] = {0, 4, 5};
+    mx_uint sdata[] = {B, 1, 8, 8, B};
+    mx_uint in_size, out_size, aux_size;
+    const mx_uint *in_ndim, *out_ndim, *aux_ndim;
+    const mx_uint **in_shapes, **out_shapes, **aux_shapes;
+    int complete;
+    CHECK(MXSymbolInferShape(sym, 2, keys, indptr, sdata, &in_size, &in_ndim,
+                             &in_shapes, &out_size, &out_ndim, &out_shapes,
+                             &aux_size, &aux_ndim, &aux_shapes, &complete));
+    if (!complete || in_size != n_args) { printf("FAIL infer\n"); return 1; }
+
+    /* allocate args + grads; save copies of shapes (the pointers are
+       thread-local and clobbered by later API calls) */
+    NDArrayHandle args[64], grads[64];
+    mx_uint reqs[64];
+    long arg_elems[64];
+    int data_idx = -1, label_idx = -1;
+    for (mx_uint i = 0; i < n_args; ++i) {
+        mx_uint shp[8];
+        long n = 1;
+        for (mx_uint j = 0; j < in_ndim[i]; ++j) {
+            shp[j] = in_shapes[i][j];
+            n *= shp[j];
+        }
+        arg_elems[i] = n;
+        CHECK(MXNDArrayCreateEx(shp, in_ndim[i], 1, 0, 0, 0, &args[i]));
+        if (strcmp(arg_names[i], "data") == 0) data_idx = (int)i;
+        if (strcmp(arg_names[i], "softmax_label") == 0) label_idx = (int)i;
+        int is_param = strcmp(arg_names[i], "data") != 0 &&
+                       strcmp(arg_names[i], "softmax_label") != 0;
+        reqs[i] = is_param ? 1 : 0;
+        if (is_param) {
+            CHECK(MXNDArrayCreateEx(shp, in_ndim[i], 1, 0, 0, 0, &grads[i]));
+            /* xavier-ish init */
+            float* w = (float*)malloc(n * sizeof(float));
+            float scale = 0.35f;
+            for (long k = 0; k < n; ++k) w[k] = (frand() - 0.5f) * scale;
+            CHECK(MXNDArraySyncCopyFromCPU(args[i], w, (size_t)n));
+            free(w);
+        } else {
+            grads[i] = NULL;
+        }
+    }
+    if (data_idx < 0 || label_idx < 0) { printf("FAIL names\n"); return 1; }
+
+    ExecutorHandle ex;
+    CHECK(MXExecutorBind(sym, 1, 0, n_args, args, grads, reqs, 0, NULL, &ex));
+
+    AtomicSymbolCreator sgd;
+    CHECK(NNGetOpHandle("sgd_update", &sgd));
+    const char* pk[] = {"lr"};
+    const char* pv[] = {"0.2"};
+
+    float x[B * 64], y[B];
+    for (int step = 0; step < 60; ++step) {
+        make_batch(x, y);
+        CHECK(MXNDArraySyncCopyFromCPU(args[data_idx], x, B * 64));
+        CHECK(MXNDArraySyncCopyFromCPU(args[label_idx], y, B));
+        CHECK(MXExecutorForward(ex, 1));
+        CHECK(MXExecutorBackward(ex, 0, NULL));
+        for (mx_uint i = 0; i < n_args; ++i) {
+            if (grads[i] == NULL) continue;
+            NDArrayHandle ins[2]; ins[0] = args[i]; ins[1] = grads[i];
+            NDArrayHandle* outs = &args[i];  /* in-place update */
+            int n_out = 1;
+            CHECK(MXImperativeInvoke(sgd, 2, ins, &n_out, &outs, 1, pk, pv));
+        }
+    }
+    CHECK(MXNDArrayWaitAll());
+
+    /* eval */
+    make_batch(x, y);
+    CHECK(MXNDArraySyncCopyFromCPU(args[data_idx], x, B * 64));
+    CHECK(MXExecutorForward(ex, 0));
+    mx_uint n_outs; NDArrayHandle* outs;
+    CHECK(MXExecutorOutputs(ex, &n_outs, &outs));
+    float prob[B * 2];
+    CHECK(MXNDArraySyncCopyToCPU(outs[0], prob, B * 2));
+    int correct = 0;
+    for (int b = 0; b < B; ++b) {
+        int pred = prob[b * 2 + 1] > prob[b * 2] ? 1 : 0;
+        if (pred == (int)y[b]) correct++;
+    }
+    printf("TRAIN_OK acc=%.4f\n", (float)correct / B);
+    return 0;
+}
+'''
+
+
+def test_c_train_driver(tmp_path):
+    """Compile and run a standalone C LeNet training driver — the
+    cpp-package deployment story over the general C API."""
+    import shutil
+    cc = shutil.which("gcc") or shutil.which("cc")
+    if cc is None:
+        pytest.skip("no C compiler")
+    json_path = _save_lenet_json(tmp_path)
+
+    driver = tmp_path / "train_driver.c"
+    driver.write_text(DRIVER_SRC)
+    exe = str(tmp_path / "train_driver")
+    subprocess.run([cc, str(driver), "-o", exe,
+                    "-L" + os.path.dirname(LIB), "-lmxtpu_c_api",
+                    "-Wl,-rpath," + os.path.dirname(LIB)], check=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.abspath(REPO) + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    env["MXNET_TPU_FORCE_CPU"] = "1"
+    p = subprocess.run([exe, json_path], capture_output=True, text=True,
+                       timeout=600, env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "TRAIN_OK" in p.stdout, p.stdout
+    acc = float(p.stdout.split("acc=")[1].split()[0])
+    assert acc > 0.8, p.stdout
