@@ -111,6 +111,7 @@ def install():
     get_op("Convolution").param_shape_infer = _conv
     get_op("Deconvolution").param_shape_infer = _deconv
     get_op("BatchNorm").param_shape_infer = _bn
+    get_op("BatchNorm_v1").param_shape_infer = _bn
     get_op("InstanceNorm").param_shape_infer = _instance_norm
     get_op("LayerNorm").param_shape_infer = _layer_norm
     get_op("Embedding").param_shape_infer = _embedding

@@ -1,0 +1,292 @@
+"""Tests for the detection/flow/signal/quantization operator set
+(reference parity: contrib/proposal.cc, contrib/deformable_convolution.cc,
+correlation.cc, contrib/fft.cc, contrib/quantize.cc, batch_norm_v1.cc,
+identity_attach_KL_sparse_reg.cc)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+
+# ---------------------------------------------------------------------------
+# Correlation
+# ---------------------------------------------------------------------------
+
+def _np_correlation(d1, d2, k, md, s1, s2, pad, mul):
+    """Independent numpy oracle (scalar-loop formulation of the FlowNet
+    correlation layer; ceil output shapes like the reference InferShape,
+    zero beyond the padded extent)."""
+    B, C, H, W = d1.shape
+    ph, pw = H + 2 * pad, W + 2 * pad
+    kr = (k - 1) // 2
+    bs = md + kr
+    th = int(np.ceil((ph - 2 * bs) / s1))
+    tw = int(np.ceil((pw - 2 * bs) / s1))
+    r = md // s2
+    D = 2 * r + 1
+    extra = 2 * md + max((th - 1) * s1, (tw - 1) * s1) + k
+    t1 = np.zeros((B, C, max(ph, extra), max(pw, extra)), d1.dtype)
+    t2 = np.zeros_like(t1)
+    t1[:, :, pad:pad + H, pad:pad + W] = d1
+    t2[:, :, pad:pad + H, pad:pad + W] = d2
+    out = np.zeros((B, D * D, th, tw), np.float32)
+    for i in range(th):
+        for j in range(tw):
+            y1, x1 = i * s1 + md, j * s1 + md
+            for tc in range(D * D):
+                dy = (tc // D - r) * s2
+                dx = (tc % D - r) * s2
+                p1 = t1[:, :, y1:y1 + k, x1:x1 + k]
+                p2 = t2[:, :, y1 + dy:y1 + dy + k, x1 + dx:x1 + dx + k]
+                v = p1 * p2 if mul else np.abs(p1 - p2)
+                out[:, tc, i, j] = v.sum(axis=(1, 2, 3))
+    return out / float(k * k * C)
+
+
+@pytest.mark.parametrize("cfg", [
+    dict(k=1, md=1, s1=1, s2=1, pad=1, mul=True),
+    dict(k=3, md=2, s1=2, s2=2, pad=2, mul=True),
+    dict(k=1, md=1, s1=1, s2=1, pad=1, mul=False),
+    # non-divisible span: exercises the reference's ceil output shape
+    dict(k=1, md=1, s1=2, s2=1, pad=0, mul=True),
+])
+def test_correlation_forward(cfg):
+    rs = np.random.RandomState(0)
+    shape = (2, 3, 9, 9) if cfg["s1"] == 2 and cfg["pad"] == 0 else (2, 3, 8, 8)
+    d1 = rs.uniform(-1, 1, shape).astype(np.float32)
+    d2 = rs.uniform(-1, 1, shape).astype(np.float32)
+    got = nd.Correlation(nd.array(d1), nd.array(d2), kernel_size=cfg["k"],
+                         max_displacement=cfg["md"], stride1=cfg["s1"],
+                         stride2=cfg["s2"], pad_size=cfg["pad"],
+                         is_multiply=cfg["mul"]).asnumpy()
+    want = _np_correlation(d1, d2, cfg["k"], cfg["md"], cfg["s1"],
+                           cfg["s2"], cfg["pad"], cfg["mul"])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_correlation_grad():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    net = sym.Correlation(a, b, kernel_size=1, max_displacement=1,
+                          pad_size=1)
+    rs = np.random.RandomState(0)
+    loc = {"a": rs.uniform(-1, 1, (1, 2, 5, 5)).astype(np.float32),
+           "b": rs.uniform(-1, 1, (1, 2, 5, 5)).astype(np.float32)}
+    check_numeric_gradient(net, loc, rtol=1e-2, atol=1e-2)
+
+
+# ---------------------------------------------------------------------------
+# fft / ifft
+# ---------------------------------------------------------------------------
+
+def test_fft_matches_numpy():
+    rs = np.random.RandomState(0)
+    x = rs.normal(size=(3, 8)).astype(np.float32)
+    got = nd.contrib.fft(nd.array(x)).asnumpy()
+    c = np.fft.fft(x, axis=-1)
+    want = np.empty((3, 16), np.float32)
+    want[:, 0::2] = c.real
+    want[:, 1::2] = c.imag
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_ifft_unnormalised_roundtrip():
+    rs = np.random.RandomState(0)
+    x = rs.normal(size=(2, 3, 2, 6)).astype(np.float32)
+    inter = nd.contrib.fft(nd.array(x))
+    back = nd.contrib.ifft(inter).asnumpy()
+    # reference ifft is unnormalised: ifft(fft(x)) == n * x
+    np.testing.assert_allclose(back, x.shape[-1] * x, rtol=1e-4, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# quantize / dequantize
+# ---------------------------------------------------------------------------
+
+def test_quantize_dequantize_roundtrip():
+    rs = np.random.RandomState(0)
+    x = rs.uniform(-3, 5, (4, 7)).astype(np.float32)
+    lo = nd.array(np.array([-3.0], np.float32))
+    hi = nd.array(np.array([5.0], np.float32))
+    q, qlo, qhi = nd.contrib.quantize(nd.array(x), lo, hi)
+    assert q.dtype == np.uint8
+    assert qlo.asnumpy().item() == -3.0 and qhi.asnumpy().item() == 5.0
+    want_q = np.clip((x - (-3.0)) * (255.0 / 8.0) + 0.5, 0, 255) \
+        .astype(np.uint8)
+    np.testing.assert_array_equal(q.asnumpy(), want_q)
+    deq = nd.contrib.dequantize(q, lo, hi).asnumpy()
+    # quantization error bounded by one step
+    assert np.abs(deq - x).max() <= 8.0 / 255.0 + 1e-6
+
+
+# ---------------------------------------------------------------------------
+# BatchNorm_v1
+# ---------------------------------------------------------------------------
+
+def test_batchnorm_v1_against_numpy():
+    rs = np.random.RandomState(0)
+    x = rs.uniform(-1, 1, (4, 3, 5, 5)).astype(np.float32)
+    g = rs.uniform(0.5, 1.5, (3,)).astype(np.float32)
+    b = rs.uniform(-0.5, 0.5, (3,)).astype(np.float32)
+    data = sym.Variable("data")
+    net = sym.BatchNorm_v1(data, fix_gamma=False, eps=1e-3, name="bn")
+    ex = net.simple_bind(ctx=mx.cpu(), data=x.shape)
+    ex.arg_dict["data"][:] = x
+    ex.arg_dict["bn_gamma"][:] = g
+    ex.arg_dict["bn_beta"][:] = b
+    ex.aux_dict["bn_moving_mean"][:] = np.zeros(3, np.float32)
+    ex.aux_dict["bn_moving_var"][:] = np.ones(3, np.float32)
+    out = ex.forward(is_train=True)[0].asnumpy()
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    want = (x - mean[None, :, None, None]) / \
+        np.sqrt(var + 1e-3)[None, :, None, None] * \
+        g[None, :, None, None] + b[None, :, None, None]
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+    # train-mode pass updates the moving stats (the legacy kernel's
+    # in-place aux contract)
+    np.testing.assert_allclose(ex.aux_dict["bn_moving_mean"].asnumpy(),
+                               0.1 * mean, rtol=1e-4, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# IdentityAttachKLSparseReg
+# ---------------------------------------------------------------------------
+
+def test_identity_attach_kl_sparse_reg():
+    rs = np.random.RandomState(0)
+    x = rs.uniform(0.05, 0.95, (6, 4)).astype(np.float32)
+    data = sym.Variable("data")
+    net = sym.IdentityAttachKLSparseReg(data, sparseness_target=0.2,
+                                        penalty=0.01, momentum=0.9,
+                                        name="klreg")
+    ex = net.simple_bind(ctx=mx.cpu(), grad_req="write", data=x.shape)
+    ex.arg_dict["data"][:] = x
+    mov0 = np.full(4, 0.5, np.float32)
+    ex.aux_dict["klreg_moving_avg"][:] = mov0
+    # one fused fwd+bwd pass (the Module path) so the moving average
+    # updates exactly once
+    out = ex.forward_backward(out_grads=nd.array(np.ones_like(x)),
+                              is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(out, x, rtol=1e-6)  # forward identity
+    avg = x.mean(axis=0)
+    mov_new = 0.9 * mov0 + 0.1 * avg
+    reg = 0.01 * (-0.2 / mov_new + 0.8 / (1 - mov_new))
+    want = np.broadcast_to(1.0 + reg[None, :], x.shape)
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(), want,
+                               rtol=1e-5, atol=1e-6)
+    # aux moving average updated by the train-mode pass
+    np.testing.assert_allclose(ex.aux_dict["klreg_moving_avg"].asnumpy(),
+                               mov_new, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution
+# ---------------------------------------------------------------------------
+
+def test_deformable_conv_zero_offset_equals_conv():
+    rs = np.random.RandomState(0)
+    x = rs.uniform(-1, 1, (2, 3, 7, 7)).astype(np.float32)
+    w = rs.uniform(-0.5, 0.5, (4, 3, 3, 3)).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 5, 5), np.float32)
+    got = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), None, kernel=(3, 3),
+        num_filter=4, no_bias=True).asnumpy()
+    want = nd.Convolution(nd.array(x), nd.array(w), None, kernel=(3, 3),
+                          num_filter=4, no_bias=True).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_fractional_offset_bilinear():
+    # constant 0.5-pixel x-shift on a linear ramp == exact interpolation
+    H = 6
+    ramp = np.tile(np.arange(H, dtype=np.float32), (H, 1))
+    x = ramp[None, None]
+    w = np.ones((1, 1, 1, 1), np.float32)
+    off = np.zeros((1, 2, H, H), np.float32)
+    off[:, 1] = 0.5  # x offset
+    got = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), None, kernel=(1, 1),
+        num_filter=1, no_bias=True).asnumpy()[0, 0]
+    want = np.minimum(ramp + 0.5, H - 1)
+    np.testing.assert_allclose(got[:, :-1], want[:, :-1], rtol=1e-5)
+
+
+def test_deformable_conv_grad():
+    data = sym.Variable("data")
+    offset = sym.Variable("offset")
+    net = sym.contrib.DeformableConvolution(
+        data, offset, kernel=(3, 3), num_filter=2, no_bias=True,
+        name="dconv")
+    rs = np.random.RandomState(0)
+    loc = {"data": rs.uniform(-1, 1, (1, 2, 6, 6)).astype(np.float32),
+           "offset": rs.uniform(-0.3, 0.3, (1, 18, 4, 4)).astype(np.float32),
+           "dconv_weight":
+               rs.uniform(-0.5, 0.5, (2, 2, 3, 3)).astype(np.float32)}
+    check_numeric_gradient(net, loc, grad_nodes=["data", "dconv_weight"],
+                           rtol=1e-2, atol=1e-2)
+
+
+# ---------------------------------------------------------------------------
+# Proposal
+# ---------------------------------------------------------------------------
+
+def _np_nms(dets, thresh, post_n):
+    x1, y1, x2, y2, sc = dets.T
+    areas = (x2 - x1 + 1) * (y2 - y1 + 1)
+    suppressed = np.zeros(len(dets), bool)
+    keep = []
+    for i in range(len(dets)):
+        if suppressed[i] or len(keep) >= post_n:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(x1[i], x1)
+        yy1 = np.maximum(y1[i], y1)
+        xx2 = np.minimum(x2[i], x2)
+        yy2 = np.minimum(y2[i], y2)
+        inter = np.maximum(0, xx2 - xx1 + 1) * np.maximum(0, yy2 - yy1 + 1)
+        iou = inter / (areas[i] + areas - inter)
+        suppressed |= (iou > thresh) & (np.arange(len(dets)) > i)
+    return keep
+
+
+def test_proposal_shapes_and_validity():
+    rs = np.random.RandomState(0)
+    A, Hf, Wf = 6, 4, 4
+    cls_prob = rs.uniform(0, 1, (1, 2 * A, Hf, Wf)).astype(np.float32)
+    bbox = rs.uniform(-0.2, 0.2, (1, 4 * A, Hf, Wf)).astype(np.float32)
+    im_info = np.array([[64.0, 64.0, 1.0]], np.float32)
+    rois = nd.contrib.Proposal(
+        nd.array(cls_prob), nd.array(bbox), nd.array(im_info),
+        rpn_pre_nms_top_n=50, rpn_post_nms_top_n=8, threshold=0.7,
+        rpn_min_size=4, scales=(2.0, 4.0), ratios=(0.5, 1.0, 2.0),
+        feature_stride=16).asnumpy()
+    assert rois.shape == (8, 5)
+    assert (rois[:, 0] == 0).all()          # batch index
+    assert (rois[:, 1] <= rois[:, 3]).all()  # x1 <= x2
+    assert (rois[:, 2] <= rois[:, 4]).all()  # y1 <= y2
+    assert rois[:, 1:].min() >= -4.0         # min_size enlargement bound
+    assert rois[:, [1, 3]].max() <= 64.0 + 4.0
+
+
+def test_proposal_picks_top_scoring_anchor():
+    """Put one overwhelming fg score on a single anchor location; the
+    first roi must be that anchor's (delta-0) box."""
+    A, Hf, Wf = 3, 3, 3
+    cls_prob = np.zeros((1, 2 * A, Hf, Wf), np.float32)
+    cls_prob[0, A:, :, :] = 0.1
+    cls_prob[0, A + 1, 1, 2] = 0.99          # anchor 1 at (h=1, w=2)
+    bbox = np.zeros((1, 4 * A, Hf, Wf), np.float32)
+    im_info = np.array([[48.0, 48.0, 1.0]], np.float32)
+    rois = nd.contrib.Proposal(
+        nd.array(cls_prob), nd.array(bbox), nd.array(im_info),
+        rpn_pre_nms_top_n=20, rpn_post_nms_top_n=4, threshold=0.5,
+        rpn_min_size=1, scales=(1.0,), ratios=(0.5, 1.0, 2.0),
+        feature_stride=16).asnumpy()
+    from mxnet_tpu.ops.contrib_extra import _generate_anchors
+    anchors = _generate_anchors(16, (0.5, 1.0, 2.0), (1.0,))
+    want = anchors[1] + np.array([2 * 16, 1 * 16, 2 * 16, 1 * 16])
+    want = np.clip(want, 0, 47)
+    np.testing.assert_allclose(rois[0, 1:], want, atol=1e-4)

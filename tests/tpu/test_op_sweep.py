@@ -357,6 +357,32 @@ case("GridGenerator", [F((1, 2, 4, 4), -0.2, 0.2)],
 case("BilinearSampler", [F((1, 2, 5, 5)), F((1, 2, 4, 4), -0.9, 0.9)], {},
      rtol=1e-3, atol=1e-3)
 
+# detection / flow / signal / quantization set
+case("Correlation", [F((1, 2, 6, 6)), F((1, 2, 6, 6))],
+     {"kernel_size": 1, "max_displacement": 1, "pad_size": 1}, **CONV_TOL)
+case("_contrib_fft", [F((3, 8))], {})
+case("_contrib_ifft", [F((3, 16))], {})
+case("_contrib_quantize",
+     [F((3, 4)), np.array([-2.0], np.float32), np.array([2.0], np.float32)],
+     {}, grad=False)
+case("_contrib_dequantize",
+     [I((3, 4), 255).astype(np.uint8), np.array([-2.0], np.float32),
+      np.array([2.0], np.float32)], {}, grad=False)
+case("BatchNorm_v1",
+     [F((2, 3, 4, 4)), P((3,)), F((3,)), F((3,)), P((3,))],
+     {"fix_gamma": False}, rtol=1e-3, atol=1e-3)
+case("IdentityAttachKLSparseReg",
+     [F((4, 3), 0.1, 0.9), F((3,), 0.3, 0.7)], {})
+case("_contrib_DeformableConvolution",
+     [F((1, 2, 6, 6)), F((1, 18, 4, 4), -0.3, 0.3), F((2, 2, 3, 3))],
+     {"kernel": (3, 3), "num_filter": 2, "no_bias": True}, **CONV_TOL)
+_pp_cls = np.abs(F((1, 4, 3, 3)))  # 2 anchors (scales x ratios) -> 2*A chans
+case("_contrib_Proposal",
+     [_pp_cls, F((1, 8, 3, 3), -0.2, 0.2),
+      np.array([[48.0, 48.0, 1.0]], np.float32)],
+     {"rpn_pre_nms_top_n": 20, "rpn_post_nms_top_n": 4, "rpn_min_size": 1,
+      "scales": (1.0, 2.0), "ratios": (1.0,)}, grad=False)
+
 # SSD contrib ops
 case("_contrib_MultiBoxPrior", [F((1, 3, 8, 8))],
      {"sizes": (0.5, 0.25), "ratios": (1.0, 2.0)}, grad=False)
