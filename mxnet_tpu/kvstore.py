@@ -40,6 +40,9 @@ class KVStore:
         self._optimizer = None
         self._compression_params = None
         self._compression = None
+        # bytes this process contributed to the last dist push's wire
+        # payload (0 for non-dist stores)
+        self.wire_bytes_last_push = 0
 
     # -- identity ----------------------------------------------------------
     @property
@@ -81,11 +84,18 @@ class KVStore:
         for k, vlist in zip(keys, values):
             if not isinstance(vlist, (list, tuple)):
                 vlist = [vlist]
-            if self._compression is not None:
-                # worker-side quantise each device shard, server-side
-                # dequantise-aggregate (reference kCompressedPushPull)
+            if self._compression is not None and not self.type.startswith("dist"):
+                # single-process stores quantise each device shard
+                # (observable quantisation semantics without a wire); in
+                # dist mode the WIRE carries the packed payload instead —
+                # local device-shard merging stays full precision, like
+                # the reference's Comm-reduce-then-compressed-push
+                # (kvstore_dist.h:357-390)
                 vlist = [self._compress_shard(k, i, v)
                          for i, v in enumerate(vlist)]
+            elif self._compression is not None:
+                for v in vlist:
+                    self._reject_sparse_compression(v)
             from .ndarray import sparse as _sp
             from .ndarray.ndarray import _wrap
             if all(isinstance(v, _sp.RowSparseNDArray) for v in vlist):
@@ -106,7 +116,7 @@ class KVStore:
                     for v in dense[1:]:
                         merged += v
             merged_list.append(merged)
-        merged_list = self._global_reduce_batch(merged_list)
+        merged_list = self._global_reduce_batch(keys, merged_list)
         for k, merged in zip(keys, merged_list):
             if self._updater is not None:
                 if k not in self._store:
@@ -128,39 +138,61 @@ class KVStore:
         devs = [by_proc[i] for i in sorted(by_proc)]
         return Mesh(np.array(devs), ("proc",))
 
-    def _global_reduce_batch(self, merged_list):
+    @staticmethod
+    def _row_bucket(n):
+        """Pad row counts to power-of-two buckets so the exchange program
+        recompiles O(log R) times, not per count."""
+        return max(8, 1 << (max(int(n), 1) - 1).bit_length())
+
+    def _global_reduce_batch(self, keys, merged_list):
         """dist_*: sum every locally-merged value across worker processes
         in ONE jitted XLA computation (parity: the ps-lite server
         aggregating every worker's push, kvstore_dist_server.h:261-312
         sync mode). Each process's contribution stays on device: the
         values are assembled into global arrays sharded over a one-
-        device-per-process mesh and a single compiled program sums them
-        with the collective riding ICI/DCN — no device→host→device round
-        trip, no per-key dispatch (the round-1 host allgather did both).
+        device-per-process mesh and a single compiled program runs with
+        the collective riding ICI/DCN.
+
+        Wire payloads (what actually crosses the link; accumulated in
+        ``self.wire_bytes_last_push`` for observability):
+        - dense, no compression: the fp32 value, summed by the collective;
+        - dense + 2-bit compression: each process sends its PACKED uint32
+          codes (16x smaller) and every process dequantise-sums the
+          gathered payloads — the reference's worker-quantise ->
+          server-dequantise-aggregate (kCompressedPushPull), so the wire
+          shrinks ~16x, not just the math (the round-2 version quantised
+          then shipped uncompressed floats);
+        - row_sparse: only TOUCHED rows travel — (indices, rows) padded to
+          the bucketed global max count, all-gathered, union-reduced;
+          O(nnz-rows) traffic like the reference's kRowSparsePushPull
+          (kvstore_dist.h:430-496), not O(full embedding) (round-2). The
+          result keeps the UNION of rows any worker touched, so a pushed
+          row whose global sum is exactly zero still reaches the
+          optimizer (reference dist-server semantics).
 
         Collective discipline: every worker must push the same keys in
-        the same order (true for SPMD training loops — each process runs
-        the same program). ``dist_async`` is emulated synchronously under
-        the same rule; true per-arrival async application needs a server
-        process, which this all-reduce design intentionally has none of
-        (SURVEY.md §2.3 "Async SGD").
-
-        Row-sparse gradients reduce via their dense view (shapes must
-        match across processes) plus a row-indicator vector, so the
-        result keeps the UNION of rows any worker touched — a pushed row
-        whose global sum is exactly zero still reaches the optimizer
-        (reference dist-server semantics: every pushed row is updated).
+        the same order (true for SPMD training loops). ``dist_async`` is
+        emulated synchronously under the same rule (SURVEY.md §2.3).
         """
+        self.wire_bytes_last_push = 0
         if not self.type.startswith("dist") or not merged_list:
             return merged_list
         import jax
+        from .ndarray import sparse as _sp
+        from .ndarray.ndarray import _wrap
         if jax.process_count() <= 1:
+            if self._compression is not None:
+                # one worker: quantisation semantics still apply (the
+                # reference worker would quantise toward its server)
+                merged_list = [
+                    m if isinstance(m, _sp.BaseSparseNDArray)
+                    else self._compress_shard(k, "dist", m)
+                    for k, m in zip(keys, merged_list)]
             return merged_list
         import jax.numpy as jnp
         import numpy as np
         from jax.sharding import NamedSharding, PartitionSpec as P
-        from .ndarray import sparse as _sp
-        from .ndarray.ndarray import _wrap
+        from jax.experimental import multihost_utils
 
         mesh = self._proc_mesh()
         nproc = mesh.devices.size
@@ -169,24 +201,48 @@ class KVStore:
         shard = NamedSharding(mesh, P("proc"))
         repl = NamedSharding(mesh, P())
 
-        # flatten: dense view per value (+ row indicator for row_sparse)
-        flat = []          # jax arrays to reduce
-        recipe = []        # (kind, ctx, extra) per merged value
-        for m in merged_list:
+        # row_sparse values need a common padded row count: one small
+        # host allgather of the local counts, bucketed
+        rsp_positions = [i for i, m in enumerate(merged_list)
+                         if isinstance(m, _sp.RowSparseNDArray)]
+        pads = {}
+        if rsp_positions:
+            local_counts = np.array(
+                [int(merged_list[i]._rsp_indices.shape[0])
+                 for i in rsp_positions], np.int64)
+            all_counts = multihost_utils.process_allgather(local_counts)
+            for j, i in enumerate(rsp_positions):
+                pads[i] = self._row_bucket(int(all_counts[:, j].max()))
+
+        flat = []          # local payload arrays
+        recipe = []        # one entry per merged value
+        for i, (k, m) in enumerate(zip(keys, merged_list)):
             if isinstance(m, _sp.RowSparseNDArray):
-                dense = m.tostype("default")
-                ind = jnp.zeros((m.shape[0],), jnp.float32)
-                if m._rsp_indices is not None and m._rsp_indices.size:
-                    ind = ind.at[m._rsp_indices].set(1.0)
-                flat.append(dense._data)
-                flat.append(ind)
-                recipe.append(("row_sparse", m.context, None))
+                pcount = pads[i]
+                nloc = int(m._rsp_indices.shape[0])
+                idx = jnp.full((pcount,), -1, jnp.int32)
+                idx = idx.at[:nloc].set(
+                    m._rsp_indices.astype(jnp.int32)) if nloc else idx
+                vals = jnp.zeros((pcount,) + tuple(m.shape[1:]),
+                                 m._rsp_data.dtype)
+                vals = vals.at[:nloc].set(m._rsp_data) if nloc else vals
+                flat.append(idx)
+                flat.append(vals)
+                recipe.append(("row_sparse", m.context, m.shape))
             elif isinstance(m, _sp.BaseSparseNDArray):
                 flat.append(m.tostype("default")._data)
-                recipe.append(("csr", m.context, None))
+                recipe.append(("csr_dense_sum", m.context, None))
+            elif self._compression is not None:
+                packed = self._compression.compress(("dist", k), m._data)
+                flat.append(packed)
+                recipe.append(("compressed", m.context,
+                               (tuple(m.shape), str(m.dtype))))
             else:
                 flat.append(m._data)
-                recipe.append(("dense", m.context, None))
+                recipe.append(("dense_sum", m.context, None))
+
+        self.wire_bytes_last_push = int(sum(a.size * a.dtype.itemsize
+                                            for a in flat))
 
         garrs = []
         for a in flat:
@@ -194,47 +250,76 @@ class KVStore:
             garrs.append(jax.make_array_from_single_device_arrays(
                 (nproc,) + tuple(a.shape), shard, [local[None]]))
 
-        sig = tuple((tuple(a.shape), str(a.dtype)) for a in flat)
+        # one jitted program per (kinds, shapes, dtypes) signature
+        ops = []           # parallel to flat: "sum" | "gather" | (shape,)
+        for kind, _, extra in recipe:
+            if kind == "row_sparse":
+                ops.append("gather")
+                ops.append("gather")
+            elif kind == "compressed":
+                ops.append(("dequant_sum", extra[0]))
+            else:
+                ops.append("sum")
+        thr = self._compression.threshold if self._compression else None
+        sig = (tuple((tuple(a.shape), str(a.dtype)) for a in flat),
+               tuple(str(o) for o in ops), thr)
         cache = getattr(self, "_reduce_cache", None)
         if cache is None:
             cache = self._reduce_cache = {}
         fn = cache.get(sig)
         if fn is None:
-            fn = cache[sig] = jax.jit(
-                lambda ts: [t.sum(axis=0) for t in ts],
-                out_shardings=repl)
+            from .gradient_compression import dequantize_2bit
+
+            def _run(ts, _ops=tuple(ops), _thr=thr):
+                outs = []
+                for t, op in zip(ts, _ops):
+                    if op == "sum":
+                        outs.append(t.sum(axis=0))
+                    elif op == "gather":
+                        outs.append(t)   # replication IS the all-gather
+                    else:
+                        shape = op[1]
+                        deq = jax.vmap(lambda p: dequantize_2bit(
+                            p, shape, _thr))(t)
+                        outs.append(deq.sum(axis=0))
+                return outs
+
+            fn = cache[sig] = jax.jit(_run, out_shardings=repl)
         outs = fn(garrs)
         # replicated outputs: read this process's addressable copy
         outs = [o.addressable_data(0) for o in outs]
 
         result = []
         i = 0
-        for kind, ctx, _ in recipe:
+        for kind, ctx, extra in recipe:
             if kind == "row_sparse":
-                dense, ind = outs[i], outs[i + 1]
+                idx_all = np.asarray(outs[i]).reshape(-1)
+                vals_all = jnp.asarray(outs[i + 1]).reshape(
+                    (-1,) + tuple(extra[1:]))
                 i += 2
-                rows = np.flatnonzero(np.asarray(ind) > 0).astype(np.int64)
-                result.append(self._rows_to_rsp(dense, rows, ctx))
-            elif kind == "csr":
+                valid = idx_all >= 0
+                uniq, inv = np.unique(idx_all[valid], return_inverse=True)
+                if uniq.size:
+                    summed = jax.ops.segment_sum(
+                        vals_all[jnp.asarray(np.flatnonzero(valid))],
+                        jnp.asarray(inv), num_segments=len(uniq))
+                else:
+                    summed = jnp.zeros((0,) + tuple(extra[1:]),
+                                       vals_all.dtype)
+                result.append(_sp.RowSparseNDArray(
+                    summed, jnp.asarray(uniq.astype(np.int64)), extra, ctx))
+            elif kind == "csr_dense_sum":
                 result.append(_sp.cast_storage(
                     _wrap(jnp.asarray(outs[i]), ctx), "csr"))
+                i += 1
+            elif kind == "compressed":
+                result.append(_wrap(
+                    jnp.asarray(outs[i]).astype(extra[1]), ctx))
                 i += 1
             else:
                 result.append(_wrap(jnp.asarray(outs[i]), ctx))
                 i += 1
         return result
-
-    @staticmethod
-    def _rows_to_rsp(dense, rows, ctx):
-        """Build a RowSparseNDArray holding exactly ``rows`` (the cross-
-        worker union), including rows whose summed value is zero."""
-        import jax.numpy as jnp
-        from .ndarray import sparse as _sp
-        dense = jnp.asarray(dense)
-        rows_j = jnp.asarray(rows, jnp.int64)
-        data = jnp.take(dense, rows_j.astype(jnp.int32), axis=0) \
-            if rows_j.size else jnp.zeros((0,) + dense.shape[1:], dense.dtype)
-        return _sp.RowSparseNDArray(data, rows_j, dense.shape, ctx)
 
     def barrier(self):
         """Block until every worker reaches this point (parity:
@@ -308,9 +393,8 @@ class KVStore:
         self._compression_params = compression_params
         self._compression = GradientCompression(type=ctype, **params)
 
-    def _compress_shard(self, key, shard_idx, v):
-        """Round-trip one shard through the 2-bit wire format."""
-        from .ndarray.ndarray import NDArray, _wrap
+    @staticmethod
+    def _reject_sparse_compression(v):
         from .ndarray.sparse import BaseSparseNDArray
         if isinstance(v, BaseSparseNDArray):
             # reference kvstore_dist.h rejects compression for sparse
@@ -319,6 +403,11 @@ class KVStore:
                 "gradient compression is not supported for sparse "
                 "gradients (reference parity); push dense or disable "
                 "set_gradient_compression")
+
+    def _compress_shard(self, key, shard_idx, v):
+        """Round-trip one shard through the 2-bit wire format."""
+        from .ndarray.ndarray import NDArray, _wrap
+        self._reject_sparse_compression(v)
         raw = v._data if isinstance(v, NDArray) else v
         packed = self._compression.compress((key, shard_idx), raw)
         deq = self._compression.decompress(packed, raw.shape, raw.dtype)

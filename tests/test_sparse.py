@@ -175,3 +175,82 @@ def test_sparse_grad_stays_sparse_through_kvstore():
     kv.push(0, [g, g])
     assert isinstance(kv._store[0], sp.RowSparseNDArray)
     np.testing.assert_allclose(kv._store[0].data.asnumpy(), [[2., 2.]])
+
+
+def test_csr_dot_native_vs_numpy():
+    """csr . dense and csr^T . dense run on the compressed representation
+    (reference dot-inl.h sparse kernels); checked against numpy on random
+    matrices with empty rows."""
+    rs = np.random.RandomState(3)
+    dense = rs.uniform(-1, 1, (17, 9)).astype(np.float32)
+    dense[dense < 0.4] = 0          # ~70% sparse
+    dense[5] = 0                    # fully empty row
+    dense[12] = 0
+    csr = mx.nd.sparse.csr_matrix(dense)
+    rhs = rs.uniform(-1, 1, (9, 4)).astype(np.float32)
+    rhs_t = rs.uniform(-1, 1, (17, 4)).astype(np.float32)
+
+    out = mx.nd.sparse.dot(csr, mx.nd.array(rhs))
+    assert out.stype == "default"
+    np.testing.assert_allclose(out.asnumpy(), dense @ rhs, rtol=1e-5,
+                               atol=1e-6)
+    out_t = mx.nd.sparse.dot(csr, mx.nd.array(rhs_t), transpose_a=True)
+    np.testing.assert_allclose(out_t.asnumpy(), dense.T @ rhs_t, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_cast_storage_csr_vectorized_roundtrip():
+    rs = np.random.RandomState(4)
+    dense = rs.uniform(-1, 1, (31, 23)).astype(np.float32)
+    dense[dense < 0.5] = 0
+    dense[0] = 0                     # leading empty row
+    dense[-1] = 0                    # trailing empty row
+    csr = mx.nd.sparse.csr_matrix(dense)
+    # canonical CSR invariants
+    ptr = csr.indptr.asnumpy()
+    assert ptr[0] == 0 and ptr[-1] == csr.data.shape[0]
+    assert (np.diff(ptr) >= 0).all()
+    np.testing.assert_allclose(csr.tostype("default").asnumpy(), dense)
+    # columns sorted within each row (row-major nonzero order)
+    ind = csr.indices.asnumpy()
+    for r in range(31):
+        row = ind[ptr[r]:ptr[r + 1]]
+        assert (np.diff(row) > 0).all() if len(row) > 1 else True
+
+
+def test_retain_device_side():
+    data = np.arange(12, dtype=np.float32).reshape(4, 3)
+    rsp = mx.nd.sparse.row_sparse_array(
+        (data, [1, 3, 5, 8]), shape=(10, 3))
+    kept = rsp.retain(mx.nd.array(np.array([3, 8, 9], np.float32)))
+    np.testing.assert_array_equal(kept.indices.asnumpy(), [3, 8])
+    np.testing.assert_allclose(kept.data.asnumpy(), data[[1, 3]])
+    # dense view agrees
+    want = np.zeros((10, 3), np.float32)
+    want[3] = data[1]
+    want[8] = data[3]
+    np.testing.assert_allclose(kept.tostype("default").asnumpy(), want)
+
+
+def test_csr_dot_empty_matrix():
+    csr = mx.nd.sparse.zeros("csr", (5, 7))
+    rhs = mx.nd.array(np.ones((7, 2), np.float32))
+    out = mx.nd.sparse.dot(csr, rhs)
+    np.testing.assert_allclose(out.asnumpy(), np.zeros((5, 2)))
+
+
+def test_csr_dot_shape_mismatch_raises():
+    csr = mx.nd.sparse.csr_matrix(np.eye(4, 6, dtype=np.float32))
+    with pytest.raises(mx.MXNetError):
+        sp.dot(csr, mx.nd.array(np.ones((5, 2), np.float32)))
+    with pytest.raises(mx.MXNetError):
+        sp.dot(csr, mx.nd.array(np.ones((6, 2), np.float32)),
+               transpose_a=True)
+
+
+def test_csr_dot_vector_rhs_falls_back_dense():
+    dense = np.eye(4, 6, dtype=np.float32) * 2
+    csr = mx.nd.sparse.csr_matrix(dense)
+    v = np.arange(6, dtype=np.float32)
+    out = sp.dot(csr, mx.nd.array(v))
+    np.testing.assert_allclose(out.asnumpy(), dense @ v)
