@@ -1,0 +1,63 @@
+"""Pretrained-weight store for the Gluon model zoo.
+
+Parity: reference ``python/mxnet/gluon/model_zoo/model_store.py``
+(get_model_file/purge). The reference downloads sha1-pinned blobs from
+the Apache repo; this build runs zero-egress, so resolution order is:
+
+1. ``{root}/{name}.params`` (or ``{name}-*.params``, the reference's
+   hash-suffixed naming) on the local filesystem;
+2. ``MXNET_GLUON_REPO`` pointing at a ``file://`` directory laid out the
+   same way (the reference honours the same env var for mirrors);
+3. otherwise a clear error telling the user where to place the file.
+
+Blob format is the reference checkpoint format (``nd.save`` dict with
+``arg:``/``aux:`` prefixes as written by ``Block.save_params``), so
+params exported from the reference load unchanged. Weights are stored in
+the reference's channels-first layouts — load into models built with the
+default (NCHW) layout.
+"""
+from __future__ import annotations
+
+import glob
+import os
+import shutil
+
+from ...base import MXNetError
+
+__all__ = ["get_model_file", "purge"]
+
+
+def _candidates(name, root):
+    out = [os.path.join(root, name + ".params")]
+    out.extend(sorted(glob.glob(os.path.join(root, name + "-*.params"))))
+    return out
+
+
+def get_model_file(name, root="~/.mxnet/models/"):
+    """Return the local path of the pretrained blob for ``name``
+    (parity: model_store.get_model_file)."""
+    root = os.path.expanduser(root)
+    for path in _candidates(name, root):
+        if os.path.exists(path):
+            return path
+    repo = os.environ.get("MXNET_GLUON_REPO", "")
+    if repo.startswith("file://"):
+        src_root = repo[len("file://"):]
+        for src in _candidates(name, src_root):
+            if os.path.exists(src):
+                os.makedirs(root, exist_ok=True)
+                dst = os.path.join(root, os.path.basename(src))
+                shutil.copyfile(src, dst)
+                return dst
+    raise MXNetError(
+        "pretrained weights for %r not found under %r (zero-egress build: "
+        "place the reference-format .params file there, or set "
+        "MXNET_GLUON_REPO=file:///path/to/mirror)" % (name, root))
+
+
+def purge(root="~/.mxnet/models/"):
+    """Remove cached model blobs (parity: model_store.purge)."""
+    root = os.path.expanduser(root)
+    if os.path.isdir(root):
+        for f in glob.glob(os.path.join(root, "*.params")):
+            os.remove(f)

@@ -83,9 +83,15 @@ densenet_spec = {
 
 def _get(num_layers, **kwargs):
     num_init_features, growth_rate, block_config = densenet_spec[num_layers]
-    kwargs.pop("pretrained", None)
-    kwargs.pop("ctx", None)
-    return DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+    pretrained = kwargs.pop("pretrained", False)
+    ctx = kwargs.pop("ctx", None)
+    root = kwargs.pop("root", "~/.mxnet/models")
+    net = DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+    if pretrained:
+        from ..model_store import get_model_file
+        net.load_params(get_model_file("densenet%d" % num_layers,
+                                       root=root), ctx=ctx)
+    return net
 
 
 def densenet121(**kwargs):

@@ -162,5 +162,10 @@ class Inception3(HybridBlock):
         return self.output(x)
 
 
-def inception_v3(pretrained=False, ctx=None, **kwargs):
-    return Inception3(**kwargs)
+def inception_v3(pretrained=False, ctx=None, root="~/.mxnet/models",
+                 **kwargs):
+    net = Inception3(**kwargs)
+    if pretrained:
+        from ..model_store import get_model_file
+        net.load_params(get_model_file("inceptionv3", root=root), ctx=ctx)
+    return net

@@ -48,9 +48,15 @@ class MobileNet(HybridBlock):
 
 
 def _get(multiplier, **kwargs):
-    kwargs.pop("pretrained", None)
-    kwargs.pop("ctx", None)
-    return MobileNet(multiplier, **kwargs)
+    pretrained = kwargs.pop("pretrained", False)
+    ctx = kwargs.pop("ctx", None)
+    root = kwargs.pop("root", "~/.mxnet/models")
+    net = MobileNet(multiplier, **kwargs)
+    if pretrained:
+        from ..model_store import get_model_file
+        net.load_params(get_model_file("mobilenet%s" % multiplier,
+                                       root=root), ctx=ctx)
+    return net
 
 
 def mobilenet1_0(**kwargs):

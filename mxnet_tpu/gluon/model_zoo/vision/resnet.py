@@ -232,9 +232,10 @@ resnet_block_versions = [
 ]
 
 
-def get_resnet(version, num_layers, pretrained=False, ctx=None, **kwargs):
-    """(parity: model_zoo get_resnet; pretrained weights must be local —
-    zero-egress)"""
+def get_resnet(version, num_layers, pretrained=False, ctx=None,
+               root="~/.mxnet/models", **kwargs):
+    """(parity: model_zoo get_resnet; pretrained blobs resolve via
+    model_store.get_model_file — local path or file:// mirror)"""
     if num_layers not in resnet_spec:
         raise MXNetError("invalid resnet depth %d" % num_layers)
     block_type, layers, channels = resnet_spec[num_layers]
@@ -244,8 +245,9 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, **kwargs):
     block_class = resnet_block_versions[version - 1][block_type]
     net = resnet_class(block_class, layers, channels, **kwargs)
     if pretrained:
-        raise MXNetError("pretrained weights unavailable in zero-egress "
-                         "build; use load_params on a local file")
+        from ..model_store import get_model_file
+        net.load_params(get_model_file(
+            "resnet%d_v%d" % (num_layers, version), root=root), ctx=ctx)
     return net
 
 

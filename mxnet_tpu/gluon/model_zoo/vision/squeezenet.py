@@ -78,9 +78,21 @@ class SqueezeNet(HybridBlock):
         return self.output(x)
 
 
+def _get(version, **kwargs):
+    pretrained = kwargs.pop("pretrained", False)
+    ctx = kwargs.pop("ctx", None)
+    root = kwargs.pop("root", "~/.mxnet/models")
+    net = SqueezeNet(version, **kwargs)
+    if pretrained:
+        from ..model_store import get_model_file
+        net.load_params(get_model_file("squeezenet%s" % version, root=root),
+                        ctx=ctx)
+    return net
+
+
 def squeezenet1_0(**kwargs):
-    return SqueezeNet("1.0", **kwargs)
+    return _get("1.0", **kwargs)
 
 
 def squeezenet1_1(**kwargs):
-    return SqueezeNet("1.1", **kwargs)
+    return _get("1.1", **kwargs)

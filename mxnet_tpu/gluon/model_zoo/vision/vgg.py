@@ -46,11 +46,19 @@ class VGG(HybridBlock):
         return self.output(x)
 
 
-def get_vgg(num_layers, pretrained=False, ctx=None, **kwargs):
+def get_vgg(num_layers, pretrained=False, ctx=None,
+            root="~/.mxnet/models", **kwargs):
     if num_layers not in vgg_spec:
         raise MXNetError("invalid vgg depth %d" % num_layers)
     layers, filters = vgg_spec[num_layers]
-    return VGG(layers, filters, **kwargs)
+    net = VGG(layers, filters, **kwargs)
+    if pretrained:
+        from ..model_store import get_model_file
+        batch_norm = kwargs.get("batch_norm", False)
+        net.load_params(get_model_file(
+            "vgg%d%s" % (num_layers, "_bn" if batch_norm else ""),
+            root=root), ctx=ctx)
+    return net
 
 
 def vgg11(**kwargs):

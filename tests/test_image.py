@@ -87,3 +87,40 @@ def test_imageiter_from_list(tmp_path):
     batch = next(iter([b for b in [next(it)]]))
     assert batch.data[0].shape == (4, 3, 24, 24)
     assert batch.label[0].shape == (4,)
+
+
+def test_imageiter_threaded_decode_matches_serial(tmp_path):
+    """preprocess_threads decode+augment (parity: the reference's
+    multithreaded iter_image_recordio_2 pipeline) must produce the same
+    batches as inline decode for deterministic augmenters."""
+    import io as _io
+    from PIL import Image
+    from mxnet_tpu import recordio
+    from mxnet_tpu.image import ImageIter
+
+    rs = np.random.RandomState(0)
+    rec_path = str(tmp_path / "t.rec")
+    rec = recordio.MXRecordIO(rec_path, "w")
+    for i in range(8):
+        arr = rs.randint(0, 255, (40, 40, 3), np.uint8)
+        buf = _io.BytesIO()
+        Image.fromarray(arr).save(buf, format="PNG")  # lossless
+        rec.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                                buf.getvalue()))
+    rec.close()
+
+    def collect(threads):
+        it = ImageIter(batch_size=4, data_shape=(3, 32, 32),
+                       path_imgrec=rec_path, preprocess_threads=threads)
+        out = []
+        for b in it:
+            out.append((b.data[0].asnumpy().copy(),
+                        b.label[0].asnumpy().copy()))
+        return out
+
+    serial = collect(0)
+    threaded = collect(3)
+    assert len(serial) == len(threaded) == 2
+    for (d0, l0), (d1, l1) in zip(serial, threaded):
+        np.testing.assert_allclose(d0, d1)
+        np.testing.assert_allclose(l0, l1)

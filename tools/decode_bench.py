@@ -1,0 +1,83 @@
+"""JPEG decode+augment throughput probe.
+
+Parity: the reference measures its input pipeline via
+iter_image_recordio_2's multithreaded decode (src/io/
+iter_image_recordio_2.cc:660-760); this probe packs synthetic JPEGs into
+RecordIO and measures ImageIter decode img/s at a given thread count, so
+a deployment can check the pipeline feeds the accelerator (compare
+against bench.py's img/s).
+
+Usage: python tools/decode_bench.py [--threads N] [--images M]
+                                    [--size HxW] [--batch B]
+Prints one JSON line: {"metric": "jpeg_decode_throughput", ...}
+"""
+import argparse
+import io as _io
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# host-side probe: never touch the accelerator (axon init can hang when
+# the tunnel is down, and decode throughput is a CPU property anyway)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--threads", type=int, default=os.cpu_count() or 1)
+    ap.add_argument("--images", type=int, default=256)
+    ap.add_argument("--size", default="224x224")
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--epochs", type=int, default=2)
+    args = ap.parse_args()
+    h, w = (int(x) for x in args.size.split("x"))
+
+    from PIL import Image
+    from mxnet_tpu import recordio
+    from mxnet_tpu.image import ImageIter
+
+    rs = np.random.RandomState(0)
+    with tempfile.TemporaryDirectory() as td:
+        rec_path = os.path.join(td, "probe.rec")
+        rec = recordio.MXRecordIO(rec_path, "w")
+        for i in range(args.images):
+            arr = rs.randint(0, 255, (h, w, 3), np.uint8)
+            buf = _io.BytesIO()
+            Image.fromarray(arr).save(buf, format="JPEG", quality=90)
+            rec.write(recordio.pack(
+                recordio.IRHeader(0, float(i % 10), i, 0), buf.getvalue()))
+        rec.close()
+
+        it = ImageIter(batch_size=args.batch, data_shape=(3, h, w),
+                       path_imgrec=rec_path,
+                       preprocess_threads=args.threads)
+        # warm epoch (thread pool spin-up, page cache)
+        for _ in it:
+            pass
+        n = 0
+        t0 = time.perf_counter()
+        for _ in range(args.epochs):
+            it.reset()
+            for batch in it:
+                n += batch.data[0].shape[0]
+        dt = time.perf_counter() - t0
+        print(json.dumps({
+            "metric": "jpeg_decode_throughput",
+            "value": round(n / dt, 1),
+            "unit": "img/s",
+            "threads": args.threads,
+            "image_size": "%dx%d" % (h, w),
+            "batch": args.batch,
+        }))
+
+
+if __name__ == "__main__":
+    main()
