@@ -1,0 +1,60 @@
+"""URI-aware stream opening for save/load and RecordIO.
+
+Parity: the reference's dmlc ``Stream::Create`` which dispatches on URI
+scheme (local, ``s3://``, ``hdfs://`` — SURVEY.md §2.1 dmlc-core). The
+TPU build is zero-egress, so remote schemes are a REGISTRY: ``file://``
+and plain paths work out of the box; a deployment registers openers for
+its object store (e.g. wrapping fsspec/gcsfs) with
+:func:`register_scheme`, and every save/load/RecordIO call site goes
+through :func:`open_uri` — the same one-dispatch-point design as
+dmlc Stream.
+
+    from mxnet_tpu import filesystem
+    filesystem.register_scheme("s3", lambda uri, mode: s3fs.open(uri, mode))
+    mx.nd.save("s3://bucket/weights.params", arrs)
+"""
+from __future__ import annotations
+
+import os
+
+from .base import MXNetError
+
+__all__ = ["open_uri", "register_scheme", "scheme_of"]
+
+_OPENERS = {}
+
+
+def scheme_of(uri):
+    """Return the URI scheme, or "" for a plain local path. Windows drive
+    letters ("C:\\...") and schemeless paths both map to ""."""
+    if not isinstance(uri, (str, os.PathLike)):
+        return ""
+    s = os.fspath(uri)
+    head, sep, _ = s.partition("://")
+    if not sep or len(head) <= 1:
+        return ""
+    return head.lower()
+
+
+def register_scheme(scheme, opener):
+    """Register ``opener(uri, mode) -> file object`` for a URI scheme
+    (parity: dmlc FileSystem registry)."""
+    if not scheme or "://" in scheme:
+        raise MXNetError("scheme must be a bare name like 's3'")
+    _OPENERS[scheme.lower()] = opener
+
+
+def open_uri(uri, mode="rb"):
+    """Open a local path, file:// URI, or any registered scheme."""
+    scheme = scheme_of(uri)
+    uri = os.fspath(uri)
+    if scheme in ("", "file"):
+        path = uri[len("file://"):] if scheme == "file" else uri
+        return open(path, mode)
+    opener = _OPENERS.get(scheme)
+    if opener is None:
+        raise MXNetError(
+            "no stream handler for %r URIs (zero-egress build): register "
+            "one with mxnet_tpu.filesystem.register_scheme(%r, opener)"
+            % (scheme, scheme))
+    return opener(uri, mode)

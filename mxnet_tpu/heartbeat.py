@@ -1,0 +1,83 @@
+"""Worker liveness heartbeats.
+
+Parity: the reference's only failure-visibility surface is
+``KVStore::get_num_dead_node(node_id, timeout)`` backed by ps-lite
+scheduler heartbeats (include/mxnet/kvstore.h:338, SURVEY.md §5.3). The
+SPMD design has no scheduler process, so liveness rides a shared
+filesystem: each worker's daemon thread touches
+``{MXTPU_HEARTBEAT_DIR}/worker-{rank}`` every ``interval`` seconds and
+any process can count peers whose file is stale. ``tools/launch.py``
+provisions the directory for local/ssh jobs (a pod slice shares NFS/GCS
+fuse mounts the same way).
+
+Like the reference, this is VISIBILITY only — a dead worker still hangs
+collectives; recovery is checkpoint-restart (SURVEY.md §5.3/5.4).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+__all__ = ["start_heartbeat", "stop_heartbeat", "count_dead"]
+
+ENV_DIR = "MXTPU_HEARTBEAT_DIR"
+DEFAULT_INTERVAL = 1.0
+
+_state = {"thread": None, "stop": None}
+
+
+def _path(root, rank):
+    return os.path.join(root, "worker-%d" % int(rank))
+
+
+def start_heartbeat(rank, root=None, interval=DEFAULT_INTERVAL):
+    """Start (idempotently) the daemon heartbeat for this process."""
+    root = root or os.environ.get(ENV_DIR)
+    if not root or _state["thread"] is not None:
+        return
+    os.makedirs(root, exist_ok=True)
+    path = _path(root, rank)
+    stop = threading.Event()
+
+    def beat():
+        while not stop.is_set():
+            try:
+                with open(path, "w") as f:
+                    f.write(str(time.time()))
+            except OSError:
+                pass
+            stop.wait(interval)
+
+    t = threading.Thread(target=beat, daemon=True,
+                         name="mxtpu-heartbeat-%d" % int(rank))
+    t.start()
+    _state["thread"] = t
+    _state["stop"] = stop
+
+
+def stop_heartbeat():
+    if _state["stop"] is not None:
+        _state["stop"].set()
+        _state["thread"] = None
+        _state["stop"] = None
+
+
+def count_dead(num_workers, root=None, timeout=None):
+    """Number of workers whose heartbeat is missing or older than
+    ``timeout`` seconds (parity: get_num_dead_node)."""
+    root = root or os.environ.get(ENV_DIR)
+    if not root:
+        return 0
+    timeout = float(timeout if timeout is not None
+                    else os.environ.get("MXTPU_HEARTBEAT_TIMEOUT", 10.0))
+    now = time.time()
+    dead = 0
+    for rank in range(int(num_workers)):
+        path = _path(root, rank)
+        try:
+            if now - os.path.getmtime(path) > timeout:
+                dead += 1
+        except OSError:
+            dead += 1
+    return dead

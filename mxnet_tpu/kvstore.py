@@ -43,6 +43,11 @@ class KVStore:
         # bytes this process contributed to the last dist push's wire
         # payload (0 for non-dist stores)
         self.wire_bytes_last_push = 0
+        if kv_type.startswith("dist"):
+            # liveness surface (parity: ps-lite scheduler heartbeats
+            # behind get_num_dead_node, kvstore.h:338)
+            from . import heartbeat
+            heartbeat.start_heartbeat(self.rank)
 
     # -- identity ----------------------------------------------------------
     @property
@@ -412,6 +417,17 @@ class KVStore:
         packed = self._compression.compress((key, shard_idx), raw)
         deq = self._compression.decompress(packed, raw.shape, raw.dtype)
         return _wrap(deq) if isinstance(v, NDArray) else deq
+
+    def num_dead_node(self, node_id=0, timeout=None):
+        """Count workers with stale/missing heartbeats (parity:
+        KVStore::get_num_dead_node, kvstore.h:338 — visibility only; a
+        dead peer still hangs collectives, recovery is
+        checkpoint-restart). node_id is accepted for API parity; the
+        heartbeat dir covers all workers."""
+        if not self.type.startswith("dist"):
+            return 0
+        from . import heartbeat
+        return heartbeat.count_dead(self.num_workers, timeout=timeout)
 
     # -- sync / lifecycle --------------------------------------------------
     def send_command_to_servers(self, head, body):

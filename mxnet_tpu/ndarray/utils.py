@@ -14,6 +14,7 @@ import zipfile
 import numpy as np
 
 from ..base import MXNetError
+from ..filesystem import open_uri, scheme_of
 from .ndarray import NDArray, array
 
 _LIST_PREFIX = "__mx_list__:"
@@ -36,19 +37,27 @@ def save(fname, data):
             arrays[_LIST_PREFIX + str(i)] = v.asnumpy()
     else:
         raise MXNetError("save: data must be NDArray, list, or dict")
-    np.savez(fname if fname.endswith(".npz") else fname, **arrays)
-    # np.savez appends .npz; rename back for exact-path semantics
-    if not fname.endswith(".npz") and os.path.exists(fname + ".npz"):
-        os.replace(fname + ".npz", fname)
+    # URI-aware stream (parity: dmlc Stream::Create — the reference
+    # saves through S3/HDFS-capable streams, ndarray/utils.py:149-185)
+    with open_uri(fname, "wb") as f:
+        np.savez(f, **arrays)
 
 
 def load(fname):
     """Load NDArrays saved by :func:`save` (parity: mx.nd.load)."""
-    if not os.path.exists(fname):
+    import io as _io
+    try:
+        f = open_uri(fname, "rb")
+    except FileNotFoundError:
         raise MXNetError("load: no such file %r" % fname)
-    with np.load(fname, allow_pickle=False) as npz:
-        keys = list(npz.keys())
-        if keys and all(k.startswith(_LIST_PREFIX) for k in keys):
-            items = sorted(keys, key=lambda k: int(k[len(_LIST_PREFIX):]))
-            return [array(npz[k]) for k in items]
-        return {k: array(npz[k]) for k in keys}
+    with f:
+        # seekable handles (local files) stream straight into np.load;
+        # only non-seekable registered-scheme streams get buffered
+        src = f if f.seekable() else _io.BytesIO(f.read())
+        with np.load(src, allow_pickle=False) as npz:
+            keys = list(npz.keys())
+            if keys and all(k.startswith(_LIST_PREFIX) for k in keys):
+                items = sorted(keys,
+                               key=lambda k: int(k[len(_LIST_PREFIX):]))
+                return [array(npz[k]) for k in items]
+            return {k: array(npz[k]) for k in keys}

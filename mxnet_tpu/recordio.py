@@ -40,11 +40,12 @@ class MXRecordIO:
         self.open()
 
     def open(self):
+        from .filesystem import open_uri
         if self.flag == "w":
-            self.handle = open(self.uri, "wb")
+            self.handle = open_uri(self.uri, "wb")
             self.writable = True
         elif self.flag == "r":
-            self.handle = open(self.uri, "rb")
+            self.handle = open_uri(self.uri, "rb")
             self.writable = False
         else:
             raise MXNetError("invalid flag %r" % self.flag)
@@ -126,21 +127,27 @@ class MXIndexedRecordIO(MXRecordIO):
         super().__init__(uri, flag)
 
     def open(self):
+        from .filesystem import open_uri
         super().open()
         self.idx = {}
         self.keys = []
-        if self.flag == "r" and os.path.exists(self.idx_path):
-            with open(self.idx_path) as f:
-                for line in f:
-                    parts = line.strip().split("\t")
-                    if len(parts) != 2:
-                        continue
-                    key = self.key_type(parts[0])
-                    self.idx[key] = int(parts[1])
-                    self.keys.append(key)
+        if self.flag == "r":
+            try:
+                f = open_uri(self.idx_path, "r")
+            except FileNotFoundError:
+                f = None
+            if f is not None:
+                with f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        if len(parts) != 2:
+                            continue
+                        key = self.key_type(parts[0])
+                        self.idx[key] = int(parts[1])
+                        self.keys.append(key)
             self.fidx = None
         elif self.flag == "w":
-            self.fidx = open(self.idx_path, "w")
+            self.fidx = open_uri(self.idx_path, "w")
 
     def close(self):
         if self.fidx is not None:

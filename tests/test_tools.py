@@ -235,3 +235,33 @@ def test_launch_dist_wire_compression_and_sparse_payload(tmp_path):
               sys.executable, str(script)])
     assert p.returncode == 0, p.stderr + p.stdout
     assert p.stdout.count("WIRE OK rank") == 2
+
+
+def test_launch_dead_node_visibility(tmp_path):
+    """A worker that dies is visible to survivors via num_dead_node
+    (parity: reference get_num_dead_node over scheduler heartbeats,
+    include/mxnet/kvstore.h:338)."""
+    script = tmp_path / "dead_kv.py"
+    script.write_text(
+        "import sys, time, os; sys.path.insert(0, %r)\n" % REPO +
+        "import mxnet_tpu as mx\n"
+        "os.environ['MXTPU_HEARTBEAT_TIMEOUT'] = '2'\n"
+        "kv = mx.kv.create('dist_sync')\n"
+        "kv.barrier()\n"
+        "assert kv.num_dead_node() == 0, kv.num_dead_node()\n"
+        "if kv.rank == 1:\n"
+        "    from mxnet_tpu import heartbeat\n"
+        "    heartbeat.stop_heartbeat()\n"
+        "    print('DEAD OK rank 1')\n"
+        "    os._exit(0)   # worker dies (cleanly, to keep exit code 0)\n"
+        "deadline = time.time() + 20\n"
+        "while time.time() < deadline and kv.num_dead_node() == 0:\n"
+        "    time.sleep(0.5)\n"
+        "assert kv.num_dead_node() == 1, kv.num_dead_node()\n"
+        "print('DEAD OK rank 0', flush=True)\n"
+        "os._exit(0)  # skip jax's shutdown barrier (peer already gone)\n")
+    p = _run([os.path.join(TOOLS, "launch.py"), "-n", "2",
+              "--force-cpu", "--port", "9419",
+              sys.executable, str(script)])
+    assert p.returncode == 0, p.stderr + p.stdout
+    assert p.stdout.count("DEAD OK") == 2

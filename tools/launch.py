@@ -18,9 +18,11 @@ the parameter server.
 import argparse
 import os
 import shlex
+import shutil
 import signal
 import subprocess
 import sys
+import tempfile
 
 
 def build_env(rank, args):
@@ -32,6 +34,9 @@ def build_env(rank, args):
         "MXNET_TPU_COORDINATOR": "%s:%d" % (args.host, args.port),
         "MXNET_TPU_NUM_PROCESSES": str(args.num_workers),
         "MXNET_TPU_PROCESS_ID": str(rank),
+        # liveness surface (mxnet_tpu/heartbeat.py; reference
+        # get_num_dead_node via scheduler heartbeats, kvstore.h:338)
+        "MXTPU_HEARTBEAT_DIR": args.heartbeat_dir,
     })
     if args.force_cpu:
         env["MXNET_TPU_FORCE_CPU"] = "1"
@@ -68,7 +73,8 @@ def launch_ssh(args, command):
         env = build_env(rank, args)
         exports = " ".join("%s=%s" % (k, shlex.quote(v))
                            for k, v in env.items()
-                           if k.startswith(("DMLC_", "MXNET_TPU_", "XLA_")))
+                           if k.startswith(("DMLC_", "MXNET_TPU_",
+                                            "MXTPU_", "XLA_")))
         dst = shlex.quote(args.sync_dst_dir) if args.sync_dst_dir else "~"
         remote = "cd %s && env %s %s" % (
             dst, exports, " ".join(shlex.quote(c) for c in command))
@@ -98,6 +104,9 @@ def main():
     parser.add_argument("--sync-dst-dir", type=str, default=None)
     parser.add_argument("--force-cpu", action="store_true",
                         help="run workers on virtual CPU devices (testing)")
+    parser.add_argument("--heartbeat-dir", type=str, default=None,
+                        help="shared dir for worker liveness heartbeats "
+                             "(default: a per-port tempdir, wiped at launch)")
     parser.add_argument("--devices-per-worker", type=int, default=1)
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()
@@ -109,6 +118,15 @@ def main():
               "over the device mesh, no parameter-server processes exist")
     if args.launcher == "ssh" and not args.hostfile:
         parser.error("ssh launcher needs -H hostfile")
+
+    if args.heartbeat_dir is None:
+        args.heartbeat_dir = os.path.join(tempfile.gettempdir(),
+                                          "mxtpu-hb-%d" % args.port)
+    # stale worker-* files from a previous job on this port would read as
+    # dead nodes — start each job from a clean directory
+    if os.path.isdir(args.heartbeat_dir):
+        shutil.rmtree(args.heartbeat_dir, ignore_errors=True)
+    os.makedirs(args.heartbeat_dir, exist_ok=True)
 
     launch = launch_local if args.launcher == "local" else launch_ssh
     sys.exit(launch(args, args.command))
