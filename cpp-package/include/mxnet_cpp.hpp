@@ -1,0 +1,285 @@
+// Header-only C++ training/inference API over the general C ABI.
+//
+// Parity: reference cpp-package/include/mxnet-cpp/*.hpp — RAII wrappers
+// (NDArray/Symbol/Executor/Op) over the flat C API so C++ programs train
+// on the same executor path as Python. The reference generated op
+// wrappers from the registry; here Op::Invoke dispatches by registry
+// name (MXListAllOpNames enumerates them), which keeps this header small
+// and always in sync with the registry.
+//
+// Link against mxnet_tpu/_lib/libmxtpu_c_api.so (see tests/test_cpp_package.py
+// for a full compile-and-train example).
+#ifndef MXNET_CPP_HPP_
+#define MXNET_CPP_HPP_
+
+#include <cstddef>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+extern "C" {
+typedef unsigned int mx_uint;
+typedef void* NDArrayHandle;
+typedef void* SymbolHandle;
+typedef void* ExecutorHandle;
+typedef void* AtomicSymbolCreator;
+const char* MXGetLastError();
+int MXNDArrayCreateEx(const mx_uint*, mx_uint, int, int, int, int,
+                      NDArrayHandle*);
+int MXNDArrayFree(NDArrayHandle);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle, const void*, size_t);
+int MXNDArraySyncCopyToCPU(NDArrayHandle, void*, size_t);
+int MXNDArrayGetShape(NDArrayHandle, mx_uint*, const mx_uint**);
+int MXNDArrayWaitAll();
+int MXListAllOpNames(mx_uint*, const char***);
+int NNGetOpHandle(const char*, AtomicSymbolCreator*);
+int MXImperativeInvoke(AtomicSymbolCreator, int, NDArrayHandle*, int*,
+                       NDArrayHandle**, int, const char**, const char**);
+int MXSymbolCreateFromFile(const char*, SymbolHandle*);
+int MXSymbolCreateFromJSON(const char*, SymbolHandle*);
+int MXSymbolFree(SymbolHandle);
+int MXSymbolListArguments(SymbolHandle, mx_uint*, const char***);
+int MXSymbolListOutputs(SymbolHandle, mx_uint*, const char***);
+int MXSymbolListAuxiliaryStates(SymbolHandle, mx_uint*, const char***);
+int MXSymbolInferShape(SymbolHandle, mx_uint, const char**, const mx_uint*,
+                       const mx_uint*, mx_uint*, const mx_uint**,
+                       const mx_uint***, mx_uint*, const mx_uint**,
+                       const mx_uint***, mx_uint*, const mx_uint**,
+                       const mx_uint***, int*);
+int MXExecutorBind(SymbolHandle, int, int, mx_uint, NDArrayHandle*,
+                   NDArrayHandle*, mx_uint*, mx_uint, NDArrayHandle*,
+                   ExecutorHandle*);
+int MXExecutorForward(ExecutorHandle, int);
+int MXExecutorBackward(ExecutorHandle, mx_uint, NDArrayHandle*);
+int MXExecutorOutputs(ExecutorHandle, mx_uint*, NDArrayHandle**);
+int MXExecutorFree(ExecutorHandle);
+}
+
+namespace mxnet {
+namespace cpp {
+
+inline void Check(int rc, const char* what) {
+  if (rc != 0) {
+    throw std::runtime_error(std::string(what) + ": " + MXGetLastError());
+  }
+}
+
+struct Context {
+  int dev_type;
+  int dev_id;
+  static Context cpu(int id = 0) { return {1, id}; }
+  static Context gpu(int id = 0) { return {2, id}; }  // maps to the TPU
+  static Context tpu(int id = 0) { return {2, id}; }
+};
+
+class NDArray {
+ public:
+  NDArray() = default;
+  NDArray(const std::vector<mx_uint>& shape, const Context& ctx,
+          int dtype = 0) {
+    NDArrayHandle h = nullptr;
+    Check(MXNDArrayCreateEx(shape.data(),
+                            static_cast<mx_uint>(shape.size()),
+                            ctx.dev_type, ctx.dev_id, 0, dtype, &h),
+          "NDArrayCreate");
+    reset(h);
+  }
+  explicit NDArray(NDArrayHandle h) { reset(h); }
+
+  NDArrayHandle handle() const { return h_ ? h_->ptr : nullptr; }
+
+  void SyncCopyFromCPU(const float* data, size_t size) {
+    Check(MXNDArraySyncCopyFromCPU(handle(), data, size), "CopyFromCPU");
+  }
+  void SyncCopyToCPU(float* data, size_t size) const {
+    Check(MXNDArraySyncCopyToCPU(handle(), data, size), "CopyToCPU");
+  }
+  std::vector<mx_uint> Shape() const {
+    mx_uint ndim = 0;
+    const mx_uint* pdata = nullptr;
+    Check(MXNDArrayGetShape(handle(), &ndim, &pdata), "GetShape");
+    return std::vector<mx_uint>(pdata, pdata + ndim);
+  }
+  size_t Size() const {
+    size_t n = 1;
+    for (auto s : Shape()) n *= s;
+    return n;
+  }
+  static void WaitAll() { Check(MXNDArrayWaitAll(), "WaitAll"); }
+
+ private:
+  struct Owner {
+    NDArrayHandle ptr;
+    explicit Owner(NDArrayHandle p) : ptr(p) {}
+    Owner(const Owner&) = delete;
+    Owner& operator=(const Owner&) = delete;
+    ~Owner() { MXNDArrayFree(ptr); }
+  };
+  std::shared_ptr<Owner> h_;
+  // construct in place: a temporary Owner would free the handle in its
+  // destructor the moment it is copied from
+  void reset(NDArrayHandle h) { h_ = std::make_shared<Owner>(h); }
+};
+
+class Op {
+ public:
+  explicit Op(const std::string& name) {
+    Check(NNGetOpHandle(name.c_str(), &op_), ("op " + name).c_str());
+  }
+  // Reference cpp-package Operator::Invoke contract: a non-empty
+  // *outputs is the in-place form (e.g. sgd_update writing the weight);
+  // an empty *outputs lets the op allocate, and the returned handles are
+  // adopted into the caller's vector.
+  void Invoke(std::vector<NDArray> inputs, std::vector<NDArray>* outputs,
+              const std::map<std::string, std::string>& params = {}) const {
+    std::vector<NDArrayHandle> in;
+    for (auto& a : inputs) in.push_back(a.handle());
+    std::vector<NDArrayHandle> out;
+    for (auto& a : *outputs) out.push_back(a.handle());
+    std::vector<const char*> keys, vals;
+    for (auto& kv : params) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    int n_out = static_cast<int>(out.size());
+    NDArrayHandle* out_ptr = out.empty() ? nullptr : out.data();
+    Check(MXImperativeInvoke(op_, static_cast<int>(in.size()), in.data(),
+                             &n_out, &out_ptr,
+                             static_cast<int>(keys.size()), keys.data(),
+                             vals.data()),
+          "ImperativeInvoke");
+    if (outputs->empty()) {   // allocate mode: adopt the new handles
+      for (int i = 0; i < n_out; ++i) outputs->emplace_back(out_ptr[i]);
+    }
+  }
+
+ private:
+  AtomicSymbolCreator op_ = nullptr;
+};
+
+class Symbol {
+ public:
+  static Symbol Load(const std::string& path) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateFromFile(path.c_str(), &h), "SymbolLoad");
+    return Symbol(h);
+  }
+  static Symbol LoadJSON(const std::string& json) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateFromJSON(json.c_str(), &h), "SymbolLoadJSON");
+    return Symbol(h);
+  }
+  SymbolHandle handle() const { return h_ ? h_->ptr : nullptr; }
+
+  std::vector<std::string> ListArguments() const {
+    return StrList(&MXSymbolListArguments);
+  }
+  std::vector<std::string> ListOutputs() const {
+    return StrList(&MXSymbolListOutputs);
+  }
+  std::vector<std::string> ListAuxiliaryStates() const {
+    return StrList(&MXSymbolListAuxiliaryStates);
+  }
+
+  // known: name -> shape; returns arg shapes in ListArguments() order
+  std::vector<std::vector<mx_uint>> InferArgShapes(
+      const std::map<std::string, std::vector<mx_uint>>& known) const {
+    std::vector<const char*> keys;
+    std::vector<mx_uint> indptr{0};
+    std::vector<mx_uint> data;
+    for (auto& kv : known) {
+      keys.push_back(kv.first.c_str());
+      for (auto v : kv.second) data.push_back(v);
+      indptr.push_back(static_cast<mx_uint>(data.size()));
+    }
+    mx_uint in_n, out_n, aux_n;
+    const mx_uint *in_nd, *out_nd, *aux_nd;
+    const mx_uint **in_sh, **out_sh, **aux_sh;
+    int complete = 0;
+    Check(MXSymbolInferShape(handle(),
+                             static_cast<mx_uint>(keys.size()), keys.data(),
+                             indptr.data(), data.data(), &in_n, &in_nd,
+                             &in_sh, &out_n, &out_nd, &out_sh, &aux_n,
+                             &aux_nd, &aux_sh, &complete),
+          "InferShape");
+    if (!complete) throw std::runtime_error("InferShape incomplete");
+    std::vector<std::vector<mx_uint>> shapes(in_n);
+    for (mx_uint i = 0; i < in_n; ++i)
+      shapes[i].assign(in_sh[i], in_sh[i] + in_nd[i]);
+    return shapes;
+  }
+
+ private:
+  explicit Symbol(SymbolHandle h) : h_(std::make_shared<Owner>(h)) {}
+  struct Owner {
+    SymbolHandle ptr;
+    explicit Owner(SymbolHandle p) : ptr(p) {}
+    Owner(const Owner&) = delete;
+    Owner& operator=(const Owner&) = delete;
+    ~Owner() { MXSymbolFree(ptr); }
+  };
+  std::shared_ptr<Owner> h_;
+
+  template <typename Fn>
+  std::vector<std::string> StrList(Fn fn) const {
+    mx_uint n = 0;
+    const char** arr = nullptr;
+    Check(fn(handle(), &n, &arr), "SymbolList");
+    std::vector<std::string> out;
+    for (mx_uint i = 0; i < n; ++i) out.emplace_back(arr[i]);
+    return out;
+  }
+};
+
+enum OpReqType { kNullOp = 0, kWriteTo = 1 };
+
+class Executor {
+ public:
+  Executor(const Symbol& sym, const Context& ctx,
+           const std::vector<NDArray>& args,
+           const std::vector<NDArray>& arg_grads,   // empty handle = null
+           const std::vector<mx_uint>& grad_reqs,
+           const std::vector<NDArray>& aux = {}) {
+    std::vector<NDArrayHandle> a, g, x;
+    for (auto& v : args) a.push_back(v.handle());
+    for (auto& v : arg_grads) g.push_back(v.handle());
+    for (auto& v : aux) x.push_back(v.handle());
+    std::vector<mx_uint> reqs = grad_reqs;
+    Check(MXExecutorBind(sym.handle(), ctx.dev_type, ctx.dev_id,
+                         static_cast<mx_uint>(a.size()), a.data(),
+                         g.data(), reqs.data(),
+                         static_cast<mx_uint>(x.size()),
+                         x.empty() ? nullptr : x.data(), &h_),
+          "ExecutorBind");
+  }
+  ~Executor() {
+    if (h_ != nullptr) MXExecutorFree(h_);
+  }
+  Executor(const Executor&) = delete;
+  Executor& operator=(const Executor&) = delete;
+
+  void Forward(bool is_train) {
+    Check(MXExecutorForward(h_, is_train ? 1 : 0), "Forward");
+  }
+  void Backward() {
+    Check(MXExecutorBackward(h_, 0, nullptr), "Backward");
+  }
+  std::vector<NDArray> Outputs() const {
+    mx_uint n = 0;
+    NDArrayHandle* arr = nullptr;
+    Check(MXExecutorOutputs(h_, &n, &arr), "Outputs");
+    std::vector<NDArray> out;
+    for (mx_uint i = 0; i < n; ++i) out.emplace_back(arr[i]);
+    return out;
+  }
+
+ private:
+  ExecutorHandle h_ = nullptr;
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+
+#endif  // MXNET_CPP_HPP_

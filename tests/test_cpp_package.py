@@ -1,0 +1,44 @@
+"""cpp-package: header-only C++ API over the C ABI (parity: reference
+cpp-package/include/mxnet-cpp + example/). Compiles and runs the real
+C++ training example."""
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+LIB_DIR = os.path.join(REPO, "mxnet_tpu", "_lib")
+LIB = os.path.join(LIB_DIR, "libmxtpu_c_api.so")
+HEADER_DIR = os.path.join(REPO, "cpp-package", "include")
+EXAMPLE = os.path.join(REPO, "cpp-package", "example", "train_lenet.cpp")
+
+pytestmark = pytest.mark.skipif(not os.path.exists(LIB),
+                                reason="native lib not built")
+
+
+def _save_lenet_json(tmp_path):
+    from test_c_api import _save_lenet_json as _impl
+    return _impl(tmp_path)
+
+
+def test_cpp_train_example(tmp_path):
+    cxx = shutil.which("g++") or shutil.which("c++")
+    if cxx is None:
+        pytest.skip("no C++ compiler")
+    sys.path.insert(0, os.path.dirname(__file__))
+    json_path = _save_lenet_json(tmp_path)
+    exe = str(tmp_path / "train_lenet")
+    subprocess.run([cxx, "-std=c++17", "-I", HEADER_DIR, EXAMPLE, "-o", exe,
+                    "-L", LIB_DIR, "-lmxtpu_c_api",
+                    "-Wl,-rpath," + LIB_DIR], check=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["MXNET_TPU_FORCE_CPU"] = "1"
+    p = subprocess.run([exe, json_path], capture_output=True, text=True,
+                       timeout=600, env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "CPP_TRAIN_OK" in p.stdout, p.stdout
+    acc = float(p.stdout.split("acc=")[1].split()[0])
+    assert acc > 0.8, p.stdout
