@@ -42,6 +42,7 @@ if _os.environ.get("MXNET_TPU_COORDINATOR"):
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus
 from . import layout
+from . import config
 from . import ops
 from . import imperative
 from . import ndarray

@@ -1,0 +1,135 @@
+"""Runtime configuration knobs.
+
+Parity: the reference's ~30 ``MXNET_*`` environment variables read via
+``dmlc::GetEnv`` (SURVEY.md §5.6). Every reference knob is REGISTERED
+here with its disposition on TPU:
+
+- ``honored`` — read and acted on by this build;
+- ``mapped``  — the need it served is met by a TPU-native mechanism
+  (named in the description); the variable is accepted and ignored;
+- the registry makes the surface introspectable (:func:`list_knobs`),
+  which the reference never had.
+
+Knobs with real behavior here:
+- ``MXNET_BACKWARD_DO_MIRROR`` -> ``jax.checkpoint`` rematerialisation of
+  the forward inside the fused fwd+bwd program (the reference's memory
+  mirroring trades FLOPs for memory exactly the same way,
+  graph_executor.cc:282-305).
+- ``MXNET_CPU_WORKER_NTHREADS`` -> engine worker pool size AND the
+  default ImageIter decode-thread count.
+- ``MXNET_ENGINE_TYPE=NaiveEngine`` -> synchronous engine debugging mode.
+- ``MXNET_EXEC_NUM_TEMP`` -> pooled temp-space slots (resource.py).
+- ``MXNET_STORAGE_FALLBACK_LOG_VERBOSE`` -> warn when a sparse op falls
+  back to its dense view.
+- ``MXNET_PROFILER_AUTOSTART`` -> profiler starts at import.
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+from .base import get_env
+
+__all__ = ["list_knobs", "storage_fallback_log", "do_mirror"]
+
+# name -> (disposition, description)
+_KNOBS = {
+    # engine
+    "MXNET_ENGINE_TYPE": ("honored", "NaiveEngine = synchronous debug mode "
+                          "(engine.py; ≙ reference threaded_engine.h:355)"),
+    "MXNET_CPU_WORKER_NTHREADS": ("honored", "engine pool size and default "
+                                  "image decode threads"),
+    "MXNET_CPU_PRIORITY_NTHREADS": ("mapped", "PJRT owns dispatch; no "
+                                    "priority CPU queue exists"),
+    "MXNET_GPU_WORKER_NTHREADS": ("mapped", "PJRT streams replace per-GPU "
+                                  "worker threads"),
+    "MXNET_OMP_MAX_THREADS": ("mapped", "XLA:CPU threadpool is configured "
+                              "by XLA flags"),
+    "MXNET_ENGINE_INFO": ("honored", "verbose engine dispatch logging "
+                          "(engine.py)"),
+    # memory
+    "MXNET_GPU_MEM_POOL_RESERVE": ("mapped", "PJRT owns HBM; use "
+                                   "XLA_PYTHON_CLIENT_MEM_FRACTION"),
+    "MXNET_EXEC_NUM_TEMP": ("honored", "pooled temp-space slots "
+                            "(resource.py)"),
+    "MXNET_BACKWARD_DO_MIRROR": ("honored", "rematerialise the forward in "
+                                 "the fused fwd+bwd program "
+                                 "(jax.checkpoint)"),
+    # executor
+    "MXNET_EXEC_BULK_EXEC_TRAIN": ("mapped", "whole-graph jit IS maximal "
+                                   "op bulking"),
+    "MXNET_EXEC_BULK_EXEC_INFERENCE": ("mapped", "whole-graph jit"),
+    "MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN": ("mapped", "whole-graph jit"),
+    "MXNET_EXEC_INPLACE_GRAD_SUM_CAP": ("mapped", "XLA memory planning"),
+    "MXNET_EXEC_VERBOSE_LOGGING": ("mapped", "use jax logging / "
+                                   "dump_jaxpr"),
+    # kvstore
+    "MXNET_KVSTORE_REDUCTION_NTHREADS": ("mapped", "XLA collectives own "
+                                         "the reduction"),
+    "MXNET_KVSTORE_BIGARRAY_BOUND": ("mapped", "no key->server striping; "
+                                     "all-reduce shards by mesh"),
+    "MXNET_KVSTORE_SERIAL_PUSH": ("mapped", "batched pushes run as one "
+                                  "jitted collective"),
+    "MXNET_ENABLE_GPU_P2P": ("mapped", "ICI links replace CUDA P2P"),
+    # cudnn / tuning
+    "MXNET_CUDNN_AUTOTUNE_DEFAULT": ("mapped", "XLA autotunes"),
+    "MXNET_CUDA_ALLOW_TENSOR_CORE": ("mapped", "MXU is always on; "
+                                     "precision via jax matmul precision"),
+    "MXNET_USE_OPERATOR_TUNING": ("mapped", "XLA autotunes"),
+    "MXNET_OUTPUT_TUNING_DATA": ("mapped", "use jax profiler traces"),
+    # storage / sparse
+    "MXNET_STORAGE_FALLBACK_LOG_VERBOSE": ("honored", "warn on sparse -> "
+                                           "dense fallbacks"),
+    "MXNET_INFER_STORAGE_TYPE_VERBOSE_LOGGING": ("mapped", "storage types "
+                                                 "are explicit here"),
+    # profiler
+    "MXNET_PROFILER_AUTOSTART": ("honored", "start the profiler at import"),
+    "MXNET_PROFILER_MODE": ("honored", "profiler.py set_config"),
+    # io
+    "MXNET_CPU_TEMP_COPY": ("mapped", "PJRT staging buffers"),
+    # distributed wiring (reference ps-lite envs, kvstore.h:254)
+    "DMLC_ROLE": ("honored", "exported by tools/launch.py"),
+    "DMLC_NUM_WORKER": ("honored", "worker count fallback (kvstore.py)"),
+    "DMLC_RANK": ("honored", "rank fallback (kvstore.py)"),
+    "DMLC_PS_ROOT_URI": ("mapped", "jax.distributed coordinator address "
+                         "(MXNET_TPU_COORDINATOR)"),
+    "DMLC_PS_ROOT_PORT": ("mapped", "jax.distributed coordinator address"),
+    "MXNET_ENFORCE_DETERMINISM": ("mapped", "TPU execution is "
+                                  "deterministic by default"),
+}
+
+
+def list_knobs():
+    """All registered knobs: {name: (disposition, description, value)}."""
+    return {k: (d, desc, os.environ.get(k))
+            for k, (d, desc) in sorted(_KNOBS.items())}
+
+
+def do_mirror():
+    """MXNET_BACKWARD_DO_MIRROR: rematerialise the forward during the
+    backward pass (reference graph_executor.cc:282-305)."""
+    return bool(get_env("MXNET_BACKWARD_DO_MIRROR", 0, int))
+
+
+_fallback_logged = set()
+
+
+def storage_fallback_log(what):
+    """Warn (once per site) when a sparse op computes via its dense view
+    (parity: MXNET_STORAGE_FALLBACK_LOG_VERBOSE, src/common/utils.h)."""
+    if not get_env("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", 0, int):
+        return
+    if what in _fallback_logged:
+        return
+    _fallback_logged.add(what)
+    logging.getLogger("mxnet_tpu").warning(
+        "storage fallback: %s computes via its dense view", what)
+
+
+def _autostart_profiler():
+    if get_env("MXNET_PROFILER_AUTOSTART", 0, int):
+        from . import profiler
+        profiler.set_state("run")
+
+
+_autostart_profiler()

@@ -101,6 +101,13 @@ class _GraphProgram:
                                                    train)
                     return tuple(outs), aux_up
 
+                from .config import do_mirror
+                if do_mirror():
+                    # MXNET_BACKWARD_DO_MIRROR: recompute forward
+                    # activations during backward instead of keeping them
+                    # resident (reference graph_executor.cc:282-305 ≙
+                    # jax.checkpoint rematerialisation)
+                    f = jax.checkpoint(f)
                 outs, vjp, aux_up = jax.vjp(f, grad_args, has_aux=True)
                 hg = tuple(
                     head_grads[i] if head_grads[i] is not None

@@ -346,8 +346,11 @@ class ImageIter(DataIter):
                  path_imgrec=None, path_imglist=None, path_root="",
                  shuffle=False, part_index=0, num_parts=1, aug_list=None,
                  imglist=None, data_name="data", label_name="softmax_label",
-                 preprocess_threads=0, **kwargs):
+                 preprocess_threads=None, **kwargs):
         super().__init__(batch_size)
+        if preprocess_threads is None:
+            from ..base import get_env
+            preprocess_threads = get_env("MXNET_CPU_WORKER_NTHREADS", 0, int)
         # decode+augment worker pool (parity: iter_image_recordio_2.cc's
         # multithreaded OpenCV decode, :660-760). PIL releases the GIL
         # during JPEG decode, so threads scale on multi-core hosts; the

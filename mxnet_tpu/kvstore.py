@@ -112,6 +112,11 @@ class KVStore:
                 # mixed sparse/dense shards fall back to a dense sum
                 # (the reference's storage-fallback path) — summing via
                 # the dense views keeps every contribution
+                if any(isinstance(v, _sp.BaseSparseNDArray) for v in vlist):
+                    from .config import storage_fallback_log
+                    storage_fallback_log(
+                        "kvstore push of [%s] shards" % ", ".join(
+                            getattr(v, "stype", "default") for v in vlist))
                 dense = [_wrap(v._data, v.context)
                          if isinstance(v, _sp.BaseSparseNDArray) else v
                          for v in vlist]

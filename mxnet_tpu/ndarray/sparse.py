@@ -296,6 +296,10 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
             out = jnp.zeros((lhs.shape[1], r.shape[1]), prod.dtype) \
                 .at[cols].add(prod)
         return _wrap(out, lhs.context)
+    if isinstance(lhs, BaseSparseNDArray) or isinstance(rhs, BaseSparseNDArray):
+        from ..config import storage_fallback_log
+        storage_fallback_log("dot(%s, %s)" % (getattr(lhs, "stype", "default"),
+                                              getattr(rhs, "stype", "default")))
     return _dense_dot(_wrap(lhs._data, lhs.context) if isinstance(lhs, BaseSparseNDArray) else lhs,
                       _wrap(rhs._data, rhs.context) if isinstance(rhs, BaseSparseNDArray) else rhs,
                       transpose_a=transpose_a, transpose_b=transpose_b)

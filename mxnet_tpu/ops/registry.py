@@ -90,6 +90,32 @@ class OpDef:
     def __repr__(self):
         return "OpDef(%s)" % self.name
 
+    def accepted_params(self):
+        """Names this op accepts as keyword params — derived from the fn
+        signature (registry defaults alone miss params that exist only as
+        fn keyword defaults). None means the fn takes **kwargs (accept
+        anything)."""
+        cached = getattr(self, "_accepted_params", False)
+        if cached is not False:
+            return cached
+        keys = set(self.defaults) | {"num_args", "num_outputs"}
+        try:
+            sig = inspect.signature(self.fn)
+            for p in sig.parameters.values():
+                if p.kind == inspect.Parameter.VAR_KEYWORD:
+                    self._accepted_params = None
+                    return None
+                if p.kind in (inspect.Parameter.KEYWORD_ONLY,
+                              inspect.Parameter.POSITIONAL_OR_KEYWORD) \
+                        and p.default is not inspect.Parameter.empty:
+                    keys.add(p.name)
+        except (TypeError, ValueError):
+            pass
+        keys -= set(self.arg_names)
+        keys -= {"_train", "_rng"}
+        self._accepted_params = keys
+        return keys
+
     def apply(self, arrays, params):
         """Run the op on raw jax arrays. Returns a tuple of outputs."""
         out = self.fn(*arrays, **params)

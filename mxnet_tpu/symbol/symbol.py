@@ -442,20 +442,34 @@ def _create(op_name, input_syms, kwargs, name=None):
 
 
 def load_json(json_str):
-    """Load a symbol from reference-format JSON (parity: sym.load_json,
-    reference src/nnvm/legacy_json_util.cc handles versioning)."""
+    """Load a symbol from reference-format JSON, upgrading legacy layouts
+    (parity: sym.load_json + src/nnvm/legacy_json_util.cc). Handled
+    versions: modern ``attrs``, 0.9-era ``attr``, pre-0.9 ``param``.
+    Non-parameter attributes a legacy graph stored alongside op params
+    (``lr_mult``/``wd_mult``/``force_mirroring``/user attrs) migrate to
+    ``__k__`` extra attrs instead of reaching the op function — the
+    upgrade pass the reference runs before attr parsing
+    (legacy_json_util.cc:29-96)."""
     graph = json.loads(json_str)
     nodes = []
     for entry in graph["nodes"]:
-        attrs = entry.get("attrs", entry.get("param", {}))
+        attrs = entry.get("attrs") or entry.get("attr") or \
+            entry.get("param") or {}
         extra = {k: v for k, v in attrs.items() if k.startswith("__")}
         params = {k: _parse_attr(v) for k, v in attrs.items()
                   if not k.startswith("__")}
         if entry["op"] == "null":
             node = _SymNode(None, entry["name"], {}, [])
+            # legacy variable nodes kept lr_mult etc. as bare keys
+            extra.update({"__%s__" % k: str(v) for k, v in params.items()})
             node._extra_attrs = extra
         else:
             op = _registry.get_op(entry["op"])
+            accepted = op.accepted_params()
+            unknown = [] if accepted is None else \
+                [k for k in params if k not in accepted]
+            for k in unknown:  # legacy non-parameter attrs -> __k__ form
+                extra["__%s__" % k] = str(params.pop(k))
             inputs = [(nodes[i], idx) for i, idx, *_ in entry["inputs"]]
             node = _SymNode(op, entry["name"], params, inputs)
             node._extra_attrs = extra

@@ -1,0 +1,75 @@
+"""Runtime config knobs (parity: the reference's MXNET_* env surface,
+SURVEY.md §5.6)."""
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import config
+
+
+def test_knob_registry_covers_reference_surface():
+    knobs = config.list_knobs()
+    assert len(knobs) >= 30
+    # every knob has a disposition + rationale
+    for name, (disp, desc, _) in knobs.items():
+        assert disp in ("honored", "mapped"), name
+        assert desc
+    honored = [k for k, v in knobs.items() if v[0] == "honored"]
+    assert "MXNET_BACKWARD_DO_MIRROR" in honored
+    assert "MXNET_ENGINE_TYPE" in honored
+
+
+def test_backward_do_mirror_same_gradients(monkeypatch):
+    from mxnet_tpu import sym
+    rs = np.random.RandomState(0)
+    x = rs.uniform(-1, 1, (4, 6)).astype(np.float32)
+
+    def grads():
+        data = sym.Variable("data")
+        net = sym.FullyConnected(data, num_hidden=3, name="fc")
+        net = sym.Activation(net, act_type="tanh")
+        ex = net.simple_bind(ctx=mx.cpu(), data=(4, 6))
+        ex.arg_dict["data"][:] = x
+        ex.arg_dict["fc_weight"][:] = \
+            rs.__class__(1).uniform(-0.5, 0.5, (3, 6)).astype(np.float32)
+        ex.forward_backward(out_grads=mx.nd.ones((4, 3)))
+        return ex.grad_dict["fc_weight"].asnumpy()
+
+    base = grads()
+    monkeypatch.setenv("MXNET_BACKWARD_DO_MIRROR", "1")
+    remat = grads()
+    np.testing.assert_allclose(remat, base, rtol=1e-6)
+
+
+def test_storage_fallback_logging(monkeypatch, caplog):
+    from mxnet_tpu.ndarray import sparse as sp
+    monkeypatch.setenv("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", "1")
+    config._fallback_logged.clear()
+    rsp = sp.row_sparse_array((np.ones((1, 2), np.float32), [0]),
+                              shape=(3, 2))
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu"):
+        sp.dot(rsp, mx.nd.ones((2, 2)))
+    assert any("storage fallback" in r.message for r in caplog.records)
+
+
+def test_imageiter_threads_default_from_env(monkeypatch, tmp_path):
+    import io as _io
+    from PIL import Image
+    from mxnet_tpu import recordio
+    from mxnet_tpu.image import ImageIter
+    rec_path = str(tmp_path / "t.rec")
+    rec = recordio.MXRecordIO(rec_path, "w")
+    arr = np.zeros((8, 8, 3), np.uint8)
+    buf = _io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    rec.write(recordio.pack(recordio.IRHeader(0, 0.0, 0, 0), buf.getvalue()))
+    rec.close()
+    monkeypatch.setenv("MXNET_CPU_WORKER_NTHREADS", "3")
+    it = ImageIter(batch_size=1, data_shape=(3, 8, 8), path_imgrec=rec_path)
+    assert it._pool is not None
+    it2 = ImageIter(batch_size=1, data_shape=(3, 8, 8), path_imgrec=rec_path,
+                    preprocess_threads=0)
+    assert it2._pool is None

@@ -133,3 +133,60 @@ def test_simple_bind_forward_with_kwargs():
     out = ex.forward(is_train=False, data=np.random.normal(size=(4, 10)))
     probs = out[0].asnumpy()
     np.testing.assert_allclose(probs.sum(axis=1), np.ones(4), rtol=1e-5)
+
+
+def test_load_json_legacy_formats():
+    """Pre-1.0 graph JSON loads: 0.9-era 'attr' key, pre-0.9 'param' key,
+    and legacy non-parameter attrs (lr_mult) migrating to __k__ form
+    (parity: src/nnvm/legacy_json_util.cc upgrade pass)."""
+    import json as _json
+    legacy = {
+        "nodes": [
+            {"op": "null", "name": "data", "inputs": []},
+            {"op": "null", "name": "fc_weight", "inputs": [],
+             "attr": {"lr_mult": "2.0"}},
+            {"op": "null", "name": "fc_bias", "inputs": []},
+            {"op": "FullyConnected", "name": "fc",
+             # 0.9-era: params under 'attr', with a non-parameter key
+             "attr": {"num_hidden": "4", "lr_mult": "0.5"},
+             "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+            {"op": "Activation", "name": "act",
+             # pre-0.9: params under 'param'
+             "param": {"act_type": "relu"},
+             "inputs": [[3, 0, 0]]},
+        ],
+        "arg_nodes": [0, 1, 2],
+        "heads": [[4, 0, 0]],
+    }
+    net = sym.load_json(_json.dumps(legacy))
+    assert net.list_arguments() == ["data", "fc_weight", "fc_bias"]
+    # the graph binds and runs (unknown attrs did not reach the op)
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 3))
+    ex.arg_dict["data"][:] = np.ones((2, 3), np.float32)
+    ex.arg_dict["fc_weight"][:] = np.ones((4, 3), np.float32)
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, np.full((2, 4), 3.0))
+    # legacy attrs preserved in __k__ form (visible to optimizers)
+    attrs = net.attr_dict()
+    assert attrs["fc_weight"]["__lr_mult__"] == "2.0"
+    assert attrs["fc"]["__lr_mult__"] == "0.5"
+
+
+def test_json_roundtrip_preserves_signature_only_params():
+    """Params that exist only as fn keyword defaults (not registry
+    defaults) must survive tojson/load_json — e.g. linalg_trsm's lower."""
+    A = sym.Variable("A")
+    B = sym.Variable("B")
+    s = sym._linalg_trsm(A, B, lower=False) if hasattr(sym, "_linalg_trsm") \
+        else sym.linalg.trsm(A, B, lower=False)
+    s2 = sym.load_json(s.tojson())
+    import numpy as _np
+    tri = _np.triu(_np.ones((3, 3), _np.float32)) + 2 * _np.eye(3, dtype=_np.float32)
+    rhs = _np.arange(9, dtype=_np.float32).reshape(3, 3)
+    outs = []
+    for net in (s, s2):
+        ex = net.simple_bind(ctx=mx.cpu(), A=(3, 3), B=(3, 3))
+        ex.arg_dict["A"][:] = tri
+        ex.arg_dict["B"][:] = rhs
+        outs.append(ex.forward()[0].asnumpy())
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-5)
