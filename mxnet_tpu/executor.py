@@ -29,20 +29,72 @@ from . import random as _random
 __all__ = ["Executor", "infer_graph_shapes"]
 
 
+# differentiable cross-device copy with static endpoints: the plain
+# device_put transpose leaves cotangents on the DESTINATION device, so
+# the backward of a grouped graph would mix devices mid-computation
+_XFER_CACHE = {}
+
+
+def _context_for_device(dev):
+    """Map a concrete jax.Device back to a Context."""
+    from .context import Context
+    if dev.platform == "cpu":
+        local = jax.local_devices(backend="cpu")
+        return Context("cpu", local.index(dev))
+    return Context("tpu", dev.id)
+
+
+def _device_transfer(v, src, dst):
+    key = (src, dst)
+    fn = _XFER_CACHE.get(key)
+    if fn is None:
+        @jax.custom_vjp
+        def t(x):
+            return jax.device_put(x, dst)
+
+        def t_fwd(x):
+            return jax.device_put(x, dst), None
+
+        def t_bwd(_, g):
+            return (jax.device_put(g, src),)
+
+        t.defvjp(t_fwd, t_bwd)
+        fn = _XFER_CACHE[key] = t
+    return fn(v)
+
+
 # ---------------------------------------------------------------------------
 # Graph program: symbol -> pure jax function
 # ---------------------------------------------------------------------------
 
 class _GraphProgram:
-    """Caches the traced/jitted callables for one Symbol."""
+    """Caches the traced/jitted callables for one Symbol.
 
-    def __init__(self, symbol):
+    With ``group2dev`` (the reference's group2ctx model parallelism,
+    AssignContext + cross-device copy nodes, graph_executor.cc:318-440):
+    each op node resolves a device from its ``ctx_group`` attribute and
+    inputs crossing a group boundary are ``jax.device_put`` to the
+    consumer's device — the cross-device copy. Grouped programs run
+    eagerly per segment (arbitrary per-op device pinning is not a GSPMD
+    program; data-parallel scaling uses the mesh path instead)."""
+
+    def __init__(self, symbol, group2dev=None, default_device=None):
         self.symbol = symbol
         self.nodes = symbol._topo_nodes()
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
         self.output_entries = list(symbol._outputs)
         self._jit_cache = {}
+        self.node_devices = None
+        self.default_device = default_device
+        if group2dev:
+            self.node_devices = {}
+            for node in self.nodes:
+                if node.op is None:
+                    continue
+                g = node._extra_attrs.get("ctx_group") or                     node._extra_attrs.get("__ctx_group__")
+                if g is not None and g in group2dev:
+                    self.node_devices[id(node)] = group2dev[g]
 
     # ---- pure evaluation -------------------------------------------------
     def eval_graph(self, arg_dict, aux_dict, rng_key, train):
@@ -60,6 +112,19 @@ class _GraphProgram:
                         raise MXNetError("unbound variable %r" % node.name)
                     continue
                 raw_in = [env[id(c)][idx] for c, idx in node.inputs]
+                if self.node_devices:
+                    dev = self.node_devices.get(id(node),
+                                                self.default_device)
+                    fixed = []
+                    for r, (c, _) in zip(raw_in, node.inputs):
+                        src = self.node_devices.get(id(c),
+                                                    self.default_device)
+                        if src is not dev:
+                            # cross-device copy at the group boundary
+                            # (reference cross_device_copy.cc node)
+                            r = _device_transfer(r, src, dev)
+                        fixed.append(r)
+                    raw_in = fixed
                 params = dict(node.op.defaults)
                 params.update(node.attrs)
                 params.pop("num_args", None)
@@ -86,7 +151,9 @@ class _GraphProgram:
         if key not in self._jit_cache:
             def fn(args, aux, rng):
                 return self.eval_graph(args, aux, rng, train)
-            self._jit_cache[key] = jax.jit(fn)
+            # grouped programs pin ops to concrete devices — eager
+            # execution (per-op dispatch), not one jitted program
+            self._jit_cache[key] = fn if self.node_devices else jax.jit(fn)
         return self._jit_cache[key]
 
     def fwd_bwd_fn(self, train, grad_names):
@@ -113,9 +180,16 @@ class _GraphProgram:
                     head_grads[i] if head_grads[i] is not None
                     else jnp.ones(outs[i].shape, outs[i].dtype)
                     for i in range(len(outs)))
+                if self.node_devices:
+                    # head gradients must enter the backward committed to
+                    # their output node's device
+                    hg = tuple(
+                        jax.device_put(g, self.node_devices.get(
+                            id(n), self.default_device))
+                        for g, (n, _) in zip(hg, self.output_entries))
                 grads = vjp(hg)[0]
                 return outs, grads, aux_up
-            self._jit_cache[key] = jax.jit(fn)
+            self._jit_cache[key] = fn if self.node_devices else jax.jit(fn)
         return self._jit_cache[key]
 
 
@@ -265,11 +339,34 @@ class Executor:
     """Bound, compiled graph (parity: python/mxnet/executor.py)."""
 
     def __init__(self, symbol, ctx, arg_arrays, grad_arrays, grad_req,
-                 aux_arrays, program=None):
+                 aux_arrays, program=None, group2ctx=None):
         from .ndarray.ndarray import NDArray
         self._symbol = symbol
         self._ctx = ctx or current_context()
-        self._prog = program or _GraphProgram(symbol)
+        group2dev = {g: c.jax_device() for g, c in group2ctx.items()} \
+            if group2ctx else None
+        # misconfigured contexts must raise here, not silently degrade
+        # grouped placement (reference AssignContext CHECKs placement)
+        default_dev = (ctx or current_context()).jax_device() \
+            if group2dev else None
+        self._prog = program or _GraphProgram(
+            symbol, group2dev=group2dev, default_device=default_dev)
+        if self._prog.node_devices:
+            # commit parameter/aux storage to its group device so weights
+            # are NOT re-copied across the boundary every step; retag the
+            # NDArray's context too, so subsequent writes (x[:] = ...,
+            # copyto) keep the placement instead of pulling the storage
+            # back to the bind context
+            by_name = {n.name: self._prog.node_devices[id(n)]
+                       for n in self._prog.nodes
+                       if n.op is None and id(n) in self._prog.node_devices}
+            for name, arr in list(zip(self._prog.arg_names, arg_arrays)) + \
+                    list(zip(self._prog.aux_names, aux_arrays)) + \
+                    list(zip(self._prog.arg_names, grad_arrays)):
+                dev = by_name.get(name)
+                if dev is not None and arr is not None:
+                    arr._set_data(jax.device_put(arr._data, dev))
+                    arr._ctx = _context_for_device(dev)
         self.arg_arrays = list(arg_arrays)
         self.grad_arrays = list(grad_arrays)
         self.aux_arrays = list(aux_arrays)
@@ -302,7 +399,8 @@ class Executor:
 
     # -- binding helpers (called from Symbol) ------------------------------
     @staticmethod
-    def _simple_bind(symbol, ctx, grad_req, type_dict, shape_kwargs):
+    def _simple_bind(symbol, ctx, grad_req, type_dict, shape_kwargs,
+                     group2ctx=None):
         from .ndarray import zeros
         (arg_shapes, _, aux_shapes, arg_types, _, aux_types) = \
             infer_graph_attrs(symbol, shape_kwargs, known_types=type_dict)
@@ -322,10 +420,12 @@ class Executor:
                        for n, s, t in zip(arg_names, arg_shapes, arg_types)]
         aux_arrays = [zeros(s, ctx=ctx, dtype=t if t is not None else "float32")
                       for s, t in zip(aux_shapes, aux_types)]
-        return Executor(symbol, ctx, arg_arrays, grad_arrays, reqs, aux_arrays)
+        return Executor(symbol, ctx, arg_arrays, grad_arrays, reqs,
+                        aux_arrays, group2ctx=group2ctx)
 
     @staticmethod
-    def _bind(symbol, ctx, args, args_grad, grad_req, aux_states):
+    def _bind(symbol, ctx, args, args_grad, grad_req, aux_states,
+              group2ctx=None):
         from .ndarray.ndarray import NDArray
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
@@ -356,7 +456,7 @@ class Executor:
             aux_arrays = [a if a is not None else _z(s, ctx=ctx)
                           for a, s in zip(aux_arrays, aux_shapes)]
         return Executor(symbol, ctx, arg_arrays, grad_arrays, grad_req,
-                        aux_arrays)
+                        aux_arrays, group2ctx=group2ctx)
 
     # -- execution ---------------------------------------------------------
     def _raw_args(self):
@@ -364,6 +464,19 @@ class Executor:
 
     def _raw_aux(self):
         return {n: a._data for n, a in zip(self._aux_names, self.aux_arrays)}
+
+    def _out_ctx(self, out_index):
+        """Context for output i: in grouped mode, the output node's group
+        device (so NDArray.context reports where the data actually
+        lives); otherwise the bind context."""
+        nd_map = self._prog.node_devices
+        if not nd_map:
+            return self._ctx
+        node, _ = self._prog.output_entries[out_index]
+        dev = nd_map.get(id(node), self._prog.default_device)
+        if dev is None or dev == self._ctx.jax_device():
+            return self._ctx
+        return _context_for_device(dev)
 
     def forward(self, is_train=False, **kwargs):
         """Run forward (parity: executor.py forward:113)."""
@@ -378,7 +491,8 @@ class Executor:
         fn = self._prog.forward_fn(bool(is_train))
         outs, aux_up = fn(self._raw_args(), self._raw_aux(), self._last_key)
         self._write_aux(aux_up)
-        self.outputs = [_wrap(o, self._ctx) for o in outs]
+        self.outputs = [_wrap(o, self._out_ctx(i))
+                        for i, o in enumerate(outs)]
         if self._monitor_callback is not None:
             for name, arr in zip(self._symbol.list_outputs(), self.outputs):
                 self._monitor_callback(name, arr)
@@ -434,7 +548,8 @@ class Executor:
                                  tuple(hg_concrete))
         self._write_aux(aux_up)
         if update_outputs:
-            self.outputs = [_wrap(o, self._ctx) for o in outs]
+            self.outputs = [_wrap(o, self._out_ctx(i))
+                            for i, o in enumerate(outs)]
         gdict = dict(zip(self._arg_names, self.grad_arrays))
         for n in grad_names:
             garr = gdict[n]

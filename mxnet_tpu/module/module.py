@@ -48,6 +48,7 @@ class Module(BaseModule):
         arg_names = symbol.list_arguments()
         input_names = self._data_names + self._label_names + self._state_names
         self._param_names = [n for n in arg_names if n not in input_names]
+        self._group2ctxs = group2ctxs
         self._aux_names = symbol.list_auxiliary_states()
         self._output_names = symbol.list_outputs()
         self._arg_params = None
@@ -144,8 +145,14 @@ class Module(BaseModule):
                 reqs[name] = grad_req if for_training else "null"
         self._grad_req = reqs
         ctx = self._context[0]
+        g2c = self._group2ctxs
+        if isinstance(g2c, (list, tuple)):
+            # reference group2ctxs is a per-context list; the single-exec
+            # module uses the first entry
+            g2c = g2c[0] if g2c else None
         self._exec = self._symbol.simple_bind(ctx=ctx, grad_req=reqs,
                                               type_dict=type_dict,
+                                              group2ctx=g2c,
                                               **shape_kwargs)
         if len(self._context) > 1:
             self._init_mesh()

@@ -257,13 +257,15 @@ class Symbol:
                     shared_exec=None, shared_buffer=None, **kwargs):
         """Allocate arrays and bind (parity: symbol.py simple_bind:1254)."""
         from ..executor import Executor
-        return Executor._simple_bind(self, ctx, grad_req, type_dict, kwargs)
+        return Executor._simple_bind(self, ctx, grad_req, type_dict, kwargs,
+                                     group2ctx=group2ctx)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
         """Bind with existing arrays (parity: symbol.py bind:1518)."""
         from ..executor import Executor
-        return Executor._bind(self, ctx, args, args_grad, grad_req, aux_states)
+        return Executor._bind(self, ctx, args, args_grad, grad_req,
+                              aux_states, group2ctx=group2ctx)
 
     # -- eval / call -------------------------------------------------------
     def eval(self, ctx=None, **kwargs):

@@ -200,3 +200,89 @@ def test_registry_shared_with_builtin_factories():
     import json
     opt = create(json.dumps(["adam", {"learning_rate": 0.1}]))
     assert type(opt).__name__ == "Adam"
+
+
+def test_group2ctx_model_parallel_matches_single_device():
+    """group2ctx places op groups on different devices with cross-device
+    copies at boundaries (parity: reference AssignContext +
+    cross_device_copy, tests/python/unittest/test_model_parallel.py).
+    Runs on the 8-device virtual CPU mesh."""
+    import jax
+    if len(jax.devices("cpu")) < 2:
+        import pytest as _pytest
+        _pytest.skip("needs 2 cpu devices")
+    rs = np.random.RandomState(0)
+    x_np = rs.uniform(-1, 1, (4, 6)).astype(np.float32)
+    w1 = rs.uniform(-0.5, 0.5, (5, 6)).astype(np.float32)
+    w2 = rs.uniform(-0.5, 0.5, (3, 5)).astype(np.float32)
+
+    def build():
+        with mx.AttrScope(ctx_group="dev1"):
+            data = mx.sym.Variable("data")
+            net = mx.sym.FullyConnected(data, num_hidden=5, no_bias=True,
+                                        name="fc1")
+            net = mx.sym.Activation(net, act_type="tanh")
+        with mx.AttrScope(ctx_group="dev2"):
+            net = mx.sym.FullyConnected(net, num_hidden=3, no_bias=True,
+                                        name="fc2")
+        return net
+
+    def run(group2ctx):
+        net = build()
+        ex = net.simple_bind(ctx=mx.cpu(0), grad_req="write",
+                             group2ctx=group2ctx, data=(4, 6))
+        ex.arg_dict["data"][:] = x_np
+        ex.arg_dict["fc1_weight"][:] = w1
+        ex.arg_dict["fc2_weight"][:] = w2
+        out = ex.forward_backward(out_grads=mx.nd.ones((4, 3)),
+                                  is_train=True)[0].asnumpy()
+        return out, ex.grad_dict["fc1_weight"].asnumpy()
+
+    base_out, base_g = run(None)
+    mp_out, mp_g = run({"dev1": mx.cpu(0), "dev2": mx.cpu(1)})
+    np.testing.assert_allclose(mp_out, base_out, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(mp_g, base_g, rtol=1e-5, atol=1e-6)
+    # the grouped program really assigned two distinct devices
+    net = build()
+    ex = net.simple_bind(ctx=mx.cpu(0),
+                         group2ctx={"dev1": mx.cpu(0), "dev2": mx.cpu(1)},
+                         data=(4, 6))
+    devs = set(ex._prog.node_devices.values())
+    assert len(devs) == 2, devs
+
+
+def test_group2ctx_placement_details():
+    """Parameters live on their group's device (no per-step re-copy),
+    gradients land there too, outputs report the group context, and
+    Module forwards group2ctxs."""
+    import jax
+    if len(jax.devices("cpu")) < 2:
+        import pytest as _pytest
+        _pytest.skip("needs 2 cpu devices")
+    with mx.AttrScope(ctx_group="dev1"):
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=5, no_bias=True,
+                                    name="fc1")
+    with mx.AttrScope(ctx_group="dev2"):
+        net = mx.sym.FullyConnected(net, num_hidden=3, no_bias=True,
+                                    name="fc2")
+    g2c = {"dev1": mx.cpu(0), "dev2": mx.cpu(1)}
+    ex = net.simple_bind(ctx=mx.cpu(0), grad_req="write", group2ctx=g2c,
+                         data=(4, 6))
+    cpu1 = mx.cpu(1).jax_device()
+    # fc2's weight storage committed to cpu(1) at bind
+    assert list(ex.arg_dict["fc2_weight"]._data.devices())[0] == cpu1
+    ex.arg_dict["data"][:] = np.ones((4, 6), np.float32)
+    ex.arg_dict["fc1_weight"][:] = np.ones((5, 6), np.float32) * 0.1
+    ex.arg_dict["fc2_weight"][:] = np.ones((3, 5), np.float32) * 0.1
+    outs = ex.forward_backward(out_grads=mx.nd.ones((4, 3)), is_train=True)
+    # output data AND reported context are the group device
+    assert list(outs[0]._data.devices())[0] == cpu1
+    assert outs[0].context == mx.cpu(1)
+    # fc2's gradient stays on its group device
+    assert list(ex.grad_dict["fc2_weight"]._data.devices())[0] == cpu1
+
+    # Module-level plumbing
+    mod = mx.mod.Module(net, context=mx.cpu(0), group2ctxs=g2c)
+    mod.bind(data_shapes=[("data", (4, 6))], label_shapes=None)
+    assert mod._exec._prog.node_devices
