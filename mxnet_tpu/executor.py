@@ -36,12 +36,15 @@ _XFER_CACHE = {}
 
 
 def _context_for_device(dev):
-    """Map a concrete jax.Device back to a Context."""
-    from .context import Context
+    """Map a concrete jax.Device back to a Context. Index by position in
+    the local device list (not ``dev.id``, a GLOBAL id that need not be
+    aligned with local indices in multi-process runs) so the round trip
+    through ``Context.jax_device()`` lands on the same device."""
+    from .context import Context, _accelerator_devices
     if dev.platform == "cpu":
         local = jax.local_devices(backend="cpu")
         return Context("cpu", local.index(dev))
-    return Context("tpu", dev.id)
+    return Context("tpu", _accelerator_devices().index(dev))
 
 
 def _device_transfer(v, src, dst):
@@ -90,11 +93,23 @@ class _GraphProgram:
         if group2dev:
             self.node_devices = {}
             for node in self.nodes:
-                if node.op is None:
-                    continue
-                g = node._extra_attrs.get("ctx_group") or                     node._extra_attrs.get("__ctx_group__")
+                g = (node._extra_attrs.get("ctx_group")
+                     or node._extra_attrs.get("__ctx_group__"))
                 if g is not None and g in group2dev:
                     self.node_devices[id(node)] = group2dev[g]
+            # variables without their own ctx_group live on their first
+            # consumer's device (reference AssignContext pulls inputs to
+            # the consuming op's group, graph_executor.cc:318-440)
+            for node in self.nodes:
+                if node.op is None:
+                    continue
+                ndev = self.node_devices.get(id(node))
+                if ndev is None:
+                    continue
+                for child, _ in node.inputs:
+                    if child.op is None and \
+                            id(child) not in self.node_devices:
+                        self.node_devices[id(child)] = ndev
 
     # ---- pure evaluation -------------------------------------------------
     def eval_graph(self, arg_dict, aux_dict, rng_key, train):
@@ -339,16 +354,14 @@ class Executor:
     """Bound, compiled graph (parity: python/mxnet/executor.py)."""
 
     def __init__(self, symbol, ctx, arg_arrays, grad_arrays, grad_req,
-                 aux_arrays, program=None, group2ctx=None):
+                 aux_arrays, program=None, group2ctx=None,
+                 owns_arrays=False):
         from .ndarray.ndarray import NDArray
         self._symbol = symbol
         self._ctx = ctx or current_context()
         group2dev = {g: c.jax_device() for g, c in group2ctx.items()} \
             if group2ctx else None
-        # misconfigured contexts must raise here, not silently degrade
-        # grouped placement (reference AssignContext CHECKs placement)
-        default_dev = (ctx or current_context()).jax_device() \
-            if group2dev else None
+        default_dev = self._ctx.jax_device() if group2dev else None
         self._prog = program or _GraphProgram(
             symbol, group2dev=group2dev, default_device=default_dev)
         if self._prog.node_devices:
@@ -356,7 +369,17 @@ class Executor:
             # are NOT re-copied across the boundary every step; retag the
             # NDArray's context too, so subsequent writes (x[:] = ...,
             # copyto) keep the placement instead of pulling the storage
-            # back to the bind context
+            # back to the bind context. Only arrays this executor
+            # allocated (simple_bind) may be moved; caller-owned arrays
+            # on the wrong device raise instead of being mutated behind
+            # the caller's back (reference AssignContext CHECKs
+            # placement, graph_executor.cc:318-440). owns_arrays may
+            # also be a collection naming the movable subset (e.g. the
+            # aux arrays _bind auto-allocates).
+            if owns_arrays is True:
+                movable = None          # everything movable
+            else:
+                movable = frozenset(owns_arrays or ())
             by_name = {n.name: self._prog.node_devices[id(n)]
                        for n in self._prog.nodes
                        if n.op is None and id(n) in self._prog.node_devices}
@@ -364,9 +387,17 @@ class Executor:
                     list(zip(self._prog.aux_names, aux_arrays)) + \
                     list(zip(self._prog.arg_names, grad_arrays)):
                 dev = by_name.get(name)
-                if dev is not None and arr is not None:
-                    arr._set_data(jax.device_put(arr._data, dev))
-                    arr._ctx = _context_for_device(dev)
+                if dev is None or arr is None:
+                    continue
+                if list(arr._data.devices())[0] == dev:
+                    continue
+                if movable is not None and name not in movable:
+                    raise MXNetError(
+                        "bind: array %r lives on %s but its ctx_group "
+                        "maps to %s; allocate it on the group's context"
+                        % (name, arr.context, _context_for_device(dev)))
+                arr._set_data(jax.device_put(arr._data, dev))
+                arr._ctx = _context_for_device(dev)
         self.arg_arrays = list(arg_arrays)
         self.grad_arrays = list(grad_arrays)
         self.aux_arrays = list(aux_arrays)
@@ -421,7 +452,7 @@ class Executor:
         aux_arrays = [zeros(s, ctx=ctx, dtype=t if t is not None else "float32")
                       for s, t in zip(aux_shapes, aux_types)]
         return Executor(symbol, ctx, arg_arrays, grad_arrays, reqs,
-                        aux_arrays, group2ctx=group2ctx)
+                        aux_arrays, group2ctx=group2ctx, owns_arrays=True)
 
     @staticmethod
     def _bind(symbol, ctx, args, args_grad, grad_req, aux_states,
@@ -448,15 +479,20 @@ class Executor:
             raise MXNetError("bind: missing arguments %s" % missing)
         grad_arrays = _as_list(args_grad, arg_names, "args_grad")
         aux_arrays = _as_list(aux_states, aux_names, "aux_states")
+        auto_aux = set()
         if any(a is None for a in aux_arrays):
-            # allocate zeros for missing aux
+            # allocate zeros for missing aux; these are executor-owned,
+            # so grouped binds may move them to their group device
             from .ndarray import zeros as _z
+            auto_aux = {n for n, a in zip(aux_names, aux_arrays)
+                        if a is None}
             shapes = {n: a.shape for n, a in zip(arg_names, arg_arrays)}
             _, _, aux_shapes = symbol.infer_shape_partial(**shapes)
             aux_arrays = [a if a is not None else _z(s, ctx=ctx)
                           for a, s in zip(aux_arrays, aux_shapes)]
         return Executor(symbol, ctx, arg_arrays, grad_arrays, grad_req,
-                        aux_arrays, group2ctx=group2ctx)
+                        aux_arrays, group2ctx=group2ctx,
+                        owns_arrays=auto_aux)
 
     # -- execution ---------------------------------------------------------
     def _raw_args(self):
@@ -468,15 +504,24 @@ class Executor:
     def _out_ctx(self, out_index):
         """Context for output i: in grouped mode, the output node's group
         device (so NDArray.context reports where the data actually
-        lives); otherwise the bind context."""
+        lives); otherwise the bind context. Static per executor — cached
+        so the per-step hot path skips the device-list lookups."""
+        cache = self.__dict__.setdefault("_out_ctx_cache", {})
+        ctx = cache.get(out_index)
+        if ctx is not None:
+            return ctx
         nd_map = self._prog.node_devices
         if not nd_map:
-            return self._ctx
-        node, _ = self._prog.output_entries[out_index]
-        dev = nd_map.get(id(node), self._prog.default_device)
-        if dev is None or dev == self._ctx.jax_device():
-            return self._ctx
-        return _context_for_device(dev)
+            ctx = self._ctx
+        else:
+            node, _ = self._prog.output_entries[out_index]
+            dev = nd_map.get(id(node), self._prog.default_device)
+            if dev is None or dev == self._ctx.jax_device():
+                ctx = self._ctx
+            else:
+                ctx = _context_for_device(dev)
+        cache[out_index] = ctx
+        return ctx
 
     def forward(self, is_train=False, **kwargs):
         """Run forward (parity: executor.py forward:113)."""
@@ -608,7 +653,8 @@ class Executor:
             else:
                 new_grads.append(zeros(s, ctx=self._ctx))
         return Executor(self._symbol, self._ctx, new_args, new_grads,
-                        self._grad_req, self.aux_arrays, program=self._prog)
+                        self._grad_req, self.aux_arrays, program=self._prog,
+                        owns_arrays=True)
 
     def set_monitor_callback(self, callback, monitor_all=False):
         self._monitor_callback = callback

@@ -150,6 +150,14 @@ class Module(BaseModule):
             # reference group2ctxs is a per-context list; the single-exec
             # module uses the first entry
             g2c = g2c[0] if g2c else None
+        if g2c and len(self._context) > 1:
+            # grouped programs pin ops to concrete devices (eager
+            # per-segment execution); the dp mesh shards ONE jitted
+            # program — the two placements are mutually exclusive
+            raise MXNetError(
+                "group2ctxs cannot be combined with a multi-device "
+                "context list; use a single context for model "
+                "parallelism or drop group2ctxs for data parallelism")
         self._exec = self._symbol.simple_bind(ctx=ctx, grad_req=reqs,
                                               type_dict=type_dict,
                                               group2ctx=g2c,

@@ -286,3 +286,37 @@ def test_group2ctx_placement_details():
     mod = mx.mod.Module(net, context=mx.cpu(0), group2ctxs=g2c)
     mod.bind(data_shapes=[("data", (4, 6))], label_shapes=None)
     assert mod._exec._prog.node_devices
+
+
+def test_group2ctx_misplacement_raises():
+    """Caller-owned arrays on the wrong group device raise at bind (no
+    silent relocation of shared storage); multi-device context lists
+    reject group2ctxs (the dp mesh shards one program — incompatible
+    with per-op device pinning)."""
+    import jax
+    import pytest
+    if len(jax.devices("cpu")) < 2:
+        pytest.skip("needs 2 cpu devices")
+    with mx.AttrScope(ctx_group="dev1"):
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=5, no_bias=True,
+                                    name="fc1")
+    with mx.AttrScope(ctx_group="dev2"):
+        net = mx.sym.FullyConnected(net, num_hidden=3, no_bias=True,
+                                    name="fc2")
+    g2c = {"dev1": mx.cpu(0), "dev2": mx.cpu(1)}
+    args = {"data": mx.nd.zeros((4, 6), ctx=mx.cpu(0)),
+            "fc1_weight": mx.nd.zeros((5, 6), ctx=mx.cpu(0)),
+            "fc2_weight": mx.nd.zeros((3, 5), ctx=mx.cpu(0))}
+    with pytest.raises(mx.MXNetError, match="fc2_weight"):
+        net.bind(ctx=mx.cpu(0), args=args, group2ctx=g2c)
+    # correctly placed caller arrays bind fine and are not moved
+    args["fc2_weight"] = mx.nd.zeros((3, 5), ctx=mx.cpu(1))
+    ex = net.bind(ctx=mx.cpu(0), args=args, group2ctx=g2c)
+    assert args["fc2_weight"].context == mx.cpu(1)
+    ex.forward(is_train=False)
+
+    mod = mx.mod.Module(net, context=[mx.cpu(0), mx.cpu(1)],
+                        group2ctxs=g2c)
+    with pytest.raises(mx.MXNetError, match="group2ctxs"):
+        mod.bind(data_shapes=[("data", (4, 6))], label_shapes=None)
