@@ -114,14 +114,17 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
-        for i, param in enumerate(self._params):
-            if param.grad_req == "null":
-                continue
-            if self._update_on_kvstore:
+        live = [(i, p) for i, p in enumerate(self._params)
+                if p.grad_req != "null"]
+        if self._update_on_kvstore:
+            for i, param in live:
                 self._kvstore.push(i, param.grad())
                 self._kvstore.pull(i, out=param.data())
-            else:
-                self._updaters(i, param.grad(), param.data())
+        else:
+            # whole parameter set in one fused dispatch (FusedUpdater)
+            self._updaters.update_batch([i for i, _ in live],
+                                        [p.grad() for _, p in live],
+                                        [p.data() for _, p in live])
 
     def save_states(self, fname):
         assert self._optimizer is not None

@@ -127,12 +127,20 @@ class KVStore:
                         merged += v
             merged_list.append(merged)
         merged_list = self._global_reduce_batch(keys, merged_list)
-        for k, merged in zip(keys, merged_list):
-            if self._updater is not None:
+        if self._updater is not None:
+            for k in keys:
                 if k not in self._store:
                     raise MXNetError("push: key %r was not init()ed" % k)
-                self._updater(_int_key(k), merged, self._store[k])
+            if hasattr(self._updater, "update_batch"):
+                # whole key list in one fused dispatch (FusedUpdater)
+                self._updater.update_batch(
+                    [_int_key(k) for k in keys], merged_list,
+                    [self._store[k] for k in keys])
             else:
+                for k, merged in zip(keys, merged_list):
+                    self._updater(_int_key(k), merged, self._store[k])
+        else:
+            for k, merged in zip(keys, merged_list):
                 self._store[k] = merged.copy()
 
     # one reduction device per process: the first local device of each,

@@ -38,6 +38,18 @@ def _as_numpy(x):
     return x.asnumpy() if isinstance(x, NDArray) else _numpy.asarray(x)
 
 
+def _colocate(ref, x):
+    """Reshard ``x`` to ``ref``'s placement (mesh-DP outputs are sharded
+    over the device mesh while labels arrive single-device)."""
+    import jax
+    try:
+        if x.sharding != ref.sharding:
+            return jax.device_put(x, ref.sharding)
+    except (AttributeError, ValueError):
+        pass
+    return x
+
+
 def check_label_shapes(labels, preds, shape=False):
     if (not shape and len(labels) != len(preds)) or \
             (shape and labels.shape != preds.shape):
@@ -82,8 +94,26 @@ class EvalMetric:
     def reset(self):
         self.num_inst = 0
         self.sum_metric = 0.0
+        self._dev_sum = None
+
+    # -- async device accumulation ----------------------------------------
+    # Hot metrics reduce ON DEVICE and enqueue the scalar without a host
+    # sync; get() is the only synchronisation point. On a remoted PJRT
+    # backend a per-batch logits pull would otherwise serialise the
+    # training pipeline (no reference counterpart — the reference's
+    # metrics run in-process where the copy is cheap, metric.py:39).
+    def _accum_device(self, scalar, n):
+        prev = getattr(self, "_dev_sum", None)
+        self._dev_sum = scalar if prev is None else prev + scalar
+        self.num_inst += n
+
+    def _flush_device(self):
+        if getattr(self, "_dev_sum", None) is not None:
+            self.sum_metric += float(self._dev_sum)
+            self._dev_sum = None
 
     def get(self):
+        self._flush_device()
         if self.num_inst == 0:
             return (self.name, float("nan"))
         return (self.name, self.sum_metric / self.num_inst)
@@ -141,8 +171,19 @@ class Accuracy(EvalMetric):
         self.axis = axis
 
     def update(self, labels, preds):
+        import jax.numpy as jnp
         check_label_shapes(labels, preds)
         for label, pred in zip(labels, preds):
+            if isinstance(label, NDArray) and isinstance(pred, NDArray):
+                p, l = pred._data, label._data
+                if p.ndim > l.ndim:
+                    p = jnp.argmax(p, axis=self.axis)
+                p = p.astype(jnp.int32).reshape(-1)
+                l = _colocate(p, l.astype(jnp.int32).reshape(-1))
+                check_label_shapes(l, p, shape=True)
+                self._accum_device(
+                    jnp.sum(p == l).astype(jnp.float32), int(l.shape[0]))
+                continue
             label = _as_numpy(label)
             pred = _as_numpy(pred)
             if pred.ndim > label.ndim:
@@ -165,8 +206,19 @@ class TopKAccuracy(EvalMetric):
         self.name += "_%d" % top_k
 
     def update(self, labels, preds):
+        import jax
+        import jax.numpy as jnp
         check_label_shapes(labels, preds)
         for label, pred in zip(labels, preds):
+            if isinstance(label, NDArray) and isinstance(pred, NDArray):
+                p, l = pred._data, label._data
+                assert p.ndim == 2
+                _, topk = jax.lax.top_k(p, self.top_k)
+                l = _colocate(topk, l.astype(jnp.int32).reshape(-1, 1))
+                hits = jnp.sum(topk == l)
+                self._accum_device(hits.astype(jnp.float32),
+                                   int(l.shape[0]))
+                continue
             pred = _as_numpy(pred)
             label = _as_numpy(label).astype(_numpy.int32)
             assert pred.ndim == 2
@@ -296,8 +348,18 @@ class CrossEntropy(EvalMetric):
         self.eps = eps
 
     def update(self, labels, preds):
+        import jax.numpy as jnp
         check_label_shapes(labels, preds)
         for label, pred in zip(labels, preds):
+            if isinstance(label, NDArray) and isinstance(pred, NDArray):
+                p, l = pred._data, label._data.reshape(-1).astype(jnp.int32)
+                assert l.shape[0] == p.shape[0]
+                l = _colocate(p, l)
+                prob = jnp.take_along_axis(
+                    p.astype(jnp.float32), l[:, None], axis=1)[:, 0]
+                self._accum_device(-jnp.sum(jnp.log(prob + self.eps)),
+                                   int(l.shape[0]))
+                continue
             label = _as_numpy(label).ravel().astype(_numpy.int32)
             pred = _as_numpy(pred)
             assert label.shape[0] == pred.shape[0]

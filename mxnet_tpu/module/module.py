@@ -386,8 +386,9 @@ class Module(BaseModule):
             if self._kvstore is not None:
                 self._kvstore.push(keys, grads)
                 self._kvstore.pull(keys, out=grads)
-            for i, name in live:
-                self._updater(i, grad_dict[name], arg_dict[name])
+            # one fused dispatch for the whole parameter set (FusedUpdater)
+            self._updater.update_batch(
+                keys, grads, [arg_dict[name] for _, name in live])
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded

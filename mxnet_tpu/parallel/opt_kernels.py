@@ -79,18 +79,22 @@ def _adagrad_init(p):
 
 
 def _adagrad_update(p, g, s, t, h):
-    grad = _clip(g * h["rescale_grad"], h["clip_gradient"]) + h["wd"] * p
+    # reference optimizer.py AdaGrad:763-805 dense branch: wd stays OUT
+    # of the history accumulator; eps inside the sqrt
+    grad = _clip(g * h["rescale_grad"], h["clip_gradient"])
     hist = s[0] + jnp.square(grad)
-    w = p - h["lr"] * grad / (jnp.sqrt(hist) + h["epsilon"])
+    w = p - h["lr"] * (grad / jnp.sqrt(hist + h["epsilon"]) + h["wd"] * p)
     return w, (hist,)
 
 
 def _adadelta_update(p, g, s, t, h):
-    grad = _clip(g * h["rescale_grad"], h["clip_gradient"]) + h["wd"] * p
+    # reference optimizer.py AdaDelta: wd applies to the weight directly,
+    # not through the accumulators
+    grad = _clip(g * h["rescale_grad"], h["clip_gradient"])
     acc_g = h["rho"] * s[0] + (1.0 - h["rho"]) * jnp.square(grad)
     delta = jnp.sqrt((s[1] + h["epsilon"]) / (acc_g + h["epsilon"])) * grad
     acc_d = h["rho"] * s[1] + (1.0 - h["rho"]) * jnp.square(delta)
-    return p - delta, (acc_g, acc_d)
+    return p - delta - h["wd"] * p, (acc_g, acc_d)
 
 
 def _ftrl_update(p, g, s, t, h):

@@ -1,0 +1,224 @@
+"""FusedUpdater: the one-dispatch batched optimizer step must be
+numerically identical to the per-parameter eager Updater path for every
+kernel-backed optimizer (parity target: reference optimizer.py Updater +
+optimizer_op.cc fused kernels; the batching itself has no reference
+counterpart — it amortises device dispatch, which the reference's
+in-process engine never paid)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+
+
+def _params(seed, n=5, low=None):
+    rs = np.random.RandomState(seed)
+    shapes = [(7, 3), (16,), (4, 5, 2), (1,), (3, 8)]
+    ws, gs = [], []
+    for i, s in enumerate(shapes[:n]):
+        w = rs.randn(*s).astype(np.float32)
+        g = rs.randn(*s).astype(np.float32)
+        if low is not None and i % 2 == 0:
+            w = w.astype(low)
+            g = g.astype(low)
+        ws.append(mx.nd.array(w, dtype=w.dtype))
+        gs.append(mx.nd.array(g, dtype=g.dtype))
+    return ws, gs
+
+
+OPTS = [
+    ("sgd", {"learning_rate": 0.1}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4}),
+    ("nag", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+    ("rmsprop", {"learning_rate": 0.01}),
+    ("adagrad", {"learning_rate": 0.05}),
+    ("adadelta", {}),
+    ("ftrl", {"learning_rate": 0.05}),
+]
+
+
+@pytest.mark.parametrize("name,kw", OPTS,
+                         ids=[f"{n}-{i}" for i, (n, _) in enumerate(OPTS)])
+def test_fused_matches_eager(name, kw):
+    steps = 4
+    ref_ws, ref_gs = _params(0)
+    fus_ws, fus_gs = _params(0)
+
+    eager = opt.Updater(opt.create(name, **kw))
+    fused = opt.get_updater(opt.create(name, **kw))
+    assert isinstance(fused, opt.FusedUpdater)
+
+    idx = list(range(len(ref_ws)))
+    for step in range(steps):
+        for i in idx:
+            eager(i, ref_gs[i], ref_ws[i])
+        fused.update_batch(idx, fus_gs, fus_ws)
+    # adam's bias correction runs in f32 on device (traced t) vs f64 on
+    # host in the eager path — a few-ulp difference, not a semantic one
+    for a, b in zip(ref_ws, fus_ws):
+        np.testing.assert_allclose(a.asnumpy(), b.asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+    # states advanced identically too (t-dependent rules: adam bias corr)
+    for i in idx:
+        sa, sb = eager.states[i], fused.states[i]
+        flat_a = sa if isinstance(sa, tuple) else (sa,)
+        flat_b = sb if isinstance(sb, tuple) else (sb,)
+        for x, y in zip(flat_a, flat_b):
+            if x is not None:
+                np.testing.assert_allclose(x.asnumpy(), y.asnumpy(),
+                                           rtol=1e-5, atol=1e-6)
+
+
+def test_fused_multi_precision_sgd():
+    import jax.numpy as jnp
+    bf16 = np.dtype(jnp.bfloat16)
+    steps = 3
+    ref_ws, ref_gs = _params(1, low=bf16)
+    fus_ws, fus_gs = _params(1, low=bf16)
+    mk = lambda: opt.create("sgd", learning_rate=0.1, momentum=0.9,
+                            multi_precision=True)
+    eager, fused = opt.Updater(mk()), opt.FusedUpdater(mk())
+    idx = list(range(len(ref_ws)))
+    for _ in range(steps):
+        for i in idx:
+            eager(i, ref_gs[i], ref_ws[i])
+        fused.update_batch(idx, fus_gs, fus_ws)
+    for i, (a, b) in enumerate(zip(ref_ws, fus_ws)):
+        assert a.dtype == b.dtype
+        np.testing.assert_allclose(
+            a.asnumpy().astype(np.float32), b.asnumpy().astype(np.float32),
+            rtol=1e-2, atol=1e-3)
+    # fp32 masters must match tightly (bf16 rounding only at the cast)
+    for i in idx:
+        ma = eager.states[i][1].asnumpy()
+        mb = fused.states[i][1].asnumpy()
+        np.testing.assert_allclose(ma, mb, rtol=2e-6, atol=2e-7)
+
+
+def test_fused_lr_scheduler_and_mults():
+    """Scheduler-driven lr changes must NOT be baked into the compiled
+    program, and per-param lr/wd multipliers must apply."""
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    mk = lambda: opt.create("sgd", learning_rate=0.4, lr_scheduler=sched.__class__(step=2, factor=0.5))
+    ref_ws, ref_gs = _params(2, n=3)
+    fus_ws, fus_gs = _params(2, n=3)
+    o1, o2 = mk(), mk()
+    for o in (o1, o2):
+        o.set_lr_mult({0: 0.1})
+        o.set_wd_mult({1: 2.0})
+    eager, fused = opt.Updater(o1), opt.FusedUpdater(o2)
+    idx = [0, 1, 2]
+    for _ in range(5):
+        for i in idx:
+            eager(i, ref_gs[i], ref_ws[i])
+        fused.update_batch(idx, fus_gs, fus_ws)
+    assert o1.num_update == o2.num_update == 5
+    for a, b in zip(ref_ws, fus_ws):
+        np.testing.assert_allclose(a.asnumpy(), b.asnumpy(),
+                                   rtol=2e-6, atol=2e-7)
+
+
+def test_fused_fallbacks():
+    """Sparse grads, centered rmsprop, and kernel-less optimizers all
+    take the per-index path and still produce correct updates."""
+    # kernel-less: Test optimizer
+    fused = opt.FusedUpdater(opt.create("test", rescale_grad=1.0))
+    w = mx.nd.ones((3,))
+    g = mx.nd.ones((3,)) * 0.5
+    fused.update_batch([0], [g], [w])
+    np.testing.assert_allclose(w.asnumpy(), np.full((3,), 1.5), rtol=1e-6)
+
+    # centered rmsprop falls back (3-array state)
+    fused = opt.FusedUpdater(opt.create("rmsprop", learning_rate=0.01,
+                                        centered=True))
+    eager = opt.Updater(opt.create("rmsprop", learning_rate=0.01,
+                                   centered=True))
+    wf, wg = mx.nd.ones((4,)), mx.nd.ones((4,)) * 0.3
+    we, ge = mx.nd.ones((4,)), mx.nd.ones((4,)) * 0.3
+    fused.update_batch([0], [wg], [wf])
+    eager(0, ge, we)
+    np.testing.assert_allclose(wf.asnumpy(), we.asnumpy(), rtol=1e-6)
+
+    # row_sparse grad falls back to the lazy update
+    from mxnet_tpu.ndarray import sparse as sp
+    w = mx.nd.zeros((6, 4))
+    data = np.ones((2, 4), np.float32)
+    g = sp.row_sparse_array((data, [1, 4]), shape=(6, 4))
+    fused = opt.FusedUpdater(opt.create("sgd", learning_rate=1.0))
+    fused.update_batch([0], [g], [w])
+    out = w.asnumpy()
+    assert np.allclose(out[[1, 4]], -1.0)
+    assert np.allclose(out[[0, 2, 3, 5]], 0.0)
+
+
+def test_fused_state_roundtrip():
+    """get_states/set_states stay pickle-compatible across the fused
+    path (reference updater serialisation contract)."""
+    fused = opt.FusedUpdater(opt.create("adam", learning_rate=0.01))
+    ws, gs = _params(3, n=2)
+    fused.update_batch([0, 1], gs, ws)
+    blob = fused.get_states()
+    other = opt.FusedUpdater(opt.create("adam", learning_rate=0.01))
+    other.set_states(blob)
+    assert set(other.states) == {0, 1}
+    # and it keeps updating through the fused path after a load
+    other.update_batch([0, 1], gs, ws)
+
+
+def test_nag_multi_precision_eager_path():
+    """NAG with multi_precision on the per-index (non-kernel) path must
+    apply NAG's rule to the fp32 master and cast back — regression: the
+    class-level alias crashed on the (mom, w32) state tuple."""
+    import jax.numpy as jnp
+    bf16 = np.dtype(jnp.bfloat16)
+    w = mx.nd.array(np.linspace(-1, 1, 8).astype(np.float32).astype(bf16),
+                    dtype=bf16)
+    g = mx.nd.array(np.full((8,), 0.25, np.float32).astype(bf16),
+                    dtype=bf16)
+    up = opt.Updater(opt.create("nag", learning_rate=0.1, momentum=0.9,
+                                multi_precision=True))
+    # fp32 shadow of the same rule
+    w32 = np.linspace(-1, 1, 8).astype(np.float32).astype(bf16)
+    w32 = w32.astype(np.float32)
+    mom = np.zeros(8, np.float32)
+    g32 = np.full((8,), 0.25, np.float32).astype(bf16).astype(np.float32)
+    for _ in range(3):
+        up(0, g, w)
+        mom = 0.9 * mom + g32
+        w32 -= 0.1 * (g32 + 0.9 * mom)
+    np.testing.assert_allclose(up.states[0][1].asnumpy(), w32,
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(w.asnumpy().astype(np.float32),
+                               w32.astype(bf16).astype(np.float32),
+                               rtol=1e-2, atol=1e-3)
+    # momentum-less NAG mp path too (state = (None, w32))
+    up2 = opt.Updater(opt.create("nag", learning_rate=0.1,
+                                 multi_precision=True))
+    up2(0, g, w)
+
+
+def test_fused_set_states_recomputes_mp_flags():
+    """Loading states saved under a different multi_precision config must
+    not reuse stale flags — regression: _mp_flags survived set_states."""
+    import jax.numpy as jnp
+    bf16 = np.dtype(jnp.bfloat16)
+    mk_w = lambda: mx.nd.array(np.ones(4, np.float32).astype(bf16),
+                               dtype=bf16)
+    g = mx.nd.array(np.full((4,), 0.5, np.float32).astype(bf16),
+                    dtype=bf16)
+    # steps under multi_precision=False → flags cached False
+    plain = opt.FusedUpdater(opt.create("sgd", learning_rate=0.1,
+                                        momentum=0.9))
+    w = mk_w()
+    plain.update_batch([0], [g], [w])
+    # load states from a multi_precision=True run (optimizer dumped too)
+    mp = opt.FusedUpdater(opt.create("sgd", learning_rate=0.1, momentum=0.9,
+                                     multi_precision=True))
+    w2 = mk_w()
+    mp.update_batch([0], [g], [w2])
+    plain.set_states(mp.get_states(dump_optimizer=True))
+    w3 = mk_w()
+    plain.update_batch([0], [g], [w3])  # must classify (mom, w32) as mp
+    assert isinstance(plain.states[0], tuple) and len(plain.states[0]) == 2
+    assert plain.states[0][1].dtype == np.float32  # master survived
