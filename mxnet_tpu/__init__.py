@@ -83,6 +83,7 @@ __version__ = "0.1.0"
 from . import operator               # noqa: E402
 from . import rnn                    # noqa: E402
 from . import profiler               # noqa: E402
+from . import tuner                  # noqa: E402
 from . import monitor                # noqa: E402
 from .monitor import Monitor         # noqa: E402
 from . import visualization          # noqa: E402

@@ -75,8 +75,13 @@ _KNOBS = {
     "MXNET_CUDNN_AUTOTUNE_DEFAULT": ("mapped", "XLA autotunes"),
     "MXNET_CUDA_ALLOW_TENSOR_CORE": ("mapped", "MXU is always on; "
                                      "precision via jax matmul precision"),
-    "MXNET_USE_OPERATOR_TUNING": ("mapped", "XLA autotunes"),
-    "MXNET_OUTPUT_TUNING_DATA": ("mapped", "use jax profiler traces"),
+    "MXNET_USE_OPERATOR_TUNING": ("honored", "mxnet_tpu.tuner measures "
+                                  "dispatch-level candidates (Pallas "
+                                  "meta-params); XLA autotunes inside "
+                                  "programs"),
+    "MXNET_OUTPUT_TUNING_DATA": ("honored", "log tuner measurements"),
+    "MXNET_TUNING_CACHE": ("honored", "persist tuner decisions (JSON)"),
+    "MXNET_TUNING_REPEAT": ("honored", "timed runs per tuner candidate"),
     # storage / sparse
     "MXNET_STORAGE_FALLBACK_LOG_VERBOSE": ("honored", "warn on sparse -> "
                                            "dense fallbacks"),

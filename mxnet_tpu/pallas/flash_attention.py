@@ -36,11 +36,47 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = ["flash_attention", "flash_attention_carry"]
 
 DEFAULT_BLOCK_Q = 128
+# candidate Q-block sizes offered to the operator tuner (default first)
+TUNE_BLOCKS_Q = (128, 256, 512)
 NEG_INF = -1e30
 
 
 def _use_interpret():
     return jax.default_backend() != "tpu"
+
+
+def _resolve_block_q(q, k, causal, interpret):
+    """``block_q=None`` -> measured choice per (shape, dtype, causal)
+    signature via the operator tuner (mxnet_tpu.tuner ≙ reference
+    operator_tune.h:37-202). Interpret mode (off-TPU) skips measurement —
+    timings there say nothing about the MXU."""
+    if interpret:
+        return DEFAULT_BLOCK_Q
+    b, h, s_q, d = q.shape
+    s_kv = k.shape[2]
+    effective = []
+    for blk in TUNE_BLOCKS_Q:
+        e = min(blk, max(s_q, 1))
+        if e not in effective:
+            effective.append(e)
+    if len(effective) == 1:
+        return effective[0]
+    from ..tuner import tuned_choice
+
+    def mk(blk):
+        def thunk():
+            qz = jnp.zeros((b, h, s_q, d), q.dtype)
+            kz = jnp.zeros((b, h, s_kv, d), k.dtype)
+            return _forward(qz, kz, kz, causal, 1.0 / math.sqrt(d), blk,
+                            interpret)[0]
+        return thunk
+
+    key = "bh%d_sq%d_skv%d_d%d_%s_c%d" % (b * h, s_q, s_kv, d,
+                                          jnp.dtype(q.dtype).name,
+                                          int(causal))
+    label = tuned_choice("flash_attention.block_q", key,
+                         [(str(e), mk(e)) for e in effective], args=(q, k))
+    return int(label)
 
 
 def _attn_kernel(scalars_ref, q_ref, k_ref, v_ref, o_in_ref, m_in_ref,
@@ -194,19 +230,28 @@ def _forward(q, k, v, causal, scale, block_q, interpret):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal=False, scale=None,
-                    block_q=DEFAULT_BLOCK_Q, interpret=None):
+                    block_q=None, interpret=None):
     """Exact attention, (B, H, S, D) layout, O(block_q * S) memory.
 
     Differentiable; the forward runs as a Pallas kernel on TPU (interpret
     mode elsewhere), the backward recomputes probabilities blockwise from
-    the saved log-sum-exp.
+    the saved log-sum-exp. ``block_q=None`` (default) lets the operator
+    tuner measure-and-cache the Q-block size per signature.
     """
+    if interpret is None:
+        interpret = _use_interpret()
+    if block_q is None:
+        block_q = _resolve_block_q(q, k, causal, interpret)
     out, _ = _forward(q, k, v, causal, scale if scale is not None
                       else 1.0 / math.sqrt(q.shape[-1]), block_q, interpret)
     return out
 
 
 def _fwd(q, k, v, causal, scale, block_q, interpret):
+    if interpret is None:
+        interpret = _use_interpret()
+    if block_q is None:
+        block_q = _resolve_block_q(q, k, causal, interpret)
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     out, lse = _forward(q, k, v, causal, scale, block_q, interpret)
     return out, (q, k, v, out, lse)
@@ -214,6 +259,9 @@ def _fwd(q, k, v, causal, scale, block_q, interpret):
 
 def _bwd(causal, scale, block_q, interpret, res, g):
     q, k, v, out, lse = res
+    # the backward recompute loop is plain XLA (lax.map) — the block size
+    # only bounds its working set, so the untuned default serves
+    block_q = block_q if block_q is not None else DEFAULT_BLOCK_Q
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     b, h, s_q, d = q.shape
     s_kv = k.shape[2]

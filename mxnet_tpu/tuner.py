@@ -1,0 +1,172 @@
+"""Runtime implementation tuning — the TPU-native ``operator_tune``.
+
+Parity target: ``src/operator/operator_tune.{h,cc,-inl.h}``
+(operator_tune.h:37-202). The reference micro-benchmarks every
+elementwise kernel at startup to decide OMP-vs-serial per (op, size)
+(``IsOMPFaster``, operator_tune.h:114), gated by
+``MXNET_USE_OPERATOR_TUNING`` and dumped via
+``MXNET_OUTPUT_TUNING_DATA``.
+
+On TPU the intra-program half of that job belongs to XLA (it autotunes
+kernel selection and tiling during compilation), so this module tunes
+what the COMPILER cannot see: which of several lowerings the framework
+should dispatch in the first place — a Pallas kernel vs a plain-XLA
+composition, or a kernel meta-parameter like the flash-attention Q-block
+size. Decisions are made the reference's way — measure each candidate
+on the device the first time a (op, static-signature) pair is seen —
+then cached in-process and optionally persisted across processes.
+
+Env knobs (names follow the reference):
+- ``MXNET_USE_OPERATOR_TUNING``  (default 1): 0 disables measurement;
+  every choice falls back to the first (default) candidate.
+- ``MXNET_OUTPUT_TUNING_DATA``   (default 0): log each measurement.
+- ``MXNET_TUNING_CACHE``: path of a JSON file to load decisions from /
+  save them to (the reference's startup-tuning analogue of a warm
+  cache; first compile dominates candidate timing cost otherwise).
+- ``MXNET_TUNING_REPEAT``        (default 3): timed runs per candidate.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from .base import get_env
+from .log import get_logger
+
+__all__ = ["OperatorTuner", "tuner", "tuned_choice"]
+
+_log = get_logger("tuner")
+
+
+def _is_tracer(x):
+    import jax
+    return isinstance(x, jax.core.Tracer)
+
+
+class OperatorTuner:
+    """Measure-and-cache chooser over named implementation candidates.
+
+    ``choose(op, key, candidates)`` returns the label of the fastest
+    candidate for this (op, key) signature. ``candidates`` is an ordered
+    ``(label, thunk)`` sequence; each thunk runs its implementation once
+    on synthetic data and returns a jax value (timed to completion with
+    ``block_until_ready``). The first candidate is the default: it wins
+    without measurement when tuning is disabled or measurement fails.
+    """
+
+    def __init__(self):
+        self._cache = {}
+        self._records = []          # (op, key, label, {label: seconds})
+        self._loaded_path = None
+
+    # -- persistence -------------------------------------------------------
+    def _persist_path(self):
+        return get_env("MXNET_TUNING_CACHE", "", str) or None
+
+    def _load_persisted(self):
+        path = self._persist_path()
+        if path and path != self._loaded_path and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    self._cache.update(json.load(f))
+            except (OSError, ValueError) as e:
+                _log.warning("tuner: could not load %s: %s", path, e)
+            self._loaded_path = path
+
+    def _save_persisted(self):
+        path = self._persist_path()
+        if not path:
+            return
+        try:
+            with open(path, "w") as f:
+                json.dump(self._cache, f, indent=0, sort_keys=True)
+        except OSError as e:
+            _log.warning("tuner: could not save %s: %s", path, e)
+
+    # -- core --------------------------------------------------------------
+    @staticmethod
+    def enabled():
+        return bool(get_env("MXNET_USE_OPERATOR_TUNING", 1, int))
+
+    @staticmethod
+    def _cache_key(op, key):
+        return "%s|%s" % (op, key)
+
+    def choose(self, op, key, candidates):
+        """Pick a label from ``candidates`` for signature ``(op, key)``."""
+        candidates = list(candidates)
+        labels = [lab for lab, _ in candidates]
+        if len(candidates) == 1:
+            return labels[0]
+        self._load_persisted()
+        ck = self._cache_key(op, key)
+        hit = self._cache.get(ck)
+        if hit in labels:
+            return hit
+        if not self.enabled():
+            return labels[0]
+        best = self._measure(op, key, candidates)
+        self._cache[ck] = best
+        self._save_persisted()
+        return best
+
+    def cached(self, op, key, default):
+        """Trace-time lookup: never measures (measurement runs real device
+        work, which a traced context must not trigger)."""
+        self._load_persisted()
+        return self._cache.get(self._cache_key(op, key), default)
+
+    def _measure(self, op, key, candidates):
+        import jax
+        repeat = max(1, get_env("MXNET_TUNING_REPEAT", 3, int))
+        timings = {}
+        for label, thunk in candidates:
+            try:
+                jax.block_until_ready(thunk())       # compile + warm
+                best = float("inf")
+                for _ in range(repeat):
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(thunk())
+                    best = min(best, time.perf_counter() - t0)
+                timings[label] = best
+            except Exception as e:                   # candidate invalid here
+                _log.debug("tuner: %s[%s] candidate %r failed: %s",
+                           op, key, label, e)
+                timings[label] = float("inf")
+        winner = min(timings, key=timings.get)
+        if not (timings[winner] < float("inf")):
+            winner = candidates[0][0]                # all failed: default
+        self._records.append((op, key, winner, dict(timings)))
+        if get_env("MXNET_OUTPUT_TUNING_DATA", 0, int):
+            _log.info("tuner: %s[%s] -> %r  (%s)", op, key, winner,
+                      ", ".join("%s=%.3gms" % (l, t * 1e3)
+                                for l, t in timings.items()))
+        return winner
+
+    # -- introspection -----------------------------------------------------
+    def records(self):
+        """Measurement log: list of (op, key, winner, {label: seconds})."""
+        return list(self._records)
+
+    def clear(self):
+        self._cache.clear()
+        self._records.clear()
+        self._loaded_path = None
+
+
+_TUNER = OperatorTuner()
+
+
+def tuner():
+    return _TUNER
+
+
+def tuned_choice(op, key, candidates, args=()):
+    """Convenience dispatcher: measured choice when called eagerly, cached
+    choice (falling back to the default candidate) when any of ``args``
+    is a tracer — so ops using the tuner stay jit-safe."""
+    candidates = list(candidates)
+    if any(_is_tracer(a) for a in args):
+        return _TUNER.cached(op, key, candidates[0][0])
+    return _TUNER.choose(op, key, candidates)
