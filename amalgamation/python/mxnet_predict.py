@@ -1,0 +1,161 @@
+"""Standalone ctypes predictor over the amalgamated predict ABI.
+
+Parity target: reference ``amalgamation/python/mxnet_predict.py`` — a
+single-file, dependency-light (numpy + ctypes only, NO mxnet_tpu
+import) client of the predict shared library, for deployments that ship
+just ``libmxnet_predict.so`` and this file.
+
+Library lookup order: ``MXNET_PREDICT_LIB`` env var, then
+``libmxnet_predict.so`` next to this file's package, then the
+framework's full build (``mxnet_tpu/_lib/libmxtpu_predict.so``).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+__all__ = ["Predictor", "load_ndarray_file"]
+
+_mx_uint = ctypes.c_uint
+_float_p = ctypes.POINTER(ctypes.c_float)
+_uint_p = ctypes.POINTER(_mx_uint)
+
+
+def _find_lib():
+    here = os.path.dirname(os.path.abspath(__file__))
+    candidates = [
+        os.environ.get("MXNET_PREDICT_LIB") or "",
+        os.path.join(here, "..", "libmxnet_predict.so"),
+        os.path.join(here, "..", "..", "mxnet_tpu", "_lib",
+                     "libmxtpu_predict.so"),
+    ]
+    for c in candidates:
+        if c and os.path.exists(c):
+            return os.path.abspath(c)
+    raise OSError("libmxnet_predict.so not found; set MXNET_PREDICT_LIB "
+                  "or run `make` in amalgamation/")
+
+
+_lib = None
+
+
+def _load_lib():
+    global _lib
+    if _lib is None:
+        _lib = ctypes.CDLL(_find_lib(), ctypes.RTLD_GLOBAL)
+        _lib.MXGetLastError.restype = ctypes.c_char_p
+    return _lib
+
+
+def _check(rc):
+    if rc != 0:
+        raise RuntimeError(_load_lib().MXGetLastError().decode("utf-8",
+                                                               "replace"))
+
+
+def _c_strs(strings):
+    arr = (ctypes.c_char_p * len(strings))()
+    arr[:] = [s.encode("utf-8") for s in strings]
+    return arr
+
+
+_DEV = {"cpu": 1, "gpu": 2, "tpu": 2}  # accelerator rides dev_type 2
+
+
+class Predictor:
+    """Run inference from a symbol JSON + param blob, no framework import.
+
+    Parameters
+    ----------
+    symbol_json : str — graph JSON text (pass file contents)
+    param_raw_bytes : bytes — ``.params`` blob as saved by the framework
+    input_shapes : dict of input name -> shape tuple
+    dev_type, dev_id : device selection (default cpu)
+    output_names : optional list of internal node names to expose as
+        outputs (reference MXPredCreatePartialOut)
+    """
+
+    def __init__(self, symbol_json, param_raw_bytes, input_shapes,
+                 dev_type="cpu", dev_id=0, output_names=None):
+        lib = _load_lib()
+        names = list(input_shapes.keys())
+        indptr = [0]
+        shape_data = []
+        for name in names:
+            shape_data.extend(int(d) for d in input_shapes[name])
+            indptr.append(len(shape_data))
+        handle = ctypes.c_void_p()
+        dev = _DEV.get(dev_type, 1) if isinstance(dev_type, str) else dev_type
+        args = [symbol_json.encode("utf-8"), param_raw_bytes,
+                len(param_raw_bytes), dev, dev_id, len(names),
+                _c_strs(names), (_mx_uint * len(indptr))(*indptr),
+                (_mx_uint * len(shape_data))(*shape_data)]
+        if output_names:
+            _check(lib.MXPredCreatePartialOut(
+                *args, len(output_names), _c_strs(output_names),
+                ctypes.byref(handle)))
+        else:
+            _check(lib.MXPredCreate(*args, ctypes.byref(handle)))
+        self.handle = handle
+        self._shapes = {k: tuple(v) for k, v in input_shapes.items()}
+
+    def __del__(self):
+        if getattr(self, "handle", None):
+            _load_lib().MXPredFree(self.handle)
+            self.handle = None
+
+    def forward(self, **kwargs):
+        """Set named inputs (numpy arrays) and run the forward pass."""
+        lib = _load_lib()
+        for name, arr in kwargs.items():
+            arr = np.ascontiguousarray(arr, np.float32)
+            if name in self._shapes and arr.shape != self._shapes[name]:
+                raise ValueError("input %r shape %s != bound %s"
+                                 % (name, arr.shape, self._shapes[name]))
+            _check(lib.MXPredSetInput(
+                self.handle, name.encode("utf-8"),
+                arr.ctypes.data_as(_float_p), arr.size))
+        _check(lib.MXPredForward(self.handle))
+
+    def get_output(self, index):
+        """Fetch output ``index`` as a numpy array."""
+        lib = _load_lib()
+        sdata = _uint_p()
+        ndim = _mx_uint()
+        _check(lib.MXPredGetOutputShape(self.handle, index,
+                                        ctypes.byref(sdata),
+                                        ctypes.byref(ndim)))
+        shape = tuple(sdata[i] for i in range(ndim.value))
+        out = np.empty(shape, np.float32)
+        _check(lib.MXPredGetOutput(self.handle, index,
+                                   out.ctypes.data_as(_float_p), out.size))
+        return out
+
+
+def load_ndarray_file(nd_bytes):
+    """Load a ``.params``/``nd.save`` blob into {name: numpy array}
+    through the library (reference MXNDListCreate/Get/Free)."""
+    lib = _load_lib()
+    handle = ctypes.c_void_p()
+    length = _mx_uint()
+    _check(lib.MXNDListCreate(nd_bytes, len(nd_bytes),
+                              ctypes.byref(handle), ctypes.byref(length)))
+    out = {}
+    try:
+        for i in range(length.value):
+            key = ctypes.c_char_p()
+            data = _float_p()
+            sdata = _uint_p()
+            ndim = _mx_uint()
+            _check(lib.MXNDListGet(handle, i, ctypes.byref(key),
+                                   ctypes.byref(data), ctypes.byref(sdata),
+                                   ctypes.byref(ndim)))
+            shape = tuple(sdata[j] for j in range(ndim.value))
+            n = int(np.prod(shape)) if shape else 1
+            arr = np.array(data[:n], np.float32).reshape(shape)
+            out[(key.value or b"").decode("utf-8")] = arr
+    finally:
+        lib.MXNDListFree(handle)
+    return out

@@ -16,17 +16,35 @@ import numpy as np
 from .predictor import Predictor
 
 __all__ = ["create", "set_input", "forward", "output_ndim", "output_shape",
-           "output_size", "copy_output", "num_outputs"]
+           "output_size", "copy_output", "num_outputs", "ndlist_create",
+           "ndlist_len", "ndlist_entry"]
 
 
-def create(symbol_json, param_bytes, dev_type, dev_id, names, shapes):
-    """(parity: MXPredCreate) names/shapes describe the input nodes."""
+def create(symbol_json, param_bytes, dev_type, dev_id, names, shapes,
+           output_keys=None):
+    """(parity: MXPredCreate / MXPredCreatePartialOut) names/shapes
+    describe the input nodes; ``output_keys`` (if given) selects internal
+    nodes as the outputs, reference-style ``name`` or ``name_output``."""
     from .context import Context
     ctx = Context(Context.devtype2str.get(dev_type, "cpu"), dev_id) \
         if isinstance(dev_type, int) else None
     input_shapes = {n: tuple(int(d) for d in s)
                     for n, s in zip(names, shapes)}
-    return Predictor(symbol_json, bytes(param_bytes), input_shapes, ctx=ctx)
+    symbol = symbol_json
+    if output_keys:
+        from . import symbol as _sym
+        if not isinstance(symbol, _sym.Symbol):
+            symbol = _sym.load_json(symbol)
+        internals = symbol.get_internals()
+        avail = set(internals.list_outputs())
+        picked = []
+        for key in output_keys:
+            name = key if key in avail else key + "_output"
+            if name not in avail:
+                raise ValueError("unknown output node %r" % key)
+            picked.append(internals[name])
+        symbol = picked[0] if len(picked) == 1 else _sym.Group(picked)
+    return Predictor(symbol, bytes(param_bytes), input_shapes, ctx=ctx)
 
 
 def set_input(pred, name, addr, size):
@@ -65,3 +83,37 @@ def copy_output(pred, index, addr, size):
         raise ValueError("output buffer too small: %d < %d"
                          % (size, flat.size))
     ctypes.memmove(addr, flat.ctypes.data, flat.size * 4)
+
+
+# -- NDArray-list blob access (parity: MXNDListCreate/Get/Free) -------------
+# The C handle owns the Python list returned by ndlist_create; every
+# pointer handed to C (name bytes, float32 data, uint32 shape) is backed
+# by an object stored IN that list, so it stays valid until MXNDListFree
+# drops the handle.
+
+def ndlist_create(param_bytes):
+    """Parse an ``nd.save`` blob into [(name_bytes, f32_data, u32_shape)]."""
+    from .ndarray import utils as _nd_utils
+    loaded = _nd_utils.load_frombuffer(bytes(param_bytes))
+    if isinstance(loaded, dict):
+        items = list(loaded.items())
+    else:
+        items = [("", v) for v in loaded]
+    out = []
+    for name, arr in items:
+        data = np.ascontiguousarray(arr.asnumpy().astype(np.float32,
+                                                         copy=False))
+        shape = np.asarray(data.shape, np.uint32)
+        out.append((name.encode("utf-8"), data.ravel(), shape))
+    return out
+
+
+def ndlist_len(lst):
+    return len(lst)
+
+
+def ndlist_entry(lst, index):
+    """-> (name_bytes, data_addr, shape_addr, ndim) for the C side."""
+    name, data, shape = lst[index]
+    return (name, int(data.ctypes.data), int(shape.ctypes.data),
+            int(shape.size))

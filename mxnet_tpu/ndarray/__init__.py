@@ -4,7 +4,7 @@ Parity: reference ``python/mxnet/ndarray/__init__.py``.
 """
 from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
                       concatenate, moveaxis, waitall, onehot_encode)
-from .utils import save, load
+from .utils import save, load, load_frombuffer
 from . import register as _register
 
 # code-gen every registered op into this module (mx.nd.dot, mx.nd.Convolution…)

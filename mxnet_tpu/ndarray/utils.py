@@ -54,10 +54,22 @@ def load(fname):
         # seekable handles (local files) stream straight into np.load;
         # only non-seekable registered-scheme streams get buffered
         src = f if f.seekable() else _io.BytesIO(f.read())
-        with np.load(src, allow_pickle=False) as npz:
-            keys = list(npz.keys())
-            if keys and all(k.startswith(_LIST_PREFIX) for k in keys):
-                items = sorted(keys,
-                               key=lambda k: int(k[len(_LIST_PREFIX):]))
-                return [array(npz[k]) for k in items]
-            return {k: array(npz[k]) for k in keys}
+        return _load_npz(src)
+
+
+def load_frombuffer(buf):
+    """Load NDArrays from an in-memory save blob (parity:
+    mx.nd.load_frombuffer / C MXNDArrayLoadFromBuffer — the path the
+    predict ABI's MXNDListCreate uses)."""
+    import io as _io
+    return _load_npz(_io.BytesIO(bytes(buf)))
+
+
+def _load_npz(src):
+    with np.load(src, allow_pickle=False) as npz:
+        keys = list(npz.keys())
+        if keys and all(k.startswith(_LIST_PREFIX) for k in keys):
+            items = sorted(keys,
+                           key=lambda k: int(k[len(_LIST_PREFIX):]))
+            return [array(npz[k]) for k in items]
+        return {k: array(npz[k]) for k in keys}

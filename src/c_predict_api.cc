@@ -54,11 +54,14 @@ extern "C" {
 
 // MXGetLastError is exported by embed_common.cc
 
-int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
-                 int param_size, int dev_type, int dev_id,
-                 mx_uint num_input_nodes, const char** input_keys,
-                 const mx_uint* input_shape_indptr,
-                 const mx_uint* input_shape_data, PredictorHandle* out) {
+int MXPredCreatePartialOut(const char* symbol_json_str,
+                           const void* param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           mx_uint num_input_nodes, const char** input_keys,
+                           const mx_uint* input_shape_indptr,
+                           const mx_uint* input_shape_data,
+                           mx_uint num_output_nodes,
+                           const char** output_keys, PredictorHandle* out) {
   PyGILState_STATE gil = EnsurePython();
   int rc = -1;
   PyObject* mod = HelperModule();
@@ -77,14 +80,23 @@ int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
       PyList_SetItem(shp, j - lo, PyLong_FromLong(input_shape_data[j]));
     PyList_SetItem(shapes, i, shp);
   }
+  PyObject* outputs = Py_None;
+  Py_INCREF(Py_None);
+  if (num_output_nodes > 0) {
+    Py_DECREF(Py_None);
+    outputs = PyList_New(num_output_nodes);
+    for (mx_uint i = 0; i < num_output_nodes; ++i)
+      PyList_SetItem(outputs, i, PyUnicode_FromString(output_keys[i]));
+  }
   PyObject* params = PyBytes_FromStringAndSize(
       static_cast<const char*>(param_bytes), param_size);
   PyObject* pred = PyObject_CallMethod(
-      mod, "create", "sOiiOO", symbol_json_str, params, dev_type, dev_id,
-      names, shapes);
+      mod, "create", "sOiiOOO", symbol_json_str, params, dev_type, dev_id,
+      names, shapes, outputs);
   Py_DECREF(params);
   Py_DECREF(names);
   Py_DECREF(shapes);
+  Py_DECREF(outputs);
   if (pred == nullptr) {
     CaptureError();
   } else {
@@ -95,6 +107,17 @@ int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
   }
   PyGILState_Release(gil);
   return rc;
+}
+
+int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char** input_keys,
+                 const mx_uint* input_shape_indptr,
+                 const mx_uint* input_shape_data, PredictorHandle* out) {
+  return MXPredCreatePartialOut(symbol_json_str, param_bytes, param_size,
+                                dev_type, dev_id, num_input_nodes,
+                                input_keys, input_shape_indptr,
+                                input_shape_data, 0, nullptr, out);
 }
 
 int MXPredSetInput(PredictorHandle handle, const char* key,
@@ -156,12 +179,85 @@ int MXPredGetOutput(PredictorHandle handle, mx_uint index, float* data,
   return rc;
 }
 
+// The reference steps the graph one engine op at a time
+// (c_predict_api.cc PartialForward). Here the whole forward is ONE XLA
+// program — the minimal faithful mapping is a single step: step 0 runs
+// the program, *step_left reports 0 afterwards.
+int MXPredPartialForward(PredictorHandle handle, int step, int* step_left) {
+  if (step_left != nullptr) *step_left = 0;
+  if (step > 0) return 0;  // whole program already ran at step 0
+  return MXPredForward(handle);
+}
+
 int MXPredFree(PredictorHandle handle) {
   Pred* p = static_cast<Pred*>(handle);
   PyGILState_STATE gil = EnsurePython();
   Py_XDECREF(p->obj);
   PyGILState_Release(gil);
   delete p;
+  return 0;
+}
+
+// -- NDArray-list access over a saved blob (MXNDList*) ----------------------
+// Handle owns the helper-module list; every pointer handed out (name,
+// data, shape) is backed by objects stored in that list, valid until
+// MXNDListFree.
+
+typedef void* NDListHandle;
+
+int MXNDListCreate(const char* nd_file_bytes, int nd_file_size,
+                   NDListHandle* out, mx_uint* out_length) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* mod = HelperModule();
+  if (mod == nullptr) {
+    CaptureError();
+    PyGILState_Release(gil);
+    return -1;
+  }
+  PyObject* blob = PyBytes_FromStringAndSize(nd_file_bytes, nd_file_size);
+  PyObject* lst = PyObject_CallMethod(mod, "ndlist_create", "O", blob);
+  Py_DECREF(blob);
+  if (lst == nullptr) {
+    CaptureError();
+    PyGILState_Release(gil);
+    return -1;
+  }
+  *out = lst;
+  *out_length = static_cast<mx_uint>(PyList_Size(lst));
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXNDListGet(NDListHandle handle, mx_uint index, const char** out_key,
+                const float** out_data, const mx_uint** out_shape,
+                mx_uint* out_ndim) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* entry = PyObject_CallMethod(
+      HelperModule(), "ndlist_entry", "OI",
+      static_cast<PyObject*>(handle), index);
+  if (entry == nullptr) {
+    CaptureError();
+    PyGILState_Release(gil);
+    return -1;
+  }
+  // (name_bytes, data_addr, shape_addr, ndim); the bytes/array objects
+  // live in the handle's list, so the raw pointers outlive `entry`
+  *out_key = PyBytes_AsString(PyTuple_GetItem(entry, 0));
+  *out_data = reinterpret_cast<const float*>(
+      PyLong_AsLongLong(PyTuple_GetItem(entry, 1)));
+  *out_shape = reinterpret_cast<const mx_uint*>(
+      PyLong_AsLongLong(PyTuple_GetItem(entry, 2)));
+  *out_ndim = static_cast<mx_uint>(
+      PyLong_AsLong(PyTuple_GetItem(entry, 3)));
+  Py_DECREF(entry);
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXNDListFree(NDListHandle handle) {
+  PyGILState_STATE gil = EnsurePython();
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  PyGILState_Release(gil);
   return 0;
 }
 

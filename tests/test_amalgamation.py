@@ -1,0 +1,106 @@
+"""Amalgamated predict-only build: one generated .cc -> one .so -> the
+standalone ctypes wrapper scores a saved model with NO mxnet_tpu import
+on the client side (parity model: reference amalgamation/ +
+python/mxnet_predict.py)."""
+import importlib.util
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+AMAL = os.path.join(REPO, "amalgamation")
+
+
+@pytest.fixture(scope="module")
+def built_lib(tmp_path_factory):
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ compiler")
+    rc = subprocess.run(["make", "-s"], cwd=AMAL, capture_output=True,
+                        text=True)
+    if rc.returncode != 0:
+        pytest.fail("amalgamation build failed:\n%s" % rc.stderr[-2000:])
+    lib = os.path.join(AMAL, "libmxnet_predict.so")
+    assert os.path.exists(lib)
+    return lib
+
+
+@pytest.fixture(scope="module")
+def wrapper(built_lib):
+    os.environ["MXNET_PREDICT_LIB"] = built_lib
+    spec = importlib.util.spec_from_file_location(
+        "mxnet_predict", os.path.join(AMAL, "python", "mxnet_predict.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def tiny_model(tmp_path_factory):
+    import mxnet_tpu as mx
+    tmp = tmp_path_factory.mktemp("amal_model")
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, name="fc1", num_hidden=3)
+    out = mx.sym.SoftmaxOutput(fc, name="softmax")
+    mod = mx.mod.Module(out, data_names=["data"],
+                        label_names=["softmax_label"])
+    mod.bind(data_shapes=[("data", (2, 4))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params(mx.initializer.Uniform(0.5))
+    prefix = str(tmp / "tiny")
+    mod.save_checkpoint(prefix, 0)
+    arg_params, _ = mod.get_params()
+    with open(prefix + "-symbol.json") as f:
+        sym_json = f.read()
+    with open(prefix + "-0000.params", "rb") as f:
+        params = f.read()
+    return sym_json, params, {k: v.asnumpy() for k, v in arg_params.items()}
+
+
+def _softmax(z):
+    e = np.exp(z - z.max(axis=1, keepdims=True))
+    return e / e.sum(axis=1, keepdims=True)
+
+
+def test_standalone_predictor(wrapper, tiny_model):
+    sym_json, params, arg_params = tiny_model
+    x = np.random.RandomState(0).uniform(size=(2, 4)).astype(np.float32)
+    pred = wrapper.Predictor(sym_json, params, {"data": (2, 4)})
+    pred.forward(data=x)
+    got = pred.get_output(0)
+    want = _softmax(x @ arg_params["fc1_weight"].T + arg_params["fc1_bias"])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_partial_out(wrapper, tiny_model):
+    """MXPredCreatePartialOut exposes an internal node (pre-softmax)."""
+    sym_json, params, arg_params = tiny_model
+    x = np.random.RandomState(1).uniform(size=(2, 4)).astype(np.float32)
+    pred = wrapper.Predictor(sym_json, params, {"data": (2, 4)},
+                             output_names=["fc1"])
+    pred.forward(data=x)
+    got = pred.get_output(0)
+    want = x @ arg_params["fc1_weight"].T + arg_params["fc1_bias"]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_load_ndarray_file(wrapper, tiny_model):
+    _, params, arg_params = tiny_model
+    loaded = wrapper.load_ndarray_file(params)
+    assert set(loaded) == {"arg:fc1_weight", "arg:fc1_bias"}
+    np.testing.assert_allclose(loaded["arg:fc1_weight"],
+                               arg_params["fc1_weight"], rtol=1e-6)
+
+
+def test_amalgamated_file_is_single_unit(built_lib):
+    src = os.path.join(AMAL, "mxnet_predict-all.cc")
+    assert os.path.exists(src)
+    with open(src) as f:
+        text = f.read()
+    assert '#include "' not in text  # every local include was inlined
+    for sym in ("MXPredCreatePartialOut", "MXPredPartialForward",
+                "MXNDListCreate", "MXGetLastError"):
+        assert sym in text
