@@ -16,6 +16,7 @@ from . import rnn         # noqa: F401
 from . import ctc         # noqa: F401
 from . import contrib     # noqa: F401
 from . import contrib_extra  # noqa: F401
+from . import contrib_extra3  # noqa: F401
 from . import spatial     # noqa: F401
 
 from . import shape_infer as _shape_infer  # noqa: E402

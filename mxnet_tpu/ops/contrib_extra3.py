@@ -1,0 +1,371 @@
+"""Final op-registry gap closure (round-3 sweep vs the reference registry).
+
+Parity targets:
+- _contrib_PSROIPooling            reference src/operator/contrib/psroi_pooling.cc
+- _contrib_DeformablePSROIPooling  contrib/deformable_psroi_pooling.cc
+- _contrib_MultiProposal           contrib/multi_proposal.cc
+- _contrib_count_sketch            contrib/count_sketch.cc
+- _contrib_SparseEmbedding         src/operator/tensor/indexing_op.cc
+- _linalg_gelqf / _linalg_syevd    src/operator/tensor/la_op.cc:483-601
+- reshape_like                     tensor/elemwise_unary_op.cc
+- _slice_assign / _slice_assign_scalar  tensor/matrix_op.cc:313-360
+- _scatter_set_nd                  tensor/indexing_op.cc:550
+- Crop                             src/operator/crop.cc (legacy)
+- Convolution_v1 / Pooling_v1 / CuDNNBatchNorm  legacy/cudnn aliases
+- _CrossDeviceCopy                 src/operator/cross_device_copy.cc
+
+TPU-first notes: PSROIPooling reduces each bin with two batched einsum
+contractions (W then H) against dynamic interval masks — MXU matmuls
+instead of the reference's per-output scalar loops; the position-
+sensitive channel map is static per (ctop, ph, pw) and becomes one
+gather. DeformablePSROIPooling vectorises the sample grid and reuses
+the bilinear gather from DeformableConvolution. count_sketch is a
+matmul against a one-hot scatter matrix (hash is data-independent).
+Gradients fall out of jax.vjp — no hand-written backward kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import MXNetError
+from .common import as_tuple
+from .registry import register, get_op, alias
+from .contrib_extra import _bilinear_gather
+
+
+# ---------------------------------------------------------------------------
+# PSROIPooling (R-FCN position-sensitive ROI pooling)
+# ---------------------------------------------------------------------------
+
+def _ps_channel_index(output_dim, pooled, group):
+    """Static (output_dim, P, P) channel map c = (ctop*G + gh)*G + gw."""
+    ph = np.arange(pooled)
+    gh = np.clip((ph * group) // pooled, 0, group - 1)
+    c = (np.arange(output_dim)[:, None, None] * group +
+         gh[None, :, None]) * group + gh[None, None, :]
+    return c.astype(np.int32)
+
+
+@register("_contrib_PSROIPooling", nin=2, jit=True,
+          arg_names=["data", "rois"],
+          defaults={"spatial_scale": 1.0, "output_dim": 0, "pooled_size": 0,
+                    "group_size": 0},
+          aliases=("_contrib_psroipooling",))
+def psroi_pooling(data, rois, spatial_scale=1.0, output_dim=0,
+                  pooled_size=0, group_size=0):
+    """Position-sensitive ROI average pooling (reference
+    psroi_pooling.cu PSROIPoolForwardKernel): each output bin averages
+    its own channel group over the bin's [start, end) extent; ROI coords
+    are rounded then scaled; empty bins emit 0."""
+    P = int(pooled_size)
+    G = int(group_size) or P
+    od = int(output_dim)
+    N, C, H, W = data.shape
+    if C != od * G * G:
+        raise MXNetError("PSROIPooling: channels %d != output_dim*group^2"
+                         % C)
+    f32 = jnp.float32
+    batch = rois[:, 0].astype(jnp.int32)
+    x1 = jnp.round(rois[:, 1]).astype(f32) * spatial_scale
+    y1 = jnp.round(rois[:, 2]).astype(f32) * spatial_scale
+    x2 = (jnp.round(rois[:, 3]) + 1.0).astype(f32) * spatial_scale
+    y2 = (jnp.round(rois[:, 4]) + 1.0).astype(f32) * spatial_scale
+    rw = jnp.maximum(x2 - x1, 0.1)
+    rh = jnp.maximum(y2 - y1, 0.1)
+    bin_h = rh / P                                   # (R,)
+    bin_w = rw / P
+
+    ph = jnp.arange(P, dtype=f32)
+    hstart = jnp.clip(jnp.floor(ph[None] * bin_h[:, None] + y1[:, None]),
+                      0, H)                          # (R, P)
+    hend = jnp.clip(jnp.ceil((ph[None] + 1) * bin_h[:, None] + y1[:, None]),
+                    0, H)
+    wstart = jnp.clip(jnp.floor(ph[None] * bin_w[:, None] + x1[:, None]),
+                      0, W)
+    wend = jnp.clip(jnp.ceil((ph[None] + 1) * bin_w[:, None] + x1[:, None]),
+                    0, W)
+
+    hs = jnp.arange(H, dtype=f32)
+    ws = jnp.arange(W, dtype=f32)
+    mask_h = ((hs[None, None] >= hstart[..., None]) &
+              (hs[None, None] < hend[..., None])).astype(f32)   # (R, P, H)
+    mask_w = ((ws[None, None] >= wstart[..., None]) &
+              (ws[None, None] < wend[..., None])).astype(f32)   # (R, P, W)
+
+    sel = data[batch].astype(f32)                    # (R, C, H, W)
+    # reduce W then H on the MXU
+    t = jnp.einsum("rchw,rqw->rchq", sel, mask_w)
+    t = jnp.einsum("rchq,rph->rcpq", t, mask_h)      # (R, C, P, P)
+
+    cidx = jnp.asarray(_ps_channel_index(od, P, G))  # (od, P, P)
+    pi = jnp.arange(P)
+    out = t[:, cidx, pi[None, :, None], pi[None, None, :]]  # (R, od, P, P)
+
+    area = ((hend - hstart)[:, None, :, None] *
+            (wend - wstart)[:, None, None, :])       # (R, 1, P, P)
+    out = jnp.where(area > 0, out / jnp.maximum(area, 1.0), 0.0)
+    return out.astype(data.dtype)
+
+
+@register("_contrib_DeformablePSROIPooling", nin=3, jit=True, nout=2,
+          arg_names=["data", "rois", "trans"],
+          defaults={"spatial_scale": 1.0, "output_dim": 0, "group_size": 0,
+                    "pooled_size": 0, "part_size": 0, "sample_per_part": 1,
+                    "trans_std": 0.0, "no_trans": False})
+def deformable_psroi_pooling(data, rois, trans, spatial_scale=1.0,
+                             output_dim=0, group_size=0, pooled_size=0,
+                             part_size=0, sample_per_part=1, trans_std=0.0,
+                             no_trans=False):
+    """Deformable position-sensitive ROI pooling (reference
+    deformable_psroi_pooling.cu): each bin averages sample_per_part^2
+    bilinear samples, offset by the (trans_std-scaled) transform of its
+    part cell. Returns (output, sample_count) like the reference."""
+    P = int(pooled_size)
+    G = int(group_size) or P
+    od = int(output_dim)
+    ps = int(part_size) or P
+    sp = int(sample_per_part)
+    N, C, H, W = data.shape
+    f32 = jnp.float32
+    R = rois.shape[0]
+
+    batch = rois[:, 0].astype(jnp.int32)
+    x1 = jnp.round(rois[:, 1]).astype(f32) * spatial_scale - 0.5
+    y1 = jnp.round(rois[:, 2]).astype(f32) * spatial_scale - 0.5
+    x2 = (jnp.round(rois[:, 3]) + 1.0).astype(f32) * spatial_scale - 0.5
+    y2 = (jnp.round(rois[:, 4]) + 1.0).astype(f32) * spatial_scale - 0.5
+    rw = jnp.maximum(x2 - x1, 0.1)
+    rh = jnp.maximum(y2 - y1, 0.1)
+    bin_h = rh / P
+    bin_w = rw / P
+    sub_h = bin_h / sp
+    sub_w = bin_w / sp
+
+    # part cell of each pooled index and class of each ctop — static maps
+    ph_idx = np.arange(P)
+    part_cell = np.floor(ph_idx / P * ps).astype(np.int32)       # (P,)
+    if no_trans:
+        n_cls = 1
+        cls_of = np.zeros(od, np.int32)
+    else:
+        n_cls = int(trans.shape[1]) // 2
+        cls_of = (np.arange(od) // max(od // n_cls, 1)).astype(np.int32)
+        tr = trans.astype(f32).reshape(R, n_cls, 2, ps, ps)
+        # offsets at each bin's part cell: (R, n_cls, P, P)
+        tx = tr[:, :, 0][:, :, part_cell][:, :, :, part_cell] * trans_std
+        ty = tr[:, :, 1][:, :, part_cell][:, :, :, part_cell] * trans_std
+
+    ph_f = jnp.asarray(ph_idx, f32)
+    ih = jnp.arange(sp, dtype=f32)
+    r1 = (slice(None), None, None, None, None)
+    # bin origins: h varies over axis 1 (ph), w over axis 2 (pw)
+    bh = ph_f[None, :, None, None, None] * bin_h[r1] + y1[r1]
+    bw = ph_f[None, None, :, None, None] * bin_w[r1] + x1[r1]
+    sh = ih[None, None, None, :, None] * sub_h[r1]     # sample row offset
+    sw = ih[None, None, None, None, :] * sub_w[r1]
+
+    cidx = jnp.asarray(_ps_channel_index(od, P, G))               # (od,P,P)
+    sel = data[batch].astype(f32)                                 # (R,C,H,W)
+
+    def one_roi(img, hc, wc, ok):
+        # img (C, H, W); hc/wc/ok (P, P, sp, sp)
+        vals = _bilinear_gather(img, hc, wc) * ok.astype(f32)
+        return vals.sum((-1, -2))                                 # (C, P, P)
+
+    outs = jnp.zeros((R, od, P, P), f32)
+    counts = jnp.zeros((R, od, P, P), f32)
+    pi = jnp.arange(P)
+    for cls in range(n_cls):
+        if no_trans:
+            oy = ox = jnp.zeros((R, 1, 1, 1, 1), f32)
+        else:
+            oy = (ty[:, cls] * rh[:, None, None])[..., None, None]
+            ox = (tx[:, cls] * rw[:, None, None])[..., None, None]
+        hh = jnp.broadcast_to(bh + oy + sh, (R, P, P, sp, sp))
+        ww = jnp.broadcast_to(bw + ox + sw, (R, P, P, sp, sp))
+        ok = ((ww > -0.5) & (ww < W - 0.5) &
+              (hh > -0.5) & (hh < H - 0.5))
+        hc = jnp.clip(hh, 0.0, H - 1.0)
+        wc = jnp.clip(ww, 0.0, W - 1.0)
+        summed = jax.vmap(one_roi)(sel, hc, wc, ok)               # (R,C,P,P)
+        cnt = ok.astype(f32).sum((-1, -2))                        # (R, P, P)
+        picked = summed[:, cidx, pi[None, :, None], pi[None, None, :]]
+        mask = jnp.asarray(cls_of == cls)[None, :, None, None]
+        outs = jnp.where(mask, picked, outs)
+        counts = jnp.where(mask, cnt[:, None], counts)
+    out = jnp.where(counts > 0, outs / jnp.maximum(counts, 1.0), 0.0)
+    return out.astype(data.dtype), counts.astype(data.dtype)
+
+
+get_op("_contrib_DeformablePSROIPooling").visible_outputs = 1
+
+
+# ---------------------------------------------------------------------------
+# MultiProposal — batched RPN proposal generation
+# ---------------------------------------------------------------------------
+
+@register("_contrib_MultiProposal", nin=3, jit=True,
+          arg_names=["cls_prob", "bbox_pred", "im_info"], nout=2,
+          defaults={"rpn_pre_nms_top_n": 6000, "rpn_post_nms_top_n": 300,
+                    "threshold": 0.7, "rpn_min_size": 16,
+                    "scales": (4.0, 8.0, 16.0, 32.0),
+                    "ratios": (0.5, 1.0, 2.0), "feature_stride": 16,
+                    "output_score": False, "iou_loss": False},
+          no_grad=True, aliases=("MultiProposal",))
+def multi_proposal(cls_prob, bbox_pred, im_info, **params):
+    """Batched Proposal (reference contrib/multi_proposal.cc): the
+    single-image RPN applied per image, batch index written into
+    rois[:, 0]. Output (B*post_nms, 5) rois + scores."""
+    from .contrib_extra import proposal
+    B = cls_prob.shape[0]
+    rois_all, scores_all = [], []
+    for i in range(B):
+        rois, scores = proposal(cls_prob[i:i + 1], bbox_pred[i:i + 1],
+                                im_info[i:i + 1], **params)
+        rois = rois.at[:, 0].set(float(i))
+        rois_all.append(rois)
+        scores_all.append(scores)
+    return jnp.concatenate(rois_all, 0), jnp.concatenate(scores_all, 0)
+
+
+get_op("_contrib_MultiProposal").visible_outputs = 1
+
+
+# ---------------------------------------------------------------------------
+# count_sketch (compact bilinear pooling)
+# ---------------------------------------------------------------------------
+
+@register("_contrib_count_sketch", nin=3, jit=True,
+          arg_names=["data", "h", "s"],
+          defaults={"out_dim": 0, "processing_batch_size": 32})
+def count_sketch(data, h, s, out_dim=0, processing_batch_size=32):
+    """Count-sketch projection (reference contrib/count_sketch.cu
+    sketch_forward_kernel): out[..., h[j]] += s[j] * data[..., j].
+    Expressed as one matmul against the static one-hot scatter matrix —
+    MXU-native, and the transpose in the backward falls out of jax.vjp.
+    processing_batch_size (a GPU grid knob) is accepted and ignored."""
+    od = int(out_dim)
+    in_dim = data.shape[-1]
+    scatter = (h.astype(jnp.int32)[:, None] ==
+               jnp.arange(od)[None, :]).astype(data.dtype)        # (in, od)
+    scatter = scatter * s.astype(data.dtype)[:, None]
+    return (data.reshape(-1, in_dim) @ scatter) \
+        .reshape(data.shape[:-1] + (od,))
+
+
+# ---------------------------------------------------------------------------
+# linalg: LQ factorization + symmetric eigendecomposition
+# ---------------------------------------------------------------------------
+
+@register("_linalg_gelqf", nout=2, aliases=("linalg_gelqf",),
+          arg_names=["A"])
+def linalg_gelqf(A):
+    """LQ factorization A = L * Q with Q row-orthonormal, L lower
+    triangular (reference la_op.cc:483-541 — LAPACK gelqf+orglq).
+    A (..., x, y) with x <= y; Q (..., x, y), L (..., x, x)."""
+    q1, r1 = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
+    return jnp.swapaxes(q1, -1, -2), jnp.swapaxes(r1, -1, -2)
+
+
+@register("_linalg_syevd", nout=2, aliases=("linalg_syevd",),
+          arg_names=["A"])
+def linalg_syevd(A):
+    """Symmetric eigendecomposition A = U^T * diag(L) * U, rows of U are
+    the eigenvectors, L ascending (reference la_op.cc syevd)."""
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+# ---------------------------------------------------------------------------
+# reshape_like, slice-assign internals, scatter_set_nd
+# ---------------------------------------------------------------------------
+
+@register("reshape_like", nin=2, arg_names=["lhs", "rhs"])
+def reshape_like(lhs, rhs):
+    """Reshape lhs to rhs's shape (reference elemwise_unary_op.cc)."""
+    return lhs.reshape(rhs.shape)
+
+
+def _slice_tuple(shape, begin, end, step):
+    begin = as_tuple(begin)
+    end = as_tuple(end)
+    step = as_tuple(step) if step else (1,) * len(begin)
+    idx = []
+    for d, (b, e) in enumerate(zip(begin, end)):
+        st = step[d] if d < len(step) and step[d] is not None else 1
+        idx.append(slice(b if b is not None else None,
+                         e if e is not None else None, st))
+    return tuple(idx)
+
+
+@register("_slice_assign", nin=2, arg_names=["lhs", "rhs"],
+          defaults={"begin": (), "end": (), "step": ()},
+          aliases=("_crop_assign",))
+def slice_assign(lhs, rhs, begin=(), end=(), step=()):
+    """lhs with lhs[begin:end:step] = rhs (reference matrix_op.cc:313,
+    the op behind sliced NDArray writes)."""
+    return lhs.at[_slice_tuple(lhs.shape, begin, end, step)].set(rhs)
+
+
+@register("_slice_assign_scalar", nin=1, arg_names=["data"],
+          defaults={"scalar": 0.0, "begin": (), "end": (), "step": ()},
+          aliases=("_crop_assign_scalar",))
+def slice_assign_scalar(data, scalar=0.0, begin=(), end=(), step=()):
+    return data.at[_slice_tuple(data.shape, begin, end, step)].set(
+        jnp.asarray(scalar, data.dtype))
+
+
+# `_scatter_set_nd` (indexing_op.cc:550) is deliberately NOT registered:
+# it is the reference's internal write-through op for `x[idx] = v`, whose
+# semantics require reading the shared output buffer — here NDArray
+# advanced-index assignment lowers directly to jnp `.at[].set`.
+
+
+# ---------------------------------------------------------------------------
+# Crop (legacy) — crop spatial dims to h_w / crop_like at offset or center
+# ---------------------------------------------------------------------------
+
+@register("Crop", nin=-1, jit=True,
+          defaults={"num_args": 1, "offset": (0, 0), "h_w": (0, 0),
+                    "center_crop": False})
+def crop_op(*inputs, num_args=1, offset=(0, 0), h_w=(0, 0),
+            center_crop=False):
+    """Legacy Crop (reference crop-inl.h): crop (N, C, H, W) to h_w (or
+    to the spatial shape of the second input) at `offset` (y, x), or
+    centered when center_crop=True."""
+    data = inputs[0]
+    H, W = data.shape[2], data.shape[3]
+    if int(num_args) == 2 or len(inputs) == 2:
+        th, tw = inputs[1].shape[2], inputs[1].shape[3]
+    else:
+        th, tw = as_tuple(h_w)
+    if center_crop:
+        oy, ox = (H - th) // 2, (W - tw) // 2
+    else:
+        oy, ox = as_tuple(offset)
+    if oy + th > H or ox + tw > W:
+        raise MXNetError("Crop: crop window exceeds input extent")
+    return data[:, :, oy:oy + th, ox:ox + tw]
+
+
+# ---------------------------------------------------------------------------
+# Cross-device copy + legacy/cudnn aliases
+# ---------------------------------------------------------------------------
+
+@register("_CrossDeviceCopy", aliases=("_copyto",))
+def cross_device_copy(data):
+    """Identity at the graph level (reference cross_device_copy.cc) —
+    device placement is explicit in the executor (group2ctx commits
+    storage to the consumer device), so the node carries no compute."""
+    return data
+
+
+# Legacy v1 layers share the modern kernels: the reference keeps both
+# registrations for old graph JSON; the compute contract is identical.
+alias("Convolution", "Convolution_v1")
+alias("Pooling", "Pooling_v1")
+alias("BatchNorm", "CuDNNBatchNorm")
+alias("Embedding", "_contrib_SparseEmbedding")
+alias("_ctc_loss", "_contrib_CTCLoss")

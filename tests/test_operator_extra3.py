@@ -1,0 +1,266 @@
+"""Ops from the final registry-gap sweep: forward vs a numpy oracle that
+follows the reference kernels (psroi_pooling.cu, deformable_psroi_pooling.cu,
+count_sketch.cu, la_op.cc, crop-inl.h, matrix_op.cc) + gradient checks
+where the reference is differentiable."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+
+def _psroi_numpy(data, rois, scale, od, P, G):
+    """Direct transcription of PSROIPoolForwardKernel's arithmetic."""
+    N, C, H, W = data.shape
+    R = rois.shape[0]
+    out = np.zeros((R, od, P, P), np.float32)
+    for r in range(R):
+        b = int(rois[r, 0])
+        x1 = round(rois[r, 1]) * scale
+        y1 = round(rois[r, 2]) * scale
+        x2 = (round(rois[r, 3]) + 1.0) * scale
+        y2 = (round(rois[r, 4]) + 1.0) * scale
+        rw = max(x2 - x1, 0.1)
+        rh = max(y2 - y1, 0.1)
+        bh, bw = rh / P, rw / P
+        for ct in range(od):
+            for ph in range(P):
+                for pw in range(P):
+                    hs = min(max(int(np.floor(ph * bh + y1)), 0), H)
+                    he = min(max(int(np.ceil((ph + 1) * bh + y1)), 0), H)
+                    ws = min(max(int(np.floor(pw * bw + x1)), 0), W)
+                    we = min(max(int(np.ceil((pw + 1) * bw + x1)), 0), W)
+                    gh = min(max(ph * G // P, 0), G - 1)
+                    gw = min(max(pw * G // P, 0), G - 1)
+                    c = (ct * G + gh) * G + gw
+                    if he <= hs or we <= ws:
+                        continue
+                    out[r, ct, ph, pw] = data[b, c, hs:he, ws:we].mean()
+    return out
+
+
+def test_psroi_pooling_forward():
+    rs = np.random.RandomState(0)
+    od, G, P = 2, 3, 3
+    data = rs.randn(2, od * G * G, 12, 12).astype(np.float32)
+    rois = np.array([[0, 1, 1, 8, 8], [1, 0, 2, 11, 9], [0, 4, 4, 6, 7]],
+                    np.float32)
+    got = mx.nd.contrib.PSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), spatial_scale=0.8,
+        output_dim=od, pooled_size=P, group_size=G).asnumpy()
+    want = _psroi_numpy(data, rois, 0.8, od, P, G)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_psroi_pooling_grad():
+    rs = np.random.RandomState(1)
+    od, G, P = 1, 2, 2
+    data = rs.randn(1, od * G * G, 6, 6).astype(np.float32)
+    rois = np.array([[0, 0, 0, 4, 4]], np.float32)
+    d = mx.sym.Variable("data")
+    r = mx.sym.Variable("rois")
+    out = mx.sym.contrib.PSROIPooling(d, r, spatial_scale=1.0,
+                                      output_dim=od, pooled_size=P,
+                                      group_size=G)
+    # finite differences vs the symbolic backward, data input only
+    check_numeric_gradient(out, [data, rois], grad_nodes=["data"],
+                           numeric_eps=1e-2, rtol=1e-2, atol=1e-3)
+
+
+def test_deformable_psroi_pooling_no_trans_matches_samples():
+    """With no_trans the op reduces to sampled position-sensitive
+    average pooling; oracle follows the CUDA kernel sample-for-sample."""
+    rs = np.random.RandomState(2)
+    od, G, P, sp = 2, 2, 2, 2
+    H = W = 8
+    data = rs.randn(1, od * G * G, H, W).astype(np.float32)
+    rois = np.array([[0, 1, 1, 6, 6]], np.float32)
+    trans = np.zeros((1, 2, P, P), np.float32)
+    got = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), mx.nd.array(trans),
+        spatial_scale=1.0, output_dim=od, pooled_size=P, group_size=G,
+        part_size=P, sample_per_part=sp, trans_std=0.1,
+        no_trans=True).asnumpy()
+
+    def bilinear(img, h, w):
+        h0, w0 = int(np.floor(h)), int(np.floor(w))
+        out = 0.0
+        for dy in (0, 1):
+            for dx in (0, 1):
+                yy, xx = h0 + dy, w0 + dx
+                if 0 <= yy < H and 0 <= xx < W:
+                    wt = ((1 - abs(h - yy)) * (1 - abs(w - xx)))
+                    out += img[yy, xx] * max(wt, 0.0)
+        return out
+
+    x1 = round(1) * 1.0 - 0.5
+    y1 = x1
+    x2 = (round(6) + 1.0) - 0.5
+    y2 = x2
+    rw, rh = max(x2 - x1, 0.1), max(y2 - y1, 0.1)
+    bh, bw = rh / P, rw / P
+    sh, sw = bh / sp, bw / sp
+    want = np.zeros_like(got)
+    for ct in range(od):
+        for ph in range(P):
+            for pw in range(P):
+                gh = min(max(ph * G // P, 0), G - 1)
+                gw = min(max(pw * G // P, 0), G - 1)
+                c = (ct * G + gh) * G + gw
+                acc, cnt = 0.0, 0
+                for ihh in range(sp):
+                    for iww in range(sp):
+                        h = ph * bh + y1 + ihh * sh
+                        w = pw * bw + x1 + iww * sw
+                        if -0.5 < w < W - 0.5 and -0.5 < h < H - 0.5:
+                            acc += bilinear(data[0, c],
+                                            min(max(h, 0), H - 1),
+                                            min(max(w, 0), W - 1))
+                            cnt += 1
+                want[0, ct, ph, pw] = acc / cnt if cnt else 0.0
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_multi_proposal_batches():
+    rs = np.random.RandomState(3)
+    B, A, Hf, Wf = 2, 3, 4, 4
+    cls_prob = rs.uniform(size=(B, 2 * A, Hf, Wf)).astype(np.float32)
+    bbox_pred = rs.randn(B, 4 * A, Hf, Wf).astype(np.float32) * 0.1
+    im_info = np.array([[64, 64, 1.0], [64, 64, 1.0]], np.float32)
+    post = 8
+    rois = mx.nd.contrib.MultiProposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred), mx.nd.array(im_info),
+        feature_stride=16, scales=(8,), ratios=(0.5, 1, 2),
+        rpn_pre_nms_top_n=20, rpn_post_nms_top_n=post,
+        rpn_min_size=4).asnumpy()
+    assert rois.shape == (B * post, 5)
+    assert np.all(rois[:post, 0] == 0) and np.all(rois[post:, 0] == 1)
+    # per-image result equals single-image Proposal
+    one = mx.nd.contrib.Proposal(
+        mx.nd.array(cls_prob[1:2]), mx.nd.array(bbox_pred[1:2]),
+        mx.nd.array(im_info[1:2]), feature_stride=16, scales=(8,),
+        ratios=(0.5, 1, 2), rpn_pre_nms_top_n=20, rpn_post_nms_top_n=post,
+        rpn_min_size=4).asnumpy()
+    np.testing.assert_allclose(rois[post:, 1:], one[:, 1:], rtol=1e-5)
+
+
+def test_count_sketch():
+    rs = np.random.RandomState(4)
+    n, in_dim, od = 5, 16, 8
+    data = rs.randn(n, in_dim).astype(np.float32)
+    h = rs.randint(0, od, size=in_dim).astype(np.float32)
+    s = rs.choice([-1.0, 1.0], size=in_dim).astype(np.float32)
+    got = mx.nd.contrib.count_sketch(mx.nd.array(data), mx.nd.array(h),
+                                     mx.nd.array(s), out_dim=od).asnumpy()
+    want = np.zeros((n, od), np.float32)
+    for j in range(in_dim):
+        want[:, int(h[j])] += s[j] * data[:, j]
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_linalg_gelqf_syevd():
+    rs = np.random.RandomState(5)
+    a = rs.randn(3, 5).astype(np.float32)
+    q, l = mx.nd.linalg_gelqf(mx.nd.array(a))
+    q, l = q.asnumpy(), l.asnumpy()
+    np.testing.assert_allclose(l @ q, a, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(q @ q.T, np.eye(3), rtol=1e-4, atol=1e-5)
+    assert np.allclose(np.triu(l, 1), 0, atol=1e-6)  # lower triangular
+
+    s = rs.randn(4, 4).astype(np.float32)
+    s = (s + s.T) / 2
+    u, lam = mx.nd.linalg_syevd(mx.nd.array(s))
+    u, lam = u.asnumpy(), lam.asnumpy()
+    np.testing.assert_allclose(u.T @ np.diag(lam) @ u, s, rtol=1e-3,
+                               atol=1e-4)
+    assert np.all(np.diff(lam) >= -1e-5)  # ascending
+
+
+def test_reshape_like_and_slice_assign():
+    a = mx.nd.arange(12).reshape((3, 4))
+    b = mx.nd.zeros((4, 3))
+    out = mx.nd.reshape_like(a, b)
+    assert out.shape == (4, 3)
+
+    lhs = mx.nd.zeros((4, 4))
+    rhs = mx.nd.ones((2, 2))
+    got = mx.nd._slice_assign(lhs, rhs, begin=(1, 1), end=(3, 3)).asnumpy()
+    want = np.zeros((4, 4), np.float32)
+    want[1:3, 1:3] = 1
+    np.testing.assert_allclose(got, want)
+
+    got = mx.nd._slice_assign_scalar(lhs, scalar=7.0, begin=(0, 2),
+                                     end=(4, 4)).asnumpy()
+    want = np.zeros((4, 4), np.float32)
+    want[:, 2:] = 7
+    np.testing.assert_allclose(got, want)
+
+
+def test_crop_legacy():
+    x = mx.nd.array(np.arange(2 * 3 * 6 * 6, dtype=np.float32)
+                    .reshape(2, 3, 6, 6))
+    got = mx.nd.Crop(x, h_w=(4, 4), offset=(1, 2), num_args=1).asnumpy()
+    np.testing.assert_allclose(got, x.asnumpy()[:, :, 1:5, 2:6])
+    # center crop
+    got = mx.nd.Crop(x, h_w=(4, 4), center_crop=True, num_args=1).asnumpy()
+    np.testing.assert_allclose(got, x.asnumpy()[:, :, 1:5, 1:5])
+    # crop-like second input
+    like = mx.nd.zeros((2, 3, 2, 2))
+    got = mx.nd.Crop(x, like, num_args=2).asnumpy()
+    np.testing.assert_allclose(got, x.asnumpy()[:, :, :2, :2])
+
+
+def test_legacy_aliases_resolve():
+    for name in ("Convolution_v1", "Pooling_v1", "CuDNNBatchNorm",
+                 "_contrib_SparseEmbedding", "_CrossDeviceCopy"):
+        assert mx.ops.get_op(name) is not None
+    # v1 conv computes like modern conv
+    rs = np.random.RandomState(6)
+    x = mx.nd.array(rs.randn(1, 2, 5, 5).astype(np.float32))
+    w = mx.nd.array(rs.randn(3, 2, 3, 3).astype(np.float32))
+    b = mx.nd.zeros((3,))
+    a = mx.nd.Convolution(x, w, b, kernel=(3, 3), num_filter=3).asnumpy()
+    v1 = mx.nd.Convolution_v1(x, w, b, kernel=(3, 3), num_filter=3).asnumpy()
+    np.testing.assert_allclose(a, v1, rtol=1e-5)
+
+
+REF_SRC = "/root/reference/src/operator"
+
+# reference-registered names deliberately NOT in the jnp op registry
+OP_SKIP_LIST = {
+    "_NDArray": "torch/numpy plugin embed op (plugin glue, no kernel)",
+    "_Native": "torch/numpy plugin embed op (plugin glue, no kernel)",
+    "_broadcast_backward": "internal backward node; jax.vjp owns grads",
+    "_scatter_set_nd": "internal write-through for x[idx]=v; NDArray "
+                       "setitem lowers to jnp .at[].set directly",
+    "_sparse_retain": "sparse storage is a Python-level wrapper here; "
+                      "exposed as mx.nd.sparse retain (ndarray/sparse.py)",
+    "cast_storage": "same — mx.nd.cast_storage via ndarray/sparse.py",
+    "name": "regex artifact of the reference's registration macro",
+}
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_SRC), reason="no reference tree")
+def test_registry_covers_reference_ops():
+    """Every op name the reference registers resolves here or sits in the
+    explicit skip list (reference NNVM_REGISTER_OP +
+    MXNET_REGISTER_OP_PROPERTY across src/operator)."""
+    import re
+    names = set()
+    for root, _, files in os.walk(REF_SRC):
+        for fn in files:
+            if not fn.endswith(".cc"):
+                continue
+            text = open(os.path.join(root, fn), errors="replace").read()
+            names.update(re.findall(r"NNVM_REGISTER_OP\(([^)]+)\)", text))
+            names.update(m.strip() for m in re.findall(
+                r"MXNET_REGISTER_OP_PROPERTY\(([^,]+),", text))
+    names = {n.strip('" ') for n in names if "##" not in n}
+    registered = set(mx.ops.list_ops())
+    missing = sorted(n for n in names
+                     if n not in registered
+                     and not n.startswith("_backward")
+                     and n not in OP_SKIP_LIST)
+    assert not missing, "unregistered reference ops: %s" % missing
