@@ -1,15 +1,26 @@
 """Gluon DataLoader.
 
 Parity: reference ``python/mxnet/gluon/data/dataloader.py:73-115`` which
-uses multiprocessing workers + POSIX-shm NDArrays. TPU-native design:
-worker THREADS + a bounded prefetch queue — batch assembly is numpy-bound
-and releases the GIL; device transfer overlaps via PJRT async
-``device_put``, which replaces the reference's shared-memory trick.
+uses multiprocessing workers + POSIX-shm NDArrays
+(``cpu_shared_storage_manager.h``). Two worker modes:
+
+- THREADS (default): batch assembly is numpy/PIL-bound and releases the
+  GIL; device transfer overlaps via PJRT async ``device_put``.
+- PROCESSES (``thread_pool=False``, the reference's mode): forked
+  workers that are **accelerator-free by contract** — a forked child
+  must never touch the PJRT client (the reference re-arms its engine via
+  pthread_atfork; no such hook exists for an XLA runtime). Workers
+  therefore assemble batches from ``Dataset.raw_item`` numpy trees and
+  ship them through POSIX shared memory (the reference's shm NDArray
+  trick); the parent wraps them into NDArrays. Datasets without a raw
+  path (e.g. with NDArray-consuming transforms) fall back to threads
+  with a warning.
 """
 from __future__ import annotations
 
 import queue as _queue
 import threading
+import warnings
 
 import numpy as np
 
@@ -18,6 +29,60 @@ from ...ndarray.ndarray import NDArray
 from ...ndarray import array as nd_array
 
 __all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def _numpy_batchify(data):
+    """Worker-side batchify over raw numpy items (no NDArray creation)."""
+    if isinstance(data[0], (tuple, list)):
+        return [_numpy_batchify(list(i)) for i in zip(*data)]
+    return np.stack([np.asarray(d) for d in data])
+
+
+def _tree_to_shm(tree, shm_list):
+    """numpy tree -> picklable descriptor; arrays move into POSIX shm."""
+    from multiprocessing import shared_memory
+    if isinstance(tree, list):
+        return ("list", [_tree_to_shm(t, shm_list) for t in tree])
+    arr = np.ascontiguousarray(tree)
+    shm = shared_memory.SharedMemory(create=True, size=max(arr.nbytes, 1))
+    shm.buf[:arr.nbytes] = arr.tobytes()
+    shm_list.append(shm)
+    return ("shm", shm.name, arr.shape, str(arr.dtype))
+
+
+def _tree_from_shm(desc):
+    """Descriptor -> NDArray tree; copies out of shm then unlinks it."""
+    from multiprocessing import shared_memory
+    if desc[0] == "list":
+        return [_tree_from_shm(d) for d in desc[1]]
+    _, name, shape, dtype = desc
+    shm = shared_memory.SharedMemory(name=name)
+    try:
+        arr = np.frombuffer(shm.buf, dtype=dtype)[:int(np.prod(shape))] \
+            .reshape(shape).copy()
+    finally:
+        shm.close()
+        shm.unlink()
+    return nd_array(arr)
+
+
+def _proc_worker(dataset, idx_q, out_q):
+    """Forked worker: numpy + shm only — never touches jax/PJRT."""
+    while True:
+        job = idx_q.get()
+        if job is None:
+            return
+        seq, indices = job
+        try:
+            items = [dataset.raw_item(int(i)) for i in indices]
+            batch = _numpy_batchify(items)
+            shms = []
+            desc = _tree_to_shm(batch, shms)
+            out_q.put((seq, desc, None))
+            for s in shms:
+                s.close()         # parent owns the segment now
+        except Exception as e:    # surface worker errors to the parent
+            out_q.put((seq, None, "%s: %s" % (type(e).__name__, e)))
 
 
 def default_batchify_fn(data):
@@ -37,20 +102,26 @@ class _BatchSampler:
         self._batch_size = batch_size
         self._shuffle = shuffle
         self._last_batch = last_batch
+        self._carry = np.zeros(0, np.int64)   # rollover residue
 
     def __iter__(self):
         order = np.arange(self._length)
         if self._shuffle:
             np.random.shuffle(order)
-        n = self._length // self._batch_size * self._batch_size
+        if self._last_batch == "rollover" and len(self._carry):
+            order = np.concatenate([self._carry, order])
+            self._carry = np.zeros(0, np.int64)
+        n = len(order) // self._batch_size * self._batch_size
         for i in range(0, n, self._batch_size):
             yield order[i:i + self._batch_size]
-        rem = self._length - n
-        if rem:
+        rem = order[n:]
+        if len(rem):
             if self._last_batch == "keep":
-                yield order[n:]
+                yield rem
             elif self._last_batch == "rollover":
-                yield order[n:]  # simplified: no cross-epoch carry
+                # incomplete batch carries into the NEXT epoch (reference
+                # sampler.BatchSampler 'rollover' semantics)
+                self._carry = rem
             elif self._last_batch == "discard":
                 return
 
@@ -58,6 +129,8 @@ class _BatchSampler:
         n, b = self._length, self._batch_size
         if self._last_batch == "discard":
             return n // b
+        if self._last_batch == "rollover":
+            return (len(self._carry) + n) // b
         return (n + b - 1) // b
 
 
@@ -66,7 +139,8 @@ class DataLoader:
 
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
-                 num_workers=0, pin_memory=False, prefetch=None):
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=True):
         self._dataset = dataset
         if batch_sampler is None:
             if batch_size is None:
@@ -77,6 +151,7 @@ class DataLoader:
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._num_workers = max(0, num_workers)
         self._prefetch = max(2, prefetch or 2 * max(self._num_workers, 1))
+        self._thread_pool = thread_pool
 
     def __len__(self):
         return len(self._batch_sampler)
@@ -89,7 +164,59 @@ class DataLoader:
             for indices in self._batch_sampler:
                 yield self._make_batch(indices)
             return
+        if not self._thread_pool:
+            # probe the raw path IN THE PARENT: device-backed columns get
+            # pulled to host here, before any fork
+            if self._batchify_fn is not default_batchify_fn:
+                warnings.warn("DataLoader: custom batchify_fn cannot run "
+                              "in accelerator-free worker processes; "
+                              "falling back to threads")
+            elif self._dataset.raw_item(0) is None:
+                warnings.warn("DataLoader: dataset has no raw (host-only) "
+                              "item path; falling back to threads")
+            else:
+                yield from self._process_iter()
+                return
         yield from self._threaded_iter()
+
+    def _process_iter(self):
+        import multiprocessing as mp
+        ctx = mp.get_context("fork")
+        idx_q = ctx.Queue()
+        out_q = ctx.Queue()
+        n_batches = 0
+        for indices in self._batch_sampler:
+            idx_q.put((n_batches, np.asarray(indices)))
+            n_batches += 1
+        for _ in range(self._num_workers):
+            idx_q.put(None)
+        procs = [ctx.Process(target=_proc_worker,
+                             args=(self._dataset, idx_q, out_q),
+                             daemon=True)
+                 for _ in range(self._num_workers)]
+        for p in procs:
+            p.start()
+        try:
+            next_seq = 0
+            pending = {}
+            received = 0
+            while received < n_batches:
+                seq, desc, err = out_q.get()
+                if err is not None:
+                    raise MXNetError("DataLoader worker failed: %s" % err)
+                received += 1
+                pending[seq] = desc
+                while next_seq in pending:
+                    yield _tree_from_shm(pending.pop(next_seq))
+                    next_seq += 1
+            while next_seq in pending:
+                yield _tree_from_shm(pending.pop(next_seq))
+                next_seq += 1
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                p.join(timeout=5)
 
     def _threaded_iter(self):
         out_q = _queue.Queue(maxsize=self._prefetch)

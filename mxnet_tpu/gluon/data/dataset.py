@@ -16,6 +16,15 @@ class Dataset:
     def __len__(self):
         raise NotImplementedError
 
+    def raw_item(self, idx):
+        """Item as a host-only (numpy/bytes) tree, or None if this
+        dataset cannot produce one. The DataLoader's process workers are
+        accelerator-free by contract (a forked child must never touch
+        the PJRT client), so only datasets with a raw path ride them —
+        the reference's fork-safety concern, solved in its engine by
+        pthread_atfork (SURVEY.md §2.1), lands here instead."""
+        return None
+
     def transform(self, fn, lazy=True):
         trans = _LazyTransformDataset(self, fn)
         if lazy:
@@ -24,6 +33,14 @@ class Dataset:
 
     def transform_first(self, fn, lazy=True):
         return self.transform(_TransformFirstClosure(fn), lazy)
+
+
+def _is_host_tree(item):
+    import numpy as np
+    if isinstance(item, (tuple, list)):
+        return all(_is_host_tree(x) for x in item)
+    return isinstance(item, (np.ndarray, np.generic, bytes, bytearray,
+                             int, float))
 
 
 class SimpleDataset(Dataset):
@@ -35,6 +52,10 @@ class SimpleDataset(Dataset):
 
     def __getitem__(self, idx):
         return self._data[idx]
+
+    def raw_item(self, idx):
+        item = self._data[idx]
+        return item if _is_host_tree(item) else None
 
 
 class _LazyTransformDataset(Dataset):
@@ -78,6 +99,24 @@ class ArrayDataset(Dataset):
             return self._data[0][idx]
         return tuple(d[idx] for d in self._data)
 
+    def raw_item(self, idx):
+        cols = self._raw_columns()
+        if len(cols) == 1:
+            return cols[0][idx]
+        return tuple(c[idx] for c in cols)
+
+    def _raw_columns(self):
+        """Host-only column views, materialised ONCE (in the parent — the
+        DataLoader probes raw_item(0) before forking, so device-backed
+        columns are pulled to numpy before any worker exists)."""
+        import numpy as np
+        cached = getattr(self, "_raw_cols", None)
+        if cached is None:
+            cached = [np.asarray(d.asnumpy() if isinstance(d, NDArray)
+                                 else d) for d in self._data]
+            self._raw_cols = cached
+        return cached
+
     def __len__(self):
         return self._length
 
@@ -92,6 +131,8 @@ class RecordFileDataset(Dataset):
 
     def __getitem__(self, idx):
         return self._record.read_idx(self._record.keys[idx])
+
+    raw_item = __getitem__          # record bytes are host-only already
 
     def __len__(self):
         return len(self._record.keys)

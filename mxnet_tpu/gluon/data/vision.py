@@ -35,6 +35,13 @@ class _DownloadedDataset(Dataset):
                                    self._label[idx])
         return nd_array(self._data[idx]), self._label[idx]
 
+    def raw_item(self, idx):
+        # transforms take NDArrays, which an accelerator-free worker
+        # process cannot build — those datasets fall back to threads
+        if self._transform is not None:
+            return None
+        return self._data[idx], self._label[idx]
+
     def __len__(self):
         return len(self._label)
 
