@@ -102,10 +102,17 @@ class NDArrayIter(DataIter):
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
-                 label_name="softmax_label"):
+                 label_name="softmax_label", num_parts=1, part_index=0):
         super().__init__(batch_size)
         self.data = _init_data(data, allow_empty=False, default_name=data_name)
         self.label = _init_data(label, allow_empty=True, default_name=label_name)
+        if num_parts > 1:
+            # distributed sharding (parity: dmlc InputSplit via the
+            # reference iterators' num_parts/part_index kwargs — each
+            # worker reads only its own partition)
+            self.data = [(k, v[part_index::num_parts]) for k, v in self.data]
+            self.label = [(k, v[part_index::num_parts])
+                          for k, v in self.label]
         self.num_data = self.data[0][1].shape[0]
         self.cursor = -batch_size
         self.shuffle = shuffle
@@ -342,7 +349,9 @@ class MNISTIter(NDArrayIter):
         else:
             imgs = imgs.reshape(len(imgs), 1, imgs.shape[1], imgs.shape[2])
         super().__init__(imgs, lbls, batch_size=int(batch_size),
-                         shuffle=bool(shuffle))
+                         shuffle=bool(shuffle),
+                         num_parts=int(kwargs.get("num_parts", 1)),
+                         part_index=int(kwargs.get("part_index", 0)))
 
 
 @register(name="CSVIter")
@@ -360,7 +369,9 @@ class CSVIter(NDArrayIter):
             if label.shape[1:] == (1,):
                 label = label[:, 0]
         super().__init__(data, label, batch_size=int(batch_size),
-                         last_batch_handle="pad" if round_batch else "discard")
+                         last_batch_handle="pad" if round_batch else "discard",
+                         num_parts=int(kwargs.get("num_parts", 1)),
+                         part_index=int(kwargs.get("part_index", 0)))
 
 
 @register(name="LibSVMIter")
@@ -369,7 +380,7 @@ class LibSVMIter(DataIter):
     CSR data batches for the sparse linear-classification workload."""
 
     def __init__(self, data_libsvm, data_shape, label_shape=(1,),
-                 batch_size=1, **kwargs):
+                 batch_size=1, num_parts=1, part_index=0, **kwargs):
         super().__init__(int(batch_size))
         self.feature_dim = int(data_shape[0] if isinstance(data_shape, (tuple, list))
                                else data_shape)
@@ -391,6 +402,10 @@ class LibSVMIter(DataIter):
         for i, row in enumerate(rows):
             for k, v in row.items():
                 dense[i, k] = v
+        if num_parts > 1:   # dmlc InputSplit parity: per-worker shard
+            dense = dense[part_index::num_parts]
+            self._labels = self._labels[part_index::num_parts]
+            rows = rows[part_index::num_parts]
         self._dense = dense
         self.cursor = -self.batch_size
         self.num_data = len(rows)
@@ -444,7 +459,7 @@ class ImageRecordIter(DataIter):
                  shuffle=False, rand_crop=False, rand_mirror=False,
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
                  std_b=1.0, scale=1.0, preprocess_threads=4, seed=0,
-                 **kwargs):
+                 num_parts=1, part_index=0, **kwargs):
         super().__init__(int(batch_size))
         from . import recordio
         self.data_shape = tuple(int(x) for x in data_shape)
@@ -456,9 +471,11 @@ class ImageRecordIter(DataIter):
         self.std = np.array([std_r, std_g, std_b], np.float32).reshape(3, 1, 1)
         self.scale = scale
         # fast path: native threaded loader (src/recordio.cc) when built and
-        # no python-side augmentation is requested
+        # no python-side augmentation is requested (the native scan has no
+        # partition support — sharded reads take the python path)
         self._native = None
-        if not rand_crop and not rand_mirror and self.label_width == 1:
+        if not rand_crop and not rand_mirror and self.label_width == 1 \
+                and num_parts == 1:
             try:
                 from ._native import NativeRecordLoader
                 self._native = NativeRecordLoader(
@@ -475,6 +492,8 @@ class ImageRecordIter(DataIter):
             if s is None:
                 break
             self._records.append(s)
+        if num_parts > 1:   # dmlc InputSplit parity: per-worker shard
+            self._records = self._records[part_index::num_parts]
         self._order = np.arange(len(self._records))
         self.cursor = -self.batch_size
 

@@ -165,3 +165,20 @@ def test_mnist_iter_from_idx_files(tmp_path):
     b = next(iter(it))
     assert b.data[0].shape == (5, 1, 28, 28)
     np.testing.assert_allclose(b.label[0].asnumpy(), lbls[:5])
+
+
+def test_iterator_num_parts_sharding():
+    """num_parts/part_index shard the data per worker (parity: dmlc
+    InputSplit through the reference iterators' kwargs)."""
+    x = np.arange(24, dtype=np.float32).reshape(12, 2)
+    y = np.arange(12, dtype=np.float32)
+    full = mx.io.NDArrayIter(x, y, batch_size=2)
+    p0 = mx.io.NDArrayIter(x, y, batch_size=2, num_parts=3, part_index=0)
+    p1 = mx.io.NDArrayIter(x, y, batch_size=2, num_parts=3, part_index=1)
+    assert p0.num_data == p1.num_data == 4
+    seen = []
+    for it in (p0, p1):
+        for b in it:
+            seen.extend(b.label[0].asnumpy().tolist())
+    assert sorted(seen) == [0, 1, 3, 4, 6, 7, 9, 10]
+    assert full.num_data == 12
