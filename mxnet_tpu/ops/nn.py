@@ -49,6 +49,41 @@ def fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False,
 # Convolution / Deconvolution
 # ---------------------------------------------------------------------------
 
+def _s2d_applicable(data, kernel, stride, dilate, pad, num_group, is_cl,
+                    ndim):
+    """The ResNet/VGG stem pattern a TPU hates: channels-last 7x7/s2 conv
+    with tiny input depth (C=3 wastes 125/128 MXU input lanes)."""
+    return (ndim == 2 and is_cl and tuple(kernel) == (7, 7)
+            and tuple(stride) == (2, 2) and tuple(pad) == (3, 3)
+            and tuple(dilate) == (1, 1) and int(num_group) == 1
+            and data.shape[-1] <= 4
+            and data.shape[1] % 2 == 0 and data.shape[2] % 2 == 0)
+
+
+def _conv_s2d_7x7s2(data, weight):
+    """Space-to-depth rewrite of the 7x7/s2 stem conv (the MLPerf trick;
+    PERF.md 'next levers'). Exactly equivalent: pad the kernel to 8x8
+    (one leading zero row/col), fold 2x2 input blocks into channels
+    (C -> 4C, making the MXU's input-lane dimension useful), and run a
+    4x4/s1 conv with the correspondingly folded weights. Pure reshapes +
+    one conv — XLA folds the weight transform at compile time, and the
+    backward falls out of jax.vjp through the linear ops."""
+    N, H, W, C = data.shape
+    O = weight.shape[0]
+    # kernel 7->8 with a LEADING zero (index shift dy -> dy+1), then
+    # split each spatial 8 into (4 taps x 2 phases)
+    w8 = jnp.pad(weight, ((0, 0), (1, 0), (1, 0), (0, 0)))
+    w4 = w8.reshape(O, 4, 2, 4, 2, C).transpose(0, 1, 3, 2, 4, 5) \
+        .reshape(O, 4, 4, 4 * C)
+    # space-to-depth: (N,H,W,C) -> (N,H/2,W/2,4C), channel=(by*2+bx)*C+c
+    y = data.reshape(N, H // 2, 2, W // 2, 2, C).transpose(0, 1, 3, 2, 4, 5) \
+        .reshape(N, H // 2, W // 2, 4 * C)
+    # original pad 3/s2 maps to asymmetric (2,1)/s1 on the folded grid
+    return jax.lax.conv_general_dilated(
+        y, w4, window_strides=(1, 1), padding=[(2, 1), (2, 1)],
+        dimension_numbers=("NHWC", "OHWI", "NHWC"))
+
+
 def _conv_nd(data, weight, bias, kernel, stride, dilate, pad, num_group,
              no_bias, transposed=False, adj=None, target_shape=None,
              layout=None):
@@ -65,12 +100,16 @@ def _conv_nd(data, weight, bias, kernel, stride, dilate, pad, num_group,
     lhs_spec = ("N" + spatial + "C") if is_cl else ("NC" + spatial)
     rhs_spec = ("O" + spatial + "I") if is_cl else ("OI" + spatial)
     if not transposed:
-        out = jax.lax.conv_general_dilated(
-            data, weight, window_strides=stride,
-            padding=[(p, p) for p in pad],
-            rhs_dilation=dilate,
-            dimension_numbers=(lhs_spec, rhs_spec, lhs_spec),
-            feature_group_count=int(num_group))
+        if _s2d_applicable(data, kernel, stride, dilate, pad, num_group,
+                           is_cl, ndim):
+            out = _conv_s2d_7x7s2(data, weight)
+        else:
+            out = jax.lax.conv_general_dilated(
+                data, weight, window_strides=stride,
+                padding=[(p, p) for p in pad],
+                rhs_dilation=dilate,
+                dimension_numbers=(lhs_spec, rhs_spec, lhs_spec),
+                feature_group_count=int(num_group))
         if not no_bias and bias is not None:
             out = out + (bias if is_cl
                          else bias.reshape((1, -1) + (1,) * ndim))

@@ -264,3 +264,51 @@ def test_registry_covers_reference_ops():
                      and not n.startswith("_backward")
                      and n not in OP_SKIP_LIST)
     assert not missing, "unregistered reference ops: %s" % missing
+
+
+def test_conv_stem_space_to_depth_rewrite():
+    """The channels-last 7x7/s2 stem conv takes the space-to-depth
+    lowering; it must be numerically identical to the NCHW reference
+    path, gradients included."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.nn import _conv_nd, _s2d_applicable
+
+    rs = np.random.RandomState(7)
+    x = rs.randn(2, 16, 16, 3).astype(np.float32)        # NHWC
+    w = rs.randn(8, 7, 7, 3).astype(np.float32)          # OHWI
+    assert _s2d_applicable(jnp.asarray(x), (7, 7), (2, 2), (1, 1), (3, 3),
+                           1, True, 2)
+
+    def nhwc(xx, ww):
+        return _conv_nd(xx, ww, None, (7, 7), (2, 2), (1, 1), (3, 3), 1,
+                        True, layout="NHWC")
+
+    def ref(xx, ww):   # NCHW path, no rewrite
+        out = _conv_nd(jnp.transpose(xx, (0, 3, 1, 2)),
+                       jnp.transpose(ww, (0, 3, 1, 2)), None, (7, 7),
+                       (2, 2), (1, 1), (3, 3), 1, True, layout=None)
+        return jnp.transpose(out, (0, 2, 3, 1))
+
+    got = nhwc(jnp.asarray(x), jnp.asarray(w))
+    want = ref(jnp.asarray(x), jnp.asarray(w))
+    assert got.shape == (2, 8, 8, 8)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+    # gradients agree through the rewrite
+    g = rs.randn(*got.shape).astype(np.float32)
+    loss = lambda f: (lambda xx, ww: jnp.sum(f(xx, ww) * g))
+    gx1, gw1 = jax.grad(loss(nhwc), argnums=(0, 1))(jnp.asarray(x),
+                                                    jnp.asarray(w))
+    gx2, gw2 = jax.grad(loss(ref), argnums=(0, 1))(jnp.asarray(x),
+                                                   jnp.asarray(w))
+    np.testing.assert_allclose(np.asarray(gx1), np.asarray(gx2),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(gw1), np.asarray(gw2),
+                               rtol=1e-3, atol=1e-3)
+
+    # odd spatial size falls back to the plain lowering
+    x_odd = jnp.asarray(rs.randn(1, 15, 15, 3).astype(np.float32))
+    assert not _s2d_applicable(x_odd, (7, 7), (2, 2), (1, 1), (3, 3),
+                               1, True, 2)
