@@ -199,19 +199,42 @@ def ring_attention(q, k, v, mesh, axis_name="sp", batch_axis_name=None,
     wrap_out = isinstance(q, NDArray)
     raw = [x._data if isinstance(x, NDArray) else x for x in (q, k, v)]
 
-    if use_pallas is None:
-        use_pallas = jax.default_backend() == "tpu"
-
     spec = P(batch_axis_name, None, axis_name, None)
     # inputs committed to one device (NDArrays) must be laid out over the
     # mesh before shard_map will accept them
     raw = [jax.device_put(x, NamedSharding(mesh, spec)) for x in raw]
 
-    fn = jax.shard_map(
-        functools.partial(_ring_attention_local, axis_name=axis_name,
-                          causal=causal, scale=scale, use_pallas=use_pallas),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        # pallas_call outputs carry no varying-mesh-axes annotation
-        check_vma=not use_pallas)
-    out = fn(*raw)
+    def build(flag):
+        return jax.shard_map(
+            functools.partial(_ring_attention_local, axis_name=axis_name,
+                              causal=causal, scale=scale, use_pallas=flag),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            # pallas_call outputs carry no varying-mesh-axes annotation
+            check_vma=not flag)
+
+    if use_pallas is None:
+        if jax.default_backend() != "tpu":
+            use_pallas = False
+        else:
+            # operator tuner decides pallas-vs-XLA per signature: the
+            # flash kernel wins at long local blocks, plain XLA at short
+            # ones where the grid overhead dominates (tuner.py ≙
+            # reference operator_tune.h)
+            from ..tuner import tuned_choice
+
+            def mk(flag):
+                def thunk():
+                    return build(flag)(*[jnp.zeros_like(x) for x in raw])
+                return thunk
+
+            key = "q%s_kv%d_%s_c%d_sp%d" % (
+                "x".join(map(str, raw[0].shape)), raw[1].shape[2],
+                raw[0].dtype.name, int(causal),
+                dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name])
+            label = tuned_choice("ring_attention.impl", key,
+                                 [("pallas", mk(True)), ("xla", mk(False))],
+                                 args=raw)
+            use_pallas = label == "pallas"
+
+    out = build(use_pallas)(*raw)
     return _wrap(out) if wrap_out else out

@@ -134,3 +134,29 @@ def test_flash_attention_tuned_default_matches_reference():
                     causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_tuned_impl(monkeypatch):
+    """On a 'tpu' backend the ring-attention impl comes from the tuner;
+    here the pallas candidate cannot lower (cpu devices), so the tuner
+    excludes it and selects the XLA path — exercising candidate-failure
+    exclusion end to end."""
+    import jax
+    from mxnet_tpu import parallel
+
+    mesh = parallel.make_mesh({"sp": 1})
+    rs = np.random.RandomState(0)
+    q = rs.randn(1, 2, 16, 8).astype(np.float32)
+    k = rs.randn(1, 2, 16, 8).astype(np.float32)
+    v = rs.randn(1, 2, 16, 8).astype(np.float32)
+    import jax.numpy as jnp
+    ref = parallel.ring_attention(jnp.asarray(q), jnp.asarray(k),
+                                  jnp.asarray(v), mesh, use_pallas=False)
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    out = parallel.ring_attention(jnp.asarray(q), jnp.asarray(k),
+                                  jnp.asarray(v), mesh)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    recs = [r for r in tuner().records() if r[0] == "ring_attention.impl"]
+    assert recs and recs[-1][2] == "xla"
