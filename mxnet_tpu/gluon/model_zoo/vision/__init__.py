@@ -26,6 +26,18 @@ for _mod in (_resnet, _alexnet, _vgg, _squeezenet, _densenet, _inception,
         if callable(_obj) and _name[0].islower() and not _name.startswith("get_"):
             _models[_name] = _obj
 
+# the reference's get_model keys use dots (model_store.py naming); python
+# function identifiers cannot, so register both spellings
+for _ref, _fn in [("mobilenet1.0", "mobilenet1_0"),
+                  ("mobilenet0.75", "mobilenet0_75"),
+                  ("mobilenet0.5", "mobilenet0_5"),
+                  ("mobilenet0.25", "mobilenet0_25"),
+                  ("squeezenet1.0", "squeezenet1_0"),
+                  ("squeezenet1.1", "squeezenet1_1"),
+                  ("inceptionv3", "inception_v3")]:
+    if _fn in _models:
+        _models[_ref] = _models[_fn]
+
 
 def get_model(name, **kwargs):
     """(parity: model_zoo.vision.get_model)"""

@@ -223,3 +223,19 @@ def test_dataloader_rollover():
     e2 = list(loader)
     assert [b.shape[0] for b in e2] == [4, 4, 4]       # 2 + 10 = 12
     np.testing.assert_allclose(e2[0].asnumpy()[:2], [8.0, 9.0])
+
+
+def test_model_zoo_reference_names():
+    """Every get_model name the reference's model_store serves resolves
+    here, including the dotted spellings (model_store.py:27-57)."""
+    from mxnet_tpu.gluon.model_zoo import vision
+    names = ["alexnet", "densenet121", "densenet161", "densenet169",
+             "densenet201", "inceptionv3", "mobilenet0.25", "mobilenet0.5",
+             "mobilenet0.75", "mobilenet1.0", "resnet18_v1", "resnet34_v1",
+             "resnet50_v1", "resnet101_v1", "resnet152_v1", "resnet18_v2",
+             "resnet34_v2", "resnet50_v2", "resnet101_v2", "resnet152_v2",
+             "squeezenet1.0", "squeezenet1.1", "vgg11", "vgg11_bn", "vgg13",
+             "vgg13_bn", "vgg16", "vgg16_bn", "vgg19", "vgg19_bn"]
+    for n in names:
+        net = vision.get_model(n)
+        assert net is not None, n
