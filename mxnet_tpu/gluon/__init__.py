@@ -2,7 +2,7 @@
 
 Parity: reference ``python/mxnet/gluon/__init__.py``.
 """
-from .parameter import Parameter, ParameterDict
+from .parameter import DeferredInitializationError, Parameter, ParameterDict
 from .block import Block, HybridBlock, SymbolBlock
 from .trainer import Trainer
 from . import nn

@@ -20,11 +20,12 @@ from ..io import DataIter, DataBatch, DataDesc
 from ..ndarray import array as nd_array
 from ..ndarray.ndarray import NDArray
 
-__all__ = ["imdecode", "imresize", "resize_short", "fixed_crop",
-           "random_crop",
+__all__ = ["imdecode", "imread", "imresize", "resize_short", "fixed_crop",
+           "random_crop", "scale_down",
            "center_crop", "color_normalize", "random_size_crop",
            "ResizeAug", "RandomCropAug", "RandomSizedCropAug", "CenterCropAug",
            "HorizontalFlipAug", "CastAug", "ColorNormalizeAug",
+           "SequentialAug", "ForceResizeAug", "HueJitterAug", "RandomGrayAug",
            "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
            "LightingAug", "ColorJitterAug", "RandomOrderAug",
            "CreateAugmenter", "ImageIter", "Augmenter"]
@@ -50,6 +51,25 @@ def imdecode(buf, flag=1, to_rgb=True, out=None):
     if not to_rgb and arr.shape[2] == 3:
         arr = arr[:, :, ::-1]
     return nd_array(arr)
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """Read an image file into an NDArray (parity: image.imread — the
+    reference routes through cv2.imread; here PIL via imdecode)."""
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def scale_down(src_size, size):
+    """Scale ``size`` down to fit in ``src_size`` keeping aspect ratio
+    (parity: image.scale_down)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
 
 
 def imresize(src, w, h, interp=1):
@@ -206,6 +226,75 @@ class CastAug(Augmenter):
 
     def __call__(self, src):
         return src.astype(self.typ)
+
+
+class SequentialAug(Augmenter):
+    """Compose a list of augmenters in order (parity: image.SequentialAug)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class ForceResizeAug(Augmenter):
+    """Resize to exactly (w, h), aspect be damned (parity:
+    image.ForceResizeAug)."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class HueJitterAug(Augmenter):
+    """Random hue rotation in [-hue, hue] using the YIQ rotation trick
+    (parity: image.HueJitterAug — same Gray/I/Q matrix composition)."""
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = np.array([[0.299, 0.587, 0.114],
+                              [0.596, -0.274, -0.321],
+                              [0.211, -0.523, 0.311]])
+        self.ityiq = np.array([[1.0, 0.956, 0.621],
+                               [1.0, -0.272, -0.647],
+                               [1.0, -1.107, 1.705]])
+
+    def __call__(self, src):
+        alpha = random.uniform(-self.hue, self.hue)
+        u = np.cos(alpha * np.pi)
+        w = np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]])
+        t = np.dot(np.dot(self.ityiq, bt), self.tyiq).T
+        arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+        return nd_array(np.dot(arr.astype(np.float32), t))
+
+
+class RandomGrayAug(Augmenter):
+    """With probability p collapse to 3-channel luminance (parity:
+    image.RandomGrayAug)."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+        self.mat = np.array([[0.21, 0.21, 0.21],
+                             [0.72, 0.72, 0.72],
+                             [0.07, 0.07, 0.07]], np.float32)
+
+    def __call__(self, src):
+        if random.random() < self.p:
+            arr = src.asnumpy() if isinstance(src, NDArray) \
+                else np.asarray(src)
+            return nd_array(np.dot(arr.astype(np.float32), self.mat))
+        return src
 
 
 class ColorNormalizeAug(Augmenter):

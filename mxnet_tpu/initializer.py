@@ -55,7 +55,9 @@ class Initializer:
             create(cls_name, **kwargs)._init_weight(desc, arr)
             return
         # name-based dispatch (parity with reference rules)
-        if desc.endswith("weight"):
+        if desc.endswith("weight") or desc.endswith("parameters"):
+            # fused RNN blobs ("*_parameters") initialise as weights —
+            # the FusedRNN initializer unpacks them per gate
             self._init_weight(desc, arr)
         elif desc.endswith("bias"):
             self._init_bias(desc, arr)
@@ -285,3 +287,39 @@ class Mixed(Initializer):
                 return
         raise MXNetError("Mixed: no pattern matches %r; add a '.*' catch-all"
                          % name)
+
+
+@register
+class FusedRNN(Initializer):
+    """Initialize a fused RNN parameter blob by unpacking it, applying an
+    inner initializer per unfused array, and repacking (parity:
+    initializer.FusedRNN — including the LSTM forget-gate bias)."""
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        if isinstance(init, str):
+            klass, kwargs = json.loads(init)
+            init = create(klass, **kwargs)
+        super().__init__(init=init.dumps() if init is not None else None,
+                         num_hidden=num_hidden, num_layers=num_layers,
+                         mode=mode, bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        from .rnn import rnn_cell
+        cell = rnn_cell.FusedRNNCell(
+            self._num_hidden, self._num_layers, self._mode,
+            self._bidirectional, forget_bias=self._forget_bias, prefix="")
+        args = cell.unpack_weights({"parameters": arr})
+        for name in args:
+            if self._mode == "lstm" and name.endswith("_f_bias"):
+                args[name][:] = self._forget_bias
+            elif self._init is not None:
+                self._init(InitDesc(name), args[name])
+        arr[:] = cell.pack_weights(args)["parameters"]

@@ -26,7 +26,7 @@ from .ndarray.ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "MNISTIter", "CSVIter", "LibSVMIter",
-           "ImageRecordIter"]
+           "ImageRecordIter", "MXDataIter"]
 
 register, _alias, create_iterator, _get = registry_create("data iterator")
 
@@ -558,3 +558,43 @@ class ImageRecordIter(DataIter):
 
     def getpad(self):
         return 0
+
+
+class MXDataIter(DataIter):
+    """Wrapper giving a registry-created iterator the reference's
+    C-handle-iterator face (parity: io.MXDataIter — there the handle is a
+    C iterator; here it wraps any registered python iterator)."""
+
+    def __init__(self, handle, data_name="data", label_name="softmax_label",
+                 **_):
+        if isinstance(handle, DataIter):
+            self._iter = handle
+        else:
+            raise MXNetError("MXDataIter wraps a created iterator; use "
+                             "mx.io.<IterName>(...) or "
+                             "create_iterator(name, **kwargs)")
+        super().__init__(self._iter.batch_size)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_iter"], name)
+
+    def reset(self):
+        self._iter.reset()
+
+    def next(self):
+        return self._iter.next()
+
+    def iter_next(self):
+        return self._iter.iter_next()
+
+    def getdata(self):
+        return self._iter.getdata()
+
+    def getlabel(self):
+        return self._iter.getlabel()
+
+    def getindex(self):
+        return self._iter.getindex()
+
+    def getpad(self):
+        return self._iter.getpad()

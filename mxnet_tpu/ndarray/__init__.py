@@ -16,3 +16,56 @@ from . import sparse   # noqa: E402,F401
 from .sparse import RowSparseNDArray, CSRNDArray  # noqa: E402,F401
 
 from . import contrib  # noqa: E402,F401
+
+
+# module-level arithmetic helpers (parity: ndarray.py:2743-3103 — the
+# reference exposes operator-overload semantics as named functions that
+# also accept scalar/scalar and scalar/array operands)
+def _binary(name, arr_fn, np_fn):
+    import numpy as _np
+
+    def fn(lhs, rhs):
+        lhs_nd = isinstance(lhs, NDArray)
+        rhs_nd = isinstance(rhs, NDArray)
+        if lhs_nd and rhs_nd:
+            return arr_fn(lhs, rhs)
+        if lhs_nd:
+            return arr_fn(lhs, rhs)
+        if rhs_nd:
+            return arr_fn(array(_np.full(rhs.shape, lhs, _np.float32)), rhs)
+        return np_fn(lhs, rhs)
+    fn.__name__ = name
+    fn.__doc__ = "(parity: mx.nd.%s)" % name
+    return fn
+
+
+import numpy as _np                                   # noqa: E402
+add = _binary("add", lambda a, b: a + b, _np.add)
+subtract = _binary("subtract", lambda a, b: a - b, _np.subtract)
+multiply = _binary("multiply", lambda a, b: a * b, _np.multiply)
+divide = _binary("divide", lambda a, b: a / b, _np.divide)
+true_divide = divide
+modulo = _binary("modulo", lambda a, b: a % b, _np.mod)
+power = _binary("power", lambda a, b: a ** b, _np.power)
+maximum = _binary("maximum", lambda a, b: broadcast_maximum(a, b)
+                  if isinstance(b, NDArray) else _maximum_scalar(a, scalar=b),
+                  _np.maximum)
+minimum = _binary("minimum", lambda a, b: broadcast_minimum(a, b)
+                  if isinstance(b, NDArray) else _minimum_scalar(a, scalar=b),
+                  _np.minimum)
+equal = _binary("equal", lambda a, b: a == b, lambda a, b: float(a == b))
+not_equal = _binary("not_equal", lambda a, b: a != b,
+                    lambda a, b: float(a != b))
+greater = _binary("greater", lambda a, b: a > b, lambda a, b: float(a > b))
+greater_equal = _binary("greater_equal", lambda a, b: a >= b,
+                        lambda a, b: float(a >= b))
+lesser = _binary("lesser", lambda a, b: a < b, lambda a, b: float(a < b))
+lesser_equal = _binary("lesser_equal", lambda a, b: a <= b,
+                       lambda a, b: float(a <= b))
+
+
+def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0, channels=3,
+             mean=None):
+    """Decode an image bytestring (parity: mx.nd.imdecode)."""
+    from ..image import image as _img
+    return _img.imdecode(str_img, flag=1 if channels == 3 else 0)

@@ -157,3 +157,125 @@ from . import symbol as _sym_mod            # noqa: E402
 from .symbol import register as _sym_reg    # noqa: E402
 _nd_mod.Custom = _nd_reg.make_op_func(get_op("Custom"))
 _sym_mod.Custom = _sym_reg.make_sym_func(get_op("Custom"))
+
+
+# ---------------------------------------------------------------------------
+# Legacy python-op classes (parity: operator.py PythonOp:37, NumpyOp:144,
+# NDArrayOp:246 — pre-CustomOp API, kept for old user code; bridged onto
+# the CustomOp machinery, so they work eagerly and under jit)
+# ---------------------------------------------------------------------------
+
+class PythonOp:
+    """Base class of legacy python operators (parity: operator.PythonOp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.info_ = None
+        self.need_top_grad_ = need_top_grad
+
+    def __call__(self, *args, **kwargs):
+        return self.get_symbol(*args, **kwargs)
+
+    def get_symbol(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def forward(self, in_data, out_data):
+        raise NotImplementedError
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        raise MXNetError("backward is not implemented")
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+
+class _LegacyAdapter(CustomOp):
+    """CustomOp running a legacy PythonOp's numpy/NDArray callbacks."""
+
+    def __init__(self, legacy, as_numpy):
+        self._legacy = legacy
+        self._np = as_numpy
+
+    def _unwrap(self, xs):
+        # numpy mode hands the legacy op WRITABLE buffers (asnumpy views
+        # of device arrays are read-only); results copy back via dst[:]
+        return [np.array(x.asnumpy()) if self._np else x for x in xs]
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        ins = self._unwrap(in_data)
+        outs = self._unwrap(out_data)
+        self._legacy.forward(in_data=ins, out_data=outs)
+        if self._np:
+            for dst, src in zip(out_data, outs):
+                dst[:] = src
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        ogs = self._unwrap(out_grad)
+        ins = self._unwrap(in_data)
+        outs = self._unwrap(out_data)
+        igs = self._unwrap(in_grad)
+        self._legacy.backward(out_grad=ogs, in_data=ins, out_data=outs,
+                              in_grad=igs)
+        if self._np:
+            for dst, src in zip(in_grad, igs):
+                dst[:] = src
+
+
+def _legacy_get_symbol(legacy, as_numpy, args, kwargs):
+    class _Prop(CustomOpProp):
+        def __init__(self, **_):
+            super().__init__(need_top_grad=legacy.need_top_grad())
+
+        def infer_shape(self, in_shape):
+            shapes = legacy.infer_shape(in_shape)
+            ishapes, oshapes = shapes[0], shapes[1]
+            return ishapes, oshapes, []
+
+        def list_arguments(self):
+            return legacy.list_arguments()
+
+        def list_outputs(self):
+            return legacy.list_outputs()
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            return _LegacyAdapter(legacy, as_numpy)
+
+    reg_name = "_legacy_%s_%x" % (type(legacy).__name__, id(legacy))
+    register(reg_name)(_Prop)
+    from . import symbol as _s
+    return _s.Custom(*args, op_type=reg_name, **kwargs)
+
+
+class NumpyOp(PythonOp):
+    """Legacy numpy operator (parity: operator.NumpyOp) — forward/backward
+    receive numpy arrays."""
+
+    def get_symbol(self, *args, **kwargs):
+        return _legacy_get_symbol(self, True, args, kwargs)
+
+
+class NDArrayOp(PythonOp):
+    """Legacy NDArray operator (parity: operator.NDArrayOp) —
+    forward/backward receive NDArrays."""
+
+    def get_symbol(self, *args, **kwargs):
+        return _legacy_get_symbol(self, False, args, kwargs)
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad():
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+
+__all__ += ["PythonOp", "NumpyOp", "NDArrayOp"]

@@ -499,6 +499,101 @@ class Test(Optimizer):
         state._set_data(weight._data)
 
 
+@register
+class ccSGD(SGD):
+    """Deprecated alias of SGD (parity: optimizer.ccSGD — same update)."""
+
+
+@register
+class Adamax(Optimizer):
+    """AdaMax, the infinity-norm Adam variant (parity: optimizer.Adamax)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (_state_zeros(weight, dtype=np.float32),
+                _state_zeros(weight, dtype=np.float32))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr /= (1.0 - self.beta1 ** t)
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = _nd._invoke("clip", [grad],
+                               {"a_min": -self.clip_gradient,
+                                "a_max": self.clip_gradient})
+        m, u = state
+        m *= self.beta1
+        m += (1.0 - self.beta1) * grad
+        u._set_data(jnp_maximum(self.beta2 * u._data,
+                                jnp_abs(grad._data)))
+        weight -= lr * m / u
+
+
+@register
+class Nadam(Optimizer):
+    """Nesterov-accelerated Adam (parity: optimizer.Nadam — Dozat's
+    momentum-schedule formulation)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (_state_zeros(weight, dtype=np.float32),
+                _state_zeros(weight, dtype=np.float32))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        grad = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient is not None:
+            grad = _nd._invoke("clip", [grad],
+                               {"a_min": -self.clip_gradient,
+                                "a_max": self.clip_gradient})
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t *
+                                                        self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** (
+            (t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+
+        m, v = state
+        m *= self.beta1
+        m += (1.0 - self.beta1) * grad
+        v *= self.beta2
+        v += (1.0 - self.beta2) * grad * grad
+
+        grad_prime = grad / (1.0 - self.m_schedule)
+        m_prime = m / (1.0 - m_schedule_next)
+        v_prime = v / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - momentum_t) * grad_prime + momentum_t_1 * m_prime
+        weight -= lr * m_bar / (_nd._invoke("sqrt", [v_prime], {}) +
+                                self.epsilon)
+
+
+def jnp_maximum(a, b):
+    import jax.numpy as jnp
+    return jnp.maximum(a, b)
+
+
+def jnp_abs(a):
+    import jax.numpy as jnp
+    return jnp.abs(a)
+
+
 create = Optimizer.create_optimizer
 
 

@@ -97,3 +97,8 @@ class Scope:
 
     def __exit__(self, *exc):
         self._ann.__exit__(*exc)
+
+
+def dump_profile():
+    """Deprecated alias of dump() (parity: profiler.dump_profile)."""
+    dump(True)

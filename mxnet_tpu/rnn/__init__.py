@@ -1,6 +1,8 @@
 """Legacy symbolic RNN package (parity: python/mxnet/rnn/)."""
 from .rnn_cell import (BaseRNNCell, RNNCell, LSTMCell, GRUCell, FusedRNNCell,
                        SequentialRNNCell, BidirectionalCell, DropoutCell,
-                       ZoneoutCell, ResidualCell)
+                       ZoneoutCell, ResidualCell, RNNParams, ModifierCell,
+                       BaseConvRNNCell, ConvRNNCell, ConvLSTMCell,
+                       ConvGRUCell)
 from .io import BucketSentenceIter, encode_sentences
 from .rnn import save_rnn_checkpoint, load_rnn_checkpoint, do_rnn_checkpoint

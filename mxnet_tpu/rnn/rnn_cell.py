@@ -12,7 +12,8 @@ from ..symbol import Symbol, Variable
 
 __all__ = ["BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell", "FusedRNNCell",
            "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
-           "ZoneoutCell", "ResidualCell", "RNNParams"]
+           "ZoneoutCell", "ResidualCell", "RNNParams", "ModifierCell",
+           "BaseConvRNNCell", "ConvRNNCell", "ConvLSTMCell", "ConvGRUCell"]
 
 
 class RNNParams:
@@ -316,6 +317,90 @@ class FusedRNNCell(BaseRNNCell):
             outputs = sym_mod.swapaxes(outputs, dim1=0, dim2=1)
         return outputs, states
 
+    # -- packed-blob <-> named-parameter views ------------------------------
+    @property
+    def _gate_names(self):
+        return {"rnn_relu": [""], "rnn_tanh": [""],
+                "lstm": ["_i", "_f", "_c", "_o"],
+                "gru": ["_r", "_z", "_o"]}[self._mode]
+
+    def _blob_layout(self, input_size):
+        """[(name, shape, offset)] of the packed blob (ops/rnn.py layout:
+        per layer, per direction: W_ih, W_hh, b_ih, b_hh; per-gate rows)."""
+        from ..ops.rnn import _GATES
+        g = _GATES[self._mode]
+        H = self._num_hidden
+        gates = self._gate_names
+        out = []
+        off = 0
+        for layer in range(self._num_layers):
+            in_sz = input_size if layer == 0 else H * self._directions
+            for d in range(self._directions):
+                pre = "%s%s%d_" % (self._prefix, "lr"[d], layer)
+                for gi in range(g):
+                    out.append(("%si2h%s_weight" % (pre, gates[gi]),
+                                (H, in_sz), off + gi * H * in_sz))
+                off += g * H * in_sz
+                for gi in range(g):
+                    out.append(("%sh2h%s_weight" % (pre, gates[gi]),
+                                (H, H), off + gi * H * H))
+                off += g * H * H
+                for gi in range(g):
+                    out.append(("%si2h%s_bias" % (pre, gates[gi]),
+                                (H,), off + gi * H))
+                off += g * H
+                for gi in range(g):
+                    out.append(("%sh2h%s_bias" % (pre, gates[gi]),
+                                (H,), off + gi * H))
+                off += g * H
+        return out, off
+
+    def _infer_input_size(self, blob_size):
+        """Solve the packed size equation for input_size (rnn_param_size
+        is linear in it)."""
+        from ..ops.rnn import rnn_param_size
+        base = rnn_param_size(0, self._num_hidden, self._num_layers,
+                              self._mode, self._bidirectional)
+        from ..ops.rnn import _GATES
+        per_in = _GATES[self._mode] * self._num_hidden * self._directions
+        in_sz, rem = divmod(blob_size - base, per_in)
+        if rem or in_sz <= 0:
+            raise MXNetError("parameter blob size %d does not match this "
+                             "cell's configuration" % blob_size)
+        return in_sz
+
+    def unpack_weights(self, args):
+        """Packed ``parameters`` blob -> per-layer/gate named arrays
+        (parity: rnn_cell.FusedRNNCell.unpack_weights)."""
+        import numpy as np
+        from ..ndarray import array as nd_array
+        args = args.copy()
+        blob = args.pop("%sparameters" % self._prefix)
+        flat = blob.asnumpy().ravel()
+        layout, total = self._blob_layout(self._infer_input_size(flat.size))
+        if total != flat.size:
+            raise MXNetError("blob size mismatch")
+        for name, shape, off in layout:
+            n = int(np.prod(shape))
+            args[name] = nd_array(flat[off:off + n].reshape(shape))
+        return args
+
+    def pack_weights(self, args):
+        """Inverse of :meth:`unpack_weights`."""
+        import numpy as np
+        from ..ndarray import array as nd_array
+        args = args.copy()
+        g = self._gate_names
+        probe = "%s%s0_i2h%s_weight" % (self._prefix, "l", g[0])
+        in_sz = args[probe].shape[1]
+        layout, total = self._blob_layout(in_sz)
+        flat = np.zeros(total, np.float32)
+        for name, shape, off in layout:
+            n = int(np.prod(shape))
+            flat[off:off + n] = args.pop(name).asnumpy().ravel()
+        args["%sparameters" % self._prefix] = nd_array(flat)
+        return args
+
 
 class SequentialRNNCell(BaseRNNCell):
     """(parity: rnn_cell.SequentialRNNCell)"""
@@ -453,3 +538,142 @@ class BidirectionalCell(BaseRNNCell):
         outputs = sym_mod.Concat(l_out, r_out, dim=2,
                                  name="%sout" % self._output_prefix)
         return outputs, l_states + r_states
+
+
+# ---------------------------------------------------------------------------
+# Convolutional RNN cells (parity: rnn_cell.py:1094-1455)
+# ---------------------------------------------------------------------------
+
+class BaseConvRNNCell(BaseRNNCell):
+    """Abstract convolutional RNN cell (parity: rnn_cell.BaseConvRNNCell):
+    gate pre-activations are convolutions over the input and the spatial
+    hidden state instead of dense projections."""
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel=(3, 3),
+                 h2h_dilate=(1, 1), i2h_kernel=(3, 3), i2h_stride=(1, 1),
+                 i2h_pad=(1, 1), i2h_dilate=(1, 1), activation="tanh",
+                 prefix="", params=None, conv_layout="NCHW"):
+        super().__init__(prefix=prefix, params=params)
+        if h2h_kernel[0] % 2 == 0 or h2h_kernel[1] % 2 == 0:
+            raise MXNetError("h2h_kernel must be odd (got %s)"
+                             % (h2h_kernel,))
+        self._h2h_kernel = tuple(h2h_kernel)
+        self._h2h_dilate = tuple(h2h_dilate)
+        self._h2h_pad = (h2h_dilate[0] * (h2h_kernel[0] - 1) // 2,
+                         h2h_dilate[1] * (h2h_kernel[1] - 1) // 2)
+        self._i2h_kernel = tuple(i2h_kernel)
+        self._i2h_stride = tuple(i2h_stride)
+        self._i2h_pad = tuple(i2h_pad)
+        self._i2h_dilate = tuple(i2h_dilate)
+        self._num_hidden = num_hidden
+        self._input_shape = tuple(input_shape)
+        self._conv_layout = conv_layout
+        self._activation = activation
+        # state spatial shape falls out of the i2h convolution
+        probe = sym_mod.Convolution(
+            Variable("data"), num_filter=num_hidden,
+            kernel=self._i2h_kernel, stride=self._i2h_stride,
+            pad=self._i2h_pad, dilate=self._i2h_dilate)
+        shape = probe.infer_shape(data=self._input_shape)[1][0]
+        self._state_shape = (0,) + tuple(shape[1:])
+        self._iW = self.params.get("i2h_weight")
+        self._hW = self.params.get("h2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def _num_gates(self):
+        return len(self._gate_names)
+
+    @property
+    def state_info(self):
+        return [{"shape": self._state_shape,
+                 "__layout__": self._conv_layout},
+                {"shape": self._state_shape,
+                 "__layout__": self._conv_layout}]
+
+    def _conv_forward(self, inputs, states, name):
+        i2h = sym_mod.Convolution(
+            inputs, weight=self._iW, bias=self._iB,
+            num_filter=self._num_hidden * self._num_gates,
+            kernel=self._i2h_kernel, stride=self._i2h_stride,
+            pad=self._i2h_pad, dilate=self._i2h_dilate,
+            name="%si2h" % name)
+        h2h = sym_mod.Convolution(
+            states[0], weight=self._hW, bias=self._hB,
+            num_filter=self._num_hidden * self._num_gates,
+            kernel=self._h2h_kernel, pad=self._h2h_pad,
+            dilate=self._h2h_dilate, name="%sh2h" % name)
+        return i2h, h2h
+
+
+class ConvRNNCell(BaseConvRNNCell):
+    """(parity: rnn_cell.ConvRNNCell)"""
+
+    @property
+    def _gate_names(self):
+        return ("",)
+
+    @property
+    def state_info(self):
+        return [{"shape": self._state_shape,
+                 "__layout__": self._conv_layout}]
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h, h2h = self._conv_forward(inputs, states, name)
+        output = self._get_activation(i2h + h2h, self._activation,
+                                      name="%sout" % name)
+        return output, [output]
+
+
+class ConvLSTMCell(BaseConvRNNCell):
+    """(parity: rnn_cell.ConvLSTMCell — Shi et al. convolutional LSTM)"""
+
+    @property
+    def _gate_names(self):
+        return ("_i", "_f", "_c", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h, h2h = self._conv_forward(inputs, states, name)
+        gates = i2h + h2h
+        sliced = sym_mod.SliceChannel(gates, num_outputs=4, axis=1,
+                                      name="%sslice" % name)
+        in_gate = sym_mod.Activation(sliced[0], act_type="sigmoid")
+        forget_gate = sym_mod.Activation(sliced[1], act_type="sigmoid")
+        in_transform = self._get_activation(sliced[2], self._activation)
+        out_gate = sym_mod.Activation(sliced[3], act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * self._get_activation(next_c, self._activation)
+        return next_h, [next_h, next_c]
+
+
+class ConvGRUCell(BaseConvRNNCell):
+    """(parity: rnn_cell.ConvGRUCell)"""
+
+    @property
+    def _gate_names(self):
+        return ("_r", "_z", "_o")
+
+    @property
+    def state_info(self):
+        return [{"shape": self._state_shape,
+                 "__layout__": self._conv_layout}]
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = "%st%d_" % (self._prefix, self._counter)
+        i2h, h2h = self._conv_forward(inputs, states, name)
+        i2h_s = sym_mod.SliceChannel(i2h, num_outputs=3, axis=1)
+        h2h_s = sym_mod.SliceChannel(h2h, num_outputs=3, axis=1)
+        reset_gate = sym_mod.Activation(i2h_s[0] + h2h_s[0],
+                                        act_type="sigmoid")
+        update_gate = sym_mod.Activation(i2h_s[1] + h2h_s[1],
+                                         act_type="sigmoid")
+        next_h_tmp = self._get_activation(i2h_s[2] + reset_gate * h2h_s[2],
+                                          self._activation)
+        next_h = (1.0 - update_gate) * next_h_tmp + update_gate * states[0]
+        return next_h, [next_h]

@@ -19,3 +19,61 @@ def arange(start, stop=None, step=1.0, repeat=1, dtype="float32", **kwargs):
 
 from . import contrib  # noqa: E402,F401
 from . import sparse   # noqa: E402,F401
+
+
+# module-level symbol helpers (parity: symbol.py:2179-2287)
+def pow(base, exp):
+    """(parity: mx.sym.pow)"""
+    from .symbol import Symbol as _S
+    if isinstance(base, _S) and isinstance(exp, _S):
+        return base.__pow__(exp)
+    if isinstance(base, _S):
+        return base ** exp
+    if isinstance(exp, _S):
+        return globals()["_rpower_scalar"](exp, scalar=base)
+    return base ** exp
+
+
+def maximum(left, right):
+    """(parity: mx.sym.maximum)"""
+    from .symbol import Symbol as _S
+    if isinstance(left, _S) and isinstance(right, _S):
+        return globals()["broadcast_maximum"](left, right)
+    if isinstance(left, _S):
+        return globals()["_maximum_scalar"](left, scalar=right)
+    if isinstance(right, _S):
+        return globals()["_maximum_scalar"](right, scalar=left)
+    return left if left > right else right
+
+
+def minimum(left, right):
+    """(parity: mx.sym.minimum)"""
+    from .symbol import Symbol as _S
+    if isinstance(left, _S) and isinstance(right, _S):
+        return globals()["broadcast_minimum"](left, right)
+    if isinstance(left, _S):
+        return globals()["_minimum_scalar"](left, scalar=right)
+    if isinstance(right, _S):
+        return globals()["_minimum_scalar"](right, scalar=left)
+    return left if left < right else right
+
+
+def hypot(left, right):
+    """(parity: mx.sym.hypot)"""
+    from .symbol import Symbol as _S
+    if isinstance(left, _S) and isinstance(right, _S):
+        return globals()["broadcast_hypot"](left, right)
+    if isinstance(left, _S):
+        return globals()["_hypot_scalar"](left, scalar=right)
+    if isinstance(right, _S):
+        return globals()["_hypot_scalar"](right, scalar=left)
+    import math
+    return math.hypot(left, right)
+
+
+def full(shape, val, dtype=None):
+    """(parity: mx.sym.full) — a constant-filled symbol."""
+    return globals()["_full"](shape=shape, value=float(val),
+                              dtype=dtype or "float32") \
+        if "_full" in globals() else \
+        globals()["zeros"](shape=shape, dtype=dtype or "float32") + val
