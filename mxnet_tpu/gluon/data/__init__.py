@@ -1,4 +1,6 @@
 """Gluon data API (parity: python/mxnet/gluon/data)."""
 from .dataset import *  # noqa: F401,F403
 from .dataloader import *  # noqa: F401,F403
+from .sampler import *  # noqa: F401,F403
+from . import sampler  # noqa: F401
 from . import vision  # noqa: F401

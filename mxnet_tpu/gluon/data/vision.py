@@ -15,9 +15,10 @@ import numpy as np
 
 from ...base import MXNetError
 from ...ndarray import array as nd_array
-from .dataset import Dataset
+from .dataset import Dataset, RecordFileDataset
 
-__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100"]
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset"]
 
 
 class _DownloadedDataset(Dataset):
@@ -135,3 +136,63 @@ class CIFAR100(_DownloadedDataset):
         raw = raw.reshape(-1, 3074)
         self._label = raw[:, 1 if self._fine else 0].astype(np.int32)
         self._data = raw[:, 2:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Dataset over a packed image RecordIO file (parity:
+    vision.ImageRecordDataset)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from ... import recordio
+        from ...image import image as img_mod
+        record = super().__getitem__(idx)
+        header, payload = recordio.unpack(record)
+        image = img_mod.imdecode(payload, flag=self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(image, label)
+        return image, label
+
+    def raw_item(self, idx):
+        return None   # decode emits NDArrays; thread workers handle it
+
+
+class ImageFolderDataset(Dataset):
+    """Folder-per-class image dataset (parity:
+    vision.ImageFolderDataset): root/<label>/<image>.jpg."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                if os.path.splitext(filename)[1].lower() in self._exts:
+                    self.items.append((os.path.join(path, filename), label))
+
+    def __getitem__(self, idx):
+        from ...image import image as img_mod
+        img = img_mod.imread(self.items[idx][0], flag=self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

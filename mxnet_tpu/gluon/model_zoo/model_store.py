@@ -24,7 +24,35 @@ import shutil
 
 from ...base import MXNetError
 
-__all__ = ["get_model_file", "purge"]
+__all__ = ["get_model_file", "purge", "short_hash"]
+
+# the reference's published sha1 pins (model_store.py:28-51) — data, kept
+# so reference-named blobs (``name-<hash8>.params``) resolve identically
+_checksums = {name: sha1 for sha1, name in [
+    ("44335d1f0046b328243b32a26a4fbd62d9057b45", "alexnet"),
+    ("f27dbf2dbd5ce9a80b102d89c7483342cd33cb31", "densenet121"),
+    ("b6c8a95717e3e761bd88d145f4d0a214aaa515dc", "densenet161"),
+    ("2603f878403c6aa5a71a124c4a3307143d6820e9", "densenet169"),
+    ("1cdbc116bc3a1b65832b18cf53e1cb8e7da017eb", "densenet201"),
+    ("ed47ec45a937b656fcc94dabde85495bbef5ba1f", "inceptionv3"),
+    ("d2b128fa89477c2e20061607a53a8d9f66ce239d", "resnet101_v1"),
+    ("6562166cd597a6328a32a0ce47bb651df80b3bbb", "resnet152_v1"),
+    ("38d6d423c22828718ec3397924b8e116a03e6ac0", "resnet18_v1"),
+    ("4dc2c2390a7c7990e0ca1e53aeebb1d1a08592d1", "resnet34_v1"),
+    ("2a903ab21260c85673a78fe65037819a843a1f43", "resnet50_v1"),
+    ("8aacf80ff4014c1efa2362a963ac5ec82cf92d5b", "resnet18_v2"),
+    ("0ed3cd06da41932c03dea1de7bc2506ef3fb97b3", "resnet34_v2"),
+    ("eb7a368774aa34a12ed155126b641ae7556dad9d", "resnet50_v2"),
+    ("264ba4970a0cc87a4f15c96e25246a1307caf523", "squeezenet1.0"),
+    ("33ba0f93753c83d86e1eb397f38a667eaf2e9376", "squeezenet1.1"),
+    ("dd221b160977f36a53f464cb54648d227c707a05", "vgg11"),
+    ("ee79a8098a91fbe05b7a973fed2017a6117723a8", "vgg11_bn"),
+    ("6bc5de58a05a5e2e7f493e2d75a580d83efde38c", "vgg13"),
+    ("7d97a06c3c7a1aecc88b6e7385c2b373a249e95e", "vgg13_bn"),
+    ("649467530119c0f78c4859999e264e7bf14471a9", "vgg16"),
+    ("6b9dbe6194e5bfed30fd7a7c9a71f7e5a276cb14", "vgg16_bn"),
+    ("f713436691eee9a20d70a145ce0d53ed24bf7399", "vgg19"),
+    ("9730961c9cea43fd7eeefb00d792e386c45847d6", "vgg19_bn")]}
 
 
 def _candidates(name, root):
@@ -61,3 +89,11 @@ def purge(root="~/.mxnet/models/"):
     if os.path.isdir(root):
         for f in glob.glob(os.path.join(root, "*.params")):
             os.remove(f)
+
+
+def short_hash(name):
+    """First 8 hex chars of the model's weight-file hash (parity:
+    model_store.short_hash — keyed off the registered checksum table)."""
+    if name not in _checksums:
+        raise ValueError("Pretrained model for %s is not available." % name)
+    return _checksums[name][:8]

@@ -13,7 +13,8 @@ from ...base import MXNetError
 from ..block import Block, HybridBlock
 from ..parameter import DeferredInitializationError
 
-__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
+__all__ = ["Lambda", "HybridLambda",
+           "Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
            "Embedding", "Flatten", "Activation", "LeakyReLU", "InstanceNorm",
            "LayerNorm"]
 
@@ -300,3 +301,46 @@ class LeakyReLU(HybridBlock):
 
     def hybrid_forward(self, F, x):
         return F.LeakyReLU(x, act_type="leaky", slope=self._alpha)
+
+
+class Lambda(Block):
+    """Wrap a function as a Block (parity: nn.Lambda; accepts an mx.nd
+    function name or a callable)."""
+
+    def __init__(self, function, prefix=None):
+        super().__init__(prefix=prefix)
+        if isinstance(function, str):
+            import mxnet_tpu.ndarray as F
+            if not hasattr(F, function):
+                raise MXNetError("function %r not found in mx.nd" % function)
+            self._func_impl = getattr(F, function)
+        else:
+            self._func_impl = function
+
+    def forward(self, *args):
+        return self._func_impl(*args)
+
+    def __repr__(self):
+        return "Lambda(%s)" % getattr(self._func_impl, "__name__",
+                                      self._func_impl)
+
+
+class HybridLambda(HybridBlock):
+    """Wrap a function as a HybridBlock (parity: nn.HybridLambda)."""
+
+    def __init__(self, function, prefix=None):
+        super().__init__(prefix=prefix)
+        if isinstance(function, str):
+            self._func_name = function
+            self._func_impl = None
+        else:
+            self._func_impl = function
+            self._func_name = getattr(function, "__name__", "lambda")
+
+    def hybrid_forward(self, F, x, *args):
+        if self._func_impl is not None:
+            return self._func_impl(F, x, *args)
+        return getattr(F, self._func_name)(x, *args)
+
+    def __repr__(self):
+        return "HybridLambda(%s)" % self._func_name

@@ -10,7 +10,8 @@ from __future__ import annotations
 from ...base import MXNetError
 from ..block import HybridBlock
 
-__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "ModifierCell",
+           "RNNCell", "LSTMCell", "GRUCell",
            "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
            "ZoneoutCell", "ResidualCell"]
 
@@ -83,6 +84,10 @@ class RecurrentCell(HybridBlock):
                 params[name] = p.data()
         from ... import ndarray as F
         return self.hybrid_forward(F, x, list(states), **params)
+
+
+# the reference's cells hybridize; RecurrentCell here IS hybrid-capable
+HybridRecurrentCell = RecurrentCell
 
 
 class _BaseRNNCell(RecurrentCell):

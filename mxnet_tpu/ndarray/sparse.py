@@ -22,6 +22,7 @@ from ..context import current_context
 from .ndarray import NDArray, _wrap, array as _dense_array
 
 __all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
+           "empty", "array",
            "zeros", "cast_storage", "dot", "add_n", "elemwise_add"]
 
 
@@ -303,3 +304,21 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     return _dense_dot(_wrap(lhs._data, lhs.context) if isinstance(lhs, BaseSparseNDArray) else lhs,
                       _wrap(rhs._data, rhs.context) if isinstance(rhs, BaseSparseNDArray) else rhs,
                       transpose_a=transpose_a, transpose_b=transpose_b)
+
+
+def empty(stype, shape, ctx=None, dtype=None):
+    """Sparse-aware empty (parity: sparse.empty — zeros-backed like the
+    dense path; XLA has no uninitialised buffers)."""
+    return zeros(stype, shape, ctx=ctx, dtype=dtype)
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Build a sparse NDArray from any array-like/sparse input keeping its
+    storage type (parity: sparse.array)."""
+    import numpy as _np
+    if isinstance(source_array, BaseSparseNDArray):
+        return cast_storage(source_array.tostype("default"),
+                            source_array.stype)
+    from .ndarray import array as _dense_array
+    dense = _dense_array(_np.asarray(source_array), ctx=ctx, dtype=dtype)
+    return dense

@@ -18,6 +18,20 @@ SKIP = {
                       "kwargs the vjp tape does not keep; hybridize/"
                       "CachedOp is the supported trace-to-graph path",
     },
+    "gluon/data/dataloader.py": {
+        # our process mode ships shm descriptors from accelerator-free
+        # forked workers (dataloader._proc_worker/_tree_to_shm); the
+        # reference's pickler-patching plumbing has no counterpart
+        "rebuild_ndarray": "mp transport is shm descriptors, not pickled "
+                           "NDArrays",
+        "reduce_ndarray": "same",
+        "ConnectionWrapper": "same",
+        "Queue": "same",
+        "default_mp_batchify_fn": "worker batchify is _numpy_batchify "
+                                  "(NDArrays cannot exist in the "
+                                  "accelerator-free child)",
+        "worker_loop": "worker entry is _proc_worker",
+    },
 }
 
 
@@ -39,6 +53,16 @@ def _pairs():
         "lr_scheduler.py": mx.lr_scheduler, "rnn/rnn_cell.py": mx.rnn,
         "rnn/io.py": mx.rnn, "model.py": mx.model, "executor.py": mx,
         "context.py": mx, "operator.py": mx.operator,
+        "gluon/nn/basic_layers.py": mx.gluon.nn,
+        "gluon/nn/conv_layers.py": mx.gluon.nn,
+        "gluon/rnn/rnn_cell.py": mx.gluon.rnn,
+        "gluon/rnn/rnn_layer.py": mx.gluon.rnn,
+        "gluon/data/dataset.py": mx.gluon.data,
+        "gluon/data/dataloader.py": mx.gluon.data,
+        "gluon/data/sampler.py": mx.gluon.data,
+        "gluon/data/vision.py": mx.gluon.data.vision,
+        "ndarray/sparse.py": mx.nd.sparse,
+        "ndarray/linalg.py": mx.nd.linalg,
     }
 
 
