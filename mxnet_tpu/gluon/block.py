@@ -206,6 +206,30 @@ class HybridBlock(Block):
     def infer_shape(self, *args):
         self._deferred_infer(args)
 
+    def infer_type(self, *args):
+        """Infer parameter dtypes from example inputs (parity:
+        block.infer_type — shapes and dtypes flow through the same
+        abstract forward here)."""
+        self._deferred_infer(args)
+
+    def export(self, path):
+        """Write ``path-symbol.json`` + ``path-0000.params`` in the
+        checkpoint format (parity: block.export). The graph is captured
+        by re-running the block on a Symbol input; parameters must be
+        initialised (run one forward first)."""
+        from .. import symbol as sym_mod
+        out = self(sym_mod.Variable("data"))
+        if isinstance(out, (list, tuple)):
+            out = sym_mod.Group(list(out))
+        out.save("%s-symbol.json" % path)
+        aux_names = set(out.list_auxiliary_states())
+        from ..ndarray import save as nd_save
+        blob = {}
+        for name, param in self.collect_params().items():
+            prefix = "aux:" if name in aux_names else "arg:"
+            blob[prefix + name] = param.data()
+        nd_save("%s-0000.params" % path, blob)
+
     def _deferred_infer(self, args):
         """Run an abstract forward to fill deferred param shapes."""
         try:
@@ -226,9 +250,20 @@ class HybridBlock(Block):
             raise
 
     def __call__(self, *args):
+        from ..symbol import Symbol as _Sym
+        if args and isinstance(args[0], _Sym):
+            # symbolic capture (reference: hybrid_forward(F=symbol) when
+            # called on Symbols) — powers export()
+            return self._forward_symbol(*args)
         if self._active and not _common.state().graph_capturing:
             return self._call_cached_op(*args)
         return self._forward_eager(*args)
+
+    def _forward_symbol(self, x, *args):
+        from .. import symbol as F
+        params = {name: F.Variable(p.name)
+                  for name, p in self._reg_params.items()}
+        return self.hybrid_forward(F, x, *args, **params)
 
     # -- eager path --------------------------------------------------------
     def _forward_eager(self, x, *args):

@@ -206,6 +206,37 @@ class BaseModule:
         pass
 
     # -- params ------------------------------------------------------------
+    def iter_predict(self, eval_data, num_batch=None, reset=True):
+        """Iterate over (outputs, batch_index, batch) during prediction
+        (parity: base_module.iter_predict)."""
+        assert self.binded and self.params_initialized
+        if reset:
+            eval_data.reset()
+        for nbatch, eval_batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(eval_batch, is_train=False)
+            pad = eval_batch.pad
+            outputs = [out[0:out.shape[0] - (pad or 0)]
+                       for out in self.get_outputs()]
+            yield outputs, nbatch, eval_batch
+
+    def get_states(self, merge_multi_context=True):
+        """States of stateful modules — none here (parity:
+        base_module.get_states; mirrors the reference default)."""
+        assert self.binded and self.params_initialized
+        return []
+
+    def set_states(self, states=None, value=None):
+        """(parity: base_module.set_states — no-op for stateless)"""
+        assert self.binded and self.params_initialized
+        assert not states and not value
+
+    def get_input_grads(self, merge_multi_context=True):
+        """Gradients w.r.t. the input data (parity:
+        base_module.get_input_grads)."""
+        raise NotImplementedError()
+
     def get_params(self):
         raise NotImplementedError
 

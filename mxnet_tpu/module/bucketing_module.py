@@ -95,6 +95,15 @@ class BucketingModule(BaseModule):
         self._curr_bucket_key = self._default_bucket_key
         self.binded = True
 
+    def get_states(self, merge_multi_context=True):
+        """(parity: bucketing_module.get_states — delegates)"""
+        assert self.binded and self.params_initialized
+        return self._curr_module.get_states(merge_multi_context)
+
+    def set_states(self, states=None, value=None):
+        assert self.binded and self.params_initialized
+        self._curr_module.set_states(states, value)
+
     def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
         """(parity: bucketing_module.switch_bucket)"""
         assert self.binded

@@ -424,6 +424,24 @@ class Module(BaseModule):
             mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
         return mod
 
+    def borrow_optimizer(self, shared_module):
+        """Share another Module's optimizer/updater (parity:
+        module.borrow_optimizer)."""
+        assert shared_module.optimizer_initialized
+        self._optimizer = shared_module._optimizer
+        self._kvstore = shared_module._kvstore
+        self._update_on_kvstore = shared_module._update_on_kvstore
+        self._updater = shared_module._updater
+        self.optimizer_initialized = True
+
+    def get_input_grads(self, merge_multi_context=True):
+        """Gradients w.r.t. inputs from the last backward (parity:
+        module.get_input_grads — requires inputs_need_grad)."""
+        assert self.binded and self.params_initialized
+        assert self.inputs_need_grad
+        grads = self._exec.grad_dict
+        return [grads[name] for name in self._data_names if name in grads]
+
     def save_optimizer_states(self, fname):
         """(parity: module.save_optimizer_states:759)"""
         assert self.optimizer_initialized

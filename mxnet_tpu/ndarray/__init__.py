@@ -69,3 +69,35 @@ def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0, channels=3,
     """Decode an image bytestring (parity: mx.nd.imdecode)."""
     from ..image import image as _img
     return _img.imdecode(str_img, flag=1 if channels == 3 else 0)
+
+
+# fluent methods: x.relu() == mx.nd.relu(x) — generated from the op
+# namespace exactly like the reference attaches op wrappers to NDArray
+# (ndarray.py fluent-method block)
+_FLUENT_METHODS = [
+    "reshape_like", "zeros_like", "ones_like", "broadcast_axes", "repeat",
+    "pad", "split", "slice", "take", "one_hot", "pick", "sort", "topk",
+    "argsort", "argmax_channel", "flip", "nansum", "nanprod", "rint",
+    "fix", "floor", "ceil", "trunc", "sin", "cos", "tan", "arcsin",
+    "arccos", "arctan", "degrees", "radians", "sinh", "cosh", "tanh",
+    "arcsinh", "arccosh", "arctanh", "expm1", "log10", "log2", "log1p",
+    "rsqrt", "cbrt", "rcbrt", "reciprocal", "relu", "sigmoid", "softmax",
+    "log_softmax", "swapaxes", "argmax", "argmin", "clip", "abs", "sign",
+    "expand_dims", "broadcast_to", "tile", "prod", "max", "min", "norm",
+    "round", "exp", "log", "sqrt", "square", "flatten",
+]
+
+
+def _attach_fluent(cls, ns, names):
+    def make(op_name, fn):
+        def method(self, *args, **kwargs):
+            return fn(self, *args, **kwargs)
+        method.__name__ = op_name
+        method.__doc__ = "Fluent form of %s(self, ...)" % op_name
+        return method
+    for op_name in names:
+        if op_name in ns and not hasattr(cls, op_name):
+            setattr(cls, op_name, make(op_name, ns[op_name]))
+
+
+_attach_fluent(NDArray, globals(), _FLUENT_METHODS)

@@ -171,6 +171,19 @@ class CSRNDArray(BaseSparseNDArray):
         raise MXNetError("unknown stype %r" % stype)
 
 
+def _csr_asscipy(self):
+    """scipy.sparse.csr_matrix view (parity: sparse.CSRNDArray.asscipy)."""
+    try:
+        from scipy import sparse as sps
+    except ImportError:
+        raise ImportError("scipy is not installed")
+    return sps.csr_matrix((self.data.asnumpy(), self.indices.asnumpy(),
+                           self.indptr.asnumpy()), shape=self.shape)
+
+
+CSRNDArray.asscipy = _csr_asscipy
+
+
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
     """Create a RowSparseNDArray (parity: mx.nd.sparse.row_sparse_array)."""
     if isinstance(arg1, tuple) and len(arg1) == 2:

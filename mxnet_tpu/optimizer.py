@@ -75,6 +75,26 @@ class Optimizer:
         self.set_lr_mult({})
         self.set_wd_mult({})
 
+    @property
+    def learning_rate(self):
+        """(parity: optimizer.learning_rate)"""
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_learning_rate(self, lr):
+        """(parity: optimizer.set_learning_rate)"""
+        if self.lr_scheduler is not None:
+            raise UserWarning("LRScheduler of the optimizer has already "
+                              "been defined.")
+        self.lr = lr
+
+    def set_lr_scale(self, args_lrscale):
+        """Deprecated alias of set_lr_mult (parity:
+        optimizer.set_lr_scale)."""
+        self.set_lr_mult({self.idx2name.get(i, i): s
+                          for i, s in args_lrscale.items()})
+
     # -- registry ----------------------------------------------------------
     @staticmethod
     def create_optimizer(name, **kwargs):

@@ -77,3 +77,27 @@ def full(shape, val, dtype=None):
                               dtype=dtype or "float32") \
         if "_full" in globals() else \
         globals()["zeros"](shape=shape, dtype=dtype or "float32") + val
+
+
+# fluent methods (x.relu() == mx.sym.relu(x)) + the reference's
+# explicitly-unsupported NDArray-only stubs (symbol.py raises
+# NotImplementedForSymbol for these)
+from ..ndarray import _FLUENT_METHODS as _FLUENT, _attach_fluent  # noqa: E402
+_attach_fluent(Symbol, globals(), _FLUENT)
+
+
+def _not_for_symbol(name):
+    def method(self, *args, **kwargs):
+        from ..base import MXNetError
+        raise MXNetError("operation %s is not supported for Symbol "
+                         "(parity: symbol.py NotImplementedForSymbol)"
+                         % name)
+    method.__name__ = name
+    return method
+
+
+for _name in ["wait_to_read", "asnumpy", "asscalar", "copy",
+              "as_in_context", "detach", "backward"]:
+    if not hasattr(Symbol, _name):
+        setattr(Symbol, _name, _not_for_symbol(_name))
+del _name

@@ -167,6 +167,42 @@ class Symbol:
         node = self._outputs[0][0]
         node._extra_attrs.update(kwargs)
 
+    def list_attr(self, recursive=False):
+        """Attributes of this node (parity: symbol.list_attr:570; use
+        attr_dict() for the recursive per-node view)."""
+        if recursive:
+            raise MXNetError("list_attr(recursive=True) was removed in the "
+                             "reference too; use attr_dict() instead")
+        node = self._outputs[0][0]
+        out = {k: str(v) for k, v in node._extra_attrs.items()}
+        return out
+
+    def list_inputs(self):
+        """All arguments and auxiliary states (parity:
+        symbol.list_inputs:786)."""
+        return self.list_arguments() + self.list_auxiliary_states()
+
+    def debug_str(self):
+        """Human-readable graph dump (parity: symbol.debug_str:1108)."""
+        lines = []
+        for node in self._topo_nodes():
+            if node.op is None:
+                lines.append("Variable:%s" % node.name)
+            else:
+                ins = ", ".join(n.name for n, _ in node.inputs)
+                attrs = "".join(", %s=%r" % kv
+                                for kv in sorted(node.attrs.items()))
+                lines.append("Op:%s, Name=%s\n  Inputs: %s%s"
+                             % (node.op.name, node.name, ins, attrs))
+        return "\n".join(lines) + "\n"
+
+    def gradient(self, wrt):
+        """(parity: symbol.gradient:1676 — unimplemented in the reference
+        as well; use simple_bind + backward or autograd)"""
+        raise MXNetError("symbol.gradient is not implemented (the "
+                         "reference raises too); use executor backward "
+                         "or autograd")
+
     def attr_dict(self):
         out = {}
         for node in self._topo_nodes():
