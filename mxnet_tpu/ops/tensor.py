@@ -203,7 +203,13 @@ def pad(data, mode="constant", pad_width=(), constant_value=0.0):
 
 @register("where", nin=3, arg_names=["condition", "x", "y"])
 def where(condition, x, y):
-    return jnp.where(condition.astype(bool), x, y)
+    """(reference control_flow_op.cc where): condition either matches
+    x/y's shape elementwise, or is a 1-D batch vector selecting whole
+    rows (csr-condition form of the reference)."""
+    cond = condition.astype(bool)
+    if cond.ndim == 1 and x.ndim > 1 and cond.shape[0] == x.shape[0]:
+        cond = cond.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.where(cond, x, y)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,230 @@
+"""Edge-case operator semantics ported (behaviourally) from the
+reference unittest suite (tests/python/unittest/test_operator.py) —
+the cases that most often diverge between backends: indexing modes,
+ordering ops, masking, transpose combos, padding modes."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+
+def _nd(x):
+    return mx.nd.array(np.asarray(x, np.float32))
+
+
+def test_take_modes():
+    """(ref test_operator.py:2699 test_take) axis + clip/wrap modes."""
+    rs = np.random.RandomState(0)
+    a = rs.randn(4, 5).astype(np.float32)
+    idx = np.array([0, 3, -1, 4, 7], np.float32)   # out of range on purpose
+    got = mx.nd.take(_nd(a), _nd(idx), axis=0, mode="clip").asnumpy()
+    want = a[np.clip(idx.astype(np.int64), 0, 3)]
+    np.testing.assert_allclose(got, want)
+    got = mx.nd.take(_nd(a), _nd(idx), axis=0, mode="wrap").asnumpy()
+    want = a[idx.astype(np.int64) % 4]
+    np.testing.assert_allclose(got, want)
+    # axis=1
+    idx2 = np.array([1, 4], np.float32)
+    got = mx.nd.take(_nd(a), _nd(idx2), axis=1).asnumpy()
+    np.testing.assert_allclose(got, a[:, [1, 4]])
+
+
+def test_pick_modes():
+    """(ref test_operator.py pick) axis selection + keepdims."""
+    rs = np.random.RandomState(1)
+    a = rs.randn(3, 4).astype(np.float32)
+    idx = np.array([0, 3, 2], np.float32)
+    got = mx.nd.pick(_nd(a), _nd(idx), axis=1).asnumpy()
+    want = a[np.arange(3), idx.astype(np.int64)]
+    np.testing.assert_allclose(got, want)
+    got = mx.nd.pick(_nd(a), _nd(idx), axis=1, keepdims=True).asnumpy()
+    np.testing.assert_allclose(got, want[:, None])
+
+
+def test_one_hot_values():
+    """(ref test_operator.py:3169) on/off values and float indices."""
+    idx = np.array([1, 0, 2, 0], np.float32)
+    got = mx.nd.one_hot(_nd(idx), depth=3, on_value=8.0,
+                        off_value=-1.0).asnumpy()
+    want = np.full((4, 3), -1.0, np.float32)
+    want[np.arange(4), idx.astype(np.int64)] = 8.0
+    np.testing.assert_allclose(got, want)
+
+
+def test_where_forms():
+    """(ref test_operator.py:3225) same-shape and vector conditions."""
+    rs = np.random.RandomState(2)
+    x = rs.randn(3, 4).astype(np.float32)
+    y = rs.randn(3, 4).astype(np.float32)
+    cond = (rs.uniform(size=(3, 4)) > 0.5).astype(np.float32)
+    got = mx.nd.where(_nd(cond), _nd(x), _nd(y)).asnumpy()
+    np.testing.assert_allclose(got, np.where(cond > 0, x, y))
+    # 1-D condition selects rows
+    vcond = np.array([0, 1, 0], np.float32)
+    got = mx.nd.where(_nd(vcond), _nd(x), _nd(y)).asnumpy()
+    want = np.where(vcond[:, None] > 0, x, y)
+    np.testing.assert_allclose(got, want)
+
+
+def test_batch_dot_transpose_combos():
+    """(ref test_operator.py:1832) all four transpose combinations,
+    forward + gradient."""
+    rs = np.random.RandomState(3)
+    for ta, tb in [(False, False), (True, False), (False, True),
+                   (True, True)]:
+        a_shape = (2, 5, 3) if ta else (2, 3, 5)
+        b_shape = (2, 4, 5) if tb else (2, 5, 4)
+        a = rs.randn(*a_shape).astype(np.float32)
+        b = rs.randn(*b_shape).astype(np.float32)
+        an = np.transpose(a, (0, 2, 1)) if ta else a
+        bn = np.transpose(b, (0, 2, 1)) if tb else b
+        want = np.einsum("bij,bjk->bik", an, bn)
+        got = mx.nd.batch_dot(_nd(a), _nd(b), transpose_a=ta,
+                              transpose_b=tb).asnumpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+        sa, sb = mx.sym.Variable("a"), mx.sym.Variable("b")
+        out = mx.sym.batch_dot(sa, sb, transpose_a=ta, transpose_b=tb)
+        check_numeric_gradient(out, [a, b], numeric_eps=1e-3, rtol=2e-2,
+                               atol=1e-2)
+
+
+def test_dot_transpose_combos():
+    rs = np.random.RandomState(4)
+    for ta, tb in [(False, False), (True, False), (False, True),
+                   (True, True)]:
+        a = rs.randn(*((5, 3) if ta else (3, 5))).astype(np.float32)
+        b = rs.randn(*((4, 5) if tb else (5, 4))).astype(np.float32)
+        want = (a.T if ta else a) @ (b.T if tb else b)
+        got = mx.nd.dot(_nd(a), _nd(b), transpose_a=ta,
+                        transpose_b=tb).asnumpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_order_ops():
+    """(ref test_operator.py:2589 test_order) topk ret_typ variants,
+    argsort/sort on axis, descending."""
+    rs = np.random.RandomState(5)
+    a = rs.permutation(20).reshape(4, 5).astype(np.float32)
+    got = mx.nd.topk(_nd(a), k=2, axis=1).asnumpy()      # default: indices
+    want_idx = np.argsort(-a, axis=1)[:, :2]
+    np.testing.assert_allclose(got, want_idx.astype(np.float32))
+    got_v = mx.nd.topk(_nd(a), k=2, axis=1, ret_typ="value").asnumpy()
+    np.testing.assert_allclose(got_v, -np.sort(-a, axis=1)[:, :2])
+    both = mx.nd.topk(_nd(a), k=2, axis=1, ret_typ="both")
+    np.testing.assert_allclose(both[0].asnumpy(), got_v)
+    np.testing.assert_allclose(both[1].asnumpy(),
+                               want_idx.astype(np.float32))
+    # sort / argsort, ascending and descending
+    np.testing.assert_allclose(mx.nd.sort(_nd(a), axis=1).asnumpy(),
+                               np.sort(a, axis=1))
+    np.testing.assert_allclose(
+        mx.nd.sort(_nd(a), axis=1, is_ascend=False).asnumpy(),
+        -np.sort(-a, axis=1))
+    np.testing.assert_allclose(mx.nd.argsort(_nd(a), axis=1).asnumpy(),
+                               np.argsort(a, axis=1).astype(np.float32))
+
+
+def test_slice_axis_negative_bounds():
+    """(ref test_operator.py:1673) negative begin/end and None end."""
+    rs = np.random.RandomState(6)
+    a = rs.randn(4, 6).astype(np.float32)
+    got = mx.nd.slice_axis(_nd(a), axis=1, begin=-3, end=None).asnumpy()
+    np.testing.assert_allclose(got, a[:, -3:])
+    got = mx.nd.slice_axis(_nd(a), axis=0, begin=1, end=-1).asnumpy()
+    np.testing.assert_allclose(got, a[1:-1])
+
+
+def test_sequence_ops_with_lengths():
+    """(ref test_operator.py:2265,2337) SequenceMask/Reverse/Last with
+    use_sequence_length."""
+    a = np.arange(2 * 3 * 2, dtype=np.float32).reshape(3, 2, 2)  # (T,N,C)
+    lengths = np.array([2, 3], np.float32)
+    got = mx.nd.SequenceMask(_nd(a), _nd(lengths), use_sequence_length=True,
+                             value=-1.0).asnumpy()
+    want = a.copy()
+    want[2:, 0] = -1.0
+    np.testing.assert_allclose(got, want)
+    got = mx.nd.SequenceLast(_nd(a), _nd(lengths),
+                             use_sequence_length=True).asnumpy()
+    want = np.stack([a[1, 0], a[2, 1]])
+    np.testing.assert_allclose(got, want)
+    got = mx.nd.SequenceReverse(_nd(a), _nd(lengths),
+                                use_sequence_length=True).asnumpy()
+    want = a.copy()
+    want[:2, 0] = a[:2, 0][::-1]
+    want[:3, 1] = a[:3, 1][::-1]
+    np.testing.assert_allclose(got, want)
+
+
+def test_pad_modes():
+    """(ref test_operator.py pad) constant and edge modes on 4-D."""
+    rs = np.random.RandomState(7)
+    a = rs.randn(1, 1, 3, 3).astype(np.float32)
+    pw = (0, 0, 0, 0, 1, 1, 2, 2)
+    got = mx.nd.pad(_nd(a), mode="constant", pad_width=pw,
+                    constant_value=5.0).asnumpy()
+    want = np.pad(a, ((0, 0), (0, 0), (1, 1), (2, 2)), mode="constant",
+                  constant_values=5.0)
+    np.testing.assert_allclose(got, want)
+    got = mx.nd.pad(_nd(a), mode="edge", pad_width=pw).asnumpy()
+    want = np.pad(a, ((0, 0), (0, 0), (1, 1), (2, 2)), mode="edge")
+    np.testing.assert_allclose(got, want)
+    got = mx.nd.pad(_nd(a), mode="reflect", pad_width=pw).asnumpy()
+    want = np.pad(a, ((0, 0), (0, 0), (1, 1), (2, 2)), mode="reflect")
+    np.testing.assert_allclose(got, want)
+
+
+def test_broadcast_binary_backward_shapes():
+    """(ref test_operator.py:1270) gradients reduce correctly over the
+    broadcast dimensions."""
+    rs = np.random.RandomState(8)
+    a = rs.uniform(0.5, 1.5, (2, 3, 1, 4)).astype(np.float32)
+    b = rs.uniform(0.5, 1.5, (1, 3, 5, 1)).astype(np.float32)
+    for op in ["broadcast_add", "broadcast_mul", "broadcast_div"]:
+        sa, sb = mx.sym.Variable("a"), mx.sym.Variable("b")
+        out = getattr(mx.sym, op)(sa, sb)
+        check_numeric_gradient(out, [a, b], numeric_eps=1e-3, rtol=2e-2,
+                               atol=1e-2)
+
+
+def test_repeat_and_tile():
+    rs = np.random.RandomState(9)
+    a = rs.randn(2, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        mx.nd.repeat(_nd(a), repeats=2, axis=1).asnumpy(),
+        np.repeat(a, 2, axis=1))
+    np.testing.assert_allclose(   # axis=None flattens, reference-style
+        mx.nd.repeat(_nd(a), repeats=3).asnumpy(), np.repeat(a, 3))
+    np.testing.assert_allclose(
+        mx.nd.tile(_nd(a), reps=(2, 3)).asnumpy(), np.tile(a, (2, 3)))
+
+
+def test_reverse_and_flip():
+    a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    np.testing.assert_allclose(mx.nd.reverse(_nd(a), axis=1).asnumpy(),
+                               a[:, ::-1])
+    np.testing.assert_allclose(mx.nd.flip(_nd(a), axis=2).asnumpy(),
+                               a[..., ::-1])
+
+
+def test_clip_gradient_boundaries():
+    """clip's gradient is zero outside [a_min, a_max] (reference clip
+    backward semantics)."""
+    a = np.array([-2.0, -0.5, 0.5, 2.0], np.float32)
+    s = mx.sym.Variable("a")
+    out = mx.sym.clip(s, a_min=-1.0, a_max=1.0)
+    exe = out.simple_bind(mx.cpu(), a=(4,), grad_req="write")
+    exe.arg_dict["a"][:] = a
+    exe.forward(is_train=True)
+    exe.backward(out_grads=[mx.nd.ones((4,))])
+    np.testing.assert_allclose(exe.grad_dict["a"].asnumpy(),
+                               [0.0, 1.0, 1.0, 0.0])
+
+
+def test_expand_dims_squeeze_roundtrip():
+    a = np.zeros((2, 3), np.float32)
+    e = mx.nd.expand_dims(_nd(a), axis=1)
+    assert e.shape == (2, 1, 3)
+    e2 = mx.nd.expand_dims(_nd(a), axis=-1)
+    assert e2.shape == (2, 3, 1)
