@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -257,7 +258,125 @@ def _fwd(q, k, v, causal, scale, block_q, interpret):
     return out, (q, k, v, out, lse)
 
 
+def _bwd_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                dq_ref, dk_ref, dv_ref, *, causal, scale, block_q):
+    """One (bh, q-block) program of the flash backward: recompute p from
+    the saved lse, then dv += p^T dO, ds = p*(dp - delta), dq = ds k,
+    dk += ds^T q. dk/dv accumulate across the (sequential) q-block grid
+    axis into constant-index output blocks — the TPU Pallas revisiting
+    pattern."""
+    i = pl.program_id(1)
+    f32 = jnp.float32
+    q = q_ref[0].astype(f32)           # (bq, D)
+    k = k_ref[0].astype(f32)           # (S, D)
+    v = v_ref[0].astype(f32)
+    g = g_ref[0].astype(f32)           # (bq, D)
+    lse = lse_ref[0]                   # (bq, 1) f32
+    delta = delta_ref[0]               # (bq, 1) f32
+
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=f32) * scale
+    if causal:
+        s_kv = k.shape[0]
+        q_pos = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, s_kv), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (block_q, s_kv), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jnp.exp(s - lse)                                   # (bq, S)
+
+    dv_c = jax.lax.dot_general(p, g, (((0,), (0,)), ((), ())),
+                               preferred_element_type=f32)  # (S, D)
+    dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=f32)    # (bq, S)
+    ds = p * (dp - delta) * scale
+    dq = jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                             preferred_element_type=f32)    # (bq, D)
+    dk_c = jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                               preferred_element_type=f32)  # (S, D)
+
+    dq_ref[0] = dq
+
+    @pl.when(i == 0)
+    def _init():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
+
+    dk_ref[0] += dk_c
+    dv_ref[0] += dv_c
+
+
 def _bwd(causal, scale, block_q, interpret, res, g):
+    if interpret is None:
+        interpret = _use_interpret()
+    use_xla = os.environ.get("MXTPU_FLASH_BWD", "") == "xla"
+    if not use_xla:
+        return _bwd_flash(causal, scale, block_q, interpret, res, g)
+    return _bwd_xla(causal, scale, block_q, interpret, res, g)
+
+
+def _bwd_flash(causal, scale, block_q, interpret, res, g):
+    q, k, v, out, lse = res
+    block_q = block_q if block_q is not None else DEFAULT_BLOCK_Q
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    b, h, s_q, d = q.shape
+    s_kv = k.shape[2]
+    f32 = jnp.float32
+    bh = b * h
+    qf = q.reshape(bh, s_q, d)
+    kf = k.reshape(bh, s_kv, d)
+    vf = v.reshape(bh, s_kv, d)
+    gf = g.reshape(bh, s_q, d)
+    of = out.reshape(bh, s_q, d)
+    lf = lse.reshape(bh, s_q)
+
+    block = min(block_q, max(s_q, 1))
+    pad = (-s_q) % block
+    qp, _ = _pad_q(qf, block)
+    gp, _ = _pad_q(gf, block)
+    op, _ = _pad_q(of, block)
+    lsep = jnp.pad(lf, ((0, 0), (0, pad)), constant_values=-NEG_INF)
+    delta = jnp.sum(gp.astype(f32) * op.astype(f32), -1)   # (BH, Sq')
+    n_q = qp.shape[1] // block
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(bh, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block, d), lambda b, i: (b, i, 0)),      # q
+            pl.BlockSpec((1, s_kv, d), lambda b, i: (b, 0, 0)),       # k
+            pl.BlockSpec((1, s_kv, d), lambda b, i: (b, 0, 0)),       # v
+            pl.BlockSpec((1, block, d), lambda b, i: (b, i, 0)),      # g
+            pl.BlockSpec((1, block, 1), lambda b, i: (b, i, 0)),      # lse
+            pl.BlockSpec((1, block, 1), lambda b, i: (b, i, 0)),      # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block, d), lambda b, i: (b, i, 0)),      # dq
+            pl.BlockSpec((1, s_kv, d), lambda b, i: (b, 0, 0)),       # dk
+            pl.BlockSpec((1, s_kv, d), lambda b, i: (b, 0, 0)),       # dv
+        ],
+    )
+    kernel = functools.partial(_bwd_kernel, causal=causal, scale=scale,
+                               block_q=block)
+    dq, dk, dv = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, qp.shape[1], d), f32),
+            jax.ShapeDtypeStruct((bh, s_kv, d), f32),
+            jax.ShapeDtypeStruct((bh, s_kv, d), f32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=5 * bh * qp.shape[1] * s_kv * d,
+            bytes_accessed=4 * (qp.size + kf.size + vf.size + gp.size),
+            transcendentals=bh * qp.shape[1] * s_kv),
+        interpret=interpret,
+    )(qp, kf, vf, gp, lsep[..., None], delta[..., None])
+    dq = dq[:, :s_q].reshape(b, h, s_q, d)
+    return (dq.astype(q.dtype), dk.reshape(b, h, s_kv, d).astype(k.dtype),
+            dv.reshape(b, h, s_kv, d).astype(v.dtype))
+
+
+def _bwd_xla(causal, scale, block_q, interpret, res, g):
     q, k, v, out, lse = res
     # the backward recompute loop is plain XLA (lax.map) — the block size
     # only bounds its working set, so the untuned default serves

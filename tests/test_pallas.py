@@ -118,3 +118,41 @@ def test_ring_attention_pallas_grads():
         assert np.all(np.isfinite(np.asarray(b)))
         np.testing.assert_allclose(np.asarray(b), np.asarray(a),
                                    rtol=1e-4, atol=1e-4)
+
+
+def test_flash_backward_pallas_vs_xla():
+    """The Pallas flash backward (sequential-grid dk/dv accumulation)
+    must match the XLA recompute backward bit-for-tolerance on uneven
+    (non-block-multiple) sequence lengths, causal and not."""
+    import importlib
+    import os
+    import jax
+    import jax.numpy as jnp
+    # the package re-exports the function under the submodule's name, so
+    # the module itself must come from importlib
+    fa = importlib.import_module("mxnet_tpu.pallas.flash_attention")
+
+    rs = np.random.RandomState(0)
+    for causal in (False, True):
+        for s_q, s_kv in [(48, 48), (33, 65)] if not causal else [(48, 48)]:
+            q = jnp.asarray(rs.randn(1, 2, s_q, 16).astype(np.float32))
+            k = jnp.asarray(rs.randn(1, 2, s_kv, 16).astype(np.float32))
+            v = jnp.asarray(rs.randn(1, 2, s_kv, 16).astype(np.float32))
+            g = jnp.asarray(rs.randn(1, 2, s_q, 16).astype(np.float32))
+
+            def loss(qq, kk, vv):
+                return jnp.sum(fa.flash_attention(qq, kk, vv,
+                                                  causal, None, 32) * g)
+
+            grads_pallas = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+            os.environ["MXTPU_FLASH_BWD"] = "xla"
+            try:
+                grads_xla = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+            finally:
+                del os.environ["MXTPU_FLASH_BWD"]
+            for gp, gx, name in zip(grads_pallas, grads_xla,
+                                    ("dq", "dk", "dv")):
+                np.testing.assert_allclose(
+                    np.asarray(gp), np.asarray(gx), rtol=2e-4, atol=2e-4,
+                    err_msg="%s causal=%s s=(%d,%d)"
+                            % (name, causal, s_q, s_kv))
