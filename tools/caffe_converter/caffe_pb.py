@@ -1,0 +1,217 @@
+"""Minimal Caffe protobuf access — no compiled schema.
+
+Parity target: reference ``tools/caffe_converter`` (which compiles
+``caffe.proto`` and imports caffe_pb2). This build instead ships two
+small self-contained pieces:
+
+- a protobuf **text-format** parser for ``.prototxt`` network
+  definitions (nested ``key { ... }`` blocks and ``key: value`` pairs),
+- a protobuf **wire-format** reader extracting exactly the fields the
+  converter needs from a binary ``.caffemodel``: layers (V2 field 100 /
+  V1 field 2), their name/type and blobs (shape + float data).
+
+Both are format-level implementations written against the public
+protobuf encoding spec; no schema file is vendored.
+"""
+from __future__ import annotations
+
+import struct
+
+
+# ---------------------------------------------------------------------------
+# text-format (.prototxt)
+# ---------------------------------------------------------------------------
+
+class Msg(dict):
+    """A parsed text-format message: repeated fields become lists."""
+
+    def add(self, key, value):
+        if key in self:
+            if not isinstance(self[key], list):
+                self[key] = [self[key]]
+            self[key].append(value)
+        else:
+            self[key] = value
+
+    def all(self, key):
+        v = self.get(key, [])
+        return v if isinstance(v, list) else [v]
+
+    def one(self, key, default=None):
+        v = self.get(key, default)
+        return v[0] if isinstance(v, list) else v
+
+
+def _tokenize(text):
+    out = []
+    for raw in text.splitlines():
+        line = raw.split("#", 1)[0].strip()
+        if not line:
+            continue
+        line = line.replace("{", " { ").replace("}", " } ")
+        i = 0
+        while i < len(line):
+            ch = line[i]
+            if ch.isspace():
+                i += 1
+                continue
+            if ch in "{}":
+                out.append(ch)
+                i += 1
+                continue
+            if ch in "\"'":
+                j = line.index(ch, i + 1)
+                out.append(line[i:j + 1])
+                i = j + 1
+                continue
+            j = i
+            while j < len(line) and not line[j].isspace() \
+                    and line[j] not in "{}":
+                j += 1
+            out.append(line[i:j])
+            i = j
+    return out
+
+
+def _convert_scalar(tok):
+    if tok and tok[0] in "\"'":
+        return tok[1:-1]
+    low = tok.lower()
+    if low == "true":
+        return True
+    if low == "false":
+        return False
+    try:
+        return int(tok)
+    except ValueError:
+        pass
+    try:
+        return float(tok)
+    except ValueError:
+        return tok
+
+
+def parse_prototxt(text):
+    """Parse protobuf text format into a tree of :class:`Msg`."""
+    tokens = _tokenize(text)
+    pos = 0
+
+    def parse_block():
+        nonlocal pos
+        msg = Msg()
+        while pos < len(tokens):
+            tok = tokens[pos]
+            if tok == "}":
+                pos += 1
+                return msg
+            key = tok.rstrip(":")
+            pos += 1
+            if pos < len(tokens) and tokens[pos] == "{":
+                pos += 1
+                msg.add(key, parse_block())
+            else:
+                msg.add(key, _convert_scalar(tokens[pos]))
+                pos += 1
+        return msg
+
+    return parse_block()
+
+
+# ---------------------------------------------------------------------------
+# wire-format (.caffemodel)
+# ---------------------------------------------------------------------------
+
+def _read_varint(buf, i):
+    shift = 0
+    out = 0
+    while True:
+        b = buf[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+
+
+def iter_fields(buf, start=0, end=None):
+    """Yield (field_number, wire_type, value-or-span) over a message."""
+    i = start
+    end = len(buf) if end is None else end
+    while i < end:
+        tag, i = _read_varint(buf, i)
+        field, wt = tag >> 3, tag & 7
+        if wt == 0:                       # varint
+            val, i = _read_varint(buf, i)
+            yield field, wt, val
+        elif wt == 1:                     # 64-bit
+            yield field, wt, buf[i:i + 8]
+            i += 8
+        elif wt == 2:                     # length-delimited
+            n, i = _read_varint(buf, i)
+            yield field, wt, buf[i:i + n]
+            i += n
+        elif wt == 5:                     # 32-bit
+            yield field, wt, buf[i:i + 4]
+            i += 4
+        else:
+            raise ValueError("unsupported wire type %d" % wt)
+
+
+def _parse_blob(buf):
+    """BlobProto: data=5 (repeated float), shape=7 (BlobShape.dim=1),
+    legacy num/channels/height/width = 1..4."""
+    data = []
+    shape = []
+    legacy = {}
+    for field, wt, val in iter_fields(buf):
+        if field == 5:
+            if wt == 2:                    # packed floats
+                data.extend(struct.unpack("<%df" % (len(val) // 4), val))
+            else:
+                data.append(struct.unpack("<f", val)[0])
+        elif field == 7 and wt == 2:       # BlobShape
+            for f2, w2, v2 in iter_fields(val):
+                if f2 == 1:
+                    if w2 == 2:            # packed int64
+                        j = 0
+                        while j < len(v2):
+                            d, j = _read_varint(v2, j)
+                            shape.append(d)
+                    else:
+                        shape.append(v2)
+        elif field in (1, 2, 3, 4) and wt == 0:
+            legacy[field] = val
+    if not shape and legacy:
+        shape = [legacy.get(i, 1) for i in (1, 2, 3, 4)]
+    return shape, data
+
+
+def parse_caffemodel(buf):
+    """-> list of {name, type, blobs: [(shape, data), ...]} from a binary
+    NetParameter. Supports V2 layers (field 100) and V1 (field 2)."""
+    layers = []
+    for field, wt, val in iter_fields(buf):
+        if wt != 2 or field not in (100, 2):
+            continue
+        name = ""
+        ltype = None
+        blobs = []
+        # LayerParameter: name=1, type=2(string); V1: name=4, type=5(enum),
+        # blobs=6; V2 blobs=7
+        for f2, w2, v2 in iter_fields(val):
+            if field == 100:
+                if f2 == 1 and w2 == 2:
+                    name = v2.decode("utf-8", "replace")
+                elif f2 == 2 and w2 == 2:
+                    ltype = v2.decode("utf-8", "replace")
+                elif f2 == 7 and w2 == 2:
+                    blobs.append(_parse_blob(v2))
+            else:                          # V1LayerParameter
+                if f2 == 4 and w2 == 2:
+                    name = v2.decode("utf-8", "replace")
+                elif f2 == 5 and w2 == 0:
+                    ltype = v2              # enum int
+                elif f2 == 6 and w2 == 2:
+                    blobs.append(_parse_blob(v2))
+        layers.append({"name": name, "type": ltype, "blobs": blobs})
+    return layers

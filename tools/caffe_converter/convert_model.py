@@ -1,0 +1,216 @@
+"""Caffe -> mxnet_tpu converter.
+
+Parity target: reference ``tools/caffe_converter/convert_symbol.py`` +
+``convert_model.py`` — turn a ``.prototxt`` into an ``mx.sym`` graph and
+a ``.caffemodel`` into the matching arg/aux params, then save a standard
+checkpoint. Layer coverage mirrors the reference converter's core set:
+Data/Input, Convolution, InnerProduct, Pooling, ReLU, Dropout, LRN,
+Concat, Eltwise, Flatten, BatchNorm(+Scale), Softmax/SoftmaxWithLoss.
+
+Usage:
+    python convert_model.py net.prototxt net.caffemodel out_prefix
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+from caffe_pb import parse_prototxt, parse_caffemodel  # noqa: E402
+
+
+def _as_tuple2(param, key, default):
+    v = param.one(key, None) if param is not None else None
+    if v is None:
+        h = param.one(key + "_h", None) if param is not None else None
+        w = param.one(key + "_w", None) if param is not None else None
+        if h is not None or w is not None:
+            return (int(h or 0), int(w or 0))
+        return (default, default)
+    return (int(v), int(v))
+
+
+def convert_symbol(prototxt_text):
+    """prototxt text -> (mx Symbol, input_name). Returns the net output
+    symbol (loss layers map to SoftmaxOutput)."""
+    import mxnet_tpu as mx
+    net = parse_prototxt(prototxt_text)
+    layers = net.all("layer") or net.all("layers")
+    tops = {}
+    input_name = None
+    for inp in net.all("input"):
+        input_name = inp
+        tops[inp] = mx.sym.Variable(inp)
+    out = None
+    for layer in layers:
+        name = layer.one("name")
+        ltype = layer.one("type")
+        bottoms = [tops[b] for b in layer.all("bottom") if b in tops]
+        top_names = layer.all("top") or [name]
+        if ltype in ("Data", "Input", "HDF5Data", "ImageData"):
+            input_name = input_name or top_names[0]
+            sym = mx.sym.Variable(top_names[0])
+            if top_names[0].lower() != "label":
+                tops[top_names[0]] = sym
+            for extra in top_names[1:]:
+                tops[extra] = mx.sym.Variable(extra)
+            continue
+        data = bottoms[0] if bottoms else tops[input_name]
+        if ltype == "Convolution":
+            p = layer.one("convolution_param")
+            kh, kw = _as_tuple2(p, "kernel_size", 1)
+            sh, sw = _as_tuple2(p, "stride", 1)
+            ph, pw = _as_tuple2(p, "pad", 0)
+            sym = mx.sym.Convolution(
+                data, name=name, kernel=(kh, kw), stride=(sh, sw),
+                pad=(ph, pw), num_filter=int(p.one("num_output")),
+                num_group=int(p.one("group", 1)),
+                no_bias=not p.one("bias_term", True))
+        elif ltype == "InnerProduct":
+            p = layer.one("inner_product_param")
+            sym = mx.sym.FullyConnected(
+                mx.sym.Flatten(data), name=name,
+                num_hidden=int(p.one("num_output")),
+                no_bias=not p.one("bias_term", True))
+        elif ltype == "Pooling":
+            p = layer.one("pooling_param")
+            kh, kw = _as_tuple2(p, "kernel_size", 1)
+            sh, sw = _as_tuple2(p, "stride", 1)
+            ph, pw = _as_tuple2(p, "pad", 0)
+            pool = {0: "max", 1: "avg", "MAX": "max",
+                    "AVE": "avg"}.get(p.one("pool", 0), "max")
+            if p.one("global_pooling", False):
+                sym = mx.sym.Pooling(data, name=name, pool_type=pool,
+                                     global_pool=True, kernel=(1, 1))
+            else:
+                # caffe pooling uses ceil output sizing = 'full'
+                sym = mx.sym.Pooling(data, name=name, kernel=(kh, kw),
+                                     stride=(sh, sw), pad=(ph, pw),
+                                     pool_type=pool,
+                                     pooling_convention="full")
+        elif ltype == "ReLU":
+            sym = mx.sym.Activation(data, name=name, act_type="relu")
+        elif ltype == "Sigmoid":
+            sym = mx.sym.Activation(data, name=name, act_type="sigmoid")
+        elif ltype == "TanH":
+            sym = mx.sym.Activation(data, name=name, act_type="tanh")
+        elif ltype == "Dropout":
+            p = layer.one("dropout_param")
+            ratio = float(p.one("dropout_ratio", 0.5)) if p else 0.5
+            sym = mx.sym.Dropout(data, name=name, p=ratio)
+        elif ltype == "LRN":
+            p = layer.one("lrn_param")
+            sym = mx.sym.LRN(data, name=name,
+                             alpha=float(p.one("alpha", 1e-4)),
+                             beta=float(p.one("beta", 0.75)),
+                             knorm=float(p.one("k", 1.0)),
+                             nsize=int(p.one("local_size", 5)))
+        elif ltype == "Concat":
+            p = layer.one("concat_param")
+            dim = int(p.one("axis", 1)) if p else 1
+            sym = mx.sym.Concat(*bottoms, name=name, dim=dim)
+        elif ltype == "Eltwise":
+            p = layer.one("eltwise_param")
+            op = p.one("operation", "SUM") if p else "SUM"
+            if op in ("SUM", 1):
+                sym = bottoms[0]
+                for b in bottoms[1:]:
+                    sym = sym + b
+            elif op in ("PROD", 0):
+                sym = bottoms[0]
+                for b in bottoms[1:]:
+                    sym = sym * b
+            else:
+                sym = mx.sym.maximum(bottoms[0], bottoms[1])
+        elif ltype == "Flatten":
+            sym = mx.sym.Flatten(data, name=name)
+        elif ltype == "BatchNorm":
+            sym = mx.sym.BatchNorm(data, name=name, fix_gamma=True,
+                                   use_global_stats=True, eps=1e-5)
+        elif ltype == "Scale":
+            # caffe Scale after BatchNorm folds into BN's gamma/beta; as a
+            # standalone it is a per-channel affine -> BatchNorm with
+            # fixed stats would double-normalise, so emit broadcast ops
+            gamma = mx.sym.Variable(name + "_gamma", shape=(0,))
+            beta = mx.sym.Variable(name + "_beta", shape=(0,))
+            sym = mx.sym.broadcast_add(
+                mx.sym.broadcast_mul(
+                    data, mx.sym.reshape(gamma, shape=(1, -1, 1, 1))),
+                mx.sym.reshape(beta, shape=(1, -1, 1, 1)))
+        elif ltype in ("Softmax",):
+            sym = mx.sym.softmax(data, name=name)
+        elif ltype in ("SoftmaxWithLoss", "SoftmaxOutput"):
+            sym = mx.sym.SoftmaxOutput(data, name="softmax")
+        elif ltype == "Accuracy":
+            continue
+        else:
+            raise NotImplementedError("caffe layer type %r is not "
+                                      "supported" % ltype)
+        for t in top_names:
+            tops[t] = sym
+        out = sym
+    return out, input_name
+
+
+def convert_model(prototxt_text, caffemodel_bytes):
+    """-> (symbol, arg_params, aux_params)."""
+    import mxnet_tpu as mx
+    sym, _ = convert_symbol(prototxt_text)
+    layers = parse_caffemodel(caffemodel_bytes)
+    arg_names = set(sym.list_arguments())
+    arg_params, aux_params = {}, {}
+    for layer in layers:
+        name = layer["name"]
+        blobs = layer["blobs"]
+        if not blobs:
+            continue
+        wshape, wdata = blobs[0]
+        weight = np.asarray(wdata, np.float32).reshape(
+            [d for d in wshape if d] or (len(wdata),))
+        if layer["type"] == "InnerProduct" and weight.ndim > 2:
+            weight = weight.reshape(weight.shape[-2], weight.shape[-1])
+        if "%s_weight" % name in arg_names:
+            arg_params["%s_weight" % name] = mx.nd.array(weight)
+            if len(blobs) > 1:
+                bshape, bdata = blobs[1]
+                arg_params["%s_bias" % name] = mx.nd.array(
+                    np.asarray(bdata, np.float32).ravel())
+        elif layer["type"] == "BatchNorm":
+            mean = np.asarray(blobs[0][1], np.float32).ravel()
+            var = np.asarray(blobs[1][1], np.float32).ravel()
+            scale = np.asarray(blobs[2][1], np.float32).ravel() \
+                if len(blobs) > 2 else np.ones(1, np.float32)
+            s = float(scale[0]) if scale.size else 1.0
+            s = 1.0 / s if s else 1.0
+            aux_params["%s_moving_mean" % name] = mx.nd.array(mean * s)
+            aux_params["%s_moving_var" % name] = mx.nd.array(var * s)
+            arg_params["%s_gamma" % name] = mx.nd.array(
+                np.ones_like(mean))
+            arg_params["%s_beta" % name] = mx.nd.array(
+                np.zeros_like(mean))
+        elif layer["type"] == "Scale":
+            arg_params["%s_gamma" % name] = mx.nd.array(
+                np.asarray(blobs[0][1], np.float32).ravel())
+            if len(blobs) > 1:
+                arg_params["%s_beta" % name] = mx.nd.array(
+                    np.asarray(blobs[1][1], np.float32).ravel())
+    return sym, arg_params, aux_params
+
+
+def main():
+    if len(sys.argv) != 4:
+        print(__doc__)
+        sys.exit(1)
+    import mxnet_tpu as mx
+    with open(sys.argv[1]) as f:
+        prototxt = f.read()
+    with open(sys.argv[2], "rb") as f:
+        blob = f.read()
+    sym, arg_params, aux_params = convert_model(prototxt, blob)
+    mx.model.save_checkpoint(sys.argv[3], 0, sym, arg_params, aux_params)
+    print("saved %s-symbol.json / %s-0000.params"
+          % (sys.argv[3], sys.argv[3]))
+
+
+if __name__ == "__main__":
+    main()
