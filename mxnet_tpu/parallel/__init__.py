@@ -18,3 +18,5 @@ from .collectives import (all_reduce, all_gather, reduce_scatter, ppermute,
 from .spmd import SPMDTrainer, shard_params_rule
 from .ring_attention import ring_attention, attention
 from .ulysses import ulysses_attention
+from .moe import moe_ffn
+from .pipeline import pipeline_apply

@@ -1,0 +1,109 @@
+"""Expert parallelism: mixture-of-experts FFN with all-to-all dispatch.
+
+New-framework extension (SURVEY.md §2.3 TP/PP/SP/EP row): the reference
+predates MoE; this supplies the 'ep' leg of the parallelism menu the
+TPU build treats as first-class. Design is the standard top-1
+switch-style layer expressed for GSPMD:
+
+- tokens arrive batch-sharded; each device holds ONE expert's weights
+  (expert count == 'ep' axis size);
+- a router picks an expert per token; tokens are packed into
+  fixed-capacity per-expert buffers (static shapes — XLA-friendly;
+  overflow tokens are dropped, the canonical switch behaviour);
+- one ``all_to_all`` moves token buffers to their experts over ICI, the
+  expert MLP runs locally, a second ``all_to_all`` brings results back,
+  and the router probability scales the combined output.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["moe_ffn"]
+
+
+def _local_moe(x, wr, w1, w2, axis_name, capacity):
+    """Per-device body. x (T, E) local tokens; wr (n_exp, E) router;
+    w1 (1, F, E), w2 (1, E, F): THIS device's expert (leading expert
+    axis sharded to size 1 under shard_map)."""
+    n = lax.psum(1, axis_name)
+    T, E = x.shape
+    f32 = jnp.float32
+
+    logits = x.astype(f32) @ wr.T.astype(f32)            # (T, n)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                  # (T,)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+
+    # position of each token within its expert's capacity buffer
+    onehot = jax.nn.one_hot(expert, n, dtype=f32)        # (T, n)
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot    # (T, n)
+    pos_in_exp = jnp.sum(pos, axis=-1).astype(jnp.int32)  # (T,)
+    keep = pos_in_exp < capacity
+
+    # scatter tokens into (n, capacity, E) dispatch buffers
+    buf = jnp.zeros((n, capacity, E), x.dtype)
+    idx_e = jnp.where(keep, expert, 0)
+    idx_c = jnp.where(keep, pos_in_exp, 0)
+    contrib = jnp.where(keep[:, None], x, 0.0)
+    buf = buf.at[idx_e, idx_c].add(contrib)
+
+    # exchange: device d receives every device's buffer for expert d
+    recv = lax.all_to_all(buf, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)                    # (n*cap, E)
+    h = jnp.maximum(recv.astype(f32) @ w1[0].T, 0.0)
+    y = (h @ w2[0].T).astype(x.dtype)                    # (n*cap, E)
+    back = lax.all_to_all(y.reshape(n, capacity, E), axis_name,
+                          split_axis=0, concat_axis=0, tiled=True) \
+        .reshape(n, capacity, E)
+
+    out = back[idx_e, idx_c]                             # (T, E)
+    out = jnp.where(keep[:, None], out, 0.0)
+    return out * gate[:, None].astype(x.dtype)
+
+
+def moe_ffn(x, router_w, expert_w1, expert_w2, mesh, axis_name="ep",
+            capacity_factor=1.25):
+    """Top-1 MoE feed-forward over an expert-parallel mesh axis.
+
+    x: (B, T, E) tokens, batch-sharded over ``axis_name`` (the standard
+    setup where the data and expert meshes coincide for this layer);
+    router_w (n_exp, E) replicated; expert_w1 (n_exp, F, E) /
+    expert_w2 (n_exp, E, F) sharded over experts. n_exp must equal the
+    'ep' axis size. Returns (B, T, E) with x's sharding. Dropped
+    (over-capacity) tokens contribute zeros, the switch convention.
+    """
+    from ..ndarray.ndarray import NDArray, _wrap
+    wrap = isinstance(x, NDArray)
+    raw = [a._data if isinstance(a, NDArray) else a
+           for a in (x, router_w, expert_w1, expert_w2)]
+    xr, wr, w1, w2 = raw
+    n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
+    if w1.shape[0] != n:
+        raise ValueError("expert count %d != %r axis size %d"
+                         % (w1.shape[0], axis_name, n))
+    B, T, E = xr.shape
+    if B % n:
+        raise ValueError("batch %d must divide by %r axis size %d"
+                         % (B, axis_name, n))
+    flat = xr.reshape(B * T, E)
+    local_tokens = (B * T) // n
+    capacity = max(1, int(capacity_factor * local_tokens / n))
+
+    xs = P(axis_name)
+    flat = jax.device_put(flat, NamedSharding(mesh, xs))
+    wr = jax.device_put(wr, NamedSharding(mesh, P()))
+    w1 = jax.device_put(w1, NamedSharding(mesh, P(axis_name)))
+    w2 = jax.device_put(w2, NamedSharding(mesh, P(axis_name)))
+
+    fn = jax.shard_map(
+        functools.partial(_local_moe, axis_name=axis_name,
+                          capacity=capacity),
+        mesh=mesh, in_specs=(xs, P(), P(axis_name), P(axis_name)),
+        out_specs=xs)
+    out = fn(flat, wr, w1, w2).reshape(B, T, E)
+    return _wrap(out) if wrap else out

@@ -1,0 +1,96 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a mesh axis.
+
+New-framework extension (SURVEY.md §2.3 — the reference's closest
+analogue is manual per-layer ``group2ctx`` model parallelism). Design:
+each device along the 'pp' axis holds ONE stage's parameters; a
+microbatch stream flows through the ring with one ``ppermute`` per
+tick. The schedule runs n_micro + n_stages - 1 ticks inside a
+``lax.scan``, so the whole pipeline — bubbles, transfers, compute — is
+a single compiled program and XLA overlaps the neighbour transfer with
+the next tick's compute. Differentiable end to end (the backward
+pipeline falls out of jax.vjp through the scan/ppermute).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["pipeline_apply"]
+
+
+def _pipe_local(params, x, stage_fn, axis_name, n_micro):
+    """Per-device body. params: this stage's params (leading stage axis
+    of size 1). x: (n_micro_local..., ) — every device receives the
+    full microbatch stream but only stage 0 injects it."""
+    n = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    mb_shape = x.shape[1:]
+
+    total = n_micro + n - 1
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def tick(carry, t):
+        acc, cur = carry
+        # stage 0 ingests microbatch t (when one remains); others use the
+        # activation ppermuted from the previous stage
+        inject = jnp.where(t < n_micro, t, n_micro - 1)
+        x_in = jnp.where(stage == 0, x[inject], cur)
+        y = stage_fn(jax.tree.map(lambda p: p[0], params), x_in)
+        # last stage records finished microbatch t - (n - 1); a where-
+        # based update keeps both sides' varying-mesh-axes types equal
+        # under shard_map (lax.cond would reject the mismatch)
+        done_idx = t - (n - 1)
+        is_done = jnp.logical_and(stage == n - 1, done_idx >= 0)
+        upd = lax.dynamic_update_index_in_dim(
+            acc, y, jnp.maximum(done_idx, 0), 0)
+        acc = jnp.where(is_done, upd, acc)
+        nxt = lax.ppermute(y, axis_name, perm)
+        return (acc, nxt), None
+
+    # carries become device-varying after one tick; mark them so from
+    # the start or the scan's carry types disagree (shard_map vma rules)
+    acc0 = lax.pvary(jnp.zeros((n_micro,) + mb_shape, x.dtype),
+                     (axis_name,))
+    cur0 = lax.pvary(jnp.zeros(mb_shape, x.dtype), (axis_name,))
+    (acc, _), _ = lax.scan(tick, (acc0, cur0), jnp.arange(total))
+    # every device returns the accumulator; only the last stage's is
+    # non-zero — a psum broadcasts it to all (cheap at dryrun scale;
+    # production would keep outputs stage-local)
+    return lax.psum(acc, axis_name)
+
+
+def pipeline_apply(stage_fn, stage_params, x, mesh, axis_name="pp",
+                   n_micro=None):
+    """Apply ``n`` pipeline stages to ``x``.
+
+    stage_fn(params_i, mb) -> mb : one stage's computation; every stage
+    must map activations to the same shape (classic GPipe layout).
+    stage_params: pytree whose leaves have a leading stage axis of size
+    n (sharded over ``axis_name``). x: (n_micro, mb...) microbatched
+    input, replicated. Returns (n_micro, mb...) outputs of the last
+    stage.
+    """
+    from ..ndarray.ndarray import NDArray, _wrap
+    wrap = isinstance(x, NDArray)
+    xr = x._data if isinstance(x, NDArray) else x
+    n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis_name]
+    n_micro = n_micro or xr.shape[0]
+
+    params = jax.tree.map(
+        lambda p: jax.device_put(p._data if isinstance(p, NDArray) else p,
+                                 NamedSharding(mesh, P(axis_name))),
+        stage_params)
+    xr = jax.device_put(xr, NamedSharding(mesh, P()))
+
+    fn = jax.shard_map(
+        functools.partial(_pipe_local, stage_fn=stage_fn,
+                          axis_name=axis_name, n_micro=n_micro),
+        mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P(axis_name), stage_params), P()),
+        out_specs=P())
+    out = fn(params, xr)
+    return _wrap(out) if wrap else out
