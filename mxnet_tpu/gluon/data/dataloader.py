@@ -145,8 +145,18 @@ class DataLoader:
         if batch_sampler is None:
             if batch_size is None:
                 raise MXNetError("batch_size required when no batch_sampler")
-            batch_sampler = _BatchSampler(len(dataset), batch_size,
-                                          shuffle, last_batch or "keep")
+            if sampler is not None:
+                if shuffle:
+                    raise MXNetError("shuffle is exclusive with a custom "
+                                     "sampler (reference contract)")
+                from .sampler import BatchSampler
+                batch_sampler = BatchSampler(sampler, batch_size,
+                                             last_batch or "keep")
+            else:
+                batch_sampler = _BatchSampler(len(dataset), batch_size,
+                                              shuffle, last_batch or "keep")
+        elif sampler is not None:
+            raise MXNetError("batch_sampler is exclusive with sampler")
         self._batch_sampler = batch_sampler
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._num_workers = max(0, num_workers)
@@ -184,27 +194,52 @@ class DataLoader:
         ctx = mp.get_context("fork")
         idx_q = ctx.Queue()
         out_q = ctx.Queue()
-        n_batches = 0
-        for indices in self._batch_sampler:
-            idx_q.put((n_batches, np.asarray(indices)))
-            n_batches += 1
-        for _ in range(self._num_workers):
-            idx_q.put(None)
+        jobs = [(i, np.asarray(ix))
+                for i, ix in enumerate(self._batch_sampler)]
+        n_batches = len(jobs)
+        # backpressure: at most `prefetch` batches in flight — workers
+        # only get a new job when the parent consumes one (the process
+        # analogue of the threaded path's bounded out queue; unbounded
+        # production would fill /dev/shm with unconsumed segments)
+        in_flight = min(self._prefetch, n_batches)
+        for job in jobs[:in_flight]:
+            idx_q.put(job)
+        feed_next = in_flight
         procs = [ctx.Process(target=_proc_worker,
                              args=(self._dataset, idx_q, out_q),
                              daemon=True)
                  for _ in range(self._num_workers)]
         for p in procs:
             p.start()
+        pending = {}
+
+        def _drain_pending():
+            """Release shm of batches that will never be consumed."""
+            for desc in pending.values():
+                try:
+                    _tree_from_shm(desc)
+                except Exception:
+                    pass
+            pending.clear()
+
         try:
             next_seq = 0
-            pending = {}
             received = 0
             while received < n_batches:
-                seq, desc, err = out_q.get()
+                try:
+                    seq, desc, err = out_q.get(timeout=5.0)
+                except _queue.Empty:
+                    if not any(p.is_alive() for p in procs):
+                        raise MXNetError(
+                            "DataLoader worker processes died without "
+                            "reporting a result (killed/OOM?)")
+                    continue
                 if err is not None:
                     raise MXNetError("DataLoader worker failed: %s" % err)
                 received += 1
+                if feed_next < n_batches:
+                    idx_q.put(jobs[feed_next])
+                    feed_next += 1
                 pending[seq] = desc
                 while next_seq in pending:
                     yield _tree_from_shm(pending.pop(next_seq))
@@ -213,6 +248,9 @@ class DataLoader:
                 yield _tree_from_shm(pending.pop(next_seq))
                 next_seq += 1
         finally:
+            _drain_pending()
+            for _ in range(self._num_workers):
+                idx_q.put(None)
             for p in procs:
                 p.terminate()
             for p in procs:

@@ -120,13 +120,22 @@ class FeedForward:
         train = self._as_iter(X, y, shuffle=True)
         mod = self._ensure_module(train)
         opt_kwargs = dict(self.kwargs)
+        # allow_extra_params means "ignore surplus keys in arg_params"
+        # (reference FeedForward semantics) — NOT Module's allow_missing
+        arg_params = self.arg_params
+        if arg_params and self.allow_extra_params:
+            valid = set(self.symbol.list_arguments())
+            arg_params = {k: v for k, v in arg_params.items() if k in valid}
         mod.fit(train, eval_data=eval_data, eval_metric=eval_metric,
                 epoch_end_callback=epoch_end_callback,
                 batch_end_callback=batch_end_callback, kvstore=kvstore,
                 optimizer=self.optimizer, optimizer_params=opt_kwargs,
                 initializer=self.initializer,
-                arg_params=self.arg_params, aux_params=self.aux_params,
-                allow_missing=self.allow_extra_params,
+                arg_params=arg_params, aux_params=self.aux_params,
+                # reference FeedForward initialises any param absent from
+                # arg_params with self.initializer (_init_params), so a
+                # partial dict is always permitted here
+                allow_missing=arg_params is not None,
                 begin_epoch=self.begin_epoch,
                 num_epoch=self.num_epoch or 1, monitor=monitor)
         self.arg_params, self.aux_params = mod.get_params()

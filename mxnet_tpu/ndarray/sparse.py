@@ -326,12 +326,28 @@ def empty(stype, shape, ctx=None, dtype=None):
 
 
 def array(source_array, ctx=None, dtype=None):
-    """Build a sparse NDArray from any array-like/sparse input keeping its
-    storage type (parity: sparse.array)."""
+    """Build a sparse NDArray from sparse input — a sparse NDArray or a
+    scipy.sparse matrix (parity: sparse.array, which accepts exactly
+    these and rejects dense input)."""
     import numpy as _np
     if isinstance(source_array, BaseSparseNDArray):
-        return cast_storage(source_array.tostype("default"),
-                            source_array.stype)
-    from .ndarray import array as _dense_array
-    dense = _dense_array(_np.asarray(source_array), ctx=ctx, dtype=dtype)
-    return dense
+        out = cast_storage(source_array.tostype("default"),
+                           source_array.stype)
+        if dtype is not None:
+            out = out.astype(dtype)
+        return out
+    try:
+        import scipy.sparse as _sps
+        is_scipy = _sps.issparse(source_array)
+    except ImportError:
+        is_scipy = False
+    if is_scipy:
+        csr = source_array.tocsr()
+        data = _np.asarray(csr.data, dtype or csr.dtype)
+        return csr_matrix((data, _np.asarray(csr.indices),
+                           _np.asarray(csr.indptr)), shape=csr.shape,
+                          ctx=ctx)
+    raise MXNetError(
+        "sparse.array expects a sparse NDArray or scipy.sparse matrix; "
+        "use mx.nd.array / csr_matrix / row_sparse_array for dense input "
+        "(the reference rejects dense input here too)")

@@ -316,7 +316,10 @@ def _bwd(causal, scale, block_q, interpret, res, g):
 
 def _bwd_flash(causal, scale, block_q, interpret, res, g):
     q, k, v, out, lse = res
-    block_q = block_q if block_q is not None else DEFAULT_BLOCK_Q
+    if block_q is None:
+        # same tuner decision as the forward: the cache is keyed by the
+        # identical signature, so the cached winner (or default) applies
+        block_q = _resolve_block_q(q, k, causal, interpret)
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     b, h, s_q, d = q.shape
     s_kv = k.shape[2]
@@ -378,9 +381,8 @@ def _bwd_flash(causal, scale, block_q, interpret, res, g):
 
 def _bwd_xla(causal, scale, block_q, interpret, res, g):
     q, k, v, out, lse = res
-    # the backward recompute loop is plain XLA (lax.map) — the block size
-    # only bounds its working set, so the untuned default serves
-    block_q = block_q if block_q is not None else DEFAULT_BLOCK_Q
+    if block_q is None:
+        block_q = _resolve_block_q(q, k, causal, interpret)
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     b, h, s_q, d = q.shape
     s_kv = k.shape[2]

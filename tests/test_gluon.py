@@ -239,3 +239,33 @@ def test_model_zoo_reference_names():
     for n in names:
         net = vision.get_model(n)
         assert net is not None, n
+
+
+def test_dataloader_custom_sampler_honored():
+    """A user sampler drives index order (was silently ignored)."""
+    ds = gluon.data.ArrayDataset(mx.nd.arange(8))
+    order = [7, 6, 5, 4, 3, 2, 1, 0]
+
+    class Rev(gluon.data.Sampler):
+        def __iter__(self):
+            return iter(order)
+
+        def __len__(self):
+            return 8
+
+    loader = gluon.data.DataLoader(ds, batch_size=4, sampler=Rev())
+    got = np.concatenate([b.asnumpy() for b in loader])
+    np.testing.assert_allclose(got, order)
+    with np.testing.assert_raises(Exception):
+        gluon.data.DataLoader(ds, batch_size=4, sampler=Rev(), shuffle=True)
+
+
+def test_sparse_array_scipy_and_dense_rejection():
+    import pytest as _pytest
+    import scipy.sparse as sps
+    m = sps.csr_matrix(np.eye(3, dtype=np.float32))
+    a = mx.nd.sparse.array(m)
+    assert a.stype == "csr"
+    np.testing.assert_allclose(a.asnumpy(), np.eye(3))
+    with _pytest.raises(Exception):
+        mx.nd.sparse.array([[0, 1], [2, 0]])
