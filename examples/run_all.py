@@ -22,6 +22,7 @@ EXAMPLES = [
     ("sparse/linear_classification.py",
      ["--num-epochs", "2", "--num-features", "200"]),
     ("ssd/train_ssd.py", ["--iters", "2", "--batch-size", "4"]),
+    ("parallel/train_moe_pipeline.py", []),
     ("model-parallel/lstm_stages.py", ["--num-stages", "4"]),
 ]
 
