@@ -168,6 +168,9 @@ class Module(BaseModule):
         if shared_module is not None and shared_module.params_initialized:
             arg_p, aux_p = shared_module.get_params()
             self.set_params(arg_p, aux_p)
+        elif self.params_initialized and self._arg_params is not None:
+            # Module.load path: checkpointed params install at bind time
+            self.set_params(self._arg_params, self._aux_params or {})
 
     # -- multi-device mesh (TPU-native DataParallelExecutorGroup) ----------
     def _init_mesh(self):
@@ -418,7 +421,9 @@ class Module(BaseModule):
         mod = Module(symbol, **kwargs)
         mod._arg_params = arg_params
         mod._aux_params = aux_params
-        mod.params_initialized = False
+        # reference Module.load marks params initialised; bind() installs
+        # them into the executor (module.py:126-183)
+        mod.params_initialized = True
         mod._preloaded_params = (arg_params, aux_params)
         if load_optimizer_states:
             mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)

@@ -320,3 +320,35 @@ def test_group2ctx_misplacement_raises():
                         group2ctxs=g2c)
     with pytest.raises(mx.MXNetError, match="group2ctxs"):
         mod.bind(data_shapes=[("data", (4, 6))], label_shapes=None)
+
+
+def test_module_load_bind_predict():
+    """Module.load -> bind -> forward installs the checkpointed params at
+    bind time (reference module.py:126-183) — regression: predictions
+    after reload must match the trained module, BN aux states included."""
+    import tempfile
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8)
+    net = mx.sym.BatchNorm(net, name="bn")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(net, num_hidden=2),
+                               name="softmax")
+    x = np.random.RandomState(0).randn(32, 4).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=8)
+    mod = mx.mod.Module(net)
+    mod.fit(it, num_epoch=2, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.initializer.Xavier())
+    batch = DataBatch([mx.nd.array(x[:8])], [mx.nd.zeros((8,))])
+    mod.forward(batch, is_train=False)
+    want = mod.get_outputs()[0].asnumpy()
+    with tempfile.TemporaryDirectory() as d:
+        import os
+        mod.save_checkpoint(os.path.join(d, "m"), 2)
+        m2 = mx.mod.Module.load(os.path.join(d, "m"), 2)
+        m2.bind(data_shapes=[("data", (8, 4))], for_training=False,
+                label_shapes=[("softmax_label", (8,))])
+        m2.forward(batch, is_train=False)
+        got = m2.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
