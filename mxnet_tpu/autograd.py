@@ -75,19 +75,24 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
 
 def grad(heads, variables, head_grads=None, retain_graph=None,
          create_graph=False, train_mode=True):
-    """Return gradients of heads w.r.t. variables (parity: autograd.grad).
+    """Return gradients of heads w.r.t. variables (parity: autograd.grad,
+    higher-order capable via ``create_graph=True``).
 
-    Gradients are returned rather than written into ``.grad``.
-    ``create_graph`` (higher-order) is not yet supported on the eager tape;
-    use jax.grad composition via gluon hybridized blocks for that.
+    Gradients are returned rather than written into ``.grad``. With
+    ``create_graph`` the backward is itself recorded: the tape subgraph
+    is replayed as one pure jax function, its vjp produces the
+    gradients, and that whole computation lands on the tape as a single
+    differentiable node — so grad-of-grad composes to any order
+    (jax owns the nested differentiation).
     """
-    if create_graph:
-        raise MXNetError("create_graph=True is not supported by the eager "
-                         "tape yet; compose jax.grad via hybridize instead")
     if not isinstance(heads, (list, tuple)):
         heads = [heads]
     single = not isinstance(variables, (list, tuple))
     varlist = [variables] if single else list(variables)
+    if create_graph:
+        out = _grad_create_graph(list(heads), varlist, head_grads,
+                                 train_mode)
+        return out[0] if single else out
 
     # stash existing grad state, attach temp buffers
     saved = [(v._grad, v._tape) for v in varlist]
@@ -107,6 +112,53 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
             v._grad = g
             v._tape = t
     return out[0] if single else out
+
+
+def _grad_create_graph(heads, varlist, head_grads, train_mode):
+    """Differentiable gradients: replay the tape as a pure function and
+    record its vjp as one new tape node."""
+    import jax
+    from .ndarray.ndarray import NDArray, _wrap
+
+    for v in varlist:
+        if v._tape is None or not isinstance(v._tape[0], _imp.Leaf):
+            raise MXNetError("autograd.grad: variables must have attached "
+                             "grad (call attach_grad before record)")
+    # replay over EVERY leaf the subgraph touches, so the recorded grad
+    # node keeps cross-derivatives w.r.t. variables not being asked for
+    replay, leaves = _imp.build_pure_from_tape(heads)
+    if head_grads is None:
+        hg = tuple(jnp.ones(h.shape, h._data.dtype) for h in heads)
+    else:
+        hg = tuple(g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                   for g in head_grads)
+    want = []
+    for v in varlist:
+        leaf = v._tape[0]
+        pos = next((i for i, l in enumerate(leaves) if l is leaf), None)
+        if pos is None:
+            raise MXNetError("autograd.grad: variable does not feed the "
+                             "given heads")
+        want.append(pos)
+
+    def grad_fn(*leaf_raws):
+        _, vjp = jax.vjp(replay, *leaf_raws)
+        all_grads = vjp(hg)
+        return tuple(all_grads[i] for i in want)
+
+    leaf_raws = [l.array._data for l in leaves]
+    outs, vjp2 = jax.vjp(grad_fn, *leaf_raws)
+    node = _imp.TapeNode(
+        [(l, 0) for l in leaves], vjp2,
+        [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs], "_grad")
+    node.pure_fn = grad_fn          # third order and beyond compose
+    node.raw_inputs = leaf_raws
+    results = []
+    for i, o in enumerate(outs):
+        nd = _wrap(o)
+        nd._tape = (node, i)
+        results.append(nd)
+    return results
 
 
 class Function:

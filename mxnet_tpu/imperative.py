@@ -60,9 +60,12 @@ class TapeNode:
     ``vjp_fn`` maps output cotangents -> input cotangents.
     """
 
-    __slots__ = ("parents", "vjp_fn", "out_avals", "op_name")
+    __slots__ = ("parents", "vjp_fn", "out_avals", "op_name",
+                 "pure_fn", "raw_inputs")
 
     def __init__(self, parents, vjp_fn, out_avals, op_name):
+        self.pure_fn = None
+        self.raw_inputs = None
         self.parents = parents
         self.vjp_fn = vjp_fn
         self.out_avals = out_avals
@@ -143,6 +146,10 @@ def invoke(op, inputs, kwargs, out=None, name=None):
         node = TapeNode(parents, vjp_fn,
                         [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs],
                         op.name)
+        # replay handles for higher-order grad (autograd.grad
+        # create_graph=True rebuilds a pure function from the tape)
+        node.pure_fn = _pure
+        node.raw_inputs = raw
     else:
         outs = _pure(*raw)
         node = None
@@ -300,3 +307,66 @@ def _write_leaf(leaf, cotangent):
 def get_symbol(x):  # pragma: no cover - parity stub
     raise MXNetError("autograd.get_symbol is not supported in the TPU build; "
                      "use gluon.HybridBlock.hybridize for graph capture")
+
+
+# ---------------------------------------------------------------------------
+# Higher-order support: rebuild a pure function from the tape
+# ---------------------------------------------------------------------------
+
+def build_pure_from_tape(outputs):
+    """Replay the recorded subgraph as a pure jax function of EVERY leaf
+    it touches (a grad that stays differentiable w.r.t. only a subset of
+    leaves would silently lose cross-derivatives). Returns
+    ``(replay, leaves)`` — ``replay(*leaf_raws) -> output_raws`` and the
+    ordered list of Leaf nodes matching the argument order. Powers
+    autograd.grad(create_graph=True): jax differentiates the replayed
+    function to any order."""
+    leaves = []
+    leaf_pos = {}
+    seen = set()
+    stack = [y._tape[0] for y in outputs if y._tape is not None]
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        if isinstance(node, Leaf):
+            leaf_pos[id(node)] = len(leaves)
+            leaves.append(node)
+            continue
+        if node.pure_fn is None:
+            raise MXNetError(
+                "higher-order grad: tape node %r has no replay info"
+                % node.op_name)
+        for p in node.parents:
+            if p is not None:
+                stack.append(p[0])
+
+    def replay(*leaf_raws):
+        cache = {}
+
+        def eval_node(node):
+            got = cache.get(id(node))
+            if got is not None:
+                return got
+            if isinstance(node, Leaf):
+                val = (leaf_raws[leaf_pos[id(node)]],)
+            else:
+                args = []
+                for j, p in enumerate(node.parents):
+                    if p is None:
+                        args.append(node.raw_inputs[j])
+                    else:
+                        pn, pi = p
+                        args.append(eval_node(pn)[pi])
+                val = node.pure_fn(*args)
+            cache[id(node)] = val
+            return val
+
+        outs = []
+        for y in outputs:
+            n, i = y._tape
+            outs.append(eval_node(n)[i])
+        return tuple(outs)
+
+    return replay, leaves

@@ -135,3 +135,42 @@ def test_contrib_grad_and_loss_tuple_outputs():
     grads, outs = f(mx.nd.array([3.0]))
     assert len(outs) == 2
     np.testing.assert_allclose(grads[0].asnumpy(), [7.0], rtol=1e-6)
+
+
+def test_grad_create_graph_second_order():
+    """(parity: reference autograd.grad create_graph) d/dx of (dy/dx)."""
+    x = mx.nd.array(np.array([1.0, 2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+        g1 = autograd.grad(y, [x], create_graph=True)[0]
+        z = (g1 * g1).sum()
+    z.backward()
+    # d/dx (3x^2)^2 = 36 x^3
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               36 * np.array([1.0, 8.0]), rtol=1e-4)
+
+
+def test_grad_create_graph_third_order():
+    x = mx.nd.array(np.array([2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 4).sum()
+        g1 = autograd.grad(y, [x], create_graph=True)[0]
+        g2 = autograd.grad(g1.sum(), [x], create_graph=True)[0]
+        w = g2.sum()
+    w.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [48.0], rtol=1e-4)
+
+
+def test_grad_create_graph_multivar():
+    """Mixed partials through two variables."""
+    a = mx.nd.array(np.array([1.5], np.float32)); a.attach_grad()
+    b = mx.nd.array(np.array([0.5], np.float32)); b.attach_grad()
+    with autograd.record():
+        y = (a * a * b).sum()          # d/da = 2ab; d^2/dadb = 2a
+        ga = autograd.grad(y, [a], create_graph=True)[0]
+        s = ga.sum()
+    s.backward()
+    np.testing.assert_allclose(b.grad.asnumpy(), [3.0], rtol=1e-5)  # 2a
+    np.testing.assert_allclose(a.grad.asnumpy(), [1.0], rtol=1e-5)  # 2b
