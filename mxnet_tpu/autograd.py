@@ -23,6 +23,7 @@ is_training = _imp.is_training
 set_recording = _imp.set_recording
 set_training = _imp.set_training
 mark_variables = _imp.mark_variables
+get_symbol = _imp.get_symbol
 
 
 class _RecordingStateScope:

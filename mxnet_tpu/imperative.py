@@ -61,11 +61,13 @@ class TapeNode:
     """
 
     __slots__ = ("parents", "vjp_fn", "out_avals", "op_name",
-                 "pure_fn", "raw_inputs")
+                 "pure_fn", "raw_inputs", "op", "params")
 
     def __init__(self, parents, vjp_fn, out_avals, op_name):
         self.pure_fn = None
         self.raw_inputs = None
+        self.op = None
+        self.params = None
         self.parents = parents
         self.vjp_fn = vjp_fn
         self.out_avals = out_avals
@@ -147,9 +149,13 @@ def invoke(op, inputs, kwargs, out=None, name=None):
                         [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs],
                         op.name)
         # replay handles for higher-order grad (autograd.grad
-        # create_graph=True rebuilds a pure function from the tape)
+        # create_graph=True rebuilds a pure function from the tape) and
+        # symbol reconstruction (autograd.get_symbol)
         node.pure_fn = _pure
         node.raw_inputs = raw
+        node.op = op
+        node.params = {k: v for k, v in params.items()
+                       if k not in ("_train", "_rng")}
     else:
         outs = _pure(*raw)
         node = None
@@ -304,9 +310,50 @@ def _write_leaf(leaf, cotangent):
         var._grad._set_data(cotangent.astype(var._grad.dtype))
 
 
-def get_symbol(x):  # pragma: no cover - parity stub
-    raise MXNetError("autograd.get_symbol is not supported in the TPU build; "
-                     "use gluon.HybridBlock.hybridize for graph capture")
+def get_symbol(x):
+    """Rebuild a Symbol from the recorded graph (parity:
+    autograd.get_symbol / C MXAutogradGetSymbol — the reference converts
+    the tape's nnvm nodes back to a Symbol; here the tape nodes carry
+    (op, params) so the same reconstruction applies). Leaves and
+    constant inputs become Variables with generated names."""
+    from .symbol.symbol import Symbol, _SymNode
+    from .ndarray.ndarray import NDArray
+    if not isinstance(x, NDArray) or x._tape is None:
+        raise MXNetError("get_symbol: array is not part of a recorded "
+                         "graph (use autograd.record())")
+    cache = {}
+    counters = {}
+
+    def name_for(base):
+        i = counters.get(base, 0)
+        counters[base] = i + 1
+        return "%s%d" % (base, i)
+
+    def conv(node):
+        got = cache.get(id(node))
+        if got is not None:
+            return got
+        if isinstance(node, Leaf):
+            sn = _SymNode(None, name_for("var"), {}, [])
+        else:
+            if node.op is None:
+                raise MXNetError("get_symbol: node %r has no symbol info "
+                                 "(grad-of-grad nodes are not "
+                                 "symbolisable)" % node.op_name)
+            inputs = []
+            for j, p in enumerate(node.parents):
+                if p is None:
+                    inputs.append((_SymNode(None, name_for("const"), {},
+                                            []), 0))
+                else:
+                    inputs.append((conv(p[0]), p[1]))
+            sn = _SymNode(node.op, name_for(node.op.name.lower()),
+                          node.params or {}, inputs)
+        cache[id(node)] = sn
+        return sn
+
+    n, i = x._tape
+    return Symbol([(conv(n), i)])
 
 
 # ---------------------------------------------------------------------------

@@ -13,11 +13,6 @@ import mxnet_tpu as mx
 REF = "/root/reference/python/mxnet"
 
 SKIP = {
-    "autograd.py": {
-        "get_symbol": "rebuilding a Symbol from the eager tape needs op "
-                      "kwargs the vjp tape does not keep; hybridize/"
-                      "CachedOp is the supported trace-to-graph path",
-    },
     "gluon/data/dataloader.py": {
         # our process mode ships shm descriptors from accelerator-free
         # forked workers (dataloader._proc_worker/_tree_to_shm); the

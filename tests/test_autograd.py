@@ -174,3 +174,21 @@ def test_grad_create_graph_multivar():
     s.backward()
     np.testing.assert_allclose(b.grad.asnumpy(), [3.0], rtol=1e-5)  # 2a
     np.testing.assert_allclose(a.grad.asnumpy(), [1.0], rtol=1e-5)  # 2b
+
+
+def test_get_symbol_reconstructs_graph():
+    """(parity: autograd.get_symbol / MXAutogradGetSymbol) — the symbol
+    rebuilt from the tape reproduces the recorded forward."""
+    x = mx.nd.array(np.random.RandomState(0).randn(2, 4).astype(np.float32))
+    w = mx.nd.array(np.random.RandomState(1).randn(3, 4).astype(np.float32))
+    x.attach_grad()
+    w.attach_grad()
+    with autograd.record():
+        y = mx.nd.FullyConnected(x, w, None, num_hidden=3, no_bias=True)
+        z = mx.nd.relu(y) * 2.0
+    sym = autograd.get_symbol(z)
+    args = sym.list_arguments()
+    assert len(args) == 2
+    exe = sym.bind(mx.cpu(), {args[0]: x, args[1]: w})
+    np.testing.assert_allclose(exe.forward()[0].asnumpy(), z.asnumpy(),
+                               rtol=1e-6)
