@@ -68,8 +68,8 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     """(parity: autograd.backward)"""
     if not isinstance(heads, (list, tuple)):
         heads = [heads]
-        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
-            head_grads = [head_grads]
+    if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
     _imp.backward(list(heads), head_grads, retain_graph=retain_graph,
                   train_mode=train_mode)
 
@@ -88,6 +88,8 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
     """
     if not isinstance(heads, (list, tuple)):
         heads = [heads]
+    if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
     single = not isinstance(variables, (list, tuple))
     varlist = [variables] if single else list(variables)
     if create_graph:

@@ -214,7 +214,17 @@ class DataLoader:
         pending = {}
 
         def _drain_pending():
-            """Release shm of batches that will never be consumed."""
+            """Release shm of every produced-but-unconsumed batch: both
+            the reordering buffer and results still sitting in out_q
+            (workers already closed their handles — the parent must
+            attach+unlink or the segments outlive the epoch)."""
+            while True:
+                try:
+                    _seq, desc, err = out_q.get_nowait()
+                except _queue.Empty:
+                    break
+                if err is None:
+                    pending[_seq] = desc
             for desc in pending.values():
                 try:
                     _tree_from_shm(desc)
@@ -225,15 +235,24 @@ class DataLoader:
         try:
             next_seq = 0
             received = 0
+            empty_strikes = 0
             while received < n_batches:
                 try:
                     seq, desc, err = out_q.get(timeout=5.0)
                 except _queue.Empty:
-                    if not any(p.is_alive() for p in procs):
+                    # a DEAD worker that still held a job can never post
+                    # its result: any death + sustained silence = hang,
+                    # raise instead of spinning (strikes reset on
+                    # progress, so a dead-but-finished worker is fine
+                    # while the others keep producing)
+                    empty_strikes += 1
+                    if empty_strikes >= 3 and \
+                            any(not p.is_alive() for p in procs):
                         raise MXNetError(
-                            "DataLoader worker processes died without "
+                            "DataLoader worker process died without "
                             "reporting a result (killed/OOM?)")
                     continue
+                empty_strikes = 0
                 if err is not None:
                     raise MXNetError("DataLoader worker failed: %s" % err)
                 received += 1
@@ -248,9 +267,13 @@ class DataLoader:
                 yield _tree_from_shm(pending.pop(next_seq))
                 next_seq += 1
         finally:
-            _drain_pending()
             for _ in range(self._num_workers):
                 idx_q.put(None)
+            # give workers a beat to flush results already in transit,
+            # then reclaim every unconsumed segment before terminating
+            for p in procs:
+                p.join(timeout=0.2)
+            _drain_pending()
             for p in procs:
                 p.terminate()
             for p in procs:

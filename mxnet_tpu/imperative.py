@@ -150,9 +150,13 @@ def invoke(op, inputs, kwargs, out=None, name=None):
                         op.name)
         # replay handles for higher-order grad (autograd.grad
         # create_graph=True rebuilds a pure function from the tape) and
-        # symbol reconstruction (autograd.get_symbol)
+        # symbol reconstruction (autograd.get_symbol). Only CONSTANT
+        # (off-tape) inputs are retained — replay recomputes on-tape
+        # values from parents, so pinning them would inflate peak memory
+        # of every eager step for a rarely-used feature
         node.pure_fn = _pure
-        node.raw_inputs = raw
+        node.raw_inputs = [r if p is None else None
+                           for r, p in zip(raw, parents)]
         node.op = op
         node.params = {k: v for k, v in params.items()
                        if k not in ("_train", "_rng")}

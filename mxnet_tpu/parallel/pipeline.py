@@ -53,9 +53,13 @@ def _pipe_local(params, x, stage_fn, axis_name, n_micro):
 
     # carries become device-varying after one tick; mark them so from
     # the start or the scan's carry types disagree (shard_map vma rules)
-    acc0 = lax.pvary(jnp.zeros((n_micro,) + mb_shape, x.dtype),
-                     (axis_name,))
-    cur0 = lax.pvary(jnp.zeros(mb_shape, x.dtype), (axis_name,))
+    def _varying(v):
+        if hasattr(lax, "pcast"):
+            return lax.pcast(v, (axis_name,), to="varying")
+        return lax.pvary(v, (axis_name,))
+
+    acc0 = _varying(jnp.zeros((n_micro,) + mb_shape, x.dtype))
+    cur0 = _varying(jnp.zeros(mb_shape, x.dtype))
     (acc, _), _ = lax.scan(tick, (acc0, cur0), jnp.arange(total))
     # every device returns the accumulator; only the last stage's is
     # non-zero — a psum broadcasts it to all (cheap at dryrun scale;
