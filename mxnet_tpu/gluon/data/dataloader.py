@@ -39,13 +39,20 @@ def _numpy_batchify(data):
 
 
 def _tree_to_shm(tree, shm_list):
-    """numpy tree -> picklable descriptor; arrays move into POSIX shm."""
-    from multiprocessing import shared_memory
+    """numpy tree -> picklable descriptor; arrays move into POSIX shm.
+    Ownership transfers to the consumer: the segment is unregistered
+    from this process's resource tracker so only the parent's unlink
+    cleans it (avoids double-unlink warnings at worker exit)."""
+    from multiprocessing import shared_memory, resource_tracker
     if isinstance(tree, list):
         return ("list", [_tree_to_shm(t, shm_list) for t in tree])
     arr = np.ascontiguousarray(tree)
     shm = shared_memory.SharedMemory(create=True, size=max(arr.nbytes, 1))
     shm.buf[:arr.nbytes] = arr.tobytes()
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:
+        pass
     shm_list.append(shm)
     return ("shm", shm.name, arr.shape, str(arr.dtype))
 
