@@ -41,6 +41,13 @@ $(LIB_DIR)/libmxtpu_engine.so: src/engine.cc
 test: all
 	python -m pytest tests/ -q
 
+# C++ unit tests for the native layer (parity: reference tests/cpp/)
+testcpp: tests/cpp/test_native
+	./tests/cpp/test_native
+
+tests/cpp/test_native: tests/cpp/test_native.cc src/engine.cc src/storage.cc
+	$(CXX) $(CXXFLAGS) -o $@ $^
+
 clean:
 	rm -rf $(LIB_DIR)
 

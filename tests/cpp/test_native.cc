@@ -1,0 +1,141 @@
+// Native-layer unit tests (parity model: reference tests/cpp/ —
+// engine/threaded_engine_test.cc dependency-ordering semantics and
+// storage/storage_test.cc pooling). Assert-based, no gtest dependency:
+// build + run via `make testcpp`.
+#include <atomic>
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+extern "C" {
+void* eng_create(int num_workers, int naive);
+void eng_destroy(void* h);
+int64_t eng_new_var(void* h);
+void eng_delete_var(void* h, int64_t v);
+void eng_push(void* h, void (*fn)(void*), void* arg, const int64_t* cvars,
+              int n_c, const int64_t* mvars, int n_m, int priority);
+void eng_wait_for_var(void* h, int64_t v);
+void eng_wait_all(void* h);
+
+void* sto_alloc(size_t nbytes);
+void sto_free(void* buf, size_t nbytes);
+void sto_direct_free(void* buf, size_t nbytes);
+void sto_stats(size_t* allocated, size_t* pooled, size_t* peak);
+void sto_release_all();
+}
+
+namespace {
+
+struct Cell {
+  std::atomic<long> value{0};
+};
+
+void increment(void* arg) {
+  auto* c = static_cast<Cell*>(arg);
+  // non-atomic read-modify-write: only safe if the engine serialises
+  // writers on the same mutable var — which is exactly the contract
+  long v = c->value.load(std::memory_order_relaxed);
+  c->value.store(v + 1, std::memory_order_relaxed);
+}
+
+struct ReadCheck {
+  Cell* cell;
+  long expected;
+  std::atomic<int>* failures;
+};
+
+void read_check(void* arg) {
+  auto* rc = static_cast<ReadCheck*>(arg);
+  if (rc->cell->value.load(std::memory_order_relaxed) != rc->expected)
+    rc->failures->fetch_add(1);
+}
+
+void engine_write_serialisation() {
+  void* eng = eng_create(4, 0);
+  Cell cell;
+  int64_t var = eng_new_var(eng);
+  const int N = 2000;
+  for (int i = 0; i < N; ++i)
+    eng_push(eng, increment, &cell, nullptr, 0, &var, 1, 0);
+  eng_wait_for_var(eng, var);
+  assert(cell.value.load() == N && "writes on one var must serialise");
+  eng_delete_var(eng, var);
+  eng_destroy(eng);
+  std::puts("ok engine_write_serialisation");
+}
+
+void engine_read_after_write() {
+  void* eng = eng_create(4, 0);
+  Cell cell;
+  int64_t var = eng_new_var(eng);
+  std::atomic<int> failures{0};
+  std::vector<ReadCheck> checks(64);
+  for (int round = 0; round < 64; ++round) {
+    eng_push(eng, increment, &cell, nullptr, 0, &var, 1, 0);
+    checks[round] = {&cell, static_cast<long>(round + 1), &failures};
+    // reader lists var as const: must observe the preceding write
+    eng_push(eng, read_check, &checks[round], &var, 1, nullptr, 0, 0);
+  }
+  eng_wait_all(eng);
+  assert(failures.load() == 0 && "reader ran before its writer");
+  eng_delete_var(eng, var);
+  eng_destroy(eng);
+  std::puts("ok engine_read_after_write");
+}
+
+void engine_naive_mode() {
+  void* eng = eng_create(1, /*naive=*/1);
+  Cell cell;
+  int64_t var = eng_new_var(eng);
+  for (int i = 0; i < 100; ++i)
+    eng_push(eng, increment, &cell, nullptr, 0, &var, 1, 0);
+  // naive mode executes synchronously: value is final without waiting
+  assert(cell.value.load() == 100);
+  eng_delete_var(eng, var);
+  eng_destroy(eng);
+  std::puts("ok engine_naive_mode");
+}
+
+void storage_pool_reuse() {
+  sto_release_all();
+  void* a = sto_alloc(1000);
+  assert(a != nullptr);
+  sto_free(a, 1000);
+  void* b = sto_alloc(900);  // same size bucket: must come from the pool
+  assert(b == a && "freed buffer should be reused for same-bucket alloc");
+  size_t allocated = 0, pooled = 0, peak = 0;
+  sto_stats(&allocated, &pooled, &peak);
+  assert(peak >= allocated);
+  sto_free(b, 900);
+  sto_stats(&allocated, &pooled, &peak);
+  assert(pooled > 0 && "freed buffer should park in the pool");
+  sto_release_all();
+  sto_stats(&allocated, &pooled, &peak);
+  assert(pooled == 0 && "release_all must drop parked buffers");
+  std::puts("ok storage_pool_reuse");
+}
+
+void storage_direct_free() {
+  void* a = sto_alloc(4096);
+  size_t pooled_before = 0;
+  sto_stats(nullptr, &pooled_before, nullptr);
+  sto_direct_free(a, 4096);
+  size_t pooled_after = 0;
+  sto_stats(nullptr, &pooled_after, nullptr);
+  assert(pooled_after == pooled_before && "direct free bypasses the pool");
+  std::puts("ok storage_direct_free");
+}
+
+}  // namespace
+
+int main() {
+  engine_write_serialisation();
+  engine_read_after_write();
+  engine_naive_mode();
+  storage_pool_reuse();
+  storage_direct_free();
+  std::puts("ALL NATIVE TESTS PASSED");
+  return 0;
+}

@@ -1,0 +1,19 @@
+"""Drive the C++ unit tests (reference tests/cpp/ analogue) through make,
+so `pytest tests/` covers the native layer's own assertions too."""
+import os
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.skipif(shutil.which("g++") is None or
+                    shutil.which("make") is None,
+                    reason="no native toolchain")
+def test_native_cpp_suite():
+    rc = subprocess.run(["make", "-s", "testcpp"], cwd=REPO,
+                        capture_output=True, text=True, timeout=300)
+    assert rc.returncode == 0, rc.stdout[-2000:] + rc.stderr[-2000:]
+    assert "ALL NATIVE TESTS PASSED" in rc.stdout
