@@ -398,6 +398,14 @@ class NDArray:
 
     # -- indexing ----------------------------------------------------------
     def __getitem__(self, key):
+        # basic axis-0 indexing returns a WRITE-THROUGH VIEW of the
+        # parent (reference NDArray.__getitem__ aliases via
+        # MXNDArraySlice/_at; `a[1:3][:] = x` must mutate `a`).
+        # Advanced/tuple indexing copies, like the reference.
+        if isinstance(key, (int, np.integer)):
+            return _SliceView(self, int(key))
+        if isinstance(key, slice) and key.step in (None, 1):
+            return _SliceView(self, key)
         if isinstance(key, NDArray):
             key = key._data.astype(jnp.int32)
         elif isinstance(key, tuple):
@@ -460,6 +468,33 @@ def _multi_device_sharding(raw):
     if sh is not None and len(getattr(sh, "device_set", ())) > 1:
         return sh
     return None
+
+
+class _SliceView(NDArray):
+    """Write-through view of a basic axis-0 slice (parity: the
+    reference's aliasing NDArray views). ``_data`` reads through to the
+    parent; ``_set_data`` writes back into the parent's buffer, so
+    in-place ops and ``view[:] = x`` mutate the parent like shared
+    storage would."""
+
+    __slots__ = ("_parent", "_vkey")
+
+    def __init__(self, parent, key):
+        self._parent = parent
+        self._vkey = key
+        self._ctx = parent._ctx
+        self._grad = None
+        self._tape = None
+        self._stype = "default"
+
+    @property
+    def _data(self):
+        return self._parent._data[self._vkey]
+
+    def _set_data(self, raw):
+        parent = self._parent
+        parent._set_data(parent._data.at[self._vkey].set(
+            jnp.asarray(raw, parent._data.dtype)))
 
 
 def _wrap(raw, ctx=None):

@@ -207,3 +207,27 @@ def test_random_shapes_and_seed():
     np.testing.assert_allclose(a.asnumpy(), b.asnumpy())
     n = nd.random.normal(loc=5, scale=0.1, shape=(2000,))
     assert abs(n.asnumpy().mean() - 5) < 0.1
+
+
+def test_basic_slice_is_write_through_view():
+    """Basic axis-0 indexing aliases the parent (reference
+    NDArray.__getitem__ via MXNDArraySlice/_at): writes through the view
+    mutate the parent; advanced indexing still copies."""
+    a = mx.nd.zeros((4, 5))
+    s = a[1:3]
+    s[:] = 9.0
+    assert a.asnumpy()[1:3].sum() == 90
+    row = a[0]
+    row += 1
+    assert a.asnumpy()[0].sum() == 5
+    v = a[2]
+    v[1] = 7.0
+    assert a.asnumpy()[2, 1] == 7
+    nested = a[1:3][0]
+    nested[:] = 2.0
+    assert a.asnumpy()[1].sum() == 10
+    # advanced indexing copies (parity: the reference copies there too)
+    idx = mx.nd.array(np.array([0, 2], np.float32))
+    c = a[idx]
+    c[:] = -1.0
+    assert a.asnumpy()[0].sum() == 5
