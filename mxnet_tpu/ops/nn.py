@@ -515,6 +515,16 @@ def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
     the forward function.
     """
     axis = 1 if multi_output else -1
+    # the reference's InferShape rejects a label that is not data minus
+    # the class axis; without this check a bad label broadcasts into a
+    # wrong-shaped cotangent and dies as a bare assertion inside vjp
+    expected = ((data.shape[0],) + tuple(data.shape[2:]) if multi_output
+                else tuple(data.shape[:-1]))
+    if tuple(label.shape) != expected:
+        raise MXNetError(
+            "SoftmaxOutput: label shape %s is inconsistent with data "
+            "shape %s (expected label %s)"
+            % (tuple(label.shape), tuple(data.shape), expected))
 
     @jax.custom_vjp
     def _fwd(d, l):

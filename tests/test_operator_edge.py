@@ -228,3 +228,15 @@ def test_expand_dims_squeeze_roundtrip():
     assert e.shape == (2, 1, 3)
     e2 = mx.nd.expand_dims(_nd(a), axis=-1)
     assert e2.shape == (2, 3, 1)
+
+
+def test_softmax_output_label_shape_validated():
+    """(reference InferShape contract) a label that is not data-minus-
+    class-axis raises a clear error instead of a broadcast assertion."""
+    d = mx.nd.zeros((4, 2))
+    with pytest.raises(Exception, match="label shape"):
+        mx.nd.SoftmaxOutput(d, mx.nd.zeros((4, 8)))
+    # valid forms still work
+    mx.nd.SoftmaxOutput(d, mx.nd.zeros((4,)))
+    mx.nd.SoftmaxOutput(mx.nd.zeros((4, 3, 5)), mx.nd.zeros((4, 5)),
+                        multi_output=True)
