@@ -40,19 +40,15 @@ def _numpy_batchify(data):
 
 def _tree_to_shm(tree, shm_list):
     """numpy tree -> picklable descriptor; arrays move into POSIX shm.
-    Ownership transfers to the consumer: the segment is unregistered
-    from this process's resource tracker so only the parent's unlink
-    cleans it (avoids double-unlink warnings at worker exit)."""
-    from multiprocessing import shared_memory, resource_tracker
+    The segment STAYS registered with the (fork-shared) resource
+    tracker as a crash-cleanup net; the consumer unregisters when it
+    unlinks, so the normal path produces no double-unlink warnings."""
+    from multiprocessing import shared_memory
     if isinstance(tree, list):
         return ("list", [_tree_to_shm(t, shm_list) for t in tree])
     arr = np.ascontiguousarray(tree)
     shm = shared_memory.SharedMemory(create=True, size=max(arr.nbytes, 1))
     shm.buf[:arr.nbytes] = arr.tobytes()
-    try:
-        resource_tracker.unregister(shm._name, "shared_memory")
-    except Exception:
-        pass
     shm_list.append(shm)
     return ("shm", shm.name, arr.shape, str(arr.dtype))
 
@@ -63,6 +59,7 @@ def _tree_from_shm(desc):
     if desc[0] == "list":
         return [_tree_from_shm(d) for d in desc[1]]
     _, name, shape, dtype = desc
+    from multiprocessing import resource_tracker
     shm = shared_memory.SharedMemory(name=name)
     try:
         arr = np.frombuffer(shm.buf, dtype=dtype)[:int(np.prod(shape))] \
@@ -70,6 +67,16 @@ def _tree_from_shm(desc):
     finally:
         shm.close()
         shm.unlink()
+        # attaching re-registered the segment in this process AND the
+        # producer registered it at create; drop both claims now that
+        # it is unlinked (fork shares one tracker, so this silences the
+        # exit-time double-unlink warning while keeping the tracker as
+        # the crash net for unconsumed segments)
+        for _ in range(2):
+            try:
+                resource_tracker.unregister(shm._name, "shared_memory")
+            except Exception:
+                break
     return nd_array(arr)
 
 

@@ -401,9 +401,18 @@ class NDArray:
         # basic axis-0 indexing returns a WRITE-THROUGH VIEW of the
         # parent (reference NDArray.__getitem__ aliases via
         # MXNDArraySlice/_at; `a[1:3][:] = x` must mutate `a`).
-        # Advanced/tuple indexing copies, like the reference.
-        if isinstance(key, (int, np.integer)):
-            return _SliceView(self, int(key))
+        # Advanced/tuple indexing copies, like the reference. bool is
+        # mask indexing, NOT row 0/1, so it must not match the int path.
+        if isinstance(key, (int, np.integer)) \
+                and not isinstance(key, (bool, np.bool_)):
+            idx = int(key)
+            n = self.shape[0] if self.ndim else 0
+            if idx < -n or idx >= n:
+                # eager bounds check: .at[oob].set silently drops writes
+                # and sequence-protocol iteration relies on IndexError
+                raise IndexError("index %d is out of bounds for axis 0 "
+                                 "with size %d" % (idx, n))
+            return _SliceView(self, idx % n if n else idx)
         if isinstance(key, slice) and key.step in (None, 1):
             return _SliceView(self, key)
         if isinstance(key, NDArray):
@@ -495,6 +504,44 @@ class _SliceView(NDArray):
         parent = self._parent
         parent._set_data(parent._data.at[self._vkey].set(
             jnp.asarray(raw, parent._data.dtype)))
+
+    # shape/dtype are derivable from the parent + key without issuing a
+    # device slice per attribute access (the _data property dispatches a
+    # gather each read)
+    @property
+    def shape(self):
+        pshape = self._parent.shape
+        if isinstance(self._vkey, slice):
+            start, stop, _ = self._vkey.indices(pshape[0])
+            return (max(0, stop - start),) + pshape[1:]
+        return pshape[1:]
+
+    @property
+    def dtype(self):
+        return self._parent.dtype
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def size(self):
+        out = 1
+        for d in self.shape:
+            out *= d
+        return out
+
+    def __reduce__(self):
+        # views pickle/deepcopy as detached base arrays (the inherited
+        # __setstate__ assigns _data, which a getter-only property on
+        # this class would reject)
+        return (_rebuild_detached, (self.asnumpy(),
+                                    self._ctx.device_type,
+                                    self._ctx.device_id))
+
+
+def _rebuild_detached(arr, ctx_type, ctx_id):
+    return array(arr, ctx=Context(ctx_type, ctx_id))
 
 
 def _wrap(raw, ctx=None):

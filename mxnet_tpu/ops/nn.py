@@ -521,10 +521,17 @@ def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
     expected = ((data.shape[0],) + tuple(data.shape[2:]) if multi_output
                 else tuple(data.shape[:-1]))
     if tuple(label.shape) != expected:
-        raise MXNetError(
-            "SoftmaxOutput: label shape %s is inconsistent with data "
-            "shape %s (expected label %s)"
-            % (tuple(label.shape), tuple(data.shape), expected))
+        flat = (data.shape[0],
+                int(np.prod(data.shape[2:])) if data.ndim > 2 else 1)
+        if multi_output and tuple(label.shape) == flat:
+            # the reference's InferShape actually assigns the label the
+            # FLATTENED Shape2(n, prod(rest)) form — accept and reshape
+            label = label.reshape(expected)
+        else:
+            raise MXNetError(
+                "SoftmaxOutput: label shape %s is inconsistent with data "
+                "shape %s (expected label %s)"
+                % (tuple(label.shape), tuple(data.shape), expected))
 
     @jax.custom_vjp
     def _fwd(d, l):
