@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# One-shot validation gate: everything the repo claims, in one command.
+#   bash tools/run_checks.sh
+set -e
+cd "$(dirname "$0")/.."
+
+echo "== native build"
+make -s
+echo "== C++ unit tests"
+make -s testcpp
+echo "== python suite (virtual 8-device CPU mesh)"
+python -m pytest tests/ -q
+echo "== multichip dryrun (8 virtual devices: dp/sp/tp + Module dp + pp/ep)"
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('MULTICHIP OK')"
+echo "== bench harness smoke (CPU)"
+MXTPU_BENCH_SMOKE=1 python bench.py
+echo "== amalgamation build + tests"
+python -m pytest tests/test_amalgamation.py -q
+echo "ALL CHECKS PASSED"
