@@ -272,8 +272,16 @@ def add_n(arrays):
 
 
 def elemwise_add(lhs, rhs):
-    """row_sparse + row_sparse -> row_sparse (reference
-    elemwise_binary_op_basic.cc sparse path)."""
+    """Sparse elemwise add (reference elemwise_binary_op_basic.cc):
+    row_sparse pairs stay on the native row-union path; csr pairs go
+    through the dense view and re-compress (the reference's
+    storage-fallback behaviour for combinations without a native
+    kernel, logged the same way)."""
+    if isinstance(lhs, CSRNDArray) or isinstance(rhs, CSRNDArray):
+        from ..config import storage_fallback_log
+        storage_fallback_log("elemwise_add(csr, csr)")
+        out = lhs.tostype("default") + rhs.tostype("default")
+        return cast_storage(out, "csr")
     return add_n([lhs, rhs])
 
 

@@ -254,3 +254,14 @@ def test_csr_dot_vector_rhs_falls_back_dense():
     v = np.arange(6, dtype=np.float32)
     out = sp.dot(csr, mx.nd.array(v))
     np.testing.assert_allclose(out.asnumpy(), dense @ v)
+
+
+def test_csr_elemwise_add():
+    """csr + csr keeps csr storage (reference elemwise add with the
+    storage-fallback path for kernel-less combinations)."""
+    d = np.random.RandomState(0).uniform(size=(4, 6)).astype(np.float32)
+    d[d < 0.5] = 0
+    c = sp.csr_matrix(d)
+    s = sp.elemwise_add(c, c)
+    assert s.stype == "csr"
+    np.testing.assert_allclose(s.asnumpy(), 2 * d, rtol=1e-6)
