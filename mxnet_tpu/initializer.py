@@ -51,7 +51,12 @@ class Initializer:
         if not isinstance(desc, str):
             raise TypeError("first argument must be a name string/InitDesc")
         if isinstance(desc, InitDesc) and desc.attrs.get("__init__"):
-            cls_name, kwargs = json.loads(desc.attrs["__init__"])
+            spec = desc.attrs["__init__"]
+            try:
+                cls_name, kwargs = json.loads(spec)
+            except (ValueError, TypeError):
+                # plain registry name (e.g. Variable(init='zeros'))
+                cls_name, kwargs = spec, {}
             create(cls_name, **kwargs)._init_weight(desc, arr)
             return
         # name-based dispatch (parity with reference rules)

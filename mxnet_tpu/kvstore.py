@@ -212,6 +212,8 @@ class KVStore:
         from jax.sharding import NamedSharding, PartitionSpec as P
         from jax.experimental import multihost_utils
 
+        self._assert_push_discipline(keys, merged_list)
+
         mesh = self._proc_mesh()
         nproc = mesh.devices.size
         local_dev = next(d for d in mesh.devices.flat
@@ -338,6 +340,43 @@ class KVStore:
                 result.append(_wrap(jnp.asarray(outs[i]), ctx))
                 i += 1
         return result
+
+    def _assert_push_discipline(self, keys, merged_list):
+        """Guard the SPMD collective discipline: every worker must push
+        the same (keys, storage types, shapes, dtypes) in the same order
+        — a mismatch would deadlock the batched collective or silently
+        mis-sum values. The reference's server tolerated arbitrary
+        arrival (kvstore_dist_server.h:173-310); SPMD cannot, so we fail
+        LOUDLY instead: hash the local push signature, allgather the
+        hashes (16 bytes/worker on the host), compare. Disable with
+        MXNET_KVSTORE_CHECK_PUSH=0 if the per-push host round-trip ever
+        matters (it is one tiny collective per batched push) — the flag
+        MUST be set uniformly on every worker: the guard's allgather is
+        itself a collective, so a worker that skips it while others run
+        it desynchronises the group exactly like the mismatch it
+        guards against."""
+        if os.environ.get("MXNET_KVSTORE_CHECK_PUSH", "1") == "0":
+            return
+        import hashlib
+        import numpy as np
+        from jax.experimental import multihost_utils
+        desc = repr([(str(k), getattr(m, "stype", "default"),
+                      tuple(m.shape), str(m.dtype))
+                     for k, m in zip(keys, merged_list)])
+        # int32 words: jax x64 is off, so int64 payloads would be
+        # silently truncated in the gather and never compare equal
+        h = np.frombuffer(hashlib.sha256(desc.encode()).digest()[:16],
+                          dtype=np.int32).copy()
+        all_h = np.asarray(multihost_utils.process_allgather(h))
+        if not (all_h == all_h[0]).all():
+            raise MXNetError(
+                "kvstore dist push discipline violated: workers pushed "
+                "different (keys, storage types, shapes, dtypes) in this "
+                "batched push. Every worker must push the same keys in "
+                "the same order (SPMD collective requirement; the "
+                "reference's parameter server tolerated arbitrary "
+                "arrival, this backend cannot). Local push signature: "
+                + desc)
 
     def barrier(self):
         """Block until every worker reaches this point (parity:

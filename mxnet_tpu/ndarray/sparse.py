@@ -70,12 +70,25 @@ class RowSparseNDArray(BaseSparseNDArray):
         super().__init__(None, ctx or current_context())
         self._sp_shape = tuple(shape)
         self._rsp_data = data
-        self._rsp_indices = indices.astype(jnp.int64)
+        self._rsp_indices = indices.astype(jnp.int32)
         self._stype = "row_sparse"
 
     def _make_dense(self):
+        # duplicate row ids (ill-formed but constructible input): XLA's
+        # scatter-set order is UNSPECIFIED, so pin last-stored-wins
+        # deterministically — cast_storage's dedup uses the same rule,
+        # keeping the two representations equal on every backend
+        idx = np.asarray(self._rsp_indices)
+        data = self._rsp_data
+        if idx.size and np.unique(idx).size != idx.size:
+            order = np.argsort(idx, kind="stable")
+            sorted_ids = idx[order]
+            keep = order[np.concatenate(
+                [sorted_ids[1:] != sorted_ids[:-1], [True]])]
+            idx = idx[keep]
+            data = data[jnp.asarray(keep.astype(np.int32))]
         return jnp.zeros(self._sp_shape, self._rsp_data.dtype) \
-            .at[self._rsp_indices.astype(jnp.int32)].set(self._rsp_data)
+            .at[jnp.asarray(idx.astype(np.int32))].set(data)
 
     @property
     def dtype(self):
@@ -96,7 +109,7 @@ class RowSparseNDArray(BaseSparseNDArray):
         if stype == "default":
             return _wrap(self._data, self._ctx)
         if stype == "csr":
-            return cast_storage(_wrap(self._data, self._ctx), "csr")
+            return cast_storage(self, "csr")  # O(stored-rows), no densify
         raise MXNetError("unknown stype %r" % stype)
 
     def copy(self):
@@ -123,9 +136,14 @@ class CSRNDArray(BaseSparseNDArray):
     def __init__(self, data, indices, indptr, shape, ctx=None):
         super().__init__(None, ctx or current_context())
         self._sp_shape = tuple(shape)
-        self._csr_data = jnp.asarray(np.asarray(data))
-        self._csr_indices = jnp.asarray(np.asarray(indices, np.int64))
-        self._csr_indptr = jnp.asarray(np.asarray(indptr, np.int64))
+        # device arrays pass through untouched — the sparse-native
+        # conversion paths must not bounce O(nnz) payloads via the host
+        self._csr_data = data if isinstance(data, jax.Array) \
+            else jnp.asarray(np.asarray(data))
+        self._csr_indices = indices if isinstance(indices, jax.Array) \
+            else jnp.asarray(np.asarray(indices, np.int64))
+        self._csr_indptr = indptr if isinstance(indptr, jax.Array) \
+            else jnp.asarray(np.asarray(indptr, np.int64))
         self._stype = "csr"
 
     def _row_ids(self):
@@ -167,7 +185,7 @@ class CSRNDArray(BaseSparseNDArray):
         if stype == "default":
             return _wrap(self._data, self._ctx)
         if stype == "row_sparse":
-            return cast_storage(_wrap(self._data, self._ctx), "row_sparse")
+            return cast_storage(self, "row_sparse")  # O(nnz), no densify
         raise MXNetError("unknown stype %r" % stype)
 
 
@@ -213,7 +231,7 @@ def zeros(stype, shape, ctx=None, dtype=None):
     dt = np.dtype(dtype or np.float32)
     if stype == "row_sparse":
         return RowSparseNDArray(jnp.zeros((0,) + tuple(shape[1:]), dt),
-                                jnp.zeros((0,), jnp.int64), tuple(shape), ctx)
+                                jnp.zeros((0,), jnp.int32), tuple(shape), ctx)
     if stype == "csr":
         return CSRNDArray(jnp.zeros((0,), dt), np.zeros((0,), np.int64),
                           np.zeros((shape[0] + 1,), np.int64), tuple(shape), ctx)
@@ -223,19 +241,60 @@ def zeros(stype, shape, ctx=None, dtype=None):
 
 def cast_storage(arr, stype):
     """Convert between storage types (parity: mx.nd.cast_storage,
-    reference src/operator/tensor/cast_storage.cc). Compression runs
-    device-side: reductions + one eager nonzero (row-major order, which
-    IS the CSR order) + gathers — no Python row loop."""
+    reference src/operator/tensor/cast_storage.cc, cast_storage-inl.h).
+    Compression runs device-side: reductions + one eager nonzero
+    (row-major order, which IS the CSR order) + gathers — no Python row
+    loop. Sparse<->sparse conversions work on the COMPRESSED
+    representation — O(stored_rows * ncols + nnz + nrows), never the
+    full dense shape (the 1M-row embedding case, SURVEY §2.3)."""
     if arr.stype == stype:
         return arr
     if stype == "default":
         return _wrap(arr._data, arr.context)
+    if isinstance(arr, RowSparseNDArray) and stype == "csr":
+        if len(arr.shape) != 2:
+            raise MXNetError("csr requires 2-D")
+        # compress only the stored block; sort by row id first (user-
+        # created rsp indices may be unsorted, CSR requires row order).
+        # Duplicate row ids: keep the LAST stored occurrence — the same
+        # scatter-set semantics the dense view (_make_dense) has, so the
+        # two representations agree. Index work is host-side numpy (the
+        # index vector is O(stored rows), tiny next to the value block).
+        idx_np = np.asarray(arr._rsp_indices)
+        order = np.argsort(idx_np, kind="stable")
+        if order.size:
+            sorted_ids = idx_np[order]
+            last_of_group = np.concatenate(
+                [sorted_ids[1:] != sorted_ids[:-1], [True]])
+            order = order[last_of_group]
+        ridx = jnp.asarray(idx_np[order].astype(np.int32))
+        block = arr._rsp_data[jnp.asarray(order.astype(np.int32))]
+        mask = block != 0
+        counts = jnp.sum(mask, axis=1, dtype=jnp.int32)
+        row_counts = jnp.zeros((arr.shape[0],), jnp.int32) \
+            .at[ridx].set(counts)
+        indptr = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                  jnp.cumsum(row_counts)])
+        r, c = jnp.nonzero(mask)  # eager; row-major == CSR order
+        return CSRNDArray(block[r, c], c.astype(jnp.int32), indptr,
+                          arr.shape, arr.context)
+    if isinstance(arr, CSRNDArray) and stype == "row_sparse":
+        counts = jnp.diff(arr._csr_indptr)
+        nz_rows = jnp.nonzero(counts > 0)[0]  # eager, already sorted
+        rows = arr._row_ids()
+        pos = jnp.searchsorted(nz_rows, rows)  # block slot per nnz
+        block = jnp.zeros((int(nz_rows.shape[0]),) + tuple(arr.shape[1:]),
+                          arr._csr_data.dtype) \
+            .at[pos, arr._csr_indices.astype(jnp.int32)] \
+            .set(arr._csr_data)
+        return RowSparseNDArray(block, nz_rows.astype(jnp.int32),
+                                arr.shape, arr.context)
     dense = arr._data
     if stype == "row_sparse":
         nz = jnp.any(dense != 0, axis=tuple(range(1, dense.ndim)))
         nz_rows = jnp.nonzero(nz)[0]
         return RowSparseNDArray(dense[nz_rows],
-                                nz_rows.astype(jnp.int64),
+                                nz_rows.astype(jnp.int32),
                                 dense.shape, arr.context)
     if stype == "csr":
         if dense.ndim != 2:
@@ -245,8 +304,8 @@ def cast_storage(arr, stype):
         indptr = jnp.concatenate([jnp.zeros((1,), counts.dtype),
                                   jnp.cumsum(counts)])
         rows, cols = jnp.nonzero(mask)
-        return CSRNDArray(dense[rows, cols], cols.astype(jnp.int64),
-                          indptr.astype(jnp.int64), dense.shape,
+        return CSRNDArray(dense[rows, cols], cols.astype(jnp.int32),
+                          indptr.astype(jnp.int32), dense.shape,
                           arr.context)
     raise MXNetError("unknown stype %r" % stype)
 
@@ -307,17 +366,36 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
         if r.shape[0] != inner:
             raise MXNetError("dot: shape mismatch %s x %s (transpose_a=%s)"
                              % (lhs.shape, tuple(r.shape), transpose_a))
-        if not transpose_a:
-            # out[i] = sum_k csr[i, k] * rhs[k]  -> segment-sum over rows
-            prod = vals[:, None] * r[cols]
-            out = jax.ops.segment_sum(prod, rows,
-                                      num_segments=lhs.shape[0])
-        else:
+        nrows, ncols = lhs.shape
+
+        def _pure(rr):
+            if not transpose_a:
+                # out[i] = sum_k csr[i, k] * rhs[k] -> segment-sum on rows
+                prod = vals[:, None] * rr[cols]
+                return (jax.ops.segment_sum(prod, rows,
+                                            num_segments=nrows),)
             # out[k] += csr[i, k] * rhs[i] -> scatter-add over columns
-            prod = vals[:, None] * r[rows]
-            out = jnp.zeros((lhs.shape[1], r.shape[1]), prod.dtype) \
-                .at[cols].add(prod)
-        return _wrap(out, lhs.context)
+            prod = vals[:, None] * rr[rows]
+            return (jnp.zeros((ncols, rr.shape[1]), prod.dtype)
+                    .at[cols].add(prod),)
+
+        # grad w.r.t. the DENSE rhs stays O(nnz * N): jax.vjp of the
+        # gather/segment-sum formulation is the transposed scatter —
+        # the reference's dot backward pair (dot-inl.h csr.T kernels).
+        # Grad w.r.t. the csr lhs is not produced (reference parity).
+        from .. import imperative as _imp
+        if (_imp.is_recording()
+                and getattr(rhs, "_tape", None) is not None):
+            (out,), vjp_fn = jax.vjp(_pure, r)
+            node = _imp.TapeNode(
+                [rhs._tape], vjp_fn,
+                [jax.ShapeDtypeStruct(out.shape, out.dtype)], "sparse_dot")
+            node.pure_fn = _pure
+            node.raw_inputs = [None]
+            res = _wrap(out, lhs.context)
+            res._tape = (node, 0)
+            return res
+        return _wrap(_pure(r)[0], lhs.context)
     if isinstance(lhs, BaseSparseNDArray) or isinstance(rhs, BaseSparseNDArray):
         from ..config import storage_fallback_log
         storage_fallback_log("dot(%s, %s)" % (getattr(lhs, "stype", "default"),

@@ -551,8 +551,11 @@ class BaseConvRNNCell(BaseRNNCell):
 
     def __init__(self, input_shape, num_hidden, h2h_kernel=(3, 3),
                  h2h_dilate=(1, 1), i2h_kernel=(3, 3), i2h_stride=(1, 1),
-                 i2h_pad=(1, 1), i2h_dilate=(1, 1), activation="tanh",
-                 prefix="", params=None, conv_layout="NCHW"):
+                 i2h_pad=(1, 1), i2h_dilate=(1, 1),
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 activation="tanh", prefix="", params=None,
+                 conv_layout="NCHW"):
         super().__init__(prefix=prefix, params=params)
         if h2h_kernel[0] % 2 == 0 or h2h_kernel[1] % 2 == 0:
             raise MXNetError("h2h_kernel must be odd (got %s)"
@@ -576,10 +579,12 @@ class BaseConvRNNCell(BaseRNNCell):
             pad=self._i2h_pad, dilate=self._i2h_dilate)
         shape = probe.infer_shape(data=self._input_shape)[1][0]
         self._state_shape = (0,) + tuple(shape[1:])
-        self._iW = self.params.get("i2h_weight")
-        self._hW = self.params.get("h2h_weight")
-        self._iB = self.params.get("i2h_bias")
-        self._hB = self.params.get("h2h_bias")
+        self._iW = self.params.get("i2h_weight",
+                                   init=i2h_weight_initializer)
+        self._hW = self.params.get("h2h_weight",
+                                   init=h2h_weight_initializer)
+        self._iB = self.params.get("i2h_bias", init=i2h_bias_initializer)
+        self._hB = self.params.get("h2h_bias", init=h2h_bias_initializer)
 
     @property
     def _num_gates(self):

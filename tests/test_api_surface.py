@@ -76,3 +76,76 @@ def test_python_frontend_surface_complete():
         if miss:
             missing[rel] = miss
     assert not missing, "reference API names unresolved: %s" % missing
+
+
+# Names whose arity deliberately diverges (each with the reason). The
+# check below only asserts the REQUIRED positional call shape, so these
+# are genuine divergences, not default-value differences.
+ARITY_SKIP = {
+    # reference Executor.__init__(handle, symbol, ctx, grad_req,
+    # group2ctx) wraps a C handle produced by MXExecutorBind; ours takes
+    # the bound arrays directly. Users construct executors through
+    # Symbol.bind/simple_bind on both sides (executor.py docstring).
+    ("executor.py", "Executor"),
+}
+
+
+def _ref_required_arity(node):
+    """Required positional-arg count of a reference def; for a class, of
+    its __init__ minus self. None when there is nothing to check (e.g.
+    class without explicit __init__)."""
+    if isinstance(node, ast.ClassDef):
+        init = next((m for m in node.body
+                     if isinstance(m, ast.FunctionDef)
+                     and m.name == "__init__"), None)
+        if init is None:
+            return None
+        a = init.args
+        drop_self = 1
+    else:
+        a = node.args
+        drop_self = 0
+    pos = list(getattr(a, "posonlyargs", [])) + list(a.args)
+    required = len(pos) - len(a.defaults) - drop_self
+    return max(required, 0)
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="no reference tree")
+def test_python_frontend_signatures_accept_reference_arity():
+    """Beyond name resolution: every resolved def must ACCEPT a call
+    with the reference's required positional arguments (sig.bind — a
+    static check, nothing is invoked). Catches stubs like
+    ``def foo(): raise`` that hasattr() cannot (round-3 verdict §weak 6)."""
+    import inspect
+    bad = {}
+    for rel, target in _pairs().items():
+        tree = ast.parse(open(os.path.join(REF, rel),
+                              errors="replace").read())
+        skips = SKIP.get(rel, {})
+        for node in tree.body:
+            if not isinstance(node, (ast.FunctionDef, ast.ClassDef)):
+                continue
+            name = node.name
+            if (name.startswith("_") or name in skips
+                    or (rel, name) in ARITY_SKIP):
+                continue
+            obj = getattr(target, name, None)
+            if obj is None:
+                continue  # the completeness test reports these
+            if not callable(obj):
+                bad.setdefault(rel, []).append("%s: not callable" % name)
+                continue
+            req = _ref_required_arity(node)
+            if req is None:
+                continue
+            try:
+                sig = inspect.signature(obj)
+            except (ValueError, TypeError):
+                continue  # C-level/builtin signature: nothing to check
+            try:
+                sig.bind(*([None] * req))
+            except TypeError as e:
+                bad.setdefault(rel, []).append(
+                    "%s: reference requires %d positional args, ours "
+                    "rejects them (%s; ours: %s)" % (name, req, e, sig))
+    assert not bad, "signature arity mismatches: %s" % bad

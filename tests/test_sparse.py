@@ -265,3 +265,114 @@ def test_csr_elemwise_add():
     s = sp.elemwise_add(c, c)
     assert s.stype == "csr"
     np.testing.assert_allclose(s.asnumpy(), 2 * d, rtol=1e-6)
+
+
+def test_cast_storage_sparse_to_sparse_native():
+    """rsp<->csr conversions run on the compressed representation —
+    correct for unsorted rsp indices and explicit zeros inside stored
+    rows, and the input's dense cache must stay cold (no densify).
+    Parity: reference cast_storage-inl.h sparse-to-sparse paths."""
+    # unsorted indices + a zero inside a stored row + an all-zero row
+    data = np.array([[0., 5., 0.], [1., 0., 2.], [0., 0., 0.]], np.float32)
+    rsp = sp.row_sparse_array((data, [4, 1, 2]), shape=(6, 3))
+    csr = rsp.tostype("csr")
+    back = csr.tostype("row_sparse")
+    # both conversions ran before any dense access: caches stay cold
+    assert rsp._dense_cache is None
+    assert csr._dense_cache is None
+    expect = np.zeros((6, 3), np.float32)
+    expect[[4, 1, 2]] = data
+    np.testing.assert_allclose(csr.asnumpy(), expect)
+    np.testing.assert_allclose(csr.indptr.asnumpy(),
+                               [0, 0, 2, 2, 2, 3, 3])
+    # all-zero stored row 2 disappears; row order is sorted
+    np.testing.assert_allclose(back.indices.asnumpy(), [1, 4])
+    np.testing.assert_allclose(back.data.asnumpy(),
+                               [[1., 0., 2.], [0., 5., 0.]])
+
+
+def test_csr_dot_backward_native():
+    """Autograd through the native csr.dot path: grad w.r.t. the dense
+    rhs is the transposed O(nnz) kernel, and the csr lhs is never
+    densified (reference dot-inl.h fwd/bwd kernel pair)."""
+    from mxnet_tpu import autograd
+    rng = np.random.RandomState(3)
+    lhs = ((rng.rand(6, 5) < 0.4) * rng.randn(6, 5)).astype(np.float32)
+    csr = sp.cast_storage(mx.nd.array(lhs), "csr")
+    csr._dense_cache = None  # cast from dense caches; reset for the probe
+    w = mx.nd.array(rng.randn(5, 4).astype(np.float32))
+    w.attach_grad()
+    with autograd.record():
+        out = sp.dot(csr, w)
+        loss = (out * out).sum()
+    loss.backward()
+    # d/dW sum((A W)^2) = 2 A^T (A W)
+    expect = 2.0 * lhs.T @ (lhs @ np.asarray(w.asnumpy()))
+    np.testing.assert_allclose(w.grad.asnumpy(), expect, rtol=1e-5,
+                               atol=1e-5)
+    assert csr._dense_cache is None
+
+    # transpose_a path: d/dW sum((A^T W)^2) = 2 A (A^T W)
+    w2 = mx.nd.array(rng.randn(6, 3).astype(np.float32))
+    w2.attach_grad()
+    with autograd.record():
+        out2 = sp.dot(csr, w2, transpose_a=True)
+        loss2 = (out2 * out2).sum()
+    loss2.backward()
+    expect2 = 2.0 * lhs @ (lhs.T @ np.asarray(w2.asnumpy()))
+    np.testing.assert_allclose(w2.grad.asnumpy(), expect2, rtol=1e-5,
+                               atol=1e-5)
+    assert csr._dense_cache is None
+
+
+def _live_device_bytes():
+    import jax
+    return sum(int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
+               for a in jax.live_arrays())
+
+
+def test_sparse_embedding_scale_o_nnz_memory():
+    """The SURVEY §2.3 case: a 1M x 512 embedding gradient. Every sparse
+    op in the chain (add_n, retain, rsp->csr->rsp) must stay O(nnz +
+    nrows-metadata): live device bytes may grow by a small fraction of
+    the 2 GB dense shape, and no dense cache may be populated."""
+    NROWS, NCOLS, NNZ = 1_000_000, 512, 1024
+    dense_bytes = NROWS * NCOLS * 4
+    rng = np.random.RandomState(0)
+    rows = np.unique(rng.randint(0, NROWS, NNZ * 2))[:NNZ].astype(np.int64)
+    vals = rng.randn(len(rows), NCOLS).astype(np.float32)
+    base = _live_device_bytes()
+    g1 = sp.row_sparse_array((vals, rows), shape=(NROWS, NCOLS))
+    g2 = sp.row_sparse_array((vals * 2.0, rows), shape=(NROWS, NCOLS))
+    s = sp.add_n([g1, g2])
+    kept = s.retain(rows[:16].tolist())
+    csr = s.tostype("csr")
+    back = csr.tostype("row_sparse")
+    import jax
+    jax.block_until_ready(back._rsp_data)
+    grown = _live_device_bytes() - base
+    assert grown < dense_bytes // 10, \
+        "sparse chain allocated %d bytes (dense would be %d)" % (
+            grown, dense_bytes)
+    for a in (g1, g2, s, kept, csr, back):
+        assert a._dense_cache is None
+    # spot-check values without densifying
+    np.testing.assert_allclose(s.data.asnumpy(), vals * 3.0, rtol=1e-6)
+    np.testing.assert_allclose(back.indices.asnumpy(), rows)
+    np.testing.assert_allclose(back.data.asnumpy(), vals * 3.0, rtol=1e-6)
+    np.testing.assert_allclose(kept.indices.asnumpy(), rows[:16])
+
+
+def test_cast_storage_duplicate_rsp_rows_matches_dense_view():
+    """Duplicate row ids in a user-built rsp: the csr conversion must
+    agree with the dense view's scatter-set semantics (last stored
+    occurrence wins), not scatter values into unrelated rows."""
+    rsp = sp.row_sparse_array(
+        (np.array([[1., 2.], [3., 4.], [5., 0.]], np.float32), [1, 1, 3]),
+        shape=(5, 2))
+    dense = rsp.asnumpy()
+    np.testing.assert_allclose(dense[1], [3., 4.])  # last wins
+    csr = sp.row_sparse_array(
+        (np.array([[1., 2.], [3., 4.], [5., 0.]], np.float32), [1, 1, 3]),
+        shape=(5, 2)).tostype("csr")
+    np.testing.assert_allclose(csr.asnumpy(), dense)

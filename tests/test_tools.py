@@ -265,3 +265,29 @@ def test_launch_dead_node_visibility(tmp_path):
               sys.executable, str(script)])
     assert p.returncode == 0, p.stderr + p.stdout
     assert p.stdout.count("DEAD OK") == 2
+
+
+def test_launch_push_discipline_mismatch_fails_loudly(tmp_path):
+    """Workers pushing DIFFERENT keys must die with a clear error, not
+    deadlock or silently corrupt (SPMD collective discipline; the
+    reference's server tolerated arbitrary arrival,
+    kvstore_dist_server.h:173-310 — we guard instead)."""
+    script = tmp_path / "bad_kv.py"
+    script.write_text(
+        "import sys; sys.path.insert(0, %r)\n" % REPO +
+        "import mxnet_tpu as mx\n"
+        "kv = mx.kv.create('dist_sync')\n"
+        "kv.init(['a', 'b'], [mx.nd.zeros((2, 2)), mx.nd.zeros((3,))])\n"
+        "kv.barrier()\n"
+        "# rank 0 pushes key 'a', rank 1 pushes key 'b': mismatch\n"
+        "key = 'a' if kv.rank == 0 else 'b'\n"
+        "val = mx.nd.ones((2, 2)) if kv.rank == 0 else mx.nd.ones((3,))\n"
+        "kv.push(key, val)\n"
+        "print('UNREACHABLE rank', kv.rank)\n")
+    p = _run([os.path.join(TOOLS, "launch.py"), "-n", "2",
+              "--force-cpu", "--port", "9421",
+              sys.executable, str(script)])
+    assert p.returncode != 0
+    combined = p.stdout + p.stderr
+    assert "discipline violated" in combined, combined
+    assert "UNREACHABLE" not in p.stdout
