@@ -164,20 +164,20 @@ def symbol_from_file(path):
 
 
 def symbol_arguments(sym):
-    return sym.list_arguments()
+    return _sym(sym).list_arguments()
 
 
 def symbol_outputs(sym):
-    return sym.list_outputs()
+    return _sym(sym).list_outputs()
 
 
 def symbol_aux(sym):
-    return sym.list_auxiliary_states()
+    return _sym(sym).list_auxiliary_states()
 
 
 def symbol_infer_shape(sym, names, shapes):
     known = dict(zip(names, [tuple(s) for s in shapes]))
-    arg_shapes, out_shapes, aux_shapes = sym.infer_shape(**known)
+    arg_shapes, out_shapes, aux_shapes = _sym(sym).infer_shape(**known)
     def clean(lst):
         return [list(s) if s is not None else [] for s in lst]
     complete = all(s is not None for s in arg_shapes + out_shapes + aux_shapes)
@@ -190,7 +190,7 @@ def executor_bind(sym, dev_type, dev_id, arg_nds, grad_nds, req_codes,
                   aux_nds):
     reqs = [_GRAD_REQ_BY_CODE[int(c)] for c in req_codes]
     grads = list(grad_nds)  # NULL C handles already arrive as None
-    return sym.bind(ctx=_ctx(dev_type, dev_id), args=list(arg_nds),
+    return _sym(sym).bind(ctx=_ctx(dev_type, dev_id), args=list(arg_nds),
                     args_grad=grads, grad_req=reqs,
                     aux_states=list(aux_nds) if aux_nds else None)
 
@@ -205,3 +205,661 @@ def executor_backward(ex, head_grads):
 
 def executor_outputs(ex):
     return list(ex.outputs)
+
+
+# ===========================================================================
+# Round-4 tranche: the rest of the high-traffic ABI (parity:
+# include/mxnet/c_api.h:359-1269 — runtime, NDArray extras, full MXSymbol
+# attr/compose surface, MXExecutorSimpleBind, MXDataIter*, MXKVStore*,
+# MXRecordIO*, MXAutograd*, CachedOp).
+# ===========================================================================
+
+# reference include/mxnet/ndarray.h:60-63 storage-type codes
+_STYPE_CODE = {"default": 0, "row_sparse": 1, "csr": 2}
+_DEV_CODE = {"cpu": 1, "gpu": 2, "tpu": 2, "cpu_pinned": 3}
+
+
+# -- runtime ----------------------------------------------------------------
+
+def version():
+    # reference MXNET_VERSION for 0.12.1 (MAJOR*10000 + MINOR*100 + PATCH)
+    return 1201
+
+
+def random_seed(seed):
+    mx.random.seed(int(seed))
+
+
+def notify_shutdown():
+    mx.nd.waitall()
+
+
+def set_num_omp_threads(n):
+    os.environ["OMP_NUM_THREADS"] = str(int(n))
+
+
+def engine_set_bulk_size(size):
+    from mxnet_tpu import engine
+    return int(engine.set_bulk_size(int(size)))
+
+
+def profiler_set_config(mode, filename):
+    mx.profiler.set_config(profile_all=bool(mode), filename=filename)
+
+
+def profiler_set_state(state):
+    mx.profiler.set_state("run" if int(state) else "stop")
+
+
+def profiler_dump():
+    mx.profiler.dump()
+
+
+# -- NDArray extras ---------------------------------------------------------
+
+def ndarray_create_none():
+    """Placeholder handle later filled by copy/load (reference
+    MXNDArrayCreateNone)."""
+    return mx.nd.zeros((0,))
+
+
+def ndarray_slice(nd, begin, end):
+    return nd[int(begin):int(end)]  # write-through view, like the reference
+
+
+def ndarray_at(nd, idx):
+    return nd[int(idx)]
+
+
+def ndarray_reshape(nd, dims):
+    return nd.reshape(tuple(int(d) for d in dims))
+
+
+def ndarray_get_context(nd):
+    ctx = nd.context
+    return _DEV_CODE.get(ctx.device_type, 1), int(ctx.device_id)
+
+
+def ndarray_storage_type(nd):
+    return _STYPE_CODE.get(getattr(nd, "stype", "default"), 0)
+
+
+def ndarray_get_grad(nd):
+    return nd.grad  # None -> NULL handle on the C side
+
+
+def ndarray_detach(nd):
+    return nd.detach()
+
+
+def ndarray_set_grad_state(nd, state):
+    nd._fresh_grad = bool(state)
+
+
+def ndarray_get_grad_state(nd):
+    return 1 if getattr(nd, "_fresh_grad", False) else 0
+
+
+def ndarray_sync_copy_from_ndarray(dst, src, i):
+    if int(i) >= 0:
+        src = src[int(i)]
+    dst._set_data(src._data)
+
+
+def ndarray_save_raw_bytes(nd):
+    """Self-describing single-array blob (round-trips through
+    ndarray_load_from_raw_bytes; the reference's raw format is its own
+    binary layout, mirrored in role, not in bytes). Plain struct-packed
+    header + raw data — NO pickle: this is the model-blob entry point
+    and must not give untrusted bytes a code-execution surface."""
+    import struct
+    arr = np.ascontiguousarray(nd.asnumpy())
+    dt = str(arr.dtype).encode()
+    return (struct.pack("<8sB", b"MXTPRAW2", len(dt)) + dt
+            + struct.pack("<B", arr.ndim)
+            + struct.pack("<%dq" % arr.ndim, *arr.shape)
+            + arr.tobytes())
+
+
+def ndarray_load_from_raw_bytes(raw):
+    import struct
+    raw = bytes(raw)
+    if raw[:8] != b"MXTPRAW2":
+        raise MXNetError("not a raw NDArray blob")
+    off = 8
+    (dtlen,) = struct.unpack_from("<B", raw, off)
+    off += 1
+    dt = raw[off:off + dtlen].decode("ascii")
+    off += dtlen
+    (ndim,) = struct.unpack_from("<B", raw, off)
+    off += 1
+    shape = struct.unpack_from("<%dq" % ndim, raw, off)
+    off += 8 * ndim
+    data = np.frombuffer(raw, dtype=np.dtype(dt), offset=off)
+    return mx.nd.array(data.reshape(shape).copy())
+
+
+# -- symbol: atomic creation + compose --------------------------------------
+
+class _AtomicSymbol:
+    """An op symbol created but not yet composed with inputs (the
+    reference's nnvm node with params only; MXSymbolCompose supplies
+    inputs in place)."""
+
+    def __init__(self, op_name, params):
+        self.op_name = op_name
+        self.params = params
+        self.composed = None
+
+
+def _sym(s):
+    """Unwrap a SymbolHandle: composed atomic symbols delegate to their
+    composition result."""
+    if isinstance(s, _AtomicSymbol):
+        if s.composed is None:
+            raise MXNetError(
+                "atomic symbol %r has not been composed with inputs yet "
+                "(call MXSymbolCompose first)" % s.op_name)
+        return s.composed
+    return s
+
+
+def symbol_create_atomic(op_name, keys, vals):
+    if not op_exists(op_name):
+        raise MXNetError("operator %r is not registered" % op_name)
+    return _AtomicSymbol(op_name,
+                         {k: _parse_val(v) for k, v in zip(keys, vals)})
+
+
+def symbol_compose(s, name, keys, args):
+    """In-place composition (parity: MXSymbolCompose). ``args`` are
+    Symbol handles; ``keys`` may be empty for positional composition."""
+    args = [_sym(a) for a in args]
+    if isinstance(s, _AtomicSymbol):
+        import mxnet_tpu.symbol as sym_mod
+        fn = getattr(sym_mod, s.op_name, None)
+        params = dict(s.params)
+        if name:
+            params["name"] = name
+        if fn is None:
+            raise MXNetError("no symbol constructor for %r" % s.op_name)
+        if keys:
+            s.composed = fn(**dict(zip(keys, args)), **params)
+        else:
+            s.composed = fn(*args, **params)
+        return
+    target = _sym(s)
+    if keys:
+        composed = target(name=name, **dict(zip(keys, args)))
+    else:
+        composed = target(*args, name=name)
+    target._outputs[:] = composed._outputs
+
+
+def symbol_create_variable(name):
+    return mx.sym.Variable(name)
+
+
+def symbol_create_group(symbols):
+    return mx.sym.Group([_sym(s) for s in symbols])
+
+
+def symbol_save_to_file(s, fname):
+    _sym(s).save(fname)
+
+
+def symbol_to_json(s):
+    return _sym(s).tojson()
+
+
+def symbol_copy(s):
+    import copy
+    return copy.deepcopy(_sym(s))
+
+
+def symbol_print(s):
+    return _sym(s).debug_str()
+
+
+def symbol_get_name(s):
+    n = _sym(s).name
+    return (n if n is not None else "", n is not None)
+
+
+def symbol_get_attr(s, key):
+    v = _sym(s).attr(key)
+    return (v if v is not None else "", v is not None)
+
+
+def symbol_set_attr(s, key, value):
+    if isinstance(s, _AtomicSymbol) and s.composed is None:
+        s.params["__%s__" % key if not key.startswith("__") else key] = value
+        return
+    _sym(s)._set_attr(**{key: value})
+
+
+def symbol_list_attr(s):
+    """Flat [key, value, ...] pairs, recursive form uses the
+    'nodename$key' convention the reference uses (c_api_symbolic.cc)."""
+    out = []
+    for node_name, attrs in _sym(s).attr_dict().items():
+        for k, v in attrs.items():
+            out.append("%s$%s" % (node_name, k))
+            out.append(str(v))
+    return out
+
+
+def symbol_list_attr_shallow(s):
+    sym = _sym(s)
+    out = []
+    node = sym._outputs[0][0]
+    for k, v in node._extra_attrs.items():
+        out.append(k)
+        out.append(str(v))
+    return out
+
+
+def symbol_get_internals(s):
+    return _sym(s).get_internals()
+
+
+def symbol_get_children(s):
+    return _sym(s).get_children()  # may be None -> NULL handle
+
+
+def symbol_get_output(s, index):
+    return _sym(s)[int(index)]
+
+
+def symbol_infer_shape_partial(s, names, shapes):
+    known = {n: tuple(sh) for n, sh in zip(names, shapes)}
+    arg_s, out_s, aux_s = _sym(s).infer_shape_partial(**known)
+
+    def clean(lst):
+        return [list(x) if x is not None else [] for x in (lst or [])]
+    complete = bool(arg_s) and all(
+        x is not None for x in arg_s + out_s + aux_s)
+    return clean(arg_s), clean(out_s), clean(aux_s), complete
+
+
+def symbol_infer_type(s, names, type_codes):
+    known = {n: _DTYPE_BY_CODE[int(c)] for n, c in zip(names, type_codes)
+             if int(c) != -1}
+    arg_t, out_t, aux_t = _sym(s).infer_type(**known)
+
+    def codes(lst):
+        return [_CODE_BY_DTYPE[np.dtype(t)] if t is not None else -1
+                for t in (lst or [])]
+    complete = bool(arg_t) and all(
+        t is not None for t in arg_t + out_t + aux_t)
+    return codes(arg_t), codes(out_t), codes(aux_t), complete
+
+
+def op_info(name):
+    """(name, description, arg_names, arg_types, arg_descs,
+    key_var_num_args) from the registry (parity:
+    MXSymbolGetAtomicSymbolInfo reading nnvm op attrs)."""
+    op = get_op(name)
+    doc = (op.fn.__doc__ or "").strip()
+    arg_names = list(op.arg_names)
+    arg_types = ["NDArray-or-Symbol"] * len(arg_names)
+    extra = sorted(k for k in op.defaults if k not in arg_names)
+    for k in extra:
+        arg_names.append(k)
+        arg_types.append("string, optional")
+    key_var = "num_args" if "num_args" in op.defaults else ""
+    return (name, doc, arg_names, arg_types, [""] * len(arg_names), key_var)
+
+
+# -- executor extras --------------------------------------------------------
+
+def executor_simple_bind(s, dev_type, dev_id, g2c_keys, g2c_dev_types,
+                         g2c_dev_ids, req_names, req_types, shape_names,
+                         shapes, dtype_names, dtype_codes, stype_names,
+                         stype_codes):
+    """(parity: MXExecutorSimpleBind, c_api_executor.cc:169). Returns
+    (executor, in_args, arg_grads, aux_states). Shared-buffer reuse is
+    accepted and ignored at the C layer (PJRT owns allocation; reuse is
+    an allocator hint in the reference)."""
+    sym = _sym(s)
+    if req_names:
+        grad_req = dict(zip(req_names, req_types))
+    elif req_types:
+        grad_req = req_types[0]
+    else:
+        grad_req = "write"
+    type_dict = {n: _DTYPE_BY_CODE[int(c)]
+                 for n, c in zip(dtype_names, dtype_codes)}
+    group2ctx = {k: _ctx(t, i)
+                 for k, t, i in zip(g2c_keys, g2c_dev_types, g2c_dev_ids)}
+    kwargs = {n: tuple(int(x) for x in sh)
+              for n, sh in zip(shape_names, shapes)}
+    ex = sym.simple_bind(ctx=_ctx(dev_type, dev_id), grad_req=grad_req,
+                         type_dict=type_dict or None,
+                         group2ctx=group2ctx or None, **kwargs)
+    return (ex, list(ex.arg_arrays),
+            [g for g in ex.grad_arrays], list(ex.aux_arrays))
+
+
+def executor_print(ex):
+    outs = ", ".join("%s %s" % (o.shape, o.dtype) for o in ex.outputs)
+    return "Executor(outputs=[%s])" % outs
+
+
+# -- CachedOp ---------------------------------------------------------------
+
+class _CCachedOp:
+    """Imperative invocation of a symbol graph with executor reuse
+    (parity: reference CachedOp, imperative/cached_op.cc — bind once per
+    input signature, then re-run)."""
+
+    def __init__(self, s):
+        self.sym = _sym(s)
+        self.arg_names = self.sym.list_arguments()
+        self.aux_names = self.sym.list_auxiliary_states()
+        self._cache = {}
+
+    def __call__(self, inputs):
+        n_args = len(self.arg_names)
+        if len(inputs) != n_args + len(self.aux_names):
+            raise MXNetError(
+                "CachedOp expects %d inputs (%d args + %d aux), got %d"
+                % (n_args + len(self.aux_names), n_args,
+                   len(self.aux_names), len(inputs)))
+        args = inputs[:n_args]
+        aux = inputs[n_args:]
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in inputs)
+        ex = self._cache.get(sig)
+        if ex is None:
+            # bind PRIVATE copies: the executor keeps its bound arrays,
+            # and later cache-hit writes must never mutate caller inputs
+            ex = self.sym.bind(ctx=args[0].context if args else mx.cpu(),
+                               args=[a.copy() for a in args],
+                               args_grad=None, grad_req="null",
+                               aux_states=[a.copy() for a in aux]
+                               if aux else None)
+            self._cache[sig] = ex
+        else:
+            for dst, src in zip(ex.arg_arrays, args):
+                dst._set_data(src._data)
+            for dst, src in zip(ex.aux_arrays, aux):
+                dst._set_data(src._data)
+        return list(ex.forward(is_train=False))
+
+
+def cached_op_create(s):
+    return _CCachedOp(s)
+
+
+def cached_op_invoke(cop, inputs):
+    return cop(list(inputs))
+
+
+# -- autograd ---------------------------------------------------------------
+
+def autograd_set_recording(flag):
+    from mxnet_tpu import imperative
+    return 1 if imperative.set_recording(bool(flag)) else 0
+
+
+def autograd_set_training(flag):
+    from mxnet_tpu import imperative
+    return 1 if imperative.set_training(bool(flag)) else 0
+
+
+def autograd_is_recording():
+    from mxnet_tpu import imperative
+    return 1 if imperative.is_recording() else 0
+
+
+def autograd_is_training():
+    from mxnet_tpu import imperative
+    return 1 if imperative.is_training() else 0
+
+
+def autograd_mark_variables(variables, req_codes, grads):
+    from mxnet_tpu import autograd
+    reqs = [_GRAD_REQ_BY_CODE[int(c)] for c in req_codes]
+    autograd.mark_variables(list(variables), list(grads), reqs)
+
+
+def autograd_backward(outputs, ograds, retain_graph, is_train):
+    from mxnet_tpu import autograd
+    heads = [o for o in ograds] if ograds else None
+    autograd.backward(list(outputs), head_grads=heads,
+                      retain_graph=bool(retain_graph),
+                      train_mode=bool(is_train))
+
+
+def autograd_backward_ex(outputs, ograds, variables, retain_graph,
+                         create_graph, is_train):
+    """(parity: MXAutogradBackwardEx). With ``variables``, returns their
+    grads + stype codes instead of writing into attached buffers."""
+    from mxnet_tpu import autograd
+    heads = list(ograds) if ograds else None
+    if not variables:
+        autograd.backward(list(outputs), head_grads=heads,
+                          retain_graph=bool(retain_graph),
+                          train_mode=bool(is_train))
+        return [], []
+    grads = autograd.grad(list(outputs), list(variables), head_grads=heads,
+                          retain_graph=bool(retain_graph),
+                          create_graph=bool(create_graph),
+                          train_mode=bool(is_train))
+    return list(grads), [ndarray_storage_type(g) for g in grads]
+
+
+def autograd_get_symbol(nd):
+    from mxnet_tpu import autograd
+    return autograd.get_symbol(nd)
+
+
+# -- data iterators ---------------------------------------------------------
+
+def _iter_registry():
+    return {
+        "MNISTIter": mx.io.MNISTIter,
+        "CSVIter": mx.io.CSVIter,
+        "LibSVMIter": mx.io.LibSVMIter,
+        "ImageRecordIter": mx.io.ImageRecordIter,
+    }
+
+
+def list_data_iters():
+    return sorted(_iter_registry())
+
+
+def data_iter_info(name):
+    cls = _iter_registry()[name]
+    import inspect
+    doc = (cls.__doc__ or "").strip()
+    try:
+        params = [p for p in inspect.signature(cls).parameters
+                  if p not in ("args", "kwargs")]
+    except (ValueError, TypeError):
+        params = []
+    return (name, doc, params, ["string"] * len(params),
+            [""] * len(params))
+
+
+class _CDataIter:
+    """Iterator handle: owns the python iterator + the current batch."""
+
+    def __init__(self, it):
+        self.it = it
+        self.batch = None
+
+    def next(self):
+        try:
+            self.batch = self.it.next()
+            return 1
+        except StopIteration:
+            self.batch = None
+            return 0
+
+
+def data_iter_create(name, keys, vals):
+    cls = _iter_registry()[name]
+    params = {k: _parse_val(v) for k, v in zip(keys, vals)}
+    return _CDataIter(cls(**params))
+
+
+def data_iter_next(h):
+    return h.next()
+
+
+def data_iter_before_first(h):
+    h.it.reset()
+    h.batch = None
+
+
+def data_iter_get_data(h):
+    if h.batch is None:
+        raise MXNetError("no current batch (call MXDataIterNext first)")
+    return h.batch.data[0]
+
+
+def data_iter_get_label(h):
+    if h.batch is None:
+        raise MXNetError("no current batch (call MXDataIterNext first)")
+    return h.batch.label[0]
+
+
+def data_iter_get_pad(h):
+    if h.batch is None:
+        raise MXNetError("no current batch (call MXDataIterNext first)")
+    return int(h.batch.pad or 0)
+
+
+def data_iter_get_index(h):
+    if h.batch is None:
+        raise MXNetError("no current batch (call MXDataIterNext first)")
+    idx = getattr(h.batch, "index", None)
+    return [int(i) for i in idx] if idx is not None else []
+
+
+# -- kvstore ----------------------------------------------------------------
+
+def init_ps_env(keys, vals):
+    for k, v in zip(keys, vals):
+        os.environ[str(k)] = str(v)
+
+
+def kvstore_create(type_str):
+    return mx.kv.create(type_str or "local")
+
+
+def kvstore_init(kv, keys, vals):
+    kv.init(list(keys), list(vals))
+
+
+def kvstore_push(kv, keys, vals, priority):
+    kv.push(list(keys), list(vals), priority=int(priority))
+
+
+def kvstore_pull(kv, keys, vals, priority):
+    kv.pull(list(keys), out=list(vals), priority=int(priority))
+
+
+def kvstore_pull_row_sparse(kv, keys, vals, row_ids, priority):
+    kv.row_sparse_pull(list(keys), out=list(vals), priority=int(priority),
+                       row_ids=list(row_ids))
+
+
+def kvstore_set_gradient_compression(kv, keys, vals):
+    kv.set_gradient_compression(
+        {k: _parse_val(v) for k, v in zip(keys, vals)})
+
+
+def kvstore_set_updater(kv, fn_addr, handle_addr, str_fn_addr=0):
+    """Install a C callback updater (parity: MXKVStoreSetUpdater/Ex).
+    typedef void (MXKVStoreUpdater)(int key, NDArrayHandle recv,
+    NDArrayHandle local, void* handle); the Ex form adds
+    MXKVStoreStrUpdater(const char* key, ...) for string keys. Handles
+    passed to the callback are NEW references (the callback frees them
+    with MXNDArrayFree, the reference's ownership contract)."""
+    cb = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_void_p,
+                          ctypes.c_void_p, ctypes.c_void_p)(int(fn_addr))         if fn_addr else None
+    str_cb = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p,
+                              ctypes.c_void_p,
+                              ctypes.c_void_p)(int(str_fn_addr))         if str_fn_addr else None
+
+    def updater(key, recv, local):
+        handle = handle_addr if handle_addr else None
+        is_int_key = isinstance(key, int)
+        if is_int_key and cb is None or not is_int_key and str_cb is None:
+            raise MXNetError(
+                "no C updater registered for %s keys (use "
+                "MXKVStoreSetUpdaterEx to install both forms)"
+                % ("int" if is_int_key else "string"))
+        ctypes.pythonapi.Py_IncRef(ctypes.py_object(recv))
+        ctypes.pythonapi.Py_IncRef(ctypes.py_object(local))
+        if is_int_key:
+            cb(int(key), id(recv), id(local), handle)
+        else:
+            str_cb(str(key).encode(), id(recv), id(local), handle)
+
+    kv._set_updater(updater)
+
+
+def kvstore_get_type(kv):
+    return kv.type
+
+
+def kvstore_get_rank(kv):
+    return int(kv.rank)
+
+
+def kvstore_get_group_size(kv):
+    return int(kv.num_workers)
+
+
+def kvstore_barrier(kv):
+    kv.barrier()
+
+
+def kvstore_send_command(kv, cmd_id, cmd_body):
+    kv.send_command_to_servers(int(cmd_id), cmd_body)
+
+
+def kvstore_num_dead_node(kv, node_id, timeout_sec):
+    return int(kv.num_dead_node(int(node_id), timeout=int(timeout_sec)))
+
+
+def kvstore_run_server(kv, controller_addr, handle_addr):
+    """SPMD has no server processes (kvstore_server.py role-absorber);
+    accept the controller callback for ABI parity and return — the
+    reference blocks here running the request loop."""
+    return None
+
+
+# -- recordio ---------------------------------------------------------------
+
+def recordio_writer_create(uri):
+    from mxnet_tpu import recordio
+    return recordio.MXRecordIO(uri, "w")
+
+
+def recordio_reader_create(uri):
+    from mxnet_tpu import recordio
+    return recordio.MXRecordIO(uri, "r")
+
+
+def recordio_close(h):
+    h.close()
+
+
+def recordio_write_record(h, ptr, size):
+    h.write(ctypes.string_at(int(ptr), int(size)))
+
+
+def recordio_read_record(h):
+    return h.read()  # None at EOF -> NULL buf
+
+
+def recordio_tell(h):
+    return int(h.tell())
+
+
+def recordio_seek(h, pos):
+    h.seek(int(pos))

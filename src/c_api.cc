@@ -17,9 +17,16 @@
 //   MXSymbolCreateFromJSON/CreateFromFile/Free,
 //   MXSymbolListArguments/ListOutputs/ListAuxiliaryStates,
 //   MXSymbolInferShape, MXExecutorBind/Forward/Backward/Outputs/Free.
+// Round-4 tranche (reference c_api.h:359-1269): runtime knobs +
+// profiler, NDArray slice/at/reshape/context/grad/raw-bytes, the full
+// MXSymbol attr/compose/atomic surface, MXExecutorSimpleBind/BackwardEx,
+// MXDataIter*, MXKVStore* (incl. C-callback updater), MXRecordIO*,
+// MXAutograd*, CachedOp — each backed by mxnet_tpu/c_api_impl.py and
+// exercised from tests/test_c_api.py via ctypes.
 
 #include <Python.h>
 
+#include <cstdarg>
 #include <cstdint>
 #include <cstring>
 #include <string>
@@ -557,6 +564,1374 @@ int MXExecutorFree(ExecutorHandle handle) {
   Py_XDECREF(static_cast<PyObject*>(handle));
   PyGILState_Release(gil);
   return 0;
+}
+
+}  // extern "C"
+
+// ===========================================================================
+// Round-4 tranche
+// ===========================================================================
+
+typedef void* KVStoreHandle;
+typedef void* DataIterHandle;
+typedef void* DataIterCreator;
+typedef void* RecordIOHandle;
+typedef void* CachedOpHandle;
+typedef void (*MXKVStoreUpdater)(int, NDArrayHandle, NDArrayHandle, void*);
+typedef void (*MXKVStoreStrUpdater)(const char*, NDArrayHandle, NDArrayHandle,
+                                    void*);
+typedef void (*MXKVStoreServerController)(int, const char*, void*);
+
+namespace {
+
+// extra thread-local return stores (one call may hand out several lists)
+thread_local std::string g_ret_str;
+thread_local std::string g_ret_str2;
+thread_local std::vector<std::string> g_info_store;
+thread_local std::vector<const char*> g_info_ptrs[3];
+thread_local std::vector<int> g_int_buf;
+thread_local std::vector<uint64_t> g_u64_buf;
+thread_local std::string g_rec_buf;
+thread_local std::vector<void*> g_handle_buf2;
+
+
+// call an impl fn; ignore result
+int CallVoid(const char* name, PyObject* args) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* r = CallImpl(name, args);
+  int rc = r != nullptr ? 0 : -1;
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+// call an impl fn; transfer the new python object to a handle
+int CallHandle(const char* name, PyObject* args, void** out) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* r = CallImpl(name, args);
+  int rc = -1;
+  if (r != nullptr) {
+    if (r == Py_None) {  // e.g. get_children of a leaf -> NULL handle
+      Py_DECREF(r);
+      *out = nullptr;
+    } else {
+      *out = r;
+    }
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+
+// Variadic forms: acquire the GIL BEFORE building the args tuple.
+// ctypes/other FFI callers invoke these functions WITHOUT the GIL, so a
+// Py_BuildValue evaluated in the caller's argument list would touch the
+// interpreter unlocked (the round-4 segfault).
+int CallIntV(const char* name, int* out, const char* fmt, ...) {
+  PyGILState_STATE gil = EnsurePython();
+  va_list ap;
+  va_start(ap, fmt);
+  PyObject* args = Py_VaBuildValue(fmt, ap);
+  va_end(ap);
+  PyObject* r = CallImpl(name, args);
+  int rc = -1;
+  if (r != nullptr) {
+    *out = static_cast<int>(PyLong_AsLong(r));
+    Py_DECREF(r);
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int CallVoidV(const char* name, const char* fmt, ...) {
+  PyGILState_STATE gil = EnsurePython();
+  va_list ap;
+  va_start(ap, fmt);
+  PyObject* args = Py_VaBuildValue(fmt, ap);
+  va_end(ap);
+  PyObject* r = CallImpl(name, args);
+  int rc = r != nullptr ? 0 : -1;
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int CallHandleV(const char* name, void** out, const char* fmt, ...) {
+  PyGILState_STATE gil = EnsurePython();
+  va_list ap;
+  va_start(ap, fmt);
+  PyObject* args = Py_VaBuildValue(fmt, ap);
+  va_end(ap);
+  PyObject* r = CallImpl(name, args);
+  int rc = -1;
+  if (r != nullptr) {
+    if (r == Py_None) {
+      Py_DECREF(r);
+      *out = nullptr;
+    } else {
+      *out = r;
+    }
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int CallStrV(const char* name, const char** out, const char* fmt, ...) {
+  PyGILState_STATE gil = EnsurePython();
+  va_list ap;
+  va_start(ap, fmt);
+  PyObject* args = Py_VaBuildValue(fmt, ap);
+  va_end(ap);
+  PyObject* r = CallImpl(name, args);
+  int rc = -1;
+  if (r != nullptr) {
+    const char* c = PyUnicode_AsUTF8(r);
+    g_ret_str = c ? c : "";
+    *out = g_ret_str.c_str();
+    Py_DECREF(r);
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int StrSuccessPairV(const char* fn, const char** out, int* success,
+                    const char* fmt, ...) {
+  PyGILState_STATE gil = EnsurePython();
+  va_list ap;
+  va_start(ap, fmt);
+  PyObject* args = Py_VaBuildValue(fmt, ap);
+  va_end(ap);
+  PyObject* r = CallImpl(fn, args);
+  int rc = -1;
+  if (r != nullptr) {
+    const char* c = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+    g_ret_str2 = c ? c : "";
+    *out = g_ret_str2.c_str();
+    *success = PyObject_IsTrue(PyTuple_GetItem(r, 1));
+    Py_DECREF(r);
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+PyObject* IntList(const int* arr, mx_uint n) {
+  PyObject* lst = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i)
+    PyList_SetItem(lst, i, PyLong_FromLong(arr ? arr[i] : 0));
+  return lst;
+}
+
+PyObject* UIntList(const mx_uint* arr, mx_uint n) {
+  PyObject* lst = PyList_New(n);
+  for (mx_uint i = 0; i < n; ++i)
+    PyList_SetItem(lst, i, PyLong_FromUnsignedLong(arr ? arr[i] : 0));
+  return lst;
+}
+
+// shape groups packed as (names, list-of-shape-lists) from ind_ptr layout
+PyObject* ShapeLists(mx_uint num_args, const mx_uint* ind_ptr,
+                     const mx_uint* shape_data) {
+  PyObject* shapes = PyList_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    mx_uint lo = ind_ptr[i], hi = ind_ptr[i + 1];
+    PyObject* s = PyList_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j)
+      PyList_SetItem(s, j - lo, PyLong_FromUnsignedLong(shape_data[j]));
+    PyList_SetItem(shapes, i, s);
+  }
+  return shapes;
+}
+
+// unpack the 3-group shape tuple exactly like MXSymbolInferShape does
+int UnpackShapeGroups(PyObject* r, mx_uint* in_shape_size,
+                      const mx_uint** in_shape_ndim,
+                      const mx_uint*** in_shape_data, mx_uint* out_shape_size,
+                      const mx_uint** out_shape_ndim,
+                      const mx_uint*** out_shape_data, mx_uint* aux_shape_size,
+                      const mx_uint** aux_shape_ndim,
+                      const mx_uint*** aux_shape_data, int* complete) {
+  g_shape_store.clear();
+  g_shape_ptrs.clear();
+  g_ndim_buf.clear();
+  mx_uint sizes[3];
+  size_t offsets[4] = {0, 0, 0, 0};
+  for (int grp = 0; grp < 3; ++grp) {
+    PyObject* lst = PyTuple_GetItem(r, grp);
+    Py_ssize_t n = PyList_Size(lst);
+    sizes[grp] = static_cast<mx_uint>(n);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* s = PyList_GetItem(lst, i);
+      Py_ssize_t nd = PyList_Size(s);
+      std::vector<mx_uint> v(nd);
+      for (Py_ssize_t j = 0; j < nd; ++j)
+        v[j] = static_cast<mx_uint>(PyLong_AsLong(PyList_GetItem(s, j)));
+      g_shape_store.push_back(std::move(v));
+      g_ndim_buf.push_back(static_cast<mx_uint>(nd));
+    }
+    offsets[grp + 1] = g_shape_store.size();
+  }
+  for (auto& v : g_shape_store) g_shape_ptrs.push_back(v.data());
+  *in_shape_size = sizes[0];
+  *in_shape_ndim = g_ndim_buf.data() + offsets[0];
+  *in_shape_data = g_shape_ptrs.data() + offsets[0];
+  *out_shape_size = sizes[1];
+  *out_shape_ndim = g_ndim_buf.data() + offsets[1];
+  *out_shape_data = g_shape_ptrs.data() + offsets[1];
+  *aux_shape_size = sizes[2];
+  *aux_shape_ndim = g_ndim_buf.data() + offsets[2];
+  *aux_shape_data = g_shape_ptrs.data() + offsets[2];
+  *complete = PyObject_IsTrue(PyTuple_GetItem(r, 3));
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- runtime knobs --------------------------------------------------------
+
+int MXGetVersion(int* out) { return CallIntV("version", out, "()"); }
+
+int MXRandomSeed(int seed) {
+  return CallVoidV("random_seed", "(i)", seed);
+}
+
+int MXNotifyShutdown() {
+  return CallVoidV("notify_shutdown", "()");
+}
+
+int MXSetNumOMPThreads(int thread_num) {
+  return CallVoidV("set_num_omp_threads", "(i)", thread_num);
+}
+
+int MXEngineSetBulkSize(int bulk_size, int* prev_bulk_size) {
+  return CallIntV("engine_set_bulk_size", prev_bulk_size, "(i)", bulk_size);
+}
+
+int MXSetProfilerConfig(int mode, const char* filename) {
+  return CallVoidV("profiler_set_config", "(is)", mode, filename);
+}
+
+int MXSetProfilerState(int state) {
+  return CallVoidV("profiler_set_state", "(i)", state);
+}
+
+int MXDumpProfile() { return CallVoidV("profiler_dump", "()"); }
+
+// ---- NDArray extras -------------------------------------------------------
+
+int MXNDArrayCreateNone(NDArrayHandle* out) {
+  return CallHandleV("ndarray_create_none", out, "()");
+}
+
+int MXNDArraySlice(NDArrayHandle handle, mx_uint begin, mx_uint end,
+                   NDArrayHandle* out) {
+  return CallHandleV("ndarray_slice", out, "(OII)",
+                     static_cast<PyObject*>(handle), begin, end);
+}
+
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle* out) {
+  return CallHandleV("ndarray_at", out, "(OI)",
+                     static_cast<PyObject*>(handle), idx);
+}
+
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, int* dims,
+                     NDArrayHandle* out) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* d = IntList(dims, ndim);
+  PyObject* a = Py_BuildValue("(OO)", static_cast<PyObject*>(handle), d);
+  Py_DECREF(d);
+  PyGILState_Release(gil);
+  return CallHandle("ndarray_reshape", a, out);
+}
+
+int MXNDArrayGetContext(NDArrayHandle handle, int* out_dev_type,
+                        int* out_dev_id) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* r = CallImpl("ndarray_get_context",
+                         Py_BuildValue("(O)",
+                                       static_cast<PyObject*>(handle)));
+  int rc = -1;
+  if (r != nullptr) {
+    *out_dev_type = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 0)));
+    *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 1)));
+    Py_DECREF(r);
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXNDArrayGetStorageType(NDArrayHandle handle, int* out_storage_type) {
+  return CallIntV("ndarray_storage_type", out_storage_type, "(O)",
+                  static_cast<PyObject*>(handle));
+}
+
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle* out) {
+  return CallHandleV("ndarray_get_grad", out, "(O)",
+                     static_cast<PyObject*>(handle));
+}
+
+int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle* out) {
+  return CallHandleV("ndarray_detach", out, "(O)",
+                     static_cast<PyObject*>(handle));
+}
+
+int MXNDArraySetGradState(NDArrayHandle handle, int state) {
+  return CallVoidV("ndarray_set_grad_state", "(Oi)",
+                   static_cast<PyObject*>(handle), state);
+}
+
+int MXNDArrayGetGradState(NDArrayHandle handle, int* out) {
+  return CallIntV("ndarray_get_grad_state", out, "(O)",
+                  static_cast<PyObject*>(handle));
+}
+
+int MXNDArraySyncCopyFromNDArray(NDArrayHandle dst, const NDArrayHandle src,
+                                 const int i) {
+  return CallVoidV("ndarray_sync_copy_from_ndarray", "(OOi)",
+                   static_cast<PyObject*>(dst),
+                   static_cast<PyObject*>(src), i);
+}
+
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t* out_size,
+                          const char** out_buf) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* r = CallImpl("ndarray_save_raw_bytes",
+                         Py_BuildValue("(O)",
+                                       static_cast<PyObject*>(handle)));
+  int rc = -1;
+  if (r != nullptr) {
+    char* buf = nullptr;
+    Py_ssize_t n = 0;
+    if (PyBytes_AsStringAndSize(r, &buf, &n) == 0) {
+      g_rec_buf.assign(buf, n);
+      *out_size = static_cast<size_t>(n);
+      *out_buf = g_rec_buf.data();
+      rc = 0;
+    } else {
+      CaptureError();
+    }
+    Py_DECREF(r);
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXNDArrayLoadFromRawBytes(const void* buf, size_t size,
+                              NDArrayHandle* out) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* b = PyBytes_FromStringAndSize(static_cast<const char*>(buf),
+                                          static_cast<Py_ssize_t>(size));
+  PyObject* a = Py_BuildValue("(O)", b);
+  Py_DECREF(b);
+  PyGILState_Release(gil);
+  return CallHandle("ndarray_load_from_raw_bytes", a, out);
+}
+
+// ---- symbol surface -------------------------------------------------------
+
+int MXSymbolCreateVariable(const char* name, SymbolHandle* out) {
+  return CallHandleV("symbol_create_variable", out, "(s)", name);
+}
+
+int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle* symbols,
+                        SymbolHandle* out) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* lst = HandleList(symbols, num_symbols);
+  PyObject* a = Py_BuildValue("(O)", lst);
+  Py_DECREF(lst);
+  PyGILState_Release(gil);
+  return CallHandle("symbol_create_group", a, out);
+}
+
+int MXSymbolSaveToFile(SymbolHandle symbol, const char* fname) {
+  return CallVoidV("symbol_save_to_file", "(Os)",
+                   static_cast<PyObject*>(symbol), fname);
+}
+
+int MXSymbolSaveToJSON(SymbolHandle symbol, const char** out_json) {
+  return CallStrV("symbol_to_json", out_json, "(O)",
+                  static_cast<PyObject*>(symbol));
+}
+
+int MXSymbolCopy(SymbolHandle symbol, SymbolHandle* out) {
+  return CallHandleV("symbol_copy", out, "(O)",
+                     static_cast<PyObject*>(symbol));
+}
+
+int MXSymbolPrint(SymbolHandle symbol, const char** out_str) {
+  return CallStrV("symbol_print", out_str, "(O)",
+                  static_cast<PyObject*>(symbol));
+}
+
+
+int MXSymbolGetName(SymbolHandle symbol, const char** out, int* success) {
+  return StrSuccessPairV("symbol_get_name", out, success, "(O)",
+                         static_cast<PyObject*>(symbol));
+}
+
+int MXSymbolGetAttr(SymbolHandle symbol, const char* key, const char** out,
+                    int* success) {
+  return StrSuccessPairV("symbol_get_attr", out, success, "(Os)",
+                         static_cast<PyObject*>(symbol), key);
+}
+
+int MXSymbolSetAttr(SymbolHandle symbol, const char* key, const char* value) {
+  return CallVoidV("symbol_set_attr", "(Oss)",
+                   static_cast<PyObject*>(symbol), key, value);
+}
+
+static int SymAttrList(const char* fn, SymbolHandle symbol, mx_uint* out_size,
+                       const char*** out) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* r = CallImpl(fn, Py_BuildValue("(O)",
+                                           static_cast<PyObject*>(symbol)));
+  if (r == nullptr) {
+    PyGILState_Release(gil);
+    return -1;
+  }
+  mx_uint n = 0;
+  ReturnStrList(r, &n, out);
+  *out_size = n / 2;  // reference counts PAIRS here
+  Py_DECREF(r);
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXSymbolListAttr(SymbolHandle symbol, mx_uint* out_size,
+                     const char*** out) {
+  return SymAttrList("symbol_list_attr", symbol, out_size, out);
+}
+
+int MXSymbolListAttrShallow(SymbolHandle symbol, mx_uint* out_size,
+                            const char*** out) {
+  return SymAttrList("symbol_list_attr_shallow", symbol, out_size, out);
+}
+
+int MXSymbolGetInternals(SymbolHandle symbol, SymbolHandle* out) {
+  return CallHandleV("symbol_get_internals", out, "(O)",
+                     static_cast<PyObject*>(symbol));
+}
+
+int MXSymbolGetChildren(SymbolHandle symbol, SymbolHandle* out) {
+  return CallHandleV("symbol_get_children", out, "(O)",
+                     static_cast<PyObject*>(symbol));
+}
+
+int MXSymbolGetOutput(SymbolHandle symbol, mx_uint index, SymbolHandle* out) {
+  return CallHandleV("symbol_get_output", out, "(OI)",
+                     static_cast<PyObject*>(symbol), index);
+}
+
+int MXSymbolCompose(SymbolHandle sym, const char* name, mx_uint num_args,
+                    const char** keys, SymbolHandle* args) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* ks = keys != nullptr ? StrList(keys, num_args) : PyList_New(0);
+  PyObject* as = HandleList(args, num_args);
+  PyObject* a = Py_BuildValue("(OsOO)", static_cast<PyObject*>(sym),
+                              name != nullptr ? name : "", ks, as);
+  Py_DECREF(ks);
+  Py_DECREF(as);
+  PyGILState_Release(gil);
+  return CallVoid("symbol_compose", a);
+}
+
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator, mx_uint num_param,
+                               const char** keys, const char** vals,
+                               SymbolHandle* out) {
+  PyGILState_STATE gil = EnsurePython();
+  std::string* name = static_cast<std::string*>(creator);
+  PyObject* ks = StrList(keys, num_param);
+  PyObject* vs = StrList(vals, num_param);
+  PyObject* a = Py_BuildValue("(sOO)", name->c_str(), ks, vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  PyGILState_Release(gil);
+  return CallHandle("symbol_create_atomic", a, out);
+}
+
+int MXSymbolListAtomicSymbolCreators(mx_uint* out_size,
+                                     AtomicSymbolCreator** out_array) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* r = CallImpl("op_names", PyTuple_New(0));
+  if (r == nullptr) {
+    PyGILState_Release(gil);
+    return -1;
+  }
+  Py_ssize_t n = PyList_Size(r);
+  // dedicated static storage: callers cache this array across later API
+  // calls (the reference returns a stable registry vector), so it must
+  // not share a buffer with any other return path
+  static std::vector<void*> creators;
+  creators.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char* c = PyUnicode_AsUTF8(PyList_GetItem(r, i));
+    creators.push_back(new std::string(c ? c : ""));  // leaked handles
+  }
+  Py_DECREF(r);
+  *out_size = static_cast<mx_uint>(n);
+  *out_array = creators.data();
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char** name) {
+  *name = static_cast<std::string*>(creator)->c_str();
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                const char** name, const char** description,
+                                mx_uint* num_args, const char*** arg_names,
+                                const char*** arg_type_infos,
+                                const char*** arg_descriptions,
+                                const char** key_var_num_args,
+                                const char** return_type) {
+  PyGILState_STATE gil = EnsurePython();
+  std::string* op = static_cast<std::string*>(creator);
+  PyObject* r = CallImpl("op_info", Py_BuildValue("(s)", op->c_str()));
+  if (r == nullptr) {
+    PyGILState_Release(gil);
+    return -1;
+  }
+  g_info_store.clear();
+  const char* c0 = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  const char* c1 = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
+  const char* c5 = PyUnicode_AsUTF8(PyTuple_GetItem(r, 5));
+  g_ret_str = c0 ? c0 : "";
+  g_ret_str2 = c1 ? c1 : "";
+  g_rec_buf = c5 ? c5 : "";
+  size_t counts[3];
+  for (int grp = 0; grp < 3; ++grp) {
+    PyObject* lst = PyTuple_GetItem(r, 2 + grp);
+    Py_ssize_t n = PyList_Size(lst);
+    counts[grp] = static_cast<size_t>(n);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      const char* c = PyUnicode_AsUTF8(PyList_GetItem(lst, i));
+      g_info_store.emplace_back(c ? c : "");
+    }
+  }
+  size_t off = 0;
+  for (int grp = 0; grp < 3; ++grp) {
+    g_info_ptrs[grp].clear();
+    for (size_t i = 0; i < counts[grp]; ++i)
+      g_info_ptrs[grp].push_back(g_info_store[off + i].c_str());
+    off += counts[grp];
+  }
+  Py_DECREF(r);
+  *name = g_ret_str.c_str();
+  *description = g_ret_str2.c_str();
+  *num_args = static_cast<mx_uint>(counts[0]);
+  *arg_names = g_info_ptrs[0].data();
+  *arg_type_infos = g_info_ptrs[1].data();
+  *arg_descriptions = g_info_ptrs[2].data();
+  *key_var_num_args = g_rec_buf.c_str();
+  if (return_type != nullptr) *return_type = "";
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXSymbolInferShapePartial(
+    SymbolHandle sym, mx_uint num_args, const char** keys,
+    const mx_uint* arg_ind_ptr, const mx_uint* arg_shape_data,
+    mx_uint* in_shape_size, const mx_uint** in_shape_ndim,
+    const mx_uint*** in_shape_data, mx_uint* out_shape_size,
+    const mx_uint** out_shape_ndim, const mx_uint*** out_shape_data,
+    mx_uint* aux_shape_size, const mx_uint** aux_shape_ndim,
+    const mx_uint*** aux_shape_data, int* complete) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* names = StrList(keys, num_args);
+  PyObject* shapes = ShapeLists(num_args, arg_ind_ptr, arg_shape_data);
+  PyObject* a = Py_BuildValue("(OOO)", static_cast<PyObject*>(sym), names,
+                              shapes);
+  Py_DECREF(names);
+  Py_DECREF(shapes);
+  PyObject* r = CallImpl("symbol_infer_shape_partial", a);
+  if (r == nullptr) {
+    PyGILState_Release(gil);
+    return -1;
+  }
+  UnpackShapeGroups(r, in_shape_size, in_shape_ndim, in_shape_data,
+                    out_shape_size, out_shape_ndim, out_shape_data,
+                    aux_shape_size, aux_shape_ndim, aux_shape_data, complete);
+  Py_DECREF(r);
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXSymbolInferType(SymbolHandle sym, mx_uint num_args, const char** keys,
+                      const int* arg_type_data, mx_uint* in_type_size,
+                      const int** in_type_data, mx_uint* out_type_size,
+                      const int** out_type_data, mx_uint* aux_type_size,
+                      const int** aux_type_data, int* complete) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* names = StrList(keys, num_args);
+  PyObject* codes = IntList(arg_type_data, num_args);
+  PyObject* a = Py_BuildValue("(OOO)", static_cast<PyObject*>(sym), names,
+                              codes);
+  Py_DECREF(names);
+  Py_DECREF(codes);
+  PyObject* r = CallImpl("symbol_infer_type", a);
+  if (r == nullptr) {
+    PyGILState_Release(gil);
+    return -1;
+  }
+  g_int_buf.clear();
+  mx_uint sizes[3];
+  size_t offsets[4] = {0, 0, 0, 0};
+  for (int grp = 0; grp < 3; ++grp) {
+    PyObject* lst = PyTuple_GetItem(r, grp);
+    Py_ssize_t n = PyList_Size(lst);
+    sizes[grp] = static_cast<mx_uint>(n);
+    for (Py_ssize_t i = 0; i < n; ++i)
+      g_int_buf.push_back(
+          static_cast<int>(PyLong_AsLong(PyList_GetItem(lst, i))));
+    offsets[grp + 1] = g_int_buf.size();
+  }
+  *in_type_size = sizes[0];
+  *in_type_data = g_int_buf.data() + offsets[0];
+  *out_type_size = sizes[1];
+  *out_type_data = g_int_buf.data() + offsets[1];
+  *aux_type_size = sizes[2];
+  *aux_type_data = g_int_buf.data() + offsets[2];
+  *complete = PyObject_IsTrue(PyTuple_GetItem(r, 3));
+  Py_DECREF(r);
+  PyGILState_Release(gil);
+  return 0;
+}
+
+// ---- executor extras ------------------------------------------------------
+
+int MXExecutorPrint(ExecutorHandle handle, const char** out_str) {
+  return CallStrV("executor_print", out_str, "(O)",
+                  static_cast<PyObject*>(handle));
+}
+
+int MXExecutorBackwardEx(ExecutorHandle handle, mx_uint len,
+                         NDArrayHandle* head_grads, int is_train) {
+  (void)is_train;  // our backward derives mode from the recorded program
+  return MXExecutorBackward(handle, len, head_grads);
+}
+
+int MXExecutorSimpleBind(
+    SymbolHandle symbol_handle, int dev_type, int dev_id,
+    const mx_uint num_g2c_keys, const char** g2c_keys,
+    const int* g2c_dev_types, const int* g2c_dev_ids,
+    const mx_uint provided_grad_req_list_len,
+    const char** provided_grad_req_names,
+    const char** provided_grad_req_types,
+    const mx_uint num_provided_arg_shapes,
+    const char** provided_arg_shape_names,
+    const mx_uint* provided_arg_shape_data,
+    const mx_uint* provided_arg_shape_idx,
+    const mx_uint num_provided_arg_dtypes,
+    const char** provided_arg_dtype_names, const int* provided_arg_dtypes,
+    const mx_uint num_provided_arg_stypes,
+    const char** provided_arg_stype_names, const int* provided_arg_stypes,
+    const mx_uint num_shared_arg_names, const char** shared_arg_name_list,
+    int* shared_buffer_len, const char** shared_buffer_name_list,
+    NDArrayHandle* shared_buffer_handle_list,
+    const char*** updated_shared_buffer_name_list,
+    NDArrayHandle** updated_shared_buffer_handle_list, mx_uint* num_in_args,
+    NDArrayHandle** in_args, NDArrayHandle** arg_grads,
+    mx_uint* num_aux_states, NDArrayHandle** aux_states,
+    ExecutorHandle shared_exec_handle, ExecutorHandle* out) {
+  // shared buffer / shared exec are allocator-reuse hints in the
+  // reference (c_api_executor.cc); PJRT owns allocation here, so they
+  // are accepted and passed through unchanged.
+  (void)num_shared_arg_names;
+  (void)shared_arg_name_list;
+  (void)shared_exec_handle;
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* g2ck = StrList(g2c_keys, num_g2c_keys);
+  PyObject* g2ct = IntList(g2c_dev_types, num_g2c_keys);
+  PyObject* g2ci = IntList(g2c_dev_ids, num_g2c_keys);
+  PyObject* reqn = StrList(provided_grad_req_names,
+                           provided_grad_req_names != nullptr
+                               ? provided_grad_req_list_len : 0);
+  PyObject* reqt = StrList(provided_grad_req_types,
+                           provided_grad_req_list_len);
+  PyObject* shn = StrList(provided_arg_shape_names, num_provided_arg_shapes);
+  PyObject* shs = ShapeLists(num_provided_arg_shapes, provided_arg_shape_idx,
+                             provided_arg_shape_data);
+  PyObject* dtn = StrList(provided_arg_dtype_names, num_provided_arg_dtypes);
+  PyObject* dtc = IntList(provided_arg_dtypes, num_provided_arg_dtypes);
+  PyObject* stn = StrList(provided_arg_stype_names, num_provided_arg_stypes);
+  PyObject* stc = IntList(provided_arg_stypes, num_provided_arg_stypes);
+  PyObject* a = Py_BuildValue(
+      "(OiiOOOOOOOOOOO)", static_cast<PyObject*>(symbol_handle), dev_type,
+      dev_id, g2ck, g2ct, g2ci, reqn, reqt, shn, shs, dtn, dtc, stn, stc);
+  Py_DECREF(g2ck); Py_DECREF(g2ct); Py_DECREF(g2ci);
+  Py_DECREF(reqn); Py_DECREF(reqt); Py_DECREF(shn); Py_DECREF(shs);
+  Py_DECREF(dtn); Py_DECREF(dtc); Py_DECREF(stn); Py_DECREF(stc);
+  PyObject* r = CallImpl("executor_simple_bind", a);
+  if (r == nullptr) {
+    PyGILState_Release(gil);
+    return -1;
+  }
+  PyObject* ex = PyTuple_GetItem(r, 0);
+  Py_INCREF(ex);
+  *out = ex;
+  mx_uint n_in = 0, n_aux = 0;
+  // in_args and arg_grads must live in SEPARATE buffers (two pointers
+  // handed out simultaneously); ReturnHandleList uses one — inline here
+  {
+    PyObject* ins = PyTuple_GetItem(r, 1);
+    PyObject* grads = PyTuple_GetItem(r, 2);
+    Py_ssize_t n = PyList_Size(ins);
+    g_handle_buf.clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* o = PyList_GetItem(ins, i);
+      Py_INCREF(o);
+      g_handle_buf.push_back(o);
+    }
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* o = PyList_GetItem(grads, i);
+      if (o == Py_None) {
+        g_handle_buf.push_back(nullptr);
+      } else {
+        Py_INCREF(o);
+        g_handle_buf.push_back(o);
+      }
+    }
+    n_in = static_cast<mx_uint>(n);
+    *in_args = g_handle_buf.data();
+    *arg_grads = g_handle_buf.data() + n;
+  }
+  {
+    PyObject* aux = PyTuple_GetItem(r, 3);
+    Py_ssize_t n = PyList_Size(aux);
+    g_handle_buf2.clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject* o = PyList_GetItem(aux, i);
+      Py_INCREF(o);
+      g_handle_buf2.push_back(o);
+    }
+    n_aux = static_cast<mx_uint>(n);
+    *aux_states = g_handle_buf2.data();
+  }
+  *num_in_args = n_in;
+  *num_aux_states = n_aux;
+  if (updated_shared_buffer_name_list != nullptr)
+    *updated_shared_buffer_name_list = shared_buffer_name_list;
+  if (updated_shared_buffer_handle_list != nullptr)
+    *updated_shared_buffer_handle_list = shared_buffer_handle_list;
+  if (shared_buffer_len != nullptr && *shared_buffer_len < 0)
+    *shared_buffer_len = 0;
+  Py_DECREF(r);
+  PyGILState_Release(gil);
+  return 0;
+}
+
+// ---- CachedOp -------------------------------------------------------------
+
+int MXCreateCachedOp(SymbolHandle handle, CachedOpHandle* out) {
+  return CallHandleV("cached_op_create", out, "(O)",
+                     static_cast<PyObject*>(handle));
+}
+
+int MXFreeCachedOp(CachedOpHandle handle) {
+  PyGILState_STATE gil = EnsurePython();
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                     NDArrayHandle* inputs, int* num_outputs,
+                     NDArrayHandle** outputs) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* ins = HandleList(inputs, num_inputs);
+  PyObject* a = Py_BuildValue("(OO)", static_cast<PyObject*>(handle), ins);
+  Py_DECREF(ins);
+  PyObject* r = CallImpl("cached_op_invoke", a);
+  if (r == nullptr) {
+    PyGILState_Release(gil);
+    return -1;
+  }
+  mx_uint n = 0;
+  ReturnHandleList(r, &n, outputs);
+  *num_outputs = static_cast<int>(n);
+  Py_DECREF(r);
+  PyGILState_Release(gil);
+  return 0;
+}
+
+// ---- autograd -------------------------------------------------------------
+
+int MXAutogradSetIsRecording(int is_recording, int* prev) {
+  return CallIntV("autograd_set_recording", prev, "(i)", is_recording);
+}
+
+int MXAutogradSetIsTraining(int is_training, int* prev) {
+  return CallIntV("autograd_set_training", prev, "(i)", is_training);
+}
+
+int MXAutogradIsRecording(bool* curr) {
+  int v = 0;
+  int rc = CallIntV("autograd_is_recording", &v, "()");
+  *curr = v != 0;
+  return rc;
+}
+
+int MXAutogradIsTraining(bool* curr) {
+  int v = 0;
+  int rc = CallIntV("autograd_is_training", &v, "()");
+  *curr = v != 0;
+  return rc;
+}
+
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle* var_handles,
+                            mx_uint* reqs_array,
+                            NDArrayHandle* grad_handles) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* vars = HandleList(var_handles, num_var);
+  PyObject* reqs = UIntList(reqs_array, num_var);
+  PyObject* grads = HandleList(grad_handles, num_var);
+  PyObject* a = Py_BuildValue("(OOO)", vars, reqs, grads);
+  Py_DECREF(vars);
+  Py_DECREF(reqs);
+  Py_DECREF(grads);
+  PyGILState_Release(gil);
+  return CallVoid("autograd_mark_variables", a);
+}
+
+int MXAutogradBackward(mx_uint num_output, NDArrayHandle* output_handles,
+                       NDArrayHandle* ograd_handles, int retain_graph) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* outs = HandleList(output_handles, num_output);
+  PyObject* ogs = ograd_handles != nullptr
+                      ? HandleList(ograd_handles, num_output) : PyList_New(0);
+  PyObject* a = Py_BuildValue("(OOii)", outs, ogs, retain_graph, 1);
+  Py_DECREF(outs);
+  Py_DECREF(ogs);
+  PyGILState_Release(gil);
+  return CallVoid("autograd_backward", a);
+}
+
+int MXAutogradComputeGradient(mx_uint num_output,
+                              NDArrayHandle* output_handles) {
+  return MXAutogradBackward(num_output, output_handles, nullptr, 0);
+}
+
+int MXAutogradBackwardEx(mx_uint num_output, NDArrayHandle* output_handles,
+                         NDArrayHandle* ograd_handles, mx_uint num_variables,
+                         NDArrayHandle* var_handles, int retain_graph,
+                         int create_graph, int is_train,
+                         NDArrayHandle** grad_handles, int** grad_stypes) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* outs = HandleList(output_handles, num_output);
+  PyObject* ogs = ograd_handles != nullptr
+                      ? HandleList(ograd_handles, num_output) : PyList_New(0);
+  PyObject* vars = HandleList(var_handles, num_variables);
+  PyObject* a = Py_BuildValue("(OOOiii)", outs, ogs, vars, retain_graph,
+                              create_graph, is_train);
+  Py_DECREF(outs);
+  Py_DECREF(ogs);
+  Py_DECREF(vars);
+  PyObject* r = CallImpl("autograd_backward_ex", a);
+  if (r == nullptr) {
+    PyGILState_Release(gil);
+    return -1;
+  }
+  if (num_variables > 0 && grad_handles != nullptr) {
+    mx_uint n = 0;
+    ReturnHandleList(PyTuple_GetItem(r, 0), &n, grad_handles);
+    PyObject* st = PyTuple_GetItem(r, 1);
+    g_int_buf.clear();
+    for (Py_ssize_t i = 0; i < PyList_Size(st); ++i)
+      g_int_buf.push_back(
+          static_cast<int>(PyLong_AsLong(PyList_GetItem(st, i))));
+    if (grad_stypes != nullptr) *grad_stypes = g_int_buf.data();
+  }
+  Py_DECREF(r);
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXAutogradGetSymbol(NDArrayHandle handle, SymbolHandle* out) {
+  return CallHandleV("autograd_get_symbol", out, "(O)",
+                     static_cast<PyObject*>(handle));
+}
+
+// ---- data iterators -------------------------------------------------------
+
+int MXListDataIters(mx_uint* out_size, DataIterCreator** out_array) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* r = CallImpl("list_data_iters", PyTuple_New(0));
+  if (r == nullptr) {
+    PyGILState_Release(gil);
+    return -1;
+  }
+  static std::vector<void*> creators;  // leaked name handles, like ops
+  creators.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(r); ++i) {
+    const char* c = PyUnicode_AsUTF8(PyList_GetItem(r, i));
+    creators.push_back(new std::string(c ? c : ""));
+  }
+  Py_DECREF(r);
+  *out_size = static_cast<mx_uint>(creators.size());
+  *out_array = creators.data();
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXDataIterGetIterInfo(DataIterCreator creator, const char** name,
+                          const char** description, mx_uint* num_args,
+                          const char*** arg_names,
+                          const char*** arg_type_infos,
+                          const char*** arg_descriptions) {
+  PyGILState_STATE gil = EnsurePython();
+  std::string* n = static_cast<std::string*>(creator);
+  PyObject* r = CallImpl("data_iter_info", Py_BuildValue("(s)", n->c_str()));
+  if (r == nullptr) {
+    PyGILState_Release(gil);
+    return -1;
+  }
+  g_info_store.clear();
+  const char* c0 = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  const char* c1 = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
+  g_ret_str = c0 ? c0 : "";
+  g_ret_str2 = c1 ? c1 : "";
+  size_t counts[3];
+  for (int grp = 0; grp < 3; ++grp) {
+    PyObject* lst = PyTuple_GetItem(r, 2 + grp);
+    Py_ssize_t cnt = PyList_Size(lst);
+    counts[grp] = static_cast<size_t>(cnt);
+    for (Py_ssize_t i = 0; i < cnt; ++i) {
+      const char* c = PyUnicode_AsUTF8(PyList_GetItem(lst, i));
+      g_info_store.emplace_back(c ? c : "");
+    }
+  }
+  size_t off = 0;
+  for (int grp = 0; grp < 3; ++grp) {
+    g_info_ptrs[grp].clear();
+    for (size_t i = 0; i < counts[grp]; ++i)
+      g_info_ptrs[grp].push_back(g_info_store[off + i].c_str());
+    off += counts[grp];
+  }
+  Py_DECREF(r);
+  *name = g_ret_str.c_str();
+  *description = g_ret_str2.c_str();
+  *num_args = static_cast<mx_uint>(counts[0]);
+  *arg_names = g_info_ptrs[0].data();
+  *arg_type_infos = g_info_ptrs[1].data();
+  *arg_descriptions = g_info_ptrs[2].data();
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXDataIterCreateIter(DataIterCreator handle, mx_uint num_param,
+                         const char** keys, const char** vals,
+                         DataIterHandle* out) {
+  PyGILState_STATE gil = EnsurePython();
+  std::string* n = static_cast<std::string*>(handle);
+  PyObject* ks = StrList(keys, num_param);
+  PyObject* vs = StrList(vals, num_param);
+  PyObject* a = Py_BuildValue("(sOO)", n->c_str(), ks, vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  PyGILState_Release(gil);
+  return CallHandle("data_iter_create", a, out);
+}
+
+int MXDataIterFree(DataIterHandle handle) {
+  PyGILState_STATE gil = EnsurePython();
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXDataIterNext(DataIterHandle handle, int* out) {
+  return CallIntV("data_iter_next", out, "(O)",
+                  static_cast<PyObject*>(handle));
+}
+
+int MXDataIterBeforeFirst(DataIterHandle handle) {
+  return CallVoidV("data_iter_before_first", "(O)",
+                   static_cast<PyObject*>(handle));
+}
+
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle* out) {
+  return CallHandleV("data_iter_get_data", out, "(O)",
+                     static_cast<PyObject*>(handle));
+}
+
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle* out) {
+  return CallHandleV("data_iter_get_label", out, "(O)",
+                     static_cast<PyObject*>(handle));
+}
+
+int MXDataIterGetPadNum(DataIterHandle handle, int* pad) {
+  return CallIntV("data_iter_get_pad", pad, "(O)",
+                  static_cast<PyObject*>(handle));
+}
+
+int MXDataIterGetIndex(DataIterHandle handle, uint64_t** out_index,
+                       uint64_t* out_size) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* r = CallImpl("data_iter_get_index",
+                         Py_BuildValue("(O)",
+                                       static_cast<PyObject*>(handle)));
+  if (r == nullptr) {
+    PyGILState_Release(gil);
+    return -1;
+  }
+  g_u64_buf.clear();
+  for (Py_ssize_t i = 0; i < PyList_Size(r); ++i)
+    g_u64_buf.push_back(static_cast<uint64_t>(
+        PyLong_AsUnsignedLongLong(PyList_GetItem(r, i))));
+  Py_DECREF(r);
+  *out_index = g_u64_buf.data();
+  *out_size = static_cast<uint64_t>(g_u64_buf.size());
+  PyGILState_Release(gil);
+  return 0;
+}
+
+// ---- kvstore --------------------------------------------------------------
+
+int MXInitPSEnv(mx_uint num_vars, const char** keys, const char** vals) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* ks = StrList(keys, num_vars);
+  PyObject* vs = StrList(vals, num_vars);
+  PyObject* a = Py_BuildValue("(OO)", ks, vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  PyGILState_Release(gil);
+  return CallVoid("init_ps_env", a);
+}
+
+int MXKVStoreCreate(const char* type, KVStoreHandle* out) {
+  return CallHandleV("kvstore_create", out, "(s)", type);
+}
+
+int MXKVStoreFree(KVStoreHandle handle) {
+  PyGILState_STATE gil = EnsurePython();
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  PyGILState_Release(gil);
+  return 0;
+}
+
+static PyObject* KVKeys(const int* keys, mx_uint num) {
+  return IntList(keys, num);
+}
+
+static PyObject* KVKeysEx(const char** keys, mx_uint num) {
+  return StrList(keys, num);
+}
+
+int MXKVStoreInit(KVStoreHandle handle, mx_uint num, const int* keys,
+                  NDArrayHandle* vals) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* ks = KVKeys(keys, num);
+  PyObject* vs = HandleList(vals, num);
+  PyObject* a = Py_BuildValue("(OOO)", static_cast<PyObject*>(handle), ks,
+                              vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  PyGILState_Release(gil);
+  return CallVoid("kvstore_init", a);
+}
+
+int MXKVStoreInitEx(KVStoreHandle handle, mx_uint num, const char** keys,
+                    NDArrayHandle* vals) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* ks = KVKeysEx(keys, num);
+  PyObject* vs = HandleList(vals, num);
+  PyObject* a = Py_BuildValue("(OOO)", static_cast<PyObject*>(handle), ks,
+                              vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  PyGILState_Release(gil);
+  return CallVoid("kvstore_init", a);
+}
+
+static int KVPush(KVStoreHandle handle, PyObject* ks, mx_uint num,
+                  NDArrayHandle* vals, int priority) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* vs = HandleList(vals, num);
+  PyObject* a = Py_BuildValue("(OOOi)", static_cast<PyObject*>(handle), ks,
+                              vs, priority);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  PyGILState_Release(gil);
+  return CallVoid("kvstore_push", a);
+}
+
+int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int* keys,
+                  NDArrayHandle* vals, int priority) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* ks = KVKeys(keys, num);
+  PyGILState_Release(gil);
+  return KVPush(handle, ks, num, vals, priority);
+}
+
+int MXKVStorePushEx(KVStoreHandle handle, mx_uint num, const char** keys,
+                    NDArrayHandle* vals, int priority) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* ks = KVKeysEx(keys, num);
+  PyGILState_Release(gil);
+  return KVPush(handle, ks, num, vals, priority);
+}
+
+static int KVPull(KVStoreHandle handle, PyObject* ks, mx_uint num,
+                  NDArrayHandle* vals, int priority) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* vs = HandleList(vals, num);
+  PyObject* a = Py_BuildValue("(OOOi)", static_cast<PyObject*>(handle), ks,
+                              vs, priority);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  PyGILState_Release(gil);
+  return CallVoid("kvstore_pull", a);
+}
+
+int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int* keys,
+                  NDArrayHandle* vals, int priority) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* ks = KVKeys(keys, num);
+  PyGILState_Release(gil);
+  return KVPull(handle, ks, num, vals, priority);
+}
+
+int MXKVStorePullEx(KVStoreHandle handle, mx_uint num, const char** keys,
+                    NDArrayHandle* vals, int priority) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* ks = KVKeysEx(keys, num);
+  PyGILState_Release(gil);
+  return KVPull(handle, ks, num, vals, priority);
+}
+
+static int KVPullRsp(KVStoreHandle handle, PyObject* ks, mx_uint num,
+                     NDArrayHandle* vals, const NDArrayHandle* row_ids,
+                     int priority) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* vs = HandleList(vals, num);
+  PyObject* rs = HandleList(const_cast<NDArrayHandle*>(row_ids), num);
+  PyObject* a = Py_BuildValue("(OOOOi)", static_cast<PyObject*>(handle), ks,
+                              vs, rs, priority);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  Py_DECREF(rs);
+  PyGILState_Release(gil);
+  return CallVoid("kvstore_pull_row_sparse", a);
+}
+
+int MXKVStorePullRowSparse(KVStoreHandle handle, mx_uint num, const int* keys,
+                           NDArrayHandle* vals, const NDArrayHandle* row_ids,
+                           int priority) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* ks = KVKeys(keys, num);
+  PyGILState_Release(gil);
+  return KVPullRsp(handle, ks, num, vals, row_ids, priority);
+}
+
+int MXKVStorePullRowSparseEx(KVStoreHandle handle, mx_uint num,
+                             const char** keys, NDArrayHandle* vals,
+                             const NDArrayHandle* row_ids, int priority) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* ks = KVKeysEx(keys, num);
+  PyGILState_Release(gil);
+  return KVPullRsp(handle, ks, num, vals, row_ids, priority);
+}
+
+int MXKVStoreSetGradientCompression(KVStoreHandle handle, mx_uint num_params,
+                                    const char** keys, const char** vals) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* ks = StrList(keys, num_params);
+  PyObject* vs = StrList(vals, num_params);
+  PyObject* a = Py_BuildValue("(OOO)", static_cast<PyObject*>(handle), ks,
+                              vs);
+  Py_DECREF(ks);
+  Py_DECREF(vs);
+  PyGILState_Release(gil);
+  return CallVoid("kvstore_set_gradient_compression", a);
+}
+
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void* updater_handle) {
+  return CallVoidV(
+      "kvstore_set_updater", "(OLL)", static_cast<PyObject*>(handle),
+      static_cast<long long>(reinterpret_cast<intptr_t>(updater)),
+      static_cast<long long>(reinterpret_cast<intptr_t>(updater_handle)));
+}
+
+int MXKVStoreSetUpdaterEx(KVStoreHandle handle, MXKVStoreUpdater updater,
+                          MXKVStoreStrUpdater str_updater,
+                          void* updater_handle) {
+  return CallVoidV(
+      "kvstore_set_updater", "(OLLL)", static_cast<PyObject*>(handle),
+      static_cast<long long>(reinterpret_cast<intptr_t>(updater)),
+      static_cast<long long>(reinterpret_cast<intptr_t>(updater_handle)),
+      static_cast<long long>(reinterpret_cast<intptr_t>(str_updater)));
+}
+
+int MXKVStoreGetType(KVStoreHandle handle, const char** type) {
+  return CallStrV("kvstore_get_type", type, "(O)",
+                  static_cast<PyObject*>(handle));
+}
+
+int MXKVStoreGetRank(KVStoreHandle handle, int* ret) {
+  return CallIntV("kvstore_get_rank", ret, "(O)",
+                  static_cast<PyObject*>(handle));
+}
+
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int* ret) {
+  return CallIntV("kvstore_get_group_size", ret, "(O)",
+                  static_cast<PyObject*>(handle));
+}
+
+// role queries: the SPMD runtime has workers only (kvstore_server.py is
+// the documented role-absorber); env overrides keep launcher parity
+int MXKVStoreIsWorkerNode(int* ret) {
+  const char* role = getenv("DMLC_ROLE");
+  *ret = (role == nullptr || std::string(role) == "worker") ? 1 : 0;
+  return 0;
+}
+
+int MXKVStoreIsServerNode(int* ret) {
+  const char* role = getenv("DMLC_ROLE");
+  *ret = (role != nullptr && std::string(role) == "server") ? 1 : 0;
+  return 0;
+}
+
+int MXKVStoreIsSchedulerNode(int* ret) {
+  const char* role = getenv("DMLC_ROLE");
+  *ret = (role != nullptr && std::string(role) == "scheduler") ? 1 : 0;
+  return 0;
+}
+
+int MXKVStoreBarrier(KVStoreHandle handle) {
+  return CallVoidV("kvstore_barrier", "(O)",
+                   static_cast<PyObject*>(handle));
+}
+
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle,
+                                  const int barrier_before_exit) {
+  (void)handle;
+  (void)barrier_before_exit;  // process teardown is jax.distributed's
+  return 0;
+}
+
+int MXKVStoreRunServer(KVStoreHandle handle,
+                       MXKVStoreServerController controller,
+                       void* controller_handle) {
+  return CallVoidV(
+      "kvstore_run_server", "(OLL)", static_cast<PyObject*>(handle),
+      static_cast<long long>(reinterpret_cast<intptr_t>(controller)),
+      static_cast<long long>(
+          reinterpret_cast<intptr_t>(controller_handle)));
+}
+
+int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_id,
+                                   const char* cmd_body) {
+  return CallVoidV("kvstore_send_command", "(Ois)",
+                   static_cast<PyObject*>(handle), cmd_id, cmd_body);
+}
+
+int MXKVStoreGetNumDeadNode(KVStoreHandle handle, const int node_id,
+                            int* number, const int timeout_sec) {
+  return CallIntV("kvstore_num_dead_node", number, "(Oii)",
+                  static_cast<PyObject*>(handle), node_id, timeout_sec);
+}
+
+// ---- recordio -------------------------------------------------------------
+
+int MXRecordIOWriterCreate(const char* uri, RecordIOHandle* out) {
+  return CallHandleV("recordio_writer_create", out, "(s)", uri);
+}
+
+int MXRecordIOReaderCreate(const char* uri, RecordIOHandle* out) {
+  return CallHandleV("recordio_reader_create", out, "(s)", uri);
+}
+
+static int RecordIOFree(RecordIOHandle handle) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* a = Py_BuildValue("(O)", static_cast<PyObject*>(handle));
+  PyObject* r = CallImpl("recordio_close", a);
+  Py_XDECREF(r);
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  PyGILState_Release(gil);
+  return r != nullptr ? 0 : -1;
+}
+
+int MXRecordIOWriterFree(RecordIOHandle handle) {
+  return RecordIOFree(handle);
+}
+
+int MXRecordIOReaderFree(RecordIOHandle handle) {
+  return RecordIOFree(handle);
+}
+
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char* buf,
+                                size_t size) {
+  return CallVoidV(
+      "recordio_write_record", "(OLn)", static_cast<PyObject*>(handle),
+      static_cast<long long>(reinterpret_cast<intptr_t>(buf)),
+      static_cast<Py_ssize_t>(size));
+}
+
+int MXRecordIOWriterTell(RecordIOHandle handle, size_t* pos) {
+  // full 64-bit position: .rec files routinely exceed 2 GB
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* r = CallImpl("recordio_tell",
+                         Py_BuildValue("(O)",
+                                       static_cast<PyObject*>(handle)));
+  int rc = -1;
+  if (r != nullptr) {
+    *pos = static_cast<size_t>(PyLong_AsUnsignedLongLong(r));
+    Py_DECREF(r);
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXRecordIOReaderTell(RecordIOHandle handle, size_t* pos) {
+  return MXRecordIOWriterTell(handle, pos);
+}
+
+int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos) {
+  return CallVoidV("recordio_seek", "(On)",
+                   static_cast<PyObject*>(handle),
+                   static_cast<Py_ssize_t>(pos));
+}
+
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, char const** buf,
+                               size_t* size) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* r = CallImpl("recordio_read_record",
+                         Py_BuildValue("(O)",
+                                       static_cast<PyObject*>(handle)));
+  int rc = -1;
+  if (r != nullptr) {
+    if (r == Py_None) {  // EOF
+      *buf = nullptr;
+      *size = 0;
+      rc = 0;
+    } else {
+      char* data = nullptr;
+      Py_ssize_t n = 0;
+      if (PyBytes_AsStringAndSize(r, &data, &n) == 0) {
+        g_rec_buf.assign(data, n);
+        *buf = g_rec_buf.data();
+        *size = static_cast<size_t>(n);
+        rc = 0;
+      } else {
+        CaptureError();
+      }
+    }
+    Py_DECREF(r);
+  }
+  PyGILState_Release(gil);
+  return rc;
 }
 
 }  // extern "C"

@@ -286,3 +286,545 @@ def test_c_train_driver(tmp_path):
     assert "TRAIN_OK" in p.stdout, p.stdout
     acc = float(p.stdout.split("acc=")[1].split()[0])
     assert acc > 0.8, p.stdout
+
+
+# ===========================================================================
+# Round-4 tranche tests (runtime knobs, NDArray extras, full symbol
+# surface, SimpleBind, CachedOp, autograd, data iters, kvstore, recordio)
+# ===========================================================================
+
+def _lib2():
+    L = ctypes.CDLL(LIB)
+    L.MXGetLastError.restype = ctypes.c_char_p
+    vp, u, i = ctypes.c_void_p, ctypes.c_uint, ctypes.c_int
+    P, cp = ctypes.POINTER, ctypes.c_char_p
+    L.MXNDArrayCreateEx.argtypes = [P(u), u, i, i, i, i, P(vp)]
+    L.MXNDArraySyncCopyFromCPU.argtypes = [vp, vp, ctypes.c_size_t]
+    L.MXNDArraySyncCopyToCPU.argtypes = [vp, vp, ctypes.c_size_t]
+    L.MXNDArrayFree.argtypes = [vp]
+    L.MXNDArraySlice.argtypes = [vp, u, u, P(vp)]
+    L.MXNDArrayAt.argtypes = [vp, u, P(vp)]
+    L.MXNDArrayReshape.argtypes = [vp, i, P(i), P(vp)]
+    L.MXNDArrayGetContext.argtypes = [vp, P(i), P(i)]
+    L.MXNDArrayGetStorageType.argtypes = [vp, P(i)]
+    L.MXNDArraySaveRawBytes.argtypes = [vp, P(ctypes.c_size_t), P(vp)]
+    L.MXNDArrayLoadFromRawBytes.argtypes = [vp, ctypes.c_size_t, P(vp)]
+    L.MXNDArrayGetShape.argtypes = [vp, P(u), P(P(u))]
+    L.MXNDArraySyncCopyFromNDArray.argtypes = [vp, vp, i]
+    L.MXNDArrayGetGrad.argtypes = [vp, P(vp)]
+    L.MXRecordIOWriterWriteRecord.argtypes = [vp, cp, ctypes.c_size_t]
+    L.MXRecordIOReaderSeek.argtypes = [vp, ctypes.c_size_t]
+    L.MXKVStoreSetUpdater.argtypes = [vp, vp, vp]
+    L.MXSymbolSaveToJSON.argtypes = [vp, P(cp)]
+    L.MXSymbolGetName.argtypes = [vp, P(cp), P(i)]
+    L.MXSymbolGetAttr.argtypes = [vp, cp, P(cp), P(i)]
+    L.MXSymbolSetAttr.argtypes = [vp, cp, cp]
+    L.MXKVStoreGetType.argtypes = [vp, P(cp)]
+    L.MXExecutorPrint.argtypes = [vp, P(cp)]
+    # handle values dereferenced from arrays arrive as bare ints — these
+    # MUST have argtypes or the pointer truncates to 32 bits
+    L.MXSymbolListAtomicSymbolCreators.argtypes = [P(u), P(P(vp))]
+    L.MXSymbolGetAtomicSymbolName.argtypes = [vp, P(cp)]
+    L.MXSymbolGetAtomicSymbolInfo.argtypes = [vp, P(cp), P(cp), P(u),
+                                              P(P(cp)), P(P(cp)), P(P(cp)),
+                                              P(cp), P(cp)]
+    L.MXListDataIters.argtypes = [P(u), P(P(vp))]
+    L.MXDataIterGetIterInfo.argtypes = [vp, P(cp), P(cp), P(u), P(P(cp)),
+                                        P(P(cp)), P(P(cp))]
+    L.MXDataIterCreateIter.argtypes = [vp, u, P(cp), P(cp), P(vp)]
+    L.MXInvokeCachedOp.argtypes = [vp, i, P(vp), P(i), P(P(vp))]
+    L.MXImperativeInvoke.argtypes = [vp, i, P(vp), P(i), P(P(vp)), i,
+                                     P(cp), P(cp)]
+    return L
+
+
+def test_runtime_knobs():
+    L = _lib2()
+    v = ctypes.c_int()
+    assert L.MXGetVersion(ctypes.byref(v)) == 0 and v.value == 1201
+    assert L.MXRandomSeed(42) == 0
+    prev = ctypes.c_int(-1)
+    assert L.MXEngineSetBulkSize(16, ctypes.byref(prev)) == 0
+    assert prev.value >= 0
+    assert L.MXSetNumOMPThreads(2) == 0
+    worker = ctypes.c_int()
+    assert L.MXKVStoreIsWorkerNode(ctypes.byref(worker)) == 0
+    assert worker.value == 1
+
+
+def _make_nd(L, arr):
+    shape = (ctypes.c_uint * arr.ndim)(*arr.shape)
+    h = ctypes.c_void_p()
+    assert L.MXNDArrayCreateEx(shape, arr.ndim, 1, 0, 0, 0,
+                               ctypes.byref(h)) == 0, L.MXGetLastError()
+    buf = (ctypes.c_float * arr.size)(*arr.ravel())
+    assert L.MXNDArraySyncCopyFromCPU(h, buf, arr.size) == 0
+    return h
+
+
+def _read_nd(L, h, n):
+    got = (ctypes.c_float * n)()
+    assert L.MXNDArraySyncCopyToCPU(h, got, n) == 0, L.MXGetLastError()
+    return np.array(got[:n])
+
+
+def test_ndarray_extras():
+    L = _lib2()
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    h = _make_nd(L, x)
+
+    s = ctypes.c_void_p()
+    assert L.MXNDArraySlice(h, 1, 3, ctypes.byref(s)) == 0
+    np.testing.assert_allclose(_read_nd(L, s, 8), x[1:3].ravel())
+
+    a = ctypes.c_void_p()
+    assert L.MXNDArrayAt(h, 2, ctypes.byref(a)) == 0
+    np.testing.assert_allclose(_read_nd(L, a, 4), x[2])
+
+    dims = (ctypes.c_int * 2)(4, 3)
+    r = ctypes.c_void_p()
+    assert L.MXNDArrayReshape(h, 2, dims, ctypes.byref(r)) == 0
+    ndim = ctypes.c_uint()
+    pdata = ctypes.POINTER(ctypes.c_uint)()
+    assert L.MXNDArrayGetShape(r, ctypes.byref(ndim), ctypes.byref(pdata)) == 0
+    assert tuple(pdata[i] for i in range(ndim.value)) == (4, 3)
+
+    dev_type, dev_id = ctypes.c_int(), ctypes.c_int()
+    assert L.MXNDArrayGetContext(h, ctypes.byref(dev_type),
+                                 ctypes.byref(dev_id)) == 0
+    assert dev_type.value == 1 and dev_id.value == 0
+    st = ctypes.c_int(-2)
+    assert L.MXNDArrayGetStorageType(h, ctypes.byref(st)) == 0
+    assert st.value == 0  # kDefaultStorage
+
+    # raw-bytes roundtrip
+    size = ctypes.c_size_t()
+    buf = ctypes.c_void_p()
+    assert L.MXNDArraySaveRawBytes(h, ctypes.byref(size),
+                                   ctypes.byref(buf)) == 0
+    h2 = ctypes.c_void_p()
+    assert L.MXNDArrayLoadFromRawBytes(buf, size.value,
+                                       ctypes.byref(h2)) == 0, \
+        L.MXGetLastError()
+    np.testing.assert_allclose(_read_nd(L, h2, 12), x.ravel())
+
+    # none + copy-from-ndarray
+    none_h = ctypes.c_void_p()
+    assert L.MXNDArrayCreateNone(ctypes.byref(none_h)) == 0
+    assert L.MXNDArraySyncCopyFromNDArray(none_h, h, -1) == 0
+    np.testing.assert_allclose(_read_nd(L, none_h, 12), x.ravel())
+
+    for hh in (h, s, a, r, h2, none_h):
+        assert L.MXNDArrayFree(hh) == 0
+
+
+def test_symbol_surface_and_compose():
+    L = _lib2()
+    # variable + atomic symbol + compose
+    data = ctypes.c_void_p()
+    assert L.MXSymbolCreateVariable(b"data", ctypes.byref(data)) == 0
+    op = ctypes.c_void_p()
+    assert L.NNGetOpHandle(b"FullyConnected", ctypes.byref(op)) == 0
+    keys = (ctypes.c_char_p * 1)(b"num_hidden")
+    vals = (ctypes.c_char_p * 1)(b"8")
+    fc = ctypes.c_void_p()
+    assert L.MXSymbolCreateAtomicSymbol(op, 1, keys, vals,
+                                        ctypes.byref(fc)) == 0, \
+        L.MXGetLastError()
+    args = (ctypes.c_void_p * 1)(data)
+    assert L.MXSymbolCompose(fc, b"fc1", 1, None, args) == 0, \
+        L.MXGetLastError()
+
+    n = ctypes.c_uint()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    assert L.MXSymbolListArguments(fc, ctypes.byref(n), ctypes.byref(arr)) == 0
+    assert [arr[i].decode() for i in range(n.value)] == \
+        ["data", "fc1_weight", "fc1_bias"]
+
+    name = ctypes.c_char_p()
+    ok = ctypes.c_int()
+    assert L.MXSymbolGetName(fc, ctypes.byref(name), ctypes.byref(ok)) == 0
+    assert ok.value == 1 and name.value == b"fc1"
+
+    # attrs
+    assert L.MXSymbolSetAttr(fc, b"lr_mult", b"2.0") == 0
+    got = ctypes.c_char_p()
+    assert L.MXSymbolGetAttr(fc, b"lr_mult", ctypes.byref(got),
+                             ctypes.byref(ok)) == 0
+    assert ok.value == 1 and got.value == b"2.0"
+
+    # json roundtrip + copy + internals/output
+    js = ctypes.c_char_p()
+    assert L.MXSymbolSaveToJSON(fc, ctypes.byref(js)) == 0
+    h2 = ctypes.c_void_p()
+    assert L.MXSymbolCreateFromJSON(js.value, ctypes.byref(h2)) == 0, \
+        L.MXGetLastError()
+    cp = ctypes.c_void_p()
+    assert L.MXSymbolCopy(fc, ctypes.byref(cp)) == 0
+    internals = ctypes.c_void_p()
+    assert L.MXSymbolGetInternals(fc, ctypes.byref(internals)) == 0
+    out0 = ctypes.c_void_p()
+    assert L.MXSymbolGetOutput(internals, 0, ctypes.byref(out0)) == 0
+    children = ctypes.c_void_p()
+    assert L.MXSymbolGetChildren(fc, ctypes.byref(children)) == 0
+    assert children.value is not None
+
+    # infer type: float32 in -> float32 out
+    tk = (ctypes.c_char_p * 1)(b"data")
+    tc = (ctypes.c_int * 1)(0)
+    in_n, out_n, aux_n = ctypes.c_uint(), ctypes.c_uint(), ctypes.c_uint()
+    in_t = ctypes.POINTER(ctypes.c_int)()
+    out_t = ctypes.POINTER(ctypes.c_int)()
+    aux_t = ctypes.POINTER(ctypes.c_int)()
+    comp = ctypes.c_int()
+    assert L.MXSymbolInferType(fc, 1, tk, tc, ctypes.byref(in_n),
+                               ctypes.byref(in_t), ctypes.byref(out_n),
+                               ctypes.byref(out_t), ctypes.byref(aux_n),
+                               ctypes.byref(aux_t), ctypes.byref(comp)) == 0
+    assert comp.value == 1 and out_t[0] == 0
+
+    for h in (data, fc, h2, cp, internals, out0, children):
+        L.MXSymbolFree(h)
+
+
+def test_atomic_symbol_info():
+    L = _lib2()
+    n = ctypes.c_uint()
+    creators = ctypes.POINTER(ctypes.c_void_p)()
+    assert L.MXSymbolListAtomicSymbolCreators(ctypes.byref(n),
+                                              ctypes.byref(creators)) == 0
+    assert n.value > 200
+    name = ctypes.c_char_p()
+    assert L.MXSymbolGetAtomicSymbolName(creators[0],
+                                         ctypes.byref(name)) == 0
+    assert len(name.value) > 0
+
+    op = ctypes.c_void_p()
+    assert L.NNGetOpHandle(b"Convolution", ctypes.byref(op)) == 0
+    desc = ctypes.c_char_p()
+    num_args = ctypes.c_uint()
+    an = ctypes.POINTER(ctypes.c_char_p)()
+    at = ctypes.POINTER(ctypes.c_char_p)()
+    ad = ctypes.POINTER(ctypes.c_char_p)()
+    kv = ctypes.c_char_p()
+    rt = ctypes.c_char_p()
+    assert L.MXSymbolGetAtomicSymbolInfo(
+        op, ctypes.byref(name), ctypes.byref(desc), ctypes.byref(num_args),
+        ctypes.byref(an), ctypes.byref(at), ctypes.byref(ad),
+        ctypes.byref(kv), ctypes.byref(rt)) == 0
+    assert name.value == b"Convolution"
+    names = [an[i].decode() for i in range(num_args.value)]
+    assert "data" in names and "weight" in names
+
+
+def test_simple_bind_forward_backward():
+    L = _lib2()
+    sym = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                name="fc")
+    js = sym.tojson().encode()
+    h = ctypes.c_void_p()
+    assert L.MXSymbolCreateFromJSON(js, ctypes.byref(h)) == 0
+
+    # simple bind: data shape provided, grad_req write for all
+    shape_names = (ctypes.c_char_p * 1)(b"data")
+    shape_idx = (ctypes.c_uint * 2)(0, 2)
+    shape_data = (ctypes.c_uint * 2)(5, 3)
+    req_types = (ctypes.c_char_p * 1)(b"write")
+    num_in = ctypes.c_uint()
+    in_args = ctypes.POINTER(ctypes.c_void_p)()
+    arg_grads = ctypes.POINTER(ctypes.c_void_p)()
+    num_aux = ctypes.c_uint()
+    aux = ctypes.POINTER(ctypes.c_void_p)()
+    ex = ctypes.c_void_p()
+    shared_len = ctypes.c_int(-1)
+    assert L.MXExecutorSimpleBind(
+        h, 1, 0,
+        0, None, None, None,            # group2ctx
+        1, None, req_types,             # grad reqs (global "write")
+        1, shape_names, shape_data, shape_idx,
+        0, None, None,                  # dtypes
+        0, None, None,                  # stypes
+        0, None,                        # shared arg names
+        ctypes.byref(shared_len), None, None, None, None,
+        ctypes.byref(num_in), ctypes.byref(in_args), ctypes.byref(arg_grads),
+        ctypes.byref(num_aux), ctypes.byref(aux),
+        None, ctypes.byref(ex)) == 0, L.MXGetLastError()
+    assert num_in.value == 3  # data, fc_weight, fc_bias
+    assert in_args[0] is not None and arg_grads[0] is not None
+
+    # seed inputs, forward, backward
+    x = np.random.RandomState(0).rand(5, 3).astype(np.float32)
+    buf = (ctypes.c_float * x.size)(*x.ravel())
+    assert L.MXNDArraySyncCopyFromCPU(ctypes.c_void_p(in_args[0]), buf,
+                                      x.size) == 0
+    assert L.MXExecutorForward(ex, 1) == 0
+    n_outs = ctypes.c_uint()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    assert L.MXExecutorOutputs(ex, ctypes.byref(n_outs),
+                               ctypes.byref(outs)) == 0
+    assert n_outs.value == 1
+    og = _make_nd(L, np.ones((5, 4), np.float32))
+    heads = (ctypes.c_void_p * 1)(og)
+    assert L.MXExecutorBackwardEx(ex, 1, heads, 1) == 0, L.MXGetLastError()
+    s = ctypes.c_char_p()
+    assert L.MXExecutorPrint(ex, ctypes.byref(s)) == 0
+    assert b"Executor" in s.value
+    L.MXExecutorFree(ex)
+    L.MXSymbolFree(h)
+
+
+def test_cached_op():
+    L = _lib2()
+    sym = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                                name="fc")
+    h = ctypes.c_void_p()
+    assert L.MXSymbolCreateFromJSON(sym.tojson().encode(),
+                                    ctypes.byref(h)) == 0
+    cop = ctypes.c_void_p()
+    assert L.MXCreateCachedOp(h, ctypes.byref(cop)) == 0, L.MXGetLastError()
+    rs = np.random.RandomState(1)
+    x = rs.rand(3, 4).astype(np.float32)
+    w = rs.rand(2, 4).astype(np.float32)
+    b = np.zeros(2, np.float32)
+    ins = (ctypes.c_void_p * 3)(_make_nd(L, x), _make_nd(L, w),
+                                _make_nd(L, b))
+    n_out = ctypes.c_int(0)
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    assert L.MXInvokeCachedOp(cop, 3, ins, ctypes.byref(n_out),
+                              ctypes.byref(outs)) == 0, L.MXGetLastError()
+    assert n_out.value == 1
+    np.testing.assert_allclose(_read_nd(L, outs[0], 6).reshape(3, 2),
+                               x @ w.T, rtol=1e-5)
+    assert L.MXFreeCachedOp(cop) == 0
+    L.MXSymbolFree(h)
+
+
+def test_autograd_c_api():
+    L = _lib2()
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    h = _make_nd(L, x)
+    g = _make_nd(L, np.zeros_like(x))
+    vars_ = (ctypes.c_void_p * 1)(h)
+    reqs = (ctypes.c_uint * 1)(1)  # write
+    grads = (ctypes.c_void_p * 1)(g)
+    assert L.MXAutogradMarkVariables(1, vars_, reqs, grads) == 0, \
+        L.MXGetLastError()
+    prev = ctypes.c_int(-1)
+    assert L.MXAutogradSetIsRecording(1, ctypes.byref(prev)) == 0
+    assert L.MXAutogradSetIsTraining(1, ctypes.byref(prev)) == 0
+    rec = ctypes.c_bool(False)
+    assert L.MXAutogradIsRecording(ctypes.byref(rec)) == 0
+    assert rec.value
+
+    op = ctypes.c_void_p()
+    assert L.NNGetOpHandle(b"square", ctypes.byref(op)) == 0
+    ins = (ctypes.c_void_p * 1)(h)
+    n_out = ctypes.c_int(0)
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    assert L.MXImperativeInvoke(op, 1, ins, ctypes.byref(n_out),
+                                ctypes.byref(outs), 0, None, None) == 0
+    y = ctypes.c_void_p(outs[0])
+    out_handles = (ctypes.c_void_p * 1)(y)
+    assert L.MXAutogradBackward(1, out_handles, None, 0) == 0, \
+        L.MXGetLastError()
+    assert L.MXAutogradSetIsRecording(0, ctypes.byref(prev)) == 0
+    assert L.MXAutogradSetIsTraining(0, ctypes.byref(prev)) == 0
+    np.testing.assert_allclose(_read_nd(L, g, 4).reshape(2, 2), 2 * x)
+
+    gh = ctypes.c_void_p()
+    assert L.MXNDArrayGetGrad(h, ctypes.byref(gh)) == 0
+    assert gh.value is not None
+    for hh in (h, g, y, gh):
+        L.MXNDArrayFree(hh)
+
+
+def test_data_iter_c_api(tmp_path):
+    L = _lib2()
+    n = ctypes.c_uint()
+    creators = ctypes.POINTER(ctypes.c_void_p)()
+    assert L.MXListDataIters(ctypes.byref(n), ctypes.byref(creators)) == 0
+    names = {}
+    for i in range(n.value):
+        nm = ctypes.c_char_p()
+        assert L.MXSymbolGetAtomicSymbolName(creators[i],
+                                             ctypes.byref(nm)) == 0
+        names[nm.value.decode()] = creators[i]
+    assert "CSVIter" in names and "MNISTIter" in names
+
+    # iter info
+    nm = ctypes.c_char_p()
+    desc = ctypes.c_char_p()
+    num_args = ctypes.c_uint()
+    an = ctypes.POINTER(ctypes.c_char_p)()
+    at = ctypes.POINTER(ctypes.c_char_p)()
+    ad = ctypes.POINTER(ctypes.c_char_p)()
+    assert L.MXDataIterGetIterInfo(names["CSVIter"], ctypes.byref(nm),
+                                   ctypes.byref(desc), ctypes.byref(num_args),
+                                   ctypes.byref(an), ctypes.byref(at),
+                                   ctypes.byref(ad)) == 0
+    assert nm.value == b"CSVIter"
+
+    # create + drain a CSVIter over a small file
+    data = np.arange(24, dtype=np.float32).reshape(6, 4)
+    csv = tmp_path / "d.csv"
+    np.savetxt(str(csv), data, delimiter=",", fmt="%.1f")
+    keys = (ctypes.c_char_p * 3)(b"data_csv", b"data_shape", b"batch_size")
+    vals = (ctypes.c_char_p * 3)(str(csv).encode(), b"(4,)", b"2")
+    it = ctypes.c_void_p()
+    assert L.MXDataIterCreateIter(names["CSVIter"], 3, keys, vals,
+                                  ctypes.byref(it)) == 0, L.MXGetLastError()
+    seen = 0
+    has = ctypes.c_int(1)
+    while True:
+        assert L.MXDataIterNext(it, ctypes.byref(has)) == 0
+        if not has.value:
+            break
+        d = ctypes.c_void_p()
+        assert L.MXDataIterGetData(it, ctypes.byref(d)) == 0
+        vals_np = _read_nd(L, d, 8).reshape(2, 4)
+        np.testing.assert_allclose(vals_np, data[seen * 2:(seen + 1) * 2])
+        pad = ctypes.c_int(-1)
+        assert L.MXDataIterGetPadNum(it, ctypes.byref(pad)) == 0
+        assert pad.value == 0
+        L.MXNDArrayFree(d)
+        seen += 1
+    assert seen == 3
+    assert L.MXDataIterBeforeFirst(it) == 0
+    assert L.MXDataIterNext(it, ctypes.byref(has)) == 0 and has.value == 1
+    assert L.MXDataIterFree(it) == 0
+
+
+def test_kvstore_c_api():
+    L = _lib2()
+    kv = ctypes.c_void_p()
+    assert L.MXKVStoreCreate(b"local", ctypes.byref(kv)) == 0
+    t = ctypes.c_char_p()
+    assert L.MXKVStoreGetType(kv, ctypes.byref(t)) == 0
+    assert t.value == b"local"
+    r = ctypes.c_int(-1)
+    assert L.MXKVStoreGetRank(kv, ctypes.byref(r)) == 0 and r.value == 0
+    assert L.MXKVStoreGetGroupSize(kv, ctypes.byref(r)) == 0 and r.value == 1
+
+    init_v = _make_nd(L, np.zeros((2, 2), np.float32))
+    keys = (ctypes.c_int * 1)(7)
+    vals = (ctypes.c_void_p * 1)(init_v)
+    assert L.MXKVStoreInit(kv, 1, keys, vals) == 0, L.MXGetLastError()
+
+    push_v = _make_nd(L, np.full((2, 2), 3.0, np.float32))
+    vals2 = (ctypes.c_void_p * 1)(push_v)
+    assert L.MXKVStorePush(kv, 1, keys, vals2, 0) == 0, L.MXGetLastError()
+
+    out_v = _make_nd(L, np.zeros((2, 2), np.float32))
+    vals3 = (ctypes.c_void_p * 1)(out_v)
+    assert L.MXKVStorePull(kv, 1, keys, vals3, 0) == 0, L.MXGetLastError()
+    np.testing.assert_allclose(_read_nd(L, out_v, 4), 3.0)
+
+    # C-callback updater: new = local - 0.5 * recv
+    calls = []
+    CB = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_void_p,
+                          ctypes.c_void_p, ctypes.c_void_p)
+
+    def updater(key, recv, local, handle):
+        calls.append(key)
+        rbuf = (ctypes.c_float * 4)()
+        lbuf = (ctypes.c_float * 4)()
+        assert L.MXNDArraySyncCopyToCPU(recv, rbuf, 4) == 0
+        assert L.MXNDArraySyncCopyToCPU(local, lbuf, 4) == 0
+        new = (ctypes.c_float * 4)(*[lbuf[i] - 0.5 * rbuf[i]
+                                     for i in range(4)])
+        assert L.MXNDArraySyncCopyFromCPU(local, new, 4) == 0
+        L.MXNDArrayFree(recv)
+        L.MXNDArrayFree(local)
+
+    cb = CB(updater)
+    assert L.MXKVStoreSetUpdater(kv, ctypes.cast(cb, ctypes.c_void_p),
+                                 None) == 0, L.MXGetLastError()
+    assert L.MXKVStorePush(kv, 1, keys, vals2, 0) == 0, L.MXGetLastError()
+    assert calls == [7]
+    assert L.MXKVStorePull(kv, 1, keys, vals3, 0) == 0
+    np.testing.assert_allclose(_read_nd(L, out_v, 4), 3.0 - 1.5)
+
+    assert L.MXKVStoreBarrier(kv) == 0
+    assert L.MXKVStoreSetBarrierBeforeExit(kv, 1) == 0
+    dead = ctypes.c_int(-1)
+    assert L.MXKVStoreGetNumDeadNode(kv, 0, ctypes.byref(dead), 60) == 0
+    assert dead.value == 0
+    assert L.MXKVStoreFree(kv) == 0
+    for hh in (init_v, push_v, out_v):
+        L.MXNDArrayFree(hh)
+
+
+def test_recordio_c_api(tmp_path):
+    L = _lib2()
+    path = str(tmp_path / "c.rec").encode()
+    w = ctypes.c_void_p()
+    assert L.MXRecordIOWriterCreate(path, ctypes.byref(w)) == 0, \
+        L.MXGetLastError()
+    for payload in (b"first-record", b"second"):
+        assert L.MXRecordIOWriterWriteRecord(w, payload, len(payload)) == 0
+    pos = ctypes.c_size_t()
+    assert L.MXRecordIOWriterTell(w, ctypes.byref(pos)) == 0
+    assert pos.value > 0
+    assert L.MXRecordIOWriterFree(w) == 0
+
+    r = ctypes.c_void_p()
+    assert L.MXRecordIOReaderCreate(path, ctypes.byref(r)) == 0
+    buf = ctypes.c_char_p()
+    size = ctypes.c_size_t()
+    assert L.MXRecordIOReaderReadRecord(r, ctypes.byref(buf),
+                                        ctypes.byref(size)) == 0
+    assert ctypes.string_at(buf, size.value) == b"first-record"
+    assert L.MXRecordIOReaderReadRecord(r, ctypes.byref(buf),
+                                        ctypes.byref(size)) == 0
+    assert ctypes.string_at(buf, size.value) == b"second"
+    assert L.MXRecordIOReaderReadRecord(r, ctypes.byref(buf),
+                                        ctypes.byref(size)) == 0
+    assert size.value == 0  # EOF
+    assert L.MXRecordIOReaderFree(r) == 0
+
+
+def test_kvstore_str_updater_ex():
+    """MXKVStoreSetUpdaterEx installs BOTH key forms; string-key pushes
+    route to the str updater (reference MXKVStoreStrUpdater contract)."""
+    L = _lib2()
+    L.MXKVStoreSetUpdaterEx.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                        ctypes.c_void_p, ctypes.c_void_p]
+    kv = ctypes.c_void_p()
+    assert L.MXKVStoreCreate(b"local", ctypes.byref(kv)) == 0
+    init_v = _make_nd(L, np.zeros((2,), np.float32))
+    keys = (ctypes.c_char_p * 1)(b"weight")
+    vals = (ctypes.c_void_p * 1)(init_v)
+    assert L.MXKVStoreInitEx(kv, 1, keys, vals) == 0, L.MXGetLastError()
+
+    got_keys = []
+    ICB = ctypes.CFUNCTYPE(None, ctypes.c_int, ctypes.c_void_p,
+                           ctypes.c_void_p, ctypes.c_void_p)
+    SCB = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p,
+                           ctypes.c_void_p, ctypes.c_void_p)
+
+    def int_updater(key, recv, local, handle):
+        got_keys.append(key)
+        L.MXNDArrayFree(recv)
+        L.MXNDArrayFree(local)
+
+    def str_updater(key, recv, local, handle):
+        got_keys.append(key)
+        buf = (ctypes.c_float * 2)()
+        assert L.MXNDArraySyncCopyToCPU(recv, buf, 2) == 0
+        assert L.MXNDArraySyncCopyFromCPU(local, buf, 2) == 0
+        L.MXNDArrayFree(recv)
+        L.MXNDArrayFree(local)
+
+    icb, scb = ICB(int_updater), SCB(str_updater)
+    assert L.MXKVStoreSetUpdaterEx(kv, ctypes.cast(icb, ctypes.c_void_p),
+                                   ctypes.cast(scb, ctypes.c_void_p),
+                                   None) == 0, L.MXGetLastError()
+    push_v = _make_nd(L, np.array([1.5, 2.5], np.float32))
+    vals2 = (ctypes.c_void_p * 1)(push_v)
+    assert L.MXKVStorePushEx(kv, 1, keys, vals2, 0) == 0, L.MXGetLastError()
+    assert got_keys == [b"weight"]
+    out_v = _make_nd(L, np.zeros((2,), np.float32))
+    vals3 = (ctypes.c_void_p * 1)(out_v)
+    assert L.MXKVStorePullEx(kv, 1, keys, vals3, 0) == 0
+    np.testing.assert_allclose(_read_nd(L, out_v, 2), [1.5, 2.5])
+    assert L.MXKVStoreFree(kv) == 0
