@@ -48,6 +48,12 @@ int MXSymbolInferShape(SymbolHandle, mx_uint, const char**, const mx_uint*,
                        const mx_uint***, mx_uint*, const mx_uint**,
                        const mx_uint***, mx_uint*, const mx_uint**,
                        const mx_uint***, int*);
+int MXSymbolCreateVariable(const char*, SymbolHandle*);
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator, mx_uint, const char**,
+                               const char**, SymbolHandle*);
+int MXSymbolCompose(SymbolHandle, const char*, mx_uint, const char**,
+                    SymbolHandle*);
+int MXSymbolSaveToJSON(SymbolHandle, const char**);
 int MXExecutorBind(SymbolHandle, int, int, mx_uint, NDArrayHandle*,
                    NDArrayHandle*, mx_uint*, mx_uint, NDArrayHandle*,
                    ExecutorHandle*);
@@ -161,6 +167,7 @@ class Op {
 
 class Symbol {
  public:
+  Symbol() = default;
   static Symbol Load(const std::string& path) {
     SymbolHandle h = nullptr;
     Check(MXSymbolCreateFromFile(path.c_str(), &h), "SymbolLoad");
@@ -171,7 +178,20 @@ class Symbol {
     Check(MXSymbolCreateFromJSON(json.c_str(), &h), "SymbolLoadJSON");
     return Symbol(h);
   }
+  static Symbol Variable(const std::string& name) {
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateVariable(name.c_str(), &h), "SymbolVariable");
+    return Symbol(h);
+  }
+  // adopt an owned SymbolHandle (used by Operator::CreateSymbol)
+  static Symbol FromHandle(SymbolHandle h) { return Symbol(h); }
   SymbolHandle handle() const { return h_ ? h_->ptr : nullptr; }
+
+  std::string ToJSON() const {
+    const char* js = nullptr;
+    Check(MXSymbolSaveToJSON(handle(), &js), "SymbolToJSON");
+    return std::string(js != nullptr ? js : "");
+  }
 
   std::vector<std::string> ListArguments() const {
     return StrList(&MXSymbolListArguments);
@@ -231,6 +251,67 @@ class Symbol {
     for (mx_uint i = 0; i < n; ++i) out.emplace_back(arr[i]);
     return out;
   }
+};
+
+// Symbol-graph composition builder (parity: reference mxnet-cpp
+// Operator — the class every generated op wrapper in mxnet_cpp_ops.hpp
+// drives): CreateAtomicSymbol with string params, then Compose with the
+// named inputs.
+class Operator {
+ public:
+  explicit Operator(const std::string& op_name) : op_name_(op_name) {}
+
+  Operator& SetParam(const std::string& key, const std::string& value) {
+    keys_.push_back(key);
+    vals_.push_back(value);
+    return *this;
+  }
+  Operator& SetParam(const std::string& key, const char* value) {
+    return SetParam(key, std::string(value));
+  }
+  Operator& SetParam(const std::string& key, bool value) {
+    return SetParam(key, std::string(value ? "True" : "False"));
+  }
+  Operator& SetParam(const std::string& key, int value) {
+    return SetParam(key, std::to_string(value));
+  }
+  Operator& SetParam(const std::string& key, double value) {
+    return SetParam(key, std::to_string(value));
+  }
+  Operator& SetInput(const std::string& name, const Symbol& s) {
+    input_keys_.push_back(name);
+    inputs_.push_back(s);
+    return *this;
+  }
+
+  Symbol CreateSymbol(const std::string& name = "") {
+    AtomicSymbolCreator op = nullptr;
+    Check(NNGetOpHandle(op_name_.c_str(), &op),
+          ("op " + op_name_).c_str());
+    std::vector<const char*> ks, vs;
+    for (auto& k : keys_) ks.push_back(k.c_str());
+    for (auto& v : vals_) vs.push_back(v.c_str());
+    SymbolHandle h = nullptr;
+    Check(MXSymbolCreateAtomicSymbol(op,
+                                     static_cast<mx_uint>(ks.size()),
+                                     ks.data(), vs.data(), &h),
+          "CreateAtomicSymbol");
+    std::vector<const char*> ik;
+    std::vector<SymbolHandle> ih;
+    for (auto& k : input_keys_) ik.push_back(k.c_str());
+    for (auto& s : inputs_) ih.push_back(s.handle());
+    Check(MXSymbolCompose(h, name.c_str(),
+                          static_cast<mx_uint>(ih.size()), ik.data(),
+                          ih.data()),
+          "SymbolCompose");
+    return Symbol::FromHandle(h);
+  }
+
+ private:
+  std::string op_name_;
+  std::vector<std::string> keys_, vals_;
+  std::vector<std::string> input_keys_;
+  std::vector<Symbol> inputs_;
 };
 
 enum OpReqType { kNullOp = 0, kWriteTo = 1 };

@@ -301,9 +301,26 @@ def ndarray_get_grad_state(nd):
 
 
 def ndarray_sync_copy_from_ndarray(dst, src, i):
-    if int(i) >= 0:
-        src = src[int(i)]
-    dst._set_data(src._data)
+    """i < 0 copies the data array; i >= 0 copies src.aux_data(i) — the
+    reference contract (c_api.h MXNDArraySyncCopyFromNDArray), where aux
+    arrays are csr [indptr, indices] / row_sparse [indices]."""
+    i = int(i)
+    if i < 0:
+        dst._set_data(src._data)
+        return
+    from .ndarray import sparse as _sp
+    if isinstance(src, _sp.CSRNDArray):
+        aux = [src._csr_indptr, src._csr_indices]
+    elif isinstance(src, _sp.RowSparseNDArray):
+        aux = [src._rsp_indices]
+    else:
+        raise MXNetError(
+            "aux_data(%d) requested on dense NDArray (aux arrays exist "
+            "only for sparse storage)" % i)
+    if i >= len(aux):
+        raise MXNetError("aux_data index %d out of range (%d aux arrays)"
+                         % (i, len(aux)))
+    dst._set_data(aux[i])
 
 
 def ndarray_save_raw_bytes(nd):
@@ -522,10 +539,15 @@ def executor_simple_bind(s, dev_type, dev_id, g2c_keys, g2c_dev_types,
     accepted and ignored at the C layer (PJRT owns allocation; reuse is
     an allocator hint in the reference)."""
     sym = _sym(s)
+    # reference calling conventions (c_api_executor.cc): names+types is
+    # the dict form; names==NULL with ONE type is the global string; a
+    # bare list (no names) applies in list_arguments() order
     if req_names:
         grad_req = dict(zip(req_names, req_types))
-    elif req_types:
+    elif len(req_types) == 1:
         grad_req = req_types[0]
+    elif req_types:
+        grad_req = list(req_types)
     else:
         grad_req = "write"
     type_dict = {n: _DTYPE_BY_CODE[int(c)]

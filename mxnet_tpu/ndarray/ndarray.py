@@ -36,7 +36,9 @@ __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
 class NDArray:
     """Multi-dimensional, asynchronously-evaluated array on a device."""
 
-    __slots__ = ("_data", "_ctx", "_grad", "_tape", "_stype", "__weakref__")
+    # _fresh_grad backs MXNDArray{Set,Get}GradState on the C ABI
+    __slots__ = ("_data", "_ctx", "_grad", "_tape", "_stype", "_fresh_grad",
+                 "__weakref__")
 
     __array_priority__ = 100.0  # beat numpy in mixed expressions
 

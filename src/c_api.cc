@@ -747,6 +747,44 @@ PyObject* ShapeLists(mx_uint num_args, const mx_uint* ind_ptr,
   return shapes;
 }
 
+// unpack (name, description, names[], types[], descs[]) info tuples —
+// shared by MXSymbolGetAtomicSymbolInfo and MXDataIterGetIterInfo; the
+// two string scalars land in g_ret_str/g_ret_str2, the three lists in
+// g_info_store with per-group pointer arrays in g_info_ptrs
+void UnpackInfoGroups(PyObject* r, const char** name,
+                      const char** description, mx_uint* num_args,
+                      const char*** arg_names, const char*** arg_type_infos,
+                      const char*** arg_descriptions) {
+  g_info_store.clear();
+  const char* c0 = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
+  const char* c1 = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
+  g_ret_str = c0 ? c0 : "";
+  g_ret_str2 = c1 ? c1 : "";
+  size_t counts[3];
+  for (int grp = 0; grp < 3; ++grp) {
+    PyObject* lst = PyTuple_GetItem(r, 2 + grp);
+    Py_ssize_t cnt = PyList_Size(lst);
+    counts[grp] = static_cast<size_t>(cnt);
+    for (Py_ssize_t i = 0; i < cnt; ++i) {
+      const char* c = PyUnicode_AsUTF8(PyList_GetItem(lst, i));
+      g_info_store.emplace_back(c ? c : "");
+    }
+  }
+  size_t off = 0;
+  for (int grp = 0; grp < 3; ++grp) {
+    g_info_ptrs[grp].clear();
+    for (size_t i = 0; i < counts[grp]; ++i)
+      g_info_ptrs[grp].push_back(g_info_store[off + i].c_str());
+    off += counts[grp];
+  }
+  *name = g_ret_str.c_str();
+  *description = g_ret_str2.c_str();
+  *num_args = static_cast<mx_uint>(counts[0]);
+  *arg_names = g_info_ptrs[0].data();
+  *arg_type_infos = g_info_ptrs[1].data();
+  *arg_descriptions = g_info_ptrs[2].data();
+}
+
 // unpack the 3-group shape tuple exactly like MXSymbolInferShape does
 int UnpackShapeGroups(PyObject* r, mx_uint* in_shape_size,
                       const mx_uint** in_shape_ndim,
@@ -1101,37 +1139,11 @@ int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
     PyGILState_Release(gil);
     return -1;
   }
-  g_info_store.clear();
-  const char* c0 = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
-  const char* c1 = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
   const char* c5 = PyUnicode_AsUTF8(PyTuple_GetItem(r, 5));
-  g_ret_str = c0 ? c0 : "";
-  g_ret_str2 = c1 ? c1 : "";
   g_rec_buf = c5 ? c5 : "";
-  size_t counts[3];
-  for (int grp = 0; grp < 3; ++grp) {
-    PyObject* lst = PyTuple_GetItem(r, 2 + grp);
-    Py_ssize_t n = PyList_Size(lst);
-    counts[grp] = static_cast<size_t>(n);
-    for (Py_ssize_t i = 0; i < n; ++i) {
-      const char* c = PyUnicode_AsUTF8(PyList_GetItem(lst, i));
-      g_info_store.emplace_back(c ? c : "");
-    }
-  }
-  size_t off = 0;
-  for (int grp = 0; grp < 3; ++grp) {
-    g_info_ptrs[grp].clear();
-    for (size_t i = 0; i < counts[grp]; ++i)
-      g_info_ptrs[grp].push_back(g_info_store[off + i].c_str());
-    off += counts[grp];
-  }
+  UnpackInfoGroups(r, name, description, num_args, arg_names,
+                   arg_type_infos, arg_descriptions);
   Py_DECREF(r);
-  *name = g_ret_str.c_str();
-  *description = g_ret_str2.c_str();
-  *num_args = static_cast<mx_uint>(counts[0]);
-  *arg_names = g_info_ptrs[0].data();
-  *arg_type_infos = g_info_ptrs[1].data();
-  *arg_descriptions = g_info_ptrs[2].data();
   *key_var_num_args = g_rec_buf.c_str();
   if (return_type != nullptr) *return_type = "";
   PyGILState_Release(gil);
@@ -1256,8 +1268,13 @@ int MXExecutorSimpleBind(
   PyObject* reqn = StrList(provided_grad_req_names,
                            provided_grad_req_names != nullptr
                                ? provided_grad_req_list_len : 0);
-  PyObject* reqt = StrList(provided_grad_req_types,
-                           provided_grad_req_list_len);
+  // reference convention: a GLOBAL grad_req arrives as list_len==0 with
+  // a single-element types array (python/mxnet/symbol.py simple_bind)
+  mx_uint n_req_types = provided_grad_req_list_len;
+  if (provided_grad_req_names == nullptr && provided_grad_req_list_len == 0
+      && provided_grad_req_types != nullptr)
+    n_req_types = 1;
+  PyObject* reqt = StrList(provided_grad_req_types, n_req_types);
   PyObject* shn = StrList(provided_arg_shape_names, num_provided_arg_shapes);
   PyObject* shs = ShapeLists(num_provided_arg_shapes, provided_arg_shape_idx,
                              provided_arg_shape_data);
@@ -1495,35 +1512,9 @@ int MXDataIterGetIterInfo(DataIterCreator creator, const char** name,
     PyGILState_Release(gil);
     return -1;
   }
-  g_info_store.clear();
-  const char* c0 = PyUnicode_AsUTF8(PyTuple_GetItem(r, 0));
-  const char* c1 = PyUnicode_AsUTF8(PyTuple_GetItem(r, 1));
-  g_ret_str = c0 ? c0 : "";
-  g_ret_str2 = c1 ? c1 : "";
-  size_t counts[3];
-  for (int grp = 0; grp < 3; ++grp) {
-    PyObject* lst = PyTuple_GetItem(r, 2 + grp);
-    Py_ssize_t cnt = PyList_Size(lst);
-    counts[grp] = static_cast<size_t>(cnt);
-    for (Py_ssize_t i = 0; i < cnt; ++i) {
-      const char* c = PyUnicode_AsUTF8(PyList_GetItem(lst, i));
-      g_info_store.emplace_back(c ? c : "");
-    }
-  }
-  size_t off = 0;
-  for (int grp = 0; grp < 3; ++grp) {
-    g_info_ptrs[grp].clear();
-    for (size_t i = 0; i < counts[grp]; ++i)
-      g_info_ptrs[grp].push_back(g_info_store[off + i].c_str());
-    off += counts[grp];
-  }
+  UnpackInfoGroups(r, name, description, num_args, arg_names,
+                   arg_type_infos, arg_descriptions);
   Py_DECREF(r);
-  *name = g_ret_str.c_str();
-  *description = g_ret_str2.c_str();
-  *num_args = static_cast<mx_uint>(counts[0]);
-  *arg_names = g_info_ptrs[0].data();
-  *arg_type_infos = g_info_ptrs[1].data();
-  *arg_descriptions = g_info_ptrs[2].data();
   PyGILState_Release(gil);
   return 0;
 }
