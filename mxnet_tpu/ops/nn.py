@@ -319,18 +319,8 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     shape[axis] = data.shape[axis]
 
     g = jnp.ones_like(gamma) if fix_gamma else gamma
-    if _train and not use_global_stats:
-        # Batch statistics accumulate in fp32 even for bf16 activations
-        # (the convert fuses into the reduce — same HBM reads, exact sums);
-        # this is the reference's cudnn BN behaviour for fp16 inputs.
-        stat_in = data.astype(jnp.float32) \
-            if data.dtype in (jnp.bfloat16, jnp.float16) else data
-        mean = jnp.mean(stat_in, axis=red).astype(moving_mean.dtype)
-        var = jnp.var(stat_in, axis=red).astype(moving_var.dtype)
-    else:
-        mean, var = moving_mean, moving_var
-        mean = jax.lax.stop_gradient(mean)
-        var = jax.lax.stop_gradient(var)
+    mean, var = _bn_stats(data, moving_mean, moving_var, red, _train,
+                          use_global_stats)
     if data.dtype in (jnp.bfloat16, jnp.float16):
         # scale/offset in fp32, one fused multiply-add over the activations
         # in their own dtype (no fp32 upcast of the big tensor).
@@ -346,27 +336,110 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     return out, mean, var
 
 
-def _bn_stateful_update(raw_inputs, raw_outputs, params):
-    """Moving-stat update the reference BatchNorm kernel does in place."""
-    if not params.get("_train") or params.get("use_global_stats"):
-        return {}
-    momentum = params.get("momentum", 0.9)
-    _, mean, var = raw_outputs[:3]
-    new_mean = momentum * raw_inputs[3] + (1 - momentum) * mean
-    new_var = momentum * raw_inputs[4] + (1 - momentum) * var
-    return {3: new_mean, 4: new_var}
+def _bn_stats(data, moving_mean, moving_var, red, _train,
+              use_global_stats):
+    """Shared BN statistics: batch mean/var in training mode (fp32
+    accumulation for half dtypes — the reference's cudnn BN behaviour),
+    stop-gradiented moving stats otherwise. One source of truth for
+    BatchNorm and the fused _contrib_BatchNormAddReLU."""
+    if _train and not use_global_stats:
+        stat_in = data.astype(jnp.float32) \
+            if data.dtype in (jnp.bfloat16, jnp.float16) else data
+        mean = jnp.mean(stat_in, axis=red).astype(moving_mean.dtype)
+        var = jnp.var(stat_in, axis=red).astype(moving_var.dtype)
+        return mean, var
+    return (jax.lax.stop_gradient(moving_mean),
+            jax.lax.stop_gradient(moving_var))
 
 
-def _bn_param_dtypes(in_types, params):
+def _make_bn_stateful_update(mean_idx, var_idx):
+    """Moving-stat update the reference BatchNorm kernel does in place;
+    parameterized by the aux-input positions (BN: 3/4, fused: 4/5)."""
+
+    def update(raw_inputs, raw_outputs, params):
+        if not params.get("_train") or params.get("use_global_stats"):
+            return {}
+        momentum = params.get("momentum", 0.9)
+        _, mean, var = raw_outputs[:3]
+        new_mean = momentum * raw_inputs[mean_idx] + (1 - momentum) * mean
+        new_var = momentum * raw_inputs[var_idx] + (1 - momentum) * var
+        return {mean_idx: new_mean, var_idx: new_var}
+
+    return update
+
+
+_bn_stateful_update = _make_bn_stateful_update(3, 4)
+
+
+def _make_bn_param_dtypes(first_param_idx):
     """gamma/beta/moving stats stay fp32 under bf16/fp16 data (reference
     cudnn_batch_norm-inl.h keeps scale/bias/saved stats in fp32)."""
-    return {1: np.float32, 2: np.float32, 3: np.float32, 4: np.float32}
+    idxs = tuple(range(first_param_idx, first_param_idx + 4))
+
+    def infer(in_types, params):
+        return {i: np.float32 for i in idxs}
+
+    return infer
+
+
+_bn_param_dtypes = _make_bn_param_dtypes(1)
 
 
 _bn = get_op("BatchNorm")
 _bn.visible_outputs = 1
 _bn.aux_inputs = (3, 4)
 _bn.stateful_update = _bn_stateful_update
+
+
+@register("_contrib_BatchNormAddReLU", nin=6, jit=True,
+          arg_names=["data", "addend", "gamma", "beta", "moving_mean",
+                     "moving_var"],
+          nout=3,
+          defaults={"eps": 1e-3, "momentum": 0.9, "fix_gamma": True,
+                    "use_global_stats": False, "axis": 1,
+                    "cudnn_off": False})
+def batch_norm_add_relu(data, addend, gamma, beta, moving_mean, moving_var,
+                        eps=1e-3, momentum=0.9, fix_gamma=True,
+                        use_global_stats=False, axis=1, cudnn_off=False,
+                        _train=False):
+    """Fused BN + residual-add + ReLU — the ResNet block tail as one op
+    (contrib extension; the reference's cudnn era added the equivalent
+    BNAddRelu fusion for the same reason). Statistics follow BatchNorm
+    exactly; the apply+add+relu runs as ONE device pass (Pallas kernel
+    mxnet_tpu/pallas/fused_bn.py) when the channel axis is last — the
+    MXU-native layout — and as the composed XLA chain otherwise.
+
+    Returns (out, mean, var) with the same aux/moving-stat contract as
+    BatchNorm (the executor updates moving stats from outputs 1/2).
+    """
+    axis = int(axis) % data.ndim
+    red = tuple(i for i in range(data.ndim) if i != axis)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    mean, var = _bn_stats(data, moving_mean, moving_var, red, _train,
+                          use_global_stats)
+    # folded apply coefficients, fp32 (same folding as batch_norm above)
+    inv = jax.lax.rsqrt(var.astype(jnp.float32) + eps)
+    s = inv * g.astype(jnp.float32)
+    b = beta.astype(jnp.float32) - mean.astype(jnp.float32) * s
+    if axis == data.ndim - 1:
+        from ..pallas.fused_bn import scale_bias_add_relu
+        out = scale_bias_add_relu(data, s, b, addend)
+    else:
+        out = jnp.maximum(
+            data * s.astype(data.dtype).reshape(shape)
+            + b.astype(data.dtype).reshape(shape) + addend,
+            jnp.zeros((), data.dtype))
+    return out, mean, var
+
+
+_bnar = get_op("_contrib_BatchNormAddReLU")
+_bnar.visible_outputs = 1
+_bnar.aux_inputs = (4, 5)
+_bnar.stateful_update = _make_bn_stateful_update(4, 5)
+_bnar.param_dtype_infer = _make_bn_param_dtypes(2)
 _bn.param_dtype_infer = _bn_param_dtypes
 
 

@@ -60,6 +60,12 @@ def _bn(shapes, params):
     return {1: (c,), 2: (c,), 3: (c,), 4: (c,)}
 
 
+def _bn_add_relu(shapes, params):
+    c = shapes[0][int(params.get("axis", 1)) % len(shapes[0])]
+    # input 1 is the residual (same shape as data); 2-5 are BN params
+    return {1: tuple(shapes[0]), 2: (c,), 3: (c,), 4: (c,), 5: (c,)}
+
+
 def _instance_norm(shapes, params):
     c = shapes[0][1]
     return {1: (c,), 2: (c,)}
@@ -112,6 +118,7 @@ def install():
     get_op("Deconvolution").param_shape_infer = _deconv
     get_op("BatchNorm").param_shape_infer = _bn
     get_op("BatchNorm_v1").param_shape_infer = _bn
+    get_op("_contrib_BatchNormAddReLU").param_shape_infer = _bn_add_relu
     get_op("InstanceNorm").param_shape_infer = _instance_norm
     get_op("LayerNorm").param_shape_infer = _layer_norm
     get_op("Embedding").param_shape_infer = _embedding

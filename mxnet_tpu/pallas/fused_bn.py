@@ -1,0 +1,134 @@
+"""Fused BatchNorm-apply + residual-add + ReLU as a Pallas TPU kernel.
+
+The perf lever PERF.md's xprof analysis calls for: at a ResNet block
+tail the compiler's fusion boundary sits at the convolution output, so
+the BN normalize, the residual add and the ReLU can land in a separate
+elementwise pass over the (N,H,W,C) activation — one extra HBM
+round-trip of the largest tensors in the model. This kernel performs
+
+    out = max(x * scale + bias + residual, 0)
+
+in ONE pass: per-channel ``scale``/``bias`` are the folded BN apply
+coefficients (scale = gamma * rsqrt(var + eps), bias = beta -
+mean * scale — the same folding ops/nn.py:batch_norm does), so the whole
+block tail reads x and residual once and writes out once.
+
+Layout: channels-LAST (the framework's MXU-native layout,
+mxnet_tpu/layout.py) — the channel dim maps to the 128-wide lane
+dimension, rows of the flattened (N*H*W, C) view map to sublanes.
+
+``interpret=True`` off-TPU so the unit suite runs on the CPU mesh.
+
+Backward is a custom VJP in plain XLA (one fused elementwise pass as
+well): with ``m = out > 0``, dx = g*m*scale, dresidual = g*m,
+dscale = sum_rows(g*m*x), dbias = sum_rows(g*m).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["scale_bias_add_relu"]
+
+BLOCK_ROWS = 256
+BLOCK_COLS = 512
+
+
+def _use_interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _kernel(x_ref, s_ref, b_ref, r_ref, o_ref):
+    x = x_ref[...]
+    y = x * s_ref[...] + b_ref[...]
+    if r_ref is not None:
+        y = y + r_ref[...]
+    o_ref[...] = jnp.maximum(y, jnp.zeros((), y.dtype))
+
+
+def _kernel_nores(x_ref, s_ref, b_ref, o_ref):
+    _kernel(x_ref, s_ref, b_ref, None, o_ref)
+
+
+@functools.partial(jax.jit, static_argnums=(4,))
+def _fused_fwd(x2, s, b, r2, interpret):
+    m, c = x2.shape
+    bm = min(BLOCK_ROWS, m)
+    bc = min(BLOCK_COLS, c)
+    grid = (pl.cdiv(m, bm), pl.cdiv(c, bc))
+    x_spec = pl.BlockSpec((bm, bc), lambda i, j: (i, j))
+    v_spec = pl.BlockSpec((1, bc), lambda i, j: (0, j))
+    if r2 is not None:
+        return pl.pallas_call(
+            _kernel,
+            grid=grid,
+            in_specs=[x_spec, v_spec, v_spec, x_spec],
+            out_specs=x_spec,
+            out_shape=jax.ShapeDtypeStruct((m, c), x2.dtype),
+            interpret=interpret,
+        )(x2, s[None, :], b[None, :], r2)
+    return pl.pallas_call(
+        _kernel_nores,
+        grid=grid,
+        in_specs=[x_spec, v_spec, v_spec],
+        out_specs=x_spec,
+        out_shape=jax.ShapeDtypeStruct((m, c), x2.dtype),
+        interpret=interpret,
+    )(x2, s[None, :], b[None, :])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _fused(x, scale, bias, residual, interpret):
+    c = x.shape[-1]
+    x2 = x.reshape(-1, c)
+    r2 = residual.reshape(-1, c) if residual is not None else None
+    out = _fused_fwd(x2, scale.astype(x.dtype), bias.astype(x.dtype), r2,
+                     interpret)
+    return out.reshape(x.shape)
+
+
+def _fused_vjp_fwd(x, scale, bias, residual, interpret):
+    out = _fused(x, scale, bias, residual, interpret)
+    # bias rides along for its dtype (cotangents must match primal
+    # dtypes); residual presence is static via the None subtree
+    return out, (x, scale, bias, out, residual)
+
+
+def _fused_vjp_bwd(interpret, res, g):
+    x, scale, bias, out, residual = res
+    m = (out > 0).astype(g.dtype)
+    gm = g * m
+    red = tuple(range(x.ndim - 1))
+    dx = (gm * scale.astype(g.dtype)).astype(x.dtype)
+    dscale = jnp.sum(gm.astype(jnp.float32) * x.astype(jnp.float32),
+                     axis=red).astype(scale.dtype)
+    dbias = jnp.sum(gm.astype(jnp.float32), axis=red).astype(bias.dtype)
+    dres = gm.astype(residual.dtype) if residual is not None else None
+    return dx, dscale, dbias, dres
+
+
+_fused.defvjp(_fused_vjp_fwd, _fused_vjp_bwd)
+
+
+def scale_bias_add_relu(x, scale, bias, residual=None, interpret=None):
+    """``max(x * scale + bias [+ residual], 0)`` in one device pass.
+
+    x: (..., C) channels-last activation; scale/bias: (C,) folded BN
+    apply coefficients; residual: same shape as x or None.
+    Differentiable w.r.t. x, scale, bias, residual.
+    """
+    if interpret is None:
+        interpret = _use_interpret()
+    if residual is not None:
+        if residual.shape != x.shape:
+            raise ValueError("residual shape %s != x shape %s"
+                             % (residual.shape, x.shape))
+        # one compute dtype inside the kernel: the store dtype is pinned
+        # to x.dtype, and mixed inputs would promote the block (the
+        # composed fallback would silently promote instead — keep the
+        # two paths numerically identical)
+        residual = residual.astype(x.dtype)
+    return _fused(x, scale, bias, residual, bool(interpret))

@@ -5,6 +5,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+import mxnet_tpu as mx
 from mxnet_tpu import parallel
 from mxnet_tpu.pallas import flash_attention, flash_attention_carry
 
@@ -156,3 +157,129 @@ def test_flash_backward_pallas_vs_xla():
                     np.asarray(gp), np.asarray(gx), rtol=2e-4, atol=2e-4,
                     err_msg="%s causal=%s s=(%d,%d)"
                             % (name, causal, s_q, s_kv))
+
+
+# ---------------------------------------------------------------------------
+# Fused BN-apply + residual-add + ReLU (pallas/fused_bn.py + the
+# _contrib_BatchNormAddReLU registry op)
+# ---------------------------------------------------------------------------
+
+def test_scale_bias_add_relu_matches_composed():
+    import jax.numpy as jnp
+    from mxnet_tpu.pallas.fused_bn import scale_bias_add_relu
+    rs = np.random.RandomState(0)
+    # shapes chosen to hit: single block (105x33), a PARTIAL row block
+    # (280 rows > BLOCK_ROWS=256, not a multiple), and a partial column
+    # block (600 cols > BLOCK_COLS=512)
+    for shape in ((3, 5, 7, 33), (2, 20, 7, 33), (2, 2, 2, 600)):
+        c = shape[-1]
+        x = jnp.asarray(rs.randn(*shape).astype(np.float32))
+        r = jnp.asarray(rs.randn(*shape).astype(np.float32))
+        s = jnp.asarray(rs.randn(c).astype(np.float32))
+        b = jnp.asarray(rs.randn(c).astype(np.float32))
+        got = scale_bias_add_relu(x, s, b, r)
+        want = np.maximum(np.asarray(x) * np.asarray(s) + np.asarray(b)
+                          + np.asarray(r), 0.0)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6,
+                                   atol=1e-6)
+        # no-residual form
+        got2 = scale_bias_add_relu(x, s, b)
+        want2 = np.maximum(np.asarray(x) * np.asarray(s) + np.asarray(b),
+                           0.0)
+        np.testing.assert_allclose(np.asarray(got2), want2, rtol=1e-6,
+                                   atol=1e-6)
+
+
+def test_scale_bias_add_relu_bf16():
+    import jax.numpy as jnp
+    from mxnet_tpu.pallas.fused_bn import scale_bias_add_relu
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(4, 8, 8, 16)).astype(jnp.bfloat16)
+    r = jnp.asarray(rs.randn(4, 8, 8, 16)).astype(jnp.bfloat16)
+    s = jnp.asarray(rs.randn(16).astype(np.float32))
+    b = jnp.asarray(rs.randn(16).astype(np.float32))
+    got = scale_bias_add_relu(x, s, b, r)
+    assert got.dtype == jnp.bfloat16
+    want = np.maximum(
+        np.asarray(x, np.float32) * np.asarray(s.astype(jnp.bfloat16),
+                                               np.float32)
+        + np.asarray(b.astype(jnp.bfloat16), np.float32)
+        + np.asarray(r, np.float32), 0.0)
+    np.testing.assert_allclose(np.asarray(got, np.float32), want,
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_scale_bias_add_relu_grad():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.pallas.fused_bn import scale_bias_add_relu
+    rs = np.random.RandomState(2)
+    x = jnp.asarray(rs.randn(2, 3, 3, 9).astype(np.float32))
+    r = jnp.asarray(rs.randn(2, 3, 3, 9).astype(np.float32))
+    s = jnp.asarray(rs.rand(9).astype(np.float32) + 0.5)
+    b = jnp.asarray(rs.randn(9).astype(np.float32))
+
+    def fused(x, s, b, r):
+        return jnp.sum(scale_bias_add_relu(x, s, b, r) ** 2)
+
+    def composed(x, s, b, r):
+        return jnp.sum(jnp.maximum(x * s + b + r, 0.0) ** 2)
+
+    g1 = jax.grad(fused, argnums=(0, 1, 2, 3))(x, s, b, r)
+    g2 = jax.grad(composed, argnums=(0, 1, 2, 3))(x, s, b, r)
+    for a, e in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e), rtol=1e-5,
+                                   atol=1e-5)
+
+
+def test_batch_norm_add_relu_op_matches_bn_chain():
+    """_contrib_BatchNormAddReLU == BatchNorm -> +residual -> relu in
+    both training and inference mode, channels-last AND channels-first,
+    including the moving-stat writeback."""
+    rs = np.random.RandomState(3)
+    for axis, shape in ((3, (2, 4, 4, 6)), (1, (2, 6, 4, 4))):
+        c = shape[axis]
+        x = mx.nd.array(rs.randn(*shape).astype(np.float32))
+        res = mx.nd.array(rs.randn(*shape).astype(np.float32))
+        gamma = mx.nd.array(rs.rand(c).astype(np.float32) + 0.5)
+        beta = mx.nd.array(rs.randn(c).astype(np.float32))
+
+        for train in (True, False):
+            mean1 = mx.nd.zeros((c,))
+            var1 = mx.nd.ones((c,))
+            mean2 = mx.nd.zeros((c,))
+            var2 = mx.nd.ones((c,))
+            from mxnet_tpu import autograd
+            with autograd.record(train_mode=train):
+                bn = mx.nd.BatchNorm(x, gamma, beta, mean1, var1,
+                                     fix_gamma=False, axis=axis)
+                want = mx.nd.relu(bn + res)
+                got = mx.nd._contrib_BatchNormAddReLU(
+                    x, res, gamma, beta, mean2, var2, fix_gamma=False,
+                    axis=axis)
+            np.testing.assert_allclose(got.asnumpy(), want.asnumpy(),
+                                       rtol=1e-5, atol=1e-5)
+            # moving stats updated identically
+            np.testing.assert_allclose(mean2.asnumpy(), mean1.asnumpy(),
+                                       rtol=1e-6)
+            np.testing.assert_allclose(var2.asnumpy(), var1.asnumpy(),
+                                       rtol=1e-6)
+
+
+def test_batch_norm_add_relu_symbol_bind():
+    """The fused op composes and trains through the symbolic executor."""
+    rs = np.random.RandomState(4)
+    data = mx.sym.Variable("data")
+    res = mx.sym.Variable("res")
+    out = mx.sym._contrib_BatchNormAddReLU(data, res, name="bnar",
+                                           fix_gamma=False, axis=3)
+    out = mx.sym.sum(out)
+    ex = out.simple_bind(ctx=mx.cpu(), data=(2, 3, 3, 5), res=(2, 3, 3, 5))
+    ex.arg_dict["data"][:] = rs.randn(2, 3, 3, 5).astype(np.float32)
+    ex.arg_dict["res"][:] = rs.randn(2, 3, 3, 5).astype(np.float32)
+    ex.arg_dict["bnar_gamma"][:] = 1.0
+    ex.arg_dict["bnar_beta"][:] = 0.0
+    ex.forward(is_train=True)
+    ex.backward()
+    g = ex.grad_dict["data"].asnumpy()
+    assert np.isfinite(g).all() and (g != 0).any()

@@ -286,6 +286,14 @@ case("BatchNorm",
      [F((2, 4, 4, 3)), P((3,)), F((3,)), F((3,)), P((3,))],
      {"fix_gamma": False, "axis": -1}, label="axis_last",
      rtol=1e-3, atol=1e-3)
+case("_contrib_BatchNormAddReLU",
+     [F((2, 4, 4, 3)), F((2, 4, 4, 3)), P((3,)), F((3,)), F((3,)),
+      P((3,))],
+     {"fix_gamma": False, "axis": -1}, rtol=1e-3, atol=1e-3)
+case("_contrib_BatchNormAddReLU",
+     [F((2, 3, 4, 4)), F((2, 3, 4, 4)), P((3,)), F((3,)), F((3,)),
+      P((3,))],
+     {"fix_gamma": False}, label="nchw_fallback", rtol=1e-3, atol=1e-3)
 case("LRN", [F((2, 6, 4, 4))], {"nsize": 3})
 case("L2Normalization", [F((2, 3, 4, 4))], {"mode": "instance"})
 case("L2Normalization", [F((2, 3, 4, 4))], {"mode": "channel"},

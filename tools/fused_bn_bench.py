@@ -1,0 +1,84 @@
+#!/usr/bin/env python3
+"""A/B the fused BN-apply+add+relu Pallas kernel against the composed
+XLA chain on the attached accelerator (PERF.md 'next levers').
+
+Measures the block-tail elementwise pass in isolation at ResNet-50
+stage shapes. Run on a TPU host:
+
+    python tools/fused_bn_bench.py            # all stage shapes
+    MXTPU_FB_ITERS=100 python tools/fused_bn_bench.py
+
+Prints one line per shape: fused vs composed us/pass and the ratio.
+On CPU it still runs (interpret mode) but timings are meaningless —
+the point of the tool is the on-chip A/B.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mxnet_tpu.pallas.fused_bn import scale_bias_add_relu
+
+ITERS = int(os.environ.get("MXTPU_FB_ITERS", "50"))
+
+# ResNet-50 batch-128 NHWC block-tail shapes (stage outputs)
+SHAPES = [
+    (128, 56, 56, 256),
+    (128, 28, 28, 512),
+    (128, 14, 14, 1024),
+    (128, 7, 7, 2048),
+]
+
+
+def bench(fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / ITERS * 1e6
+
+
+def main():
+    dev = jax.devices()[0]
+    print("device:", dev.device_kind)
+    dt = jnp.bfloat16 if dev.platform == "tpu" else jnp.float32
+    shapes = SHAPES
+    if dev.platform != "tpu":
+        # interpret-mode Pallas is a serial CPU emulation: stage-size
+        # tensors would take minutes per call. Tiny shapes keep the tool
+        # runnable as a smoke check; the numbers only mean something on
+        # the chip.
+        shapes = [(2, 7, 7, 64)]
+    for shape in shapes:
+        c = shape[-1]
+        rs = np.random.RandomState(0)
+        x = jax.device_put(rs.randn(*shape).astype(np.float32)).astype(dt)
+        r = jax.device_put(rs.randn(*shape).astype(np.float32)).astype(dt)
+        s = jax.device_put(rs.rand(c).astype(np.float32) + 0.5)
+        b = jax.device_put(rs.randn(c).astype(np.float32))
+
+        fused = jax.jit(lambda x, s, b, r: scale_bias_add_relu(x, s, b, r))
+
+        @jax.jit
+        def composed(x, s, b, r):
+            return jnp.maximum(x * s.astype(x.dtype) + b.astype(x.dtype)
+                               + r, jnp.zeros((), x.dtype))
+
+        t_fused = bench(fused, x, s, b, r)
+        t_comp = bench(composed, x, s, b, r)
+        gb = 3 * np.prod(shape) * np.dtype(dt).itemsize / 1e9
+        print("%s  fused %8.1f us (%5.0f GB/s)  composed %8.1f us "
+              "(%5.0f GB/s)  ratio %.3f"
+              % (shape, t_fused, gb / (t_fused / 1e6),
+                 t_comp, gb / (t_comp / 1e6), t_comp / t_fused))
+
+
+if __name__ == "__main__":
+    main()
