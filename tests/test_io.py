@@ -182,3 +182,43 @@ def test_iterator_num_parts_sharding():
             seen.extend(b.label[0].asnumpy().tolist())
     assert sorted(seen) == [0, 1, 3, 4, 6, 7, 9, 10]
     assert full.num_data == 12
+
+
+def test_sustained_feed_probe_overlaps_decode_with_consumer():
+    """The pipeline must DECODE WHILE THE CONSUMER RUNS (reference
+    iter_image_recordio_2.cc decode-parallel design): a consumer paced
+    at half of measured decode capacity is sustained, with wall-clock
+    visibly under the serialized decode+consume sum. Runs the probe in
+    a SUBPROCESS (the tools pattern — its module body pins
+    jax_platforms=cpu, which must not leak into this session); timing
+    thresholds are deliberately loose, this is a concurrency-property
+    check, not a perf gate. tools/feed_probe.py is the deployment-
+    facing version (point --target-img-s at bench.py's measured rate).
+    Retried once: the capacity measurement and the paced phase run at
+    different times, so a host-load spike between them can produce one
+    spurious miss."""
+    import json
+    import subprocess
+    import sys as _sys
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    env = dict(os.environ)
+    env["MXNET_TPU_FORCE_CPU"] = "1"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [_sys.executable,
+           os.path.join(repo, "tools", "feed_probe.py"),
+           "--threads", "1", "--images", "96", "--size", "64x64",
+           "--batch", "16", "--target-fraction", "0.5"]
+    res = None
+    for _ in range(2):
+        p = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=300, env=env)
+        assert p.returncode == 0, p.stderr
+        res = json.loads(p.stdout.strip().splitlines()[-1])
+        if res["sustained"] and res["overlap_efficiency"] > 0.15:
+            break
+    assert res["sustained"], res
+    assert res["overlap_efficiency"] > 0.15, res
+    # core-sizing arithmetic is exactly ceil(target / per-core rate)
+    import math
+    assert res["cores_needed_for_target"] == int(
+        math.ceil(res["target_img_s"] / res["per_core_img_s"])), res

@@ -92,3 +92,68 @@ def test_gluon_training_converges():
     pred = net(xs).asnumpy().argmax(axis=1)
     acc = (pred == y).mean()
     assert acc > 0.95, "gluon training failed to converge: acc=%f" % acc
+
+
+def test_cifar_shape_conv_bf16_converges():
+    """The reference-scale dtype workload (tests/python/train/
+    test_dtype.py run_cifar10 shape: conv+BN stack on 3x32x32, low-
+    precision data iterator): bf16 activations with fp32 master weights
+    (multi_precision) and fp32 BN params via the InferType pass — the
+    exact numeric regime bench.py's ResNet-50 measurement relies on.
+    Must clear an accuracy threshold far above the reference's 0.08."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(5)
+    n, classes = 384, 4
+    # separable color-geometry task: class = which quadrant carries the
+    # dominant channel energy
+    x = rng.uniform(0, 0.3, size=(n, 3, 32, 32)).astype(np.float32)
+    y = rng.randint(0, classes, size=n)
+    for i in range(n):
+        q = y[i]
+        r0, c0 = (q // 2) * 16, (q % 2) * 16
+        x[i, :, r0:r0 + 16, c0:c0 + 16] += 0.7
+    bf16 = np.dtype(jnp.bfloat16)
+
+    train = mx.io.NDArrayIter(x.astype(bf16), y.astype(np.float32),
+                              batch_size=32, shuffle=True)
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data=data, num_filter=8, kernel=(3, 3),
+                             pad=(1, 1), name="conv1")
+    net = mx.sym.BatchNorm(net, fix_gamma=False, name="bn1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.Convolution(net, num_filter=16, kernel=(3, 3),
+                             pad=(1, 1), name="conv2")
+    net = mx.sym.BatchNorm(net, fix_gamma=False, name="bn2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.current_context())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "rescale_grad": 1.0 / 32,
+                              "multi_precision": True},
+            num_epoch=6, eval_metric="acc")
+    # executor ran bf16 end to end (InferType pinned the data path)
+    assert mod._exec.arg_dict["data"].dtype == bf16
+    assert mod._exec.arg_dict["conv1_weight"].dtype == bf16
+    # fp32 master weights exist in the optimizer (mp_sgd scheme):
+    # multi-precision states are (state, fp32 master) tuples
+    updater = mod._updater
+    if updater is None and mod._kvstore is not None:
+        updater = mod._kvstore._updater
+    states = getattr(updater, "states", {})
+    assert any(
+        isinstance(st, tuple) and len(st) == 2
+        and getattr(st[1], "dtype", None) == np.float32
+        for st in states.values()), \
+        "no fp32 master weights found (multi_precision was a no-op)"
+    train.reset()
+    acc = dict(mod.score(train, mx.metric.Accuracy()))["accuracy"]
+    assert acc > 0.9, "bf16 conv net failed to converge: acc=%f" % acc

@@ -40,21 +40,15 @@ def main():
     args = ap.parse_args()
     h, w = (int(x) for x in args.size.split("x"))
 
-    from PIL import Image
-    from mxnet_tpu import recordio
     from mxnet_tpu.image import ImageIter
+    # one packing methodology for both probes: PERF.md compares their
+    # numbers, so the JPEG quality/seed/header must not drift apart
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from feed_probe import pack_synthetic_rec
 
-    rs = np.random.RandomState(0)
     with tempfile.TemporaryDirectory() as td:
         rec_path = os.path.join(td, "probe.rec")
-        rec = recordio.MXRecordIO(rec_path, "w")
-        for i in range(args.images):
-            arr = rs.randint(0, 255, (h, w, 3), np.uint8)
-            buf = _io.BytesIO()
-            Image.fromarray(arr).save(buf, format="JPEG", quality=90)
-            rec.write(recordio.pack(
-                recordio.IRHeader(0, float(i % 10), i, 0), buf.getvalue()))
-        rec.close()
+        pack_synthetic_rec(rec_path, args.images, h, w)
 
         it = ImageIter(batch_size=args.batch, data_shape=(3, h, w),
                        path_imgrec=rec_path,
