@@ -1,0 +1,68 @@
+"""Fast-gradient-sign adversarial examples (mirrors reference
+example/adversary/: train a classifier, take the loss gradient w.r.t.
+the INPUT via autograd, perturb, re-evaluate)."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def make_data(rs, n=512, dim=16, classes=4):
+    centers = rs.uniform(-2, 2, size=(classes, dim)).astype(np.float32)
+    y = rs.randint(0, classes, n)
+    x = centers[y] + 0.3 * rs.normal(size=(n, dim)).astype(np.float32)
+    return x.astype(np.float32), y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epsilon", type=float, default=1.0)
+    ap.add_argument("--iters", type=int, default=150)
+    args = ap.parse_args()
+
+    rs = np.random.RandomState(0)
+    x, y = make_data(rs)
+    xs, ys = mx.nd.array(x), mx.nd.array(y.astype(np.float32))
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"))
+        net.add(nn.Dense(4))
+    net.initialize(mx.initializer.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-2})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    for _ in range(args.iters):
+        with mx.autograd.record():
+            loss = ce(net(xs), ys).mean()
+        loss.backward()
+        trainer.step(x.shape[0])
+
+    clean_acc = float((net(xs).asnumpy().argmax(1) == y).mean())
+
+    # FGSM: gradient of the loss w.r.t. the INPUT
+    xadv_in = mx.nd.array(x)
+    xadv_in.attach_grad()
+    with mx.autograd.record():
+        loss = ce(net(xadv_in), ys).sum()
+    loss.backward()
+    x_adv = mx.nd.array(x + args.epsilon
+                        * np.sign(xadv_in.grad.asnumpy()))
+    adv_acc = float((net(x_adv).asnumpy().argmax(1) == y).mean())
+
+    print("clean accuracy %.3f adversarial accuracy %.3f (eps=%.2f)"
+          % (clean_acc, adv_acc, args.epsilon))
+    assert clean_acc > 0.9, "classifier failed to train"
+    assert adv_acc < clean_acc - 0.1, "FGSM perturbation had no effect"
+
+
+if __name__ == "__main__":
+    main()

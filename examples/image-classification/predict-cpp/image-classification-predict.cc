@@ -1,0 +1,123 @@
+// C++ image-classification inference over the predict C ABI (parity:
+// reference example/image-classification/predict-cpp/
+// image-classification-predict.cc — load symbol JSON + params, set the
+// input image, forward, read class probabilities).
+//
+// Build (from repo root, after `make`):
+//   g++ -std=c++17 examples/image-classification/predict-cpp/\
+//       image-classification-predict.cc -o predict \
+//       -L mxnet_tpu/_lib -lmxtpu_c_api -Wl,-rpath,mxnet_tpu/_lib
+// Run:
+//   PYTHONPATH=. MXNET_TPU_FORCE_CPU=1 ./predict model-symbol.json \
+//       model-0000.params 1,3,32,32
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void* PredictorHandle;
+
+extern "C" {
+const char* MXGetLastError();
+int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id, mx_uint num_input,
+                 const char** input_keys, const mx_uint* input_shape_indptr,
+                 const mx_uint* input_shape_data, PredictorHandle* out);
+int MXPredSetInput(PredictorHandle handle, const char* key,
+                   const mx_float* data, mx_uint size);
+int MXPredForward(PredictorHandle handle);
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint** shape_data, mx_uint* shape_ndim);
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float* data,
+                    mx_uint size);
+int MXPredFree(PredictorHandle handle);
+}
+
+#define CHECK(x)                                              \
+  do {                                                        \
+    if ((x) != 0) {                                           \
+      std::fprintf(stderr, "FAIL %s: %s\n", #x,              \
+                   MXGetLastError());                         \
+      std::exit(1);                                           \
+    }                                                         \
+  } while (0)
+
+static std::vector<char> ReadFile(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (f == nullptr) {
+    std::fprintf(stderr, "cannot open %s\n", path);
+    std::exit(1);
+  }
+  std::fseek(f, 0, SEEK_END);
+  long n = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::vector<char> buf(n);
+  if (std::fread(buf.data(), 1, n, f) != static_cast<size_t>(n)) {
+    std::fprintf(stderr, "short read on %s\n", path);
+    std::exit(1);
+  }
+  std::fclose(f);
+  return buf;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::fprintf(stderr,
+                 "usage: %s symbol.json params N,C,H,W\n", argv[0]);
+    return 1;
+  }
+  std::vector<char> symbol = ReadFile(argv[1]);
+  symbol.push_back('\0');
+  std::vector<char> params = ReadFile(argv[2]);
+
+  // parse the input shape "N,C,H,W"
+  std::vector<mx_uint> shape;
+  for (char* tok = std::strtok(argv[3], ","); tok != nullptr;
+       tok = std::strtok(nullptr, ",")) {
+    shape.push_back(static_cast<mx_uint>(std::atoi(tok)));
+  }
+  mx_uint indptr[2] = {0, static_cast<mx_uint>(shape.size())};
+  const char* keys[1] = {"data"};
+
+  PredictorHandle pred = nullptr;
+  CHECK(MXPredCreate(symbol.data(), params.data(),
+                     static_cast<int>(params.size()), 1, 0, 1, keys, indptr,
+                     shape.data(), &pred));
+
+  size_t n_in = 1;
+  for (auto s : shape) n_in *= s;
+  std::vector<mx_float> img(n_in);
+  unsigned int seed = 11;
+  for (auto& v : img) {
+    seed = seed * 1103515245u + 12345u;
+    v = static_cast<float>((seed >> 8) & 0xffffff) /
+        static_cast<float>(0x1000000);
+  }
+  CHECK(MXPredSetInput(pred, "data", img.data(),
+                       static_cast<mx_uint>(n_in)));
+  CHECK(MXPredForward(pred));
+
+  mx_uint* oshape = nullptr;
+  mx_uint ondim = 0;
+  CHECK(MXPredGetOutputShape(pred, 0, &oshape, &ondim));
+  size_t n_out = 1;
+  for (mx_uint i = 0; i < ondim; ++i) n_out *= oshape[i];
+  std::vector<mx_float> probs(n_out);
+  CHECK(MXPredGetOutput(pred, 0, probs.data(),
+                        static_cast<mx_uint>(n_out)));
+
+  // argmax per row of the (batch, classes) output
+  size_t classes = oshape[ondim - 1];
+  double psum = 0.0;
+  for (auto p : probs) psum += p;
+  int best = 0;
+  for (size_t j = 1; j < classes; ++j) {
+    if (probs[j] > probs[best]) best = static_cast<int>(j);
+  }
+  std::printf("PREDICT_OK classes=%zu best=%d prob=%.4f prob_sum=%.3f\n",
+              classes, best, probs[best], psum);
+  return 0;
+}

@@ -1,0 +1,69 @@
+"""Multi-task training: one trunk, two softmax heads grouped into a
+single symbol (mirrors reference example/multi-task/example_multi_task.py
+— Group(softmax1, softmax2), a Module with two label inputs and a
+per-head metric)."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args()
+
+    rs = np.random.RandomState(0)
+    n, dim = 512, 12
+    centers = rs.uniform(-2, 2, size=(4, dim)).astype(np.float32)
+    y1 = rs.randint(0, 4, n)                 # task 1: which center
+    y2 = (y1 % 2).astype(np.int64)           # task 2: its parity
+    x = centers[y1] + 0.3 * rs.normal(size=(n, dim)).astype(np.float32)
+
+    it = mx.io.NDArrayIter(
+        {"data": x.astype(np.float32)},
+        {"softmax1_label": y1.astype(np.float32),
+         "softmax2_label": y2.astype(np.float32)},
+        batch_size=args.batch_size, shuffle=True)
+
+    data = mx.sym.Variable("data")
+    trunk = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    trunk = mx.sym.Activation(trunk, act_type="relu")
+    h1 = mx.sym.FullyConnected(trunk, num_hidden=4, name="head1")
+    h2 = mx.sym.FullyConnected(trunk, num_hidden=2, name="head2")
+    out = mx.sym.Group([
+        mx.sym.SoftmaxOutput(h1, name="softmax1"),
+        mx.sym.SoftmaxOutput(h2, name="softmax2"),
+    ])
+
+    mod = mx.mod.Module(out, data_names=["data"],
+                        label_names=["softmax1_label", "softmax2_label"],
+                        context=mx.current_context())
+    mod.fit(it, optimizer="adam",
+            optimizer_params={"learning_rate": 1e-2,
+                              "rescale_grad": 1.0 / args.batch_size},
+            num_epoch=args.num_epochs, eval_metric="acc")
+
+    it.reset()
+    correct1 = correct2 = total = 0
+    for batch in it:
+        mod.forward(batch, is_train=False)
+        o1, o2 = (o.asnumpy() for o in mod.get_outputs())
+        l1 = batch.label[0].asnumpy()
+        l2 = batch.label[1].asnumpy()
+        correct1 += int((o1.argmax(1) == l1).sum())
+        correct2 += int((o2.argmax(1) == l2).sum())
+        total += len(l1)
+    acc1, acc2 = correct1 / total, correct2 / total
+    print("task1 accuracy %.3f task2 accuracy %.3f" % (acc1, acc2))
+    assert acc1 > 0.9 and acc2 > 0.9, "multi-task training failed"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+"""Custom operator in Python (mirrors reference
+example/numpy-ops/custom_softmax.py): a softmax-with-loss implemented as
+a CustomOp/CustomOpProp pair and trained inside a normal Module graph —
+the frontend custom-op subsystem end to end."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+class Softmax(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = np.exp(x - x.max(axis=1, keepdims=True))
+        y /= y.sum(axis=1, keepdims=True)
+        self.assign(out_data[0], req[0], mx.nd.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        l = in_data[1].asnumpy().ravel().astype(np.int64)
+        y = out_data[0].asnumpy().copy()
+        y[np.arange(l.shape[0]), l] -= 1.0
+        self.assign(in_grad[0], req[0], mx.nd.array(y))
+
+
+@mx.operator.register("example_softmax")
+class SoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ["data", "label"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = (in_shape[0][0],)
+        return [data_shape, label_shape], [data_shape], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Softmax()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args()
+
+    rs = np.random.RandomState(0)
+    n, dim, classes = 512, 10, 3
+    centers = rs.uniform(-2, 2, size=(classes, dim)).astype(np.float32)
+    y = rs.randint(0, classes, n)
+    x = centers[y] + 0.3 * rs.normal(size=(n, dim)).astype(np.float32)
+
+    it = mx.io.NDArrayIter(x.astype(np.float32), y.astype(np.float32),
+                           batch_size=args.batch_size, shuffle=True,
+                           label_name="softmax_label")
+
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    fc = mx.sym.FullyConnected(data, num_hidden=classes, name="fc")
+    net = mx.sym.Custom(fc, label, op_type="example_softmax",
+                        name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.current_context())
+    mod.fit(it, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2,
+                              "rescale_grad": 1.0 / args.batch_size},
+            num_epoch=args.num_epochs, eval_metric="acc")
+    it.reset()
+    acc = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
+    print("custom-softmax accuracy %.3f" % acc)
+    assert acc > 0.9, "custom-op training failed"
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,13 @@ EXAMPLES = [
     ("ssd/train_ssd.py", ["--iters", "2", "--batch-size", "4"]),
     ("parallel/train_moe_pipeline.py", []),
     ("model-parallel/lstm_stages.py", ["--num-stages", "4"]),
+    ("autoencoder/autoencoder.py", ["--num-epochs", "6"]),
+    ("gan/gan_synthetic.py", ["--iters", "150"]),
+    ("adversary/fgsm.py", ["--iters", "80"]),
+    ("multi-task/multitask.py", ["--num-epochs", "6"]),
+    ("numpy-ops/custom_softmax.py", ["--num-epochs", "6"]),
+    ("recommenders/matrix_fact.py", ["--num-epochs", "8"]),
+    ("profiler/profiler_demo.py", []),
 ]
 
 

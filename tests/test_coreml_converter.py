@@ -1,0 +1,88 @@
+"""tools/coreml converter (parity: reference tools/coreml/test/ — build
+a net, convert, verify the emitted layer list and weight payloads)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(REPO, "tools", "coreml"))
+
+import mxnet_tpu as mx
+import converter as cml
+
+
+def _lenet_checkpoint(tmp_path):
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                             name="conv1")
+    net = mx.sym.BatchNorm(net, fix_gamma=False, name="bn1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max", name="pool1")
+    net = mx.sym.Flatten(net, name="flat")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc1")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, label_names=["softmax_label"], context=mx.cpu())
+    mod.bind(data_shapes=[("data", (1, 3, 8, 8))], for_training=False)
+    mod.init_params(mx.initializer.Xavier())
+    prefix = str(tmp_path / "lenet")
+    mod.save_checkpoint(prefix, 0)
+    return prefix
+
+
+def test_convert_lenet_layers_and_weights(tmp_path):
+    prefix = _lenet_checkpoint(tmp_path)
+    sym, arg_params, aux_params = mx.model.load_checkpoint(prefix, 0)
+    spec = cml.convert(sym, arg_params, aux_params, (1, 3, 8, 8),
+                       class_labels=["a", "b", "c"])
+    kinds = [l["type"] for l in spec["neuralNetwork"]["layers"]]
+    assert kinds == ["convolution", "batchnorm", "activation", "pooling",
+                     "flatten", "innerProduct", "softmax"]
+    conv = spec["neuralNetwork"]["layers"][0]
+    np.testing.assert_allclose(
+        cml.decode_weights(conv["weights"]),
+        arg_params["conv1_weight"].asnumpy(), rtol=1e-6)
+    fc = spec["neuralNetwork"]["layers"][5]
+    np.testing.assert_allclose(
+        cml.decode_weights(fc["bias"]),
+        arg_params["fc1_bias"].asnumpy(), rtol=1e-6)
+    bn = spec["neuralNetwork"]["layers"][1]
+    np.testing.assert_allclose(
+        cml.decode_weights(bn["mean"]),
+        aux_params["bn1_moving_mean"].asnumpy(), rtol=1e-6)
+    assert spec["description"]["class_labels"] == ["a", "b", "c"]
+    # spec JSON round-trip
+    out = cml.save_spec(spec, str(tmp_path / "lenet.mlmodel"))
+    again = cml.load_spec(out)
+    assert again["neuralNetwork"]["layers"][0]["type"] == "convolution"
+
+
+def test_convert_rejects_unsupported_op(tmp_path):
+    data = mx.sym.Variable("data")
+    net = mx.sym.SwapAxis(data, dim1=1, dim2=2, name="swap")
+    with pytest.raises(ValueError, match="SwapAxis"):
+        cml.convert(net, {}, {}, (1, 2, 3))
+
+
+def test_cli_end_to_end(tmp_path):
+    prefix = _lenet_checkpoint(tmp_path)
+    out = str(tmp_path / "model.mlmodel")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["MXNET_TPU_FORCE_CPU"] = "1"
+    p = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "coreml",
+                      "mxnet_coreml_converter.py"),
+         "--model-prefix", prefix, "--epoch", "0",
+         "--input-shape", "1,3,8,8", "--output-file", out,
+         "--class-labels", "x,y,z"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert p.returncode == 0, p.stderr
+    assert "converted 7 layers" in p.stdout
+    spec = json.load(open(out + ".json"))
+    assert len(spec["neuralNetwork"]["layers"]) == 7

@@ -33,6 +33,22 @@ def test_ssd_example():
     assert "detection output" in out
 
 
+def test_adversary_example():
+    out = _run("adversary/fgsm.py", ["--iters", "80"])
+    assert "adversarial accuracy" in out
+
+
+def test_custom_op_example():
+    out = _run("numpy-ops/custom_softmax.py", ["--num-epochs", "6"])
+    acc = float(out.strip().splitlines()[-1].split()[-1])
+    assert acc > 0.9
+
+
+def test_recommender_example():
+    out = _run("recommenders/matrix_fact.py", ["--num-epochs", "8"])
+    assert "rmse" in out
+
+
 @pytest.mark.slow
 def test_all_examples():
     """Full sweep; run explicitly with -m slow (CI nightly analogue)."""
@@ -42,3 +58,47 @@ def test_all_examples():
         if proc.returncode != 0:
             failures.append((rel, proc.stderr[-500:]))
     assert not failures, failures
+
+
+def test_predict_cpp_example(tmp_path):
+    """The reference's predict-cpp deployment example over our predict C
+    ABI: save a checkpoint, compile the C++ driver, run inference."""
+    import shutil
+    import subprocess as sp
+    cxx = shutil.which("g++") or shutil.which("c++")
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    lib_dir = os.path.join(repo, "mxnet_tpu", "_lib")
+    if cxx is None or not os.path.exists(
+            os.path.join(lib_dir, "libmxtpu_c_api.so")):
+        import pytest as _pytest
+        _pytest.skip("no C++ compiler or native lib")
+    import mxnet_tpu as mx
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                             name="conv")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=5, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, label_names=["softmax_label"],
+                        context=mx.cpu())
+    mod.bind(data_shapes=[("data", (1, 3, 8, 8))], for_training=False)
+    mod.init_params(mx.initializer.Xavier())
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 0)
+
+    src = os.path.join(repo, "examples", "image-classification",
+                       "predict-cpp", "image-classification-predict.cc")
+    exe = str(tmp_path / "predict")
+    sp.run([cxx, "-std=c++17", src, "-o", exe, "-L", lib_dir,
+            "-lmxtpu_c_api", "-Wl,-rpath," + lib_dir], check=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["MXNET_TPU_FORCE_CPU"] = "1"
+    p = sp.run([exe, prefix + "-symbol.json", prefix + "-0000.params",
+                "1,3,8,8"], capture_output=True, text=True, timeout=300,
+               env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "PREDICT_OK classes=5" in p.stdout, p.stdout
+    psum = float(p.stdout.split("prob_sum=")[1].split()[0])
+    assert abs(psum - 1.0) < 1e-3  # softmax over 5 classes, batch 1

@@ -1,0 +1,260 @@
+"""MXNet-checkpoint -> CoreML NeuralNetwork converter.
+
+Parity: reference tools/coreml/converter/_mxnet_converter.py + _layers.py
+— walk the symbol graph in topological order, map each supported op to a
+CoreML NeuralNetwork layer carrying the trained weights, and emit the
+model spec. The reference drives coremltools' NeuralNetworkBuilder; this
+converter builds the SAME spec structure as plain dicts, and
+``save_spec`` writes it as JSON (`<out>.mlmodel.json`) — same layer
+list, same weight payloads (base64). ``spec_to_mlmodel`` converts that
+spec to a binary .mlmodel via coremltools' NeuralNetworkBuilder on a
+machine that has coremltools (it cannot be installed in this
+zero-egress image, so that path is best-effort and unexercised here;
+the JSON spec is the tested artifact).
+
+Supported ops (the reference's registry): Convolution, FullyConnected,
+Activation, Pooling, Flatten, Reshape, SoftmaxOutput/softmax,
+BatchNorm, elemwise_add, Concat. Anything else raises with the op name
+(the reference errors the same way).
+"""
+from __future__ import annotations
+
+import base64
+import json
+
+import numpy as np
+
+
+def _b64(arr):
+    arr = np.ascontiguousarray(arr, np.float32)
+    return {"shape": list(arr.shape),
+            "data": base64.b64encode(arr.tobytes()).decode("ascii")}
+
+
+def _nodes_topo(sym):
+    graph = json.loads(sym.tojson())
+    return graph["nodes"], graph["heads"]
+
+
+def convert(sym, arg_params, aux_params, input_shape, class_labels=None,
+            mode=None):
+    """Returns the CoreML spec as a plain dict (the builder-level
+    representation; serialization is the caller's concern)."""
+    nodes, heads = _nodes_topo(sym)
+    layers = []
+    out_of = {}      # node id -> blob name
+
+    # the network input is the argument that carries no trained weights
+    # (the reference derives it from the symbol's arguments the same way)
+    known_params = set(arg_params) | set(aux_params)
+    data_names = [n for n in sym.list_arguments() if n not in known_params]
+    if not data_names:
+        raise ValueError("no data input found in symbol arguments")
+    input_name = data_names[0]
+    for i, node in enumerate(nodes):
+        if node["op"] == "null" and node["name"] == input_name:
+            out_of[i] = input_name
+
+    def param(name):
+        if name in arg_params:
+            return arg_params[name].asnumpy()
+        if name in aux_params:
+            return aux_params[name].asnumpy()
+        raise KeyError("parameter %r missing from checkpoint" % name)
+
+    for i, node in enumerate(nodes):
+        op, name = node["op"], node["name"]
+        attrs = node.get("attrs", node.get("param", {})) or {}
+        if op == "null":
+            continue
+        in_blobs = [out_of[inp[0]] for inp in node["inputs"]
+                    if inp[0] in out_of]
+        out_blob = name + "_output"
+        if op == "Convolution":
+            w = param(name + "_weight")
+            layer = {"type": "convolution", "name": name,
+                     "input": in_blobs[:1], "output": [out_blob],
+                     "kernel": json.loads(attrs["kernel"].replace("(", "[")
+                                          .replace(")", "]")),
+                     "stride": json.loads(attrs.get("stride", "(1, 1)")
+                                          .replace("(", "[")
+                                          .replace(")", "]")),
+                     "pad": json.loads(attrs.get("pad", "(0, 0)")
+                                       .replace("(", "[")
+                                       .replace(")", "]")),
+                     "num_filter": int(attrs["num_filter"]),
+                     "weights": _b64(w)}
+            if attrs.get("no_bias", "False") not in ("True", "true"):
+                layer["bias"] = _b64(param(name + "_bias"))
+            layers.append(layer)
+        elif op == "FullyConnected":
+            layer = {"type": "innerProduct", "name": name,
+                     "input": in_blobs[:1], "output": [out_blob],
+                     "num_hidden": int(attrs["num_hidden"]),
+                     "weights": _b64(param(name + "_weight"))}
+            if attrs.get("no_bias", "False") not in ("True", "true"):
+                layer["bias"] = _b64(param(name + "_bias"))
+            layers.append(layer)
+        elif op == "Activation":
+            layers.append({"type": "activation", "name": name,
+                           "input": in_blobs[:1], "output": [out_blob],
+                           "act_type": attrs.get("act_type", "relu")})
+        elif op == "Pooling":
+            layers.append({
+                "type": "pooling", "name": name,
+                "input": in_blobs[:1], "output": [out_blob],
+                "pool_type": attrs.get("pool_type", "max"),
+                "kernel": json.loads(attrs.get("kernel", "(2, 2)")
+                                     .replace("(", "[").replace(")", "]")),
+                "stride": json.loads(attrs.get("stride", "(1, 1)")
+                                     .replace("(", "[").replace(")", "]")),
+                "global": attrs.get("global_pool", "False")
+                in ("True", "true")})
+        elif op in ("Flatten", "flatten"):
+            layers.append({"type": "flatten", "name": name,
+                           "input": in_blobs[:1], "output": [out_blob]})
+        elif op in ("Reshape", "reshape"):
+            layers.append({"type": "reshape", "name": name,
+                           "input": in_blobs[:1], "output": [out_blob],
+                           "shape": attrs.get("shape")})
+        elif op in ("SoftmaxOutput", "softmax"):
+            layers.append({"type": "softmax", "name": name,
+                           "input": in_blobs[:1], "output": [out_blob]})
+        elif op == "BatchNorm":
+            layers.append({
+                "type": "batchnorm", "name": name,
+                "input": in_blobs[:1], "output": [out_blob],
+                "gamma": _b64(param(name + "_gamma")),
+                "beta": _b64(param(name + "_beta")),
+                "mean": _b64(param(name + "_moving_mean")),
+                "variance": _b64(param(name + "_moving_var")),
+                "eps": float(attrs.get("eps", 1e-3))})
+        elif op in ("elemwise_add", "_Plus", "broadcast_add"):
+            layers.append({"type": "add", "name": name,
+                           "input": in_blobs, "output": [out_blob]})
+        elif op == "Concat":
+            layers.append({"type": "concat", "name": name,
+                           "input": in_blobs, "output": [out_blob]})
+        else:
+            raise ValueError(
+                "CoreML conversion not supported for op %r (node %r) — "
+                "same unsupported-op contract as the reference converter"
+                % (op, name))
+        out_of[i] = out_blob
+
+    spec = {
+        "format": "mxnet_tpu-coreml-spec-v1",
+        "description": {
+            "input": [{"name": input_name, "shape": list(input_shape)}],
+            "output": [{"name": out_of[heads[0][0]]}],
+            "class_labels": list(class_labels) if class_labels else None,
+            "mode": mode,
+        },
+        "neuralNetwork": {"layers": layers},
+    }
+    return spec
+
+
+def save_spec(spec, path):
+    """Write the spec as JSON (the tested artifact; see module
+    docstring). ``path`` gets a ``.json`` suffix unless it has one."""
+    out = path if path.endswith(".json") else path + ".json"
+    with open(out, "w") as f:
+        json.dump(spec, f)
+    return out
+
+
+def spec_to_mlmodel(spec, path):
+    """Best-effort binary .mlmodel emission on a coremltools host (the
+    builder calls mirror the reference's _layers.py; this path cannot
+    run in the zero-egress build image and is therefore unexercised by
+    the test suite — the JSON spec is the artifact of record)."""
+    try:
+        from coremltools.models import datatypes
+        from coremltools.models.neural_network import NeuralNetworkBuilder
+        import coremltools
+    except ImportError as e:
+        raise ImportError(
+            "coremltools is required for binary .mlmodel output; "
+            "use save_spec for the JSON form") from e
+    inp = spec["description"]["input"][0]
+    out_name = spec["description"]["output"][0]["name"]
+    builder = NeuralNetworkBuilder(
+        [(inp["name"], datatypes.Array(*inp["shape"][1:]))],
+        [(out_name, None)])
+    for l in spec["neuralNetwork"]["layers"]:
+        kind = l["type"]
+        if kind == "convolution":
+            w = decode_weights(l["weights"])
+            b = decode_weights(l["bias"]) if "bias" in l else None
+            builder.add_convolution(
+                name=l["name"], kernel_channels=w.shape[1],
+                output_channels=w.shape[0], height=l["kernel"][0],
+                width=l["kernel"][1], stride_height=l["stride"][0],
+                stride_width=l["stride"][1], border_mode="valid",
+                groups=1, W=np.transpose(w, (2, 3, 1, 0)), b=b,
+                has_bias=b is not None, input_name=l["input"][0],
+                output_name=l["output"][0],
+                padding_top=l["pad"][0], padding_bottom=l["pad"][0],
+                padding_left=l["pad"][1], padding_right=l["pad"][1])
+        elif kind == "innerProduct":
+            w = decode_weights(l["weights"])
+            b = decode_weights(l["bias"]) if "bias" in l else None
+            builder.add_inner_product(
+                name=l["name"], W=w, b=b, input_channels=w.shape[1],
+                output_channels=w.shape[0], has_bias=b is not None,
+                input_name=l["input"][0], output_name=l["output"][0])
+        elif kind == "activation":
+            builder.add_activation(
+                name=l["name"],
+                non_linearity=l["act_type"].upper()
+                if l["act_type"] != "relu" else "RELU",
+                input_name=l["input"][0], output_name=l["output"][0])
+        elif kind == "pooling":
+            builder.add_pooling(
+                name=l["name"], height=l["kernel"][0],
+                width=l["kernel"][1], stride_height=l["stride"][0],
+                stride_width=l["stride"][1],
+                layer_type=l["pool_type"].upper(), padding_type="VALID",
+                input_name=l["input"][0], output_name=l["output"][0],
+                is_global=l.get("global", False))
+        elif kind == "flatten":
+            builder.add_flatten(name=l["name"], mode=0,
+                                input_name=l["input"][0],
+                                output_name=l["output"][0])
+        elif kind == "softmax":
+            builder.add_softmax(name=l["name"], input_name=l["input"][0],
+                                output_name=l["output"][0])
+        elif kind == "batchnorm":
+            builder.add_batchnorm(
+                name=l["name"],
+                channels=len(decode_weights(l["gamma"])),
+                gamma=decode_weights(l["gamma"]),
+                beta=decode_weights(l["beta"]),
+                mean=decode_weights(l["mean"]),
+                variance=decode_weights(l["variance"]),
+                input_name=l["input"][0], output_name=l["output"][0],
+                epsilon=l["eps"])
+        elif kind == "add":
+            builder.add_elementwise(
+                name=l["name"], input_names=l["input"],
+                output_name=l["output"][0], mode="ADD")
+        elif kind == "concat":
+            builder.add_elementwise(
+                name=l["name"], input_names=l["input"],
+                output_name=l["output"][0], mode="CONCAT")
+        else:
+            raise ValueError("unsupported layer kind %r" % kind)
+    model = coremltools.models.MLModel(builder.spec)
+    model.save(path)
+    return path
+
+
+def load_spec(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def decode_weights(entry):
+    raw = base64.b64decode(entry["data"])
+    return np.frombuffer(raw, np.float32).reshape(entry["shape"])

@@ -1,0 +1,48 @@
+#!/usr/bin/env python3
+"""CLI (parity: reference tools/coreml/mxnet_coreml_converter.py):
+
+    python tools/coreml/mxnet_coreml_converter.py \
+        --model-prefix model --epoch 0 \
+        --input-shape 1,3,32,32 --output-file model.mlmodel
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # conversion is host-side
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-prefix", required=True)
+    ap.add_argument("--epoch", type=int, default=0)
+    ap.add_argument("--input-shape", required=True)
+    ap.add_argument("--output-file", required=True)
+    ap.add_argument("--class-labels", default=None,
+                    help="comma-separated labels")
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from converter import convert, save_spec  # noqa: E402
+
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        args.model_prefix, args.epoch)
+    shape = tuple(int(x) for x in args.input_shape.split(","))
+    labels = args.class_labels.split(",") if args.class_labels else None
+    spec = convert(sym, arg_params, aux_params, shape, class_labels=labels)
+    try:
+        from converter import spec_to_mlmodel
+        out = spec_to_mlmodel(spec, args.output_file)
+    except ImportError:
+        out = save_spec(spec, args.output_file)
+    n = len(spec["neuralNetwork"]["layers"])
+    print("converted %d layers -> %s" % (n, out))
+
+
+if __name__ == "__main__":
+    main()

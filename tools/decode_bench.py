@@ -12,14 +12,11 @@ Usage: python tools/decode_bench.py [--threads N] [--images M]
 Prints one JSON line: {"metric": "jpeg_decode_throughput", ...}
 """
 import argparse
-import io as _io
 import json
 import os
 import sys
 import tempfile
 import time
-
-import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
