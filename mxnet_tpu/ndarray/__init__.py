@@ -14,6 +14,8 @@ from . import random   # noqa: E402,F401
 from . import linalg   # noqa: E402,F401
 from . import sparse   # noqa: E402,F401
 from .sparse import RowSparseNDArray, CSRNDArray  # noqa: E402,F401
+# top-level aliases the reference exposes as nnvm ops (mx.nd.cast_storage)
+from .sparse import cast_storage  # noqa: E402,F401
 
 from . import contrib  # noqa: E402,F401
 

@@ -331,14 +331,48 @@ def add_n(arrays):
 
 
 def elemwise_add(lhs, rhs):
-    """Sparse elemwise add (reference elemwise_binary_op_basic.cc):
-    row_sparse pairs stay on the native row-union path; csr pairs go
-    through the dense view and re-compress (the reference's
-    storage-fallback behaviour for combinations without a native
-    kernel, logged the same way)."""
+    """Sparse elemwise add (reference elemwise_binary_op_basic.cc).
+
+    csr + csr runs NATIVELY on the compressed representations: COO
+    concat -> host lexsort of the (row, col) keys (O(nnz) ints; the
+    value merge stays on device) -> segment-sum of duplicates ->
+    rebuild indptr. O(nnz) memory, never the dense shape — the
+    reference's DotCsrCsr-style merge kernel role. Result keeps the
+    structural UNION of coordinates (a sum that cancels to exact zero
+    stays stored, reference sparse-kernel semantics). row_sparse pairs
+    use the native row-union path; mixed sparse/dense falls back to
+    dense (the reference's storage-fallback, logged the same way)."""
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray):
+        if lhs.shape != rhs.shape:
+            raise MXNetError("elemwise_add: shape mismatch %s vs %s"
+                             % (lhs.shape, rhs.shape))
+        r = np.concatenate([np.asarray(lhs._row_ids()),
+                            np.asarray(rhs._row_ids())])
+        c = np.concatenate([np.asarray(lhs._csr_indices),
+                            np.asarray(rhs._csr_indices)])
+        vals = jnp.concatenate([lhs._csr_data, rhs._csr_data])
+        order = np.lexsort((c, r))
+        r, c = r[order], c[order]
+        # unique (row, col) keys in CSR order + inverse map for the sum
+        key_changed = np.empty(len(r), bool)
+        key_changed[:1] = True
+        if len(r) > 1:
+            key_changed[1:] = (r[1:] != r[:-1]) | (c[1:] != c[:-1])
+        seg = np.cumsum(key_changed) - 1
+        n_seg = int(seg[-1]) + 1 if len(seg) else 0
+        summed = jax.ops.segment_sum(vals[jnp.asarray(order)],
+                                     jnp.asarray(seg),
+                                     num_segments=n_seg)
+        uniq_r, uniq_c = r[key_changed], c[key_changed]
+        row_counts = np.bincount(uniq_r, minlength=lhs.shape[0])
+        indptr = np.concatenate([[0], np.cumsum(row_counts)])
+        return CSRNDArray(summed, jnp.asarray(uniq_c.astype(np.int32)),
+                          jnp.asarray(indptr.astype(np.int32)),
+                          lhs.shape, lhs.context)
     if isinstance(lhs, CSRNDArray) or isinstance(rhs, CSRNDArray):
         from ..config import storage_fallback_log
-        storage_fallback_log("elemwise_add(csr, csr)")
+        storage_fallback_log("elemwise_add(%s, %s)"
+                             % (lhs.stype, rhs.stype))
         out = lhs.tostype("default") + rhs.tostype("default")
         return cast_storage(out, "csr")
     return add_n([lhs, rhs])

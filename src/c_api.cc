@@ -1925,4 +1925,499 @@ int MXRecordIOReaderReadRecord(RecordIOHandle handle, char const** buf,
   return rc;
 }
 
+
+// ---- sparse NDArray -------------------------------------------------------
+
+int MXNDArrayCreateSparseEx(int storage_type, const mx_uint* shape,
+                            mx_uint ndim, int dev_type, int dev_id,
+                            int delay_alloc, int dtype, mx_uint num_aux,
+                            int* aux_type, mx_uint* aux_ndims,
+                            const mx_uint* aux_shape, NDArrayHandle* out) {
+  (void)delay_alloc;
+  (void)aux_ndims;
+  (void)aux_shape;
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* shp = UIntList(shape, ndim);
+  PyObject* at = IntList(aux_type, num_aux);
+  PyObject* a = Py_BuildValue("(iOiiiO)", storage_type, shp, dev_type,
+                              dev_id, dtype, at);
+  Py_DECREF(shp);
+  Py_DECREF(at);
+  PyGILState_Release(gil);
+  return CallHandle("ndarray_create_sparse", a, out);
+}
+
+int MXNDArrayGetAuxType(NDArrayHandle handle, mx_uint i, int* out_type) {
+  return CallIntV("ndarray_get_aux_type", out_type, "(OI)",
+                  static_cast<PyObject*>(handle), i);
+}
+
+int MXNDArrayGetAuxNDArray(NDArrayHandle handle, mx_uint i,
+                           NDArrayHandle* out) {
+  return CallHandleV("ndarray_get_aux_ndarray", out, "(OI)",
+                     static_cast<PyObject*>(handle), i);
+}
+
+int MXNDArrayGetDataNDArray(NDArrayHandle handle, NDArrayHandle* out) {
+  return CallHandleV("ndarray_get_data_ndarray", out, "(O)",
+                     static_cast<PyObject*>(handle));
+}
+
+int MXNDArraySyncCheckFormat(NDArrayHandle handle, const bool full_check) {
+  return CallVoidV("ndarray_sync_check_format", "(Oi)",
+                   static_cast<PyObject*>(handle), full_check ? 1 : 0);
+}
+
+// READ-ONLY host view (documented divergence: PJRT owns device memory,
+// so this is a synced host copy, alive until the next call on this
+// thread — the reference returns the live device pointer)
+int MXNDArrayGetData(NDArrayHandle handle, void** out_pdata) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* r = CallImpl("ndarray_get_data_ptr",
+                         Py_BuildValue("(O)",
+                                       static_cast<PyObject*>(handle)));
+  int rc = -1;
+  if (r != nullptr) {
+    // r is a numpy array; keep it alive in a thread-local slot and
+    // expose its buffer
+    static thread_local PyObject* keep = nullptr;
+    PyObject* old = keep;
+    keep = r;
+    Py_XDECREF(old);
+    Py_buffer view;
+    if (PyObject_GetBuffer(r, &view, PyBUF_SIMPLE) == 0) {
+      *out_pdata = view.buf;
+      PyBuffer_Release(&view);  // numpy keeps the memory; r stays alive
+      rc = 0;
+    } else {
+      CaptureError();
+    }
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+// ---- legacy function API --------------------------------------------------
+
+typedef void* FunctionHandle;
+
+int MXListFunctions(mx_uint* out_size, FunctionHandle** out_array) {
+  // functions ARE the ops under the legacy convention
+  return MXSymbolListAtomicSymbolCreators(
+      out_size, reinterpret_cast<AtomicSymbolCreator**>(out_array));
+}
+
+int MXGetFunction(const char* name, FunctionHandle* out) {
+  return NNGetOpHandle(name,
+                       reinterpret_cast<AtomicSymbolCreator*>(out));
+}
+
+int MXFuncGetInfo(FunctionHandle fun, const char** name,
+                  const char** description, mx_uint* num_args,
+                  const char*** arg_names, const char*** arg_type_infos,
+                  const char*** arg_descriptions,
+                  const char** return_type) {
+  PyGILState_STATE gil = EnsurePython();
+  std::string* op = static_cast<std::string*>(fun);
+  PyObject* r = CallImpl("func_info", Py_BuildValue("(s)", op->c_str()));
+  if (r == nullptr) {
+    PyGILState_Release(gil);
+    return -1;
+  }
+  UnpackInfoGroups(r, name, description, num_args, arg_names,
+                   arg_type_infos, arg_descriptions);
+  Py_DECREF(r);
+  if (return_type != nullptr) *return_type = "";
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXFuncDescribe(FunctionHandle fun, mx_uint* num_use_vars,
+                   mx_uint* num_scalars, mx_uint* num_mutate_vars,
+                   int* type_mask) {
+  PyGILState_STATE gil = EnsurePython();
+  std::string* op = static_cast<std::string*>(fun);
+  PyObject* r = CallImpl("func_describe",
+                         Py_BuildValue("(s)", op->c_str()));
+  int rc = -1;
+  if (r != nullptr) {
+    *num_use_vars =
+        static_cast<mx_uint>(PyLong_AsLong(PyTuple_GetItem(r, 0)));
+    *num_scalars =
+        static_cast<mx_uint>(PyLong_AsLong(PyTuple_GetItem(r, 1)));
+    *num_mutate_vars =
+        static_cast<mx_uint>(PyLong_AsLong(PyTuple_GetItem(r, 2)));
+    *type_mask = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 3)));
+    Py_DECREF(r);
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+static int FuncInvokeImpl(FunctionHandle fun, NDArrayHandle* use_vars,
+                          float* scalar_args, NDArrayHandle* mutate_vars,
+                          int num_params, char** param_keys,
+                          char** param_vals);
+
+int MXFuncInvokeEx(FunctionHandle fun, NDArrayHandle* use_vars,
+                   float* scalar_args, NDArrayHandle* mutate_vars,
+                   int num_params, char** param_keys, char** param_vals) {
+  return FuncInvokeImpl(fun, use_vars, scalar_args, mutate_vars,
+                        num_params, param_keys, param_vals);
+}
+
+int MXFuncInvoke(FunctionHandle fun, NDArrayHandle* use_vars,
+                 float* scalar_args, NDArrayHandle* mutate_vars) {
+  return FuncInvokeImpl(fun, use_vars, scalar_args, mutate_vars, 0,
+                        nullptr, nullptr);
+}
+
+static int FuncInvokeImpl(FunctionHandle fun, NDArrayHandle* use_vars,
+                          float* scalar_args, NDArrayHandle* mutate_vars,
+                          int num_params, char** param_keys,
+                          char** param_vals) {
+  PyGILState_STATE gil = EnsurePython();
+  std::string* op = static_cast<std::string*>(fun);
+  // arity comes from func_describe
+  PyObject* d = CallImpl("func_describe",
+                         Py_BuildValue("(s)", op->c_str()));
+  if (d == nullptr) {
+    PyGILState_Release(gil);
+    return -1;
+  }
+  long n_use = PyLong_AsLong(PyTuple_GetItem(d, 0));
+  long n_scalar = PyLong_AsLong(PyTuple_GetItem(d, 1));
+  long n_mut = PyLong_AsLong(PyTuple_GetItem(d, 2));
+  Py_DECREF(d);
+  PyObject* uses = HandleList(use_vars, static_cast<mx_uint>(n_use));
+  PyObject* scalars = PyList_New(n_scalar);
+  for (long i = 0; i < n_scalar; ++i)
+    PyList_SetItem(scalars, i,
+                   PyFloat_FromDouble(scalar_args ? scalar_args[i] : 0.0));
+  PyObject* muts = HandleList(mutate_vars, static_cast<mx_uint>(n_mut));
+  PyObject* ek = StrList(const_cast<const char**>(param_keys),
+                         param_keys != nullptr ? num_params : 0);
+  PyObject* ev = StrList(const_cast<const char**>(param_vals),
+                         param_vals != nullptr ? num_params : 0);
+  PyObject* a = Py_BuildValue("(sOOOOO)", op->c_str(), uses, scalars, muts,
+                              ek, ev);
+  Py_DECREF(uses);
+  Py_DECREF(scalars);
+  Py_DECREF(muts);
+  Py_DECREF(ek);
+  Py_DECREF(ev);
+  PyObject* r = CallImpl("func_invoke", a);
+  int rc = r != nullptr ? 0 : -1;
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+// ---- executor bind with device map ---------------------------------------
+
+static int ExecutorBindMapped(SymbolHandle sym, int dev_type, int dev_id,
+                              mx_uint num_map_keys, const char** map_keys,
+                              const int* map_dev_types,
+                              const int* map_dev_ids, mx_uint len,
+                              NDArrayHandle* in_args,
+                              NDArrayHandle* arg_grad_store,
+                              mx_uint* grad_req_type, mx_uint aux_states_len,
+                              NDArrayHandle* aux_states,
+                              ExecutorHandle* out) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* mk = StrList(map_keys, num_map_keys);
+  PyObject* mt = IntList(map_dev_types, num_map_keys);
+  PyObject* mi = IntList(map_dev_ids, num_map_keys);
+  PyObject* args = HandleList(in_args, len);
+  PyObject* grads = HandleList(arg_grad_store, len);
+  PyObject* reqs = UIntList(grad_req_type, len);
+  PyObject* aux = HandleList(aux_states, aux_states_len);
+  PyObject* a = Py_BuildValue("(OiiOOOOOOO)", static_cast<PyObject*>(sym),
+                              dev_type, dev_id, mk, mt, mi, args, grads,
+                              reqs, aux);
+  Py_DECREF(mk); Py_DECREF(mt); Py_DECREF(mi);
+  Py_DECREF(args); Py_DECREF(grads); Py_DECREF(reqs); Py_DECREF(aux);
+  PyGILState_Release(gil);
+  return CallHandle("executor_bind_x", a, out);
+}
+
+int MXExecutorBindX(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                    mx_uint num_map_keys, const char** map_keys,
+                    const int* map_dev_types, const int* map_dev_ids,
+                    mx_uint len, NDArrayHandle* in_args,
+                    NDArrayHandle* arg_grad_store, mx_uint* grad_req_type,
+                    mx_uint aux_states_len, NDArrayHandle* aux_states,
+                    ExecutorHandle* out) {
+  return ExecutorBindMapped(symbol_handle, dev_type, dev_id, num_map_keys,
+                            map_keys, map_dev_types, map_dev_ids, len,
+                            in_args, arg_grad_store, grad_req_type,
+                            aux_states_len, aux_states, out);
+}
+
+int MXExecutorBindEX(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                     mx_uint num_map_keys, const char** map_keys,
+                     const int* map_dev_types, const int* map_dev_ids,
+                     mx_uint len, NDArrayHandle* in_args,
+                     NDArrayHandle* arg_grad_store, mx_uint* grad_req_type,
+                     mx_uint aux_states_len, NDArrayHandle* aux_states,
+                     ExecutorHandle shared_exec, ExecutorHandle* out) {
+  (void)shared_exec;  // allocator-reuse hint; PJRT owns allocation
+  return ExecutorBindMapped(symbol_handle, dev_type, dev_id, num_map_keys,
+                            map_keys, map_dev_types, map_dev_ids, len,
+                            in_args, arg_grad_store, grad_req_type,
+                            aux_states_len, aux_states, out);
+}
+
+typedef void (*ExecutorMonitorCallback)(const char*, NDArrayHandle, void*);
+
+int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                 ExecutorMonitorCallback callback,
+                                 void* callback_handle) {
+  return CallVoidV(
+      "executor_set_monitor_callback", "(OLLi)",
+      static_cast<PyObject*>(handle),
+      static_cast<long long>(reinterpret_cast<intptr_t>(callback)),
+      static_cast<long long>(reinterpret_cast<intptr_t>(callback_handle)),
+      0);
+}
+
+// ---- Ex invoke variants ---------------------------------------------------
+
+int MXImperativeInvokeEx(AtomicSymbolCreator creator, int num_inputs,
+                         NDArrayHandle* inputs, int* num_outputs,
+                         NDArrayHandle** outputs, int num_params,
+                         const char** param_keys, const char** param_vals,
+                         const int** out_stypes) {
+  int rc = MXImperativeInvoke(creator, num_inputs, inputs, num_outputs,
+                              outputs, num_params, param_keys, param_vals);
+  if (rc != 0) return rc;
+  PyGILState_STATE gil = EnsurePython();
+  g_int_buf.clear();
+  for (int i = 0; i < *num_outputs; ++i) {
+    PyObject* r = CallImpl(
+        "ndarray_storage_type",
+        Py_BuildValue("(O)", static_cast<PyObject*>((*outputs)[i])));
+    g_int_buf.push_back(r != nullptr
+                            ? static_cast<int>(PyLong_AsLong(r)) : 0);
+    Py_XDECREF(r);
+  }
+  if (out_stypes != nullptr) *out_stypes = g_int_buf.data();
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
+                       NDArrayHandle* inputs, int* num_outputs,
+                       NDArrayHandle** outputs, const int** out_stypes) {
+  int rc = MXInvokeCachedOp(handle, num_inputs, inputs, num_outputs,
+                            outputs);
+  if (rc != 0) return rc;
+  PyGILState_STATE gil = EnsurePython();
+  g_int_buf.clear();
+  for (int i = 0; i < *num_outputs; ++i) {
+    PyObject* r = CallImpl(
+        "ndarray_storage_type",
+        Py_BuildValue("(O)", static_cast<PyObject*>((*outputs)[i])));
+    g_int_buf.push_back(r != nullptr
+                            ? static_cast<int>(PyLong_AsLong(r)) : 0);
+    Py_XDECREF(r);
+  }
+  if (out_stypes != nullptr) *out_stypes = g_int_buf.data();
+  PyGILState_Release(gil);
+  return 0;
+}
+
+// ---- RTC (PallasModule-backed; the reference compiles CUDA C here —
+// documented divergence, PARITY.md) ----------------------------------------
+
+typedef void* RtcHandle;
+typedef void* CudaModuleHandle;
+typedef void* CudaKernelHandle;
+
+int MXRtcCreate(char* name, mx_uint num_input, mx_uint num_output,
+                char** input_names, char** output_names,
+                NDArrayHandle* inputs, NDArrayHandle* outputs,
+                char* kernel, RtcHandle* out) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* in_names = StrList(const_cast<const char**>(input_names),
+                               num_input);
+  PyObject* out_names = StrList(const_cast<const char**>(output_names),
+                                num_output);
+  PyObject* ins = HandleList(inputs, num_input);
+  PyObject* outs = HandleList(outputs, num_output);
+  PyObject* a = Py_BuildValue("(sOOOOs)", name, in_names, out_names, ins,
+                              outs, kernel);
+  Py_DECREF(in_names);
+  Py_DECREF(out_names);
+  Py_DECREF(ins);
+  Py_DECREF(outs);
+  PyGILState_Release(gil);
+  return CallHandle("rtc_create", a, out);
+}
+
+int MXRtcPush(RtcHandle handle, mx_uint num_input, mx_uint num_output,
+              NDArrayHandle* inputs, NDArrayHandle* outputs,
+              mx_uint gridDimX, mx_uint gridDimY, mx_uint gridDimZ,
+              mx_uint blockDimX, mx_uint blockDimY, mx_uint blockDimZ) {
+  (void)gridDimX; (void)gridDimY; (void)gridDimZ;
+  (void)blockDimX; (void)blockDimY; (void)blockDimZ;  // XLA schedules
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* ins = HandleList(inputs, num_input);
+  PyObject* outs = HandleList(outputs, num_output);
+  PyObject* a = Py_BuildValue("(OOO)", static_cast<PyObject*>(handle),
+                              ins, outs);
+  Py_DECREF(ins);
+  Py_DECREF(outs);
+  PyGILState_Release(gil);
+  return CallVoid("rtc_push", a);
+}
+
+int MXRtcFree(RtcHandle handle) {
+  PyGILState_STATE gil = EnsurePython();
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXRtcCudaModuleCreate(const char* source, int num_options,
+                          const char** options, int num_exports,
+                          const char** exports, CudaModuleHandle* out) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* opts = StrList(options, num_options);
+  PyObject* exps = StrList(exports, num_exports);
+  PyObject* a = Py_BuildValue("(sOO)", source, opts, exps);
+  Py_DECREF(opts);
+  Py_DECREF(exps);
+  PyGILState_Release(gil);
+  return CallHandle("rtc_module_create", a, out);
+}
+
+int MXRtcCudaModuleFree(CudaModuleHandle handle) {
+  PyGILState_STATE gil = EnsurePython();
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXRtcCudaKernelCreate(CudaModuleHandle handle, const char* name,
+                          int num_args, int* is_ndarray, int* is_const,
+                          int* arg_types, CudaKernelHandle* out) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* nds = IntList(is_ndarray, num_args);
+  PyObject* consts = IntList(is_const, num_args);
+  PyObject* types = IntList(arg_types, num_args);
+  PyObject* a = Py_BuildValue("(OsOOO)", static_cast<PyObject*>(handle),
+                              name, nds, consts, types);
+  Py_DECREF(nds);
+  Py_DECREF(consts);
+  Py_DECREF(types);
+  PyGILState_Release(gil);
+  return CallHandle("rtc_kernel_create", a, out);
+}
+
+int MXRtcCudaKernelFree(CudaKernelHandle handle) {
+  PyGILState_STATE gil = EnsurePython();
+  Py_XDECREF(static_cast<PyObject*>(handle));
+  PyGILState_Release(gil);
+  return 0;
+}
+
+int MXRtcCudaKernelCall(CudaKernelHandle handle, int dev_id, void** args,
+                        mx_uint grid_dim_x, mx_uint grid_dim_y,
+                        mx_uint grid_dim_z, mx_uint block_dim_x,
+                        mx_uint block_dim_y, mx_uint block_dim_z,
+                        mx_uint shared_mem) {
+  (void)shared_mem;
+  PyGILState_STATE gil = EnsurePython();
+  // the tuple handle is (kernel, is_ndarray, dtype_codes); its second
+  // element tells how many args the call takes
+  PyObject* tup = static_cast<PyObject*>(handle);
+  Py_ssize_t n_args = PyList_Size(PyTuple_GetItem(tup, 1));
+  PyObject* addrs = PyList_New(n_args);
+  for (Py_ssize_t i = 0; i < n_args; ++i)
+    PyList_SetItem(addrs, i,
+                   PyLong_FromLongLong(static_cast<long long>(
+                       reinterpret_cast<intptr_t>(args[i]))));
+  PyObject* a = Py_BuildValue("(OiOIIIIII)", tup, dev_id, addrs,
+                              grid_dim_x, grid_dim_y, grid_dim_z,
+                              block_dim_x, block_dim_y, block_dim_z);
+  Py_DECREF(addrs);
+  PyGILState_Release(gil);
+  return CallVoid("rtc_kernel_call", a);
+}
+
+// ---- custom ops (documented divergence) -----------------------------------
+
+// The reference's C callback protocol (MXCallbackList with per-op
+// forward/backward/infer function pointers) exists to run custom code
+// inside its C++ engine. Here custom operators are a PYTHON surface
+// (mxnet_tpu.operator CustomOp/CustomOpProp) running under the same
+// executor as every other op; the C entry points report that clearly
+// instead of half-implementing an engine that does not exist.
+int MXCustomOpRegister(const char* op_type, void* creator) {
+  (void)creator;
+  mxtpu_last_error =
+      std::string("MXCustomOpRegister: C-callback custom ops are not "
+                  "supported on the TPU backend; register op '") +
+      (op_type ? op_type : "?") +
+      "' through the Python CustomOp API (mxnet_tpu.operator.register) "
+      "— see PARITY.md 'known deliberate divergences'";
+  return -1;
+}
+
+int MXCustomFunctionRecord(int num_inputs, NDArrayHandle* inputs,
+                           int num_outputs, NDArrayHandle* outputs,
+                           void* callbacks) {
+  (void)num_inputs; (void)inputs; (void)num_outputs; (void)outputs;
+  (void)callbacks;
+  mxtpu_last_error =
+      "MXCustomFunctionRecord: C-callback autograd functions are not "
+      "supported on the TPU backend; use autograd.Function in Python "
+      "(mxnet_tpu.autograd) — see PARITY.md";
+  return -1;
+}
+
+// ---- shared-memory transport ----------------------------------------------
+
+int MXNDArrayGetSharedMemHandle(NDArrayHandle handle, int* shared_pid,
+                                int* shared_id) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* r = CallImpl("ndarray_get_shared_mem_handle",
+                         Py_BuildValue("(O)",
+                                       static_cast<PyObject*>(handle)));
+  int rc = -1;
+  if (r != nullptr) {
+    *shared_pid = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 0)));
+    *shared_id = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 1)));
+    Py_DECREF(r);
+    rc = 0;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXNDArrayCreateFromSharedMem(int shared_pid, int shared_id,
+                                 const mx_uint* shape, mx_uint ndim,
+                                 int dtype, NDArrayHandle* out) {
+  PyGILState_STATE gil = EnsurePython();
+  PyObject* shp = UIntList(shape, ndim);
+  PyObject* a = Py_BuildValue("(iiOi)", shared_pid, shared_id, shp, dtype);
+  Py_DECREF(shp);
+  PyGILState_Release(gil);
+  return CallHandle("ndarray_create_from_shared_mem", a, out);
+}
+
+
+int MXSymbolGrad(SymbolHandle sym, mx_uint num_wrt, const char** wrt,
+                 SymbolHandle* out) {
+  (void)sym; (void)num_wrt; (void)wrt; (void)out;
+  // the reference's own implementation is LOG(FATAL) << "not
+  // implemented" (c_api_symbolic.cc:564-568); same contract here
+  mxtpu_last_error = "MXSymbolGrad: not implemented (the reference "
+                     "raises the same; use executor backward or "
+                     "MXAutogradBackward)";
+  return -1;
+}
+
 }  // extern "C"

@@ -828,3 +828,256 @@ def test_kvstore_str_updater_ex():
     assert L.MXKVStorePullEx(kv, 1, keys, vals3, 0) == 0
     np.testing.assert_allclose(_read_nd(L, out_v, 2), [1.5, 2.5])
     assert L.MXKVStoreFree(kv) == 0
+
+
+# ===========================================================================
+# Final tranche: sparse ABI, legacy MXFunc*, BindX, monitor callback,
+# RTC, shared-mem transport, Ex invoke variants
+# ===========================================================================
+
+def _lib3():
+    L = _lib2()
+    vp, u, i = ctypes.c_void_p, ctypes.c_uint, ctypes.c_int
+    P, cp = ctypes.POINTER, ctypes.c_char_p
+    L.MXNDArrayCreateSparseEx.argtypes = [i, P(u), u, i, i, i, i, u, P(i),
+                                          P(u), P(u), P(vp)]
+    L.MXNDArrayGetAuxType.argtypes = [vp, u, P(i)]
+    L.MXNDArrayGetAuxNDArray.argtypes = [vp, u, P(vp)]
+    L.MXNDArrayGetDataNDArray.argtypes = [vp, P(vp)]
+    L.MXNDArraySyncCheckFormat.argtypes = [vp, ctypes.c_bool]
+    L.MXNDArrayGetData.argtypes = [vp, P(vp)]
+    L.MXGetFunction.argtypes = [cp, P(vp)]
+    L.MXFuncDescribe.argtypes = [vp, P(u), P(u), P(u), P(i)]
+    L.MXFuncGetInfo.argtypes = [vp, P(cp), P(cp), P(u), P(P(cp)),
+                                P(P(cp)), P(P(cp)), P(cp)]
+    L.MXFuncInvoke.argtypes = [vp, P(vp), P(ctypes.c_float), P(vp)]
+    L.MXExecutorSetMonitorCallback.argtypes = [vp, vp, vp]
+    L.MXRtcCudaModuleCreate.argtypes = [cp, i, P(cp), i, P(cp), P(vp)]
+    L.MXRtcCudaKernelCreate.argtypes = [vp, cp, i, P(i), P(i), P(i), P(vp)]
+    L.MXRtcCudaKernelCall.argtypes = [vp, i, P(vp), u, u, u, u, u, u, u]
+    L.MXNDArrayGetSharedMemHandle.argtypes = [vp, P(i), P(i)]
+    L.MXNDArrayCreateFromSharedMem.argtypes = [i, i, P(u), u, i, P(vp)]
+    L.MXCustomOpRegister.argtypes = [cp, vp]
+    return L
+
+
+def test_sparse_ndarray_c_api():
+    L = _lib3()
+    shape = (ctypes.c_uint * 2)(4, 3)
+    aux_t = (ctypes.c_int * 2)(6, 6)
+    h = ctypes.c_void_p()
+    assert L.MXNDArrayCreateSparseEx(2, shape, 2, 1, 0, 0, 0, 2, aux_t,
+                                     None, None, ctypes.byref(h)) == 0, \
+        L.MXGetLastError()
+    st = ctypes.c_int(-1)
+    assert L.MXNDArrayGetStorageType(h, ctypes.byref(st)) == 0
+    assert st.value == 2  # kCSRStorage
+    assert L.MXNDArraySyncCheckFormat(h, True) == 0, L.MXGetLastError()
+
+    # cast a dense array to csr through the imperative ABI, then read
+    # its aux/data arrays back out
+    dense = np.array([[0, 1, 0], [2, 0, 3], [0, 0, 0], [4, 0, 0]],
+                     np.float32)
+    dh = _make_nd(L, dense)
+    op = ctypes.c_void_p()
+    assert L.NNGetOpHandle(b"cast_storage", ctypes.byref(op)) == 0
+    n_out = ctypes.c_int(0)
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    ins = (ctypes.c_void_p * 1)(dh)
+    keys = (ctypes.c_char_p * 1)(b"stype")
+    vals = (ctypes.c_char_p * 1)(b"csr")
+    assert L.MXImperativeInvoke(op, 1, ins, ctypes.byref(n_out),
+                                ctypes.byref(outs), 1, keys, vals) == 0, \
+        L.MXGetLastError()
+    csr = ctypes.c_void_p(outs[0])
+    assert L.MXNDArrayGetStorageType(csr, ctypes.byref(st)) == 0
+    assert st.value == 2
+    assert L.MXNDArraySyncCheckFormat(csr, True) == 0, L.MXGetLastError()
+
+    data_nd = ctypes.c_void_p()
+    assert L.MXNDArrayGetDataNDArray(csr, ctypes.byref(data_nd)) == 0
+    np.testing.assert_allclose(_read_nd(L, data_nd, 4), [1, 2, 3, 4])
+    aux_nd = ctypes.c_void_p()
+    assert L.MXNDArrayGetAuxNDArray(csr, 0, ctypes.byref(aux_nd)) == 0
+    ndim = ctypes.c_uint()
+    pdata = ctypes.POINTER(ctypes.c_uint)()
+    assert L.MXNDArrayGetShape(aux_nd, ctypes.byref(ndim),
+                               ctypes.byref(pdata)) == 0
+    assert pdata[0] == 5  # indptr has nrows+1 entries
+    t = ctypes.c_int(-1)
+    assert L.MXNDArrayGetAuxType(csr, 0, ctypes.byref(t)) == 0
+    assert t.value in (4, 6)  # int32/int64
+    for hh in (h, dh, csr, data_nd, aux_nd):
+        L.MXNDArrayFree(hh)
+
+
+def test_ndarray_get_data_pointer():
+    L = _lib3()
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    h = _make_nd(L, x)
+    ptr = ctypes.c_void_p()
+    assert L.MXNDArrayGetData(h, ctypes.byref(ptr)) == 0, L.MXGetLastError()
+    view = np.ctypeslib.as_array(
+        ctypes.cast(ptr, ctypes.POINTER(ctypes.c_float)), shape=(6,))
+    np.testing.assert_allclose(view, x.ravel())
+    L.MXNDArrayFree(h)
+
+
+def test_legacy_function_api():
+    L = _lib3()
+    n = ctypes.c_uint()
+    funcs = ctypes.POINTER(ctypes.c_void_p)()
+    assert L.MXListFunctions(ctypes.byref(n), ctypes.byref(funcs)) == 0
+    assert n.value > 200
+
+    f = ctypes.c_void_p()
+    assert L.MXGetFunction(b"sgd_update", ctypes.byref(f)) == 0
+    nu, ns, nm = ctypes.c_uint(), ctypes.c_uint(), ctypes.c_uint()
+    mask = ctypes.c_int()
+    assert L.MXFuncDescribe(f, ctypes.byref(nu), ctypes.byref(ns),
+                            ctypes.byref(nm), ctypes.byref(mask)) == 0
+    assert nu.value == 1 and nm.value == 1  # grad in, weight in/out
+
+    name = ctypes.c_char_p()
+    desc = ctypes.c_char_p()
+    na = ctypes.c_uint()
+    an = ctypes.POINTER(ctypes.c_char_p)()
+    at = ctypes.POINTER(ctypes.c_char_p)()
+    ad = ctypes.POINTER(ctypes.c_char_p)()
+    rt = ctypes.c_char_p()
+    assert L.MXFuncGetInfo(f, ctypes.byref(name), ctypes.byref(desc),
+                           ctypes.byref(na), ctypes.byref(an),
+                           ctypes.byref(at), ctypes.byref(ad),
+                           ctypes.byref(rt)) == 0
+    scalar_names = [an[i].decode() for i in range(na.value)]
+    assert "lr" in scalar_names
+
+    # invoke: w -= lr * g with lr read from the scalar slot
+    w = _make_nd(L, np.ones(4, np.float32))
+    g = _make_nd(L, np.full(4, 0.5, np.float32))
+    scalars = (ctypes.c_float * na.value)()
+    for i, s in enumerate(scalar_names):
+        scalars[i] = {"lr": 0.2, "rescale_grad": 1.0, "wd": 0.0,
+                      "clip_gradient": -1.0}.get(s, 0.0)
+    use = (ctypes.c_void_p * 1)(g)
+    mut = (ctypes.c_void_p * 1)(w)
+    assert L.MXFuncInvoke(f, use, scalars, mut) == 0, L.MXGetLastError()
+    np.testing.assert_allclose(_read_nd(L, w, 4), 0.9, rtol=1e-6)
+    for hh in (w, g):
+        L.MXNDArrayFree(hh)
+
+
+def test_executor_bindx_and_monitor():
+    L = _lib3()
+    sym = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                                name="fc")
+    h = ctypes.c_void_p()
+    assert L.MXSymbolCreateFromJSON(sym.tojson().encode(),
+                                    ctypes.byref(h)) == 0
+    rs = np.random.RandomState(0)
+    args = [_make_nd(L, rs.rand(2, 4).astype(np.float32)),
+            _make_nd(L, rs.rand(3, 4).astype(np.float32)),
+            _make_nd(L, np.zeros(3, np.float32))]
+    arr = (ctypes.c_void_p * 3)(*args)
+    grads = (ctypes.c_void_p * 3)(None, None, None)
+    reqs = (ctypes.c_uint * 3)(0, 0, 0)
+    ex = ctypes.c_void_p()
+    assert L.MXExecutorBindEX(h, 1, 0, 0, None, None, None, 3, arr, grads,
+                              reqs, 0, None, None, ctypes.byref(ex)) == 0, \
+        L.MXGetLastError()
+
+    seen = []
+    CB = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p,
+                          ctypes.c_void_p)
+
+    def monitor(nm, arr_h, _):
+        seen.append(nm.decode())
+        L.MXNDArrayFree(arr_h)
+
+    cb = CB(monitor)
+    assert L.MXExecutorSetMonitorCallback(
+        ex, ctypes.cast(cb, ctypes.c_void_p), None) == 0
+    assert L.MXExecutorForward(ex, 0) == 0
+    assert seen, "monitor callback never fired"
+    L.MXExecutorFree(ex)
+    L.MXSymbolFree(h)
+    for a in args:
+        L.MXNDArrayFree(a)
+
+
+def test_rtc_cuda_module_c_api():
+    L = _lib3()
+    src = b"import jax.numpy as jnp\n" \
+          b"def axpy(alpha, x, y):\n" \
+          b"    return y + alpha * x\n"
+    exports = (ctypes.c_char_p * 1)(b"axpy")
+    mod = ctypes.c_void_p()
+    assert L.MXRtcCudaModuleCreate(src, 0, None, 1, exports,
+                                   ctypes.byref(mod)) == 0, \
+        L.MXGetLastError()
+    is_nd = (ctypes.c_int * 3)(0, 1, 1)
+    is_const = (ctypes.c_int * 3)(0, 1, 0)
+    types = (ctypes.c_int * 3)(0, 0, 0)  # float
+    k = ctypes.c_void_p()
+    assert L.MXRtcCudaKernelCreate(mod, b"axpy", 3, is_nd, is_const, types,
+                                   ctypes.byref(k)) == 0, L.MXGetLastError()
+    x = _make_nd(L, np.ones(4, np.float32))
+    y = _make_nd(L, np.full(4, 2.0, np.float32))
+    alpha = ctypes.c_float(3.0)
+    call_args = (ctypes.c_void_p * 3)(
+        ctypes.cast(ctypes.byref(alpha), ctypes.c_void_p), x, y)
+    assert L.MXRtcCudaKernelCall(k, 0, call_args, 1, 1, 1, 4, 1, 1, 0) \
+        == 0, L.MXGetLastError()
+    np.testing.assert_allclose(_read_nd(L, y, 4), 5.0)
+    assert L.MXRtcCudaKernelFree(k) == 0
+    assert L.MXRtcCudaModuleFree(mod) == 0
+    for hh in (x, y):
+        L.MXNDArrayFree(hh)
+
+
+def test_shared_mem_c_api():
+    L = _lib3()
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    h = _make_nd(L, x)
+    pid = ctypes.c_int()
+    sid = ctypes.c_int()
+    assert L.MXNDArrayGetSharedMemHandle(h, ctypes.byref(pid),
+                                         ctypes.byref(sid)) == 0, \
+        L.MXGetLastError()
+    shape = (ctypes.c_uint * 2)(3, 4)
+    h2 = ctypes.c_void_p()
+    assert L.MXNDArrayCreateFromSharedMem(pid.value, sid.value, shape, 2,
+                                          0, ctypes.byref(h2)) == 0, \
+        L.MXGetLastError()
+    np.testing.assert_allclose(_read_nd(L, h2, 12), x.ravel())
+    # one-shot transport: the consumer unlinked the segment
+    assert not os.path.exists(
+        "/dev/shm/mxtpu_%d_%d" % (pid.value, sid.value))
+    for hh in (h, h2):
+        L.MXNDArrayFree(hh)
+
+
+def test_op_handle_rejects_nd_module_attrs():
+    """NNGetOpHandle must NOT hand out handles for arbitrary mx.nd
+    attributes (save/array/NDArray are not operators)."""
+    L = _lib3()
+    op = ctypes.c_void_p()
+    assert L.NNGetOpHandle(b"save", ctypes.byref(op)) == -1
+    assert L.NNGetOpHandle(b"cast_storage", ctypes.byref(op)) == 0
+
+
+def test_custom_op_register_reports_divergence():
+    L = _lib3()
+    assert L.MXCustomOpRegister(b"my_op", None) == -1
+    msg = L.MXGetLastError().decode()
+    assert "CustomOp" in msg and "Python" in msg
+
+
+def test_symbol_grad_matches_reference_contract():
+    """MXSymbolGrad is unimplemented in the reference itself
+    (c_api_symbolic.cc:564 LOG(FATAL)); ours errors with the same
+    contract instead of crashing the process."""
+    L = _lib3()
+    out = ctypes.c_void_p()
+    assert L.MXSymbolGrad(None, 0, None, ctypes.byref(out)) == -1
+    assert b"not implemented" in L.MXGetLastError()

@@ -114,9 +114,11 @@ def convert(sym, arg_params, aux_params, input_shape, class_labels=None,
             layers.append({"type": "flatten", "name": name,
                            "input": in_blobs[:1], "output": [out_blob]})
         elif op in ("Reshape", "reshape"):
+            tgt = attrs.get("shape", "()")
             layers.append({"type": "reshape", "name": name,
                            "input": in_blobs[:1], "output": [out_blob],
-                           "shape": attrs.get("shape")})
+                           "shape": json.loads(
+                               tgt.replace("(", "[").replace(")", "]"))})
         elif op in ("SoftmaxOutput", "softmax"):
             layers.append({"type": "softmax", "name": name,
                            "input": in_blobs[:1], "output": [out_blob]})
@@ -205,23 +207,34 @@ def spec_to_mlmodel(spec, path):
                 output_channels=w.shape[0], has_bias=b is not None,
                 input_name=l["input"][0], output_name=l["output"][0])
         elif kind == "activation":
+            # MXNet act names -> coremltools non_linearity names
+            act_map = {"relu": "RELU", "sigmoid": "SIGMOID",
+                       "tanh": "TANH", "softrelu": "SOFTPLUS"}
             builder.add_activation(
                 name=l["name"],
-                non_linearity=l["act_type"].upper()
-                if l["act_type"] != "relu" else "RELU",
+                non_linearity=act_map.get(l["act_type"],
+                                          l["act_type"].upper()),
                 input_name=l["input"][0], output_name=l["output"][0])
         elif kind == "pooling":
+            pool_map = {"max": "MAX", "avg": "AVERAGE", "sum": "L2"}
             builder.add_pooling(
                 name=l["name"], height=l["kernel"][0],
                 width=l["kernel"][1], stride_height=l["stride"][0],
                 stride_width=l["stride"][1],
-                layer_type=l["pool_type"].upper(), padding_type="VALID",
+                layer_type=pool_map.get(l["pool_type"],
+                                        l["pool_type"].upper()),
+                padding_type="VALID",
                 input_name=l["input"][0], output_name=l["output"][0],
                 is_global=l.get("global", False))
         elif kind == "flatten":
             builder.add_flatten(name=l["name"], mode=0,
                                 input_name=l["input"][0],
                                 output_name=l["output"][0])
+        elif kind == "reshape":
+            builder.add_reshape(name=l["name"],
+                                input_name=l["input"][0],
+                                output_name=l["output"][0],
+                                target_shape=tuple(l["shape"]), mode=0)
         elif kind == "softmax":
             builder.add_softmax(name=l["name"], input_name=l["input"][0],
                                 output_name=l["output"][0])
