@@ -20,7 +20,8 @@ from ..io import DataIter, DataBatch, DataDesc
 from ..ndarray import array as nd_array
 from ..ndarray.ndarray import NDArray
 
-__all__ = ["imdecode", "imread", "imresize", "resize_short", "fixed_crop",
+__all__ = ["imdecode", "imread", "imresize", "copyMakeBorder",
+           "resize_short", "fixed_crop",
            "random_crop", "scale_down",
            "center_crop", "color_normalize", "random_size_crop",
            "ResizeAug", "RandomCropAug", "RandomSizedCropAug", "CenterCropAug",
@@ -82,6 +83,46 @@ def imresize(src, w, h, interp=1):
     if out.ndim == 2:
         out = out[:, :, None]
     return nd_array(out)
+
+
+def copyMakeBorder(src, top, bot, left, right, type=0, value=0.0,
+                   values=None, out=None):
+    """Pad an HWC image with a border (parity: mx.image.copyMakeBorder
+    over the reference's _cvcopyMakeBorder plugin op — plugin/opencv,
+    same kwarg names). ``type`` takes the cv2 border codes: 0 CONSTANT
+    (``value`` scalar or ``values`` per-channel), 1 REPLICATE,
+    2 REFLECT, 3 WRAP, 4 REFLECT_101."""
+    arr = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    pad = ((int(top), int(bot)), (int(left), int(right))) \
+        + ((0, 0),) * (arr.ndim - 2)
+    btype = int(type)
+    if btype == 0:
+        if values is not None:
+            # per-channel constant fill: pad each channel separately
+            chans = [np.pad(arr[..., c], pad[:2], mode="constant",
+                            constant_values=np.asarray(v, arr.dtype))
+                     for c, v in enumerate(
+                         np.broadcast_to(np.asarray(values),
+                                         (arr.shape[-1],)))]
+            padded = np.stack(chans, axis=-1)
+        else:
+            padded = np.pad(arr, pad, mode="constant",
+                            constant_values=np.asarray(value, arr.dtype))
+    else:
+        mode = {1: "edge", 2: "symmetric", 3: "wrap",
+                4: "reflect"}.get(btype)
+        if mode is None:
+            raise MXNetError("unsupported border type %d" % btype)
+        padded = np.pad(arr, pad, mode=mode)
+    res = nd_array(padded)
+    if out is not None:
+        if tuple(out.shape) != tuple(res.shape):
+            raise MXNetError(
+                "copyMakeBorder: out shape %s != padded shape %s"
+                % (tuple(out.shape), tuple(res.shape)))
+        out[:] = res
+        return out
+    return res
 
 
 def resize_short(src, size, interp=2):

@@ -124,3 +124,39 @@ def test_imageiter_threaded_decode_matches_serial(tmp_path):
     for (d0, l0), (d1, l1) in zip(serial, threaded):
         np.testing.assert_allclose(d0, d1)
         np.testing.assert_allclose(l0, l1)
+
+
+def test_copy_make_border():
+    """copyMakeBorder (the opencv-plugin op role, plugin/opencv
+    _cvcopyMakeBorder): constant fill and replicate modes."""
+    img = mx.nd.array(np.arange(12, dtype=np.uint8).reshape(2, 2, 3))
+    out = mx.image.copyMakeBorder(img, 1, 1, 2, 2, type=0, value=7)
+    assert out.shape == (4, 6, 3)
+    got = out.asnumpy()
+    np.testing.assert_array_equal(got[0], np.full((6, 3), 7, np.uint8))
+    np.testing.assert_array_equal(got[1:3, 2:4], img.asnumpy())
+    rep = mx.image.copyMakeBorder(img, 1, 0, 0, 0, type=1)
+    np.testing.assert_array_equal(rep.asnumpy()[0], img.asnumpy()[0])
+
+
+def test_copy_make_border_modes_and_out():
+    img = mx.nd.array(np.arange(12, dtype=np.uint8).reshape(2, 2, 3))
+    a = img.asnumpy()
+    # reflect / wrap / reflect_101 map to the numpy modes exactly
+    for btype, mode in ((2, "symmetric"), (3, "wrap"), (4, "reflect")):
+        got = mx.image.copyMakeBorder(img, 1, 1, 1, 1, type=btype)
+        want = np.pad(a, ((1, 1), (1, 1), (0, 0)), mode=mode)
+        np.testing.assert_array_equal(got.asnumpy(), want)
+    # per-channel constant fill
+    got = mx.image.copyMakeBorder(img, 1, 0, 0, 0, type=0,
+                                  values=[1, 2, 3])
+    np.testing.assert_array_equal(got.asnumpy()[0],
+                                  np.tile([1, 2, 3], (2, 1)))
+    # out= validates shape
+    import pytest as _pytest
+    bad = mx.nd.zeros((2, 2, 3), dtype="uint8")
+    with _pytest.raises(mx.MXNetError):
+        mx.image.copyMakeBorder(img, 1, 1, 1, 1, out=bad)
+    ok = mx.nd.zeros((4, 4, 3), dtype="uint8")
+    ret = mx.image.copyMakeBorder(img, 1, 1, 1, 1, out=ok)
+    assert ret is ok and ok.asnumpy()[1, 1, 0] == 0
