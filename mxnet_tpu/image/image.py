@@ -99,7 +99,9 @@ def copyMakeBorder(src, top, bot, left, right, type=0, value=0.0,
     if btype == 0:
         if values is not None:
             # per-channel constant fill: pad each channel separately
-            chans = [np.pad(arr[..., c], pad[:2], mode="constant",
+            # (pad width excludes the channel axis, whatever the ndim)
+            chan_pad = pad[:-1] if arr.ndim > 1 else pad
+            chans = [np.pad(arr[..., c], chan_pad, mode="constant",
                             constant_values=np.asarray(v, arr.dtype))
                      for c, v in enumerate(
                          np.broadcast_to(np.asarray(values),

@@ -153,9 +153,8 @@ def test_copy_make_border_modes_and_out():
     np.testing.assert_array_equal(got.asnumpy()[0],
                                   np.tile([1, 2, 3], (2, 1)))
     # out= validates shape
-    import pytest as _pytest
     bad = mx.nd.zeros((2, 2, 3), dtype="uint8")
-    with _pytest.raises(mx.MXNetError):
+    with pytest.raises(mx.MXNetError):
         mx.image.copyMakeBorder(img, 1, 1, 1, 1, out=bad)
     ok = mx.nd.zeros((4, 4, 3), dtype="uint8")
     ret = mx.image.copyMakeBorder(img, 1, 1, 1, 1, out=ok)
