@@ -98,9 +98,14 @@ def copyMakeBorder(src, top, bot, left, right, type=0, value=0.0,
     btype = int(type)
     if btype == 0:
         if values is not None:
+            if arr.ndim < 3:
+                raise MXNetError(
+                    "copyMakeBorder: per-channel values need an image "
+                    "with a channel axis (ndim >= 3); got ndim=%d"
+                    % arr.ndim)
             # per-channel constant fill: pad each channel separately
-            # (pad width excludes the channel axis, whatever the ndim)
-            chan_pad = pad[:-1] if arr.ndim > 1 else pad
+            # (pad width excludes the channel axis)
+            chan_pad = pad[:-1]
             chans = [np.pad(arr[..., c], chan_pad, mode="constant",
                             constant_values=np.asarray(v, arr.dtype))
                      for c, v in enumerate(
