@@ -85,3 +85,82 @@ def test_smoke_end_to_end():
     assert proc.returncode == 0
     out = bench._last_json_line(proc.stdout)
     assert out is not None and "value" in out and out["unit"] == "img/s"
+
+
+def _patched_supervise(monkeypatch, phases, deadline=30.0, smoke=False):
+    """Run supervise() with _run_phase replaced by a scripted stub.
+    `phases` maps mode -> callable returning (parsed, timed_out); the
+    stub records the call sequence. Returns (rc, calls, stdout_json)."""
+    calls = []
+
+    def fake_phase(mode, timeout):
+        calls.append(mode)
+        return phases[mode](len([c for c in calls if c == mode]))
+
+    monkeypatch.setattr(bench, "_run_phase", fake_phase)
+    monkeypatch.setattr(bench, "TOTAL_DEADLINE", deadline)
+    monkeypatch.setattr(bench, "SMOKE", smoke)
+    monkeypatch.setattr(bench, "PROBE_TIMEOUT", 1.0)
+    monkeypatch.setattr(bench, "PROBE_GAP", 0.0)
+    monkeypatch.setattr(bench, "RAW_MIN", 0.5)
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = bench.supervise()
+    return rc, calls, bench._last_json_line(buf.getvalue())
+
+
+def test_supervise_emits_error_json_when_backend_never_up(monkeypatch):
+    """Probes that never succeed: no raw child is ever launched, and a
+    diagnostic JSON line is still printed (the round-4 rc=124/parsed-null
+    failure mode must be impossible)."""
+    import time as _time
+
+    def failing_probe(n):
+        _time.sleep(0.2)  # a real probe child costs wall-clock
+        return None, True
+
+    rc, calls, out = _patched_supervise(
+        monkeypatch,
+        {"--probe": failing_probe},
+        deadline=2.0)
+    assert rc == 1
+    assert "--child" not in calls          # raw child is probe-gated
+    assert calls.count("--probe") >= 2     # it LOOPS, not one-shot
+    assert out is not None and "error" in out and out["probe_ok"] is False
+
+
+def test_supervise_probe_gates_then_measures(monkeypatch):
+    """First probe fails, second succeeds, raw child then measures; the
+    module phase result is merged in."""
+    meas = {"value": 123.0, "unit": "img/s"}
+    rc, calls, out = _patched_supervise(
+        monkeypatch,
+        {"--probe": lambda n: ((None, True) if n == 1
+                               else ({"device": "x"}, False)),
+         "--child": lambda n: (dict(meas), False),
+         "--module-child": lambda n: ({"module_fit_img_s": 99.0}, False)},
+        deadline=600.0)
+    assert rc == 0
+    assert calls.index("--child") > calls.index("--probe")
+    assert out["value"] == 123.0 and out["module_fit_img_s"] == 99.0
+
+
+def test_supervise_raw_failure_returns_to_probing(monkeypatch):
+    """A raw child that dies after a good probe sends the loop back to
+    probing; a later raw attempt can still win."""
+    state = {"raw": 0}
+
+    def raw(n):
+        state["raw"] = n
+        if n < 2:
+            return None, False
+        return {"value": 7.0, "unit": "img/s"}, False
+
+    monkeypatch.setenv("MXTPU_BENCH_MODULE", "0")
+    rc, calls, out = _patched_supervise(
+        monkeypatch,
+        {"--probe": lambda n: ({"device": "x"}, False), "--child": raw},
+        deadline=600.0)
+    assert rc == 0 and out["value"] == 7.0 and state["raw"] == 2

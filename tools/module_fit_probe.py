@@ -1,0 +1,116 @@
+#!/usr/bin/env python3
+"""Break down where Module.fit's wall-clock goes vs the raw fused step
+(PERF.md: the round-5 bench measured 157.9 img/s user-path vs 2254 raw).
+
+Times each fit-loop phase IN ISOLATION on the attached accelerator:
+  - forward_backward (the fused executor program)
+  - update           (FusedUpdater one-dispatch step)
+  - update_metric    (device-accumulated Accuracy)
+  - epoch-end get_params/set_params round trip
+
+Run on a TPU host:  python tools/module_fit_probe.py
+Smoke (CPU):        MXTPU_PROBE_SMOKE=1 python tools/module_fit_probe.py
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+SMOKE = os.environ.get("MXTPU_PROBE_SMOKE", "") == "1"
+BATCH = 8 if SMOKE else 128
+IMG = 32 if SMOKE else 224
+ITERS = 2 if SMOKE else 10
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+if SMOKE:
+    jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import DataDesc
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "..",
+    "examples", "image-classification"))
+from symbols.resnet import get_symbol
+
+
+def sync():
+    """Drain the device queue (block_until_ready alone does not on
+    relayed PJRT backends) — fetch a scalar through the executor."""
+    jax.block_until_ready(jax.device_put(np.zeros(())))
+
+
+def timed(label, fn, iters=ITERS, pre_sync=True):
+    if pre_sync:
+        sync()
+    fn()  # warm
+    sync()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    sync()
+    dt = (time.perf_counter() - t0) / iters
+    print("%-28s %8.2f ms" % (label, dt * 1e3), flush=True)
+    return dt
+
+
+def main():
+    dev = jax.devices()[0]
+    print("device:", dev.device_kind, flush=True)
+    sym = get_symbol(num_classes=1000, num_layers=50,
+                     image_shape="3,%d,%d" % (IMG, IMG))
+    bf16 = np.dtype(jnp.bfloat16)
+    mod = mx.mod.Module(sym, context=mx.tpu() if dev.platform != "cpu"
+                        else mx.cpu())
+    mod.bind(data_shapes=[DataDesc("data", (BATCH, 3, IMG, IMG),
+                                   dtype=bf16)],
+             label_shapes=[DataDesc("softmax_label", (BATCH,))],
+             for_training=True)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9,
+                                         "multi_precision": True})
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.uniform(-1, 1, (BATCH, 3, IMG, IMG))
+                    .astype(np.float32)).astype(bf16)
+    y = mx.nd.array(rs.randint(0, 1000, BATCH).astype(np.float32))
+    from mxnet_tpu.io import DataBatch
+    batch = DataBatch([x], [y], pad=0)
+    metric = mx.metric.Accuracy()
+
+    results = {}
+    results["forward_backward_ms"] = timed(
+        "forward_backward", lambda: mod.forward_backward(batch)) * 1e3
+    results["update_ms"] = timed("update", lambda: mod.update()) * 1e3
+    results["update_metric_ms"] = timed(
+        "update_metric",
+        lambda: mod.update_metric(metric, batch.label)) * 1e3
+
+    def whole_step():
+        mod.forward_backward(batch)
+        mod.update()
+        mod.update_metric(metric, batch.label)
+
+    step_s = timed("whole step (fb+upd+metric)", whole_step)
+    results["step_ms"] = step_s * 1e3
+    results["step_img_s"] = BATCH / step_s
+
+    def epoch_end():
+        arg_p, aux_p = mod.get_params()
+        mod.set_params(arg_p, aux_p)
+
+    results["epoch_end_get_set_ms"] = timed(
+        "epoch-end get/set_params", epoch_end, iters=max(2, ITERS // 3)) * 1e3
+
+    print(json.dumps({k: round(v, 2) for k, v in results.items()}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
