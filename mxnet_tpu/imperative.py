@@ -126,10 +126,31 @@ def invoke(op, inputs, kwargs, out=None, name=None):
         params["_rng"] = _common.take_rng()
 
     nds = [x if isinstance(x, NDArray) else None for x in inputs]
-    raw = [_as_raw(x) for x in inputs]
 
     record = (is_recording() and not op.no_grad
               and any(nd is not None and nd._tape is not None for nd in nds))
+
+    # storage-aware dispatch BEFORE any dense view is touched: sparse
+    # inputs first consult the FComputeEx table (reference operator-attr
+    # machinery, imperative.cc dispatch-mode selection). No native
+    # kernel for the combination -> logged storage fallback, then the
+    # dense path below (src/common/utils.h CastStorageDispatch role).
+    # Recording takes the dense path too: sparse autograd surfaces that
+    # need compressed grads (sparse.dot, embeddings) manage their own
+    # tape nodes.
+    if (not record and out is None
+            and any(nd is not None and nd.stype != "default" for nd in nds)):
+        from .ndarray import sparse as _sparse
+        res = _sparse.dispatch_ex(op.name, inputs, params)
+        if res is not NotImplemented:
+            return res
+        from .config import storage_fallback_log
+        storage_fallback_log("%s(%s)" % (
+            op.name,
+            ", ".join(nd.stype if nd is not None else "default"
+                      for nd in nds)))
+
+    raw = [_as_raw(x) for x in inputs]
 
     if op.jit_cache:
         jfn, dyn = op.jitted(params)

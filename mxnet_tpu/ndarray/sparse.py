@@ -23,7 +23,8 @@ from .ndarray import NDArray, _wrap, array as _dense_array
 
 __all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
            "empty", "array",
-           "zeros", "cast_storage", "dot", "add_n", "elemwise_add"]
+           "zeros", "cast_storage", "dot", "add_n", "elemwise_add",
+           "elemwise_sub", "elemwise_mul", "square", "square_sum", "sum"]
 
 
 class BaseSparseNDArray(NDArray):
@@ -330,52 +331,203 @@ def add_n(arrays):
                             arrays[0].context)
 
 
-def elemwise_add(lhs, rhs):
-    """Sparse elemwise add (reference elemwise_binary_op_basic.cc).
+def _csr_merge(lhs, rhs, mode):
+    """COO merge of two same-shape CSR matrices on the compressed
+    representations: concat -> host lexsort of the (row, col) keys
+    (O(nnz) ints; the value merge stays on device) -> segment
+    combine -> rebuild indptr. O(nnz) memory, never the dense shape —
+    the reference's elemwise FComputeEx kernel role
+    (elemwise_binary_op-inl.h csr/csr paths).
 
-    csr + csr runs NATIVELY on the compressed representations: COO
-    concat -> host lexsort of the (row, col) keys (O(nnz) ints; the
-    value merge stays on device) -> segment-sum of duplicates ->
-    rebuild indptr. O(nnz) memory, never the dense shape — the
-    reference's DotCsrCsr-style merge kernel role. Result keeps the
-    structural UNION of coordinates (a sum that cancels to exact zero
-    stays stored, reference sparse-kernel semantics). row_sparse pairs
-    use the native row-union path; mixed sparse/dense falls back to
-    dense (the reference's storage-fallback, logged the same way)."""
+    mode "add"/"sub": structural UNION of coordinates (a sum that
+    cancels to exact zero stays stored — reference sparse-kernel
+    semantics). mode "mul": structural INTERSECTION (a coordinate
+    stored on only one side contributes 0 * x and is dropped, which is
+    exactly what the reference's csr*csr kernel produces)."""
+    if lhs.shape != rhs.shape:
+        raise MXNetError("elemwise %s: shape mismatch %s vs %s"
+                         % (mode, lhs.shape, rhs.shape))
+    r = np.concatenate([np.asarray(lhs._row_ids()),
+                        np.asarray(rhs._row_ids())])
+    c = np.concatenate([np.asarray(lhs._csr_indices),
+                        np.asarray(rhs._csr_indices)])
+    rhs_vals = rhs._csr_data if mode != "sub" else -rhs._csr_data
+    vals = jnp.concatenate([lhs._csr_data, rhs_vals])
+    order = np.lexsort((c, r))
+    r, c = r[order], c[order]
+    # unique (row, col) keys in CSR order + segment map for the combine
+    key_changed = np.empty(len(r), bool)
+    key_changed[:1] = True
+    if len(r) > 1:
+        key_changed[1:] = (r[1:] != r[:-1]) | (c[1:] != c[:-1])
+    seg = np.cumsum(key_changed) - 1
+    n_seg = int(seg[-1]) + 1 if len(seg) else 0
+    vals = vals[jnp.asarray(order)]
+    uniq_r, uniq_c = r[key_changed], c[key_changed]
+    if mode == "mul":
+        # CSR coordinates are unique per matrix, so a segment holds 1 or
+        # 2 values; products survive only where BOTH sides stored one
+        combined = jax.ops.segment_prod(vals, jnp.asarray(seg),
+                                        num_segments=n_seg)
+        both = np.bincount(seg, minlength=n_seg) == 2
+        combined = combined[jnp.asarray(np.nonzero(both)[0])]
+        uniq_r, uniq_c = uniq_r[both], uniq_c[both]
+    else:
+        combined = jax.ops.segment_sum(vals, jnp.asarray(seg),
+                                       num_segments=n_seg)
+    row_counts = np.bincount(uniq_r, minlength=lhs.shape[0])
+    indptr = np.concatenate([[0], np.cumsum(row_counts)])
+    return CSRNDArray(combined, jnp.asarray(uniq_c.astype(np.int32)),
+                      jnp.asarray(indptr.astype(np.int32)),
+                      lhs.shape, lhs.context)
+
+
+def _rsp_pair(lhs, rhs, mode):
+    """Native (row_sparse, row_sparse) elemwise combine on the stored
+    blocks. add/sub: row-id UNION via one segment-sum (reference
+    ElemwiseBinaryOp rsp/rsp path); mul: row-id INTERSECTION — rows
+    stored on one side only multiply implicit zeros and vanish."""
+    if lhs.shape != rhs.shape:
+        raise MXNetError("elemwise %s: shape mismatch %s vs %s"
+                         % (mode, lhs.shape, rhs.shape))
+    if mode == "mul":
+        li = np.asarray(lhs._rsp_indices, np.int64)
+        ri = np.asarray(rhs._rsp_indices, np.int64)
+        common, lpos, rpos = np.intersect1d(li, ri, return_indices=True)
+        data = lhs._rsp_data[jnp.asarray(lpos.astype(np.int32))] \
+            * rhs._rsp_data[jnp.asarray(rpos.astype(np.int32))]
+        return RowSparseNDArray(data, jnp.asarray(common.astype(np.int32)),
+                                lhs.shape, lhs.context)
+    neg = rhs if mode == "add" else RowSparseNDArray(
+        -rhs._rsp_data, rhs._rsp_indices, rhs.shape, rhs._ctx)
+    return add_n([lhs, neg])
+
+
+def _binary_sparse(lhs, rhs, mode, opname):
+    """Shared storage-dispatch for elemwise add/sub/mul (reference
+    elemwise_binary_op_basic.cc storage tables: csr/csr -> csr,
+    rsp/rsp -> rsp, anything else -> dense through the logged storage
+    fallback)."""
     if isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray):
-        if lhs.shape != rhs.shape:
-            raise MXNetError("elemwise_add: shape mismatch %s vs %s"
-                             % (lhs.shape, rhs.shape))
-        r = np.concatenate([np.asarray(lhs._row_ids()),
-                            np.asarray(rhs._row_ids())])
-        c = np.concatenate([np.asarray(lhs._csr_indices),
-                            np.asarray(rhs._csr_indices)])
-        vals = jnp.concatenate([lhs._csr_data, rhs._csr_data])
-        order = np.lexsort((c, r))
-        r, c = r[order], c[order]
-        # unique (row, col) keys in CSR order + inverse map for the sum
-        key_changed = np.empty(len(r), bool)
-        key_changed[:1] = True
-        if len(r) > 1:
-            key_changed[1:] = (r[1:] != r[:-1]) | (c[1:] != c[:-1])
-        seg = np.cumsum(key_changed) - 1
-        n_seg = int(seg[-1]) + 1 if len(seg) else 0
-        summed = jax.ops.segment_sum(vals[jnp.asarray(order)],
-                                     jnp.asarray(seg),
-                                     num_segments=n_seg)
-        uniq_r, uniq_c = r[key_changed], c[key_changed]
-        row_counts = np.bincount(uniq_r, minlength=lhs.shape[0])
-        indptr = np.concatenate([[0], np.cumsum(row_counts)])
-        return CSRNDArray(summed, jnp.asarray(uniq_c.astype(np.int32)),
-                          jnp.asarray(indptr.astype(np.int32)),
-                          lhs.shape, lhs.context)
-    if isinstance(lhs, CSRNDArray) or isinstance(rhs, CSRNDArray):
-        from ..config import storage_fallback_log
-        storage_fallback_log("elemwise_add(%s, %s)"
-                             % (lhs.stype, rhs.stype))
-        out = lhs.tostype("default") + rhs.tostype("default")
-        return cast_storage(out, "csr")
-    return add_n([lhs, rhs])
+        return _csr_merge(lhs, rhs, mode)
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        return _rsp_pair(lhs, rhs, mode)
+    from ..config import storage_fallback_log
+    storage_fallback_log("%s(%s, %s)" % (opname, lhs.stype, rhs.stype))
+    ld = lhs.tostype("default") if isinstance(lhs, BaseSparseNDArray) else lhs
+    rd = rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) else rhs
+    # mixed storage combinations produce DEFAULT storage — the
+    # reference's documented table ("otherwise ... default storage"),
+    # and identical to what the registered-op dispatch route yields
+    return {"add": ld.__add__, "sub": ld.__sub__, "mul": ld.__mul__}[mode](rd)
+
+
+def elemwise_add(lhs, rhs):
+    """Sparse elemwise add (reference elemwise_binary_op_basic.cc)."""
+    return _binary_sparse(lhs, rhs, "add", "elemwise_add")
+
+
+def elemwise_sub(lhs, rhs):
+    """Sparse elemwise subtract: csr-csr -> csr, rsp-rsp -> rsp, native
+    on the compressed representations (reference
+    elemwise_binary_op_basic.cc elemwise_sub storage table)."""
+    return _binary_sparse(lhs, rhs, "sub", "elemwise_sub")
+
+
+def elemwise_mul(lhs, rhs):
+    """Sparse elemwise multiply: csr*csr -> csr, rsp*rsp -> rsp
+    (structural intersection), native on the compressed representations
+    (reference elemwise_binary_op_basic.cc elemwise_mul storage table)."""
+    return _binary_sparse(lhs, rhs, "mul", "elemwise_mul")
+
+
+def square(arr):
+    """Stype-preserving elementwise square: square(rsp)=rsp,
+    square(csr)=csr, operating on the stored values only — f(0)=0, so
+    the structure is unchanged (reference elemwise_unary_op_basic.cc
+    MXNET_OPERATOR_REGISTER_UNARY_WITH_RSP_CSR(square))."""
+    return _map_values(arr, lambda v: v * v)
+
+
+def _map_values(arr, fn):
+    """Apply an f(0)=0 elementwise fn to the stored values, keeping the
+    sparse structure (the reference's UnaryOp::ComputeEx / scalar
+    ComputeEx shape: `only operates on the data array of the input`)."""
+    if isinstance(arr, RowSparseNDArray):
+        return RowSparseNDArray(fn(arr._rsp_data), arr._rsp_indices,
+                                arr.shape, arr._ctx)
+    if isinstance(arr, CSRNDArray):
+        return CSRNDArray(fn(arr._csr_data), arr._csr_indices,
+                          arr._csr_indptr, arr.shape, arr._ctx)
+    return _wrap(fn(arr._data), arr.context)
+
+
+def square_sum(arr, axis=None, keepdims=False):
+    """Sum of squares over a row_sparse array WITHOUT densifying
+    (reference _square_sum, src/operator/tensor/square_sum-inl.h — the
+    reduction behind lazy-update optimizer norms). Storage table, per
+    SquareSumForwardInferStorageType:
+      axis=1, keepdims=True  -> row_sparse (per stored row)
+      axis=1, keepdims=False -> dense vector (nrows,)
+      axis=0                 -> dense vector over columns
+    Anything else is unsupported there too."""
+    if not isinstance(arr, RowSparseNDArray):
+        raise MXNetError("_square_sum: row_sparse input required "
+                         "(reference square_sum-inl.h)")
+    if isinstance(axis, (tuple, list)):
+        if len(axis) != 1:
+            raise MXNetError("_square_sum: single-axis reductions only "
+                             "(got axis=%r; reference square_sum-inl.h "
+                             "supports axis 0 or 1)" % (axis,))
+        ax = axis[0]
+    else:
+        ax = axis
+    sq = arr._rsp_data * arr._rsp_data
+    nrows = arr.shape[0]
+    if ax == 1 and keepdims:
+        per_row = jnp.sum(sq, axis=tuple(range(1, sq.ndim)), keepdims=False)
+        return RowSparseNDArray(per_row[:, None], arr._rsp_indices,
+                                (nrows, 1), arr._ctx)
+    if ax == 1:
+        per_row = jnp.sum(sq, axis=tuple(range(1, sq.ndim)))
+        dense = jnp.zeros((nrows,), per_row.dtype) \
+            .at[arr._rsp_indices].set(per_row)
+        return _wrap(dense, arr.context)
+    if ax == 0:
+        out = jnp.sum(sq, axis=0)
+        if keepdims:
+            out = out[None, ...]
+        return _wrap(out, arr.context)
+    raise MXNetError("_square_sum: axis must be 0 or 1 (got %r)" % (axis,))
+
+
+def sum(arr, axis=None, keepdims=False, exclude=False):
+    """Reduce a CSR matrix over one axis natively — O(nnz) segment-sum /
+    scatter-add, dense output (reference sum(csr, axis) FComputeEx,
+    broadcast_reduce_op_value.cc SumOpForwardEx). Other inputs take the
+    logged dense fallback."""
+    ax = axis[0] if isinstance(axis, (tuple, list)) and len(axis) == 1 \
+        else axis
+    if isinstance(arr, CSRNDArray) and not exclude and ax in (0, 1):
+        nrows, ncols = arr.shape
+        if ax == 1:
+            out = jax.ops.segment_sum(arr._csr_data, arr._row_ids(),
+                                      num_segments=nrows)
+            if keepdims:
+                out = out[:, None]
+        else:
+            out = jnp.zeros((ncols,), arr._csr_data.dtype) \
+                .at[arr._csr_indices.astype(jnp.int32)].add(arr._csr_data)
+            if keepdims:
+                out = out[None, :]
+        return _wrap(out, arr.context)
+    from ..config import storage_fallback_log
+    storage_fallback_log("sum(%s, axis=%r)"
+                         % (getattr(arr, "stype", "default"), axis))
+    from . import sum as _dense_sum
+    dense = _wrap(arr._data, arr.context) \
+        if isinstance(arr, BaseSparseNDArray) else arr
+    return _dense_sum(dense, axis=axis, keepdims=keepdims, exclude=exclude)
 
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
@@ -437,6 +589,62 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     return _dense_dot(_wrap(lhs._data, lhs.context) if isinstance(lhs, BaseSparseNDArray) else lhs,
                       _wrap(rhs._data, rhs.context) if isinstance(rhs, BaseSparseNDArray) else rhs,
                       transpose_a=transpose_a, transpose_b=transpose_b)
+
+
+#: op name -> union/intersection mode for the binary FComputeEx table.
+#: broadcast_* entries serve the NDArray dunders, whose same-shape
+#: sparse case IS the elemwise op (reference FInferStorageType routes
+#: identically).
+_BINARY_EX = {"elemwise_add": "add", "broadcast_add": "add",
+              "_grad_add": "add",
+              "elemwise_sub": "sub", "broadcast_sub": "sub",
+              "elemwise_mul": "mul", "broadcast_mul": "mul"}
+
+
+def dispatch_ex(op_name, inputs, params):
+    """Storage-aware kernel dispatch — the reference's FInferStorageType
+    + FComputeEx pair (operator registry attrs, e.g.
+    elemwise_binary_op_basic.cc) collapsed into one table lookup.
+    ``imperative.invoke`` consults this before touching any input's
+    dense view; NotImplemented means "no native kernel for this storage
+    combination" and the caller takes the logged dense fallback, exactly
+    the reference's dispatch-mode machinery (src/common/utils.h)."""
+    mode = _BINARY_EX.get(op_name)
+    if mode is not None and len(inputs) == 2:
+        l, r = inputs
+        if (isinstance(l, CSRNDArray) and isinstance(r, CSRNDArray)
+                and l.shape == r.shape):
+            return _csr_merge(l, r, mode)
+        if (isinstance(l, RowSparseNDArray)
+                and isinstance(r, RowSparseNDArray) and l.shape == r.shape):
+            return _rsp_pair(l, r, mode)
+        return NotImplemented
+    if len(inputs) != 1 or not isinstance(inputs[0], BaseSparseNDArray):
+        return NotImplemented
+    arr = inputs[0]
+    if op_name == "square":
+        return square(arr)
+    if op_name == "negative":
+        return _map_values(arr, lambda v: -v)
+    if op_name == "_mul_scalar":
+        s = params.get("scalar", 1.0)
+        return _map_values(arr, lambda v: v * s)
+    if op_name == "_div_scalar":
+        s = params.get("scalar", 1.0)
+        return _map_values(arr, lambda v: v / s)
+    if op_name == "sum" and isinstance(arr, CSRNDArray):
+        ax = params.get("axis")
+        axn = ax[0] if isinstance(ax, (tuple, list)) and len(ax) == 1 else ax
+        if not params.get("exclude", False) and axn in (0, 1):
+            return sum(arr, axis=ax, keepdims=params.get("keepdims", False))
+        return NotImplemented
+    if op_name == "_square_sum" and isinstance(arr, RowSparseNDArray):
+        ax = params.get("axis")
+        axn = ax[0] if isinstance(ax, (tuple, list)) and len(ax) == 1 else ax
+        if axn in (0, 1):
+            return square_sum(arr, axis=ax,
+                              keepdims=params.get("keepdims", False))
+    return NotImplemented
 
 
 def empty(stype, shape, ctx=None, dtype=None):

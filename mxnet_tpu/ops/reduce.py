@@ -34,6 +34,20 @@ register("min", defaults={"axis": None, "keepdims": False, "exclude": False},
          aliases=("min_axis",))(_reduce(jnp.min))
 
 
+@register("_square_sum", defaults={"axis": None, "keepdims": False,
+                                   "exclude": False})
+def _square_sum(data, axis=None, keepdims=False, exclude=False):
+    """Sum of squares — row_sparse-only in the reference (the lazy-update
+    optimizer norm reduction, square_sum-inl.h: LOG(FATAL) "nothing to
+    fallback on" for dense input). Sparse inputs are intercepted by the
+    storage dispatch (ndarray/sparse.py:square_sum) before this body
+    runs; reaching it means a dense input, which the reference rejects
+    too."""
+    from ..base import MXNetError
+    raise MXNetError("_square_sum: only row_sparse input is supported "
+                     "(reference square_sum-inl.h has no dense kernel)")
+
+
 @register("norm")
 def norm(data):
     """L2 norm over all elements (reference 0.12 norm reduces everything)."""
