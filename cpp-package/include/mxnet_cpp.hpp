@@ -94,13 +94,29 @@ class NDArray {
   }
   explicit NDArray(NDArrayHandle h) { reset(h); }
 
+  // non-owning view over a handle whose lifetime someone else manages
+  // (kvstore updater callbacks hand out borrowed handles)
+  static NDArray Borrow(NDArrayHandle h) {
+    NDArray a;
+    a.h_ = std::make_shared<Owner>(h, false);
+    return a;
+  }
+
   NDArrayHandle handle() const { return h_ ? h_->ptr : nullptr; }
 
   void SyncCopyFromCPU(const float* data, size_t size) {
     Check(MXNDArraySyncCopyFromCPU(handle(), data, size), "CopyFromCPU");
   }
+  void SyncCopyFromCPU(const std::vector<float>& data) {
+    SyncCopyFromCPU(data.data(), data.size());
+  }
   void SyncCopyToCPU(float* data, size_t size) const {
     Check(MXNDArraySyncCopyToCPU(handle(), data, size), "CopyToCPU");
+  }
+  void SyncCopyToCPU(std::vector<float>* data, size_t size = 0) const {
+    if (size == 0) size = Size();
+    data->resize(size);
+    SyncCopyToCPU(data->data(), size);
   }
   std::vector<mx_uint> Shape() const {
     mx_uint ndim = 0;
@@ -108,6 +124,11 @@ class NDArray {
     Check(MXNDArrayGetShape(handle(), &ndim, &pdata), "GetShape");
     return std::vector<mx_uint>(pdata, pdata + ndim);
   }
+  // reference mxnet-cpp spelling of the same accessor
+  std::vector<mx_uint> GetShape() const { return Shape(); }
+  // argmax over axis 1 (the metric helper the reference NDArray carries);
+  // defined after Op below
+  inline NDArray ArgmaxChannel() const;
   size_t Size() const {
     size_t n = 1;
     for (auto s : Shape()) n *= s;
@@ -118,10 +139,13 @@ class NDArray {
  private:
   struct Owner {
     NDArrayHandle ptr;
-    explicit Owner(NDArrayHandle p) : ptr(p) {}
+    bool own;
+    explicit Owner(NDArrayHandle p, bool o = true) : ptr(p), own(o) {}
     Owner(const Owner&) = delete;
     Owner& operator=(const Owner&) = delete;
-    ~Owner() { MXNDArrayFree(ptr); }
+    ~Owner() {
+      if (own) MXNDArrayFree(ptr);
+    }
   };
   std::shared_ptr<Owner> h_;
   // construct in place: a temporary Owner would free the handle in its
@@ -164,6 +188,12 @@ class Op {
  private:
   AtomicSymbolCreator op_ = nullptr;
 };
+
+inline NDArray NDArray::ArgmaxChannel() const {
+  std::vector<NDArray> out;
+  Op("argmax_channel").Invoke({*this}, &out);
+  return out.at(0);
+}
 
 class Symbol {
  public:

@@ -85,3 +85,30 @@ def test_generated_op_wrappers_build_and_train(tmp_path):
     assert "CPP_OPS_TRAIN_OK" in p.stdout, p.stdout
     acc = float(p.stdout.split("acc=")[1].split()[0])
     assert acc > 0.8, p.stdout
+
+
+def test_cpp_train_full_surface(tmp_path):
+    """The cpp-package TRAINING classes (mxnet_cpp_train.hpp, parity:
+    reference mxnet-cpp optimizer.h/kvstore.h/io.h/metric.h/
+    initializer.h/lr_scheduler.h): every registered optimizer descends
+    on a quadratic, then an MLP composed from generated op wrappers
+    trains via MXDataIter(CSVIter) -> KVStore::Push/Pull with a
+    FactorScheduler'd SGD updater, scored by Accuracy."""
+    cxx = shutil.which("g++") or shutil.which("c++")
+    if cxx is None:
+        pytest.skip("no C++ compiler")
+    example = os.path.join(REPO, "cpp-package", "example",
+                           "train_mlp_full.cpp")
+    exe = str(tmp_path / "train_mlp_full")
+    subprocess.run([cxx, "-std=c++17", "-I", HEADER_DIR, example, "-o", exe,
+                    "-L", LIB_DIR, "-lmxtpu_c_api",
+                    "-Wl,-rpath," + LIB_DIR], check=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["MXNET_TPU_FORCE_CPU"] = "1"
+    p = subprocess.run([exe, str(tmp_path)], capture_output=True, text=True,
+                       timeout=900, env=env)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "CPP_TRAIN_FULL_OK" in p.stdout, p.stdout + p.stderr
+    acc = float(p.stdout.split("CPP_TRAIN_FULL_OK acc=")[1].split()[0])
+    assert acc > 0.85, p.stdout
