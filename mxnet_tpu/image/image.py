@@ -590,7 +590,7 @@ class ImageIter(DataIter):
         # temp_space slots (MXNET_EXEC_NUM_TEMP defaults to 1) could be
         # handed to another consumer mid-assembly. NOTE the buffer is not
         # zeroed; every row [0, batch_size) is written below before use —
-        # any future pad-batch support must clear the tail rows itself.
+        # the partial-final-batch path below clears the tail rows.
         if getattr(self, "_batch_space", None) is None:
             from ..resource import Resource
             from ..context import current_context
@@ -617,7 +617,22 @@ class ImageIter(DataIter):
             batch_data[i] = arr.transpose(2, 0, 1)
             batch_label[i] = label
 
-        samples = [self.next_sample() for _ in range(self.batch_size)]
+        # collect up to batch_size samples; a partial FINAL batch is
+        # padded, not dropped (reference image.py ImageIter.next:1160 —
+        # pad = batch_size - i, zero-filled tail rows)
+        samples = []
+        for _ in range(self.batch_size):
+            try:
+                samples.append(self.next_sample())
+            except StopIteration:
+                break
+        if not samples:
+            raise StopIteration
+        pad = self.batch_size - len(samples)
+        if pad:
+            # batch_label is freshly zeroed above; only the pooled,
+            # reused data buffer needs its tail rows cleared
+            batch_data[len(samples):] = 0.0
         if self._pool is not None:
             list(self._pool.map(
                 lambda args: _decode_into(args[0], *args[1]),
@@ -626,4 +641,4 @@ class ImageIter(DataIter):
             for i, (label, s) in enumerate(samples):
                 _decode_into(i, label, s)
         return DataBatch([nd_array(batch_data)], [nd_array(batch_label)],
-                         pad=0)
+                         pad=pad)

@@ -222,3 +222,32 @@ def test_sustained_feed_probe_overlaps_decode_with_consumer():
     import math
     assert res["cores_needed_for_target"] == int(
         math.ceil(res["target_img_s"] / res["per_core_img_s"])), res
+
+
+def test_worker_decode_scaling_probe():
+    """Process-based decode workers (the multi-core feed-scaling model,
+    PERF.md): N workers on disjoint num_parts shards must cover every
+    image exactly once and sustain, concurrently, a meaningful fraction
+    of the single-process rate even when time-slicing one core (on N
+    cores the same machinery multiplies instead). Subprocess for the
+    same jax_platforms isolation as the probe above."""
+    import json
+    import subprocess
+    import sys as _sys
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    env = dict(os.environ)
+    env["MXNET_TPU_FORCE_CPU"] = "1"
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [_sys.executable,
+           os.path.join(repo, "tools", "feed_probe.py"),
+           "--workers", "2", "--images", "64", "--size", "64x64",
+           "--batch", "16"]
+    p = subprocess.run(cmd, capture_output=True, text=True,
+                       timeout=600, env=env)
+    assert p.returncode == 0, p.stderr
+    res = json.loads(p.stdout.strip().splitlines()[-1])
+    assert res["workers"] == 2 and len(res["per_worker_img_s"]) == 2, res
+    assert res["shard_exact_cover"], res
+    # loose: scheduler overhead on a loaded 1-core host can be large,
+    # but the two workers' concurrent aggregate must not collapse
+    assert res["scaling_efficiency_vs_single"] > 0.3, res
