@@ -55,6 +55,11 @@ _KNOBS = {
     "MXNET_BACKWARD_DO_MIRROR": ("honored", "rematerialise the forward in "
                                  "the fused fwd+bwd program "
                                  "(jax.checkpoint)"),
+    "MXNET_FUSED_BN_ADD_RELU": ("honored", "model-zoo ResNet V1 block "
+                                "tails run the fused "
+                                "_contrib_BatchNormAddReLU op "
+                                "(gluon/model_zoo/vision/resnet.py; "
+                                "A/B in PERF.md)"),
     # executor
     "MXNET_EXEC_BULK_EXEC_TRAIN": ("mapped", "whole-graph jit IS maximal "
                                    "op bulking"),

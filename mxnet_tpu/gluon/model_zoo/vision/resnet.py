@@ -23,6 +23,15 @@ def _conv3x3(channels, stride, in_channels):
                      use_bias=False, in_channels=in_channels)
 
 
+def _use_fused_tail():
+    """MXNET_FUSED_BN_ADD_RELU=1 routes every V1 block tail through the
+    fused _contrib_BatchNormAddReLU op (mxnet_tpu/config.py knob table;
+    A/B'd on-chip in PERF.md — off by default because XLA already fuses
+    the composed chain into the conv epilogue at most stage shapes)."""
+    from .... import base as _base
+    return bool(_base.get_env("MXNET_FUSED_BN_ADD_RELU", 0, int))
+
+
 class BasicBlockV1(HybridBlock):
     def __init__(self, channels, stride, downsample=False, in_channels=0,
                  **kwargs):
@@ -32,7 +41,11 @@ class BasicBlockV1(HybridBlock):
         self.body.add(nn.BatchNorm())
         self.body.add(nn.Activation("relu"))
         self.body.add(_conv3x3(channels, 1, channels))
-        self.body.add(nn.BatchNorm())
+        self._fused_tail = _use_fused_tail()
+        if self._fused_tail:
+            self.bn_tail = nn.FusedBNAddReLU()
+        else:
+            self.body.add(nn.BatchNorm())
         if downsample:
             self.downsample = nn.HybridSequential(prefix="")
             self.downsample.add(nn.Conv2D(channels, kernel_size=1,
@@ -47,6 +60,8 @@ class BasicBlockV1(HybridBlock):
         x = self.body(x)
         if self.downsample:
             residual = self.downsample(residual)
+        if self._fused_tail:
+            return self.bn_tail(x, residual)
         return F.Activation(residual + x, act_type="relu")
 
     _forward_eager = HybridBlock._forward_eager
@@ -64,7 +79,11 @@ class BottleneckV1(HybridBlock):
         self.body.add(nn.BatchNorm())
         self.body.add(nn.Activation("relu"))
         self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1))
-        self.body.add(nn.BatchNorm())
+        self._fused_tail = _use_fused_tail()
+        if self._fused_tail:
+            self.bn_tail = nn.FusedBNAddReLU()
+        else:
+            self.body.add(nn.BatchNorm())
         if downsample:
             self.downsample = nn.HybridSequential(prefix="")
             self.downsample.add(nn.Conv2D(channels, kernel_size=1,
@@ -79,6 +98,8 @@ class BottleneckV1(HybridBlock):
         x = self.body(x)
         if self.downsample:
             residual = self.downsample(residual)
+        if self._fused_tail:
+            return self.bn_tail(x, residual)
         return F.Activation(x + residual, act_type="relu")
 
 

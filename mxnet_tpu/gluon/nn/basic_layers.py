@@ -15,6 +15,7 @@ from ..parameter import DeferredInitializationError
 
 __all__ = ["Lambda", "HybridLambda",
            "Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
+           "FusedBNAddReLU",
            "Embedding", "Flatten", "Activation", "LeakyReLU", "InstanceNorm",
            "LayerNorm"]
 
@@ -197,6 +198,23 @@ class BatchNorm(HybridBlock):
                            fix_gamma=not self._scale,
                            use_global_stats=self._use_global_stats,
                            axis=self._axis)
+
+
+class FusedBNAddReLU(BatchNorm):
+    """ResNet block tail — BN-apply + residual-add + ReLU — as ONE op
+    (``_contrib_BatchNormAddReLU``, ops/nn.py; Pallas kernel when the
+    channel axis is last). Same parameters and moving-stat contract as
+    BatchNorm; takes (x, residual) and returns relu(bn(x) + residual).
+    The model zoo flips blocks onto this tail when
+    MXNET_FUSED_BN_ADD_RELU=1 (see PERF.md for the measured A/B)."""
+
+    def hybrid_forward(self, F, x, addend, gamma, beta, running_mean,
+                       running_var):
+        return F._contrib_BatchNormAddReLU(
+            x, addend, gamma, beta, running_mean, running_var,
+            eps=self._epsilon, momentum=self._momentum,
+            fix_gamma=not self._scale,
+            use_global_stats=self._use_global_stats, axis=self._axis)
 
 
 class InstanceNorm(HybridBlock):
