@@ -1,0 +1,82 @@
+"""CNN for sentence classification (mirrors reference
+example/cnn_text_classification/text_cnn.py — Kim-2014 architecture:
+embedding -> parallel conv branches with several filter widths ->
+max-over-time pooling -> concat -> dropout -> FC -> softmax).
+
+Synthetic task (zero-egress): a "sentence" is a sequence of token ids;
+class 1 iff the trigram (3, 4, 5) occurs anywhere — exactly the local
+n-gram pattern a width-3 text filter learns. Exercises the op paths no
+other example hits together: Embedding in a conv pipeline, Reshape to
+NCHW "text image", multi-branch Conv2D with full-width kernels,
+max-over-time Pooling, Concat of branch outputs, Dropout.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def make_data(rs, n, seqlen, vocab):
+    x = rs.randint(6, vocab, size=(n, seqlen)).astype(np.float32)
+    y = rs.randint(0, 2, size=n).astype(np.float32)
+    for i in range(n):
+        if y[i] == 1:
+            pos = rs.randint(0, seqlen - 3)
+            x[i, pos:pos + 3] = [3, 4, 5]
+    return x, y
+
+
+def build(seqlen, vocab, embed=16, filters=(2, 3, 4), nfilt=8):
+    data = mx.sym.Variable("data")                     # (B, T)
+    emb = mx.sym.Embedding(data, input_dim=vocab, output_dim=embed,
+                           name="embed")               # (B, T, E)
+    img = mx.sym.Reshape(emb, shape=(-1, 1, seqlen, embed))
+    branches = []
+    for w in filters:
+        c = mx.sym.Convolution(img, kernel=(w, embed), num_filter=nfilt,
+                               name="conv%d" % w)      # (B, F, T-w+1, 1)
+        a = mx.sym.Activation(c, act_type="relu")
+        p = mx.sym.Pooling(a, pool_type="max",
+                           kernel=(seqlen - w + 1, 1))  # max over time
+        branches.append(p)
+    h = mx.sym.Concat(*branches, dim=1)
+    h = mx.sym.Flatten(h)
+    h = mx.sym.Dropout(h, p=0.3)
+    fc = mx.sym.FullyConnected(h, num_hidden=2, name="fc")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--seqlen", type=int, default=16)
+    ap.add_argument("--vocab", type=int, default=40)
+    args = ap.parse_args()
+
+    rs = np.random.RandomState(0)
+    x, y = make_data(rs, 512, args.seqlen, args.vocab)
+    it = mx.io.NDArrayIter(x, y, batch_size=args.batch_size, shuffle=True)
+
+    mod = mx.mod.Module(build(args.seqlen, args.vocab),
+                        context=mx.current_context())
+    metric = mx.metric.Accuracy()
+    mod.fit(it, eval_metric=metric, num_epoch=args.num_epochs,
+            initializer=mx.initializer.Xavier(),
+            optimizer="adam", optimizer_params={"learning_rate": 5e-3})
+    it.reset()
+    metric.reset()
+    mod.score(it, metric)
+    acc = metric.get()[1]
+    print("final accuracy %.4f" % acc)
+    assert acc > 0.85, acc
+    print("TEXT_CNN_OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -31,6 +31,11 @@ EXAMPLES = [
     ("numpy-ops/custom_softmax.py", ["--num-epochs", "6"]),
     ("recommenders/matrix_fact.py", ["--num-epochs", "8"]),
     ("profiler/profiler_demo.py", []),
+    ("cnn_text_classification/text_cnn.py", ["--num-epochs", "6"]),
+    ("nce-loss/toy_nce.py", ["--num-epochs", "6"]),
+    ("bi-lstm-sort/lstm_sort.py", ["--num-epochs", "8"]),
+    ("vae/vae.py", ["--num-epochs", "10"]),
+    ("neural-style/nstyle.py", ["--iters", "100"]),
 ]
 
 

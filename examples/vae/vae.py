@@ -1,0 +1,109 @@
+"""Variational autoencoder (mirrors reference example/vae/VAE.py — the
+symbolic VAE: encoder -> (mu, logvar) -> reparameterised sample ->
+decoder, trained on Bernoulli reconstruction + KL with MakeLoss).
+
+Synthetic data on a low-dimensional manifold keeps it runnable with
+zero egress. Exercises: the reparameterisation trick with an epsilon
+DATA input (reference VAE.py feeds eps the same way — random inside
+the graph would break the deterministic executor contract), exp/square
+elementwise chains, MakeLoss heads combined with Group, and a
+multi-output executor where only loss heads produce gradients.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def build(ndim, nhid, nz):
+    data = mx.sym.Variable("data")
+    eps = mx.sym.Variable("eps")                  # N(0,1) sample, fed as data
+    h = mx.sym.FullyConnected(data, num_hidden=nhid, name="enc1")
+    h = mx.sym.Activation(h, act_type="tanh")
+    mu = mx.sym.FullyConnected(h, num_hidden=nz, name="mu")
+    logvar = mx.sym.FullyConnected(h, num_hidden=nz, name="logvar")
+    z = mu + mx.sym.exp(0.5 * logvar) * eps       # reparameterisation
+    d = mx.sym.FullyConnected(z, num_hidden=nhid, name="dec1")
+    d = mx.sym.Activation(d, act_type="tanh")
+    y = mx.sym.FullyConnected(d, num_hidden=ndim, name="dec2")
+    # Gaussian reconstruction + analytic KL(q||N(0,1)), one scalar loss
+    rec = mx.sym.sum(mx.sym.square(y - data), axis=1)
+    kl = -0.5 * mx.sym.sum(1 + logvar - mx.sym.square(mu)
+                           - mx.sym.exp(logvar), axis=1)
+    loss = mx.sym.MakeLoss(mx.sym.mean(rec + 0.1 * kl), name="vae_loss")
+    # expose the reconstruction too (BlockGrad: monitoring head only)
+    return mx.sym.Group([loss, mx.sym.BlockGrad(y)])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=15)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--dim", type=int, default=24)
+    ap.add_argument("--nz", type=int, default=4)
+    args = ap.parse_args()
+
+    rs = np.random.RandomState(0)
+    basis = rs.normal(size=(args.nz, args.dim)).astype(np.float32)
+    codes = rs.normal(size=(768, args.nz)).astype(np.float32)
+    x = codes @ basis + 0.05 * rs.normal(size=(768, args.dim)).astype(
+        np.float32)
+
+    mod = mx.mod.Module(build(args.dim, 32, args.nz),
+                        data_names=["data", "eps"], label_names=[],
+                        context=mx.current_context())
+    it = mx.io.NDArrayIter(
+        {"data": x, "eps": rs.normal(size=(768, args.nz)).astype(np.float32)},
+        batch_size=args.batch_size, shuffle=False)
+    mod.bind(data_shapes=it.provide_data)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 1e-2})
+
+    first = last = None
+    for epoch in range(args.num_epochs):
+        # fresh eps every epoch — the stochastic part of the estimator
+        it = mx.io.NDArrayIter(
+            {"data": x,
+             "eps": rs.normal(size=(768, args.nz)).astype(np.float32)},
+            batch_size=args.batch_size, shuffle=False)
+        tot = n = 0.0
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            tot += float(mod.get_outputs()[0].asnumpy())
+            n += 1
+            mod.backward()
+            mod.update()
+        loss = tot / n
+        if first is None:
+            first = loss
+        last = loss
+        print("epoch %d elbo-loss %.4f" % (epoch, loss))
+
+    print("loss %.3f -> %.3f" % (first, last))
+    assert last < 0.5 * first, (first, last)
+    # reconstruction head: decode with eps=0 must approximate the input
+    it0 = mx.io.NDArrayIter(
+        {"data": x, "eps": np.zeros((768, args.nz), np.float32)},
+        batch_size=args.batch_size, shuffle=False)
+    se = n = 0.0
+    for batch in it0:
+        mod.forward(batch, is_train=False)
+        rec = mod.get_outputs()[1].asnumpy()
+        xb = batch.data[0].asnumpy()
+        se += float(((rec - xb) ** 2).mean()) * xb.shape[0]
+        n += xb.shape[0]
+    mse = se / n
+    var = float(x.var())
+    print("recon mse %.4f (data var %.4f)" % (mse, var))
+    assert mse < 0.5 * var, (mse, var)
+    print("VAE_OK")
+
+
+if __name__ == "__main__":
+    main()
