@@ -13,7 +13,11 @@ PY_LDFLAGS := $(shell python3-config --embed --ldflags 2>/dev/null || \
 
 all: $(LIB_DIR)/libmxtpu_io.so $(LIB_DIR)/libmxtpu_engine.so \
      $(LIB_DIR)/libmxtpu_storage.so $(LIB_DIR)/libmxtpu_predict.so \
-     $(LIB_DIR)/libmxtpu_c_api.so
+     $(LIB_DIR)/libmxtpu_c_api.so tools/im2rec
+
+# native list->RecordIO packer (parity: reference tools/im2rec.cc)
+tools/im2rec: src/im2rec.cc
+	$(CXX) $(CXXFLAGS) -o $@ $<
 
 $(LIB_DIR)/libmxtpu_predict.so: src/c_predict_api.cc src/embed_common.cc
 	@mkdir -p $(LIB_DIR)
@@ -50,5 +54,6 @@ tests/cpp/test_native: tests/cpp/test_native.cc src/engine.cc src/storage.cc
 
 clean:
 	rm -rf $(LIB_DIR)
+	rm -f tools/im2rec
 
 .PHONY: all test clean

@@ -1,0 +1,172 @@
+// Native im2rec: pack an image list into RecordIO (parity: reference
+// tools/im2rec.cc — same .lst input, same IRHeader/record wire format
+// as mxnet_tpu/recordio.py and src/recordio.cc).
+//
+// Divergence (documented): the reference decodes + optionally resizes/
+// re-encodes through OpenCV; this environment has no native image
+// codec, so the packer streams the ENCODED bytes through untouched
+// (the reference's behaviour at resize=0, quality=default). Decode-time
+// augmentation lives in the Python pipeline (mxnet_tpu/image).
+//
+// Usage:
+//   im2rec <prefix.lst> <image-root> <out-prefix> [num_parts part_index]
+//
+// .lst format (reference im2rec.py): index \t label(s...) \t relpath
+// Multi-label rows use the flag=len(labels) wire form with float32
+// labels prepended to the payload.
+//
+// Writes out-prefix.rec and out-prefix.idx (tab-separated key\toffset).
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+#pragma pack(push, 1)
+struct IRHeader {
+  uint32_t flag;
+  float label;
+  uint64_t id;
+  uint64_t id2;
+};
+#pragma pack(pop)
+
+struct ListEntry {
+  uint64_t index;
+  std::vector<float> labels;
+  std::string path;
+};
+
+bool ParseListLine(const std::string& line, ListEntry* e) {
+  // index \t label... \t path  (path is the LAST field; labels between)
+  std::vector<std::string> fields;
+  std::stringstream ss(line);
+  std::string f;
+  while (std::getline(ss, f, '\t')) fields.push_back(f);
+  if (fields.size() < 3) return false;
+  e->index = std::strtoull(fields[0].c_str(), nullptr, 10);
+  e->labels.clear();
+  for (size_t i = 1; i + 1 < fields.size(); ++i)
+    e->labels.push_back(std::strtof(fields[i].c_str(), nullptr));
+  e->path = fields.back();
+  return true;
+}
+
+class RecWriter {
+ public:
+  RecWriter(const std::string& rec_path, const std::string& idx_path)
+      : rec_(std::fopen(rec_path.c_str(), "wb")),
+        idx_(std::fopen(idx_path.c_str(), "w")) {}
+  ~RecWriter() {
+    if (rec_ != nullptr) std::fclose(rec_);
+    if (idx_ != nullptr) std::fclose(idx_);
+  }
+  bool ok() const { return rec_ != nullptr && idx_ != nullptr; }
+
+  bool Write(const ListEntry& e, const std::string& payload) {
+    long pos = std::ftell(rec_);
+    IRHeader hdr{};
+    hdr.id = e.index;
+    hdr.id2 = 0;
+    std::string body;
+    if (e.labels.size() == 1) {
+      hdr.flag = 0;
+      hdr.label = e.labels[0];
+      body = payload;
+    } else {  // multi-label: flag = count, labels prepended as float32
+      hdr.flag = static_cast<uint32_t>(e.labels.size());
+      hdr.label = 0.0f;
+      body.assign(reinterpret_cast<const char*>(e.labels.data()),
+                  e.labels.size() * sizeof(float));
+      body += payload;
+    }
+    uint32_t len =
+        static_cast<uint32_t>(sizeof(IRHeader) + body.size()) & kLenMask;
+    if (std::fwrite(&kMagic, 4, 1, rec_) != 1) return false;
+    if (std::fwrite(&len, 4, 1, rec_) != 1) return false;
+    if (std::fwrite(&hdr, sizeof(IRHeader), 1, rec_) != 1) return false;
+    if (!body.empty() &&
+        std::fwrite(body.data(), body.size(), 1, rec_) != 1)
+      return false;
+    uint32_t pad = (4 - (sizeof(IRHeader) + body.size()) % 4) % 4;
+    static const char zeros[4] = {0, 0, 0, 0};
+    if (pad != 0 && std::fwrite(zeros, pad, 1, rec_) != 1) return false;
+    std::fprintf(idx_, "%llu\t%ld\n",
+                 static_cast<unsigned long long>(e.index), pos);
+    return true;
+  }
+
+ private:
+  FILE* rec_;
+  FILE* idx_;
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::fprintf(stderr,
+                 "usage: %s <list.lst> <image-root> <out-prefix> "
+                 "[num_parts part_index]\n",
+                 argv[0]);
+    return 2;
+  }
+  std::string lst = argv[1], root = argv[2], prefix = argv[3];
+  if (argc == 5) {
+    std::fprintf(stderr,
+                 "im2rec: num_parts given without part_index\n");
+    return 2;
+  }
+  int num_parts = argc > 5 ? std::atoi(argv[4]) : 1;
+  int part_index = argc > 5 ? std::atoi(argv[5]) : 0;
+  if (!root.empty() && root.back() != '/') root += '/';
+
+  std::ifstream in(lst);
+  if (!in) {
+    std::fprintf(stderr, "im2rec: cannot open list %s\n", lst.c_str());
+    return 1;
+  }
+  RecWriter w(prefix + ".rec", prefix + ".idx");
+  if (!w.ok()) {
+    std::fprintf(stderr, "im2rec: cannot open output %s.rec/.idx\n",
+                 prefix.c_str());
+    return 1;
+  }
+  std::string line;
+  long row = 0, written = 0, missing = 0;
+  while (std::getline(in, line)) {
+    if (line.empty()) continue;
+    long this_row = row++;
+    if (num_parts > 1 && this_row % num_parts != part_index) continue;
+    ListEntry e;
+    if (!ParseListLine(line, &e)) {
+      std::fprintf(stderr, "im2rec: bad list line %ld\n", this_row);
+      continue;
+    }
+    std::ifstream img(root + e.path, std::ios::binary);
+    if (!img) {
+      std::fprintf(stderr, "im2rec: missing image %s\n",
+                   (root + e.path).c_str());
+      ++missing;
+      continue;
+    }
+    std::ostringstream buf;
+    buf << img.rdbuf();
+    if (!w.Write(e, buf.str())) {
+      std::fprintf(stderr, "im2rec: write failed at row %ld\n", this_row);
+      return 1;
+    }
+    ++written;
+  }
+  std::printf("im2rec: wrote %ld records (%ld missing) -> %s.rec\n",
+              written, missing, prefix.c_str());
+  return missing == 0 ? 0 : 1;
+}
