@@ -38,6 +38,30 @@ def _as_numpy(x):
     return x.asnumpy() if isinstance(x, NDArray) else _numpy.asarray(x)
 
 
+def _acc_fused(pred, label, acc, argmax_axis):
+    """Accuracy accumulate as one compiled program: (optional argmax) +
+    compare + sum + running-sum add. Jitted once per shape signature;
+    ``argmax_axis`` is static (None = predictions are already class
+    ids)."""
+    import jax
+    global _ACC_FUSED_JIT
+    if _ACC_FUSED_JIT is None:
+        import jax.numpy as jnp
+
+        def _kernel(p, l, a, axis):
+            if axis is not None:
+                p = jnp.argmax(p, axis=axis)
+            p = p.astype(jnp.int32).reshape(-1)
+            l = l.astype(jnp.int32).reshape(-1)
+            return a + jnp.sum(p == l).astype(jnp.float32)
+
+        _ACC_FUSED_JIT = jax.jit(_kernel, static_argnames="axis")
+    return _ACC_FUSED_JIT(pred, label, acc, axis=argmax_axis)
+
+
+_ACC_FUSED_JIT = None
+
+
 def _colocate(ref, x):
     """Reshard ``x`` to ``ref``'s placement (mesh-DP outputs are sharded
     over the device mesh while labels arrive single-device)."""
@@ -176,13 +200,29 @@ class Accuracy(EvalMetric):
         for label, pred in zip(labels, preds):
             if isinstance(label, NDArray) and isinstance(pred, NDArray):
                 p, l = pred._data, label._data
+                # shape agreement checked host-side so the whole
+                # argmax+compare+sum+accumulate chain runs as ONE
+                # dispatched program — eager op-by-op execution cost
+                # several relay round-trips per batch on remoted PJRT
+                n = int(_numpy.prod(l.shape))
                 if p.ndim > l.ndim:
-                    p = jnp.argmax(p, axis=self.axis)
-                p = p.astype(jnp.int32).reshape(-1)
-                l = _colocate(p, l.astype(jnp.int32).reshape(-1))
-                check_label_shapes(l, p, shape=True)
-                self._accum_device(
-                    jnp.sum(p == l).astype(jnp.float32), int(l.shape[0]))
+                    ax = self.axis % p.ndim
+                    p_n = int(_numpy.prod(p.shape[:ax]
+                                          + p.shape[ax + 1:]))
+                else:
+                    p_n = int(_numpy.prod(p.shape))
+                if p_n != n:
+                    raise MXNetError(
+                        "Shape of labels %s does not match shape of "
+                        "predictions %s" % (l.shape, p.shape))
+                l = _colocate(p, l)
+                prev = getattr(self, "_dev_sum", None)
+                if prev is None:
+                    prev = jnp.zeros((), jnp.float32)
+                self._dev_sum = _acc_fused(p, l, prev,
+                                           self.axis % p.ndim
+                                           if p.ndim > l.ndim else None)
+                self.num_inst += n
                 continue
             label = _as_numpy(label)
             pred = _as_numpy(pred)
