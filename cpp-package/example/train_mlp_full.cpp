@@ -151,6 +151,8 @@ int main(int argc, char** argv) {
               KVStore::GetNumWorkers());
 
   Executor exec(net, ctx, args, grads, reqs);
+  Monitor monitor(200);  // per-output |x| stats every 200th batch
+  monitor.install(&exec);
 
   MXDataIter train_iter("CSVIter");
   train_iter.SetParam("data_csv", dir + "/x.csv")
@@ -176,8 +178,10 @@ int main(int argc, char** argv) {
       args[data_idx].SyncCopyFromCPU(host);
       batch.label.SyncCopyToCPU(&host);
       args[label_idx].SyncCopyFromCPU(host);
+      monitor.tic();
       exec.Forward(true);
       exec.Backward();
+      monitor.toc_print();
       // gradients ride the kvstore; the optimizer applies them in the
       // updater and Pull hands the fresh weights back
       KVStore::Push(param_keys, param_grads);

@@ -371,6 +371,8 @@ class Executor {
   Executor(const Executor&) = delete;
   Executor& operator=(const Executor&) = delete;
 
+  ExecutorHandle handle() const { return h_; }
+
   void Forward(bool is_train) {
     Check(MXExecutorForward(h_, is_train ? 1 : 0), "Forward");
   }

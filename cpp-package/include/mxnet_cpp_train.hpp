@@ -20,11 +20,14 @@
 #include <algorithm>
 #include <cmath>
 #include <cstdint>
+#include <cstdio>
 #include <functional>
 #include <map>
 #include <memory>
+#include <regex>
 #include <sstream>
 #include <string>
+#include <tuple>
 #include <utility>
 #include <vector>
 
@@ -47,6 +50,10 @@ int MXKVStoreGetGroupSize(KVStoreHandle, int*);
 int MXKVStoreBarrier(KVStoreHandle);
 int MXKVStoreRunServer(KVStoreHandle,
                        void (*)(int, const char*, void*), void*);
+typedef void* ExecutorHandle;
+int MXExecutorSetMonitorCallback(ExecutorHandle,
+                                 void (*)(const char*, NDArrayHandle, void*),
+                                 void*);
 int MXListDataIters(mx_uint*, DataIterCreator**);
 int MXDataIterGetIterInfo(DataIterCreator, const char**, const char**,
                           mx_uint*, const char***, const char***,
@@ -758,6 +765,83 @@ class MXDataIter : public DataIter {
   DataIterCreator creator_ = nullptr;
   std::map<std::string, std::string> params_;
   std::shared_ptr<void> blob_;
+};
+
+// ---------------------------------------------------------------------------
+// Monitor (reference monitor.h) — per-output statistics via the
+// executor monitor callback
+// ---------------------------------------------------------------------------
+
+inline NDArray _default_monitor_func(const NDArray& x) {
+  // mean |x| — the reference's default statistic
+  std::vector<NDArray> a, s;
+  Op("abs").Invoke({x}, &a);
+  Op("mean").Invoke({a.at(0)}, &s);
+  return s.at(0);
+}
+
+class Monitor {
+ public:
+  typedef std::function<NDArray(const NDArray&)> StatFunc;
+  typedef std::tuple<int, std::string, NDArray> Stat;
+
+  explicit Monitor(int interval, std::regex pattern = std::regex(".*"),
+                   StatFunc stat_func = _default_monitor_func)
+      : interval(interval), pattern(std::move(pattern)),
+        stat_func(std::move(stat_func)) {}
+
+  void install(Executor* exe) {
+    Check(MXExecutorSetMonitorCallback(exe->handle(),
+                                       &Monitor::executor_callback, this),
+          "SetMonitorCallback");
+    exes.push_back(exe);
+  }
+
+  void tic() {
+    if (step % interval == 0) {
+      activated = true;
+      stats.clear();
+    }
+  }
+
+  std::vector<Stat> toc() {
+    std::vector<Stat> out;
+    if (activated) {
+      activated = false;
+      NDArray::WaitAll();
+      out.swap(stats);
+    }
+    ++step;
+    return out;
+  }
+
+  void toc_print() {
+    for (auto& s : toc()) {
+      std::vector<float> v;
+      std::get<2>(s).SyncCopyToCPU(&v, 1);
+      std::printf("Batch %d %s %.6f\n", std::get<0>(s),
+                  std::get<1>(s).c_str(), v.at(0));
+    }
+  }
+
+ protected:
+  int interval;
+  std::regex pattern;
+  StatFunc stat_func;
+  std::vector<Executor*> exes;
+  int step = 0;
+  bool activated = false;
+  std::vector<Stat> stats;
+
+  static void executor_callback(const char* name, NDArrayHandle handle,
+                                void* monitor_ptr) {
+    auto* m = static_cast<Monitor*>(monitor_ptr);
+    // callback handles are new references (ABI contract) — owning wrap
+    NDArray arr(handle);
+    if (m->activated && std::regex_match(name, m->pattern)) {
+      m->stats.emplace_back(m->step, name, m->stat_func(arr));
+    }
+  }
 };
 
 // ---------------------------------------------------------------------------
