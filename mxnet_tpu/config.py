@@ -60,6 +60,9 @@ _KNOBS = {
                                 "_contrib_BatchNormAddReLU op "
                                 "(gluon/model_zoo/vision/resnet.py; "
                                 "A/B in PERF.md)"),
+    "MXNET_CONV_S2D_STEM": ("honored", "space-to-depth rewrite of the "
+                            "channels-last 7x7/s2 stem conv (ops/nn.py; "
+                            "default on, =0 for the PERF.md A/B)"),
     # executor
     "MXNET_EXEC_BULK_EXEC_TRAIN": ("mapped", "whole-graph jit IS maximal "
                                    "op bulking"),

@@ -52,12 +52,16 @@ def fully_connected(data, weight, bias=None, num_hidden=0, no_bias=False,
 def _s2d_applicable(data, kernel, stride, dilate, pad, num_group, is_cl,
                     ndim):
     """The ResNet/VGG stem pattern a TPU hates: channels-last 7x7/s2 conv
-    with tiny input depth (C=3 wastes 125/128 MXU input lanes)."""
+    with tiny input depth (C=3 wastes 125/128 MXU input lanes).
+    MXNET_CONV_S2D_STEM=0 disables the rewrite (the PERF.md A/B knob);
+    read at trace time, so flipping it requires a fresh jit cache."""
+    from ..base import get_env
     return (ndim == 2 and is_cl and tuple(kernel) == (7, 7)
             and tuple(stride) == (2, 2) and tuple(pad) == (3, 3)
             and tuple(dilate) == (1, 1) and int(num_group) == 1
             and data.shape[-1] <= 4
-            and data.shape[1] % 2 == 0 and data.shape[2] % 2 == 0)
+            and data.shape[1] % 2 == 0 and data.shape[2] % 2 == 0
+            and bool(get_env("MXNET_CONV_S2D_STEM", 1, int)))
 
 
 def _conv_s2d_7x7s2(data, weight):
