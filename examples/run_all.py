@@ -36,6 +36,12 @@ EXAMPLES = [
     ("bi-lstm-sort/lstm_sort.py", ["--num-epochs", "8"]),
     ("vae/vae.py", ["--num-epochs", "10"]),
     ("neural-style/nstyle.py", ["--iters", "100"]),
+    ("fcn-xs/fcn_xs.py", ["--num-epochs", "8"]),
+    ("svm_mnist/svm_mnist.py", ["--num-epochs", "6"]),
+    ("captcha/captcha_ocr.py", ["--num-epochs", "8"]),
+    ("rcnn/fast_rcnn.py", ["--num-epochs", "30"]),
+    ("dec/dec.py", ["--refine-iters", "25"]),
+    ("stochastic-depth/sd_cifar.py", ["--num-epochs", "10"]),
 ]
 
 

@@ -1,0 +1,70 @@
+"""Multiclass SVM on image features (mirrors reference
+example/svm_mnist/svm_mnist.py — the same MLP but trained with
+SVMOutput's hinge loss instead of softmax cross-entropy, both the L2
+and L1 margin variants).
+
+Synthetic separable digits keep it egress-free. Exercises SVMOutput
+(margin/regularization_coefficient/use_linear — no other tree touches
+the hinge-loss head) and compares the two margin modes converge.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def build(use_linear):
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=10, name="fc2")
+    return mx.sym.SVMOutput(h, margin=1.0, regularization_coefficient=1e-3,
+                            use_linear=use_linear, name="svm")
+
+
+def make_data(rs, n, dim=64):
+    protos = rs.normal(0, 1.0, (10, dim)).astype(np.float32)
+    y = rs.randint(0, 10, n).astype(np.float32)
+    x = protos[y.astype(int)] + 0.3 * rs.normal(size=(n, dim)).astype(
+        np.float32)
+    return x, y
+
+
+def train_one(use_linear, args, x, y):
+    it = mx.io.NDArrayIter(x, y, batch_size=args.batch_size, shuffle=True,
+                           label_name="svm_label")
+    mod = mx.mod.Module(build(use_linear), label_names=["svm_label"],
+                        context=mx.current_context())
+    metric = mx.metric.Accuracy()
+    mod.fit(it, eval_metric=metric, num_epoch=args.num_epochs,
+            initializer=mx.initializer.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    it.reset()
+    metric.reset()
+    mod.score(it, metric)
+    return metric.get()[1]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args()
+
+    rs = np.random.RandomState(0)
+    x, y = make_data(rs, 1024)
+    for use_linear in (False, True):
+        acc = train_one(use_linear, args, x, y)
+        print("%s-SVM accuracy %.4f" % ("L1" if use_linear else "L2", acc))
+        assert acc > 0.9, (use_linear, acc)
+    print("SVM_MNIST_OK")
+
+
+if __name__ == "__main__":
+    main()
