@@ -1,0 +1,156 @@
+"""REINFORCE policy gradient on a synthetic pole-balance task (mirrors
+the scope of reference example/reinforcement-learning/ — dqn/a3c/ddpg
+agents; this tree exercises the policy-gradient building blocks:
+``pick`` over action probabilities, ``BlockGrad`` on the advantage
+input, and a ``MakeLoss`` head driving Module's update loop directly,
+an op combination no other example tree touches).
+
+The environment is a linearised cart-pole implemented in numpy (no gym
+in the image): state (x, x_dot, theta, theta_dot), two actions pushing
+left/right, reward 1 per step until |theta| or |x| leaves bounds.
+REINFORCE with a running-baseline should push mean episode length up.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+class PoleEnv:
+    """Euler-integrated inverted pendulum on a cart, numpy only."""
+
+    DT = 0.02
+    FORCE = 10.0
+    GRAV = 9.8
+    MASS_CART = 1.0
+    MASS_POLE = 0.1
+    LEN = 0.5
+
+    def __init__(self, rs):
+        self.rs = rs
+        self.reset()
+
+    def reset(self):
+        self.s = self.rs.uniform(-0.05, 0.05, 4).astype(np.float32)
+        return self.s.copy()
+
+    def step(self, action):
+        x, x_dot, th, th_dot = self.s
+        force = self.FORCE if action == 1 else -self.FORCE
+        total_m = self.MASS_CART + self.MASS_POLE
+        pm_len = self.MASS_POLE * self.LEN
+        tmp = (force + pm_len * th_dot ** 2 * np.sin(th)) / total_m
+        th_acc = (self.GRAV * np.sin(th) - np.cos(th) * tmp) / \
+            (self.LEN * (4.0 / 3.0 - self.MASS_POLE * np.cos(th) ** 2
+                         / total_m))
+        x_acc = tmp - pm_len * th_acc * np.cos(th) / total_m
+        self.s = np.array([x + self.DT * x_dot,
+                           x_dot + self.DT * x_acc,
+                           th + self.DT * th_dot,
+                           th_dot + self.DT * th_acc], np.float32)
+        done = abs(self.s[0]) > 2.4 or abs(self.s[2]) > 12 * np.pi / 180
+        return self.s.copy(), 1.0, done
+
+
+def build_policy(num_actions=2):
+    data = mx.sym.Variable("data")
+    act = mx.sym.Variable("action")
+    adv = mx.sym.Variable("advantage")
+    h = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    h = mx.sym.Activation(h, act_type="tanh")
+    logits = mx.sym.FullyConnected(h, num_hidden=num_actions, name="fc2")
+    probs = mx.sym.SoftmaxActivation(logits, name="probs")
+    # -E[log pi(a|s) * A]; the advantage is data, not a differentiable
+    # path — BlockGrad documents that (reference a3c.py stops gradients
+    # through the critic's value the same way)
+    picked = mx.sym.pick(probs, act, axis=1)
+    loss = mx.sym.MakeLoss(
+        0.0 - mx.sym.log(picked + 1e-8) * mx.sym.BlockGrad(adv),
+        name="pg_loss")
+    return mx.sym.Group([loss, mx.sym.BlockGrad(probs)])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--episodes", type=int, default=60)
+    ap.add_argument("--batch-episodes", type=int, default=4)
+    ap.add_argument("--max-steps", type=int, default=120)
+    ap.add_argument("--gamma", type=float, default=0.97)
+    ap.add_argument("--lr", type=float, default=0.02)
+    args = ap.parse_args()
+
+    rs = np.random.RandomState(7)
+    env = PoleEnv(rs)
+    sym = build_policy()
+
+    mod = mx.mod.Module(sym, data_names=["data", "action", "advantage"],
+                        label_names=[], context=mx.current_context())
+    bsz = args.batch_episodes * args.max_steps
+    from mxnet_tpu.io import DataDesc, DataBatch
+    mod.bind(data_shapes=[DataDesc("data", (bsz, 4)),
+                          DataDesc("action", (bsz,)),
+                          DataDesc("advantage", (bsz,))],
+             label_shapes=None, for_training=True)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": args.lr})
+
+    baseline = 0.0
+    lengths = []
+    n_batches = max(1, args.episodes // args.batch_episodes)
+    for it in range(n_batches):
+        states, actions, rets, ep_lens = [], [], [], []
+        for _ in range(args.batch_episodes):
+            s = env.reset()
+            ep_s, ep_a, ep_r = [], [], []
+            for _ in range(args.max_steps):
+                # batch-1 inference rides the same module: a second jit
+                # signature, not a rebind (executor.reshape semantics)
+                mod.forward(DataBatch(
+                    [mx.nd.array(s[None]), mx.nd.zeros((1,)),
+                     mx.nd.zeros((1,))], []), is_train=False)
+                p = mod.get_outputs()[1].asnumpy()[0]
+                a = int(rs.rand() < p[1])
+                ep_s.append(s)
+                ep_a.append(a)
+                s, r, done = env.step(a)
+                ep_r.append(r)
+                if done:
+                    break
+            # discounted returns
+            g, run = np.zeros(len(ep_r), np.float32), 0.0
+            for t in reversed(range(len(ep_r))):
+                run = ep_r[t] + args.gamma * run
+                g[t] = run
+            states += ep_s
+            actions += ep_a
+            rets += list(g)
+            ep_lens.append(len(ep_r))
+        lengths.append(float(np.mean(ep_lens)))
+        baseline = 0.9 * baseline + 0.1 * float(np.mean(rets))
+        adv = np.asarray(rets, np.float32) - baseline
+        n = len(states)
+        pad = bsz - n
+        x = np.concatenate([np.asarray(states, np.float32),
+                            np.zeros((pad, 4), np.float32)])
+        a = np.concatenate([np.asarray(actions, np.float32),
+                            np.zeros(pad, np.float32)])
+        ad = np.concatenate([adv, np.zeros(pad, np.float32)])
+        mod.forward_backward(DataBatch(
+            [mx.nd.array(x), mx.nd.array(a), mx.nd.array(ad)], []))
+        mod.update()
+
+    early = np.mean(lengths[:3])
+    late = np.mean(lengths[-3:])
+    print("episode length: first batches %.1f -> last %.1f" % (early, late))
+    assert late > early, "policy gradient did not improve episode length"
+    print("reinforce ok")
+
+
+if __name__ == "__main__":
+    main()

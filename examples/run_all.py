@@ -42,6 +42,15 @@ EXAMPLES = [
     ("rcnn/fast_rcnn.py", ["--num-epochs", "30"]),
     ("dec/dec.py", ["--refine-iters", "25"]),
     ("stochastic-depth/sd_cifar.py", ["--num-epochs", "10"]),
+    ("reinforcement-learning/reinforce_pole.py",
+     ["--episodes", "24", "--batch-episodes", "4", "--max-steps", "60"]),
+    ("bayesian-methods/sgld_regression.py",
+     ["--num-epochs", "45", "--burn-in", "21"]),
+    ("memcost/memcost.py", ["--depth", "12", "--hidden", "128"]),
+    ("warpctc/ctc_seq_train.py",
+     ["--num-epochs", "30", "--train-size", "256"]),
+    ("speech-demo/lstm_acoustic.py",
+     ["--num-epochs", "12", "--train-size", "192"]),
 ]
 
 

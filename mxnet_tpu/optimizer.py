@@ -773,12 +773,16 @@ class FusedUpdater(Updater):
             inner_n.append(len(tup) - (1 if is_mp else 0))
 
         # host-side bookkeeping exactly as the eager path does it:
-        # update counts first, then scheduler-aware lr/wd per index
+        # update counts first, then scheduler-aware lr/wd per index.
+        # Shipped as THREE (n,) arrays, not 3n scalar pytree leaves —
+        # every leaf is its own host->device transfer per step on a
+        # remoted PJRT backend (~50ms/step at ResNet-50 param counts)
         for i in indices:
             opt._update_count(i)
-        ts = [np.float32(opt._index_update_count[i]) for i in indices]
-        lrs = [np.float32(opt._get_lr(i)) for i in indices]
-        wds = [np.float32(opt._get_wd(i)) for i in indices]
+        ts = np.asarray([opt._index_update_count[i] for i in indices],
+                        np.float32)
+        lrs = np.asarray([opt._get_lr(i) for i in indices], np.float32)
+        wds = np.asarray([opt._get_wd(i) for i in indices], np.float32)
 
         statics = tuple(sorted(
             (k, v) for k, v in hyper.items() if k not in ("lr", "wd")))

@@ -49,6 +49,36 @@ def test_recommender_example():
     assert "rmse" in out
 
 
+def test_reinforce_example():
+    out = _run("reinforcement-learning/reinforce_pole.py",
+               ["--episodes", "16", "--batch-episodes", "4",
+                "--max-steps", "40"])
+    assert "reinforce ok" in out
+
+
+def test_sgld_example():
+    out = _run("bayesian-methods/sgld_regression.py",
+               ["--num-epochs", "36", "--burn-in", "18"])
+    assert "sgld ok" in out
+
+
+def test_memcost_example():
+    out = _run("memcost/memcost.py", ["--depth", "8", "--hidden", "64"])
+    assert "memcost ok" in out
+
+
+def test_ctc_example():
+    out = _run("warpctc/ctc_seq_train.py",
+               ["--num-epochs", "30", "--train-size", "256"])
+    assert "ctc ok" in out
+
+
+def test_speech_demo_example():
+    out = _run("speech-demo/lstm_acoustic.py",
+               ["--num-epochs", "12", "--train-size", "192"])
+    assert "speech demo ok" in out
+
+
 @pytest.mark.slow
 def test_all_examples():
     """Full sweep; run explicitly with -m slow (CI nightly analogue)."""

@@ -39,21 +39,18 @@ sys.path.insert(0, os.path.join(
 from symbols.resnet import get_symbol
 
 
-def sync():
-    """Drain the device queue (block_until_ready alone does not on
-    relayed PJRT backends) — fetch a scalar through the executor."""
-    jax.block_until_ready(jax.device_put(np.zeros(())))
-
-
-def timed(label, fn, iters=ITERS, pre_sync=True):
-    if pre_sync:
-        sync()
+def timed(label, fn, fence, iters=ITERS):
+    """``fence`` must return (or contain) buffers DATA-DEPENDENT on the
+    work ``fn`` queued — a fresh unrelated transfer does NOT drain the
+    compute queue, so fencing on one under-reports any async phase."""
     fn()  # warm
-    sync()
+    np.asarray(jax.tree_util.tree_leaves(
+        jax.block_until_ready(fence()))[0])
     t0 = time.perf_counter()
     for _ in range(iters):
         fn()
-    sync()
+    np.asarray(jax.tree_util.tree_leaves(
+        jax.block_until_ready(fence()))[0])
     dt = (time.perf_counter() - t0) / iters
     print("%-28s %8.2f ms" % (label, dt * 1e3), flush=True)
     return dt
@@ -84,20 +81,32 @@ def main():
     batch = DataBatch([x], [y], pad=0)
     metric = mx.metric.Accuracy()
 
+    def grad_fence():
+        return [g._data for g in mod._exec.grad_arrays if g is not None]
+
+    def param_fence():
+        return [mod._exec.arg_dict[n]._data for n in mod._param_names[:1]]
+
+    def metric_fence():
+        return metric._dev_sum
+
     results = {}
     results["forward_backward_ms"] = timed(
-        "forward_backward", lambda: mod.forward_backward(batch)) * 1e3
-    results["update_ms"] = timed("update", lambda: mod.update()) * 1e3
+        "forward_backward", lambda: mod.forward_backward(batch),
+        grad_fence) * 1e3
+    results["update_ms"] = timed("update", lambda: mod.update(),
+                                 param_fence) * 1e3
     results["update_metric_ms"] = timed(
         "update_metric",
-        lambda: mod.update_metric(metric, batch.label)) * 1e3
+        lambda: mod.update_metric(metric, batch.label), metric_fence) * 1e3
 
     def whole_step():
         mod.forward_backward(batch)
         mod.update()
         mod.update_metric(metric, batch.label)
 
-    step_s = timed("whole step (fb+upd+metric)", whole_step)
+    step_s = timed("whole step (fb+upd+metric)", whole_step,
+                   lambda: (param_fence(), metric_fence()))
     results["step_ms"] = step_s * 1e3
     results["step_img_s"] = BATCH / step_s
 
@@ -106,7 +115,8 @@ def main():
         mod.set_params(arg_p, aux_p)
 
     results["epoch_end_get_set_ms"] = timed(
-        "epoch-end get/set_params", epoch_end, iters=max(2, ITERS // 3)) * 1e3
+        "epoch-end get/set_params", epoch_end, param_fence,
+        iters=max(2, ITERS // 3)) * 1e3
 
     print(json.dumps({k: round(v, 2) for k, v in results.items()}),
           flush=True)
