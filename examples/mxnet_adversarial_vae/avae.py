@@ -1,0 +1,123 @@
+"""Adversarial variational autoencoder (mirrors reference
+example/mxnet_adversarial_vae/ — a VAE whose decoder doubles as a GAN
+generator: the encoder/decoder train on ELBO while a discriminator
+scores decoded samples, and its gradient flows back into the decoder).
+
+Three gluon networks trained jointly with autograd on a synthetic 2-D
+mixture; exercises the three-network, two-optimizer training loop with
+a gradient path THROUGH a frozen discriminator — a composition no
+other tree runs (gan/ trains two nets, vae/ trains one).
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+LATENT = 4
+
+
+def real_batch(rs, n):
+    centers = np.array([[2, 0], [-2, 0], [0, 2], [0, -2]], np.float32)
+    c = centers[rs.randint(0, 4, n)]
+    return c + 0.15 * rs.normal(size=(n, 2)).astype(np.float32)
+
+
+def mlp(widths, out):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        for w in widths:
+            net.add(nn.Dense(w, activation="relu"))
+        net.add(nn.Dense(out))
+    return net
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=400)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--adv-weight", type=float, default=0.05)
+    args = ap.parse_args()
+
+    rs = np.random.RandomState(0)
+    np.random.seed(0)
+    mx.random.seed(0)
+
+    enc = mlp([32], 2 * LATENT)          # -> (mu, logvar)
+    dec = mlp([32, 32], 2)
+    disc = mlp([32, 32], 1)
+    for net in (enc, dec, disc):
+        net.initialize(mx.initializer.Xavier())
+        net.hybridize()
+    vae_tr = gluon.Trainer(
+        dict(list(enc.collect_params().items())
+             + list(dec.collect_params().items())),
+        "adam", {"learning_rate": 3e-3})
+    d_tr = gluon.Trainer(disc.collect_params(), "adam",
+                         {"learning_rate": 1e-3})
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+
+    b = args.batch_size
+    ones, zeros = mx.nd.ones((b,)), mx.nd.zeros((b,))
+    recon_hist, fool_hist = [], []
+    for it in range(args.iters):
+        xr = mx.nd.array(real_batch(rs, b))
+
+        # -- discriminator: real decoded-from-prior vs dataset ----------
+        z_prior = mx.nd.array(rs.normal(size=(b, LATENT))
+                              .astype(np.float32))
+        with autograd.record():
+            fake = dec(z_prior)
+            ld = bce(disc(xr), ones) + bce(disc(fake.detach()), zeros)
+        ld.backward()
+        d_tr.step(b)
+
+        # -- VAE: ELBO + adversarial term through the FROZEN D ----------
+        eps = mx.nd.array(rs.normal(size=(b, LATENT)).astype(np.float32))
+        with autograd.record():
+            h = enc(xr)
+            # -4 shift: posterior starts tight (std ~0.14) so the
+            # decoder sees signal through the noise from step one —
+            # without it the unit-variance init collapses the latent
+            mu, logvar = h[:, :LATENT], h[:, LATENT:] - 4.0
+            z = mu + eps * mx.nd.exp(0.5 * logvar)
+            xh = dec(z)
+            recon = mx.nd.mean(mx.nd.square(xh - xr), axis=1)
+            kl = -0.5 * mx.nd.mean(
+                1 + logvar - mx.nd.square(mu) - mx.nd.exp(logvar), axis=1)
+            fool = bce(disc(dec(z_prior)), ones)   # grads stop at disc's
+            loss = recon + 0.05 * kl + args.adv_weight * fool  # params
+        loss.backward()
+        vae_tr.step(b)     # disc params NOT in this trainer: frozen
+
+        recon_hist.append(float(recon.mean().asnumpy()))
+        fool_hist.append(float(fool.mean().asnumpy()))
+
+    early_r = np.mean(recon_hist[:20])
+    late_r = np.mean(recon_hist[-20:])
+    late_fool = np.mean(fool_hist[-20:])
+    # at the adversarial equilibrium D cannot separate decoded samples
+    # from data and the fooling BCE sits near ln2~0.69; a decoder D has
+    # beaten outright shows 2-5 here (observed before the logvar-shift
+    # fix), so bound it rather than demand sub-0.69
+    print("recon %.4f -> %.4f | fool-bce %.3f" % (early_r, late_r,
+                                                  late_fool))
+    assert late_r < 0.5 * early_r, "reconstruction did not improve"
+    assert late_fool < 1.5, \
+        "adversarial path dead: D separates decoded samples outright"
+    samples = dec(mx.nd.array(rs.normal(size=(256, LATENT))
+                              .astype(np.float32))).asnumpy()
+    spread = samples.std(axis=0)
+    print("sample std %s" % np.round(spread, 3))
+    assert spread.max() > 0.5, "decoder collapsed to a point"
+    print("avae ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -51,6 +51,8 @@ EXAMPLES = [
      ["--num-epochs", "30", "--train-size", "256"]),
     ("speech-demo/lstm_acoustic.py",
      ["--num-epochs", "12", "--train-size", "192"]),
+    ("dsd/dsd.py", ["--epochs-per-phase", "4"]),
+    ("mxnet_adversarial_vae/avae.py", ["--iters", "400"]),
 ]
 
 

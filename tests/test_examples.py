@@ -79,6 +79,16 @@ def test_speech_demo_example():
     assert "speech demo ok" in out
 
 
+def test_dsd_example():
+    out = _run("dsd/dsd.py", ["--epochs-per-phase", "4"])
+    assert "dsd ok" in out
+
+
+def test_adversarial_vae_example():
+    out = _run("mxnet_adversarial_vae/avae.py", ["--iters", "400"])
+    assert "avae ok" in out
+
+
 @pytest.mark.slow
 def test_all_examples():
     """Full sweep; run explicitly with -m slow (CI nightly analogue)."""
