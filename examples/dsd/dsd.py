@@ -104,9 +104,7 @@ def main():
     mod.init_optimizer(optimizer=opt[0],
                        optimizer_params={"learning_rate": 5e-4},
                        force_init=True)
-    mod.fit(it, num_epoch=args.epochs_per_phase,
-            optimizer=opt[0],
-            optimizer_params={"learning_rate": 5e-4}, force_init=False)
+    mod.fit(it, num_epoch=args.epochs_per_phase)
     acc_redense = accuracy(mod, test_it)
 
     print("dense %.3f -> sparse(%.0f%% pruned) %.3f -> re-dense %.3f"

@@ -53,6 +53,8 @@ EXAMPLES = [
      ["--num-epochs", "12", "--train-size", "192"]),
     ("dsd/dsd.py", ["--epochs-per-phase", "4"]),
     ("mxnet_adversarial_vae/avae.py", ["--iters", "400"]),
+    ("module/seq_module.py", ["--num-epochs", "6"]),
+    ("python-howto/howto.py", ["--num-epochs", "4"]),
 ]
 
 

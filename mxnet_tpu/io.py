@@ -449,17 +449,20 @@ class LibSVMIter(DataIter):
 class ImageRecordIter(DataIter):
     """RecordIO image iterator (parity: src/io/iter_image_recordio_2.cc).
 
-    Reads packed RecordIO (see recordio.py / src/recordio.cc); decodes raw
-    uint8 image payloads, applies crop/mirror augmentation, normalises, and
-    prefetches in a background thread. JPEG decode requires the optional
-    C++ pipeline; raw/packed tensors always work.
+    Reads packed RecordIO (see recordio.py / src/recordio.cc). Raw uint8
+    payloads decode directly; encoded payloads (JPEG/PNG/...) decode via
+    PIL with crop/mirror augmentation. The optional C++ pipeline
+    (src/recordio.cc) accelerates the unaugmented raw path. round_batch
+    wraps the final partial batch to the epoch head and reports the
+    wrapped count in ``DataBatch.pad`` (reference round_batch semantics);
+    round_batch=False drops the partial tail.
     """
 
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
                  shuffle=False, rand_crop=False, rand_mirror=False,
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
                  std_b=1.0, scale=1.0, preprocess_threads=4, seed=0,
-                 num_parts=1, part_index=0, **kwargs):
+                 num_parts=1, part_index=0, round_batch=True, **kwargs):
         super().__init__(int(batch_size))
         from . import recordio
         self.data_shape = tuple(int(x) for x in data_shape)
@@ -467,8 +470,11 @@ class ImageRecordIter(DataIter):
         self.shuffle = shuffle
         self.rand_crop = rand_crop
         self.rand_mirror = rand_mirror
-        self.mean = np.array([mean_r, mean_g, mean_b], np.float32).reshape(3, 1, 1)
-        self.std = np.array([std_r, std_g, std_b], np.float32).reshape(3, 1, 1)
+        c = int(data_shape[0])
+        means = [mean_r, mean_g, mean_b][:c] if c <= 3 else [mean_r] * c
+        stds = [std_r, std_g, std_b][:c] if c <= 3 else [std_r] * c
+        self.mean = np.array(means, np.float32).reshape(c, 1, 1)
+        self.std = np.array(stds, np.float32).reshape(c, 1, 1)
         self.scale = scale
         # fast path: native threaded loader (src/recordio.cc) when built and
         # no python-side augmentation is requested (the native scan has no
@@ -494,6 +500,13 @@ class ImageRecordIter(DataIter):
             self._records.append(s)
         if num_parts > 1:   # dmlc InputSplit parity: per-worker shard
             self._records = self._records[part_index::num_parts]
+        self._round_batch = bool(round_batch)
+        if self._round_batch and self._native is not None \
+                and len(self._records) % self.batch_size != 0:
+            # the native loader drops the partial tail (recordio.cc
+            # n_batches = n/batch); round_batch demands wrap-and-pad, so
+            # fall back to the python path to keep semantics build-independent
+            self._native = None
         self._order = np.arange(len(self._records))
         self.cursor = -self.batch_size
 
@@ -525,18 +538,68 @@ class ImageRecordIter(DataIter):
 
     def iter_next(self):
         self.cursor += self.batch_size
+        if self._round_batch:
+            # last partial batch wraps to the epoch head; pad reports the
+            # wrapped count (parity: iter_image_recordio_2.cc round_batch)
+            return self.cursor < len(self._records)
         return self.cursor + self.batch_size <= len(self._records)
+
+    def _batch_indices(self):
+        idx = self._order[self.cursor:self.cursor + self.batch_size]
+        while len(idx) < self.batch_size:    # wrap (repeatedly, if the
+            idx = np.concatenate(            # dataset is < one batch)
+                [idx, self._order[:self.batch_size - len(idx)]])
+        return idx
+
+    _IMG_MAGIC = (b"\xff\xd8", b"\x89PN", b"BM", b"GIF")
 
     def _decode(self, s):
         from . import recordio
         header, img = recordio.unpack(s)
         c, h, w = self.data_shape
-        arr = np.frombuffer(img, dtype=np.uint8)
-        if arr.size >= c * h * w:
-            arr = arr[:c * h * w].reshape(c, h, w).astype(np.float32)
+        if img[:2] in self._IMG_MAGIC or img[:3] in self._IMG_MAGIC:
+            # encoded payload (JPEG/PNG/...): PIL decode, then crop to
+            # data_shape — random when rand_crop, centred otherwise
+            # (parity: iter_image_recordio_2.cc's ImageAugmenter)
+            import io as _pyio
+            from PIL import Image
+            try:
+                pic = Image.open(_pyio.BytesIO(img))
+                pic.load()
+            except Exception:
+                # raw pixels that merely started with an image signature
+                pic = None
         else:
-            raise MXNetError("record payload too small; use the C++ decode "
-                             "pipeline for encoded images")
+            pic = None
+        if pic is not None:
+            if c == 1:
+                pic = pic.convert("L")
+            elif c == 3:
+                if pic.mode != "RGB":
+                    pic = pic.convert("RGB")
+            else:
+                raise MXNetError(
+                    "encoded-image decode supports 1 or 3 channels, "
+                    "data_shape has %d" % c)
+            if pic.width < w or pic.height < h:
+                pic = pic.resize((max(w, pic.width), max(h, pic.height)))
+            dx, dy = pic.width - w, pic.height - h
+            if self.rand_crop:
+                x0 = np.random.randint(0, dx + 1)
+                y0 = np.random.randint(0, dy + 1)
+            else:
+                x0, y0 = dx // 2, dy // 2
+            pic = pic.crop((x0, y0, x0 + w, y0 + h))
+            arr = np.asarray(pic, dtype=np.float32)
+            arr = arr[:, :, None] if arr.ndim == 2 else arr
+            arr = arr.transpose(2, 0, 1)
+        else:
+            arr = np.frombuffer(img, dtype=np.uint8)
+            if arr.size >= c * h * w:
+                arr = arr[:c * h * w].reshape(c, h, w).astype(np.float32)
+            else:
+                raise MXNetError("record payload too small for raw decode "
+                                 "and not an encoded image")
         if self.rand_mirror and np.random.rand() < 0.5:
             arr = arr[:, :, ::-1]
         arr = (arr * self.scale - self.mean) / self.std
@@ -544,20 +607,24 @@ class ImageRecordIter(DataIter):
         return arr, label
 
     def getdata(self):
-        idx = self._order[self.cursor:self.cursor + self.batch_size]
+        idx = self._batch_indices()
         batch = np.stack([self._decode(self._records[i])[0] for i in idx])
         return [_nd_array(batch)]
 
     def getlabel(self):
-        idx = self._order[self.cursor:self.cursor + self.batch_size]
-        labels = np.array([np.atleast_1d(self._decode(self._records[i])[1])
-                           for i in idx], np.float32)
+        from . import recordio
+        idx = self._batch_indices()
+        # labels live in the record header — no image decode needed
+        labels = np.array(
+            [np.atleast_1d(recordio.unpack(self._records[i])[0].label)
+             for i in idx], np.float32)
         if self.label_width == 1:
             labels = labels[:, 0]
         return [_nd_array(labels)]
 
     def getpad(self):
-        return 0
+        remain = len(self._records) - self.cursor
+        return max(0, self.batch_size - remain) if remain > 0 else 0
 
 
 class MXDataIter(DataIter):

@@ -72,7 +72,9 @@ class PythonModule(BaseModule):
             # module; and this requires nothing to do
             pass
         else:
-            raise NotImplementedError()
+            # by default the outputs are scores the metric can consume
+            # (parity: reference python_module.py:151-156)
+            eval_metric.update(labels, self.get_outputs())
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,

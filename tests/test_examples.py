@@ -89,6 +89,16 @@ def test_adversarial_vae_example():
     assert "avae ok" in out
 
 
+def test_module_tour_example():
+    out = _run("module/seq_module.py", ["--num-epochs", "6"])
+    assert "module tour ok" in out
+
+
+def test_python_howto_example():
+    out = _run("python-howto/howto.py", ["--num-epochs", "4"])
+    assert "howto ok" in out
+
+
 @pytest.mark.slow
 def test_all_examples():
     """Full sweep; run explicitly with -m slow (CI nightly analogue)."""

@@ -303,3 +303,76 @@ def test_native_im2rec_roundtrip(tmp_path):
                 break
             ids.append(recordio.unpack(buf)[0].id)
     assert sorted(ids) == [0, 1, 2]
+
+
+def _write_jpeg_rec(path, n=7, size=(12, 12), gray=False):
+    import io as pyio
+    from PIL import Image
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(n):
+        arr = np.random.randint(0, 255, size + ((1,) if gray else (3,)),
+                                dtype=np.uint8)
+        img = Image.fromarray(arr[:, :, 0] if gray else arr,
+                              mode="L" if gray else "RGB")
+        buf = pyio.BytesIO()
+        img.save(buf, format="JPEG")
+        w.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                              buf.getvalue()))
+    w.close()
+
+
+def test_image_record_iter_jpeg_decode_and_round_batch(tmp_path):
+    """Encoded payloads decode via PIL; round_batch wraps + reports pad."""
+    path = str(tmp_path / "jpeg.rec")
+    _write_jpeg_rec(path, n=7)
+    it = ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8),
+                         batch_size=4, rand_crop=True, rand_mirror=True)
+    b0 = it.next()
+    assert b0.data[0].shape == (4, 3, 8, 8) and b0.pad == 0
+    b1 = it.next()   # 3 records left -> wraps 1, pad=1
+    assert b1.data[0].shape == (4, 3, 8, 8) and b1.pad == 1
+    np.testing.assert_allclose(b1.label[0].asnumpy(), [4, 5, 6, 0])
+    import pytest as _pytest
+    with _pytest.raises(StopIteration):
+        it.next()
+    # round_batch=False drops the partial tail instead
+    it2 = ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8),
+                          batch_size=4, rand_crop=True, round_batch=False)
+    it2.next()
+    with _pytest.raises(StopIteration):
+        it2.next()
+
+
+def test_image_record_iter_grayscale_jpeg(tmp_path):
+    path = str(tmp_path / "gray.rec")
+    _write_jpeg_rec(path, n=4, gray=True)
+    it = ImageRecordIter(path_imgrec=path, data_shape=(1, 8, 8),
+                         batch_size=4, mean_r=1.0, std_r=2.0)
+    batch = it.next()
+    assert batch.data[0].shape == (4, 1, 8, 8)
+
+
+def test_image_record_iter_smaller_than_batch(tmp_path):
+    path = str(tmp_path / "tiny.rec")
+    _write_jpeg_rec(path, n=3)
+    it = ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8),
+                         batch_size=8, rand_crop=True)
+    batch = it.next()   # wraps repeatedly to fill, pad = 8-3 = 5
+    assert batch.data[0].shape == (8, 3, 8, 8) and batch.pad == 5
+    np.testing.assert_allclose(batch.label[0].asnumpy(),
+                               [0, 1, 2, 0, 1, 2, 0, 1])
+
+
+def test_image_record_iter_raw_payload_with_magic_prefix(tmp_path):
+    """Raw pixels starting with a JPEG signature still raw-decode."""
+    path = str(tmp_path / "trap.rec")
+    w = recordio.MXRecordIO(path, "w")
+    arr = np.random.randint(0, 255, (3, 8, 8), dtype=np.uint8)
+    arr.flat[0], arr.flat[1] = 0xFF, 0xD8   # JPEG SOI magic
+    w.write(recordio.pack(recordio.IRHeader(0, 5.0, 0, 0), arr.tobytes()))
+    w.close()
+    it = ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8),
+                         batch_size=1)
+    batch = it.next()
+    np.testing.assert_allclose(batch.data[0].asnumpy()[0],
+                               arr.astype(np.float32))
