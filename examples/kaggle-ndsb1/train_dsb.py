@@ -1,0 +1,172 @@
+"""Kaggle NDSB-1 plankton pipeline (mirrors reference
+example/kaggle-ndsb1/ — gen_img_list.py builds stratified .lst splits,
+im2rec packs them, train_dsb.py trains a small convnet with an lr
+schedule + gradient clipping, predict_dsb.py + submission_dsb.py turn
+class probabilities into the competition CSV).
+
+The whole competition loop runs here on synthetic "plankton" (one blob
+shape per class), exercising a chain no other tree does end to end:
+class-directory images -> ``tools/im2rec.py --list`` + pack (the real
+CLI, in subprocesses) -> ``ImageRecordIter`` over the packed .rec ->
+``Module.fit`` with ``MultiFactorScheduler`` and ``clip_gradient`` ->
+``predict`` on an unlabeled test .rec -> probability-matrix submission
+CSV (rows must sum to 1).
+"""
+import argparse
+import csv
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+CLASSES = ["acantharia", "copepod", "diatom", "shrimp"]
+IMG = 24
+
+
+def draw_class(rs, cls):
+    """One distinguishable grayscale blob per class."""
+    a = np.zeros((IMG, IMG), np.uint8)
+    yy, xx = np.mgrid[:IMG, :IMG]
+    cy, cx = rs.randint(8, IMG - 8, 2)
+    if cls == 0:    # disc
+        a[(yy - cy) ** 2 + (xx - cx) ** 2 < 30] = 220
+    elif cls == 1:  # vertical bar
+        a[:, max(0, cx - 2):cx + 2] = 220
+    elif cls == 2:  # horizontal bar
+        a[max(0, cy - 2):cy + 2, :] = 220
+    else:           # cross
+        a[:, max(0, cx - 1):cx + 1] = 220
+        a[max(0, cy - 1):cy + 1, :] = 220
+    noise = rs.randint(0, 40, a.shape).astype(np.uint8)
+    return np.minimum(255, a + noise)
+
+
+def write_images(root, rs, per_class):
+    from PIL import Image
+    for ci, cname in enumerate(CLASSES):
+        d = os.path.join(root, cname)
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            img = Image.fromarray(draw_class(rs, ci), mode="L").convert(
+                "RGB")
+            img.save(os.path.join(d, "%s_%03d.jpg" % (cname, i)))
+
+
+def im2rec(repo, argv):
+    tool = os.path.join(repo, "tools", "im2rec.py")
+    subprocess.run([sys.executable, tool] + argv, check=True, timeout=600)
+
+
+def build():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=16,
+                             pad=(1, 1), name="conv1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=32,
+                             pad=(1, 1), name="conv2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=len(CLASSES), name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--per-class", type=int, default=60)
+    ap.add_argument("--lr", type=float, default=0.003)
+    args = ap.parse_args()
+
+    repo = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", ".."))
+    rs = np.random.RandomState(0)
+    work = tempfile.mkdtemp(prefix="ndsb1_")
+    img_root = os.path.join(work, "train_imgs")
+    write_images(img_root, rs, args.per_class)
+
+    # 1) stratified list + pack via the im2rec CLI (reference gen_img_list
+    #    + im2rec.cc step)
+    prefix = os.path.join(work, "train")
+    im2rec(repo, ["--list", "--recursive", "--shuffle", "1",
+                  prefix, img_root])
+    im2rec(repo, [prefix, img_root])
+
+    test_root = os.path.join(work, "test_imgs", "unknown")
+    os.makedirs(test_root)
+    from PIL import Image
+    test_labels = []
+    for i in range(64):
+        ci = rs.randint(0, len(CLASSES))
+        test_labels.append(ci)
+        Image.fromarray(draw_class(rs, ci), mode="L").convert("RGB").save(
+            os.path.join(test_root, "img_%03d.jpg" % i))
+    tprefix = os.path.join(work, "test")
+    im2rec(repo, ["--list", "--recursive", tprefix,
+                  os.path.dirname(test_root)])
+    im2rec(repo, [tprefix, os.path.dirname(test_root)])
+
+    # 2) train from the packed records with an lr schedule + clipping
+    train_it = mx.io.ImageRecordIter(
+        path_imgrec=prefix + ".rec", data_shape=(3, IMG, IMG),
+        batch_size=args.batch_size, shuffle=True, rand_mirror=True,
+        mean_r=60.0, mean_g=60.0, mean_b=60.0,
+        std_r=80.0, std_g=80.0, std_b=80.0)
+    steps_per_epoch = max(1, (args.per_class * len(CLASSES))
+                          // args.batch_size)
+    sched = mx.lr_scheduler.MultiFactorScheduler(
+        step=[steps_per_epoch * max(1, args.num_epochs // 2)], factor=0.3)
+    mod = mx.mod.Module(build(), context=mx.current_context())
+    metric = mx.metric.Accuracy()
+    mod.fit(train_it, eval_metric=metric, num_epoch=args.num_epochs,
+            initializer=mx.initializer.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "clip_gradient": 5.0,
+                              "lr_scheduler": sched})
+    train_it.reset()
+    metric.reset()
+    mod.score(train_it, metric)
+    acc = metric.get()[1]
+    print("train accuracy %.3f" % acc)
+
+    # 3) predict the test set and emit the probability submission
+    test_it = mx.io.ImageRecordIter(
+        path_imgrec=tprefix + ".rec", data_shape=(3, IMG, IMG),
+        batch_size=args.batch_size,
+        mean_r=60.0, mean_g=60.0, mean_b=60.0,
+        std_r=80.0, std_g=80.0, std_b=80.0)
+    probs = mod.predict(test_it).asnumpy()[:64]
+    # row order comes from the packed .lst, exactly as the reference's
+    # predict_dsb.py/submission_dsb.py pair reads it back
+    with open(tprefix + ".lst") as f:
+        names = [line.split("\t")[2].strip() for line in f]
+    sub_path = os.path.join(work, "submission.csv")
+    with open(sub_path, "w", newline="") as f:
+        wr = csv.writer(f)
+        wr.writerow(["image"] + CLASSES)
+        for name, row in zip(names, probs):
+            wr.writerow([os.path.basename(name)]
+                        + ["%.5f" % p for p in row])
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-4)
+    order = [int(os.path.basename(n).split("_")[1].split(".")[0])
+             for n in names]
+    truth = np.array([test_labels[i] for i in order])
+    test_acc = float((probs.argmax(axis=1) == truth).mean())
+    print("test accuracy %.3f (submission: %s)" % (test_acc, sub_path))
+    assert acc > 0.9 and test_acc > 0.8
+    print("ndsb1 ok")
+
+
+if __name__ == "__main__":
+    main()

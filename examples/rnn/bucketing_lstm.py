@@ -35,7 +35,8 @@ def sym_gen_factory(vocab, num_hidden, num_embed, num_layers):
         pred = mx.sym.Reshape(outputs, shape=(-1, num_hidden))
         pred = mx.sym.FullyConnected(data=pred, num_hidden=vocab, name="pred")
         label = mx.sym.Reshape(label, shape=(-1,))
-        pred = mx.sym.SoftmaxOutput(data=pred, label=label, name="softmax")
+        pred = mx.sym.SoftmaxOutput(data=pred, label=label, use_ignore=True,
+                                    ignore_label=-1, name="softmax")
         return pred, ("data",), ("softmax_label",)
     return sym_gen
 
@@ -58,14 +59,14 @@ def main():
     mod = mx.mod.BucketingModule(sym_gen,
                                  default_bucket_key=train.default_bucket_key,
                                  context=mx.current_context())
-    mod.fit(train, eval_metric=mx.metric.Perplexity(ignore_label=None),
+    mod.fit(train, eval_metric=mx.metric.Perplexity(ignore_label=-1),
             optimizer="adam",
             optimizer_params={"learning_rate": 0.01,
                               "rescale_grad": 1.0 / args.batch_size},
             initializer=mx.initializer.Xavier(),
             num_epoch=args.num_epochs)
     train.reset()
-    score = dict(mod.score(train, mx.metric.Perplexity(ignore_label=None)))
+    score = dict(mod.score(train, mx.metric.Perplexity(ignore_label=-1)))
     print("final train perplexity: %.3f" % list(score.values())[0])
 
 

@@ -55,6 +55,10 @@ EXAMPLES = [
     ("mxnet_adversarial_vae/avae.py", ["--iters", "400"]),
     ("module/seq_module.py", ["--num-epochs", "6"]),
     ("python-howto/howto.py", ["--num-epochs", "4"]),
+    ("rnn-time-major/rnn_cell_demo.py", ["--num-epochs", "4"]),
+    ("speech_recognition/deepspeech.py", ["--num-epochs", "24"]),
+    ("kaggle-ndsb1/train_dsb.py", ["--num-epochs", "8"]),
+    ("kaggle-ndsb2/train_heart.py", ["--num-epochs", "14"]),
 ]
 
 

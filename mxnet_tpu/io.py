@@ -476,21 +476,6 @@ class ImageRecordIter(DataIter):
         self.mean = np.array(means, np.float32).reshape(c, 1, 1)
         self.std = np.array(stds, np.float32).reshape(c, 1, 1)
         self.scale = scale
-        # fast path: native threaded loader (src/recordio.cc) when built and
-        # no python-side augmentation is requested (the native scan has no
-        # partition support — sharded reads take the python path)
-        self._native = None
-        if not rand_crop and not rand_mirror and self.label_width == 1 \
-                and num_parts == 1:
-            try:
-                from ._native import NativeRecordLoader
-                self._native = NativeRecordLoader(
-                    path_imgrec, int(batch_size), self.data_shape,
-                    num_threads=int(preprocess_threads),
-                    shuffle=bool(shuffle), seed=int(seed), scale=scale,
-                    mean=(mean_r, mean_g, mean_b), std=(std_r, std_g, std_b))
-            except Exception:
-                self._native = None
         self.rec = recordio.MXRecordIO(path_imgrec, "r")
         self._records = []
         while True:
@@ -501,14 +486,56 @@ class ImageRecordIter(DataIter):
         if num_parts > 1:   # dmlc InputSplit parity: per-worker shard
             self._records = self._records[part_index::num_parts]
         self._round_batch = bool(round_batch)
-        if self._round_batch and self._native is not None \
-                and len(self._records) % self.batch_size != 0:
-            # the native loader drops the partial tail (recordio.cc
-            # n_batches = n/batch); round_batch demands wrap-and-pad, so
-            # fall back to the python path to keep semantics build-independent
-            self._native = None
+        # fast path: native threaded loader (src/recordio.cc) when built and
+        # no python-side augmentation is requested. Three disqualifiers keep
+        # the semantics build-independent: sharded reads (no partition
+        # support in the native scan), encoded payloads (recordio.cc has no
+        # JPEG decode — it would read compressed bytes as pixels), and a
+        # partial tail under round_batch (the native loader drops it,
+        # python wraps-and-pads it).
+        self._native = None
+        if not rand_crop and not rand_mirror and self.label_width == 1 \
+                and num_parts == 1 and not self._records_encoded() \
+                and not (self._round_batch
+                         and len(self._records) % self.batch_size != 0):
+            try:
+                from ._native import NativeRecordLoader
+                self._native = NativeRecordLoader(
+                    path_imgrec, int(batch_size), self.data_shape,
+                    num_threads=int(preprocess_threads),
+                    shuffle=bool(shuffle), seed=int(seed), scale=scale,
+                    mean=(mean_r, mean_g, mean_b), std=(std_r, std_g, std_b))
+            except Exception:
+                self._native = None
         self._order = np.arange(len(self._records))
         self.cursor = -self.batch_size
+
+    def _open_encoded(self, img):
+        """Return a loaded PIL image if the payload is an encoded image,
+        else None. The single source of truth shared by the per-record
+        decoder and the native-loader eligibility scan: encoded means the
+        payload starts with an image magic AND PIL accepts it (raw pixels
+        that merely start with a magic byte pair fall back to raw)."""
+        if not (img[:2] in self._IMG_MAGIC or img[:3] in self._IMG_MAGIC):
+            return None
+        import io as _pyio
+        from PIL import Image
+        try:
+            pic = Image.open(_pyio.BytesIO(img))
+            pic.load()
+            return pic
+        except Exception:
+            return None
+
+    def _records_encoded(self):
+        """True if ANY payload is an encoded image rather than raw pixels
+        (records may mix; one encoded record rules out the native raw
+        loader). The magic sniff short-circuits almost every raw record;
+        PIL runs only on magic collisions."""
+        from . import recordio
+        return any(
+            self._open_encoded(recordio.unpack(r)[1]) is not None
+            for r in self._records)
 
     @property
     def provide_data(self):
@@ -557,20 +584,10 @@ class ImageRecordIter(DataIter):
         from . import recordio
         header, img = recordio.unpack(s)
         c, h, w = self.data_shape
-        if img[:2] in self._IMG_MAGIC or img[:3] in self._IMG_MAGIC:
-            # encoded payload (JPEG/PNG/...): PIL decode, then crop to
-            # data_shape — random when rand_crop, centred otherwise
-            # (parity: iter_image_recordio_2.cc's ImageAugmenter)
-            import io as _pyio
-            from PIL import Image
-            try:
-                pic = Image.open(_pyio.BytesIO(img))
-                pic.load()
-            except Exception:
-                # raw pixels that merely started with an image signature
-                pic = None
-        else:
-            pic = None
+        # encoded payload (JPEG/PNG/...): PIL decode, then crop to
+        # data_shape — random when rand_crop, centred otherwise
+        # (parity: iter_image_recordio_2.cc's ImageAugmenter)
+        pic = self._open_encoded(img)
         if pic is not None:
             if c == 1:
                 pic = pic.convert("L")

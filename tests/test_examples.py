@@ -99,6 +99,26 @@ def test_python_howto_example():
     assert "howto ok" in out
 
 
+def test_time_major_example():
+    out = _run("rnn-time-major/rnn_cell_demo.py", ["--num-epochs", "4"])
+    assert "time-major ok" in out
+
+
+def test_deepspeech_example():
+    out = _run("speech_recognition/deepspeech.py", ["--num-epochs", "24"])
+    assert "deepspeech ok" in out
+
+
+def test_ndsb1_pipeline_example():
+    out = _run("kaggle-ndsb1/train_dsb.py", ["--num-epochs", "8"])
+    assert "ndsb1 ok" in out
+
+
+def test_ndsb2_crps_example():
+    out = _run("kaggle-ndsb2/train_heart.py", ["--num-epochs", "14"])
+    assert "ndsb2 ok" in out
+
+
 @pytest.mark.slow
 def test_all_examples():
     """Full sweep; run explicitly with -m slow (CI nightly analogue)."""

@@ -376,3 +376,26 @@ def test_image_record_iter_raw_payload_with_magic_prefix(tmp_path):
     batch = it.next()
     np.testing.assert_allclose(batch.data[0].asnumpy()[0],
                                arr.astype(np.float32))
+
+
+def test_image_record_iter_jpeg_bypasses_native_loader(tmp_path):
+    """Encoded payloads must never hit the native raw-pixel loader, even
+    in its sweet spot (no augmentation, batch divides evenly)."""
+    import io as pyio
+    from PIL import Image
+    path = str(tmp_path / "enc.rec")
+    w = recordio.MXRecordIO(path, "w")
+    arr = np.full((8, 8, 3), 200, np.uint8)
+    for i in range(4):
+        buf = pyio.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG", quality=95)
+        w.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                              buf.getvalue()))
+    w.close()
+    it = ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8),
+                         batch_size=4)
+    assert it._native is None
+    batch = it.next()
+    # decoded pixels, not compressed bytes: a near-uniform 200 plane
+    got = batch.data[0].asnumpy()
+    assert abs(got.mean() - 200.0) < 5.0 and got.std() < 10.0
