@@ -388,12 +388,16 @@ def _last_json_line(text):
     return None
 
 
-def _run_phase(mode, timeout):
+def _run_phase(mode, timeout, env_extra=None):
     """Run one child phase; return (parsed_json_or_None, timed_out)."""
+    env = None
+    if env_extra:
+        env = dict(os.environ)
+        env.update(env_extra)
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), mode],
-            stdout=subprocess.PIPE, text=True, timeout=timeout)
+            stdout=subprocess.PIPE, text=True, timeout=timeout, env=env)
     except subprocess.TimeoutExpired as e:
         # the child prints its JSON the moment it has it — salvage it
         return _last_json_line(e.stdout), True
@@ -450,7 +454,9 @@ def supervise():
               file=sys.stderr, flush=True)
         if remaining() < RAW_MIN:
             break  # too late to measure; the diagnostic reports the probe
-        out, timed_out = _run_phase("--child", phase_budget(RAW_TIMEOUT))
+        out, timed_out = _run_phase(
+            "--child", phase_budget(RAW_TIMEOUT),
+            env_extra={"MXNET_FUSED_BN_ADD_RELU": "0"})  # pinned baseline
         if out and "value" in out:
             if timed_out:
                 out["salvaged"] = True
@@ -488,6 +494,21 @@ def supervise():
             out["module_fit_img_s"] = mod_out["module_fit_img_s"]
         else:
             print("bench: module phase yielded no number (raw result kept)",
+                  file=sys.stderr, flush=True)
+
+    # opportunistic A/B of the fused BN-tail kernel (PERF.md: the
+    # end-to-end number, not the isolated pass, decides the knob)
+    if (os.environ.get("MXTPU_BENCH_AB", "1") == "1"
+            and remaining() > RAW_MIN):
+        ab_out, ab_timed_out = _run_phase(
+            "--child", phase_budget(RAW_TIMEOUT),
+            env_extra={"MXNET_FUSED_BN_ADD_RELU": "1"})
+        if ab_out and "value" in ab_out:
+            out["img_s_fused_bn_tail"] = ab_out["value"]
+            if ab_timed_out:
+                out["fused_bn_tail_salvaged"] = True
+        else:
+            print("bench: fused-BN A/B yielded no number",
                   file=sys.stderr, flush=True)
 
     print(json.dumps(out))

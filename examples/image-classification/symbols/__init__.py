@@ -1,12 +1,21 @@
 """Symbol model zoo for the image-classification examples
 (mirrors reference example/image-classification/symbols/)."""
-from . import mlp, lenet, alexnet, resnet
+from . import (mlp, lenet, alexnet, resnet, vgg, googlenet, mobilenet,
+               resnext, inception_bn, inception_v3)
+
+_MODULES = {
+    "mlp": mlp,
+    "lenet": lenet,
+    "alexnet": alexnet,
+    "resnet": resnet,
+    "vgg": vgg,
+    "googlenet": googlenet,
+    "mobilenet": mobilenet,
+    "resnext": resnext,
+    "inception-bn": inception_bn,
+    "inception-v3": inception_v3,
+}
 
 
 def get_symbol(network, num_classes, **kwargs):
-    return {
-        "mlp": mlp,
-        "lenet": lenet,
-        "alexnet": alexnet,
-        "resnet": resnet,
-    }[network].get_symbol(num_classes=num_classes, **kwargs)
+    return _MODULES[network].get_symbol(num_classes=num_classes, **kwargs)

@@ -1,0 +1,52 @@
+"""GoogLeNet / Inception-v1 symbol (mirrors reference
+symbols/googlenet.py — the Szegedy et al. 2014 inception modules with
+1x1/3x3/5x5/pool-proj branches)."""
+import mxnet_tpu as mx
+
+
+def conv(data, num_filter, kernel, stride=(1, 1), pad=(0, 0), name=None):
+    c = mx.sym.Convolution(data, num_filter=num_filter, kernel=kernel,
+                           stride=stride, pad=pad, name="conv_%s" % name)
+    return mx.sym.Activation(c, act_type="relu", name="relu_%s" % name)
+
+
+def inception(data, f1, f3r, f3, f5r, f5, proj, name):
+    b1 = conv(data, f1, (1, 1), name="%s_1x1" % name)
+    b3 = conv(data, f3r, (1, 1), name="%s_3x3r" % name)
+    b3 = conv(b3, f3, (3, 3), pad=(1, 1), name="%s_3x3" % name)
+    b5 = conv(data, f5r, (1, 1), name="%s_5x5r" % name)
+    b5 = conv(b5, f5, (5, 5), pad=(2, 2), name="%s_5x5" % name)
+    bp = mx.sym.Pooling(data, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                        pool_type="max", name="%s_pool" % name)
+    bp = conv(bp, proj, (1, 1), name="%s_proj" % name)
+    return mx.sym.Concat(b1, b3, b5, bp, name="%s_concat" % name)
+
+
+def get_symbol(num_classes, **kwargs):
+    data = mx.sym.Variable("data")
+    net = conv(data, 64, (7, 7), stride=(2, 2), pad=(3, 3), name="stem1")
+    net = mx.sym.Pooling(net, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                         pool_type="max")
+    net = conv(net, 64, (1, 1), name="stem2r")
+    net = conv(net, 192, (3, 3), pad=(1, 1), name="stem2")
+    net = mx.sym.Pooling(net, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                         pool_type="max")
+    net = inception(net, 64, 96, 128, 16, 32, 32, "3a")
+    net = inception(net, 128, 128, 192, 32, 96, 64, "3b")
+    net = mx.sym.Pooling(net, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                         pool_type="max")
+    net = inception(net, 192, 96, 208, 16, 48, 64, "4a")
+    net = inception(net, 160, 112, 224, 24, 64, 64, "4b")
+    net = inception(net, 128, 128, 256, 24, 64, 64, "4c")
+    net = inception(net, 112, 144, 288, 32, 64, 64, "4d")
+    net = inception(net, 256, 160, 320, 32, 128, 128, "4e")
+    net = mx.sym.Pooling(net, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                         pool_type="max")
+    net = inception(net, 256, 160, 320, 32, 128, 128, "5a")
+    net = inception(net, 384, 192, 384, 48, 128, 128, "5b")
+    net = mx.sym.Pooling(net, kernel=(7, 7), stride=(1, 1),
+                         pool_type="avg", global_pool=True)
+    net = mx.sym.Flatten(net)
+    net = mx.sym.Dropout(net, p=0.4)
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")

@@ -1,0 +1,66 @@
+"""Inception-BN symbol (mirrors reference symbols/inception-bn.py —
+the BN-Inception network of Ioffe & Szegedy 2015: inception modules
+with two stacked 3x3s in place of the 5x5, BatchNorm after every
+conv, avg/max pool-through variants)."""
+import mxnet_tpu as mx
+
+
+def conv(data, num_filter, kernel, stride=(1, 1), pad=(0, 0), name=None):
+    c = mx.sym.Convolution(data, num_filter=num_filter, kernel=kernel,
+                           stride=stride, pad=pad, no_bias=True,
+                           name="%s_conv" % name)
+    c = mx.sym.BatchNorm(c, fix_gamma=False, name="%s_bn" % name)
+    return mx.sym.Activation(c, act_type="relu", name="%s_relu" % name)
+
+
+def inception(data, f1, f3r, f3, fd3r, fd3, proj, pool, name):
+    b1 = conv(data, f1, (1, 1), name="%s_1x1" % name)
+    b3 = conv(data, f3r, (1, 1), name="%s_3x3r" % name)
+    b3 = conv(b3, f3, (3, 3), pad=(1, 1), name="%s_3x3" % name)
+    bd = conv(data, fd3r, (1, 1), name="%s_d3x3r" % name)
+    bd = conv(bd, fd3, (3, 3), pad=(1, 1), name="%s_d3x3a" % name)
+    bd = conv(bd, fd3, (3, 3), pad=(1, 1), name="%s_d3x3b" % name)
+    bp = mx.sym.Pooling(data, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                        pool_type=pool, name="%s_pool" % name)
+    bp = conv(bp, proj, (1, 1), name="%s_proj" % name)
+    return mx.sym.Concat(b1, b3, bd, bp, name="%s_concat" % name)
+
+
+def inception_down(data, f3r, f3, fd3r, fd3, name):
+    """stride-2 module: no 1x1 branch, pool passes through un-projected"""
+    b3 = conv(data, f3r, (1, 1), name="%s_3x3r" % name)
+    b3 = conv(b3, f3, (3, 3), stride=(2, 2), pad=(1, 1),
+              name="%s_3x3" % name)
+    bd = conv(data, fd3r, (1, 1), name="%s_d3x3r" % name)
+    bd = conv(bd, fd3, (3, 3), pad=(1, 1), name="%s_d3x3a" % name)
+    bd = conv(bd, fd3, (3, 3), stride=(2, 2), pad=(1, 1),
+              name="%s_d3x3b" % name)
+    bp = mx.sym.Pooling(data, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                        pool_type="max", name="%s_pool" % name)
+    return mx.sym.Concat(b3, bd, bp, name="%s_concat" % name)
+
+
+def get_symbol(num_classes, **kwargs):
+    data = mx.sym.Variable("data")
+    net = conv(data, 64, (7, 7), stride=(2, 2), pad=(3, 3), name="stem1")
+    net = mx.sym.Pooling(net, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                         pool_type="max")
+    net = conv(net, 64, (1, 1), name="stem2r")
+    net = conv(net, 192, (3, 3), pad=(1, 1), name="stem2")
+    net = mx.sym.Pooling(net, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                         pool_type="max")
+    net = inception(net, 64, 64, 64, 64, 96, 32, "avg", "3a")
+    net = inception(net, 64, 64, 96, 64, 96, 64, "avg", "3b")
+    net = inception_down(net, 128, 160, 64, 96, "3c")
+    net = inception(net, 224, 64, 96, 96, 128, 128, "avg", "4a")
+    net = inception(net, 192, 96, 128, 96, 128, 128, "avg", "4b")
+    net = inception(net, 160, 128, 160, 128, 160, 128, "avg", "4c")
+    net = inception(net, 96, 128, 192, 160, 192, 128, "avg", "4d")
+    net = inception_down(net, 128, 192, 192, 256, "4e")
+    net = inception(net, 352, 192, 320, 160, 224, 128, "avg", "5a")
+    net = inception(net, 352, 192, 320, 192, 224, 128, "max", "5b")
+    net = mx.sym.Pooling(net, kernel=(7, 7), pool_type="avg",
+                         global_pool=True)
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")

@@ -1,0 +1,108 @@
+"""Inception-v3 symbol (mirrors reference symbols/inception-v3.py —
+Szegedy et al. 2015: factorised 7x7 -> 1x7/7x1 modules, grid-reduction
+modules, 299x299 input)."""
+import mxnet_tpu as mx
+
+
+def conv(data, num_filter, kernel, stride=(1, 1), pad=(0, 0), name=None):
+    c = mx.sym.Convolution(data, num_filter=num_filter, kernel=kernel,
+                           stride=stride, pad=pad, no_bias=True,
+                           name="%s_conv" % name)
+    c = mx.sym.BatchNorm(c, fix_gamma=True, eps=0.001, name="%s_bn" % name)
+    return mx.sym.Activation(c, act_type="relu", name="%s_relu" % name)
+
+
+def inc_a(data, proj, name):
+    b1 = conv(data, 64, (1, 1), name="%s_1x1" % name)
+    b5 = conv(data, 48, (1, 1), name="%s_5x5r" % name)
+    b5 = conv(b5, 64, (5, 5), pad=(2, 2), name="%s_5x5" % name)
+    b3 = conv(data, 64, (1, 1), name="%s_3x3r" % name)
+    b3 = conv(b3, 96, (3, 3), pad=(1, 1), name="%s_3x3a" % name)
+    b3 = conv(b3, 96, (3, 3), pad=(1, 1), name="%s_3x3b" % name)
+    bp = mx.sym.Pooling(data, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                        pool_type="avg")
+    bp = conv(bp, proj, (1, 1), name="%s_proj" % name)
+    return mx.sym.Concat(b1, b5, b3, bp)
+
+
+def red_a(data, name):
+    b3 = conv(data, 384, (3, 3), stride=(2, 2), name="%s_3x3" % name)
+    bd = conv(data, 64, (1, 1), name="%s_d3x3r" % name)
+    bd = conv(bd, 96, (3, 3), pad=(1, 1), name="%s_d3x3a" % name)
+    bd = conv(bd, 96, (3, 3), stride=(2, 2), name="%s_d3x3b" % name)
+    bp = mx.sym.Pooling(data, kernel=(3, 3), stride=(2, 2),
+                        pool_type="max")
+    return mx.sym.Concat(b3, bd, bp)
+
+
+def inc_b(data, mid, name):
+    b1 = conv(data, 192, (1, 1), name="%s_1x1" % name)
+    b7 = conv(data, mid, (1, 1), name="%s_7r" % name)
+    b7 = conv(b7, mid, (1, 7), pad=(0, 3), name="%s_1x7" % name)
+    b7 = conv(b7, 192, (7, 1), pad=(3, 0), name="%s_7x1" % name)
+    bd = conv(data, mid, (1, 1), name="%s_d7r" % name)
+    bd = conv(bd, mid, (7, 1), pad=(3, 0), name="%s_d7a" % name)
+    bd = conv(bd, mid, (1, 7), pad=(0, 3), name="%s_d7b" % name)
+    bd = conv(bd, mid, (7, 1), pad=(3, 0), name="%s_d7c" % name)
+    bd = conv(bd, 192, (1, 7), pad=(0, 3), name="%s_d7d" % name)
+    bp = mx.sym.Pooling(data, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                        pool_type="avg")
+    bp = conv(bp, 192, (1, 1), name="%s_proj" % name)
+    return mx.sym.Concat(b1, b7, bd, bp)
+
+
+def red_b(data, name):
+    b3 = conv(data, 192, (1, 1), name="%s_3r" % name)
+    b3 = conv(b3, 320, (3, 3), stride=(2, 2), name="%s_3x3" % name)
+    b7 = conv(data, 192, (1, 1), name="%s_7r" % name)
+    b7 = conv(b7, 192, (1, 7), pad=(0, 3), name="%s_1x7" % name)
+    b7 = conv(b7, 192, (7, 1), pad=(3, 0), name="%s_7x1" % name)
+    b7 = conv(b7, 192, (3, 3), stride=(2, 2), name="%s_3x3b" % name)
+    bp = mx.sym.Pooling(data, kernel=(3, 3), stride=(2, 2),
+                        pool_type="max")
+    return mx.sym.Concat(b3, b7, bp)
+
+
+def inc_c(data, name):
+    b1 = conv(data, 320, (1, 1), name="%s_1x1" % name)
+    b3 = conv(data, 384, (1, 1), name="%s_3r" % name)
+    b3a = conv(b3, 384, (1, 3), pad=(0, 1), name="%s_1x3" % name)
+    b3b = conv(b3, 384, (3, 1), pad=(1, 0), name="%s_3x1" % name)
+    bd = conv(data, 448, (1, 1), name="%s_dr" % name)
+    bd = conv(bd, 384, (3, 3), pad=(1, 1), name="%s_d3" % name)
+    bda = conv(bd, 384, (1, 3), pad=(0, 1), name="%s_d1x3" % name)
+    bdb = conv(bd, 384, (3, 1), pad=(1, 0), name="%s_d3x1" % name)
+    bp = mx.sym.Pooling(data, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                        pool_type="avg")
+    bp = conv(bp, 192, (1, 1), name="%s_proj" % name)
+    return mx.sym.Concat(b1, b3a, b3b, bda, bdb, bp)
+
+
+def get_symbol(num_classes, **kwargs):
+    data = mx.sym.Variable("data")
+    net = conv(data, 32, (3, 3), stride=(2, 2), name="stem1")
+    net = conv(net, 32, (3, 3), name="stem2")
+    net = conv(net, 64, (3, 3), pad=(1, 1), name="stem3")
+    net = mx.sym.Pooling(net, kernel=(3, 3), stride=(2, 2),
+                         pool_type="max")
+    net = conv(net, 80, (1, 1), name="stem4")
+    net = conv(net, 192, (3, 3), name="stem5")
+    net = mx.sym.Pooling(net, kernel=(3, 3), stride=(2, 2),
+                         pool_type="max")
+    net = inc_a(net, 32, "mixed0")
+    net = inc_a(net, 64, "mixed1")
+    net = inc_a(net, 64, "mixed2")
+    net = red_a(net, "mixed3")
+    net = inc_b(net, 128, "mixed4")
+    net = inc_b(net, 160, "mixed5")
+    net = inc_b(net, 160, "mixed6")
+    net = inc_b(net, 192, "mixed7")
+    net = red_b(net, "mixed8")
+    net = inc_c(net, "mixed9")
+    net = inc_c(net, "mixed10")
+    net = mx.sym.Pooling(net, kernel=(8, 8), pool_type="avg",
+                         global_pool=True)
+    net = mx.sym.Flatten(net)
+    net = mx.sym.Dropout(net, p=0.5)
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")

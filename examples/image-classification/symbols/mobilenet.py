@@ -1,0 +1,38 @@
+"""MobileNet-v1 symbol (mirrors reference symbols/mobilenet.py —
+depthwise-separable conv stacks via grouped Convolution, width
+multiplier via the alpha kwarg)."""
+import mxnet_tpu as mx
+
+
+def conv_bn(data, num_filter, kernel, stride=(1, 1), pad=(0, 0),
+            num_group=1, name=None):
+    c = mx.sym.Convolution(data, num_filter=num_filter, kernel=kernel,
+                           stride=stride, pad=pad, num_group=num_group,
+                           no_bias=True, name="%s_conv" % name)
+    c = mx.sym.BatchNorm(c, fix_gamma=False, name="%s_bn" % name)
+    return mx.sym.Activation(c, act_type="relu", name="%s_relu" % name)
+
+
+def dw_sep(data, in_ch, out_ch, stride, name):
+    """depthwise 3x3 (groups == channels) then pointwise 1x1"""
+    dw = conv_bn(data, in_ch, (3, 3), stride=stride, pad=(1, 1),
+                 num_group=in_ch, name="%s_dw" % name)
+    return conv_bn(dw, out_ch, (1, 1), name="%s_pw" % name)
+
+
+def get_symbol(num_classes, alpha=1.0, **kwargs):
+    def ch(n):
+        return max(8, int(n * alpha))
+    data = mx.sym.Variable("data")
+    net = conv_bn(data, ch(32), (3, 3), stride=(2, 2), pad=(1, 1),
+                  name="stem")
+    plan = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+            (256, 256, 1), (256, 512, 2)] \
+        + [(512, 512, 1)] * 5 + [(512, 1024, 2), (1024, 1024, 1)]
+    for i, (cin, cout, s) in enumerate(plan):
+        net = dw_sep(net, ch(cin), ch(cout), (s, s), "sep%d" % i)
+    net = mx.sym.Pooling(net, kernel=(7, 7), pool_type="avg",
+                         global_pool=True)
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")

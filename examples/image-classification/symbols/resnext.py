@@ -1,0 +1,64 @@
+"""ResNeXt symbol (mirrors reference symbols/resnext.py — aggregated
+residual transforms: the bottleneck's 3x3 runs as a grouped conv with
+`num_group` cardinality)."""
+import mxnet_tpu as mx
+
+
+def resnext_unit(data, num_filter, stride, dim_match, num_group, name):
+    mid = num_filter // 2
+    body = mx.sym.Convolution(data, num_filter=mid, kernel=(1, 1),
+                              no_bias=True, name=name + "_conv1")
+    body = mx.sym.BatchNorm(body, fix_gamma=False, eps=2e-5,
+                            name=name + "_bn1")
+    body = mx.sym.Activation(body, act_type="relu")
+    body = mx.sym.Convolution(body, num_filter=mid, kernel=(3, 3),
+                              stride=stride, pad=(1, 1),
+                              num_group=num_group, no_bias=True,
+                              name=name + "_conv2")
+    body = mx.sym.BatchNorm(body, fix_gamma=False, eps=2e-5,
+                            name=name + "_bn2")
+    body = mx.sym.Activation(body, act_type="relu")
+    body = mx.sym.Convolution(body, num_filter=num_filter, kernel=(1, 1),
+                              no_bias=True, name=name + "_conv3")
+    body = mx.sym.BatchNorm(body, fix_gamma=False, eps=2e-5,
+                            name=name + "_bn3")
+    if dim_match:
+        shortcut = data
+    else:
+        shortcut = mx.sym.Convolution(data, num_filter=num_filter,
+                                      kernel=(1, 1), stride=stride,
+                                      no_bias=True, name=name + "_sc")
+        shortcut = mx.sym.BatchNorm(shortcut, fix_gamma=False, eps=2e-5,
+                                    name=name + "_sc_bn")
+    return mx.sym.Activation(body + shortcut, act_type="relu")
+
+
+# depth -> units per stage (same table as resnet bottleneck depths)
+UNITS = {50: [3, 4, 6, 3], 101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
+
+
+def get_symbol(num_classes, num_layers=50, num_group=32, **kwargs):
+    if num_layers not in UNITS:
+        raise ValueError("resnext depth must be one of %s" % list(UNITS))
+    units = UNITS[num_layers]
+    filters = [256, 512, 1024, 2048]
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, num_filter=64, kernel=(7, 7),
+                             stride=(2, 2), pad=(3, 3), no_bias=True,
+                             name="conv0")
+    net = mx.sym.BatchNorm(net, fix_gamma=False, eps=2e-5, name="bn0")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                         pool_type="max")
+    for stage, (n, f) in enumerate(zip(units, filters)):
+        stride = (1, 1) if stage == 0 else (2, 2)
+        net = resnext_unit(net, f, stride, False, num_group,
+                           "stage%d_unit0" % stage)
+        for i in range(1, n):
+            net = resnext_unit(net, f, (1, 1), True, num_group,
+                               "stage%d_unit%d" % (stage, i))
+    net = mx.sym.Pooling(net, kernel=(7, 7), pool_type="avg",
+                         global_pool=True)
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")

@@ -1,0 +1,37 @@
+"""VGG symbol (mirrors reference symbols/vgg.py — stacked 3x3 conv
+blocks from the Simonyan & Zisserman configs, optional BN)."""
+import mxnet_tpu as mx
+
+# layers-per-stage for each supported depth (VGG paper table 1)
+CONFIGS = {
+    11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
+    13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
+    16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
+    19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512]),
+}
+
+
+def get_symbol(num_classes, num_layers=16, batch_norm=False, **kwargs):
+    if num_layers not in CONFIGS:
+        raise ValueError("vgg depth must be one of %s" % list(CONFIGS))
+    layers, filters = CONFIGS[num_layers]
+    net = mx.sym.Variable("data")
+    for stage, (n, f) in enumerate(zip(layers, filters)):
+        for i in range(n):
+            net = mx.sym.Convolution(net, kernel=(3, 3), pad=(1, 1),
+                                     num_filter=f,
+                                     name="conv%d_%d" % (stage + 1, i + 1))
+            if batch_norm:
+                net = mx.sym.BatchNorm(net,
+                                       name="bn%d_%d" % (stage + 1, i + 1))
+            net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2),
+                             stride=(2, 2), name="pool%d" % (stage + 1))
+    net = mx.sym.Flatten(net)
+    for i, hidden in enumerate((4096, 4096)):
+        net = mx.sym.FullyConnected(net, num_hidden=hidden,
+                                    name="fc%d" % (6 + i))
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.Dropout(net, p=0.5)
+    net = mx.sym.FullyConnected(net, num_hidden=num_classes, name="fc8")
+    return mx.sym.SoftmaxOutput(net, name="softmax")

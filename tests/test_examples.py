@@ -172,3 +172,46 @@ def test_predict_cpp_example(tmp_path):
     assert "PREDICT_OK classes=5" in p.stdout, p.stdout
     psum = float(p.stdout.split("prob_sum=")[1].split()[0])
     assert abs(psum - 1.0) < 1e-3  # softmax over 5 classes, batch 1
+
+
+def test_symbol_zoo_builds_and_infers():
+    """Every symbols/ network builds, shape-infers to (N, classes), and
+    the light ones run a real forward (reference benchmark_score nets)."""
+    import numpy as np
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "examples", "image-classification"))
+    import symbols
+    import mxnet_tpu as mx
+
+    cases = {
+        "mlp": (28, {}),
+        "lenet": (28, {}),
+        "alexnet": (224, {}),
+        "resnet": (224, {"num_layers": 50}),
+        "vgg": (224, {"num_layers": 16}),
+        "googlenet": (224, {}),
+        "mobilenet": (224, {}),
+        "resnext": (224, {"num_layers": 50}),
+        "inception-bn": (224, {}),
+        "inception-v3": (299, {}),
+    }
+    for net, (size, kwargs) in cases.items():
+        sym = symbols.get_symbol(net, 10, **kwargs)
+        shape = (2, 784) if net == "mlp" else (2, 3, size, size)
+        _, out_shapes, _ = sym.infer_shape(data=shape)
+        assert out_shapes[0] == (2, 10), (net, out_shapes)
+
+    # forward the cheap ones for real
+    for net in ("googlenet", "mobilenet"):
+        sym = symbols.get_symbol(net, 10)
+        mod = mx.mod.Module(sym, label_names=["softmax_label"],
+                            context=mx.cpu())
+        mod.bind(data_shapes=[("data", (1, 3, 224, 224))],
+                 for_training=False)
+        mod.init_params(mx.initializer.Xavier())
+        from mxnet_tpu.io import DataBatch
+        mod.forward(DataBatch(
+            data=[mx.nd.array(np.random.rand(1, 3, 224, 224))]),
+            is_train=False)
+        probs = mod.get_outputs()[0].asnumpy()
+        np.testing.assert_allclose(probs.sum(), 1.0, rtol=1e-4)

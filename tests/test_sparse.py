@@ -587,3 +587,83 @@ def test_native_kernels_no_densify_at_scale():
     np.testing.assert_allclose(prod.data.asnumpy(), vals * vals * 2.0,
                                rtol=1e-6)
     np.testing.assert_allclose(sq.data.asnumpy(), vals * vals, rtol=1e-6)
+
+
+def _random_dense(rs, shape, density):
+    d = rs.randn(*shape).astype(np.float32)
+    mask = rs.rand(*shape) < density
+    return d * mask
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_csr_kernels_randomised_midscale(seed):
+    """Property check at awkward (non-aligned) shapes: the native csr
+    kernel chain against numpy oracles on random 513x257 operands."""
+    rs = np.random.RandomState(seed)
+    shape = (513, 257)
+    a = _random_dense(rs, shape, 0.05)
+    b = _random_dense(rs, shape, 0.05)
+    ca = sp.cast_storage(mx.nd.array(a), "csr")
+    cb = sp.cast_storage(mx.nd.array(b), "csr")
+
+    # structural round trip
+    np.testing.assert_allclose(ca.asnumpy(), a, rtol=1e-6)
+    assert int(ca.indptr.asnumpy()[-1]) == int((a != 0).sum())
+
+    # csr + csr (native COO-merge path) stays csr and matches numpy
+    s = mx.nd.elemwise_add(ca, cb)
+    assert s.stype == "csr"
+    np.testing.assert_allclose(s.asnumpy(), a + b, rtol=1e-5)
+    m = mx.nd.elemwise_mul(ca, cb)
+    assert m.stype == "csr"
+    np.testing.assert_allclose(m.asnumpy(), a * b, rtol=1e-5)
+
+    # csr . dense and csr^T . dense with gradient through the dense rhs
+    w = rs.randn(shape[1], 31).astype(np.float32)
+    out = mx.nd.dot(ca, mx.nd.array(w))
+    np.testing.assert_allclose(out.asnumpy(), a @ w, rtol=1e-4, atol=1e-4)
+    wt = rs.randn(shape[0], 17).astype(np.float32)
+    outt = mx.nd.dot(ca, mx.nd.array(wt), transpose_a=True)
+    np.testing.assert_allclose(outt.asnumpy(), a.T @ wt, rtol=1e-4,
+                               atol=1e-4)
+
+    # sparse<->sparse casts agree with the dense path
+    rsp = ca.tostype("row_sparse")
+    np.testing.assert_allclose(rsp.asnumpy(), a, rtol=1e-6)
+    back = rsp.tostype("csr")
+    np.testing.assert_allclose(back.asnumpy(), a, rtol=1e-6)
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_rsp_kernels_randomised_midscale(seed):
+    rs = np.random.RandomState(seed)
+    nrows, ncols, k = 997, 129, 41
+    rows = np.sort(rs.choice(nrows, size=k, replace=False)).astype(np.int64)
+    va = rs.randn(k, ncols).astype(np.float32)
+    vb = rs.randn(k, ncols).astype(np.float32)
+    ga = sp.row_sparse_array((va, rows), shape=(nrows, ncols))
+    gb = sp.row_sparse_array((vb, rows), shape=(nrows, ncols))
+    dense_a = np.zeros((nrows, ncols), np.float32); dense_a[rows] = va
+    dense_b = np.zeros((nrows, ncols), np.float32); dense_b[rows] = vb
+
+    for op, ref in [(mx.nd.elemwise_add, dense_a + dense_b),
+                    (mx.nd.elemwise_sub, dense_a - dense_b),
+                    (mx.nd.elemwise_mul, dense_a * dense_b)]:
+        got = op(ga, gb)
+        assert got.stype == "row_sparse"
+        np.testing.assert_allclose(got.asnumpy(), ref, rtol=1e-5)
+
+    sq = mx.nd.square(ga)
+    assert sq.stype == "row_sparse"
+    np.testing.assert_allclose(sq.asnumpy(), dense_a ** 2, rtol=1e-5)
+    ssum = sp.square_sum(ga, axis=1, keepdims=True)
+    np.testing.assert_allclose(
+        ssum.asnumpy(), (dense_a ** 2).sum(axis=1, keepdims=True),
+        rtol=1e-4)
+
+    # retain an awkward subset, compare against dense masking
+    keep = np.sort(rs.choice(nrows, size=211, replace=False))
+    kept = ga.retain(keep)
+    dense_keep = np.zeros_like(dense_a)
+    dense_keep[keep] = dense_a[keep]
+    np.testing.assert_allclose(kept.asnumpy(), dense_keep, rtol=1e-6)
