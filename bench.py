@@ -237,11 +237,16 @@ def child():
         master, mom, pbf, loss = run(master, mom, pbf, x, y, rng)
     float(loss)
 
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        master, mom, pbf, loss = run(master, mom, pbf, x, y, rng)
-    float(loss)
-    dt = time.perf_counter() - t0
+    import contextlib
+    trace_dir = os.environ.get("MXTPU_BENCH_TRACE", "")
+    tracer = (jax.profiler.trace(trace_dir) if trace_dir  # mfu_capture lane
+              else contextlib.nullcontext())
+    with tracer:
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            master, mom, pbf, loss = run(master, mom, pbf, x, y, rng)
+        float(loss)
+        dt = time.perf_counter() - t0
 
     img_s = BATCH * ITERS / dt
     out = {

@@ -13,11 +13,12 @@ TOOLS = os.path.join(REPO, "tools")
 
 
 def _run(cmd, **kw):
-    env = dict(os.environ)
+    env = dict(kw.pop("env", None) or os.environ)
     env["MXNET_TPU_FORCE_CPU"] = "1"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    kw.setdefault("timeout", 300)
     return subprocess.run([sys.executable] + cmd, capture_output=True,
-                          text=True, timeout=300, env=env, **kw)
+                          text=True, env=env, **kw)
 
 
 def test_im2rec_roundtrip(tmp_path):
@@ -291,3 +292,17 @@ def test_launch_push_discipline_mismatch_fails_loudly(tmp_path):
     combined = p.stdout + p.stderr
     assert "discipline violated" in combined, combined
     assert "UNREACHABLE" not in p.stdout
+
+
+def test_mfu_capture_smoke():
+    """The fresh-capture roofline tool: traced bench child on CPU, xplane
+    parsed, category shares extracted (the on-chip run reuses this path)."""
+    import json
+    p = _run([os.path.join(TOOLS, "mfu_capture.py"), "--timeout", "420"],
+             env={**os.environ, "MXTPU_BENCH_SMOKE": "1"}, timeout=500)
+    assert p.returncode == 0, p.stderr[-1500:]
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    assert out["hlo_rows"] > 100
+    shares = out["self_time_share"]
+    assert "convolution fusions" in shares
+    assert abs(sum(shares.values()) - 1.0) < 0.01
