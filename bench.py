@@ -146,7 +146,11 @@ def child():
         # (mxnet_tpu/layout.py; effect quantified in PERF.md).
         mx.layout.set_default_layout("NHWC")
         np.random.seed(0)
-        net = vision.resnet50_v1()
+        # MXTPU_BENCH_NET picks the model-zoo family member (the driver
+        # path always measures resnet50_v1, the baseline of record; the
+        # reference also publishes 18/34/101/152 numbers — BASELINE.md)
+        net_name = os.environ.get("MXTPU_BENCH_NET", "resnet50_v1")
+        net = getattr(vision, net_name)()
         net.initialize(mx.initializer.Xavier())
         net(mx.nd.ones((1, 32, 32, 3)))  # complete deferred shapes (on CPU)
         fn, raw_params, param_names = make_pure_fn(net, train=True)
@@ -250,7 +254,7 @@ def child():
 
     img_s = BATCH * ITERS / dt
     out = {
-        "metric": "resnet50_train_throughput",
+        "metric": "%s_train_throughput" % net_name.replace("_v1", ""),
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),

@@ -253,10 +253,15 @@ class LSTMBias(Initializer):
 
 @register
 class Load(Initializer):
-    """Init from a dict of arrays, fall back to default_init."""
+    """Init from a .params file or dict of arrays, fall back to
+    default_init (parity: initializer.Load, which accepts both —
+    reference initializer.py:303-306)."""
 
     def __init__(self, param, default_init=None, verbose=False):
         super().__init__()
+        if isinstance(param, str):
+            from .ndarray import load as _nd_load
+            param = _nd_load(param)
         self.param = {k[4:] if k.startswith(("arg:", "aux:")) else k: v
                       for k, v in param.items()}
         self.default_init = default_init

@@ -60,7 +60,10 @@ def print_summary(symbol, shape=None, line_length=120, positions=None):
         params = 0
         for in_idx, *_ in node["inputs"]:
             in_node = nodes[in_idx]
-            if in_node["op"] == "null" and in_node["name"].startswith(name):
+            if in_node["op"] == "null" and in_node["name"].startswith(name) \
+                    and in_node["name"].endswith(("weight", "bias",
+                                                  "gamma", "beta",
+                                                  "parameters")):
                 s = shape_dict.get(in_node["name"], None)
                 if s:
                     params += int(np.prod(s))

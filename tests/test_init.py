@@ -1,0 +1,126 @@
+"""Initializer suite (parity model: reference
+tests/python/unittest/test_init.py — default_init, variance of the
+scaled families, structural initializers, aux handling)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import initializer as init
+
+
+def _materialise(initializer, name, shape):
+    arr = mx.nd.zeros(shape)
+    initializer(init.InitDesc(name), arr)
+    return arr.asnumpy()
+
+
+def test_constant_families():
+    assert (_materialise(init.Zero(), "w_weight", (4, 3)) == 0).all()
+    assert (_materialise(init.One(), "w_weight", (4, 3)) == 1).all()
+    c = _materialise(init.Constant(2.5), "w_weight", (4, 3))
+    np.testing.assert_allclose(c, 2.5)
+
+
+def test_uniform_normal_ranges():
+    mx.random.seed(0)
+    u = _materialise(init.Uniform(0.1), "w_weight", (200, 50))
+    assert abs(u.mean()) < 0.01 and u.min() >= -0.1 and u.max() <= 0.1
+    n = _materialise(init.Normal(0.5), "w_weight", (200, 50))
+    assert abs(n.std() - 0.5) < 0.02
+
+
+@pytest.mark.parametrize("rnd_type,factor,magnitude", [
+    ("uniform", "avg", 3.0),
+    ("gaussian", "in", 2.0),
+    ("uniform", "out", 1.0),
+])
+def test_xavier_variance(rnd_type, factor, magnitude):
+    shape = (256, 128)
+    w = _materialise(init.Xavier(rnd_type=rnd_type, factor_type=factor,
+                                 magnitude=magnitude), "w_weight", shape)
+    fan_in, fan_out = shape[1], shape[0]
+    fan = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in,
+           "out": fan_out}[factor]
+    # scale = sqrt(magnitude/fan); uniform(-s, s) has var s^2/3,
+    # normal(0, s) has var s^2 (reference initializer.py Xavier)
+    expect_var = magnitude / fan / (3.0 if rnd_type == "uniform" else 1.0)
+    assert abs(w.var() - expect_var) / expect_var < 0.15
+
+
+def test_msra_prelu_is_xavier_gaussian_avg():
+    w = _materialise(init.MSRAPrelu(slope=0.0), "w_weight", (256, 128))
+    # magnitude 2/(1+slope^2)=2, default factor avg -> var = 2/192
+    expect = 2.0 / 192
+    assert abs(w.var() - expect) / expect < 0.15
+
+
+def test_orthogonal_columns():
+    mx.random.seed(3)
+    w = _materialise(init.Orthogonal(scale=1.0), "w_weight", (64, 32))
+    gram = w.T @ w
+    np.testing.assert_allclose(gram, np.eye(32), atol=1e-4)
+
+
+def test_bilinear_upsampling_kernel():
+    w = _materialise(init.Bilinear(), "up_weight", (1, 1, 4, 4))
+    k = w[0, 0]
+    # symmetric, peak in the centre block, classic bilinear taps
+    np.testing.assert_allclose(k, k[::-1, ::-1])
+    np.testing.assert_allclose(k[1, 1], 0.5625, rtol=1e-6)
+
+
+def test_lstmbias_sets_forget_gate():
+    b = _materialise(init.LSTMBias(forget_bias=1.0), "lstm_bias", (32,))
+    H = 8  # 4 gates x 8
+    np.testing.assert_allclose(b[H:2 * H], 1.0)   # forget gate chunk
+    np.testing.assert_allclose(b[:H], 0.0)
+
+
+def test_name_dispatch_defaults():
+    """Initializer base dispatches by suffix: bias/gamma/beta/moving_*."""
+    ini = init.Xavier()
+    assert (_materialise(ini, "fc_bias", (16,)) == 0).all()
+    assert (_materialise(ini, "bn_gamma", (16,)) == 1).all()
+    assert (_materialise(ini, "bn_beta", (16,)) == 0).all()
+    assert (_materialise(ini, "bn_moving_var", (16,)) == 1).all()
+    assert (_materialise(ini, "bn_moving_mean", (16,)) == 0).all()
+
+
+def test_mixed_initializer_pattern_routing():
+    # weight names, because suffix dispatch sends *_bias to _init_bias
+    # (zeros) regardless of the routed initializer — reference semantics
+    mixed = init.Mixed(["embed.*", ".*"], [init.Constant(3.0),
+                                           init.Zero()])
+    assert (_materialise(mixed, "embed_weight", (8, 4)) == 3.0).all()
+    assert (_materialise(mixed, "fc_weight", (8, 8)) == 0.0).all()
+    with pytest.raises(Exception):
+        init.Mixed(["embed.*"], [init.Constant(3.0)])(
+            init.InitDesc("no_match_weight"), mx.nd.zeros((2,)))
+
+
+def test_load_initializer_with_default(tmp_path):
+    params = {"arg:fc_weight": mx.nd.array(np.full((4, 4), 7.0,
+                                                   np.float32))}
+    path = str(tmp_path / "p.params")
+    mx.nd.save(path, {k: v for k, v in params.items()})
+    ld = init.Load(path, default_init=init.Zero(), verbose=False)
+    got = _materialise(ld, "fc_weight", (4, 4))
+    np.testing.assert_allclose(got, 7.0)
+    other = _materialise(ld, "other_weight", (2, 2))
+    np.testing.assert_allclose(other, 0.0)
+
+
+def test_init_through_module_respects_families():
+    """End to end: Module.init_params applies the name dispatch."""
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc")
+    net = mx.sym.BatchNorm(net, name="bn")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 6))])
+    mod.init_params(init.Xavier())
+    args, aux = mod.get_params()
+    assert (args["fc_bias"].asnumpy() == 0).all()
+    assert (args["bn_gamma"].asnumpy() == 1).all()
+    assert (aux["bn_moving_var"].asnumpy() == 1).all()
+    assert args["fc_weight"].asnumpy().std() > 0

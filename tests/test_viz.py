@@ -1,0 +1,45 @@
+"""Visualization suite (parity model: reference
+tests/python/unittest/test_viz.py — print_summary over an MLP/conv net,
+plot_network gated on graphviz)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _net():
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4,
+                             pad=(1, 1), name="conv")
+    net = mx.sym.Activation(net, act_type="relu", name="relu")
+    net = mx.sym.Flatten(net, name="flatten")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_print_summary_param_counts(capsys):
+    mx.viz.print_summary(_net(), shape={"data": (1, 3, 8, 8)})
+    out = capsys.readouterr().out
+    assert "conv" in out and "fc" in out
+    # conv: 3*3*3*4 + 4 = 112; fc: 4*8*8*10 + 10 = 2570
+    assert "112" in out
+    assert "2570" in out
+    total = [ln for ln in out.splitlines() if "Total params" in ln]
+    assert total and "2682" in total[0]
+
+
+def test_print_summary_without_shape(capsys):
+    mx.viz.print_summary(_net())
+    out = capsys.readouterr().out
+    assert "softmax" in out
+
+
+def test_plot_network_nodes():
+    try:
+        import graphviz  # noqa: F401
+    except ImportError:
+        pytest.skip("graphviz not installed")
+    dot = mx.viz.plot_network(_net(), shape={"data": (1, 3, 8, 8)})
+    src = dot.source
+    for node in ("conv", "fc", "softmax"):
+        assert node in src

@@ -31,8 +31,8 @@ sys.path.insert(0, REPO)
 # HBM bandwidth by device kind (public spec sheets), for the
 # FLOP/byte break-even in the roofline re-derivation
 HBM_BW = [("v6", 1.6e12), ("trillium", 1.6e12), ("v5p", 2.77e12),
-          ("v5 lite", 8.19e11), ("v5e", 8.19e11), ("v4", 1.2e12),
-          ("v3", 9.0e11), ("v2", 7.0e11)]
+          ("v5 lite", 8.19e11), ("v5e", 8.19e11), ("v5litepod", 8.19e11),
+          ("v4", 1.2e12), ("v3", 9.0e11), ("v2", 7.0e11)]
 
 
 def hbm_bw_for(kind):
