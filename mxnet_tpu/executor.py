@@ -465,7 +465,7 @@ class Executor:
 
     def __init__(self, symbol, ctx, arg_arrays, grad_arrays, grad_req,
                  aux_arrays, program=None, group2ctx=None,
-                 owns_arrays=False):
+                 owns_arrays=False, out_shapes=None):
         from .ndarray.ndarray import NDArray
         self._symbol = symbol
         self._ctx = ctx or current_context()
@@ -520,6 +520,23 @@ class Executor:
         self._grad_req = {n: grad_req.get(n, "null") for n in self._arg_names}
         self.outputs = []
         self._monitor_callback = None
+        self._monitor_all = False
+        # reference parity: outputs are allocated (zero) NDArrays from
+        # bind time, readable before the first forward. out_shapes may be
+        # threaded in by the bind paths that already ran shape inference.
+        try:
+            if out_shapes is None:
+                shapes = {n: a.shape for n, a in
+                          zip(self._arg_names, self.arg_arrays)
+                          if a is not None}
+                _, out_shapes, _ = self._symbol.infer_shape_partial(**shapes)
+            from .ndarray import zeros as _zeros
+            self.outputs = [_zeros(s, ctx=self._ctx) if s is not None
+                            else None for s in out_shapes]
+            if any(o is None for o in self.outputs):
+                self.outputs = []    # unknown head shape: defer to forward
+        except Exception:
+            pass
 
     # -- dict views --------------------------------------------------------
     @property
@@ -543,7 +560,7 @@ class Executor:
     def _simple_bind(symbol, ctx, grad_req, type_dict, shape_kwargs,
                      group2ctx=None):
         from .ndarray import zeros
-        (arg_shapes, _, aux_shapes, arg_types, _, aux_types) = \
+        (arg_shapes, out_shapes, aux_shapes, arg_types, _, aux_types) = \
             infer_graph_attrs(symbol, shape_kwargs, known_types=type_dict)
         arg_names = symbol.list_arguments()
         arg_arrays = [zeros(s, ctx=ctx, dtype=t if t is not None else "float32")
@@ -562,7 +579,8 @@ class Executor:
         aux_arrays = [zeros(s, ctx=ctx, dtype=t if t is not None else "float32")
                       for s, t in zip(aux_shapes, aux_types)]
         return Executor(symbol, ctx, arg_arrays, grad_arrays, reqs,
-                        aux_arrays, group2ctx=group2ctx, owns_arrays=True)
+                        aux_arrays, group2ctx=group2ctx, owns_arrays=True,
+                        out_shapes=out_shapes)
 
     @staticmethod
     def _bind(symbol, ctx, args, args_grad, grad_req, aux_states,
@@ -661,9 +679,47 @@ class Executor:
         self.outputs = [_wrap(o, self._out_ctx(i))
                         for i, o in enumerate(outs)]
         if self._monitor_callback is not None:
+            self._emit_monitor(is_train)
+        return self.outputs
+
+    def _emit_monitor(self, is_train):
+        """Feed the monitor callback EVERY op's output, not just the graph
+        heads (parity: the engine-level monitor tap — reference
+        graph_executor.cc monitor_callback_ fires per op). Runs a cached
+        internals program; monitoring is a debug lane, so the extra
+        compile/execute cost is acceptable."""
+        from .ndarray.ndarray import _wrap
+        if self._prog.node_devices:
+            # grouped (group2ctx) executors: the internals program has no
+            # device map — emit the graph heads only
             for name, arr in zip(self._symbol.list_outputs(), self.outputs):
                 self._monitor_callback(name, arr)
-        return self.outputs
+            return
+        if getattr(self, "_mon_prog", None) is None:
+            from .symbol.symbol import Group
+            internals = self._symbol.get_internals()
+            # op outputs only (incl. multi-output "%s_output%d" names) —
+            # variable echoes aren't computed nodes
+            var_names = set(internals.list_arguments()) | \
+                set(internals.list_auxiliary_states())
+            self._mon_names = [n for n in internals.list_outputs()
+                               if n not in var_names]
+            self._mon_prog = _GraphProgram(
+                Group([internals[n] for n in self._mon_names]))
+        fn = self._mon_prog.forward_fn(bool(is_train))
+        args = {n: self.arg_dict[n]._data for n in self._mon_prog.arg_names}
+        aux = {n: self.aux_dict[n]._data for n in self._mon_prog.aux_names}
+        key = getattr(self, "_last_key", None)
+        if key is None:
+            key = self._step_key()
+        outs, _ = fn(args, aux, key)
+        for name, o in zip(self._mon_names, outs):
+            self._monitor_callback(name, _wrap(o, self._ctx))
+        if self._monitor_all:        # inputs/params too (reference
+            for name, arr in self.arg_dict.items():   # monitor_all=True)
+                self._monitor_callback(name, arr)
+            for name, arr in self.aux_dict.items():
+                self._monitor_callback(name, arr)
 
     def backward(self, out_grads=None, is_train=True):
         """Run backward (parity: executor.py backward:154). Recomputes the
@@ -780,6 +836,7 @@ class Executor:
 
     def set_monitor_callback(self, callback, monitor_all=False):
         self._monitor_callback = callback
+        self._monitor_all = bool(monitor_all)
 
     def debug_str(self):
         lines = ["Symbol outputs: %s" % self._symbol.list_outputs()]

@@ -87,16 +87,22 @@ def test_smoke_end_to_end():
     assert out is not None and "value" in out and out["unit"] == "img/s"
 
 
-def _patched_supervise(monkeypatch, phases, deadline=30.0, smoke=False):
+def _patched_supervise(monkeypatch, phases, deadline=30.0, smoke=False,
+                       ab=False):
     """Run supervise() with _run_phase replaced by a scripted stub.
     `phases` maps mode -> callable returning (parsed, timed_out); the
     stub records the call sequence. Returns (rc, calls, stdout_json)."""
     calls = []
 
-    def fake_phase(mode, timeout):
+    def fake_phase(mode, timeout, env_extra=None):
         calls.append(mode)
-        return phases[mode](len([c for c in calls if c == mode]))
+        n = calls.count(mode)
+        fn = phases[mode]
+        if fn.__code__.co_argcount >= 2:
+            return fn(n, env_extra)
+        return fn(n)
 
+    monkeypatch.setenv("MXTPU_BENCH_AB", "1" if ab else "0")
     monkeypatch.setattr(bench, "_run_phase", fake_phase)
     monkeypatch.setattr(bench, "TOTAL_DEADLINE", deadline)
     monkeypatch.setattr(bench, "SMOKE", smoke)
@@ -164,3 +170,23 @@ def test_supervise_raw_failure_returns_to_probing(monkeypatch):
         {"--probe": lambda n: ({"device": "x"}, False), "--child": raw},
         deadline=600.0)
     assert rc == 0 and out["value"] == 7.0 and state["raw"] == 2
+
+
+def test_supervise_fused_bn_ab_phase(monkeypatch):
+    """With budget left after the raw number, a second raw child runs
+    with the fused-BN knob pinned on; the baseline pins it off."""
+    envs = []
+
+    def raw(n, env_extra=None):
+        envs.append(env_extra)
+        return {"value": 100.0 + n, "unit": "img/s"}, False
+
+    monkeypatch.setenv("MXTPU_BENCH_MODULE", "0")
+    rc, calls, out = _patched_supervise(
+        monkeypatch,
+        {"--probe": lambda n: ({"device": "x"}, False), "--child": raw},
+        deadline=600.0, ab=True)
+    assert rc == 0
+    assert envs[0] == {"MXNET_FUSED_BN_ADD_RELU": "0"}
+    assert envs[1] == {"MXNET_FUSED_BN_ADD_RELU": "1"}
+    assert out["value"] == 101.0 and out["img_s_fused_bn_tail"] == 102.0

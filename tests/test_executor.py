@@ -1,0 +1,110 @@
+"""Executor-level suite (parity model: reference
+tests/python/unittest/test_executor.py — bind/simple_bind forward and
+gradient equivalence, reshape, monitor callback, dict views)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    return net
+
+
+def test_bind_forward_backward_matches_numpy():
+    rs = np.random.RandomState(0)
+    x = rs.randn(4, 5).astype(np.float32)
+    w = rs.randn(3, 5).astype(np.float32)
+    lhs = mx.sym.Variable("x")
+    out = mx.sym.FullyConnected(lhs, num_hidden=3, no_bias=True,
+                                name="fc")
+    args = [mx.nd.array(x), mx.nd.array(w)]
+    grads = [mx.nd.zeros((4, 5)), mx.nd.zeros((3, 5))]
+    ex = out._bind_legacy(mx.cpu(), args, grads, "write") \
+        if hasattr(out, "_bind_legacy") else out.bind(
+            mx.cpu(), args=args, args_grad=grads, grad_req="write")
+    ex.forward(is_train=True)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), x @ w.T,
+                               rtol=1e-5)
+    head = np.ones((4, 3), np.float32)
+    ex.backward(out_grads=[mx.nd.array(head)])
+    np.testing.assert_allclose(ex.grad_arrays[0].asnumpy(), head @ w,
+                               rtol=1e-5)
+    np.testing.assert_allclose(ex.grad_arrays[1].asnumpy(), head.T @ x,
+                               rtol=1e-5)
+
+
+def test_simple_bind_dict_views():
+    ex = _mlp().simple_bind(ctx=mx.cpu(), data=(2, 6))
+    assert set(ex.arg_dict) == {"data", "fc1_weight", "fc1_bias",
+                                "fc2_weight", "fc2_bias"}
+    assert ex.arg_dict["fc1_weight"].shape == (8, 6)
+    assert set(ex.output_dict) == {"fc2_output"}
+    # grad_dict mirrors arg_dict for grad_req='write'
+    assert ex.grad_dict["fc1_weight"].shape == (8, 6)
+
+
+def test_reshape_batch_dim():
+    ex = _mlp().simple_bind(ctx=mx.cpu(), data=(2, 6))
+    for name, arr in ex.arg_dict.items():
+        if name != "data":
+            arr[:] = 0.1
+    ex2 = ex.reshape(data=(5, 6))
+    assert ex2.arg_dict["data"].shape == (5, 6)
+    # params carry over by reference — same values, same buffers
+    np.testing.assert_allclose(ex2.arg_dict["fc1_weight"].asnumpy(), 0.1)
+    ex2.forward(is_train=False,
+                data=mx.nd.array(np.ones((5, 6), np.float32)))
+    assert ex2.outputs[0].shape == (5, 3)
+
+
+def test_monitor_callback_sees_internal_outputs():
+    seen = []
+
+    def cb(name, arr):
+        seen.append(name)
+
+    ex = _mlp().simple_bind(ctx=mx.cpu(), data=(2, 6))
+    ex.set_monitor_callback(cb)
+    ex.forward(is_train=False,
+               data=mx.nd.array(np.zeros((2, 6), np.float32)))
+    assert any("fc1" in n for n in seen), seen
+
+
+def test_copy_params_from():
+    ex = _mlp().simple_bind(ctx=mx.cpu(), data=(2, 6))
+    src = {"fc1_weight": mx.nd.ones((8, 6)),
+           "fc1_bias": mx.nd.zeros((8,)),
+           "fc2_weight": mx.nd.ones((3, 8)),
+           "fc2_bias": mx.nd.zeros((3,))}
+    ex.copy_params_from(src)
+    np.testing.assert_allclose(ex.arg_dict["fc2_weight"].asnumpy(), 1.0)
+
+
+def test_debug_str_lists_nodes():
+    s = _mlp().simple_bind(ctx=mx.cpu(), data=(2, 6)).debug_str()
+    assert "fc1" in s and "fc2" in s
+
+
+def test_monitor_all_includes_params():
+    seen = []
+    ex = _mlp().simple_bind(ctx=mx.cpu(), data=(2, 6))
+    ex.set_monitor_callback(lambda n, a: seen.append(n), monitor_all=True)
+    ex.forward(is_train=False,
+               data=mx.nd.array(np.zeros((2, 6), np.float32)))
+    assert "fc1_weight" in seen and "fc1_output" in seen
+
+
+def test_monitor_covers_multi_output_ops():
+    data = mx.sym.Variable("data")
+    parts = mx.sym.SliceChannel(data, num_outputs=2, name="sp")
+    out = parts[0] + parts[1]
+    ex = out.simple_bind(ctx=mx.cpu(), data=(2, 4))
+    seen = []
+    ex.set_monitor_callback(lambda n, a: seen.append(n))
+    ex.forward(is_train=False,
+               data=mx.nd.array(np.ones((2, 4), np.float32)))
+    assert any(n.startswith("sp_output") for n in seen), seen
