@@ -11,3 +11,4 @@ from . import loss
 from . import data
 from . import utils
 from . import model_zoo
+from . import contrib

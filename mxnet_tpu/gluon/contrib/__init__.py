@@ -1,0 +1,2 @@
+"""Gluon contrib namespace (parity: python/mxnet/gluon/contrib/)."""
+from . import rnn

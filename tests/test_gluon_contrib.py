@@ -1,0 +1,115 @@
+"""gluon.contrib suite (parity model: reference
+tests/python/unittest/test_gluon_contrib.py — conv RNN cell family
+shapes, VariationalDropoutCell mask reuse)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon.contrib import rnn as crnn
+
+
+@pytest.mark.parametrize("cls,dims,gates", [
+    (crnn.Conv1DRNNCell, 1, 1),
+    (crnn.Conv1DLSTMCell, 1, 4),
+    (crnn.Conv1DGRUCell, 1, 3),
+    (crnn.Conv2DRNNCell, 2, 1),
+    (crnn.Conv2DLSTMCell, 2, 4),
+    (crnn.Conv2DGRUCell, 2, 3),
+    (crnn.Conv3DRNNCell, 3, 1),
+    (crnn.Conv3DLSTMCell, 3, 4),
+    (crnn.Conv3DGRUCell, 3, 3),
+])
+def test_conv_cell_shapes(cls, dims, gates):
+    spatial = (6,) * dims
+    cell = cls(input_shape=(3,) + spatial, hidden_channels=4,
+               i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    x = mx.nd.array(np.random.rand(2, 5, 3, *spatial).astype(np.float32))
+    out, states = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert out.shape == (2, 5, 4) + spatial
+    for s in states:
+        assert s.shape == (2, 4) + spatial
+    assert cell.i2h_weight.shape[0] == gates * 4
+    # h2h conv preserves spatial dims by construction
+    assert len(states) == (2 if "LSTM" in cls.__name__ else 1)
+
+
+def test_conv_cell_even_h2h_kernel_rejected():
+    with pytest.raises(ValueError):
+        crnn.Conv2DLSTMCell(input_shape=(3, 6, 6), hidden_channels=4,
+                            i2h_kernel=3, h2h_kernel=2, i2h_pad=1)
+
+
+def test_conv_lstm_gradients_flow():
+    cell = crnn.Conv2DLSTMCell(input_shape=(2, 5, 5), hidden_channels=3,
+                               i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    x = mx.nd.array(np.random.rand(2, 4, 2, 5, 5).astype(np.float32))
+    with autograd.record():
+        out, _ = cell.unroll(4, x, layout="NTC", merge_outputs=True)
+        loss = (out * out).sum()
+    loss.backward()
+    g = cell.i2h_weight.grad()
+    assert float((g.asnumpy() ** 2).sum()) > 0
+    g2 = cell.h2h_weight.grad()
+    assert float((g2.asnumpy() ** 2).sum()) > 0
+
+
+def test_variational_dropout_mask_constant_across_steps():
+    base = gluon.rnn.LSTMCell(8)
+    cell = crnn.VariationalDropoutCell(base, drop_outputs=0.5)
+    cell.initialize()
+    x = mx.nd.ones((4, 16))
+    states = cell.begin_state(batch_size=4)
+    with autograd.record(train_mode=True):
+        o1, states = cell(x, states)
+        o2, states = cell(x, states)
+    # the SAME output mask applies to both steps: zeros line up
+    z1 = o1.asnumpy() == 0.0
+    z2 = o2.asnumpy() == 0.0
+    assert z1.any()
+    np.testing.assert_array_equal(z1, z2)
+
+    # reset() resamples; two sequences almost surely get different masks
+    cell.reset()
+    with autograd.record(train_mode=True):
+        o3, _ = cell(x, cell.begin_state(batch_size=4))
+    assert not np.array_equal(z1, o3.asnumpy() == 0.0)
+
+
+def test_variational_dropout_inference_is_identity():
+    base = gluon.rnn.LSTMCell(8)
+    cell = crnn.VariationalDropoutCell(base, drop_inputs=0.5,
+                                       drop_states=0.5, drop_outputs=0.5)
+    cell.initialize()
+    x = mx.nd.ones((4, 16))
+    o_drop, _ = cell(x, cell.begin_state(batch_size=4))
+    cell.reset()
+    o_base, _ = base(x, base.begin_state(batch_size=4))
+    np.testing.assert_allclose(o_drop.asnumpy(), o_base.asnumpy(),
+                               rtol=1e-6)
+
+
+def test_conv_cell_channels_last_layout():
+    """conv_layout='NHWC': channels-last data, gates sliced on the last
+    axis, state reported channels-last."""
+    cell = crnn.Conv2DLSTMCell(input_shape=(6, 6, 3), hidden_channels=4,
+                               i2h_kernel=3, h2h_kernel=3, i2h_pad=1,
+                               conv_layout="NHWC")
+    cell.initialize()
+    x = mx.nd.array(np.random.rand(2, 5, 6, 6, 3).astype(np.float32))
+    out, states = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert out.shape == (2, 5, 6, 6, 4)
+    assert states[0].shape == (2, 6, 6, 4)
+    info = cell.state_info(batch_size=2)
+    assert info[0]["shape"] == (2, 6, 6, 4)
+
+
+def test_conv_cell_wrong_rank_input_shape_rejected():
+    with pytest.raises(ValueError):
+        crnn.Conv2DRNNCell(input_shape=(3, 6, 6, 6), hidden_channels=4,
+                           i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    with pytest.raises(ValueError):
+        crnn.Conv2DRNNCell(input_shape=(3, 6), hidden_channels=4,
+                           i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
