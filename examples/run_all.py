@@ -59,6 +59,7 @@ EXAMPLES = [
     ("speech_recognition/deepspeech.py", ["--num-epochs", "24"]),
     ("kaggle-ndsb1/train_dsb.py", ["--num-epochs", "8"]),
     ("kaggle-ndsb2/train_heart.py", ["--num-epochs", "14"]),
+    ("image-classification/fine_tune.py", ["--num-epochs", "6"]),
 ]
 
 

@@ -119,6 +119,11 @@ def test_ndsb2_crps_example():
     assert "ndsb2 ok" in out
 
 
+def test_fine_tune_example():
+    out = _run("image-classification/fine_tune.py", ["--num-epochs", "6"])
+    assert "fine-tune ok" in out
+
+
 @pytest.mark.slow
 def test_all_examples():
     """Full sweep; run explicitly with -m slow (CI nightly analogue)."""
