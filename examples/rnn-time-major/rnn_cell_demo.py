@@ -65,6 +65,12 @@ def main():
     ap.add_argument("--vocab", type=int, default=40)
     args = ap.parse_args()
 
+    # seed every RNG the path touches: framework init, numpy + stdlib
+    # shuffles inside BucketSentenceIter.reset()
+    mx.random.seed(2)
+    np.random.seed(2)
+    import random as _random
+    _random.seed(2)
     buckets = [10, 20, 30]
     train = BucketSentenceIter(synthetic_sentences(vocab=args.vocab),
                                args.batch_size, buckets=buckets,
