@@ -1,0 +1,274 @@
+/* XS glue: the mxnet_tpu C ABI -> Perl.
+ *
+ * Parity: reference perl-package/AI-MXNetCAPI (SWIG-generated wrapper
+ * over include/mxnet/c_api.h) — this is the same idea, hand-rolled and
+ * minimal: NDArray create/copy/shape/free, imperative op invoke, and
+ * the full predict ABI. The high-level OO layer lives in
+ * lib/AI/MXNetTPU.pm.
+ */
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *NDArrayHandle;
+typedef void *OpHandle;
+typedef void *PredictorHandle;
+
+extern const char *MXGetLastError(void);
+extern int MXGetVersion(int *out);
+extern int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim,
+                             int dev_type, int dev_id, int delay_alloc,
+                             int dtype, NDArrayHandle *out);
+extern int MXNDArrayFree(NDArrayHandle h);
+extern int MXNDArraySyncCopyFromCPU(NDArrayHandle h, const void *data,
+                                    size_t size);
+extern int MXNDArraySyncCopyToCPU(NDArrayHandle h, void *data, size_t size);
+extern int MXNDArrayGetShape(NDArrayHandle h, mx_uint *out_dim,
+                             const mx_uint **out_pdata);
+extern int NNGetOpHandle(const char *name, OpHandle *out);
+extern int MXImperativeInvoke(OpHandle op, int num_inputs,
+                              NDArrayHandle *inputs, int *num_outputs,
+                              NDArrayHandle **outputs, int num_params,
+                              const char **param_keys,
+                              const char **param_vals);
+extern int MXPredCreate(const char *symbol_json, const void *param_bytes,
+                        int param_size, int dev_type, int dev_id,
+                        mx_uint num_input, const char **input_keys,
+                        const mx_uint *input_shape_indptr,
+                        const mx_uint *input_shape_data,
+                        PredictorHandle *out);
+extern int MXPredSetInput(PredictorHandle h, const char *key,
+                          const mx_float *data, mx_uint size);
+extern int MXPredForward(PredictorHandle h);
+extern int MXPredGetOutputShape(PredictorHandle h, mx_uint index,
+                                mx_uint **shape_data, mx_uint *shape_ndim);
+extern int MXPredGetOutput(PredictorHandle h, mx_uint index, mx_float *data,
+                           mx_uint size);
+extern int MXPredFree(PredictorHandle h);
+
+static void croak_mx(const char *what) {
+    croak("%s failed: %s", what, MXGetLastError());
+}
+
+MODULE = AI::MXNetTPU    PACKAGE = AI::MXNetTPU
+
+PROTOTYPES: DISABLE
+
+int
+_version()
+  CODE:
+    {
+        int v = 0;
+        if (MXGetVersion(&v) != 0) croak_mx("MXGetVersion");
+        RETVAL = v;
+    }
+  OUTPUT:
+    RETVAL
+
+IV
+_nd_create(AV *shape_av, AV *data_av)
+  CODE:
+    {
+        mx_uint ndim = (mx_uint)(av_len(shape_av) + 1);
+        mx_uint shape[16];
+        size_t total = 1, n, i;
+        NDArrayHandle h = NULL;
+        float *buf;
+        if (ndim > 16) croak("ndim > 16");
+        for (i = 0; i < ndim; ++i) {
+            shape[i] = (mx_uint)SvUV(*av_fetch(shape_av, (I32)i, 0));
+            total *= shape[i];
+        }
+        n = (size_t)(av_len(data_av) + 1);
+        if (n != total) croak("data length %zu != shape product %zu",
+                              n, total);
+        if (MXNDArrayCreateEx(shape, ndim, 1, 0, 0, 0, &h) != 0)
+            croak_mx("MXNDArrayCreateEx");
+        Newx(buf, total, float);
+        for (i = 0; i < total; ++i)
+            buf[i] = (float)SvNV(*av_fetch(data_av, (I32)i, 0));
+        if (MXNDArraySyncCopyFromCPU(h, buf, total) != 0) {
+            Safefree(buf);
+            croak_mx("MXNDArraySyncCopyFromCPU");
+        }
+        Safefree(buf);
+        RETVAL = PTR2IV(h);
+    }
+  OUTPUT:
+    RETVAL
+
+void
+_nd_free(IV h)
+  CODE:
+    MXNDArrayFree(INT2PTR(NDArrayHandle, h));
+
+AV *
+_nd_shape(IV h)
+  CODE:
+    {
+        mx_uint ndim = 0, i;
+        const mx_uint *pdata = NULL;
+        if (MXNDArrayGetShape(INT2PTR(NDArrayHandle, h), &ndim,
+                              &pdata) != 0)
+            croak_mx("MXNDArrayGetShape");
+        RETVAL = newAV();
+        sv_2mortal((SV *)RETVAL);
+        for (i = 0; i < ndim; ++i)
+            av_push(RETVAL, newSVuv(pdata[i]));
+    }
+  OUTPUT:
+    RETVAL
+
+AV *
+_nd_to_list(IV h)
+  CODE:
+    {
+        mx_uint ndim = 0, i;
+        const mx_uint *pdata = NULL;
+        size_t total = 1;
+        float *buf;
+        if (MXNDArrayGetShape(INT2PTR(NDArrayHandle, h), &ndim,
+                              &pdata) != 0)
+            croak_mx("MXNDArrayGetShape");
+        for (i = 0; i < ndim; ++i) total *= pdata[i];
+        Newx(buf, total, float);
+        if (MXNDArraySyncCopyToCPU(INT2PTR(NDArrayHandle, h), buf,
+                                   total) != 0) {
+            Safefree(buf);
+            croak_mx("MXNDArraySyncCopyToCPU");
+        }
+        RETVAL = newAV();
+        sv_2mortal((SV *)RETVAL);
+        for (i = 0; i < total; ++i)
+            av_push(RETVAL, newSVnv(buf[i]));
+        Safefree(buf);
+    }
+  OUTPUT:
+    RETVAL
+
+AV *
+_op_invoke(const char *op_name, AV *in_av, AV *keys_av, AV *vals_av)
+  CODE:
+    {
+        OpHandle op = NULL;
+        NDArrayHandle ins[16];
+        NDArrayHandle *outs = NULL;
+        int n_in = (int)(av_len(in_av) + 1);
+        int n_params = (int)(av_len(keys_av) + 1);
+        const char *keys[32];
+        const char *vals[32];
+        int n_out = 0, i;
+        if (n_in > 16) croak("too many inputs");
+        if (n_params > 32) croak("too many params");
+        if (NNGetOpHandle(op_name, &op) != 0) croak_mx("NNGetOpHandle");
+        for (i = 0; i < n_in; ++i)
+            ins[i] = INT2PTR(NDArrayHandle,
+                             SvIV(*av_fetch(in_av, (I32)i, 0)));
+        for (i = 0; i < n_params; ++i) {
+            keys[i] = SvPV_nolen(*av_fetch(keys_av, (I32)i, 0));
+            vals[i] = SvPV_nolen(*av_fetch(vals_av, (I32)i, 0));
+        }
+        if (MXImperativeInvoke(op, n_in, ins, &n_out, &outs, n_params,
+                               n_params ? keys : NULL,
+                               n_params ? vals : NULL) != 0)
+            croak_mx("MXImperativeInvoke");
+        RETVAL = newAV();
+        sv_2mortal((SV *)RETVAL);
+        for (i = 0; i < n_out; ++i)
+            av_push(RETVAL, newSViv(PTR2IV(outs[i])));
+    }
+  OUTPUT:
+    RETVAL
+
+IV
+_pred_create(SV *symbol_json, SV *param_bytes, AV *input_keys_av, AV *shapes_av)
+  CODE:
+    {
+        STRLEN jlen, plen;
+        const char *json = SvPV(symbol_json, jlen);
+        const char *params = SvPV(param_bytes, plen);
+        mx_uint num_input = (mx_uint)(av_len(input_keys_av) + 1);
+        const char *keys[8];
+        mx_uint indptr[9];
+        mx_uint shape_data[64];
+        mx_uint pos = 0, i, j;
+        PredictorHandle h = NULL;
+        if (num_input > 8) croak("too many inputs");
+        indptr[0] = 0;
+        for (i = 0; i < num_input; ++i) {
+            AV *shape_av;
+            SV **slot = av_fetch(shapes_av, (I32)i, 0);
+            keys[i] = SvPV_nolen(*av_fetch(input_keys_av, (I32)i, 0));
+            if (!slot || !SvROK(*slot)) croak("shapes must be arrayrefs");
+            shape_av = (AV *)SvRV(*slot);
+            for (j = 0; j <= (mx_uint)av_len(shape_av); ++j) {
+                if (pos >= 64) croak("shape data overflow");
+                shape_data[pos++] =
+                    (mx_uint)SvUV(*av_fetch(shape_av, (I32)j, 0));
+            }
+            indptr[i + 1] = pos;
+        }
+        if (MXPredCreate(json, params, (int)plen, 1, 0, num_input, keys,
+                         indptr, shape_data, &h) != 0)
+            croak_mx("MXPredCreate");
+        RETVAL = PTR2IV(h);
+    }
+  OUTPUT:
+    RETVAL
+
+void
+_pred_set_input(IV h, const char *key, AV *data_av)
+  CODE:
+    {
+        size_t n = (size_t)(av_len(data_av) + 1), i;
+        float *buf;
+        Newx(buf, n, float);
+        for (i = 0; i < n; ++i)
+            buf[i] = (float)SvNV(*av_fetch(data_av, (I32)i, 0));
+        if (MXPredSetInput(INT2PTR(PredictorHandle, h), key, buf,
+                           (mx_uint)n) != 0) {
+            Safefree(buf);
+            croak_mx("MXPredSetInput");
+        }
+        Safefree(buf);
+    }
+
+void
+_pred_forward(IV h)
+  CODE:
+    if (MXPredForward(INT2PTR(PredictorHandle, h)) != 0)
+        croak_mx("MXPredForward");
+
+AV *
+_pred_get_output(IV h, unsigned int index)
+  CODE:
+    {
+        mx_uint *shape_data = NULL;
+        mx_uint ndim = 0, i;
+        size_t total = 1;
+        float *buf;
+        if (MXPredGetOutputShape(INT2PTR(PredictorHandle, h), index,
+                                 &shape_data, &ndim) != 0)
+            croak_mx("MXPredGetOutputShape");
+        for (i = 0; i < ndim; ++i) total *= shape_data[i];
+        Newx(buf, total, float);
+        if (MXPredGetOutput(INT2PTR(PredictorHandle, h), index, buf,
+                            (mx_uint)total) != 0) {
+            Safefree(buf);
+            croak_mx("MXPredGetOutput");
+        }
+        RETVAL = newAV();
+        sv_2mortal((SV *)RETVAL);
+        for (i = 0; i < total; ++i)
+            av_push(RETVAL, newSVnv(buf[i]));
+        Safefree(buf);
+    }
+  OUTPUT:
+    RETVAL
+
+void
+_pred_free(IV h)
+  CODE:
+    MXPredFree(INT2PTR(PredictorHandle, h));

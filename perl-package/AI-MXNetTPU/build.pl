@@ -1,0 +1,39 @@
+#!/usr/bin/perl
+# Build AI::MXNetTPU: xsubpp -> C -> shared object next to the .pm.
+#
+# Usage: perl build.pl        (requires `make` to have produced
+#                              mxnet_tpu/_lib/libmxtpu_c_api.so first)
+use strict;
+use warnings;
+use Config;
+use File::Spec;
+use File::Path qw(make_path);
+use FindBin;
+
+my $root = File::Spec->rel2abs(File::Spec->catdir($FindBin::Bin,
+                                                  '..', '..'));
+my $lib_dir = File::Spec->catdir($root, 'mxnet_tpu', '_lib');
+my $so = File::Spec->catfile($lib_dir, 'libmxtpu_c_api.so');
+die "native library not built: $so (run `make` at the repo root)\n"
+    unless -e $so;
+
+my $xs = File::Spec->catfile($FindBin::Bin, 'MXNetTPU.xs');
+my $c = File::Spec->catfile($FindBin::Bin, 'MXNetTPU.c');
+my $auto = File::Spec->catdir($FindBin::Bin, 'blib', 'arch', 'auto',
+                              'AI', 'MXNetTPU');
+make_path($auto);
+my $out = File::Spec->catfile($auto, "MXNetTPU.$Config{dlext}");
+
+my $typemap = `perl -MExtUtils::ParseXS -e 'print \$INC{"ExtUtils/ParseXS.pm"}'`;
+$typemap =~ s/ParseXS\.pm$/typemap/;
+
+system("xsubpp", "-typemap", $typemap, "-output", $c, $xs) == 0
+    or die "xsubpp failed\n";
+
+my $ccflags = `perl -MExtUtils::Embed -e ccopts`;
+chomp $ccflags;
+my $cmd = "cc -shared -fPIC $ccflags -o '$out' '$c' " .
+          "-L'$lib_dir' -lmxtpu_c_api -Wl,-rpath,'$lib_dir'";
+print "$cmd\n";
+system($cmd) == 0 or die "cc failed\n";
+print "built $out\n";

@@ -1,0 +1,143 @@
+package AI::MXNetTPU;
+# Minimal Perl binding over the mxnet_tpu C ABI.
+#
+# Parity: reference perl-package/AI-MXNet (high-level OO API over the
+# SWIG AI-MXNetCAPI layer). This package keeps the same shape at small
+# scale: AI::MXNetTPU::NDArray with operator overloading routed through
+# MXImperativeInvoke, and AI::MXNetTPU::Predictor over the predict ABI
+# for checkpoint inference. Build with build.pl (xsubpp + g++ against
+# mxnet_tpu/_lib/libmxtpu_c_api.so).
+use strict;
+use warnings;
+use DynaLoader;
+
+our $VERSION = '0.01';
+our @ISA = ('DynaLoader');
+
+sub dl_load_flags { 0x01 }   # RTLD_GLOBAL for the embedded CPython
+
+__PACKAGE__->bootstrap($VERSION);
+
+sub version { return _version(); }
+
+package AI::MXNetTPU::NDArray;
+use strict;
+use warnings;
+use overload
+    '+' => \&_add,
+    '-' => \&_sub,
+    '*' => \&_mul,
+    '""' => \&_str;
+
+sub new {
+    my ($class, $data, $shape) = @_;
+    my $h = AI::MXNetTPU::_nd_create($shape, $data);
+    return bless { handle => $h, owned => 1 }, $class;
+}
+
+sub _wrap {
+    my ($class, $h) = @_;
+    return bless { handle => $h, owned => 1 }, $class;
+}
+
+sub shape    { my $s = AI::MXNetTPU::_nd_shape($_[0]{handle}); return $s; }
+sub aslist   { return AI::MXNetTPU::_nd_to_list($_[0]{handle}); }
+
+sub _invoke1 {
+    my ($op, @ins) = @_;
+    my $outs = AI::MXNetTPU::_op_invoke(
+        $op, [map { $_->{handle} } @ins], [], []);
+    return AI::MXNetTPU::NDArray->_wrap($outs->[0]);
+}
+
+sub _add { return _invoke1('elemwise_add', $_[0], $_[1]); }
+sub _sub {
+    my ($a, $b, $swap) = @_;
+    return $swap ? _invoke1('elemwise_sub', $b, $a)
+                 : _invoke1('elemwise_sub', $a, $b);
+}
+sub _mul { return _invoke1('elemwise_mul', $_[0], $_[1]); }
+
+sub dot  { return _invoke1('dot', $_[0], $_[1]); }
+sub exp_ { return _invoke1('exp', $_[0]); }
+
+sub invoke {
+    my ($self, $op, %params) = @_;
+    my @k = keys %params;
+    my @v = map { "$params{$_}" } @k;
+    my $outs = AI::MXNetTPU::_op_invoke($op, [$self->{handle}],
+                                        \@k, \@v);
+    return AI::MXNetTPU::NDArray->_wrap($outs->[0]);
+}
+
+sub _str {
+    my $self = shift;
+    my $shape = $self->shape;
+    return sprintf("<NDArray %s>", join('x', @$shape));
+}
+
+sub DESTROY {
+    my $self = shift;
+    AI::MXNetTPU::_nd_free($self->{handle})
+        if $self->{owned} && $self->{handle};
+}
+
+package AI::MXNetTPU::Predictor;
+use strict;
+use warnings;
+
+sub new {
+    my ($class, %args) = @_;
+    open(my $jf, '<', $args{symbol_file})
+        or die "cannot open $args{symbol_file}: $!";
+    local $/; my $json = <$jf>; close $jf;
+    open(my $pf, '<:raw', $args{param_file})
+        or die "cannot open $args{param_file}: $!";
+    my $params = <$pf>; close $pf;
+    my @keys   = map { $_->[0] } @{ $args{inputs} };
+    my @shapes = map { $_->[1] } @{ $args{inputs} };
+    my $h = AI::MXNetTPU::_pred_create($json, $params, \@keys, \@shapes);
+    return bless { handle => $h }, $class;
+}
+
+sub set_input {
+    my ($self, $key, $data) = @_;
+    AI::MXNetTPU::_pred_set_input($self->{handle}, $key, $data);
+}
+
+sub forward { AI::MXNetTPU::_pred_forward($_[0]{handle}); }
+
+sub get_output {
+    my ($self, $index) = @_;
+    return AI::MXNetTPU::_pred_get_output($self->{handle}, $index // 0);
+}
+
+sub DESTROY {
+    my $self = shift;
+    AI::MXNetTPU::_pred_free($self->{handle}) if $self->{handle};
+}
+
+1;
+__END__
+
+=head1 NAME
+
+AI::MXNetTPU - Perl interface to the mxnet_tpu framework's C ABI
+
+=head1 SYNOPSIS
+
+    use AI::MXNetTPU;
+    my $a = AI::MXNetTPU::NDArray->new([1, 2, 3, 4], [2, 2]);
+    my $b = AI::MXNetTPU::NDArray->new([5, 6, 7, 8], [2, 2]);
+    my $c = $a + $b;                 # MXImperativeInvoke('elemwise_add')
+    print join(',', @{ $c->aslist }), "\n";
+
+    my $pred = AI::MXNetTPU::Predictor->new(
+        symbol_file => 'model-symbol.json',
+        param_file  => 'model-0000.params',
+        inputs      => [['data', [1, 3, 8, 8]]]);
+    $pred->set_input('data', \@pixels);
+    $pred->forward;
+    my $probs = $pred->get_output(0);
+
+=cut

@@ -1,0 +1,48 @@
+#!/usr/bin/perl
+# NDArray + imperative-op + predictor round trip (parity model:
+# reference perl-package/AI-MXNet/t/ test files).
+use strict;
+use warnings;
+use FindBin;
+use File::Spec;
+use lib File::Spec->catdir($FindBin::Bin, '..', 'lib');
+use lib File::Spec->catdir($FindBin::Bin, '..', 'blib', 'arch');
+use Test::More tests => 8;
+
+use_ok('AI::MXNetTPU');
+
+ok(AI::MXNetTPU::version() >= 1200, 'MXGetVersion answers');
+
+my $a = AI::MXNetTPU::NDArray->new([1, 2, 3, 4, 5, 6], [2, 3]);
+is_deeply($a->shape, [2, 3], 'shape round trip');
+
+my $b = AI::MXNetTPU::NDArray->new([10, 20, 30, 40, 50, 60], [2, 3]);
+my $c = $a + $b;
+is_deeply($c->aslist, [11, 22, 33, 44, 55, 66], 'elemwise_add');
+
+my $d = $a * $b;
+is_deeply($d->aslist, [10, 40, 90, 160, 250, 360], 'elemwise_mul');
+
+my $e = $a->invoke('sum', axis => 1, keepdims => 0);
+is_deeply($e->aslist, [6, 15], 'op with params (sum axis=1)');
+
+# matmul: (2,3) x (3,2)
+my $m = AI::MXNetTPU::NDArray->new([1, 0, 0, 1, 1, 1], [3, 2]);
+my $prod = $a->dot($m);
+is_deeply($prod->aslist, [4, 5, 10, 11], 'dot');
+
+# predictor over a saved checkpoint (written by the python harness into
+# $ENV{MXTPU_PERL_MODEL_PREFIX})
+SKIP: {
+    my $prefix = $ENV{MXTPU_PERL_MODEL_PREFIX};
+    skip 'no model prefix provided', 1 unless $prefix;
+    my $pred = AI::MXNetTPU::Predictor->new(
+        symbol_file => "$prefix-symbol.json",
+        param_file  => "$prefix-0000.params",
+        inputs      => [['data', [1, 4]]]);
+    $pred->set_input('data', [0.5, -0.25, 1.0, 2.0]);
+    $pred->forward;
+    my $probs = $pred->get_output(0);
+    my $sum = 0; $sum += $_ for @$probs;
+    ok(abs($sum - 1.0) < 1e-3, 'predictor softmax sums to 1');
+}

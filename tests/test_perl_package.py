@@ -1,0 +1,46 @@
+"""Perl binding suite — builds AI::MXNetTPU (XS over the C ABI) and runs
+its t/basic.t including the predictor path against a freshly saved
+checkpoint (parity model: reference perl-package/ + test.sh)."""
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+PKG = os.path.join(REPO, "perl-package", "AI-MXNetTPU")
+LIB = os.path.join(REPO, "mxnet_tpu", "_lib", "libmxtpu_c_api.so")
+
+
+@pytest.mark.skipif(shutil.which("perl") is None, reason="no perl")
+@pytest.mark.skipif(not os.path.exists(LIB), reason="native lib not built")
+def test_perl_binding_end_to_end(tmp_path):
+    build = subprocess.run(["perl", "build.pl"], cwd=PKG,
+                           capture_output=True, text=True, timeout=300)
+    assert build.returncode == 0, build.stdout + build.stderr
+
+    # a small softmax model for the predictor leg
+    import mxnet_tpu as mx
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=6, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, label_names=["softmax_label"], context=mx.cpu())
+    mod.bind(data_shapes=[("data", (1, 4))], for_training=False)
+    mod.init_params(mx.initializer.Xavier())
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 0)
+
+    env = dict(os.environ)
+    env["MXNET_TPU_FORCE_CPU"] = "1"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["MXTPU_PERL_MODEL_PREFIX"] = prefix
+    proc = subprocess.run(["perl", os.path.join("t", "basic.t")], cwd=PKG,
+                          capture_output=True, text=True, timeout=600,
+                          env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "not ok" not in proc.stdout, proc.stdout
+    assert "# skip" not in proc.stdout, proc.stdout  # predictor leg ran
+    assert proc.stdout.count("\nok ") >= 7, proc.stdout
