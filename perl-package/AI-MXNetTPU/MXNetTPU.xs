@@ -91,6 +91,7 @@ _nd_create(AV *shape_av, AV *data_av)
             buf[i] = (float)SvNV(*av_fetch(data_av, (I32)i, 0));
         if (MXNDArraySyncCopyFromCPU(h, buf, total) != 0) {
             Safefree(buf);
+            MXNDArrayFree(h);
             croak_mx("MXNDArraySyncCopyFromCPU");
         }
         Safefree(buf);
@@ -160,9 +161,23 @@ _op_invoke(const char *op_name, AV *in_av, AV *keys_av, AV *vals_av)
         const char *keys[32];
         const char *vals[32];
         int n_out = 0, i;
+        /* op handles are interned per name: NNGetOpHandle allocates a
+         * handle that lives forever, so cache it (one per distinct op)
+         * instead of leaking one per invocation */
+        static HV *op_cache = NULL;
+        SV **cached;
         if (n_in > 16) croak("too many inputs");
         if (n_params > 32) croak("too many params");
-        if (NNGetOpHandle(op_name, &op) != 0) croak_mx("NNGetOpHandle");
+        if (!op_cache) op_cache = newHV();
+        cached = hv_fetch(op_cache, op_name, (I32)strlen(op_name), 0);
+        if (cached) {
+            op = INT2PTR(OpHandle, SvIV(*cached));
+        } else {
+            if (NNGetOpHandle(op_name, &op) != 0)
+                croak_mx("NNGetOpHandle");
+            (void)hv_store(op_cache, op_name, (I32)strlen(op_name),
+                           newSViv(PTR2IV(op)), 0);
+        }
         for (i = 0; i < n_in; ++i)
             ins[i] = INT2PTR(NDArrayHandle,
                              SvIV(*av_fetch(in_av, (I32)i, 0)));
@@ -199,11 +214,14 @@ _pred_create(SV *symbol_json, SV *param_bytes, AV *input_keys_av, AV *shapes_av)
         indptr[0] = 0;
         for (i = 0; i < num_input; ++i) {
             AV *shape_av;
+            I32 sdim;
             SV **slot = av_fetch(shapes_av, (I32)i, 0);
             keys[i] = SvPV_nolen(*av_fetch(input_keys_av, (I32)i, 0));
             if (!slot || !SvROK(*slot)) croak("shapes must be arrayrefs");
             shape_av = (AV *)SvRV(*slot);
-            for (j = 0; j <= (mx_uint)av_len(shape_av); ++j) {
+            sdim = av_len(shape_av) + 1;
+            if (sdim <= 0) croak("input %u has an empty shape", i);
+            for (j = 0; j < (mx_uint)sdim; ++j) {
                 if (pos >= 64) croak("shape data overflow");
                 shape_data[pos++] =
                     (mx_uint)SvUV(*av_fetch(shape_av, (I32)j, 0));

@@ -24,14 +24,19 @@ my $auto = File::Spec->catdir($FindBin::Bin, 'blib', 'arch', 'auto',
 make_path($auto);
 my $out = File::Spec->catfile($auto, "MXNetTPU.$Config{dlext}");
 
-my $typemap = `perl -MExtUtils::ParseXS -e 'print \$INC{"ExtUtils/ParseXS.pm"}'`;
+require ExtUtils::ParseXS;
+my $typemap = $INC{'ExtUtils/ParseXS.pm'};
 $typemap =~ s/ParseXS\.pm$/typemap/;
+die "cannot locate xsubpp typemap\n" unless -e $typemap;
 
 system("xsubpp", "-typemap", $typemap, "-output", $c, $xs) == 0
     or die "xsubpp failed\n";
 
-my $ccflags = `perl -MExtUtils::Embed -e ccopts`;
+require ExtUtils::Embed;
+# ccopts() returns the flag string when called in non-void context
+my $ccflags = ExtUtils::Embed::ccopts();
 chomp $ccflags;
+die "empty ccopts from ExtUtils::Embed\n" unless $ccflags;
 my $cmd = "cc -shared -fPIC $ccflags -o '$out' '$c' " .
           "-L'$lib_dir' -lmxtpu_c_api -Wl,-rpath,'$lib_dir'";
 print "$cmd\n";

@@ -43,11 +43,19 @@ sub _wrap {
 sub shape    { my $s = AI::MXNetTPU::_nd_shape($_[0]{handle}); return $s; }
 sub aslist   { return AI::MXNetTPU::_nd_to_list($_[0]{handle}); }
 
+sub _invoke_all {
+    my ($op, $ins, $keys, $vals) = @_;
+    my $outs = AI::MXNetTPU::_op_invoke(
+        $op, [map { $_->{handle} } @$ins], $keys, $vals);
+    return map { AI::MXNetTPU::NDArray->_wrap($_) } @$outs;
+}
+
 sub _invoke1 {
     my ($op, @ins) = @_;
-    my $outs = AI::MXNetTPU::_op_invoke(
-        $op, [map { $_->{handle} } @ins], [], []);
-    return AI::MXNetTPU::NDArray->_wrap($outs->[0]);
+    my @out = _invoke_all($op, \@ins, [], []);
+    die "$op returned " . scalar(@out) . " outputs, expected 1"
+        unless @out == 1;
+    return $out[0];
 }
 
 sub _add { return _invoke1('elemwise_add', $_[0], $_[1]); }
@@ -62,12 +70,13 @@ sub dot  { return _invoke1('dot', $_[0], $_[1]); }
 sub exp_ { return _invoke1('exp', $_[0]); }
 
 sub invoke {
+    # every output comes back wrapped (and so freed); scalar context
+    # yields the first output, list context all of them
     my ($self, $op, %params) = @_;
     my @k = keys %params;
     my @v = map { "$params{$_}" } @k;
-    my $outs = AI::MXNetTPU::_op_invoke($op, [$self->{handle}],
-                                        \@k, \@v);
-    return AI::MXNetTPU::NDArray->_wrap($outs->[0]);
+    my @out = _invoke_all($op, [$self], \@k, \@v);
+    return wantarray ? @out : $out[0];
 }
 
 sub _str {
