@@ -36,7 +36,10 @@ def main():
     ap.add_argument("--lr", type=float, default=5e-3)
     args = ap.parse_args()
 
-    mx.random.seed(11)  # SGLD's injected noise must be reproducible
+    # SGLD noise rides the framework RNG; param init rides global
+    # np.random - seed both for a reproducible run
+    mx.random.seed(11)
+    np.random.seed(11)
     rs = np.random.RandomState(3)
     # train only on [-1, 0] u [0.5, 1]: the gap probes epistemic
     # uncertainty
