@@ -306,3 +306,98 @@ def test_mfu_capture_smoke():
     shares = out["self_time_share"]
     assert "convolution fusions" in shares
     assert abs(sum(shares.values()) - 1.0) < 0.01
+
+
+def test_accnn_low_rank_factorization(tmp_path):
+    """tools/accnn: SVD-split convs + FCs. Full rank reproduces the
+    original network almost exactly; reduced rank shrinks params and
+    stays close (reference tools/accnn workflow)."""
+    import json
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.io import DataBatch
+
+    np.random.seed(0)
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8,
+                             pad=(1, 1), name="conv1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=16, name="fc1")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(net, num_hidden=4, name="fc2"),
+        name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (2, 3, 6, 6))], for_training=False)
+    mod.init_params(mx.initializer.Xavier())
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 0)
+
+    def run_acc(ranks, out):
+        p = _run([os.path.join(TOOLS, "accnn", "accnn.py"),
+                  "--model", prefix, "--epoch", "0",
+                  "--ranks", json.dumps(ranks), "--output", out])
+        assert p.returncode == 0, p.stderr[-1500:]
+        return p.stdout
+
+    x = mx.nd.array(np.random.rand(2, 3, 6, 6).astype(np.float32))
+    mod.forward(DataBatch([x]), is_train=False)
+    ref = mod.get_outputs()[0].asnumpy()
+
+    def run_net(out_prefix):
+        sym2, a2, x2 = mx.model.load_checkpoint(out_prefix, 0)
+        m2 = mx.mod.Module(sym2, context=mx.cpu())
+        m2.bind(data_shapes=[("data", (2, 3, 6, 6))], for_training=False)
+        m2.set_params(a2, x2)
+        m2.forward(DataBatch([x]), is_train=False)
+        return m2.get_outputs()[0].asnumpy()
+
+    # full rank: numerically faithful
+    run_acc({"conv1": 64, "fc1": 64}, prefix + "-full")
+    np.testing.assert_allclose(run_net(prefix + "-full"), ref,
+                               atol=1e-4)
+
+    # reduced rank: smaller and still close
+    out = run_acc({"conv1": 4, "fc1": 6}, prefix + "-lo")
+    pct = float(out.split("(")[1].split("%")[0])
+    assert pct < 100.0
+    assert np.abs(run_net(prefix + "-lo") - ref).max() < 0.2
+
+
+def test_rec2idx_roundtrip(tmp_path):
+    from mxnet_tpu import recordio
+    rec = str(tmp_path / "a.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    for i in range(5):
+        w.write(recordio.pack(recordio.IRHeader(0, float(i), i * 10, 0),
+                              b"x" * (10 + i)))
+    w.close()
+    idx = str(tmp_path / "a.idx")
+    p = _run([os.path.join(TOOLS, "rec2idx.py"), rec, idx])
+    assert p.returncode == 0, p.stderr
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    hdr, payload = recordio.unpack(r.read_idx(30))
+    assert hdr.label == 3.0 and payload == b"x" * 13
+
+
+def test_diagnose_runs():
+    p = _run([os.path.join(TOOLS, "diagnose.py"), "--accelerator", "0"])
+    assert p.returncode == 0, p.stderr
+    assert "Framework" in p.stdout and "native C ABI : built" in p.stdout
+
+
+def test_rec2idx_duplicate_ids_key_sequentially(tmp_path):
+    from mxnet_tpu import recordio
+    rec = str(tmp_path / "dup.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    for i in range(4):
+        w.write(recordio.pack(recordio.IRHeader(0, float(i), 0, 0),
+                              bytes([i]) * 4))
+    w.close()
+    idx = str(tmp_path / "dup.idx")
+    p = _run([os.path.join(TOOLS, "rec2idx.py"), rec, idx])
+    assert p.returncode == 0, p.stderr
+    r = recordio.MXIndexedRecordIO(idx, rec, "r")
+    for i in range(4):
+        hdr, payload = recordio.unpack(r.read_idx(i))
+        assert payload == bytes([i]) * 4

@@ -1,0 +1,241 @@
+"""ACCNN — accelerate a trained network by low-rank factorization
+(parity: reference tools/accnn/ — acc_conv.py's SVD split of k x k
+convolutions into a vertical (k x 1) + horizontal (1 x k) rank-d pair
+[Jaderberg et al. 2014] and acc_fc.py's two-FC SVD split, driven by a
+rank table).
+
+Given a checkpoint, every Convolution whose name appears in the rank
+table is replaced in the symbol JSON by ``<name>_v`` (d filters,
+kh x 1, carries the vertical factor, no bias) followed by ``<name>_h``
+(original filters, 1 x kw, carries the horizontal factor and the
+original bias); FullyConnected layers split into ``<name>_red`` /
+``<name>_rec``. Factor weights come from the SVD of the trained
+tensor, so the factored net approximates the original without
+retraining (fine-tune afterwards for exactness — same workflow as the
+reference).
+
+Usage:
+  python tools/accnn/accnn.py --model prefix --epoch N \
+      --ranks '{"conv1": 8, "fc1": 16}' --output prefix-acc
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+os.environ.setdefault("MXNET_TPU_FORCE_CPU", "1")
+
+import numpy as np
+
+
+def factor_conv(w, rank):
+    """W (out, in, kh, kw) ~= H (out, rank, 1, kw) * V (rank, in, kh, 1).
+
+    Solved by SVD of M[(in, kh), (out, kw)] — the exact scheme of
+    reference acc_conv.py.
+    """
+    out_c, in_c, kh, kw = w.shape
+    m = w.transpose(1, 2, 0, 3).reshape(in_c * kh, out_c * kw)
+    u, s, vt = np.linalg.svd(m, full_matrices=False)
+    rank = int(min(rank, len(s)))
+    root_s = np.sqrt(s[:rank])
+    v = (u[:, :rank] * root_s).T.reshape(rank, in_c, kh, 1)
+    h = (vt[:rank, :].T * root_s).reshape(out_c, kw, rank) \
+        .transpose(0, 2, 1).reshape(out_c, rank, 1, kw)
+    return v.astype(w.dtype), h.astype(w.dtype)
+
+
+def factor_fc(w, rank):
+    """W (out, in) ~= A (out, rank) @ B (rank, in)."""
+    u, s, vt = np.linalg.svd(w, full_matrices=False)
+    rank = int(min(rank, len(s)))
+    root_s = np.sqrt(s[:rank])
+    a = (u[:, :rank] * root_s).astype(w.dtype)
+    b = ((vt[:rank, :].T * root_s).T).astype(w.dtype)
+    return b, a    # (reduce, reconstruct)
+
+
+def _attr_tuple(attrs, key, default):
+    v = attrs.get(key)
+    if v is None:
+        return default
+    return tuple(int(x) for x in v.strip("()").replace(" ", "").split(",")
+                 if x)
+
+
+def accelerate(symbol_json, arg_params, ranks):
+    """Rewrite the graph + params. Returns (new_json, new_args)."""
+    graph = json.loads(symbol_json)
+    nodes = graph["nodes"]
+    new_nodes = []
+    idmap = {}           # old node id -> (new id, output index)
+    new_args = dict(arg_params)
+    factored = set()     # layer names actually rewritten
+
+    def emit(node):
+        new_nodes.append(node)
+        return len(new_nodes) - 1
+
+    def var(name):
+        return {"op": "null", "name": name, "inputs": []}
+
+    for old_id, node in enumerate(nodes):
+        op = node.get("op")
+        name = node["name"]
+        attrs = dict(node.get("attrs") or node.get("param") or {})
+        mapped_inputs = [[idmap[src][0], out_ix, 0]
+                         for src, out_ix, *_ in node["inputs"]]
+
+        if op == "Convolution" and name in ranks \
+                and _attr_tuple(attrs, "kernel", (1, 1)) > (1, 1) \
+                and int(attrs.get("num_group", 1)) == 1:
+            rank = ranks[name]
+            kh, kw = _attr_tuple(attrs, "kernel", (1, 1))
+            sh, sw = _attr_tuple(attrs, "stride", (1, 1)) or (1, 1)
+            ph, pw = _attr_tuple(attrs, "pad", (0, 0)) or (0, 0)
+            dh, dw = _attr_tuple(attrs, "dilate", (1, 1)) or (1, 1)
+            num_filter = int(attrs["num_filter"])
+            no_bias = str(attrs.get("no_bias", "False")) in ("True", "1")
+            factored.add(name)
+
+            w = np.asarray(arg_params[name + "_weight"])
+            v, h = factor_conv(w, rank)
+            new_args[name + "_v_weight"] = v
+            new_args[name + "_h_weight"] = h
+            if not no_bias:
+                new_args[name + "_h_bias"] = np.asarray(
+                    arg_params[name + "_bias"])
+
+            data_in = mapped_inputs[0]
+            vw = emit(var(name + "_v_weight"))
+            v_id = emit({
+                "op": "Convolution", "name": name + "_v",
+                "attrs": {"kernel": "(%d, 1)" % kh,
+                          "stride": "(%d, 1)" % sh,
+                          "pad": "(%d, 0)" % ph,
+                          "dilate": "(%d, 1)" % dh,
+                          "num_filter": str(v.shape[0]),
+                          "no_bias": "True"},
+                "inputs": [data_in, [vw, 0, 0]]})
+            hw = emit(var(name + "_h_weight"))
+            h_inputs = [[v_id, 0, 0], [hw, 0, 0]]
+            if not no_bias:
+                hb = emit(var(name + "_h_bias"))
+                h_inputs.append([hb, 0, 0])
+            h_id = emit({
+                "op": "Convolution", "name": name + "_h",
+                "attrs": {"kernel": "(1, %d)" % kw,
+                          "stride": "(1, %d)" % sw,
+                          "pad": "(0, %d)" % pw,
+                          "dilate": "(1, %d)" % dw,
+                          "num_filter": str(num_filter),
+                          "no_bias": str(no_bias)},
+                "inputs": h_inputs})
+            idmap[old_id] = (h_id, 0)
+            continue
+
+        if op == "FullyConnected" and name in ranks:
+            rank = ranks[name]
+            factored.add(name)
+            num_hidden = int(attrs["num_hidden"])
+            no_bias = str(attrs.get("no_bias", "False")) in ("True", "1")
+            w = np.asarray(arg_params[name + "_weight"])
+            b_red, a_rec = factor_fc(w, rank)
+            new_args[name + "_red_weight"] = b_red
+            new_args[name + "_rec_weight"] = a_rec
+            if not no_bias:
+                new_args[name + "_rec_bias"] = np.asarray(
+                    arg_params[name + "_bias"])
+            data_in = mapped_inputs[0]
+            rw = emit(var(name + "_red_weight"))
+            red = emit({
+                "op": "FullyConnected", "name": name + "_red",
+                "attrs": {"num_hidden": str(b_red.shape[0]),
+                          "no_bias": "True"},
+                "inputs": [data_in, [rw, 0, 0]]})
+            cw = emit(var(name + "_rec_weight"))
+            rec_inputs = [[red, 0, 0], [cw, 0, 0]]
+            if not no_bias:
+                cb = emit(var(name + "_rec_bias"))
+                rec_inputs.append([cb, 0, 0])
+            rec = emit({
+                "op": "FullyConnected", "name": name + "_rec",
+                "attrs": {"num_hidden": str(num_hidden),
+                          "no_bias": str(no_bias)},
+                "inputs": rec_inputs})
+            idmap[old_id] = (rec, 0)
+            continue
+
+        # the variables of factored layers are rewritten to _v/_h (or
+        # _red/_rec) names; a factored layer's original weight/bias
+        # nodes are dead ONLY once the rewrite actually happened —
+        # layers named in the rank table but skipped (1x1, grouped)
+        # keep their variables. Because variable nodes precede their
+        # consumer in topo order, dead ones are dropped in a second
+        # pass below; here every null node is kept provisionally.
+
+        node = dict(node)
+        node["inputs"] = mapped_inputs
+        idmap[old_id] = (emit(node), 0)
+
+    # remap heads, then prune dead variable nodes (the originals of
+    # factored layers, now consumerless)
+    heads = [[idmap[h[0]][0], h[1] if len(h) > 1 else 0, 0]
+             for h in graph["heads"]]
+    used = set(h[0] for h in heads)
+    for n in new_nodes:
+        for src, _, _ in n["inputs"]:
+            used.add(src)
+    keep = [i for i, n in enumerate(new_nodes)
+            if n["op"] != "null" or i in used]
+    remap = {old: new for new, old in enumerate(keep)}
+    pruned = []
+    for i in keep:
+        n = dict(new_nodes[i])
+        n["inputs"] = [[remap[src], ix, k] for src, ix, k in n["inputs"]]
+        pruned.append(n)
+    heads = [[remap[h[0]], h[1], h[2]] for h in heads]
+    new_nodes = pruned
+    for nm in factored:
+        new_args.pop(nm + "_weight", None)
+        new_args.pop(nm + "_bias", None)
+    arg_nodes = [i for i, n in enumerate(new_nodes) if n["op"] == "null"]
+    out = {"nodes": new_nodes, "arg_nodes": arg_nodes,
+           "heads": heads,
+           "node_row_ptr": list(range(len(new_nodes) + 1))}
+    for k in ("attrs",):
+        if k in graph:
+            out[k] = graph[k]
+    return json.dumps(out), new_args
+
+
+def main():
+    import mxnet_tpu as mx
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", required=True, help="checkpoint prefix")
+    ap.add_argument("--epoch", type=int, default=0)
+    ap.add_argument("--ranks", required=True,
+                    help='JSON rank table, e.g. \'{"conv1": 8}\'')
+    ap.add_argument("--output", required=True, help="output prefix")
+    args = ap.parse_args()
+
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        args.model, args.epoch)
+    ranks = json.loads(args.ranks)
+    arg_np = {k: v.asnumpy() for k, v in arg_params.items()}
+    new_json, new_args = accelerate(sym.tojson(), arg_np, ranks)
+
+    with open(args.output + "-symbol.json", "w") as f:
+        f.write(new_json)
+    save_dict = {"arg:" + k: mx.nd.array(v) for k, v in new_args.items()}
+    save_dict.update({"aux:" + k: v for k, v in aux_params.items()})
+    mx.nd.save("%s-%04d.params" % (args.output, args.epoch), save_dict)
+    old_n = sum(v.size for v in arg_np.values())
+    new_n = sum(v.size for v in new_args.values())
+    print("params: %d -> %d (%.1f%%)" % (old_n, new_n,
+                                         100.0 * new_n / old_n))
+
+
+if __name__ == "__main__":
+    main()

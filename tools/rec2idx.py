@@ -1,0 +1,48 @@
+"""rec2idx — rebuild the .idx index for an existing RecordIO file
+(parity: reference tools/rec2idx.py). Each line of the .idx is
+``<record id>\t<byte offset>`` so MXIndexedRecordIO can seek.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("MXNET_TPU_FORCE_CPU", "1")
+from mxnet_tpu import recordio  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Create an index file from a RecordIO file")
+    ap.add_argument("record", help="path to the .rec file")
+    ap.add_argument("index", help="path of the .idx to write")
+    args = ap.parse_args()
+
+    reader = recordio.MXRecordIO(args.record, "r")
+    entries = []
+    while True:
+        pos = reader.tell()
+        buf = reader.read()
+        if buf is None:
+            break
+        try:
+            header, _ = recordio.unpack(buf)
+            rid = header.id
+        except Exception:
+            rid = len(entries)
+        entries.append((rid, pos))
+    ids = [rid for rid, _ in entries]
+    if len(set(ids)) != len(ids):
+        # duplicate header ids (commonly all-zero) would collapse the
+        # index to one reachable record per id - key the whole file
+        # sequentially instead
+        print("duplicate record ids; keying sequentially")
+        entries = [(i, pos) for i, (_, pos) in enumerate(entries)]
+    with open(args.index, "w") as out:
+        for rid, pos in entries:
+            out.write("%d\t%d\n" % (rid, pos))
+    print("wrote %d entries to %s" % (len(entries), args.index))
+
+
+if __name__ == "__main__":
+    main()
