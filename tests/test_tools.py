@@ -434,8 +434,14 @@ def test_accnn_speedup_rank_selection(tmp_path):
     ranks = json.loads(p.stdout.split("selected ranks:")[1]
                        .strip().splitlines()[0])
     assert set(ranks) == {"c1", "c2"}
-    # rank caps: c1 <= min(3*3, ...)=9? svals len = min(c_in*kh, out*kw)
     assert all(1 <= r for r in ranks.values())
+    # the central property: factored conv cost <= original cost / 2
+    # (10x10 outputs at pad=same; cost model from select_ranks)
+    xy = 100
+    full = (3 * 3 * 16 * 3 + 5 * 5 * 16 * 16) * xy
+    cost = (ranks["c1"] * (3 * 3 + 3 * 16)
+            + ranks["c2"] * (5 * 16 + 5 * 16)) * xy
+    assert cost <= full / 2.0, (ranks, cost, full)
     # the factored net loads and runs
     sym2, a2, x2 = mx.model.load_checkpoint(prefix + "-sp", 0)
     m2 = mx.mod.Module(sym2, context=mx.cpu())

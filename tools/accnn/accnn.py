@@ -222,6 +222,8 @@ def select_ranks(sym, arg_params, data_shape, speedup):
     _, out_shapes, _ = internals.infer_shape_partial(data=data_shape)
     shape_of = dict(zip(internals.list_outputs(), out_shapes))
     nodes = graph["nodes"]
+    # note: conv input channels and spectra come from the weight tensor
+    # itself, so producers of any shape/output-arity are fine here
 
     convs = []
     for node in nodes:
@@ -232,21 +234,17 @@ def select_ranks(sym, arg_params, data_shape, speedup):
         if (kh, kw) <= (1, 1) or int(attrs.get("num_group", 1)) != 1:
             continue
         name = node["name"]
-        data_node = nodes[node["inputs"][0][0]]
-        if data_node["op"] == "null":
-            ishape = data_shape[1:]
-        else:
-            ishape = shape_of[data_node["name"] + "_output"][1:]
-        c_in = ishape[0]
         oshape = shape_of[name + "_output"]
         xy = int(np.prod(oshape[2:]))
         n_f = int(attrs["num_filter"])
         w = np.asarray(arg_params[name + "_weight"])
+        c_in = w.shape[1]          # channels from the weight itself
         svals = np.linalg.svd(
             w.transpose(1, 2, 0, 3).reshape(c_in * kh, -1),
             compute_uv=False)
-        # cost of the factored pair per unit rank / of the original
-        per_rank = kw * (n_f + c_in) * xy
+        # factored pair cost per unit rank: vertical kh x 1 over c_in
+        # channels + horizontal 1 x kw into n_f filters
+        per_rank = (kh * c_in + kw * n_f) * xy
         full = kh * kw * n_f * c_in * xy
         convs.append((name, svals, per_rank, full))
 
@@ -293,14 +291,13 @@ def main():
 
     sym, arg_params, aux_params = mx.model.load_checkpoint(
         args.model, args.epoch)
+    arg_np = {k: v.asnumpy() for k, v in arg_params.items()}
     if args.speedup is not None:
         shape = tuple(int(x) for x in args.data_shape.split(","))
-        arg_np0 = {k: v.asnumpy() for k, v in arg_params.items()}
-        ranks = select_ranks(sym, arg_np0, shape, args.speedup)
+        ranks = select_ranks(sym, arg_np, shape, args.speedup)
         print("selected ranks:", json.dumps(ranks))
     else:
         ranks = json.loads(args.ranks)
-    arg_np = {k: v.asnumpy() for k, v in arg_params.items()}
     new_json, new_args = accelerate(sym.tojson(), arg_np, ranks)
 
     with open(args.output + "-symbol.json", "w") as f:
