@@ -1,0 +1,164 @@
+"""BiLSTM-CRF sequence tagger (mirrors reference
+example/gluon/lstm_crf.py — imperative gluon Block with a CRF layer:
+the forward algorithm as differentiable log-partition, Viterbi decode
+at inference).
+
+TPU-first deviation from the reference: the forward recursion is
+VECTORISED over tags (one logsumexp per timestep instead of the
+reference's per-tag python loop), so each step is one fused XLA
+reduction; the transition matrix is a proper gluon Parameter trained
+with everything else. Synthetic tagging grammar (determiner-noun-verb
+agreement) stands in for the tutorial data; Viterbi accuracy must
+approach 1.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..",
+                                ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import Block, nn, rnn
+
+START, STOP = 0, 1           # special tags
+TAGS = {"<start>": 0, "<stop>": 1, "DET": 2, "NOUN": 3, "VERB": 4}
+K = len(TAGS)
+
+
+def log_sum_exp(x, axis):
+    m = nd.max(x, axis=axis, keepdims=True)
+    return (nd.log(nd.sum(nd.exp(x - m), axis=axis, keepdims=True))
+            + m).reshape((-1,))
+
+
+class BiLSTM_CRF(Block):
+    def __init__(self, vocab_size, embedding_dim, hidden_dim):
+        super().__init__()
+        with self.name_scope():
+            self.embed = nn.Embedding(vocab_size, embedding_dim)
+            self.lstm = rnn.LSTM(hidden_dim // 2, bidirectional=True,
+                                 layout="TNC")
+            self.hidden2tag = nn.Dense(K)
+            # transitions[i, j]: score of moving TO tag i FROM tag j
+            self.transitions = self.params.get(
+                "transitions", shape=(K, K),
+                init=mx.initializer.Normal(0.1))
+
+    def _features(self, sentence):
+        emb = self.embed(sentence).reshape((len(sentence), 1, -1))
+        out = self.lstm(emb)
+        return self.hidden2tag(out.reshape((len(sentence), -1)))
+
+    def _forward_alg(self, feats):
+        """log Z, vectorised: one logsumexp over previous tags/step."""
+        trans = self.transitions.data()
+        alphas = nd.array([-10000.0] * K)
+        alphas[START] = 0.0
+        for t in range(feats.shape[0]):
+            # next[j] = LSE_i(alpha[i] + trans[j, i]) + feat[j]
+            scores = alphas.reshape((1, K)) + trans
+            alphas = log_sum_exp(scores, axis=1) + feats[t]
+        terminal = alphas + trans[STOP]
+        return log_sum_exp(terminal.reshape((1, K)), axis=1)
+
+    def _score_sentence(self, feats, tags):
+        trans = self.transitions.data()
+        score = nd.zeros((1,))
+        prev = START
+        for t in range(feats.shape[0]):
+            cur = int(tags[t])
+            score = score + trans[cur, prev] + feats[t, cur]
+            prev = cur
+        return score + trans[STOP, prev]
+
+    def neg_log_likelihood(self, sentence, tags):
+        feats = self._features(sentence)
+        return self._forward_alg(feats) - self._score_sentence(feats, tags)
+
+    def viterbi(self, sentence):
+        """Best path (numpy DP over the trained scores; inference only)."""
+        feats = self._features(sentence).asnumpy()
+        trans = self.transitions.data().asnumpy()
+        score = np.full(K, -10000.0)
+        score[START] = 0.0
+        back = []
+        for t in range(len(feats)):
+            m = score[None, :] + trans          # (to, from)
+            bp = m.argmax(axis=1)
+            score = m.max(axis=1) + feats[t]
+            back.append(bp)
+        score = score + trans[STOP]
+        best = int(score.argmax())
+        path = [best]
+        for bp in reversed(back):
+            best = int(bp[best])
+            path.append(best)
+        path.reverse()
+        assert path[0] == START
+        return path[1:]
+
+
+def make_corpus(rs, n):
+    """det noun verb [det noun] sentences over a toy vocabulary."""
+    dets = ["the", "a"]
+    nouns = ["dog", "cat", "bird", "fish"]
+    verbs = ["chased", "saw", "ate"]
+    vocab = {w: i for i, w in enumerate(dets + nouns + verbs)}
+    tag_of = {**{w: TAGS["DET"] for w in dets},
+              **{w: TAGS["NOUN"] for w in nouns},
+              **{w: TAGS["VERB"] for w in verbs}}
+    data = []
+    for _ in range(n):
+        sent = [rs.choice(dets), rs.choice(nouns), rs.choice(verbs)]
+        if rs.rand() < 0.5:
+            sent += [rs.choice(dets), rs.choice(nouns)]
+        words = nd.array([vocab[w] for w in sent])
+        tags = [tag_of[w] for w in sent]
+        data.append((words, tags))
+    return data, vocab
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=8)
+    ap.add_argument("--train-size", type=int, default=24)
+    args = ap.parse_args()
+
+    mx.random.seed(1)
+    np.random.seed(1)
+    rs = np.random.RandomState(1)
+    data, vocab = make_corpus(rs, args.train_size)
+
+    model = BiLSTM_CRF(len(vocab), embedding_dim=8, hidden_dim=8)
+    model.initialize()
+    trainer = gluon.Trainer(model.collect_params(), "sgd",
+                            {"learning_rate": 0.01, "wd": 1e-4})
+
+    for epoch in range(args.num_epochs):
+        total = 0.0
+        for words, tags in data:
+            with autograd.record():
+                loss = model.neg_log_likelihood(words, tags)
+            loss.backward()
+            trainer.step(1)
+            total += float(loss.asnumpy()[0])
+        if epoch % 2 == 0 or epoch == args.num_epochs - 1:
+            print("epoch %d nll %.3f" % (epoch, total / len(data)))
+
+    correct = total_tags = 0
+    for words, tags in data:
+        pred = model.viterbi(words)
+        correct += sum(int(p == t) for p, t in zip(pred, tags))
+        total_tags += len(tags)
+    acc = correct / total_tags
+    print("viterbi tag accuracy %.3f" % acc)
+    assert acc > 0.95, "CRF should nail the deterministic grammar"
+    print("lstm-crf ok")
+
+
+if __name__ == "__main__":
+    main()

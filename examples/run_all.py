@@ -60,6 +60,9 @@ EXAMPLES = [
     ("kaggle-ndsb1/train_dsb.py", ["--num-epochs", "8"]),
     ("kaggle-ndsb2/train_heart.py", ["--num-epochs", "14"]),
     ("image-classification/fine_tune.py", ["--num-epochs", "6"]),
+    ("gluon/lstm_crf/lstm_crf.py", ["--num-epochs", "8"]),
+    ("gluon/super_resolution/super_resolution.py",
+     ["--num-epochs", "200"]),
 ]
 
 

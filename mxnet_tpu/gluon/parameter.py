@@ -91,9 +91,25 @@ class Parameter:
         self._finish_init(init, ctx, default_init)
 
     def _finish_init(self, initializer, ctx, default_init):
+        from ..initializer import Initializer, create as _init_create
         arr = nd_zeros(self.shape, ctx=ctx, dtype=self.dtype)
         desc = InitDesc(self.name, {"__init__": ""})
-        (initializer or self.init or default_init)(desc, arr)
+        # a param-specific init applies as a WEIGHT init regardless of
+        # the parameter's name suffix; only the global default goes
+        # through suffix dispatch (parity: reference parameter.py
+        # _finish_deferred_init + initializer.py __call__). Initializers
+        # with their own dispatch (Mixed, Load, FusedRNN via an
+        # overridden __call__) route themselves.
+        specific = initializer or self.init
+        if specific is None:
+            default_init(desc, arr)
+        else:
+            if isinstance(specific, str):
+                specific = _init_create(specific)
+            if type(specific).__call__ is not Initializer.__call__:
+                specific(desc, arr)
+            else:
+                specific._init_weight(desc, arr)
         self._data = arr
         if self._grad_req != "null":
             self._init_grad()

@@ -417,6 +417,44 @@ class NDArray:
             return _SliceView(self, idx % n if n else idx)
         if isinstance(key, slice) and key.step in (None, 1):
             return _SliceView(self, key)
+        # advanced indexing under autograd must stay on the tape: route
+        # through the registered gather ops (reference: a[i, j] and
+        # fancy indexing are differentiable gathers)
+        from ..imperative import is_recording
+        if is_recording():
+            if isinstance(key, NDArray):
+                # mode="wrap" preserves eager negative-index semantics
+                return _invoke("take", [self, key],
+                               {"axis": 0, "mode": "wrap"})
+            if isinstance(key, tuple) and key and \
+                    all(isinstance(k, (int, np.integer, NDArray))
+                        and not isinstance(k, (bool, np.bool_))
+                        for k in key):
+                from . import array as _array
+                if all(isinstance(k, (int, np.integer)) for k in key):
+                    # one gather, one constant index matrix
+                    indices = _array(np.array([[int(k)] for k in key],
+                                              np.int32))
+                    out = _invoke("gather_nd", [self, indices], {})
+                    return out.reshape(tuple(self.shape[len(key):]))
+                # mixed int/array keys broadcast like eager numpy fancy
+                # indexing; each key becomes one row of the gather_nd
+                # index tensor at the broadcast shape
+                bshape = np.broadcast_shapes(
+                    *[k.shape for k in key if isinstance(k, NDArray)])
+                rows = []
+                for k in key:
+                    if isinstance(k, NDArray):
+                        if tuple(k.shape) != tuple(bshape):
+                            k = _invoke("broadcast_to", [k],
+                                        {"shape": bshape})
+                    else:
+                        k = _array(np.broadcast_to(
+                            np.int32(int(k)), bshape).copy())
+                    rows.append(k.reshape((1,) + tuple(bshape)))
+                indices = _invoke("Concat", rows,
+                                  {"dim": 0, "num_args": len(rows)})
+                return _invoke("gather_nd", [self, indices], {})
         if isinstance(key, NDArray):
             key = key._data.astype(jnp.int32)
         elif isinstance(key, tuple):

@@ -192,3 +192,65 @@ def test_get_symbol_reconstructs_graph():
     exe = sym.bind(mx.cpu(), {args[0]: x, args[1]: w})
     np.testing.assert_allclose(exe.forward()[0].asnumpy(), z.asnumpy(),
                                rtol=1e-6)
+
+
+def test_advanced_indexing_is_differentiable():
+    """a[i, j] and fancy a[idx] stay on the tape (reference: gathers
+    with scatter backward) — the lstm_crf example's CRF scoring relies
+    on this."""
+    import numpy as np
+    w = mx.nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    w.attach_grad()
+    with mx.autograd.record():
+        s = w[1, 2] * 3.0 + w[0, 0]
+    s.backward()
+    expect = np.zeros((3, 4), np.float32)
+    expect[1, 2], expect[0, 0] = 3.0, 1.0
+    np.testing.assert_allclose(w.grad.asnumpy(), expect)
+    assert s.shape == ()
+
+    x = mx.nd.array(np.arange(6, dtype=np.float32))
+    x.attach_grad()
+    idx = mx.nd.array(np.array([1, 3, 3], np.float32))
+    with mx.autograd.record():
+        y = x[idx].sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [0, 1, 0, 2, 0, 0])
+
+
+def test_advanced_indexing_matches_eager_semantics():
+    """Recording-path gathers must agree with eager fancy indexing:
+    mixed vector+int keys, negative indices, multi-dim index arrays."""
+    import numpy as np
+    a_np = np.arange(24, dtype=np.float32).reshape(4, 6)
+    a = mx.nd.array(a_np)
+    a.attach_grad()
+
+    # mixed vector + int
+    ridx = mx.nd.array(np.array([0, 2, 3], np.float32))
+    with mx.autograd.record():
+        picked = a[ridx, 1]
+        loss = picked.sum()
+    loss.backward()
+    np.testing.assert_allclose(picked.asnumpy(), a_np[[0, 2, 3], 1])
+    expect = np.zeros_like(a_np)
+    expect[[0, 2, 3], 1] = 1.0
+    np.testing.assert_allclose(a.grad.asnumpy(), expect)
+
+    # negative fancy index wraps, as eagerly
+    x = mx.nd.array(np.arange(6, dtype=np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = x[mx.nd.array(np.array([-1, 1], np.float32))].sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [0, 1, 0, 0, 0, 1])
+
+    # 2-D index arrays keep their shape
+    i = mx.nd.array(np.array([[0, 1], [2, 3]], np.float32))
+    j = mx.nd.array(np.array([[5, 4], [3, 2]], np.float32))
+    with mx.autograd.record():
+        g = a[i, j]
+        (g * g).sum().backward()
+    assert g.shape == (2, 2)
+    np.testing.assert_allclose(g.asnumpy(),
+                               a_np[[[0, 1], [2, 3]], [[5, 4], [3, 2]]])

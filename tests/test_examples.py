@@ -124,6 +124,17 @@ def test_fine_tune_example():
     assert "fine-tune ok" in out
 
 
+def test_lstm_crf_example():
+    out = _run("gluon/lstm_crf/lstm_crf.py", ["--num-epochs", "8"])
+    assert "lstm-crf ok" in out
+
+
+def test_super_resolution_example():
+    out = _run("gluon/super_resolution/super_resolution.py",
+               ["--num-epochs", "200"])
+    assert "super-resolution ok" in out
+
+
 @pytest.mark.slow
 def test_all_examples():
     """Full sweep; run explicitly with -m slow (CI nightly analogue)."""
