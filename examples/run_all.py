@@ -63,6 +63,9 @@ EXAMPLES = [
     ("gluon/lstm_crf/lstm_crf.py", ["--num-epochs", "8"]),
     ("gluon/super_resolution/super_resolution.py",
      ["--num-epochs", "200"]),
+    ("gluon/tree_lstm/tree_lstm.py",
+     ["--num-epochs", "16", "--train-size", "48", "--depth", "2",
+      "--hidden", "12"]),
 ]
 
 

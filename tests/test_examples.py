@@ -135,6 +135,13 @@ def test_super_resolution_example():
     assert "super-resolution ok" in out
 
 
+def test_tree_lstm_example():
+    out = _run("gluon/tree_lstm/tree_lstm.py",
+               ["--num-epochs", "16", "--train-size", "48",
+                "--depth", "2", "--hidden", "12"])
+    assert "tree-lstm ok" in out
+
+
 @pytest.mark.slow
 def test_all_examples():
     """Full sweep; run explicitly with -m slow (CI nightly analogue)."""
