@@ -55,8 +55,9 @@ class ChildSumTreeLSTMCell(Block):
         o = nd.sigmoid(iou[:, H:2 * H])
         u = nd.tanh(iou[:, 2 * H:])
         c = i * u
+        wfx = self.W_f(x)                 # constant across children
         for h_k, c_k in children:
-            f_k = nd.sigmoid(self.W_f(x) + self.U_f(h_k))
+            f_k = nd.sigmoid(wfx + self.U_f(h_k))
             c = c + f_k * c_k
         h = o * nd.tanh(c)
         return h, c
@@ -103,6 +104,8 @@ def main():
     ap.add_argument("--hidden", type=int, default=16)
     ap.add_argument("--depth", type=int, default=3)
     args = ap.parse_args()
+    if args.depth < 1:
+        ap.error("--depth must be >= 1 (depth-0 trees are bare literals)")
 
     mx.random.seed(7)
     np.random.seed(7)
