@@ -61,3 +61,72 @@ def test_gluon_hybridize_on_tpu():
         first = cur if first is None else first
         last = cur
     assert last < first, (first, last)
+
+
+def test_fused_rnn_time_major_on_tpu():
+    """Fused sym.RNN (lax.scan over time) compiles and trains on chip in
+    its native TNC layout — the round-5 rnn-time-major path."""
+    from mxnet_tpu.rnn import FusedRNNCell
+    T, N, V, H = 12, 8, 20, 16
+    rng = np.random.RandomState(0)
+    # next-token = (token + 1) % V
+    starts = rng.randint(0, V, N * 8)
+    seqs = (starts[:, None] + np.arange(T + 1)[None, :]) % V
+    x = seqs[:, :T].T.astype(np.float32)           # (T, N*8)
+    lab = seqs[:, 1:].T.astype(np.float32)
+
+    data = sym.Variable("data")
+    label = sym.Variable("softmax_label")
+    emb = sym.Embedding(data, input_dim=V, output_dim=8, name="emb")
+    cell = FusedRNNCell(num_hidden=H, num_layers=1, mode="lstm",
+                        prefix="l_")
+    out, _ = cell.unroll(T, inputs=emb, layout="TNC",
+                         merge_outputs=True)
+    pred = sym.Reshape(out, shape=(-1, H))
+    pred = sym.FullyConnected(pred, num_hidden=V, name="fc")
+    net = sym.SoftmaxOutput(pred, sym.Reshape(label, shape=(-1,)),
+                            name="softmax")
+    class TM(NDArrayIter):
+        def next(self):
+            b = super().next()
+            return type(b)([b.data[0].T], [b.label[0].T], pad=b.pad)
+    tm = TM(x.T.reshape(N * 8, T), lab.T.reshape(N * 8, T), batch_size=N)
+    mod = mx.mod.Module(net, context=mx.tpu(),
+                        data_names=["data"],
+                        label_names=["softmax_label"])
+    mod.bind(data_shapes=[("data", (T, N))],
+             label_shapes=[("softmax_label", (T, N))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.02})
+    for _ in range(6):
+        tm.reset()
+        for batch in tm:
+            mod.forward_backward(batch)
+            mod.update()
+    tm.reset()
+    correct = total = 0
+    for batch in tm:
+        mod.forward(batch, is_train=False)
+        pred = mod.get_outputs()[0].asnumpy().argmax(axis=1)
+        labs = batch.label[0].asnumpy().reshape(-1)
+        correct += int((pred == labs).sum())
+        total += len(labs)
+    assert correct / total > 0.8, (correct, total)
+
+
+def test_conv_lstm_cell_on_tpu():
+    """gluon.contrib Conv2DLSTMCell forward+backward compiles on chip."""
+    from mxnet_tpu.gluon.contrib.rnn import Conv2DLSTMCell
+    from mxnet_tpu import autograd
+    cell = Conv2DLSTMCell(input_shape=(2, 6, 6), hidden_channels=3,
+                          i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize(ctx=mx.tpu())
+    x = nd.array(np.random.rand(2, 4, 2, 6, 6).astype(np.float32),
+                 ctx=mx.tpu())
+    with autograd.record():
+        out, _ = cell.unroll(4, x, layout="NTC", merge_outputs=True)
+        loss = (out * out).sum()
+    loss.backward()
+    g = cell.i2h_weight.grad()
+    assert float((g.asnumpy() ** 2).sum()) > 0
