@@ -30,7 +30,7 @@ import os
 
 from .base import get_env
 
-__all__ = ["list_knobs", "storage_fallback_log", "do_mirror"]
+__all__ = ["list_knobs", "storage_fallback_log", "do_mirror", "fused_fit"]
 
 # name -> (disposition, description)
 _KNOBS = {
@@ -55,6 +55,11 @@ _KNOBS = {
     "MXNET_BACKWARD_DO_MIRROR": ("honored", "rematerialise the forward in "
                                  "the fused fwd+bwd program "
                                  "(jax.checkpoint)"),
+    "MXNET_MODULE_FUSED_STEP": ("honored", "Module.fit/fused_step compile "
+                                "forward+backward+optimizer+metric into "
+                                "ONE donated-buffer XLA program (default "
+                                "on; =0 pins the phase-split path — the "
+                                "PERF.md \"Module.fit gap\" A/B)"),
     "MXNET_FUSED_BN_ADD_RELU": ("honored", "model-zoo ResNet V1 block "
                                 "tails run the fused "
                                 "_contrib_BatchNormAddReLU op "
@@ -122,6 +127,14 @@ def do_mirror():
     """MXNET_BACKWARD_DO_MIRROR: rematerialise the forward during the
     backward pass (reference graph_executor.cc:282-305)."""
     return bool(get_env("MXNET_BACKWARD_DO_MIRROR", 0, int))
+
+
+def fused_fit():
+    """MXNET_MODULE_FUSED_STEP: whole-train-step fusion in Module.fit /
+    Module.fused_step (forward+backward+optimizer+metric as one donated
+    XLA program). Default on; =0 pins the phase-split path (the
+    correctness oracle and the PERF.md A/B baseline)."""
+    return bool(get_env("MXNET_MODULE_FUSED_STEP", 1, int))
 
 
 _fallback_logged = set()

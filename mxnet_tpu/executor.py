@@ -26,7 +26,25 @@ from .context import current_context
 from .ops.common import rng_scope, mx_dtype
 from . import random as _random
 
-__all__ = ["Executor", "infer_graph_shapes"]
+__all__ = ["Executor", "infer_graph_shapes", "record_dispatch"]
+
+
+# ---------------------------------------------------------------------------
+# Dispatch accounting
+# ---------------------------------------------------------------------------
+# One call per jitted-program execution (NOT per eager op): the number of
+# device dispatches per train batch is a load-bearing performance
+# property on a remoted PJRT backend, so tests pin it. Monkeypatch
+# ``mxnet_tpu.executor.dispatch_hook`` with a callable taking one tag
+# string to count; ``None`` (the default) costs one attribute read per
+# program launch.
+dispatch_hook = None
+
+
+def record_dispatch(kind):
+    """Report one jitted-program execution to the installed hook."""
+    if dispatch_hook is not None:
+        dispatch_hook(kind)
 
 
 # differentiable cross-device copy with static endpoints: the plain
@@ -278,29 +296,37 @@ class _GraphProgram:
             self._jit_cache[key] = fn if self.node_devices else jax.jit(fn)
         return self._jit_cache[key]
 
+    def _vjp_over_graph(self, grad_args, rest, aux, rng, train):
+        """``jax.vjp`` over the whole graph under the mirror policy —
+        the ONE forward/backward scaffold both the phase-split
+        ``fwd_bwd_fn`` and the whole-step ``train_step_fn`` trace, so
+        the checkpointing choice and gradient partitioning stay
+        identical by construction."""
+        from .config import do_mirror
+        mirror = do_mirror()
+        segmented = mirror and self.can_segment()
+
+        def f(ga):
+            ev = self.eval_graph_mirrored if segmented \
+                else self.eval_graph
+            outs, aux_up = ev({**rest, **ga}, aux, rng, train)
+            return tuple(outs), aux_up
+        if mirror and not segmented:
+            # grouped (eager per-device) or tiny graphs can't be
+            # segment-checkpointed; one checkpoint around the whole
+            # graph still frees activation buffers between forward and
+            # backward
+            f = jax.checkpoint(f)
+        return jax.vjp(f, grad_args, has_aux=True)
+
     def fwd_bwd_fn(self, train, grad_names):
         key = ("fwdbwd", bool(train), tuple(grad_names))
         if key not in self._jit_cache:
             def fn(args, aux, rng, head_grads):
                 grad_args = {k: args[k] for k in grad_names}
                 rest = {k: v for k, v in args.items() if k not in grad_names}
-
-                from .config import do_mirror
-                mirror = do_mirror()
-                segmented = mirror and self.can_segment()
-
-                def f(ga):
-                    ev = self.eval_graph_mirrored if segmented \
-                        else self.eval_graph
-                    outs, aux_up = ev({**rest, **ga}, aux, rng, train)
-                    return tuple(outs), aux_up
-                if mirror and not segmented:
-                    # grouped (eager per-device) or tiny graphs can't be
-                    # segment-checkpointed; one checkpoint around the
-                    # whole graph still frees activation buffers between
-                    # forward and backward
-                    f = jax.checkpoint(f)
-                outs, vjp, aux_up = jax.vjp(f, grad_args, has_aux=True)
+                outs, vjp, aux_up = self._vjp_over_graph(
+                    grad_args, rest, aux, rng, train)
                 hg = tuple(
                     head_grads[i] if head_grads[i] is not None
                     else jnp.ones(outs[i].shape, outs[i].dtype)
@@ -316,6 +342,82 @@ class _GraphProgram:
                 return outs, grads, aux_up
             self._jit_cache[key] = fn if self.node_devices else jax.jit(fn)
         return self._jit_cache[key]
+
+    def train_step_fn(self, update_names, add_names, input_dtypes, cache_key,
+                      build_update_fn, build_metric_fn):
+        """Whole-training-step program: forward + backward + optimizer
+        update (+ metric accumulation when ``build_metric_fn`` is given)
+        traced into ONE jitted XLA function, with the parameter,
+        optimizer-state, metric-accumulator, and aux buffers DONATED —
+        the step updates weights in place instead of round-tripping every
+        parameter buffer (the end-to-end program compilation the TVM /
+        Julia-to-TPU line of work keeps proving out; closes the
+        Module.fit dispatch gap, PERF.md "Module.fit gap").
+
+        ``update_names`` orders the trained parameters (matching the
+        per-parameter ``lrs``/``wds``/``ts`` arrays and the packed state
+        list); ``add_names`` marks ``grad_req='add'`` parameters whose
+        incoming gradient accumulator rides as a non-donated input.
+        ``build_update_fn``/``build_metric_fn`` are invoked only on a
+        cache miss; ``cache_key`` must capture everything their closures
+        depend on (optimizer statics, state layout, metric identity).
+        Grouped (group2ctx) programs cannot ride — callers fall back to
+        the phase-split path."""
+        if self.node_devices:
+            raise MXNetError("train_step_fn: grouped programs run eagerly "
+                             "per segment and cannot fuse the train step")
+        key = ("train_step", tuple(update_names), tuple(sorted(add_names)),
+               tuple(sorted(input_dtypes.items(), key=lambda kv: kv[0])),
+               cache_key)
+        fn = self._jit_cache.get(key)
+        if fn is not None:
+            return fn
+        update_fn = build_update_fn()
+        metric_fn = build_metric_fn() if build_metric_fn is not None else None
+        grad_set = frozenset(update_names)
+
+        def step(params, opt_states, metric_acc, aux, inputs, rng,
+                 lrs, wds, ts, add_grads):
+            # inputs adopt the bound argument dtypes (a bf16 DataDesc
+            # keeps binding a bf16 program even though the batch arrays
+            # are fed functionally, without a copy into bound storage)
+            ins = {k: (v.astype(input_dtypes[k])
+                       if v.dtype != input_dtypes[k] else v)
+                   for k, v in inputs.items()}
+            grad_args = {k: params[k] for k in update_names}
+            rest = {k: v for k, v in params.items() if k not in grad_set}
+            rest.update(ins)
+            outs, vjp, aux_up = self._vjp_over_graph(
+                grad_args, rest, aux, rng, True)
+            hg = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
+            grads = vjp(hg)[0]
+            # gradients pass through the bound grad-array dtype (the
+            # phase-split path stores them there before the optimizer
+            # reads them — bit-parity demands the same rounding). Only
+            # ``grad_req='add'`` accumulators are MATERIALIZED as program
+            # outputs (they feed the next step); 'write' grads live and
+            # die inside the program — emitting them would be pure
+            # output-buffer overhead nothing consumes
+            gs, grads_out = [], {}
+            for k in update_names:
+                g = grads[k].astype(params[k].dtype)
+                if k in add_names:
+                    g = add_grads[k] + g
+                    grads_out[k] = g
+                gs.append(g)
+            ws = [params[k] for k in update_names]
+            new_ws, new_states = update_fn(ws, opt_states, gs, lrs, wds, ts)
+            new_params = dict(params)
+            new_params.update(zip(update_names, new_ws))
+            new_aux = dict(aux)
+            new_aux.update({k: v for k, v in aux_up.items() if k in aux})
+            new_acc = metric_fn(outs, ins, metric_acc) if metric_fn \
+                else metric_acc
+            return new_params, new_states, new_acc, new_aux, outs, grads_out
+
+        fn = jax.jit(step, donate_argnums=(0, 1, 2, 3))
+        self._jit_cache[key] = fn
+        return fn
 
 
 # ---------------------------------------------------------------------------
@@ -674,6 +776,8 @@ class Executor:
                     self.arg_dict[k][:] = np.asarray(v)
         self._last_key = self._step_key()
         fn = self._prog.forward_fn(bool(is_train))
+        if not self._prog.node_devices:
+            record_dispatch("forward")
         outs, aux_up = fn(self._raw_args(), self._raw_aux(), self._last_key)
         self._write_aux(aux_up)
         self.outputs = [_wrap(o, self._out_ctx(i))
@@ -707,6 +811,7 @@ class Executor:
             self._mon_prog = _GraphProgram(
                 Group([internals[n] for n in self._mon_names]))
         fn = self._mon_prog.forward_fn(bool(is_train))
+        record_dispatch("monitor")
         args = {n: self.arg_dict[n]._data for n in self._mon_prog.arg_names}
         aux = {n: self.aux_dict[n]._data for n in self._mon_prog.aux_names}
         key = getattr(self, "_last_key", None)
@@ -767,6 +872,8 @@ class Executor:
         hg_concrete = []
         for i, g in enumerate(hg):
             hg_concrete.append(g)
+        if not self._prog.node_devices:
+            record_dispatch("fwd_bwd")
         outs, grads, aux_up = fn(self._raw_args(), self._raw_aux(), key,
                                  tuple(hg_concrete))
         self._write_aux(aux_up)

@@ -38,24 +38,30 @@ def _as_numpy(x):
     return x.asnumpy() if isinstance(x, NDArray) else _numpy.asarray(x)
 
 
+def _acc_chain(p, l, a, axis):
+    """Pure accuracy accumulate: (optional argmax) + compare + sum +
+    running-sum add. The ONE definition both the phase-split jitted
+    program (``_acc_fused``) and the whole-step fused metric kernel
+    (``Accuracy.device_kernel``) trace — bit-identical paths by
+    construction, not by hand-synchronised copies. ``axis`` is None
+    when predictions are already class ids."""
+    import jax.numpy as jnp
+    if axis is not None:
+        p = jnp.argmax(p, axis=axis)
+    p = p.astype(jnp.int32).reshape(-1)
+    l = l.astype(jnp.int32).reshape(-1)
+    return a + jnp.sum(p == l).astype(jnp.float32)
+
+
 def _acc_fused(pred, label, acc, argmax_axis):
-    """Accuracy accumulate as one compiled program: (optional argmax) +
-    compare + sum + running-sum add. Jitted once per shape signature;
-    ``argmax_axis`` is static (None = predictions are already class
-    ids)."""
+    """Accuracy accumulate as one compiled program (jitted
+    ``_acc_chain``; ``argmax_axis`` is static)."""
     import jax
     global _ACC_FUSED_JIT
     if _ACC_FUSED_JIT is None:
-        import jax.numpy as jnp
-
-        def _kernel(p, l, a, axis):
-            if axis is not None:
-                p = jnp.argmax(p, axis=axis)
-            p = p.astype(jnp.int32).reshape(-1)
-            l = l.astype(jnp.int32).reshape(-1)
-            return a + jnp.sum(p == l).astype(jnp.float32)
-
-        _ACC_FUSED_JIT = jax.jit(_kernel, static_argnames="axis")
+        _ACC_FUSED_JIT = jax.jit(_acc_chain, static_argnames="axis")
+    from .executor import record_dispatch
+    record_dispatch("metric")
     return _ACC_FUSED_JIT(pred, label, acc, axis=argmax_axis)
 
 
@@ -136,6 +142,22 @@ class EvalMetric:
             self.sum_metric += float(self._dev_sum)
             self._dev_sum = None
 
+    # -- whole-train-step fusion hooks -------------------------------------
+    def device_kernel(self):
+        """Pure accumulate function for the Module whole-step fused
+        training program: ``(labels, preds, acc) -> new_acc`` over traced
+        arrays, or None when this metric can only accumulate eagerly
+        (Module.fit then falls back to the phase-split ``update`` path
+        for the metric — see module/module.py ``_fused_batch_step``)."""
+        return None
+
+    def _install_fused(self, dev_sum, n):
+        """Adopt the accumulator returned by a fused train step (the
+        device value is fetched lazily at ``get()``, like the eager
+        ``_accum_device`` path)."""
+        self._dev_sum = dev_sum
+        self.num_inst += n
+
     def get(self):
         self._flush_device()
         if self.num_inst == 0:
@@ -193,6 +215,20 @@ class Accuracy(EvalMetric):
                  label_names=None):
         super().__init__(name, output_names, label_names, axis=axis)
         self.axis = axis
+
+    def device_kernel(self):
+        """Fused-step accumulate: traces the SAME ``_acc_chain`` the
+        phase-split ``_acc_fused`` program jits, so the two paths are
+        bit-identical."""
+        axis = self.axis
+
+        def kernel(labels, preds, acc):
+            for l, p in zip(labels, preds):
+                ax = axis % p.ndim if p.ndim > l.ndim else None
+                acc = _acc_chain(p, l, acc, ax)
+            return acc
+
+        return kernel
 
     def update(self, labels, preds):
         import jax.numpy as jnp

@@ -61,6 +61,43 @@ class BaseModule:
         self.forward(data_batch, is_train=True)
         self.backward()
 
+    def _fused_batch_step(self, data_batch, eval_metric=None):
+        """Whole-train-step fusion hook: run forward+backward+optimizer
+        (+metric) as ONE compiled program and return True, or return
+        False when the caller must use the phase-split path. Subclasses
+        with a fused program override (Module, BucketingModule); the
+        base class always phase-splits."""
+        return False
+
+    def fused_step(self, data, label=None, eval_metric=None):
+        """Run ONE whole training step — forward, backward, optimizer
+        update, and (when ``eval_metric`` can accumulate on device)
+        metric update — as a single compiled XLA program with parameter /
+        optimizer-state / metric buffers donated. This is the
+        ``Module.fit`` inner loop exposed for manual training loops:
+
+            for batch in train_iter:
+                mod.fused_step(batch, eval_metric=metric)
+
+        ``data`` may be a DataBatch (then ``label`` is ignored) or an
+        NDArray/list of NDArrays with ``label`` alongside. When any
+        piece cannot fuse (see Module._fused_batch_step for the rules)
+        the step still runs — phase-split — and False is returned;
+        True means the single fused program ran."""
+        from ..io import DataBatch
+        if not isinstance(data, DataBatch):
+            d = list(data) if isinstance(data, (list, tuple)) else [data]
+            lab = None if label is None else (
+                list(label) if isinstance(label, (list, tuple)) else [label])
+            data = DataBatch(data=d, label=lab)
+        if self._fused_batch_step(data, eval_metric):
+            return True
+        self.forward_backward(data)
+        self.update()
+        if eval_metric is not None:
+            self.update_metric(eval_metric, data.label)
+        return False
+
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
               epoch=0, sparse_row_id_fn=None):
@@ -159,15 +196,26 @@ class BaseModule:
                 data_batch = next_data_batch
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
+                # whole-step fused program when every piece can ride
+                # (one device dispatch, buffers donated, metric
+                # accumulated in-program); phase-split otherwise — see
+                # Module._fused_batch_step for the fallback rules. The
+                # loop itself never blocks on device values: batch N+1
+                # dispatches while batch N executes, metric values are
+                # fetched lazily (sync happens only at epoch end and in
+                # callbacks that read the metric).
+                fused = self._fused_batch_step(data_batch, eval_metric)
+                if not fused:
+                    self.forward_backward(data_batch)
+                    self.update()
                 try:
                     next_data_batch = next(data_iter)
                     self.prepare(next_data_batch,
                                  sparse_row_id_fn=sparse_row_id_fn)
                 except StopIteration:
                     end_of_batch = True
-                self.update_metric(eval_metric, data_batch.label)
+                if not fused:
+                    self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:
@@ -183,9 +231,14 @@ class BaseModule:
             self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
                              time.time() - tic)
 
-            arg_p, aux_p = self.get_params()
-            self.set_params(arg_p, aux_p)
+            # epoch-end host param sync ONLY at a callback boundary: the
+            # executor already holds the canonical values, so the
+            # reference's unconditional get_params→set_params round trip
+            # (every parameter through the host, every epoch — multiple
+            # ms/epoch on a relayed PJRT backend) buys nothing without a
+            # consumer
             if epoch_end_callback is not None:
+                arg_p, aux_p = self.get_params()
                 for cb in _as_list(epoch_end_callback):
                     cb(epoch, self.symbol, arg_p, aux_p)
 

@@ -164,6 +164,28 @@ class BucketingModule(BaseModule):
                            data_batch.provide_label)
         self._curr_module.forward_backward(data_batch)
 
+    def _fused_batch_step(self, data_batch, eval_metric=None):
+        """Whole-train-step fusion, PER BUCKET: switch to the batch's
+        bucket (the shared optimizer/updater state rides across — update
+        counts stay uniform), then delegate to that bucket Module's fused
+        program. A bucket whose graph can't fuse falls back for ITS
+        batches only; fusible buckets keep their one-dispatch step, and
+        each bucket caches its own compiled signature."""
+        assert self.binded and self.params_initialized
+        self.switch_bucket(data_batch.bucket_key, data_batch.provide_data,
+                           data_batch.provide_label)
+        fused = self._curr_module._fused_batch_step(data_batch, eval_metric)
+        if fused:
+            self._params_dirty = True
+        return fused
+
+    @property
+    def _fused_fallback_reason(self):
+        """Why the CURRENT bucket's last step phase-split (None = fused)."""
+        if self._curr_module is None:
+            return "module not fully initialised"
+        return self._curr_module._fused_fallback_reason
+
     def backward(self, out_grads=None):
         self._curr_module.backward(out_grads=out_grads)
 

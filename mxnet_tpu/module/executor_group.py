@@ -6,7 +6,11 @@ splits each batch across GPU contexts and keeps one executor per device
 SHARDING of one executor's program, not N executors — XLA partitions the
 program over the mesh and inserts ICI collectives (see mxnet_tpu.parallel).
 This class keeps the reference API for code that instantiates it directly,
-delegating to a single Executor.
+delegating to a single Executor. The performance-critical train loop does
+NOT live here: ``Module.fit``/``Module.fused_step`` compile the whole
+step (forward+backward+optimizer+metric) into one donated-buffer XLA
+program (``executor._GraphProgram.train_step_fn``; PERF.md "Module.fit
+gap") — this facade only covers the reference's phase-by-phase surface.
 """
 from __future__ import annotations
 
@@ -40,6 +44,8 @@ class DataParallelExecutorGroup:
         self.contexts = contexts
         self.param_names = param_names
         self.for_training = for_training
+        self.data_shapes = list(data_shapes)
+        self.label_shapes = list(label_shapes) if label_shapes else []
         shape_kwargs = {name: shape for name, shape in
                         [(d[0], d[1]) for d in data_shapes]}
         if label_shapes:
@@ -56,11 +62,23 @@ class DataParallelExecutorGroup:
                                          **shape_kwargs)]
 
     def forward(self, data_batch, is_train=None):
-        ex = self.execs[0]
+        """Install the batch into bound storage and run the forward
+        program (the old facade discarded the batch — any direct user
+        forward-ran stale data). Executor.forward owns the copy-in."""
         data = data_batch.data
-        for (name, _), arr in zip(ex._symbol.list_arguments(), data):
-            pass
-        ex.forward(is_train=bool(is_train))
+        if not isinstance(data, (list, tuple)):
+            data = [data]
+        names = [d[0] if isinstance(d, (list, tuple)) else d.name
+                 for d in self.data_shapes]
+        feed = dict(zip(names, data))
+        label = getattr(data_batch, "label", None)
+        if label is not None and self.label_shapes:
+            if not isinstance(label, (list, tuple)):
+                label = [label]
+            lnames = [l[0] if isinstance(l, (list, tuple)) else l.name
+                      for l in self.label_shapes]
+            feed.update(zip(lnames, label))
+        self.execs[0].forward(is_train=bool(is_train), **feed)
 
     def backward(self, out_grads=None):
         self.execs[0].backward(out_grads=out_grads)

@@ -18,11 +18,13 @@ import logging
 import numpy as np
 
 from ..base import MXNetError
+from ..config import fused_fit
 from ..context import Context, cpu, current_context
+from ..executor import record_dispatch
 from ..initializer import Uniform, InitDesc
 from ..model import _create_kvstore, save_checkpoint, load_checkpoint
 from .. import optimizer as opt
-from ..ndarray.ndarray import NDArray, zeros
+from ..ndarray.ndarray import NDArray, zeros, _wrap
 from .base_module import BaseModule, _as_list
 
 
@@ -65,6 +67,8 @@ class Module(BaseModule):
         self._mesh = None
         self._data_sharding = None
         self._repl_sharding = None
+        self._fused_fallback_reason = None
+        self._fused_plan = None
 
     # -- introspection -----------------------------------------------------
     @property
@@ -392,6 +396,307 @@ class Module(BaseModule):
             # one fused dispatch for the whole parameter set (FusedUpdater)
             self._updater.update_batch(
                 keys, grads, [arg_dict[name] for _, name in live])
+
+    # -- whole-step fused training -----------------------------------------
+    def _fused_batch_step(self, data_batch, eval_metric=None):
+        """Forward + backward + optimizer update (+ metric accumulation
+        when the metric has a device kernel) as ONE jitted XLA program
+        with params/optimizer-state/metric/aux buffers donated
+        (``executor._GraphProgram.train_step_fn``) — the whole-step
+        program compilation that closes the Module.fit dispatch gap
+        (PERF.md "Module.fit gap"). Batch arrays ride as jit arguments,
+        so no copy into bound storage either. Returns True when the
+        fused program ran; on False the caller must run the phase-split
+        path (forward_backward/update/update_metric), which stays the
+        correctness oracle. The reason for the last fallback is kept in
+        ``_fused_fallback_reason``.
+
+        Fallback rules (each mirrors a real constraint):
+        - ``MXNET_MODULE_FUSED_STEP=0`` — the A/B pin
+        - grouped (group2ctx) programs — eager per-segment execution
+        - monitor installed — per-op taps need the phase-split programs
+        - kvstore-mediated updates — push/pull is not a pure function
+          of (params, grads)
+        - optimizers without a pure batch kernel (no SPMD kernel
+          mapping, centered RMSProp, inexpressible state layouts) or a
+          non-Fused updater
+        - ``inputs_need_grad`` — data gradients are phase-split only
+
+        The expensive eligibility cascade + program lookup runs once and
+        is cached as a per-module PLAN (``_fused_plan``), invalidated on
+        any identity change (rebind, new optimizer/updater/metric);
+        conditions that can flip without an identity change (the env
+        pin, monitors, kvstore, hyperparameter statics, optimizer-state
+        layout) are re-checked every step — they are attribute reads,
+        not program rebuilds.
+        """
+        if not fused_fit():
+            self._fused_fallback_reason = "MXNET_MODULE_FUSED_STEP=0"
+            return False
+        ex = self._exec
+        if ex is not None and ex._monitor_callback is not None:
+            self._fused_fallback_reason = "monitor installed"
+            return False
+        if self._kvstore is not None or self._update_on_kvstore:
+            self._fused_fallback_reason = "kvstore-mediated update"
+            return False
+        plan = self._fused_plan
+        packed = None
+        if (plan is None or plan["exec"] is not ex
+                or plan["updater"] is not self._updater
+                or plan["optimizer"] is not self._optimizer
+                or plan["metric"] is not eval_metric
+                or plan["has_label"] != (data_batch.label is not None)):
+            plan = self._fused_plan = self._build_fused_plan(
+                data_batch, eval_metric)
+        else:
+            # hyperparameters baked into the program as statics can be
+            # mutated on the live optimizer object — verify per step
+            try:
+                kname, hyper = plan["hyper_fn"](self._optimizer)
+            except MXNetError as e:
+                self._fused_fallback_reason = str(e)
+                self._fused_plan = None
+                return False
+            statics = tuple(sorted(
+                (k, v) for k, v in hyper.items() if k not in ("lr", "wd")))
+            if kname != plan["kname"] or statics != plan["statics"]:
+                plan = self._fused_plan = self._build_fused_plan(
+                    data_batch, eval_metric)
+            else:
+                # optimizer state re-gathered every step: layouts can
+                # drift under the plan (load_optimizer_states swaps the
+                # state NDArrays) and states for late parameters are
+                # created here
+                packed, mp, inner_n = self._updater._gather_batch(
+                    plan["kname"], plan["indices"], plan["weights"])
+                if packed is None or tuple(mp) != plan["mp"] \
+                        or tuple(inner_n) != plan["inner_n"]:
+                    packed = None
+                    plan = self._fused_plan = self._build_fused_plan(
+                        data_batch, eval_metric)
+        if plan is None:
+            return False
+        if packed is None:
+            # a just-built plan carries the state its own gather packed
+            packed = plan.pop("packed")
+        return self._run_fused_step(plan, packed, data_batch, eval_metric)
+
+    def _build_fused_plan(self, data_batch, eval_metric):
+        """Run the full fusion-eligibility cascade and assemble the
+        per-module plan ``_fused_batch_step`` executes from: parameter
+        ordering, the jitted whole-step program, and the metric device
+        kernel. Returns None (with ``_fused_fallback_reason`` set) when
+        any piece can't ride."""
+        if not (self.binded and self.params_initialized
+                and self.optimizer_initialized):
+            self._fused_fallback_reason = "module not fully initialised"
+            return None
+        ex = self._exec
+        if ex._prog.node_devices:
+            self._fused_fallback_reason = "group2ctx grouped program"
+            return None
+        updater = self._updater
+        if not isinstance(updater, opt.FusedUpdater):
+            self._fused_fallback_reason = "updater has no fused batch path"
+            return None
+        if self.inputs_need_grad:
+            self._fused_fallback_reason = "inputs_need_grad"
+            return None
+        optimizer = self._optimizer
+        from ..parallel import opt_kernels as _ok
+        try:
+            kname, hyper = _ok.hyper_from_optimizer(optimizer)
+        except MXNetError as e:
+            self._fused_fallback_reason = str(e)
+            return None
+        if getattr(optimizer, "centered", False):
+            self._fused_fallback_reason = "centered RMSProp state layout"
+            return None
+
+        arg_dict = ex.arg_dict
+        live = [(i, n) for i, n in enumerate(self._param_names)
+                if self._grad_req.get(n, "null") != "null"]
+        if not live:
+            self._fused_fallback_reason = "no trainable parameters"
+            return None
+        indices = [i for i, _ in live]
+        update_names = tuple(n for _, n in live)
+        add_names = frozenset(n for _, n in live
+                              if self._grad_req[n] == "add")
+        weights = [arg_dict[n] for n in update_names]
+        packed, mp, inner_n = updater._gather_batch(kname, indices, weights)
+        if packed is None:
+            self._fused_fallback_reason = \
+                "optimizer state layout not expressible as a kernel step"
+            return None
+
+        has_label = data_batch.label is not None
+        graph_args = frozenset(ex._prog.arg_names)
+        bound_labels = [l.name for l in self._label_shapes] \
+            if self._label_shapes else []
+        # only GRAPH-CONSUMED labels ride as program inputs: a label
+        # bound purely for metric use (e.g. a MakeLoss custom loss) is
+        # not a graph argument, and feeding it would blow the trace
+        label_inputs = [n for n in bound_labels if n in graph_args]
+        # metric: fuse only a plain (no output/label renaming) metric
+        # with a device kernel and a 1:1 BOUND, graph-fed label/output
+        # pairing — the kernel reads the label arrays the step actually
+        # feeds; anything else accumulates phase-split on the step's
+        # outputs
+        kernel = None
+        if eval_metric is not None and has_label \
+                and eval_metric.output_names is None \
+                and eval_metric.label_names is None \
+                and bound_labels and label_inputs == bound_labels \
+                and len(bound_labels) == len(self._output_names):
+            kernel = eval_metric.device_kernel()
+
+        input_names = [d.name for d in self._data_shapes]
+        if has_label:
+            input_names += label_inputs
+        input_names += list(self._state_names)
+        if any(n not in arg_dict for n in input_names):
+            self._fused_fallback_reason = (
+                "bound input(s) missing from the executor arg dict: "
+                + ", ".join(sorted(n for n in input_names
+                                   if n not in arg_dict)))
+            return None
+        input_dtypes = {n: arg_dict[n]._data.dtype for n in input_names}
+
+        # every graph argument must be fed (as a param or an input): a
+        # label-consuming graph bound without label shapes, or handed a
+        # label-less batch, cannot ride the pure-function program
+        missing = graph_args.difference(self._param_names, input_names)
+        if missing:
+            self._fused_fallback_reason = (
+                "graph argument(s) not fed by the fused step: "
+                + ", ".join(sorted(missing)))
+            return None
+
+        statics = tuple(sorted(
+            (k, v) for k, v in hyper.items() if k not in ("lr", "wd")))
+        metric_key = None if kernel is None else \
+            (type(eval_metric).__module__, type(eval_metric).__qualname__,
+             getattr(eval_metric, "axis", None), tuple(bound_labels))
+        cache_key = (kname, statics, tuple(mp), tuple(inner_n), metric_key)
+        label_names = bound_labels
+
+        def build_metric_fn():
+            def metric_fn(outs, ins, acc):
+                return kernel([ins[n] for n in label_names], list(outs), acc)
+            return metric_fn
+
+        fn = ex._prog.train_step_fn(
+            update_names, add_names, input_dtypes, cache_key,
+            build_update_fn=lambda: opt._make_batch_update(
+                kname, dict(statics), list(mp), list(inner_n)),
+            build_metric_fn=build_metric_fn if kernel is not None else None)
+        return {
+            "exec": ex, "updater": updater, "optimizer": optimizer,
+            "metric": eval_metric, "has_label": has_label,
+            "kname": kname, "statics": statics,
+            "hyper_fn": _ok.hyper_from_optimizer,
+            "indices": indices, "update_names": update_names,
+            "add_names": add_names, "weights": weights,
+            "mp": tuple(mp), "inner_n": tuple(inner_n),
+            "kernel": kernel, "fn": fn,
+            "label_inputs": frozenset(label_inputs),
+            # the state gathered above, consumed (popped) by the step
+            # that built the plan — later steps re-gather fresh
+            "packed": packed,
+        }
+
+    def _run_fused_step(self, plan, packed, data_batch, eval_metric):
+        """Execute one whole-step fused program from a validated plan:
+        marshal raw buffers, launch, reinstall the donated results."""
+        ex = self._exec
+        arg_dict = ex.arg_dict
+        optimizer = plan["optimizer"]
+        kernel = plan["kernel"]
+        data = data_batch.data
+        if not isinstance(data, (list, tuple)):
+            data = [data]
+        label = data_batch.label
+        if label is not None and not isinstance(label, (list, tuple)):
+            label = [label]
+
+        mesh = self._mesh
+        sharding = self._data_sharding
+
+        def _raw(arr):
+            raw = arr._data if isinstance(arr, NDArray) else np.asarray(arr)
+            if mesh is not None:
+                import jax
+                raw = jax.device_put(raw, sharding)
+            return raw
+
+        inputs = {}
+        for desc, arr in zip(self._data_shapes, data):
+            inputs[desc.name] = _raw(arr)
+        label_raws = []
+        if label is not None and self._label_shapes:
+            for desc, arr in zip(self._label_shapes, label):
+                r = _raw(arr)
+                # the jit signature carries only graph-consumed labels
+                if desc.name in plan["label_inputs"]:
+                    inputs[desc.name] = r
+                label_raws.append(r)
+        for name in self._state_names:
+            inputs[name] = arg_dict[name]._data
+
+        # host-side bookkeeping exactly as the phase-split update() does
+        # it — same Updater states, same count/lr/wd schedule, so a
+        # fallback mid-training continues seamlessly
+        indices = plan["indices"]
+        for i in indices:
+            optimizer._update_count(i)
+        counts = optimizer._index_update_count
+        ts = np.asarray([counts[i] for i in indices], np.float32)
+        lrs = np.asarray([optimizer._get_lr(i) for i in indices], np.float32)
+        wds = np.asarray([optimizer._get_wd(i) for i in indices], np.float32)
+
+        params_raw = {n: arg_dict[n]._data for n in self._param_names}
+        states_raw = [tuple(x._data for x in tup) for tup in packed]
+        aux_raw = {n: a._data for n, a in zip(ex._aux_names, ex.aux_arrays)}
+        grad_dict = ex.grad_dict
+        add_names = plan["add_names"]
+        add_grads = {n: grad_dict[n]._data for n in add_names}
+        acc = None
+        if kernel is not None:
+            acc = getattr(eval_metric, "_dev_sum", None)
+            if acc is None:
+                import jax.numpy as jnp
+                acc = jnp.zeros((), jnp.float32)
+        rng = ex._step_key()
+
+        record_dispatch("train_step")
+        new_params, new_states, new_acc, new_aux, outs, grads_out = \
+            plan["fn"](params_raw, states_raw, acc, aux_raw, inputs, rng,
+                       lrs, wds, ts, add_grads)
+
+        # donation invalidated the old buffers — reinstall everything
+        for n in self._param_names:
+            arg_dict[n]._set_data(new_params[n])
+        for tup, ntup in zip(packed, new_states):
+            for x, nx in zip(tup, ntup):
+                x._set_data(nx)
+        for n, a in zip(ex._aux_names, ex.aux_arrays):
+            a._set_data(new_aux[n])
+        # only 'add' accumulators come back (next step's input); 'write'
+        # grads are consumed inside the program and never materialized
+        # (add_grads above already established every 'add' grad exists)
+        for n in add_names:
+            grad_dict[n]._set_data(grads_out[n])
+        ex.outputs = [_wrap(o, ex._out_ctx(i)) for i, o in enumerate(outs)]
+        if kernel is not None:
+            n_inst = sum(int(r.size) for r in label_raws)
+            eval_metric._install_fused(new_acc, n_inst)
+        elif eval_metric is not None:
+            self.update_metric(eval_metric, data_batch.label)
+        self._params_dirty = True
+        self._fused_fallback_reason = None
+        return True
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded

@@ -676,6 +676,44 @@ class Updater:
                             else states)
 
 
+def _make_batch_update(kname, statics, mp, inner_n):
+    """Pure whole-parameter-set update: ``(ws, states, gs, lrs, wds, ts)
+    -> (new_ws, new_states)`` applying one optimizer kernel to every
+    parameter. Shared by ``FusedUpdater.update_batch`` (jitted alone, one
+    dispatch per optimizer step) and the Module whole-train-step program
+    (``executor._GraphProgram.train_step_fn``), so both paths run
+    IDENTICAL update arithmetic. ``mp[i]`` marks multi-precision entries
+    whose state tuple ends with the fp32 master weight; ``inner_n[i]`` is
+    the kernel-owned state count."""
+    from .parallel.opt_kernels import get_kernel
+    _, update_fn = get_kernel(kname)
+    n = len(mp)
+
+    def step(ws, states, gs, lrs, wds, ts):
+        new_ws, new_states = [], []
+        for i in range(n):
+            w, s, g = ws[i], states[i], gs[i]
+            h = dict(statics)
+            h["lr"], h["wd"] = lrs[i], wds[i]
+            if mp[i]:
+                p = s[-1]                       # fp32 master
+                inner = s[:-1]
+                p_new, inner_new = update_fn(
+                    p, g.astype(p.dtype), inner, ts[i], h)
+                new_ws.append(p_new.astype(w.dtype))
+                ns = tuple(x.astype(o.dtype) for x, o in
+                           zip(inner_new[:inner_n[i]], inner)) + (p_new,)
+            else:
+                w_new, s_new = update_fn(w, g, s, ts[i], h)
+                new_ws.append(w_new.astype(w.dtype))
+                ns = tuple(x.astype(o.dtype) for x, o in
+                           zip(s_new[:inner_n[i]], s))
+            new_states.append(ns)
+        return new_ws, new_states
+
+    return step
+
+
 class FusedUpdater(Updater):
     """Updater with a batched one-dispatch path: ``update_batch`` traces
     EVERY parameter's update rule into a single jitted XLA program
@@ -732,6 +770,32 @@ class FusedUpdater(Updater):
                 return tuple(state)
         return None
 
+    def _gather_batch(self, kname, indices, weights):
+        """(packed, mp, inner_n) state layout for a whole-parameter-set
+        kernel step, creating/syncing states as needed — or (None, None,
+        None) when any entry's layout can't ride the kernel program (the
+        caller keeps the WHOLE batch on one path so update counts stay
+        uniform)."""
+        packed, mp, inner_n = [], [], []
+        for i, w in zip(indices, weights):
+            st = self._ensure_state(i, w)
+            is_mp = self._mp_flags[i]
+            if is_mp:
+                inner, w32 = st
+                tup = self._pack_state(inner)
+                tup = tup + (w32,) if tup is not None else None
+            else:
+                tup = self._pack_state(st)
+            if tup is None or (kname == "nag" and len(tup) == (1 if is_mp
+                                                               else 0)):
+                # inexpressible state layout (or momentum-less NAG, whose
+                # kernel always reads s[0])
+                return None, None, None
+            packed.append(tup)
+            mp.append(is_mp)
+            inner_n.append(len(tup) - (1 if is_mp else 0))
+        return packed, mp, inner_n
+
     def update_batch(self, indices, grads, weights):
         """One fused optimizer step over parallel lists of (index, grad,
         weight). Falls back to the per-index path when any element can't
@@ -752,25 +816,9 @@ class FusedUpdater(Updater):
                 any(isinstance(g, _sp.BaseSparseNDArray) for g in grads):
             return _fallback()
 
-        packed, mp, inner_n = [], [], []
-        for i, g, w in zip(indices, grads, weights):
-            st = self._ensure_state(i, w)
-            is_mp = self._mp_flags[i]
-            if is_mp:
-                inner, w32 = st
-                tup = self._pack_state(inner)
-                tup = tup + (w32,) if tup is not None else None
-            else:
-                tup = self._pack_state(st)
-            if tup is None or (kname == "nag" and len(tup) == (1 if is_mp
-                                                               else 0)):
-                # inexpressible state layout (or momentum-less NAG, whose
-                # kernel always reads s[0]) — keep the whole batch on one
-                # path so update counts stay uniform
-                return _fallback()
-            packed.append(tup)
-            mp.append(is_mp)
-            inner_n.append(len(tup) - (1 if is_mp else 0))
+        packed, mp, inner_n = self._gather_batch(kname, indices, weights)
+        if packed is None:
+            return _fallback()
 
         # host-side bookkeeping exactly as the eager path does it:
         # update counts first, then scheduler-aware lr/wd per index.
@@ -802,6 +850,8 @@ class FusedUpdater(Updater):
         raw_ws = [w._data for w in weights]
         raw_gs = [g._data for g in grads]
         raw_states = [tuple(x._data for x in tup) for tup in packed]
+        from .executor import record_dispatch
+        record_dispatch("opt_update")
         new_ws, new_states = fn(raw_ws, raw_states, raw_gs, lrs, wds, ts)
 
         for w, tup, nw, ntup in zip(weights, packed, new_ws, new_states):
@@ -811,34 +861,8 @@ class FusedUpdater(Updater):
 
     def _build_step(self, kname, statics, mp, inner_n):
         import jax
-        import jax.numpy as jnp
-        from .parallel.opt_kernels import get_kernel
-        _, update_fn = get_kernel(kname)
-        n = len(mp)
-
-        def step(ws, states, gs, lrs, wds, ts):
-            new_ws, new_states = [], []
-            for i in range(n):
-                w, s, g = ws[i], states[i], gs[i]
-                h = dict(statics)
-                h["lr"], h["wd"] = lrs[i], wds[i]
-                if mp[i]:
-                    p = s[-1]                       # fp32 master
-                    inner = s[:-1]
-                    p_new, inner_new = update_fn(
-                        p, g.astype(p.dtype), inner, ts[i], h)
-                    new_ws.append(p_new.astype(w.dtype))
-                    ns = tuple(x.astype(o.dtype) for x, o in
-                               zip(inner_new[:inner_n[i]], inner)) + (p_new,)
-                else:
-                    w_new, s_new = update_fn(w, g, s, ts[i], h)
-                    new_ws.append(w_new.astype(w.dtype))
-                    ns = tuple(x.astype(o.dtype) for x, o in
-                               zip(s_new[:inner_n[i]], s))
-                new_states.append(ns)
-            return new_ws, new_states
-
-        return jax.jit(step, donate_argnums=(0, 1))
+        return jax.jit(_make_batch_update(kname, statics, mp, inner_n),
+                       donate_argnums=(0, 1))
 
 
 def get_updater(optimizer):

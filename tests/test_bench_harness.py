@@ -190,3 +190,140 @@ def test_supervise_fused_bn_ab_phase(monkeypatch):
     assert envs[0] == {"MXNET_FUSED_BN_ADD_RELU": "0"}
     assert envs[1] == {"MXNET_FUSED_BN_ADD_RELU": "1"}
     assert out["value"] == 101.0 and out["img_s_fused_bn_tail"] == 102.0
+
+
+def test_budget_args_bare_number(monkeypatch):
+    """--budget-s 1200 rescales the total deadline and strips the flag
+    (the BENCH_r03/r04 rc=124 fix: the driver hands its window in)."""
+    monkeypatch.setattr(bench, "TOTAL_DEADLINE", 1500.0)
+    rest = bench._apply_budget_args(["--budget-s", "1200", "--child"])
+    assert rest == ["--child"]
+    assert bench.TOTAL_DEADLINE == 1200.0
+
+
+def test_budget_args_per_phase(monkeypatch):
+    for name in ("TOTAL_DEADLINE", "PROBE_TIMEOUT", "RAW_TIMEOUT",
+                 "MODULE_TIMEOUT"):
+        monkeypatch.setattr(bench, name, getattr(bench, name))
+    rest = bench._apply_budget_args(
+        ["--budget-s=probe=60,raw=600", "--budget-s", "module=300"])
+    assert rest == []
+    assert bench.PROBE_TIMEOUT == 60.0
+    assert bench.RAW_TIMEOUT == 600.0
+    assert bench.MODULE_TIMEOUT == 300.0
+
+
+def test_budget_args_unknown_phase_fails_loudly(monkeypatch):
+    monkeypatch.setattr(bench, "TOTAL_DEADLINE", 1500.0)
+    with pytest.raises(SystemExit):
+        bench._apply_budget_args(["--budget-s", "warmup=10"])
+
+
+def test_budget_args_malformed_fails_loudly(monkeypatch):
+    """A trailing --budget-s with no value, or a non-numeric seconds
+    value, must exit with a usage error — not an IndexError/ValueError
+    traceback that skips the harness's final-JSON-line contract."""
+    monkeypatch.setattr(bench, "TOTAL_DEADLINE", 1500.0)
+    with pytest.raises(SystemExit):
+        bench._apply_budget_args(["--child", "--budget-s"])
+    with pytest.raises(SystemExit):
+        bench._apply_budget_args(["--budget-s", "1.5x"])
+    with pytest.raises(SystemExit):
+        bench._apply_budget_args(["--budget-s", "raw=fast"])
+
+
+def test_no_backend_round_marked_skipped(monkeypatch):
+    """A round where the backend never initialises must read as
+    unmeasurable (skipped: true), not as a zero — a tunnel outage can
+    no longer zero out a round's numbers."""
+    import time as _time
+
+    def failing_probe(n):
+        _time.sleep(0.2)
+        return None, True
+
+    rc, calls, out = _patched_supervise(
+        monkeypatch, {"--probe": failing_probe}, deadline=2.0)
+    assert rc == 1
+    assert out["skipped"] is True
+
+
+def test_backend_up_but_raw_failed_not_skipped(monkeypatch):
+    """Probe succeeded but every raw child died: that IS a measurement
+    failure (skipped: false) — the backend was reachable."""
+    rc, calls, out = _patched_supervise(
+        monkeypatch,
+        {"--probe": lambda n: ({"device": "x"}, False),
+         "--child": lambda n: (None, False)},
+        deadline=8.0)
+    assert rc == 1
+    assert "error" in out and out["skipped"] is False
+
+
+def test_module_phase_ab_merge_and_partial_emission(monkeypatch):
+    """The module child's fused + phase-split numbers both merge into
+    the final line, and the raw number is banked as a partial line
+    BEFORE the module phase runs (an outer kill mid-module-phase
+    salvages it)."""
+    import io
+    from contextlib import redirect_stdout
+
+    calls = []
+
+    def fake_phase(mode, timeout, env_extra=None):
+        calls.append(mode)
+        if mode == "--probe":
+            return {"device": "x"}, False
+        if mode == "--child":
+            return {"value": 500.0, "unit": "img/s"}, False
+        return {"module_fit_img_s": 90.0,
+                "module_fit_phase_split_img_s": 30.0}, False
+
+    monkeypatch.setenv("MXTPU_BENCH_AB", "0")
+    monkeypatch.setenv("MXTPU_BENCH_MODULE", "1")
+    monkeypatch.setattr(bench, "_run_phase", fake_phase)
+    monkeypatch.setattr(bench, "TOTAL_DEADLINE", 600.0)
+    monkeypatch.setattr(bench, "SMOKE", False)
+    monkeypatch.setattr(bench, "PROBE_TIMEOUT", 1.0)
+    monkeypatch.setattr(bench, "PROBE_GAP", 0.0)
+    monkeypatch.setattr(bench, "RAW_MIN", 0.5)
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = bench.supervise()
+    assert rc == 0
+    lines = [json.loads(l) for l in buf.getvalue().splitlines()
+             if l.strip().startswith("{")]
+    # a partial line with the raw number lands before the module phase
+    partials = [l for l in lines if l.get("partial")]
+    assert partials and partials[0]["value"] == 500.0
+    assert "module_fit_img_s" not in partials[0]
+    final = lines[-1]
+    assert not final.get("partial")
+    assert final["module_fit_img_s"] == 90.0
+    assert final["module_fit_phase_split_img_s"] == 30.0
+
+
+def test_module_child_marks_silent_fallback(monkeypatch):
+    """module_child must not record two phase-split numbers as a fused
+    A/B: when the fused leg silently falls back, the emitted JSON
+    carries the fallback reason."""
+    import io
+    from contextlib import redirect_stdout
+    monkeypatch.setattr(bench, "_init_device", lambda jax: None)
+    monkeypatch.setattr(bench, "_module_fit_throughput",
+                        lambda dev: (42.0, "kvstore-mediated update"))
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench.module_child()
+    lines = [json.loads(l) for l in buf.getvalue().splitlines()]
+    assert lines[-1]["module_fit_img_s"] == 42.0
+    assert lines[-1]["module_fit_fused_fallback"] == \
+        "kvstore-mediated update"
+    # a clean fused leg carries no fallback marker
+    monkeypatch.setattr(bench, "_module_fit_throughput",
+                        lambda dev: (42.0, None))
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench.module_child()
+    lines = [json.loads(l) for l in buf.getvalue().splitlines()]
+    assert "module_fit_fused_fallback" not in lines[-1]

@@ -352,3 +352,27 @@ def test_module_load_bind_predict():
         m2.forward(batch, is_train=False)
         got = m2.get_outputs()[0].asnumpy()
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_executor_group_facade_forward_feeds_batch():
+    """Regression: the DataParallelExecutorGroup compatibility facade
+    discarded the batch in forward() — any direct user forward-ran
+    whatever was last bound."""
+    from mxnet_tpu.module.executor_group import DataParallelExecutorGroup
+
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = sym.SoftmaxOutput(net, sym.Variable("softmax_label"),
+                            name="softmax")
+    g = DataParallelExecutorGroup(
+        net, [mx.cpu()], None, [("data", (2, 3))],
+        [("softmax_label", (2,))], ["fc_weight", "fc_bias"], True, False)
+    g.execs[0].arg_dict["fc_weight"][:] = \
+        np.arange(12).reshape(4, 3).astype(np.float32)
+    g.forward(DataBatch([nd.ones((2, 3))], [nd.zeros((2,))]),
+              is_train=False)
+    o1 = np.asarray(g.get_outputs()[0]._data).copy()
+    g.forward(DataBatch([nd.zeros((2, 3))], [nd.zeros((2,))]),
+              is_train=False)
+    o2 = np.asarray(g.get_outputs()[0]._data)
+    assert not np.array_equal(o1, o2), "forward must see fresh batch data"

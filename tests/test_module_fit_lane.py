@@ -1,0 +1,59 @@
+"""Tier-1 smoke lane for the user-facing Module.fit path.
+
+Runs ``tools/module_fit_probe.py --fit-smoke`` (CPU backend, tiny MLP,
+20 batches) as a subprocess and pins the two acceptance numbers:
+
+- the fused whole-step program issues <= 2 jitted-program dispatches per
+  batch (it is 1 today), the phase-split oracle exactly 3;
+- fused Module.fit throughput >= 3x the phase-split path.
+
+The probe's JSON lands as an artifact (``$MXTPU_ARTIFACT_DIR/
+module_fit_smoke.json``, default /tmp/mxtpu_artifacts) so the img/s
+trajectory is captured every round even when the TPU tunnel is down —
+the r03/r04 outages left no user-path numbers at all.
+"""
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_probe(art):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # the lane measures single-program dispatch; the 8-device test mesh
+    # is covered by the equivalence suite
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "module_fit_probe.py"),
+         "--fit-smoke", "--json-out", art],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, timeout=420, env=env, cwd=ROOT)
+    assert proc.returncode == 0, proc.stdout[-2000:]
+    with open(art) as f:
+        return json.loads(f.read())
+
+
+def test_module_fit_smoke_lane():
+    art_dir = os.environ.get("MXTPU_ARTIFACT_DIR", "/tmp/mxtpu_artifacts")
+    os.makedirs(art_dir, exist_ok=True)
+    art = os.path.join(art_dir, "module_fit_smoke.json")
+    out = _run_probe(art)
+    assert out["lane"] == "module_fit_smoke"
+    fused, split = out["fused"], out["phase_split"]
+    # the dispatch counts are the deterministic regression guard — any
+    # extra program sneaking into either inner loop fails regardless of
+    # timing noise
+    assert fused["dispatches_per_batch"] <= 2.0, out
+    assert split["dispatches_per_batch"] == 3.0, out
+    assert fused["img_s"] > 0 and split["img_s"] > 0
+    # the acceptance floor: the whole-step program must beat the
+    # phase-split dispatch chain >= 3x on the probe's interleaved
+    # best-of timing. The ratio is noise-hardened but epochs are ~10ms
+    # windows on share-throttled CI boxes — one re-measure before
+    # declaring a throughput regression (dispatch counts above stay
+    # unconditioned)
+    if out["fit_speedup"] < 3.0:
+        out = _run_probe(art)
+    assert out["fit_speedup"] >= 3.0, out

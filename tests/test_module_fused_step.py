@@ -1,0 +1,483 @@
+"""Whole-step fused training program (Module.fit / Module.fused_step).
+
+Three load-bearing properties, each pinned:
+
+1. DISPATCH COUNT — the fused inner loop must issue ONE jitted-program
+   execution per batch (the PERF.md "Module.fit gap" was pure dispatch
+   overhead; the guard catches any regression that sneaks a second
+   program back into the loop). The phase-split fallback's count is
+   pinned too, so a regression in EITHER path fails loudly.
+2. NUMERICAL EQUIVALENCE — fused vs phase-split must be bit-identical
+   (params, optimizer state, metric) after N batches on the virtual
+   8-device CPU mesh, including bf16-resident weights + fp32 master and
+   a grad_req='add' accumulation case. The phase-split path is the
+   correctness oracle; fusion may only change WHEN things compute, not
+   WHAT.
+3. FALLBACK RULES — every non-fusible configuration must still train
+   (via the phase-split path) and must say why it fell back.
+"""
+import contextlib
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.executor as _ex
+from mxnet_tpu import nd, sym
+from mxnet_tpu.io import DataBatch, DataDesc
+
+import jax
+import jax.numpy as jnp
+
+
+@contextlib.contextmanager
+def _pin(value):
+    """Pin MXNET_MODULE_FUSED_STEP for the duration (the A/B knob)."""
+    old = os.environ.get("MXNET_MODULE_FUSED_STEP")
+    os.environ["MXNET_MODULE_FUSED_STEP"] = value
+    try:
+        yield
+    finally:
+        if old is None:
+            del os.environ["MXNET_MODULE_FUSED_STEP"]
+        else:
+            os.environ["MXNET_MODULE_FUSED_STEP"] = old
+
+
+@contextlib.contextmanager
+def _count_dispatches(counts):
+    _ex.dispatch_hook = \
+        lambda kind: counts.__setitem__(kind, counts.get(kind, 0) + 1)
+    try:
+        yield counts
+    finally:
+        _ex.dispatch_hook = None
+
+
+def _mlp(c=4):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=c, name="fc2")
+    return sym.SoftmaxOutput(net, sym.Variable("softmax_label"),
+                             name="softmax")
+
+
+def _batches(nbatch, batch=16, d=8, c=4, seed=7):
+    rs = np.random.RandomState(seed)
+    return [DataBatch(
+        data=[nd.array(rs.uniform(-1, 1, (batch, d)).astype(np.float32))],
+        label=[nd.array(rs.randint(0, c, batch).astype(np.float32))],
+        pad=0) for _ in range(nbatch)]
+
+
+def _make_module(n_dev=1, bf16=False, grad_req="write", batch=16, d=8):
+    ctx = [mx.cpu(i) for i in range(n_dev)] if n_dev > 1 else mx.cpu()
+    mod = mx.mod.Module(_mlp(), context=ctx)
+    ddtype = np.dtype(jnp.bfloat16) if bf16 else None
+    mod.bind(data_shapes=[DataDesc("data", (batch, d), dtype=ddtype)],
+             label_shapes=[DataDesc("softmax_label", (batch,))],
+             grad_req=grad_req)
+    np.random.seed(11)
+    mod.init_params(mx.initializer.Xavier())
+    # kvstore=None: a kvstore-mediated update is a documented fallback
+    # (push/pull is not a pure function of params/grads) — on the mesh
+    # the gradient all-reduce rides inside the sharded program instead
+    mod.init_optimizer(
+        kvstore=None, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.05, "momentum": 0.9,
+                          "wd": 1e-4, "multi_precision": bf16})
+    return mod
+
+
+def _state_arrays(updater):
+    out = []
+    for i in sorted(updater.states):
+        for leaf in jax.tree_util.tree_leaves(updater.states[i]):
+            out.append(np.asarray(leaf._data if hasattr(leaf, "_data")
+                                  else leaf))
+    return out
+
+
+def _train(fused, n_dev=1, bf16=False, grad_req="write", nbatch=6):
+    with _pin("1" if fused else "0"):
+        mod = _make_module(n_dev=n_dev, bf16=bf16, grad_req=grad_req)
+        metric = mx.metric.Accuracy()
+        for b in _batches(nbatch):
+            ran_fused = mod.fused_step(b, eval_metric=metric)
+            assert ran_fused == fused, mod._fused_fallback_reason
+    params = {n: np.asarray(mod._exec.arg_dict[n]._data)
+              for n in mod._param_names}
+    grads = {n: np.asarray(g._data)
+             for n, g in mod._exec.grad_dict.items() if g is not None}
+    return params, _state_arrays(mod._updater), metric.get(), grads
+
+
+# ---------------------------------------------------------------------------
+# 1. dispatch-count regression guard
+# ---------------------------------------------------------------------------
+
+def test_fused_fit_dispatch_guard():
+    """The fused Module.fit inner loop must stay at <= 2 jitted-program
+    dispatches per batch on the CPU backend (it is exactly 1 today:
+    train_step; the headroom covers a future second program, nothing
+    more)."""
+    nbatch = 5
+    with _pin("1"):
+        mod = _make_module()
+        metric = mx.metric.Accuracy()
+        batches = _batches(2)
+        for b in batches:  # warm: compiles the program
+            assert mod.fused_step(b, eval_metric=metric), \
+                mod._fused_fallback_reason
+        with _count_dispatches({}) as counts:
+            for b in _batches(nbatch):
+                assert mod.fused_step(b, eval_metric=metric)
+    assert mod._fused_fallback_reason is None
+    assert sum(counts.values()) <= 2 * nbatch, counts
+    assert counts == {"train_step": nbatch}, counts
+
+
+def test_phase_split_dispatch_pinned():
+    """The fallback path's per-batch dispatch count is pinned at exactly
+    fwd_bwd + opt_update + metric — a regression in the phase-split
+    (oracle) path must be as loud as one in the fused path."""
+    nbatch = 5
+    with _pin("0"):
+        mod = _make_module()
+        metric = mx.metric.Accuracy()
+        for b in _batches(2):  # warm
+            assert not mod.fused_step(b, eval_metric=metric)
+        assert mod._fused_fallback_reason == "MXNET_MODULE_FUSED_STEP=0"
+        with _count_dispatches({}) as counts:
+            for b in _batches(nbatch):
+                assert not mod.fused_step(b, eval_metric=metric)
+    assert counts == {"fwd_bwd": nbatch, "opt_update": nbatch,
+                      "metric": nbatch}, counts
+
+
+# ---------------------------------------------------------------------------
+# 2. numerical equivalence: fused vs phase-split oracle
+# ---------------------------------------------------------------------------
+
+def _assert_equal_runs(run_a, run_b):
+    params_a, states_a, metric_a, grads_a = run_a
+    params_b, states_b, metric_b, grads_b = run_b
+    for n in params_a:
+        np.testing.assert_array_equal(params_a[n], params_b[n], err_msg=n)
+    assert len(states_a) == len(states_b)
+    for i, (a, b) in enumerate(zip(states_a, states_b)):
+        np.testing.assert_array_equal(a, b, err_msg="state %d" % i)
+    assert metric_a == metric_b, (metric_a, metric_b)
+
+
+def test_equivalence_fp32_mesh():
+    """fp32 SGD+momentum+wd on the virtual 8-device mesh: params,
+    optimizer state, and metric bit-identical after 6 batches."""
+    n_dev = min(8, jax.device_count())
+    _assert_equal_runs(_train(True, n_dev=n_dev), _train(False, n_dev=n_dev))
+
+
+def test_equivalence_bf16_master_mesh():
+    """bf16-resident weights + fp32 master (multi_precision) on the
+    mesh: the fused program must round exactly like the phase-split
+    bf16 executor + mp optimizer chain."""
+    n_dev = min(8, jax.device_count())
+    _assert_equal_runs(_train(True, n_dev=n_dev, bf16=True),
+                       _train(False, n_dev=n_dev, bf16=True))
+
+
+def test_equivalence_grad_add():
+    """grad_req='add': the gradient accumulator is a fused-program
+    OUTPUT (it feeds the next step) — its running value must match the
+    phase-split accumulation bit for bit, params and states too."""
+    fused = _train(True, grad_req="add")
+    split = _train(False, grad_req="add")
+    _assert_equal_runs(fused, split)
+    assert fused[3], "grad_req='add' run must expose accumulators"
+    for n in fused[3]:
+        np.testing.assert_array_equal(fused[3][n], split[3][n], err_msg=n)
+
+
+def test_equivalence_through_fit_loop():
+    """Same equivalence through the real Module.fit loop (callbacks,
+    epoch-end sync, lazily fetched metric) — the loop restructure must
+    not change the math either."""
+    from mxnet_tpu.io import NDArrayIter
+    rs = np.random.RandomState(3)
+    x = rs.uniform(-1, 1, (96, 8)).astype(np.float32)
+    y = rs.randint(0, 4, 96).astype(np.float32)
+
+    def run(fused):
+        with _pin("1" if fused else "0"):
+            np.random.seed(5)
+            mx.random.seed(5)
+            mod = mx.mod.Module(_mlp(), context=mx.cpu())
+            metric = mx.metric.Accuracy()
+            mod.fit(NDArrayIter(x, y, batch_size=16),
+                    eval_metric=metric, num_epoch=2,
+                    initializer=mx.initializer.Xavier(),
+                    optimizer="sgd",
+                    optimizer_params={"learning_rate": 0.05,
+                                      "momentum": 0.9})
+            assert (mod._fused_fallback_reason is None) == fused
+            return ({n: np.asarray(mod._exec.arg_dict[n]._data)
+                     for n in mod._param_names}, metric.get())
+
+    params_f, metric_f = run(True)
+    params_s, metric_s = run(False)
+    for n in params_f:
+        np.testing.assert_array_equal(params_f[n], params_s[n], err_msg=n)
+    assert metric_f == metric_s
+
+
+# ---------------------------------------------------------------------------
+# 3. fused_step API + fallback rules
+# ---------------------------------------------------------------------------
+
+def test_fused_step_accepts_raw_arrays():
+    """fused_step(data, label) without a DataBatch — the manual-loop
+    spelling from the README."""
+    mod = _make_module()
+    b = _batches(1)[0]
+    before = np.asarray(mod._exec.arg_dict["fc1_weight"]._data).copy()
+    with _pin("1"):
+        assert mod.fused_step(b.data[0], b.label[0])
+    after = np.asarray(mod._exec.arg_dict["fc1_weight"]._data)
+    assert not np.array_equal(before, after), "step must train"
+
+
+def test_fused_step_fallback_still_trains():
+    """A fallback is a slow path, not a no-op: with the knob pinned off
+    the step must still run (phase-split) and return False."""
+    mod = _make_module()
+    metric = mx.metric.Accuracy()
+    b = _batches(1)[0]
+    before = np.asarray(mod._exec.arg_dict["fc1_weight"]._data).copy()
+    with _pin("0"):
+        assert not mod.fused_step(b, eval_metric=metric)
+    after = np.asarray(mod._exec.arg_dict["fc1_weight"]._data)
+    assert not np.array_equal(before, after), "fallback step must train"
+    assert metric.get()[1] >= 0.0  # metric accumulated eagerly
+
+
+def test_fallback_reason_monitor():
+    mod = _make_module()
+    mon = mx.monitor.Monitor(1, pattern=".*weight")
+    mod.install_monitor(mon)
+    with _pin("1"):
+        assert not mod.fused_step(_batches(1)[0])
+    assert mod._fused_fallback_reason == "monitor installed"
+
+
+def test_fused_with_metric_only_label():
+    """A label bound for metric use but NOT consumed by the graph (e.g.
+    a MakeLoss custom loss) must still fuse — the label simply doesn't
+    ride as a program input, and the metric accumulates phase-split on
+    the step's outputs instead of crashing the plan build."""
+    data = sym.Variable("data")
+    net = sym.MakeLoss(sym.mean(sym.square(
+        sym.FullyConnected(data, num_hidden=4, name="fc1"))))
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[DataDesc("data", (16, 8))],
+             label_shapes=[DataDesc("softmax_label", (16,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd")
+    before = np.asarray(mod._exec.arg_dict["fc1_weight"]._data).copy()
+    with _pin("1"):
+        assert mod.fused_step(_batches(1)[0]), mod._fused_fallback_reason
+    after = np.asarray(mod._exec.arg_dict["fc1_weight"]._data)
+    assert not np.array_equal(before, after), "step must train"
+
+
+def test_fallback_unbound_label_shapes():
+    """A label-consuming graph bound WITHOUT label shapes must fall back
+    (the fused pure-function program cannot feed `softmax_label`), not
+    crash — the phase-split path handles this binding fine."""
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[DataDesc("data", (16, 8))], label_shapes=None,
+             for_training=True)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd")
+    before = np.asarray(mod._exec.arg_dict["fc1_weight"]._data).copy()
+    with _pin("1"):
+        assert not mod.fused_step(_batches(1)[0],
+                                  eval_metric=mx.metric.Accuracy())
+    assert "not fed by the fused step" in mod._fused_fallback_reason
+    after = np.asarray(mod._exec.arg_dict["fc1_weight"]._data)
+    assert not np.array_equal(before, after), "fallback step must train"
+
+
+def test_fallback_reason_inputs_need_grad():
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (16, 8))],
+             label_shapes=[("softmax_label", (16,))],
+             inputs_need_grad=True)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd")
+    with _pin("1"):
+        assert not mod.fused_step(_batches(1)[0])
+    assert mod._fused_fallback_reason == "inputs_need_grad"
+
+
+def test_plan_invalidation_on_new_optimizer():
+    """A cached plan is keyed to the optimizer identity: re-initialising
+    the optimizer must rebuild the plan, not run the stale program."""
+    mod = _make_module()
+    with _pin("1"):
+        assert mod.fused_step(_batches(1)[0])
+        plan1 = mod._fused_plan
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.5},
+                           force_init=True)
+        assert mod.fused_step(_batches(1)[0])
+        assert mod._fused_plan is not plan1
+        assert mod._fused_plan["optimizer"] is mod._optimizer
+
+
+def test_plan_rebuild_on_hyper_mutation():
+    """Statics baked into the compiled program (momentum, rescale_grad)
+    are re-checked per step: mutating them on the live optimizer must
+    not silently keep running the stale program."""
+    mod = _make_module()
+    metric = mx.metric.Accuracy()
+    with _pin("1"):
+        assert mod.fused_step(_batches(1)[0], eval_metric=metric)
+        fn1 = mod._fused_plan["fn"]
+        mod._optimizer.rescale_grad = 0.5
+        assert mod.fused_step(_batches(1)[0], eval_metric=metric)
+        assert mod._fused_plan["fn"] is not fn1
+
+
+# ---------------------------------------------------------------------------
+# 4. BucketingModule: per-bucket fusion, per-bucket fallback
+# ---------------------------------------------------------------------------
+
+def _bucket_setup():
+    def sym_gen(seq_len):
+        # weights must be bucket-key independent (as in a real unrolled
+        # RNN): pool over the variable-length axis before the shared FC
+        data = sym.Variable("data")
+        net = sym.mean(data, axis=1, keepdims=True)
+        net = sym.FullyConnected(net, num_hidden=8, name="fc_shared")
+        net = sym.SoftmaxOutput(net, sym.Variable("softmax_label"),
+                                name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=16,
+                                 context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 16))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9})
+
+    def batch(key):
+        return DataBatch(data=[nd.ones((4, key))], label=[nd.zeros((4,))],
+                         bucket_key=key,
+                         provide_data=[("data", (4, key))],
+                         provide_label=[("softmax_label", (4,))])
+
+    return mod, batch
+
+
+def test_bucketing_fused_per_bucket():
+    """Each bucket compiles and runs its own whole-step program; the
+    shared optimizer's update counts stay uniform across buckets."""
+    mod, batch = _bucket_setup()
+    metric = mx.metric.Accuracy()
+    keys = [16, 8, 16, 8, 8, 16]
+    with _pin("1"):
+        for k in keys[:2]:  # warm both buckets
+            assert mod.fused_step(batch(k), eval_metric=metric), \
+                mod._fused_fallback_reason
+        with _count_dispatches({}) as counts:
+            for k in keys:
+                assert mod.fused_step(batch(k), eval_metric=metric)
+    assert counts == {"train_step": len(keys)}, counts
+    opt = mod._curr_module._optimizer
+    assert len(set(opt._index_update_count.values())) == 1, \
+        "shared optimizer counts must stay uniform across buckets"
+
+
+def test_bucketing_fallback_is_per_bucket():
+    """A bucket that can't fuse falls back for ITS batches only — the
+    other bucket keeps its one-dispatch program."""
+    mod, batch = _bucket_setup()
+    with _pin("1"):
+        assert mod.fused_step(batch(16))
+        assert mod.fused_step(batch(8))
+        # wedge bucket 8 only (a per-bucket monitor tap is the
+        # realistic way a single bucket loses fusion eligibility)
+        mod._buckets[8]._exec._monitor_callback = lambda *a: None
+        with _count_dispatches({}) as counts:
+            assert mod.fused_step(batch(16))
+            assert mod._fused_fallback_reason is None
+            assert not mod.fused_step(batch(8))
+            assert mod._fused_fallback_reason == "monitor installed"
+            assert mod.fused_step(batch(16))
+    assert counts["train_step"] == 2, counts
+    assert counts["fwd_bwd"] == 1, counts
+
+
+def _train_sym(symbol, fused, nbatch=6, batch=16, d=8):
+    with _pin("1" if fused else "0"):
+        mod = mx.mod.Module(symbol, context=mx.cpu())
+        mod.bind(data_shapes=[DataDesc("data", (batch, d))],
+                 label_shapes=[DataDesc("softmax_label", (batch,))])
+        np.random.seed(11)
+        mod.init_params(mx.initializer.Xavier())
+        mx.random.seed(13)
+        mod.init_optimizer(kvstore=None, optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.05,
+                                             "momentum": 0.9})
+        metric = mx.metric.Accuracy()
+        for b in _batches(nbatch):
+            assert mod.fused_step(b, eval_metric=metric) == fused, \
+                mod._fused_fallback_reason
+    params = {n: np.asarray(mod._exec.arg_dict[n]._data)
+              for n in mod._param_names}
+    aux = {n: np.asarray(a._data)
+           for n, a in zip(mod._exec._aux_names, mod._exec.aux_arrays)}
+    return params, aux, metric.get()
+
+
+def test_equivalence_batchnorm_aux():
+    """BatchNorm moving mean/var are AUX state — donated and updated
+    inside the fused program; their trajectory must match the
+    phase-split forward/backward aux write-back exactly."""
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.BatchNorm(net, name="bn1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = sym.SoftmaxOutput(net, sym.Variable("softmax_label"),
+                            name="softmax")
+    pf, auxf, mf = _train_sym(net, True)
+    ps, auxs, ms = _train_sym(net, False)
+    assert auxf, "BatchNorm must expose moving-stat aux states"
+    for n in pf:
+        np.testing.assert_array_equal(pf[n], ps[n], err_msg=n)
+    for n in auxf:
+        np.testing.assert_array_equal(auxf[n], auxs[n], err_msg=n)
+    assert mf == ms
+
+
+def test_equivalence_dropout_rng():
+    """Dropout consumes the executor's step RNG: the fused step must
+    advance the SAME key sequence as the phase-split forward/backward,
+    one key per batch — masks, and therefore params, bit-identical."""
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.Dropout(net, p=0.5, name="drop1")
+    net = sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = sym.SoftmaxOutput(net, sym.Variable("softmax_label"),
+                            name="softmax")
+    pf, _, mf = _train_sym(net, True)
+    ps, _, ms = _train_sym(net, False)
+    for n in pf:
+        np.testing.assert_array_equal(pf[n], ps[n], err_msg=n)
+    assert mf == ms
