@@ -70,6 +70,11 @@ PROBE_GAP = 20
 RAW_TIMEOUT = 900
 RAW_MIN = 240          # don't bother launching a raw child with less
 MODULE_TIMEOUT = 540   # covers the fused AND phase-split fit measurements
+DP_TIMEOUT = 900       # the optional data-parallel fused-vs-kvstore A/B:
+                       # up to 2 legs PER axis size (vs module's 2 total),
+                       # so it gets the raw-child-scale budget; a kill
+                       # mid-sweep truncates to the sizes already banked
+                       # (stdout partials AND the artifact update per size)
 TOTAL_DEADLINE = float(os.environ.get("MXTPU_BENCH_DEADLINE", "1500"))
 
 
@@ -81,6 +86,7 @@ def _apply_budget_args(argv):
     is clipped to the time remaining under it). Returns argv with the
     budget flags stripped; unknown phase names fail loudly."""
     global TOTAL_DEADLINE, PROBE_TIMEOUT, RAW_TIMEOUT, MODULE_TIMEOUT
+    global DP_TIMEOUT
     vals, rest, i = [], [], 0
     while i < len(argv):
         a = argv[i]
@@ -96,14 +102,15 @@ def _apply_budget_args(argv):
             rest.append(a)
         i += 1
     names = {"probe": "PROBE_TIMEOUT", "raw": "RAW_TIMEOUT",
-             "module": "MODULE_TIMEOUT", "total": "TOTAL_DEADLINE"}
+             "module": "MODULE_TIMEOUT", "dp": "DP_TIMEOUT",
+             "total": "TOTAL_DEADLINE"}
     for v in vals:
         for part in v.split(","):
             if "=" in part:
                 k, s = part.split("=", 1)
                 if k not in names:
                     raise SystemExit("--budget-s: unknown phase %r "
-                                     "(probe|raw|module|total)" % k)
+                                     "(probe|raw|module|dp|total)" % k)
             else:
                 k, s = "total", part
             try:
@@ -338,25 +345,47 @@ def module_child():
     here is absorbed by the supervisor without touching the raw number."""
     import jax
     dev = _init_device(jax)
-    os.environ["MXNET_MODULE_FUSED_STEP"] = "1"
-    img_s, fallback = _module_fit_throughput(dev)
-    out = {"module_fit_img_s": round(img_s, 2)}
-    if fallback is not None:
-        # a silent fallback would record two phase-split numbers as the
-        # A/B — mark the leg so the number reads as what it measured
-        out["module_fit_fused_fallback"] = fallback
-    print(json.dumps(out), flush=True)
-    os.environ["MXNET_MODULE_FUSED_STEP"] = "0"
-    img_s, _ = _module_fit_throughput(dev)
-    out["module_fit_phase_split_img_s"] = round(img_s, 2)
-    print(json.dumps(out), flush=True)
+    old_pin = os.environ.get("MXNET_MODULE_FUSED_STEP")
+    try:
+        os.environ["MXNET_MODULE_FUSED_STEP"] = "1"
+        img_s, fallback = _module_fit_throughput(dev)
+        out = {"module_fit_img_s": round(img_s, 2)}
+        if fallback is not None:
+            # a silent fallback would record two phase-split numbers as
+            # the A/B — mark the leg so the number reads as what it
+            # measured
+            out["module_fit_fused_fallback"] = fallback
+        print(json.dumps(out), flush=True)
+        os.environ["MXNET_MODULE_FUSED_STEP"] = "0"
+        img_s, _ = _module_fit_throughput(dev)
+        out["module_fit_phase_split_img_s"] = round(img_s, 2)
+        print(json.dumps(out), flush=True)
+    finally:
+        _restore_pin(old_pin)
 
 
-def _module_fit_throughput(dev):
+def _restore_pin(old):
+    """Put MXNET_MODULE_FUSED_STEP back (the A/B children flip it; an
+    in-process caller — the harness tests drive the children directly —
+    must not inherit the last leg's pin)."""
+    if old is None:
+        os.environ.pop("MXNET_MODULE_FUSED_STEP", None)
+    else:
+        os.environ["MXNET_MODULE_FUSED_STEP"] = old
+
+
+def _module_fit_throughput(dev, contexts=None, kvstore="local"):
     """Throughput of the USER-FACING training path — Module.fit itself
     (symbolic ResNet-50, bf16 executor via the InferType pass, fp32
     master weights in the optimizer, metric updates included) — so
-    framework overhead above the raw fused step is a measured number."""
+    framework overhead above the raw fused step is a measured number.
+
+    ``contexts`` (default: one device) selects the data-parallel mesh:
+    the per-chip batch stays ``BATCH`` and the GLOBAL batch scales with
+    the axis size, so per-axis img/s reads as scaling efficiency.
+    ``kvstore`` feeds straight into Module.fit — the dp A/B runs the
+    fused-SPMD step (subsumed in-process kvstore) against the pinned-off
+    kvstore phase-split path."""
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -373,6 +402,9 @@ def _module_fit_throughput(dev):
     sym = get_symbol(num_classes=1000, num_layers=50,
                      image_shape="3,%d,%d" % (img, img))
     bf16 = np.dtype(jnp.bfloat16)
+    if contexts is None:
+        contexts = [mx.tpu() if dev.platform != "cpu" else mx.cpu()]
+    batch = BATCH * len(contexts)
 
     class _DeviceBatchIter(DataIter):
         """Synthetic iterator handing out the SAME device-resident batch
@@ -381,12 +413,12 @@ def _module_fit_throughput(dev):
         pipeline)."""
 
         def __init__(self, n):
-            super().__init__(BATCH)
+            super().__init__(batch)
             rs = np.random.RandomState(0)
             xb = jax.device_put(rs.uniform(
-                -1, 1, (BATCH, 3, img, img)).astype(np.float32), dev)
+                -1, 1, (batch, 3, img, img)).astype(np.float32), dev)
             yb = jax.device_put(rs.randint(
-                0, 1000, BATCH).astype(np.float32), dev)
+                0, 1000, batch).astype(np.float32), dev)
             from mxnet_tpu.ndarray.ndarray import _wrap
             self._batch = DataBatch([_wrap(xb.astype(bf16))],
                                     [_wrap(yb)], pad=0)
@@ -395,11 +427,11 @@ def _module_fit_throughput(dev):
 
         @property
         def provide_data(self):
-            return [DataDesc("data", (BATCH, 3, img, img), dtype=bf16)]
+            return [DataDesc("data", (batch, 3, img, img), dtype=bf16)]
 
         @property
         def provide_label(self):
-            return [DataDesc("softmax_label", (BATCH,))]
+            return [DataDesc("softmax_label", (batch,))]
 
         def reset(self):
             self.i = 0
@@ -410,14 +442,13 @@ def _module_fit_throughput(dev):
             self.i += 1
             return self._batch
 
-    mod = mx.mod.Module(sym, context=mx.tpu() if dev.platform != "cpu"
-                        else mx.cpu())
+    mod = mx.mod.Module(sym, context=contexts)
     opt_params = {"learning_rate": LR, "momentum": MOMENTUM,
                   "multi_precision": True}
     metric = mx.metric.Accuracy()
     warm = _DeviceBatchIter(3)
     # warmup epoch binds, initializes, and compiles the fused program
-    mod.fit(warm, eval_metric=metric, num_epoch=1,
+    mod.fit(warm, eval_metric=metric, num_epoch=1, kvstore=kvstore,
             initializer=mx.initializer.Xavier(),
             optimizer="sgd", optimizer_params=opt_params)
     # The fit loop is fully asynchronous (fused one-dispatch update,
@@ -430,7 +461,7 @@ def _module_fit_throughput(dev):
     marks = []
     n = max(n_iters, 40)
     timed = _DeviceBatchIter(n)
-    mod.fit(timed, eval_metric=metric, num_epoch=1,
+    mod.fit(timed, eval_metric=metric, num_epoch=1, kvstore=kvstore,
             optimizer="sgd", optimizer_params=opt_params,
             batch_end_callback=lambda p: marks.append(time.perf_counter()))
     # drain the queue: fetch every trainable param so the clock covers
@@ -439,7 +470,90 @@ def _module_fit_throughput(dev):
     float(sum(_jnp.sum(mod._exec.arg_dict[name]._data)
               for name in mod._param_names))
     dt = time.perf_counter() - marks[0]
-    return BATCH * (len(marks) - 1) / dt, mod._fused_fallback_reason
+    return batch * (len(marks) - 1) / dt, mod._fused_fallback_reason
+
+
+def dp_child():
+    """Data-parallel A/B child: Module.fit through the fused-SPMD step
+    (in-process kvstore subsumed into the ONE mesh program) vs the
+    kvstore phase-split path, per dp-axis size, per-chip batch pinned at
+    BATCH. Every axis size's numbers are printed the moment they exist
+    (partial-result emission — a hang at a larger axis size salvages the
+    smaller ones), and the final object is also banked into the
+    MULTICHIP artifact dir so the scaling trajectory is recorded per
+    round. In smoke mode the mesh is the virtual 8-device CPU host."""
+    import jax
+    if SMOKE:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+    dev = _init_device(jax)
+    import mxnet_tpu as mx
+    n_dev = len([d for d in jax.devices() if d.platform == dev.platform])
+    axes_env = os.environ.get("MXTPU_BENCH_DP_AXES", "")
+    if axes_env:
+        sizes = [int(s) for s in axes_env.split(",")]
+        dropped = [k for k in sizes if k > n_dev]
+        if dropped:
+            # skip ONLY the oversized entries — later valid sizes in the
+            # operator's list must still be measured
+            print("bench: dp axis size(s) %s exceed %d devices, skipped"
+                  % (dropped, n_dev), file=sys.stderr, flush=True)
+        sizes = [k for k in sizes if k <= n_dev]
+    else:
+        sizes, k = [], 1
+        while k <= n_dev:
+            sizes.append(k)
+            k *= 2
+    mk_ctx = mx.tpu if dev.platform != "cpu" else mx.cpu
+    out = {"lane": "dp_ab", "device": dev.device_kind,
+           "n_devices": n_dev, "per_chip_batch": BATCH, "dp": {}}
+    old_pin = os.environ.get("MXNET_MODULE_FUSED_STEP")
+    try:
+        for k in sizes:
+            contexts = [mk_ctx(i) for i in range(k)]
+            # at k=1 _create_kvstore resolves 'device' to NO kvstore, so
+            # the split leg is the plain phase-split baseline — mark it
+            # so the table never reads as a kvstore measurement there
+            entry = {"split_kvstore_active": k > 1}
+            os.environ["MXNET_MODULE_FUSED_STEP"] = "1"
+            img_s, fallback = _module_fit_throughput(dev, contexts=contexts,
+                                                     kvstore="device")
+            entry["fused_img_s"] = round(img_s, 2)
+            if fallback is not None:
+                # a silently fallen-back leg must not read as a fused
+                # number
+                entry["fused_fallback"] = getattr(fallback, "code",
+                                                  str(fallback))
+            os.environ["MXNET_MODULE_FUSED_STEP"] = "0"
+            img_s, _ = _module_fit_throughput(dev, contexts=contexts,
+                                              kvstore="device")
+            entry["kvstore_img_s"] = round(img_s, 2)
+            out["dp"][str(k)] = entry
+            print(json.dumps(dict(out, partial=True)), flush=True)
+            # re-bank the artifact after EVERY axis size: a hang/kill at
+            # a larger mesh (the failure mode this lane exists to catch)
+            # must not lose the sizes already measured
+            _write_dp_artifact(dict(out, ok=False, skipped=False,
+                                    truncated=True))
+    finally:
+        _restore_pin(old_pin)
+    print(json.dumps(out), flush=True)
+    _write_dp_artifact(dict(out, ok=True, skipped=False))
+
+
+def _write_dp_artifact(obj):
+    """MULTICHIP artifact schema superset: n_devices/ok/skipped plus the
+    per-axis-size img/s table (ok=False+truncated=True until the sweep
+    completes, so a killed run reads as partial, not as a clean round)."""
+    art_dir = os.environ.get("MXTPU_ARTIFACT_DIR", "/tmp/mxtpu_artifacts")
+    try:
+        os.makedirs(art_dir, exist_ok=True)
+        with open(os.path.join(art_dir, "multichip_dp_ab.json"), "w") as f:
+            f.write(json.dumps(obj) + "\n")
+    except OSError as e:
+        print("bench: dp artifact write failed: %s" % e, file=sys.stderr)
 
 
 def _last_json_line(text):
@@ -576,6 +690,19 @@ def supervise():
             print("bench: module phase yielded no number (raw result kept)",
                   file=sys.stderr, flush=True)
 
+    # data-parallel A/B (fused-SPMD vs kvstore phase-split per axis
+    # size) — optional like the module phase, banked as partials
+    if (os.environ.get("MXTPU_BENCH_DP", "1") == "1"
+            and remaining() > 180):
+        dp_out, _ = _run_phase("--dp-child", phase_budget(DP_TIMEOUT))
+        if dp_out and dp_out.get("dp"):
+            out["dp"] = dp_out["dp"]
+            out["dp_per_chip_batch"] = dp_out.get("per_chip_batch", BATCH)
+            print(json.dumps(dict(out, partial=True)), flush=True)
+        else:
+            print("bench: dp phase yielded no number (raw result kept)",
+                  file=sys.stderr, flush=True)
+
     # opportunistic A/B of the fused BN-tail kernel (PERF.md: the
     # end-to-end number, not the isolated pass, decides the knob)
     if (os.environ.get("MXTPU_BENCH_AB", "1") == "1"
@@ -603,5 +730,7 @@ if __name__ == "__main__":
         probe()
     elif "--module-child" in _argv:
         module_child()
+    elif "--dp-child" in _argv:
+        dp_child()
     else:
         sys.exit(supervise())

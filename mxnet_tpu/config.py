@@ -57,7 +57,10 @@ _KNOBS = {
                                  "(jax.checkpoint)"),
     "MXNET_MODULE_FUSED_STEP": ("honored", "Module.fit/fused_step compile "
                                 "forward+backward+optimizer+metric into "
-                                "ONE donated-buffer XLA program (default "
+                                "ONE donated-buffer XLA program — on a "
+                                "multi-context dp mesh ONE SPMD program "
+                                "with the gradient all-reduce inside "
+                                "(in-process kvstores subsumed). Default "
                                 "on; =0 pins the phase-split path — the "
                                 "PERF.md \"Module.fit gap\" A/B)"),
     "MXNET_FUSED_BN_ADD_RELU": ("honored", "model-zoo ResNet V1 block "

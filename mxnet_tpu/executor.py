@@ -344,7 +344,7 @@ class _GraphProgram:
         return self._jit_cache[key]
 
     def train_step_fn(self, update_names, add_names, input_dtypes, cache_key,
-                      build_update_fn, build_metric_fn):
+                      build_update_fn, build_metric_fn, spmd=None):
         """Whole-training-step program: forward + backward + optimizer
         update (+ metric accumulation when ``build_metric_fn`` is given)
         traced into ONE jitted XLA function, with the parameter,
@@ -362,13 +362,25 @@ class _GraphProgram:
         cache miss; ``cache_key`` must capture everything their closures
         depend on (optimizer statics, state layout, metric identity).
         Grouped (group2ctx) programs cannot ride — callers fall back to
-        the phase-split path."""
+        the phase-split path.
+
+        ``spmd`` (a ``parallel.spmd.DataParallelSpec``) selects the SPMD
+        variant: the SAME step is jitted with explicit NamedShardings —
+        batch inputs split over the data axis, params/optimizer state/
+        metric accumulator/aux replicated (still donated) — so XLA GSPMD
+        compiles ONE program over the whole mesh with the cross-replica
+        gradient psum, the optimizer update and the metric reduction
+        fused INSIDE the step (no software kvstore staging, no host-side
+        batch splitting: the global batch arrives via one sharded
+        device_put). The replicated metric accumulator comes back already
+        psummed across replicas, so fetching it needs no extra program.
+        """
         if self.node_devices:
             raise MXNetError("train_step_fn: grouped programs run eagerly "
                              "per segment and cannot fuse the train step")
         key = ("train_step", tuple(update_names), tuple(sorted(add_names)),
                tuple(sorted(input_dtypes.items(), key=lambda kv: kv[0])),
-               cache_key)
+               cache_key, spmd)
         fn = self._jit_cache.get(key)
         if fn is not None:
             return fn
@@ -415,7 +427,22 @@ class _GraphProgram:
                 else metric_acc
             return new_params, new_states, new_acc, new_aux, outs, grads_out
 
-        fn = jax.jit(step, donate_argnums=(0, 1, 2, 3))
+        if spmd is None:
+            fn = jax.jit(step, donate_argnums=(0, 1, 2, 3))
+        else:
+            repl, dsh = spmd.repl_sharding, spmd.data_sharding
+            # args: (params, opt_states, metric_acc, aux, inputs, rng,
+            #        lrs, wds, ts, add_grads) — each entry is a pytree
+            # PREFIX broadcast over its subtree. The batch-sharded inputs
+            # plus replicated params force GSPMD to insert the gradient
+            # all-reduce (psum over the dp axis) inside the step; output
+            # shardings are propagated (params/state/acc come out
+            # replicated, per-example outputs batch-sharded), which keeps
+            # donation buffer-compatible.
+            fn = jax.jit(step,
+                         in_shardings=(repl, repl, repl, repl, dsh,
+                                       repl, repl, repl, repl, repl),
+                         donate_argnums=(0, 1, 2, 3))
         self._jit_cache[key] = fn
         return fn
 

@@ -98,7 +98,20 @@ class DataIter:
 
 class NDArrayIter(DataIter):
     """Iterate over in-memory arrays (parity: io.NDArrayIter — the workhorse
-    of tests and small trainers)."""
+    of tests and small trainers).
+
+    Last-batch semantics under the data-parallel mesh: with
+    ``last_batch_handle='pad'`` (the default) a short final batch is
+    padded BY WRAPPING from the epoch head, so every emitted batch keeps
+    the full ``batch_size`` — divisibility over the dp axis is checked
+    ONCE at bind time and holds for every batch. ``DataBatch.pad``
+    reports the wrapped count: ``predict``/``iter_predict`` slice those
+    rows off; ``fit`` metrics include them (reference parity — epoch
+    metrics over a padded tail count the wrapped rows). ``'discard'``
+    drops the short tail instead. The iterator never emits a batch whose
+    size differs from ``batch_size``; a hand-built DataBatch whose
+    global batch does NOT divide over the dp axis is rejected by the
+    Module feed path with a clear error — never silently padded."""
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
@@ -151,11 +164,11 @@ class NDArrayIter(DataIter):
         for name, arr in arrays:
             idx = self._order[self.cursor:self.cursor + self.batch_size]
             part = arr[idx]
-            if len(idx) < self.batch_size:  # pad by wrapping (parity: 'pad')
-                if self.last_batch_handle == "roll_over":
-                    extra = self._order[:self.batch_size - len(idx)]
-                else:
-                    extra = self._order[:self.batch_size - len(idx)]
+            if len(idx) < self.batch_size:
+                # pad by wrapping from the epoch head (parity: 'pad';
+                # 'roll_over' emits the same full-size batch — every
+                # batch keeps batch_size, which the dp mesh requires)
+                extra = self._order[:self.batch_size - len(idx)]
                 part = np.concatenate([part, arr[extra]], axis=0)
             out.append(_nd_array(part))
         return out

@@ -70,6 +70,19 @@ class KVStore:
                 return int(os.environ.get("DMLC_NUM_WORKER", 1))
         return 1
 
+    @property
+    def fused_step_subsumable(self):
+        """True when a single-program SPMD train step may SUBSUME this
+        store's gradient reduction: the in-process aggregation types
+        (``local``/``device``/``nccl`` — on TPU one implementation,
+        because the dp Module compiles ONE mesh-sharded program whose
+        gradients come out of the step already all-reduced over ICI, so
+        the software push/pull is an identity round-trip). ``dist_*``
+        stores cross worker processes outside the compiled program and
+        gradient compression changes the pushed values — both must keep
+        the explicit push/pull path."""
+        return not self.type.startswith("dist") and self._compression is None
+
     # -- core ops ----------------------------------------------------------
     def init(self, key, value):
         """(parity: kvstore.init) one key or lists of keys/values."""

@@ -148,13 +148,20 @@ class EvalMetric:
         training program: ``(labels, preds, acc) -> new_acc`` over traced
         arrays, or None when this metric can only accumulate eagerly
         (Module.fit then falls back to the phase-split ``update`` path
-        for the metric — see module/module.py ``_fused_batch_step``)."""
+        for the metric — see module/module.py ``_fused_batch_step``).
+
+        Under the dp-mesh SPMD step the kernel traces over BATCH-SHARDED
+        labels/preds and a replicated accumulator: the reduction to the
+        scalar makes GSPMD insert the cross-replica psum inside the step
+        program, so the accumulator handed back to ``_install_fused`` is
+        already the GLOBAL sum — fetching it costs no extra program."""
         return None
 
     def _install_fused(self, dev_sum, n):
         """Adopt the accumulator returned by a fused train step (the
         device value is fetched lazily at ``get()``, like the eager
-        ``_accum_device`` path)."""
+        ``_accum_device`` path). ``dev_sum`` is the global (mesh-psummed)
+        running sum and ``n`` the GLOBAL instance count."""
         self._dev_sum = dev_sum
         self.num_inst += n
 

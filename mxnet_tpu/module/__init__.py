@@ -1,5 +1,5 @@
 """Module API (parity: python/mxnet/module/__init__.py)."""
-from .base_module import BaseModule
+from .base_module import BaseModule, FusedFallback, FUSED_FALLBACK_CODES
 from .module import Module
 from .bucketing_module import BucketingModule
 from .sequential_module import SequentialModule

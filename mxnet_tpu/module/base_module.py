@@ -17,6 +17,42 @@ from ..model import BatchEndParam
 from ..initializer import Uniform
 
 
+# stable fallback reason codes -> what they mean. Bench lanes and tests
+# assert on CODES; the human-readable message may reword freely.
+FUSED_FALLBACK_CODES = {
+    "env_pin": "MXNET_MODULE_FUSED_STEP=0 pins the phase-split A/B leg",
+    "monitor": "per-op monitor taps need the phase-split programs",
+    "kvstore_dist": "dist_* kvstore push/pull crosses worker processes",
+    "kvstore_compression": "gradient compression changes pushed values",
+    "group2ctx": "grouped (group2ctx) programs run eagerly per segment",
+    "no_fused_updater": "updater has no fused batch path",
+    "inputs_need_grad": "data gradients are phase-split only",
+    "optimizer_kernel": "optimizer has no pure SPMD batch kernel",
+    "centered_rmsprop": "centered RMSProp state layout",
+    "no_trainable_params": "nothing to update",
+    "state_layout": "optimizer state layout not expressible as a kernel",
+    "missing_input": "bound input missing from the executor arg dict",
+    "unfed_graph_arg": "graph argument not fed by the fused step",
+    "not_initialised": "module not fully initialised",
+}
+
+
+class FusedFallback(str):
+    """Why one step ran phase-split instead of fused. A ``str`` subclass
+    so every existing message-text consumer (tests, bench JSON, logs)
+    keeps working unchanged; ``code`` is the STABLE enumerable identity
+    (one of ``FUSED_FALLBACK_CODES``) for bench lanes and tests to
+    assert on, and ``detail`` carries the free-form specifics."""
+    __slots__ = ("code", "detail")
+
+    def __new__(cls, code, message, detail=None):
+        assert code in FUSED_FALLBACK_CODES, code
+        self = str.__new__(cls, message)
+        self.code = code
+        self.detail = message if detail is None else detail
+        return self
+
+
 class BaseModule:
     """(parity: base_module.BaseModule)"""
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 import logging
 
 from ..base import MXNetError
-from .base_module import BaseModule
+from .base_module import BaseModule, FusedFallback
 from .module import Module
 
 
@@ -181,9 +181,11 @@ class BucketingModule(BaseModule):
 
     @property
     def _fused_fallback_reason(self):
-        """Why the CURRENT bucket's last step phase-split (None = fused)."""
+        """Why the CURRENT bucket's last step phase-split (None = fused);
+        a ``FusedFallback`` str carrying the stable reason ``code``."""
         if self._curr_module is None:
-            return "module not fully initialised"
+            return FusedFallback("not_initialised",
+                                 "module not fully initialised")
         return self._curr_module._fused_fallback_reason
 
     def backward(self, out_grads=None):

@@ -3,13 +3,16 @@
 Parity: reference ``python/mxnet/module/executor_group.py:128`` which
 splits each batch across GPU contexts and keeps one executor per device
 (decide_slices:266). TPU-native design: batch splitting across chips is a
-SHARDING of one executor's program, not N executors — XLA partitions the
-program over the mesh and inserts ICI collectives (see mxnet_tpu.parallel).
-This class keeps the reference API for code that instantiates it directly,
-delegating to a single Executor. The performance-critical train loop does
-NOT live here: ``Module.fit``/``Module.fused_step`` compile the whole
-step (forward+backward+optimizer+metric) into one donated-buffer XLA
-program (``executor._GraphProgram.train_step_fn``; PERF.md "Module.fit
+SHARDING of one executor's program, not N executors — a multi-context
+group commits the dp mesh placements (batch split over the ``dp`` axis,
+params/grads replicated) on its ONE executor, and each fed batch is a
+single sharded device_put of the GLOBAL batch (no decide_slices host
+splitting); XLA partitions the program over the mesh and inserts the ICI
+collectives (see mxnet_tpu.parallel). This class keeps the reference API
+for code that instantiates it directly. The performance-critical train
+loop does NOT live here: ``Module.fit``/``Module.fused_step`` compile the
+whole step (forward+backward+optimizer+metric) into one donated-buffer
+XLA program (``executor._GraphProgram.train_step_fn``; PERF.md "Module.fit
 gap") — this facade only covers the reference's phase-by-phase surface.
 """
 from __future__ import annotations
@@ -19,7 +22,9 @@ from ..base import MXNetError
 
 def decide_slices(batch_size, work_load_list):
     """Split a batch between workers proportionally (parity:
-    executor_group.decide_slices:266); retained for API compatibility."""
+    executor_group.decide_slices:266); retained for API compatibility —
+    the TPU-native path does NOT slice on the host, it shards ONE
+    device_put over the mesh (see DataParallelExecutorGroup)."""
     total = sum(work_load_list)
     slices = []
     start = 0
@@ -60,6 +65,28 @@ class DataParallelExecutorGroup:
                 reqs[name] = "write" if inputs_need_grad else "null"
         self.execs = [symbol.simple_bind(ctx=contexts[0], grad_req=reqs,
                                          **shape_kwargs)]
+        self._dp_spec = None
+        if len(contexts) > 1:
+            self._init_dp(shape_kwargs, state_names)
+
+    def _init_dp(self, shape_kwargs, state_names):
+        """Commit the dp-mesh placements on the single executor: the
+        global batch must divide over the data axis (same clear error as
+        Module.bind — no silent pad), inputs shard over ``dp``, params/
+        grads replicate (the shared ``commit_dp_placements`` rule —
+        Module commits the same way). GSPMD then splits every program
+        this executor runs and inserts the gradient all-reduce."""
+        from ..parallel import mesh as _pmesh, spmd as _spmd
+        spec = _spmd.dp_spec(_pmesh.mesh_from_contexts(self.contexts))
+        for shapes in (self.data_shapes, self.label_shapes):
+            for d in shapes:
+                shape = d[1] if isinstance(d, (list, tuple)) else d.shape
+                if shape:
+                    _spmd.check_batch_divisible(shape[0], spec.num_devices,
+                                                "batch size")
+        self._dp_spec = spec
+        input_names = set(shape_kwargs) | set(state_names or ())
+        _spmd.commit_dp_placements(self.execs[0], input_names, spec)
 
     def forward(self, data_batch, is_train=None):
         """Install the batch into bound storage and run the forward

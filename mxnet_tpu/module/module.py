@@ -25,7 +25,7 @@ from ..initializer import Uniform, InitDesc
 from ..model import _create_kvstore, save_checkpoint, load_checkpoint
 from .. import optimizer as opt
 from ..ndarray.ndarray import NDArray, zeros, _wrap
-from .base_module import BaseModule, _as_list
+from .base_module import BaseModule, FusedFallback, _as_list
 
 
 class Module(BaseModule):
@@ -65,6 +65,7 @@ class Module(BaseModule):
         self._label_shapes = None
         self._grad_req = None
         self._mesh = None
+        self._dp_spec = None
         self._data_sharding = None
         self._repl_sharding = None
         self._fused_fallback_reason = None
@@ -184,37 +185,25 @@ class Module(BaseModule):
         program is GSPMD-sharded — batch over the ``dp`` axis, params
         replicated — so XLA inserts the gradient all-reduce over ICI
         inside the fused fwd+bwd step."""
-        import jax
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-        devs = [c.jax_device() for c in self._context]
-        if len(set(devs)) != len(devs):
-            raise MXNetError("duplicate devices in context list %s"
-                             % (self._context,))
+        from ..parallel import mesh as _pmesh, spmd as _spmd
+        n = len(self._context)
         for d in self._data_shapes + self._label_shapes:
-            if d.shape and d.shape[0] % len(devs) != 0:
-                raise MXNetError(
-                    "batch size %d not divisible by %d devices"
-                    % (d.shape[0], len(devs)))
-        self._mesh = Mesh(np.array(devs), ("dp",))
-        self._data_sharding = NamedSharding(self._mesh, P("dp"))
-        self._repl_sharding = NamedSharding(self._mesh, P())
+            if d.shape:
+                _spmd.check_batch_divisible(d.shape[0], n, "batch size")
+        spec = _spmd.dp_spec(_pmesh.mesh_from_contexts(self._context))
+        self._dp_spec = spec
+        self._mesh = spec.mesh
+        self._data_sharding = spec.data_sharding
+        self._repl_sharding = spec.repl_sharding
         self._shard_exec_arrays()
 
     def _shard_exec_arrays(self):
         """Commit shardings: data/label batch-sharded, params/grads/aux
         replicated. GSPMD propagates from these committed placements."""
-        import jax
+        from ..parallel import spmd as _spmd
         input_names = set(self._data_names) | set(self._label_names) \
             | set(self._state_names)
-        for name, arr in self._exec.arg_dict.items():
-            sh = self._data_sharding if name in input_names \
-                else self._repl_sharding
-            arr._set_data(jax.device_put(arr._data, sh))
-        for arr in self._exec.grad_arrays:
-            if arr is not None:
-                arr._set_data(jax.device_put(arr._data, self._repl_sharding))
-        for arr in self._exec.aux_arrays:
-            arr._set_data(jax.device_put(arr._data, self._repl_sharding))
+        _spmd.commit_dp_placements(self._exec, input_names, self._dp_spec)
 
     # -- params ------------------------------------------------------------
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
@@ -355,15 +344,21 @@ class Module(BaseModule):
     def _write_input(self, dst, src):
         if self._mesh is not None:
             # commit the batch sharded over dp so GSPMD splits the step;
-            # keep the bound placeholder's dtype (as copyto/setitem do)
-            import jax
-            dt = dst._data.dtype
+            # keep the bound placeholder's dtype (as copyto/setitem do).
+            # A reshaped (variable-batch) feed must stay divisible — the
+            # sharded device_put would otherwise die inside XLA
+            from ..parallel import spmd as _spmd
             raw = src._data if isinstance(src, NDArray) else np.asarray(src)
+            if raw.shape:
+                _spmd.check_batch_divisible(raw.shape[0],
+                                            self._dp_spec.num_devices,
+                                            "batch size")
+            dt = dst._data.dtype
             if isinstance(raw, np.ndarray):
-                raw = jax.device_put(raw.astype(dt, copy=False),
-                                     self._data_sharding)
+                raw = _spmd.shard_put(raw.astype(dt, copy=False),
+                                      self._data_sharding)
             else:
-                raw = jax.device_put(raw, self._data_sharding).astype(dt)
+                raw = _spmd.shard_put(raw, self._data_sharding).astype(dt)
             dst._set_data(raw)
         elif isinstance(src, NDArray):
             src.copyto(dst)
@@ -411,12 +406,19 @@ class Module(BaseModule):
         correctness oracle. The reason for the last fallback is kept in
         ``_fused_fallback_reason``.
 
-        Fallback rules (each mirrors a real constraint):
-        - ``MXNET_MODULE_FUSED_STEP=0`` — the A/B pin
+        Fallback rules (each mirrors a real constraint; the recorded
+        reason is a ``FusedFallback`` — a str with a stable ``.code``):
+        - ``MXNET_MODULE_FUSED_STEP=0`` — the A/B pin (``env_pin``)
         - grouped (group2ctx) programs — eager per-segment execution
         - monitor installed — per-op taps need the phase-split programs
-        - kvstore-mediated updates — push/pull is not a pure function
-          of (params, grads)
+        - ``dist_*`` kvstores (``kvstore_dist``) — push/pull crosses
+          worker processes outside the compiled program — and stores
+          with gradient compression (``kvstore_compression``). The
+          in-process types (``local``/``device``/``nccl``) are SUBSUMED:
+          on the dp mesh the gradient all-reduce rides inside the SPMD
+          step program, so their push/pull is an identity round-trip
+          the fused step skips (store weights are kept coherent so a
+          mid-training fallback continues seamlessly)
         - optimizers without a pure batch kernel (no SPMD kernel
           mapping, centered RMSProp, inexpressible state layouts) or a
           non-Fused updater
@@ -431,50 +433,68 @@ class Module(BaseModule):
         not program rebuilds.
         """
         if not fused_fit():
-            self._fused_fallback_reason = "MXNET_MODULE_FUSED_STEP=0"
+            self._fused_fallback_reason = FusedFallback(
+                "env_pin", "MXNET_MODULE_FUSED_STEP=0")
             return False
         ex = self._exec
         if ex is not None and ex._monitor_callback is not None:
-            self._fused_fallback_reason = "monitor installed"
+            self._fused_fallback_reason = FusedFallback(
+                "monitor", "monitor installed")
             return False
-        if self._kvstore is not None or self._update_on_kvstore:
-            self._fused_fallback_reason = "kvstore-mediated update"
+        kv = self._kvstore
+        if kv is not None and not kv.fused_step_subsumable:
+            if kv.type.startswith("dist"):
+                self._fused_fallback_reason = FusedFallback(
+                    "kvstore_dist", "kvstore-mediated update",
+                    "kvstore type %r crosses worker processes" % kv.type)
+            else:
+                self._fused_fallback_reason = FusedFallback(
+                    "kvstore_compression", "kvstore-mediated update",
+                    "gradient compression changes the pushed values")
             return False
+        # an in-process kvstore's reduce is subsumed by the SPMD step;
+        # with update_on_kvstore the kvstore's server-side updater owns
+        # the optimizer state, so the plan runs THAT updater's kernels
+        updater = kv._updater if (kv is not None
+                                  and self._update_on_kvstore) \
+            else self._updater
         plan = self._fused_plan
         packed = None
         if (plan is None or plan["exec"] is not ex
-                or plan["updater"] is not self._updater
+                or plan["updater"] is not updater
+                or plan["kvstore"] is not kv
                 or plan["optimizer"] is not self._optimizer
                 or plan["metric"] is not eval_metric
                 or plan["has_label"] != (data_batch.label is not None)):
             plan = self._fused_plan = self._build_fused_plan(
-                data_batch, eval_metric)
+                data_batch, eval_metric, updater)
         else:
             # hyperparameters baked into the program as statics can be
             # mutated on the live optimizer object — verify per step
             try:
                 kname, hyper = plan["hyper_fn"](self._optimizer)
             except MXNetError as e:
-                self._fused_fallback_reason = str(e)
+                self._fused_fallback_reason = FusedFallback(
+                    "optimizer_kernel", str(e))
                 self._fused_plan = None
                 return False
             statics = tuple(sorted(
                 (k, v) for k, v in hyper.items() if k not in ("lr", "wd")))
             if kname != plan["kname"] or statics != plan["statics"]:
                 plan = self._fused_plan = self._build_fused_plan(
-                    data_batch, eval_metric)
+                    data_batch, eval_metric, updater)
             else:
                 # optimizer state re-gathered every step: layouts can
                 # drift under the plan (load_optimizer_states swaps the
                 # state NDArrays) and states for late parameters are
                 # created here
-                packed, mp, inner_n = self._updater._gather_batch(
+                packed, mp, inner_n = updater._gather_batch(
                     plan["kname"], plan["indices"], plan["weights"])
                 if packed is None or tuple(mp) != plan["mp"] \
                         or tuple(inner_n) != plan["inner_n"]:
                     packed = None
                     plan = self._fused_plan = self._build_fused_plan(
-                        data_batch, eval_metric)
+                        data_batch, eval_metric, updater)
         if plan is None:
             return False
         if packed is None:
@@ -482,43 +502,54 @@ class Module(BaseModule):
             packed = plan.pop("packed")
         return self._run_fused_step(plan, packed, data_batch, eval_metric)
 
-    def _build_fused_plan(self, data_batch, eval_metric):
+    def _build_fused_plan(self, data_batch, eval_metric, updater=None):
         """Run the full fusion-eligibility cascade and assemble the
         per-module plan ``_fused_batch_step`` executes from: parameter
-        ordering, the jitted whole-step program, and the metric device
-        kernel. Returns None (with ``_fused_fallback_reason`` set) when
-        any piece can't ride."""
+        ordering, the jitted whole-step program (SPMD-sharded over the
+        dp mesh for a multi-context bind), and the metric device kernel.
+        ``updater`` is the EFFECTIVE updater (the kvstore's server-side
+        one under update_on_kvstore, else the module's). Returns None
+        (with ``_fused_fallback_reason`` set) when any piece can't
+        ride."""
         if not (self.binded and self.params_initialized
                 and self.optimizer_initialized):
-            self._fused_fallback_reason = "module not fully initialised"
+            self._fused_fallback_reason = FusedFallback(
+                "not_initialised", "module not fully initialised")
             return None
         ex = self._exec
         if ex._prog.node_devices:
-            self._fused_fallback_reason = "group2ctx grouped program"
+            self._fused_fallback_reason = FusedFallback(
+                "group2ctx", "group2ctx grouped program")
             return None
-        updater = self._updater
+        if updater is None:
+            updater = self._updater
         if not isinstance(updater, opt.FusedUpdater):
-            self._fused_fallback_reason = "updater has no fused batch path"
+            self._fused_fallback_reason = FusedFallback(
+                "no_fused_updater", "updater has no fused batch path")
             return None
         if self.inputs_need_grad:
-            self._fused_fallback_reason = "inputs_need_grad"
+            self._fused_fallback_reason = FusedFallback(
+                "inputs_need_grad", "inputs_need_grad")
             return None
         optimizer = self._optimizer
         from ..parallel import opt_kernels as _ok
         try:
             kname, hyper = _ok.hyper_from_optimizer(optimizer)
         except MXNetError as e:
-            self._fused_fallback_reason = str(e)
+            self._fused_fallback_reason = FusedFallback(
+                "optimizer_kernel", str(e))
             return None
         if getattr(optimizer, "centered", False):
-            self._fused_fallback_reason = "centered RMSProp state layout"
+            self._fused_fallback_reason = FusedFallback(
+                "centered_rmsprop", "centered RMSProp state layout")
             return None
 
         arg_dict = ex.arg_dict
         live = [(i, n) for i, n in enumerate(self._param_names)
                 if self._grad_req.get(n, "null") != "null"]
         if not live:
-            self._fused_fallback_reason = "no trainable parameters"
+            self._fused_fallback_reason = FusedFallback(
+                "no_trainable_params", "no trainable parameters")
             return None
         indices = [i for i, _ in live]
         update_names = tuple(n for _, n in live)
@@ -527,8 +558,9 @@ class Module(BaseModule):
         weights = [arg_dict[n] for n in update_names]
         packed, mp, inner_n = updater._gather_batch(kname, indices, weights)
         if packed is None:
-            self._fused_fallback_reason = \
-                "optimizer state layout not expressible as a kernel step"
+            self._fused_fallback_reason = FusedFallback(
+                "state_layout",
+                "optimizer state layout not expressible as a kernel step")
             return None
 
         has_label = data_batch.label is not None
@@ -557,7 +589,8 @@ class Module(BaseModule):
             input_names += label_inputs
         input_names += list(self._state_names)
         if any(n not in arg_dict for n in input_names):
-            self._fused_fallback_reason = (
+            self._fused_fallback_reason = FusedFallback(
+                "missing_input",
                 "bound input(s) missing from the executor arg dict: "
                 + ", ".join(sorted(n for n in input_names
                                    if n not in arg_dict)))
@@ -569,7 +602,8 @@ class Module(BaseModule):
         # label-less batch, cannot ride the pure-function program
         missing = graph_args.difference(self._param_names, input_names)
         if missing:
-            self._fused_fallback_reason = (
+            self._fused_fallback_reason = FusedFallback(
+                "unfed_graph_arg",
                 "graph argument(s) not fed by the fused step: "
                 + ", ".join(sorted(missing)))
             return None
@@ -591,9 +625,21 @@ class Module(BaseModule):
             update_names, add_names, input_dtypes, cache_key,
             build_update_fn=lambda: opt._make_batch_update(
                 kname, dict(statics), list(mp), list(inner_n)),
-            build_metric_fn=build_metric_fn if kernel is not None else None)
+            build_metric_fn=build_metric_fn if kernel is not None else None,
+            spmd=self._dp_spec)
+        # a SUBSUMED update_on_kvstore store holds its own canonical
+        # weight copies (push updates them, pull serves them); the fused
+        # step keeps them coherent with zero-cost pointer swaps so a
+        # mid-training fallback (or save_checkpoint via pull) continues
+        # from the right values
+        kv = self._kvstore
+        store_sync = []
+        if kv is not None and self._update_on_kvstore:
+            store_sync = [(n, kv._store[i]) for i, n in live
+                          if i in kv._store]
         return {
             "exec": ex, "updater": updater, "optimizer": optimizer,
+            "kvstore": kv, "store_sync": store_sync,
             "metric": eval_metric, "has_label": has_label,
             "kname": kname, "statics": statics,
             "hyper_fn": _ok.hyper_from_optimizer,
@@ -623,12 +669,28 @@ class Module(BaseModule):
 
         mesh = self._mesh
         sharding = self._data_sharding
+        import jax
+        dev = None if mesh is not None else self._context[0].jax_device()
 
         def _raw(arr):
             raw = arr._data if isinstance(arr, NDArray) else np.asarray(arr)
             if mesh is not None:
-                import jax
-                raw = jax.device_put(raw, sharding)
+                # one sharded device_put of the GLOBAL batch — each
+                # device receives its shard, no host-side splitting
+                from ..parallel import spmd as _spmd
+                if raw.shape:
+                    _spmd.check_batch_divisible(
+                        raw.shape[0], self._dp_spec.num_devices,
+                        "batch size")
+                raw = _spmd.shard_put(raw, sharding)
+            else:
+                # batch arrays ride as jit arguments without a copy into
+                # bound storage, so THIS is where they must commit to
+                # the module's device (a module on a non-default device
+                # fed default-device arrays would otherwise crash the
+                # program with mixed committed inputs; same-device puts
+                # are a no-op)
+                raw = jax.device_put(raw, dev)
             return raw
 
         inputs = {}
@@ -667,7 +729,13 @@ class Module(BaseModule):
             acc = getattr(eval_metric, "_dev_sum", None)
             if acc is None:
                 import jax.numpy as jnp
+                # a fresh accumulator commits to the module's placement
+                # (the mesh program reshards via in_shardings; a single-
+                # device module must not introduce a default-device
+                # operand)
                 acc = jnp.zeros((), jnp.float32)
+                if dev is not None:
+                    acc = jax.device_put(acc, dev)
         rng = ex._step_key()
 
         record_dispatch("train_step")
@@ -688,6 +756,10 @@ class Module(BaseModule):
         # (add_grads above already established every 'add' grad exists)
         for n in add_names:
             grad_dict[n]._set_data(grads_out[n])
+        # subsumed update_on_kvstore: refresh the store's canonical
+        # weight copies (pointer swaps — no device work)
+        for n, store_arr in plan["store_sync"]:
+            store_arr._set_data(new_params[n])
         ex.outputs = [_wrap(o, ex._out_ctx(i)) for i, o in enumerate(outs)]
         if kernel is not None:
             n_inst = sum(int(r.size) for r in label_raws)

@@ -638,16 +638,28 @@ class Updater:
 
     def _sync_state(self, index, weight):
         """Host states from set_states -> NDArrays on the weight's context
-        (parity: optimizer.Updater.sync_state_context)."""
+        (parity: optimizer.Updater.sync_state_context). A weight that is
+        committed to a device mesh (the dp Module replicates params over
+        the ``dp`` axis) pulls the state onto the SAME placement —
+        loaded checkpoint states must not re-enter as single-device
+        arrays or the donated SPMD step / fused batch update would mix
+        mesh-committed and single-device operands."""
+        import jax
+        sh = _nd._multi_device_sharding(weight._data)
+
         def _conv(s):
             if s is None:
                 return None
             if isinstance(s, tuple):
                 return tuple(_conv(x) for x in s)
             if isinstance(s, _nd.NDArray):
-                return s.as_in_context(weight.context)
-            return _nd.array(np.asarray(s), ctx=weight.context,
-                             dtype=np.asarray(s).dtype)
+                out = s.as_in_context(weight.context)
+            else:
+                out = _nd.array(np.asarray(s), ctx=weight.context,
+                                dtype=np.asarray(s).dtype)
+            if sh is not None:
+                out._set_data(jax.device_put(out._data, sh))
+            return out
         self.states[index] = _conv(self.states[index])
         self.states_synced[index] = True
 
@@ -724,6 +736,13 @@ class FusedUpdater(Updater):
     optimizer_op.cc:39-299); state layout and pickled get_states format
     stay identical to ``Updater``. Per-(index) ``__call__`` remains the
     fallback for sparse gradients and optimizers without a pure kernel.
+
+    Under the dp-mesh Module the weights (and therefore the states —
+    ``_state_zeros`` copies the weight's placement) are committed
+    REPLICATED over the mesh: the donated buffers are the replicated
+    copies, so both this phase-split batch step and the whole-step SPMD
+    program (``executor.train_step_fn``) update every replica in place
+    without a broadcast.
     """
 
     def __init__(self, optimizer):

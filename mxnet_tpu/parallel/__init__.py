@@ -12,10 +12,11 @@ Components:
 - spmd:        sharded train-step compiler (dp/tp batch+param sharding)
 - ring_attention: sequence-parallel blockwise attention over ppermute
 """
-from .mesh import make_mesh, default_mesh, barrier
+from .mesh import make_mesh, default_mesh, mesh_from_contexts, barrier
 from .collectives import (all_reduce, all_gather, reduce_scatter, ppermute,
                           all_to_all)
-from .spmd import SPMDTrainer, shard_params_rule
+from .spmd import (SPMDTrainer, shard_params_rule, DataParallelSpec,
+                   dp_spec, check_batch_divisible, shard_put, DP_AXIS)
 from .ring_attention import ring_attention, attention
 from .ulysses import ulysses_attention
 from .moe import moe_ffn

@@ -13,7 +13,7 @@ from jax.sharding import Mesh
 
 from ..base import MXNetError
 
-__all__ = ["make_mesh", "default_mesh", "barrier"]
+__all__ = ["make_mesh", "default_mesh", "mesh_from_contexts", "barrier"]
 
 
 def make_mesh(axes, devices=None):
@@ -41,6 +41,18 @@ def make_mesh(axes, devices=None):
 def default_mesh(data_axis="dp"):
     """All visible devices on one data-parallel axis."""
     return make_mesh({data_axis: -1})
+
+
+def mesh_from_contexts(contexts, axis="dp"):
+    """One-axis Mesh over a Module-style context list — the TPU-native
+    reading of the reference's per-GPU context list (the devices that
+    DataParallelExecutorGroup would have bound one executor each on
+    become the ``dp`` axis of ONE program's mesh)."""
+    devs = [c.jax_device() for c in contexts]
+    if len(set(devs)) != len(devs):
+        raise MXNetError("duplicate devices in context list %s"
+                         % (list(contexts),))
+    return Mesh(np.array(devs), (axis,))
 
 
 def barrier():

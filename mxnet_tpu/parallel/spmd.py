@@ -10,6 +10,8 @@ Scaling efficiency is then XLA's collective scheduling, which is the
 """
 from __future__ import annotations
 
+import collections
+
 import numpy as np
 
 import jax
@@ -18,7 +20,70 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
 
-__all__ = ["SPMDTrainer", "shard_params_rule"]
+__all__ = ["SPMDTrainer", "shard_params_rule", "DataParallelSpec",
+           "dp_spec", "check_batch_divisible", "shard_put",
+           "commit_dp_placements", "DP_AXIS"]
+
+# the canonical data-parallel axis name shared by the Module mesh path,
+# the executor's SPMD train-step program and the bench/probe lanes
+DP_AXIS = "dp"
+
+
+class DataParallelSpec(
+        collections.namedtuple("DataParallelSpec",
+                               ["mesh", "data_sharding", "repl_sharding"])):
+    """Hashable bundle describing one data-parallel mesh: the Mesh, the
+    batch sharding (dim 0 over the dp axis) and the replicated sharding
+    for params/optimizer state/metric accumulators. Hashability matters:
+    the spec rides in ``_GraphProgram.train_step_fn``'s jit-cache key, so
+    two Modules on the same mesh share one compiled SPMD step."""
+    __slots__ = ()
+
+    @property
+    def num_devices(self):
+        return self.mesh.devices.size
+
+
+def dp_spec(mesh, data_axis=DP_AXIS):
+    """DataParallelSpec for a one-axis data-parallel mesh."""
+    return DataParallelSpec(mesh,
+                            NamedSharding(mesh, P(data_axis)),
+                            NamedSharding(mesh, P()))
+
+
+def check_batch_divisible(batch_dim, n_devices, what="batch size"):
+    """The ONE owner of the dp divisibility rule: bind-time shape checks
+    (Module bind / executor-group construction) and per-step feeds (a
+    variable-shape batch swapped in mid-training) raise the same clear
+    error instead of padding silently or dying inside XLA."""
+    if batch_dim % n_devices != 0:
+        raise MXNetError("%s %d not divisible by %d devices"
+                         % (what, batch_dim, n_devices))
+
+
+def shard_put(raw, sharding):
+    """Sharded device_put of a GLOBAL batch array: each device receives
+    only its shard (no host-side splitting, no full-batch replication —
+    the TPU-native replacement for the reference's decide_slices copy
+    loop, executor_group.py:266)."""
+    return jax.device_put(raw, sharding)
+
+
+def commit_dp_placements(executor, input_names, spec):
+    """Commit the dp-mesh placements on ONE bound executor's storage:
+    batch-like inputs (data/labels/states, all batch-major) shard over
+    the data axis, params/grads/aux replicate. The ONE owner of the
+    placement rule — Module._shard_exec_arrays and the multi-context
+    DataParallelExecutorGroup facade both call this, so the two can
+    never drift. GSPMD propagates from these committed placements for
+    every program the executor runs."""
+    for name, arr in executor.arg_dict.items():
+        sh = spec.data_sharding if name in input_names \
+            else spec.repl_sharding
+        arr._set_data(jax.device_put(arr._data, sh))
+    for arr in list(executor.grad_arrays) + list(executor.aux_arrays):
+        if arr is not None:
+            arr._set_data(jax.device_put(arr._data, spec.repl_sharding))
 
 
 def shard_params_rule(params, mesh, tp_axis=None):

@@ -103,6 +103,8 @@ def _patched_supervise(monkeypatch, phases, deadline=30.0, smoke=False,
         return fn(n)
 
     monkeypatch.setenv("MXTPU_BENCH_AB", "1" if ab else "0")
+    # optional phases default OFF here; dedicated tests opt back in
+    monkeypatch.setenv("MXTPU_BENCH_DP", "0")
     monkeypatch.setattr(bench, "_run_phase", fake_phase)
     monkeypatch.setattr(bench, "TOTAL_DEADLINE", deadline)
     monkeypatch.setattr(bench, "SMOKE", smoke)
@@ -281,6 +283,7 @@ def test_module_phase_ab_merge_and_partial_emission(monkeypatch):
 
     monkeypatch.setenv("MXTPU_BENCH_AB", "0")
     monkeypatch.setenv("MXTPU_BENCH_MODULE", "1")
+    monkeypatch.setenv("MXTPU_BENCH_DP", "0")
     monkeypatch.setattr(bench, "_run_phase", fake_phase)
     monkeypatch.setattr(bench, "TOTAL_DEADLINE", 600.0)
     monkeypatch.setattr(bench, "SMOKE", False)
@@ -301,6 +304,106 @@ def test_module_phase_ab_merge_and_partial_emission(monkeypatch):
     assert not final.get("partial")
     assert final["module_fit_img_s"] == 90.0
     assert final["module_fit_phase_split_img_s"] == 30.0
+
+
+def test_supervise_dp_phase_merges(monkeypatch):
+    """With budget left, the dp A/B child runs and its per-axis-size
+    table merges into the final line."""
+    dp_table = {"1": {"fused_img_s": 150.0, "kvstore_img_s": 150.0},
+                "8": {"fused_img_s": 1000.0, "kvstore_img_s": 400.0}}
+
+    def fake_phase(mode, timeout, env_extra=None):
+        if mode == "--probe":
+            return {"device": "x"}, False
+        if mode == "--child":
+            return {"value": 500.0, "unit": "img/s"}, False
+        assert mode == "--dp-child", mode
+        return {"lane": "dp_ab", "dp": dict(dp_table),
+                "per_chip_batch": 128}, False
+
+    import io
+    from contextlib import redirect_stdout
+    monkeypatch.setenv("MXTPU_BENCH_AB", "0")
+    monkeypatch.setenv("MXTPU_BENCH_MODULE", "0")
+    monkeypatch.setenv("MXTPU_BENCH_DP", "1")
+    monkeypatch.setattr(bench, "_run_phase", fake_phase)
+    monkeypatch.setattr(bench, "TOTAL_DEADLINE", 600.0)
+    monkeypatch.setattr(bench, "SMOKE", False)
+    monkeypatch.setattr(bench, "PROBE_TIMEOUT", 1.0)
+    monkeypatch.setattr(bench, "PROBE_GAP", 0.0)
+    monkeypatch.setattr(bench, "RAW_MIN", 0.5)
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = bench.supervise()
+    assert rc == 0
+    out = bench._last_json_line(buf.getvalue())
+    assert out["dp"] == dp_table
+    assert out["dp_per_chip_batch"] == 128
+    assert out["value"] == 500.0
+
+
+def test_dp_child_per_axis_partials_and_artifact(tmp_path, monkeypatch):
+    """dp_child emits a partial line per axis size (a hang at a larger
+    mesh salvages the smaller sizes), marks a silently-fallen-back fused
+    leg by its stable reason CODE, and banks the MULTICHIP-schema
+    artifact."""
+    import io
+    from contextlib import redirect_stdout
+    from mxnet_tpu.module import FusedFallback
+
+    class _Dev:
+        platform = "cpu"
+        device_kind = "cpu"
+
+    calls = []
+
+    def fake_throughput(dev, contexts=None, kvstore=None):
+        calls.append((len(contexts), kvstore,
+                      os.environ["MXNET_MODULE_FUSED_STEP"]))
+        if len(contexts) == 2 and os.environ[
+                "MXNET_MODULE_FUSED_STEP"] == "1":
+            return 100.0, FusedFallback("monitor", "monitor installed")
+        return 100.0 * len(contexts), None
+
+    monkeypatch.setattr(bench, "_init_device", lambda jax: _Dev())
+    monkeypatch.setattr(bench, "_module_fit_throughput", fake_throughput)
+    # the oversized 999 must be SKIPPED, not abort the later valid sizes
+    monkeypatch.setenv("MXTPU_BENCH_DP_AXES", "1,999,2")
+    monkeypatch.setenv("MXTPU_ARTIFACT_DIR", str(tmp_path))
+    # dp_child mutates the fused-step pin; monkeypatch restores it
+    monkeypatch.setenv("MXNET_MODULE_FUSED_STEP", "1")
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench.dp_child()
+    lines = [json.loads(l) for l in buf.getvalue().splitlines()
+             if l.strip().startswith("{")]
+    partials = [l for l in lines if l.get("partial")]
+    assert len(partials) == 2          # one banked line per axis size
+    assert set(partials[0]["dp"]) == {"1"}
+    final = lines[-1]
+    assert set(final["dp"]) == {"1", "2"}
+    assert final["dp"]["2"]["fused_fallback"] == "monitor"
+    assert final["dp"]["1"]["fused_img_s"] == 100.0
+    # at k=1 the 'device' kvstore resolves to None — the split leg must
+    # be marked as the plain phase-split baseline, not a kvstore number
+    assert final["dp"]["1"]["split_kvstore_active"] is False
+    assert final["dp"]["2"]["split_kvstore_active"] is True
+    # the A/B drove both legs through the same in-process kvstore
+    assert all(kv == "device" for _, kv, _ in calls)
+    with open(tmp_path / "multichip_dp_ab.json") as f:
+        art = json.load(f)
+    # the completed sweep reads as a clean round (per-size interim
+    # writes carry ok=False/truncated=True so a killed run reads as
+    # partial — that state must be gone after the final bank)
+    assert art["ok"] is True and art["skipped"] is False
+    assert "truncated" not in art
+    assert art["dp"] == final["dp"]
+
+
+def test_budget_args_dp_phase(monkeypatch):
+    monkeypatch.setattr(bench, "DP_TIMEOUT", bench.DP_TIMEOUT)
+    rest = bench._apply_budget_args(["--budget-s", "dp=120"])
+    assert rest == [] and bench.DP_TIMEOUT == 120.0
 
 
 def test_module_child_marks_silent_fallback(monkeypatch):

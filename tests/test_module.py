@@ -376,3 +376,47 @@ def test_executor_group_facade_forward_feeds_batch():
               is_train=False)
     o2 = np.asarray(g.get_outputs()[0]._data)
     assert not np.array_equal(o1, o2), "forward must see fresh batch data"
+
+
+def test_executor_group_facade_multi_context_shards():
+    """A multi-context facade commits the dp mesh on its ONE executor:
+    the global batch feeds through a sharded device_put (no host-side
+    decide_slices split) and matches the single-context result; a batch
+    that does not divide over the contexts is rejected at construction
+    with the same clear error as Module.bind."""
+    import jax
+    from mxnet_tpu.module.executor_group import DataParallelExecutorGroup
+
+    n_dev = min(4, jax.device_count())
+    assert n_dev >= 2, "conftest sets an 8-device virtual CPU mesh"
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = sym.SoftmaxOutput(net, sym.Variable("softmax_label"),
+                            name="softmax")
+    rs = np.random.RandomState(0)
+    w = rs.uniform(-1, 1, (4, 3)).astype(np.float32)
+    x = rs.uniform(-1, 1, (8, 3)).astype(np.float32)
+
+    def run(contexts):
+        g = DataParallelExecutorGroup(
+            net, contexts, None, [("data", (8, 3))],
+            [("softmax_label", (8,))], ["fc_weight", "fc_bias"], True,
+            False)
+        g.execs[0].arg_dict["fc_weight"][:] = w
+        g.forward(DataBatch([nd.array(x)], [nd.zeros((8,))]),
+                  is_train=False)
+        return np.asarray(g.get_outputs()[0]._data)
+
+    single = run([mx.cpu()])
+    sharded = run([mx.cpu(i) for i in range(n_dev)])
+    np.testing.assert_allclose(sharded, single, rtol=1e-5, atol=1e-6)
+
+    try:
+        DataParallelExecutorGroup(
+            net, [mx.cpu(i) for i in range(3)], None, [("data", (8, 3))],
+            [("softmax_label", (8,))], ["fc_weight", "fc_bias"], True,
+            False)
+    except mx.base.MXNetError as e:
+        assert "not divisible" in str(e)
+    else:
+        raise AssertionError("expected divisibility error")

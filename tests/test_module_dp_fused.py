@@ -1,0 +1,319 @@
+"""SPMD data-parallel fused train step (ISSUE 2).
+
+A multi-context Module with an in-process kvstore now runs the WHOLE
+train step — forward, backward, cross-replica gradient all-reduce,
+optimizer update, metric accumulation — as ONE donated-buffer SPMD
+program over the dp mesh (the kvstore reduce is SUBSUMED: for a single
+mesh program the push/pull was an identity round-trip staged through
+software). Pinned properties:
+
+1. DISPATCH COUNT — exactly 1 jitted-program execution per batch on the
+   8-device CPU mesh, with a live ``local`` kvstore.
+2. EQUIVALENCE — dp-fused is BIT-identical to the dp phase-split kvstore
+   path (same mesh, same reduction order — the oracle), including bf16
+   weights + fp32 master and ``grad_req='add'``; and matches the
+   single-device fused step to float tolerance (per-shard partial sums
+   reassociate the batch reduction, so cross-mesh-size bit-equality is
+   not a property ANY data-parallel implementation can offer — the
+   dp-vs-single tolerance here is the reassociation noise floor, same
+   as the pre-existing ``test_dp_module_matches_single_device`` gate).
+3. FALLBACK — ``dist_*`` kvstores keep the push/pull path and record
+   the stable ``kvstore_dist`` reason code; mid-training fallback
+   continues bit-exactly (the subsumed store's weight copies are kept
+   coherent by the fused step).
+4. FEEDING — a runtime batch whose global size does not divide over the
+   dp axis raises the same clear error as the bind-time check (no
+   silent pad).
+"""
+import contextlib
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+import mxnet_tpu.executor as _ex
+from mxnet_tpu import nd, sym
+from mxnet_tpu.io import DataBatch, DataDesc
+from mxnet_tpu.module import FusedFallback, FUSED_FALLBACK_CODES
+
+import jax
+import jax.numpy as jnp
+
+N_DEV = min(8, jax.device_count())
+
+
+@contextlib.contextmanager
+def _pin(value):
+    old = os.environ.get("MXNET_MODULE_FUSED_STEP")
+    os.environ["MXNET_MODULE_FUSED_STEP"] = value
+    try:
+        yield
+    finally:
+        if old is None:
+            del os.environ["MXNET_MODULE_FUSED_STEP"]
+        else:
+            os.environ["MXNET_MODULE_FUSED_STEP"] = old
+
+
+@contextlib.contextmanager
+def _count_dispatches(counts):
+    _ex.dispatch_hook = \
+        lambda kind: counts.__setitem__(kind, counts.get(kind, 0) + 1)
+    try:
+        yield counts
+    finally:
+        _ex.dispatch_hook = None
+
+
+def _mlp(c=4):
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, num_hidden=16, name="fc1")
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, num_hidden=c, name="fc2")
+    return sym.SoftmaxOutput(net, sym.Variable("softmax_label"),
+                             name="softmax")
+
+
+def _batches(nbatch, batch=16, d=8, c=4, seed=7):
+    rs = np.random.RandomState(seed)
+    return [DataBatch(
+        data=[nd.array(rs.uniform(-1, 1, (batch, d)).astype(np.float32))],
+        label=[nd.array(rs.randint(0, c, batch).astype(np.float32))],
+        pad=0) for _ in range(nbatch)]
+
+
+def _make_module(n_dev, kvstore, bf16=False, grad_req="write", batch=16,
+                 d=8):
+    ctx = [mx.cpu(i) for i in range(n_dev)] if n_dev > 1 else mx.cpu()
+    mod = mx.mod.Module(_mlp(), context=ctx)
+    ddtype = np.dtype(jnp.bfloat16) if bf16 else None
+    mod.bind(data_shapes=[DataDesc("data", (batch, d), dtype=ddtype)],
+             label_shapes=[DataDesc("softmax_label", (batch,))],
+             grad_req=grad_req)
+    np.random.seed(11)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(
+        kvstore=kvstore, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.05, "momentum": 0.9,
+                          "wd": 1e-4, "multi_precision": bf16})
+    return mod
+
+
+def _effective_updater(mod):
+    """The updater that owns the optimizer state: the kvstore's
+    server-side one under update_on_kvstore, else the module's."""
+    if mod._kvstore is not None and mod._update_on_kvstore:
+        return mod._kvstore._updater
+    return mod._updater
+
+
+def _state_arrays(updater):
+    out = []
+    for i in sorted(updater.states):
+        for leaf in jax.tree_util.tree_leaves(updater.states[i]):
+            out.append(np.asarray(leaf._data if hasattr(leaf, "_data")
+                                  else leaf))
+    return out
+
+
+def _train(fused, n_dev, kvstore, bf16=False, grad_req="write", nbatch=6):
+    with _pin("1" if fused else "0"):
+        mod = _make_module(n_dev, kvstore, bf16=bf16, grad_req=grad_req)
+        metric = mx.metric.Accuracy()
+        for b in _batches(nbatch):
+            ran_fused = mod.fused_step(b, eval_metric=metric)
+            assert ran_fused == fused, mod._fused_fallback_reason
+    params = {n: np.asarray(mod._exec.arg_dict[n]._data)
+              for n in mod._param_names}
+    grads = {n: np.asarray(g._data)
+             for n, g in mod._exec.grad_dict.items() if g is not None}
+    return params, _state_arrays(_effective_updater(mod)), metric.get(), \
+        grads
+
+
+def _assert_bit_equal(run_a, run_b):
+    params_a, states_a, metric_a, _ = run_a
+    params_b, states_b, metric_b, _ = run_b
+    for n in params_a:
+        np.testing.assert_array_equal(params_a[n], params_b[n], err_msg=n)
+    assert len(states_a) == len(states_b)
+    for i, (a, b) in enumerate(zip(states_a, states_b)):
+        np.testing.assert_array_equal(a, b, err_msg="state %d" % i)
+    assert metric_a == metric_b, (metric_a, metric_b)
+
+
+# ---------------------------------------------------------------------------
+# 1. dispatch-count guard on the mesh, kvstore live
+# ---------------------------------------------------------------------------
+
+def test_dp_fused_dispatch_guard():
+    """Multi-context + ``local`` kvstore must run the fused SPMD path at
+    EXACTLY 1 jitted-program dispatch per batch (the acceptance gate:
+    the kvstore reduce is inside the program, not a second dispatch)."""
+    assert N_DEV >= 2, "conftest sets an 8-device virtual CPU mesh"
+    nbatch = 5
+    with _pin("1"):
+        mod = _make_module(N_DEV, "local")
+        metric = mx.metric.Accuracy()
+        for b in _batches(2):  # warm: compiles the SPMD program
+            assert mod.fused_step(b, eval_metric=metric), \
+                mod._fused_fallback_reason
+        with _count_dispatches({}) as counts:
+            for b in _batches(nbatch):
+                assert mod.fused_step(b, eval_metric=metric)
+    assert mod._fused_fallback_reason is None
+    assert counts == {"train_step": nbatch}, counts
+
+
+# ---------------------------------------------------------------------------
+# 2. equivalence: dp-fused vs dp phase-split kvstore vs single-device fused
+# ---------------------------------------------------------------------------
+
+def test_dp_equivalence_fp32():
+    dp_fused = _train(True, N_DEV, "local")
+    dp_split = _train(False, N_DEV, "local")
+    _assert_bit_equal(dp_fused, dp_split)
+    # vs the single-device fused step: per-shard partial sums + psum
+    # reassociate the batch reduction — tight allclose, not bit-equal
+    single = _train(True, 1, None)
+    for n in dp_fused[0]:
+        np.testing.assert_allclose(dp_fused[0][n], single[0][n],
+                                   rtol=1e-5, atol=1e-6, err_msg=n)
+    assert dp_fused[2] == single[2]  # integer metric counts agree exactly
+
+
+def test_dp_equivalence_bf16_master():
+    """bf16-resident weights + fp32 master on the mesh: the fused SPMD
+    program must round exactly like the phase-split kvstore chain."""
+    _assert_bit_equal(_train(True, N_DEV, "local", bf16=True),
+                      _train(False, N_DEV, "local", bf16=True))
+
+
+def test_dp_equivalence_grad_add():
+    """grad_req='add' on the mesh: the gradient accumulator (a fused-
+    program OUTPUT) must match the phase-split accumulation bit for
+    bit."""
+    fused = _train(True, N_DEV, "local", grad_req="add")
+    split = _train(False, N_DEV, "local", grad_req="add")
+    _assert_bit_equal(fused, split)
+    assert fused[3], "grad_req='add' run must expose accumulators"
+    for n in fused[3]:
+        np.testing.assert_array_equal(fused[3][n], split[3][n], err_msg=n)
+
+
+def test_dp_fallback_continuity_mid_training():
+    """3 fused steps then 3 phase-split steps == 6 phase-split steps,
+    bit for bit: the subsumed kvstore's weight copies are kept coherent
+    by the fused step, so flipping the pin mid-training (or any dynamic
+    fallback) continues the exact same trajectory."""
+    mod = _make_module(N_DEV, "local")
+    metric = mx.metric.Accuracy()
+    batches = _batches(6)
+    with _pin("1"):
+        for b in batches[:3]:
+            assert mod.fused_step(b, eval_metric=metric)
+    with _pin("0"):
+        for b in batches[3:]:
+            assert not mod.fused_step(b, eval_metric=metric)
+    split = _train(False, N_DEV, "local")
+    for n in split[0]:
+        np.testing.assert_array_equal(
+            np.asarray(mod._exec.arg_dict[n]._data), split[0][n], err_msg=n)
+    assert metric.get() == split[2]
+
+
+# ---------------------------------------------------------------------------
+# 3. fallback rules + stable reason codes
+# ---------------------------------------------------------------------------
+
+def test_dp_fallback_code_dist_kvstore():
+    """dist_* stores cross worker processes — the step must phase-split
+    with the stable ``kvstore_dist`` code, and still train."""
+    with _pin("1"):
+        mod = _make_module(2, "dist_sync")
+        before = np.asarray(mod._exec.arg_dict["fc1_weight"]._data).copy()
+        assert not mod.fused_step(_batches(1)[0])
+        reason = mod._fused_fallback_reason
+        assert isinstance(reason, FusedFallback)
+        assert reason.code == "kvstore_dist"
+        assert reason == "kvstore-mediated update"  # legacy text pinned
+        after = np.asarray(mod._exec.arg_dict["fc1_weight"]._data)
+        assert not np.array_equal(before, after), "fallback must train"
+
+
+def test_fallback_codes_are_stable_and_enumerable():
+    """Every recorded reason is a FusedFallback whose code is in the
+    published registry; the str VALUE keeps the legacy message so
+    message-text consumers (bench JSON, old asserts) never broke."""
+    mod = _make_module(1, None)
+    with _pin("0"):
+        assert not mod.fused_step(_batches(1)[0])
+    r = mod._fused_fallback_reason
+    assert r.code == "env_pin" and r == "MXNET_MODULE_FUSED_STEP=0"
+    assert r.code in FUSED_FALLBACK_CODES
+
+    mod = _make_module(1, None)
+    mon = mx.monitor.Monitor(1, pattern=".*weight")
+    mod.install_monitor(mon)
+    with _pin("1"):
+        assert not mod.fused_step(_batches(1)[0])
+    r = mod._fused_fallback_reason
+    assert r.code == "monitor" and r == "monitor installed"
+
+    mod = mx.mod.Module(_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (16, 8))],
+             label_shapes=[("softmax_label", (16,))],
+             inputs_need_grad=True)
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd")
+    with _pin("1"):
+        assert not mod.fused_step(_batches(1)[0])
+    assert mod._fused_fallback_reason.code == "inputs_need_grad"
+
+
+# ---------------------------------------------------------------------------
+# 4. sharded feeding: no silent pad
+# ---------------------------------------------------------------------------
+
+def test_dp_runtime_batch_not_divisible_raises():
+    """A hand-fed batch whose global size does not divide over the dp
+    axis must raise the SAME clear error as the bind-time check — on
+    both the fused and the phase-split feed paths — never silently pad
+    or die inside XLA."""
+    rs = np.random.RandomState(3)
+    bad = DataBatch(
+        data=[nd.array(rs.uniform(-1, 1, (14, 8)).astype(np.float32))],
+        label=[nd.array(rs.randint(0, 4, 14).astype(np.float32))], pad=0)
+    for pin in ("1", "0"):
+        mod = _make_module(4, "local")
+        with _pin(pin):
+            try:
+                mod.fused_step(bad)
+            except mx.base.MXNetError as e:
+                assert "not divisible" in str(e), e
+            else:
+                raise AssertionError("expected divisibility error "
+                                     "(pin=%s)" % pin)
+
+
+def test_dp_optimizer_states_roundtrip_stays_on_mesh():
+    """save/load_optimizer_states mid-training on the mesh: loaded
+    states must re-commit to the weights' mesh placement (not re-enter
+    single-device) and the fused trajectory must continue bit-exactly."""
+    import tempfile
+    batches = _batches(6)
+    with _pin("1"):
+        mod = _make_module(N_DEV, "local")
+        metric = mx.metric.Accuracy()
+        for b in batches[:3]:
+            assert mod.fused_step(b, eval_metric=metric)
+        with tempfile.NamedTemporaryFile(suffix=".states") as f:
+            mod.save_optimizer_states(f.name)
+            mod.load_optimizer_states(f.name)
+        for b in batches[3:]:
+            assert mod.fused_step(b, eval_metric=metric), \
+                mod._fused_fallback_reason
+    ref = _train(True, N_DEV, "local")
+    for n in ref[0]:
+        np.testing.assert_array_equal(
+            np.asarray(mod._exec.arg_dict[n]._data), ref[0][n], err_msg=n)

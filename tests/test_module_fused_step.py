@@ -248,6 +248,29 @@ def test_fused_step_accepts_raw_arrays():
     assert not np.array_equal(before, after), "step must train"
 
 
+def test_fused_step_on_non_default_device():
+    """A module bound on a NON-default device fed default-device batch
+    arrays: the fused step feeds batches as jit arguments (no copy into
+    bound storage), so IT must commit them — and a fresh metric
+    accumulator — to the module's device, or the program crashes on
+    mixed committed inputs where the phase-split path trains fine."""
+    mod = mx.mod.Module(_mlp(), context=mx.cpu(1))
+    mod.bind(data_shapes=[DataDesc("data", (16, 8))],
+             label_shapes=[DataDesc("softmax_label", (16,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.05})
+    metric = mx.metric.Accuracy()
+    before = np.asarray(mod._exec.arg_dict["fc1_weight"]._data).copy()
+    with _pin("1"):
+        for b in _batches(2):
+            assert mod.fused_step(b, eval_metric=metric), \
+                mod._fused_fallback_reason
+    after = np.asarray(mod._exec.arg_dict["fc1_weight"]._data)
+    assert not np.array_equal(before, after), "step must train"
+    assert metric.get()[1] >= 0.0
+
+
 def test_fused_step_fallback_still_trains():
     """A fallback is a slow path, not a no-op: with the knob pinned off
     the step must still run (phase-split) and return False."""

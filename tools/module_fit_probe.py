@@ -15,6 +15,12 @@ Fit-smoke lane:     python tools/module_fit_probe.py --fit-smoke \
   (tier-1 CI: tiny-MLP Module.fit on the CPU backend, 20 batches, fused
   vs phase-split A/B with per-batch dispatch counts — the user-path
   trajectory is captured every round even when the TPU tunnel is down)
+DP-smoke lane:      python tools/module_fit_probe.py --dp-smoke \
+                        [--json-out PATH]
+  (tier-1 CI: tiny-MLP Module.fit on the virtual 8-device CPU mesh —
+  the fused-SPMD data-parallel step vs the kvstore phase-split path;
+  asserts dp-fused >= phase-split img/s and EXACTLY 1 jitted-program
+  dispatch per batch via executor.dispatch_hook)
 """
 import json
 import os
@@ -25,15 +31,25 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 SMOKE = os.environ.get("MXTPU_PROBE_SMOKE", "") == "1"
 FIT_SMOKE = "--fit-smoke" in sys.argv
+DP_SMOKE = "--dp-smoke" in sys.argv
+N_DEV = 8
 BATCH = 8 if SMOKE else 128
 IMG = 32 if SMOKE else 224
 ITERS = 2 if SMOKE else 10
+
+if DP_SMOKE:
+    # the virtual mesh flag must land before the CPU backend initialises
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=%d" % N_DEV
+        ).strip()
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
-if SMOKE or FIT_SMOKE:
+if SMOKE or FIT_SMOKE or DP_SMOKE:
     jax.config.update("jax_platforms", "cpu")
 
 import mxnet_tpu as mx
@@ -128,12 +144,17 @@ def main():
           flush=True)
 
 
-def fit_smoke(json_out=None, nbatch=20, batch=32):
-    """Tier-1 smoke lane: tiny-MLP ``Module.fit`` on the CPU backend,
-    fused whole-step program vs phase-split oracle, with jitted-program
-    dispatch counts per batch (``executor.dispatch_hook``). One JSON
-    object on stdout (and to ``json_out`` when given) — the artifact the
-    CI lane banks each round."""
+def _smoke_lane(lane, contexts, kvstore, rounds, nbatch, batch,
+                speed_key, extra=None, json_out=None):
+    """The ONE tier-1 lane harness both smoke lanes share: tiny-MLP
+    ``Module.fit``, fused whole-step program vs phase-split oracle, with
+    jitted-program dispatch counts per batch (``executor.dispatch_hook``)
+    and interleaved best-of timing (one epoch is a ~10ms window and
+    share-throttled CI boxes drift in sustained speed — timing the two
+    paths back to back inside each round keeps the RATIO honest under
+    drift, and the min converges on the dispatch floor under spike
+    noise). One JSON object on stdout (and to ``json_out``) — the
+    artifact the CI lane banks each round. Returns (out, dispatch)."""
     import mxnet_tpu as mx
     import mxnet_tpu.executor as _ex
     from mxnet_tpu.io import DataIter, DataDesc, DataBatch
@@ -185,16 +206,20 @@ def fit_smoke(json_out=None, nbatch=20, batch=32):
 
     def setup(fused):
         os.environ["MXNET_MODULE_FUSED_STEP"] = "1" if fused else "0"
-        mod = mx.mod.Module(mlp(), context=mx.cpu())
+        mod = mx.mod.Module(mlp(), context=contexts)
         metric = mx.metric.Accuracy()
         train = _PreslicedIter()
         # warm epoch: bind + init + compile land outside the timed window
-        mod.fit(train, eval_metric=metric, num_epoch=1,
+        mod.fit(train, eval_metric=metric, num_epoch=1, kvstore=kvstore,
                 initializer=mx.initializer.Xavier(),
                 optimizer="sgd", optimizer_params=opt_params)
-        if fused and mod._fused_fallback_reason is not None:
-            raise SystemExit("fit-smoke: fused path fell back: %s"
-                             % mod._fused_fallback_reason)
+        reason = mod._fused_fallback_reason
+        if fused and reason is not None:
+            raise SystemExit("%s: fused path fell back: %s (%s)"
+                             % (lane, reason, getattr(reason, "code", "?")))
+        if not fused and getattr(reason, "code", None) != "env_pin":
+            raise SystemExit("%s: phase-split leg expected the env_pin "
+                             "fallback code, got %r" % (lane, reason))
         return mod, metric, train
 
     def epoch(state, fused, counts):
@@ -202,7 +227,7 @@ def fit_smoke(json_out=None, nbatch=20, batch=32):
         os.environ["MXNET_MODULE_FUSED_STEP"] = "1" if fused else "0"
         counts.clear()
         t0 = time.perf_counter()
-        mod.fit(train, eval_metric=metric, num_epoch=1,
+        mod.fit(train, eval_metric=metric, num_epoch=1, kvstore=kvstore,
                 optimizer="sgd", optimizer_params=opt_params)
         # the loop is async — close the window on a data-dependent fetch
         metric.get()
@@ -215,12 +240,7 @@ def fit_smoke(json_out=None, nbatch=20, batch=32):
     dispatch = {True: {}, False: {}}
     _ex.dispatch_hook = None
     try:
-        # best-of-9, INTERLEAVED: one epoch is a ~10ms window, and
-        # share-throttled CI boxes drift in sustained speed — timing the
-        # two paths back to back inside each round keeps the RATIO
-        # honest under drift, and the min converges on the dispatch
-        # floor under spike noise
-        for _ in range(9):
+        for _ in range(rounds):
             for f in (True, False):
                 counts = dispatch[f]
                 _ex.dispatch_hook = lambda kind: counts.__setitem__(
@@ -238,28 +258,78 @@ def fit_smoke(json_out=None, nbatch=20, batch=32):
         }
 
     fused, split = report(True), report(False)
-    out = {
-        "lane": "module_fit_smoke",
-        "platform": jax.devices()[0].platform,
+    out = {"lane": lane, "platform": jax.devices()[0].platform}
+    out.update(extra or {})
+    out.update({
         "batch": batch, "nbatch": nbatch,
         "fused": fused, "phase_split": split,
-        "fit_speedup": round(fused["img_s"] / split["img_s"], 2),
-    }
+        speed_key: round(fused["img_s"] / split["img_s"], 2),
+    })
     line = json.dumps(out)
     print(line, flush=True)
     if json_out:
         with open(json_out, "w") as f:
             f.write(line + "\n")
+    return out, dispatch
+
+
+def fit_smoke(json_out=None, nbatch=20, batch=32):
+    """Tier-1 smoke lane: tiny-MLP ``Module.fit`` on the CPU backend,
+    fused whole-step program vs phase-split oracle (best-of-9
+    interleaved)."""
+    import mxnet_tpu as mx
+    _smoke_lane("module_fit_smoke", mx.cpu(), "local", rounds=9,
+                nbatch=nbatch, batch=batch, speed_key="fit_speedup",
+                json_out=json_out)
+
+
+def dp_smoke(json_out=None, nbatch=12, batch=32):
+    """Tier-1 dp lane: tiny-MLP ``Module.fit`` on the virtual 8-device
+    CPU mesh, the whole-step fused SPMD program (multi-context +
+    subsumed ``device`` kvstore) vs the kvstore phase-split path.
+    Asserts the two load-bearing dp properties — EXACTLY 1 dispatch per
+    batch on the fused path and dp-fused throughput >= the phase-split
+    path — and banks the JSON artifact stamped with the gate outcome
+    (a gate-failing round must not read as a healthy record in the
+    artifact dir; 5 rounds keeps the tier-1 lane's wall-clock small)."""
+    import mxnet_tpu as mx
+
+    n_dev = min(N_DEV, jax.device_count())
+    assert n_dev >= 2, "dp-smoke needs the virtual multi-device CPU mesh"
+    contexts = [mx.cpu(i) for i in range(n_dev)]
+    out, dispatch = _smoke_lane(
+        "module_fit_dp_smoke", contexts, "device", rounds=5,
+        nbatch=nbatch, batch=batch, speed_key="dp_speedup",
+        extra={"n_devices": n_dev}, json_out=None)
+    # the dp acceptance gates (ISSUE 2): one program per batch, and the
+    # fused SPMD step at least as fast as the kvstore phase-split path
+    try:
+        assert dispatch[True] == {"train_step": nbatch}, dispatch[True]
+        assert out["fused"]["dispatches_per_batch"] == 1.0, out
+        assert out["fused"]["img_s"] >= out["phase_split"]["img_s"], out
+        out["gates_passed"] = True
+    except AssertionError:
+        out["gates_passed"] = False
+        raise
+    finally:
+        if json_out:
+            with open(json_out, "w") as f:
+                f.write(json.dumps(out) + "\n")
+
+
+def _json_out_arg():
+    if "--json-out" not in sys.argv:
+        return None
+    i = sys.argv.index("--json-out") + 1
+    if i >= len(sys.argv) or sys.argv[i].startswith("--"):
+        raise SystemExit("--json-out: missing output path")
+    return sys.argv[i]
 
 
 if __name__ == "__main__":
-    if FIT_SMOKE:
-        path = None
-        if "--json-out" in sys.argv:
-            i = sys.argv.index("--json-out") + 1
-            if i >= len(sys.argv) or sys.argv[i].startswith("--"):
-                raise SystemExit("--json-out: missing output path")
-            path = sys.argv[i]
-        fit_smoke(json_out=path)
+    if DP_SMOKE:
+        dp_smoke(json_out=_json_out_arg())
+    elif FIT_SMOKE:
+        fit_smoke(json_out=_json_out_arg())
     else:
         main()
