@@ -335,6 +335,28 @@ def child():
     print(json.dumps(out), flush=True)
 
 
+def _telemetry_summary():
+    """Trimmed ``mx.telemetry.snapshot()`` for the BENCH/MULTICHIP
+    artifacts: the full counter registry (dispatches by kind, jit
+    compiles vs. hits, fused-fallback codes, transfer bytes, blocking
+    syncs) plus the fit-phase span percentiles — the per-phase numbers
+    the next perf PR starts from. ``_module_fit_throughput`` resets the
+    registry at the top of its timed window, so this reads as one leg's
+    accounting."""
+    try:
+        import mxnet_tpu as mx
+        snap = mx.telemetry.snapshot()
+    except Exception as e:                  # telemetry must never cost a run
+        return {"error": str(e)}
+    from mxnet_tpu import telemetry as _tel
+    spans = {k: v for k, v in snap["spans"].items()
+             if k in _tel.FIT_PHASE_SPANS}
+    # keep the flag: a disabled-telemetry leg's all-zero counters must
+    # read as "instrumentation off", not as a measured zero
+    return {"enabled": snap["enabled"], "counters": snap["counters"],
+            "spans": spans}
+
+
 def module_child():
     """Separate child for the OPTIONAL user-facing-path measurement:
     Module.fit through the whole-step fused program AND, budget
@@ -355,10 +377,12 @@ def module_child():
             # the A/B — mark the leg so the number reads as what it
             # measured
             out["module_fit_fused_fallback"] = fallback
+        out["telemetry"] = _telemetry_summary()
         print(json.dumps(out), flush=True)
         os.environ["MXNET_MODULE_FUSED_STEP"] = "0"
         img_s, _ = _module_fit_throughput(dev)
         out["module_fit_phase_split_img_s"] = round(img_s, 2)
+        out["telemetry_phase_split"] = _telemetry_summary()
         print(json.dumps(out), flush=True)
     finally:
         _restore_pin(old_pin)
@@ -461,6 +485,9 @@ def _module_fit_throughput(dev, contexts=None, kvstore="local"):
     marks = []
     n = max(n_iters, 40)
     timed = _DeviceBatchIter(n)
+    # clean telemetry window: the banked snapshot covers the TIMED epoch
+    # only (bind/compile/warmup accounting would read as steady-state)
+    mx.telemetry.reset()
     mod.fit(timed, eval_metric=metric, num_epoch=1, kvstore=kvstore,
             optimizer="sgd", optimizer_params=opt_params,
             batch_end_callback=lambda p: marks.append(time.perf_counter()))
@@ -521,6 +548,7 @@ def dp_child():
             img_s, fallback = _module_fit_throughput(dev, contexts=contexts,
                                                      kvstore="device")
             entry["fused_img_s"] = round(img_s, 2)
+            entry["telemetry"] = _telemetry_summary()
             if fallback is not None:
                 # a silently fallen-back leg must not read as a fused
                 # number

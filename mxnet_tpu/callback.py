@@ -75,6 +75,102 @@ class Speedometer:
             self.tic = time.time()
 
 
+class TelemetryLogger:
+    """Speedometer-style batch-end callback over the telemetry registry:
+    every ``frequent`` batches, log the window's jitted-program
+    dispatches per batch, jit compiles vs. cache hits, fused-fallback
+    events, host->device bytes, blocking host syncs, and the step-span
+    p50/p95/p99 — the counters PERF.md wants attached to every training
+    run (no reference counterpart; the reference had no host-side
+    registry to read)::
+
+        mod.fit(train, batch_end_callback=mx.callback.TelemetryLogger(50))
+    """
+
+    def __init__(self, frequent=50, logger=None):
+        from . import telemetry
+        self.frequent = int(max(1, frequent))
+        self.logger = logger or logging.getLogger("mxnet_tpu.telemetry")
+        self._telemetry = telemetry
+        self._last_counters = {}
+        self._last_nbatch = None
+        self._last_step_total = 0
+
+    def _rebase(self, count):
+        self._last_counters = self._telemetry.counters()
+        self._last_step_total = self._telemetry.span_count("step")
+        self._last_nbatch = count
+        self._window_start = count
+
+    def _window(self):
+        cur = self._telemetry.counters()
+        if any(v < self._last_counters.get(k, 0) for k, v in cur.items()) \
+                or any(k not in cur for k in self._last_counters):
+            # someone reset() the registry mid-window: the deltas are
+            # meaningless — skip this log line and rebase
+            self._last_counters = cur
+            return None
+        delta = {k: v - self._last_counters.get(k, 0)
+                 for k, v in cur.items()
+                 if v != self._last_counters.get(k, 0)}
+        self._last_counters = cur
+        return delta
+
+    def __call__(self, param):
+        count = param.nbatch
+        if self._last_nbatch is None or count < self._last_nbatch:
+            # first call of an epoch (fit fires batch-end at nbatch=0,
+            # Speedometer-style): establish the window baseline — a
+            # partial first window would misreport every per-batch rate
+            self._rebase(count)
+            return
+        self._last_nbatch = count
+        # the window spans everything since the last LOG (or rebase),
+        # not since the last callback — skipped callbacks must not
+        # shrink the per-batch denominator
+        nbatches = count - self._window_start
+        if count % self.frequent != 0 or nbatches <= 0:
+            return
+        self._window_start = count
+        delta = self._window()
+        if delta is None:
+            self._last_step_total = self._telemetry.span_count("step")
+            return
+        n = float(nbatches)
+        dispatches = sum(v for k, v in delta.items()
+                         if k.startswith("dispatch."))
+        fallbacks = {k[len("fused_fallback."):]: v
+                     for k, v in delta.items()
+                     if k.startswith("fused_fallback.")}
+        # step percentiles over THIS WINDOW's samples only (the
+        # cumulative histogram would keep the first batch's compile
+        # outlier in p99 forever)
+        durs = self._telemetry.span_durations("step")
+        total = self._telemetry.span_count("step")
+        k = min(max(total - self._last_step_total, 0), len(durs))
+        self._last_step_total = total
+        window = sorted(durs[-k:]) if k else []
+        msg = ("Epoch[%d] Batch [%d]\tdispatches/batch=%.2f"
+               % (param.epoch, count, dispatches / n))
+        msg += "\tjit compile/hit=%d/%d" % (
+            delta.get("jit.compile", 0), delta.get("jit.hit", 0))
+        if window:
+            pct = self._telemetry._percentile    # the ONE percentile rule
+            msg += "\tstep p50/p95/p99=%.2f/%.2f/%.2fms" % (
+                pct(window, 50) * 1e3, pct(window, 95) * 1e3,
+                pct(window, 99) * 1e3)
+        h2d = delta.get("transfer.h2d_bytes", 0)
+        if h2d:
+            msg += "\th2d=%.1fKiB/batch" % (h2d / 1024.0 / n)
+        syncs = delta.get("host_sync.blocking", 0)
+        if syncs:
+            msg += "\tblocking_syncs=%d" % syncs
+        if fallbacks:
+            msg += "\tfused_fallbacks=%s" % (
+                ",".join("%s:%d" % kv for kv in sorted(fallbacks.items())))
+        self.logger.info(msg)
+
+
 class ProgressBar:
     """(parity: callback.ProgressBar)"""
 

@@ -103,9 +103,13 @@ _KNOBS = {
                                            "dense fallbacks"),
     "MXNET_INFER_STORAGE_TYPE_VERBOSE_LOGGING": ("mapped", "storage types "
                                                  "are explicit here"),
-    # profiler
+    # profiler / telemetry
     "MXNET_PROFILER_AUTOSTART": ("honored", "start the profiler at import"),
     "MXNET_PROFILER_MODE": ("honored", "profiler.py set_config"),
+    "MXNET_TELEMETRY": ("honored", "runtime telemetry registry (dispatch/"
+                        "jit/fallback/transfer counters + host-span "
+                        "tracing, telemetry.py); default on, =0 starts "
+                        "disabled — the <2% overhead A/B pin"),
     # io
     "MXNET_CPU_TEMP_COPY": ("mapped", "PJRT staging buffers"),
     # distributed wiring (reference ps-lite envs, kvstore.h:254)

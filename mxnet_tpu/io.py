@@ -23,6 +23,7 @@ import numpy as np
 from .base import MXNetError, registry_create
 from .ndarray import array as _nd_array
 from .ndarray.ndarray import NDArray
+from . import telemetry
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "MNISTIter", "CSVIter", "LibSVMIter",
@@ -78,7 +79,17 @@ class DataIter:
         raise StopIteration
 
     def __next__(self):
-        return self.next()
+        # batch-iteration host span: time spent PRODUCING batches (file
+        # reads, decode, numpy slicing) is the io phase of the merged
+        # host+device trace. Only the epoch-end StopIteration cancels
+        # the sample — a mid-epoch pipeline failure still charges the
+        # time it burned to the io phase before propagating
+        with telemetry.span("io_next") as sp:
+            try:
+                return self.next()
+            except StopIteration:
+                sp.cancel()
+                raise
 
     def iter_next(self):
         raise NotImplementedError

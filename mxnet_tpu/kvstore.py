@@ -26,6 +26,7 @@ import pickle
 
 from .base import MXNetError
 from .ndarray.ndarray import NDArray, zeros as _zeros
+from . import telemetry
 
 __all__ = ["KVStore", "create"]
 
@@ -97,6 +98,14 @@ class KVStore:
         per-device shard list; reduction = sum, as CommDevice does. A list
         of KEYS is one batched push: in dist mode all their cross-process
         reductions run as a single jitted collective."""
+        with telemetry.span("kv_push"):
+            self._push_impl(key, value)
+        telemetry.counter_inc("kvstore.push")
+        if self.wire_bytes_last_push:
+            telemetry.counter_inc("kvstore.wire_bytes",
+                                  self.wire_bytes_last_push)
+
+    def _push_impl(self, key, value):
         keys, values = _key_value(key, value, allow_list_value=True)
         merged_list = []
         for k, vlist in zip(keys, values):
@@ -404,15 +413,17 @@ class KVStore:
         """Broadcast current value into out arrays (parity: kvstore.pull)."""
         if out is None:
             raise MXNetError("pull requires out=")
-        keys, outs = _key_value(key, out, allow_list_value=True)
-        for k, olist in zip(keys, outs):
-            if k not in self._store:
-                raise MXNetError("pull: key %r was not init()ed" % k)
-            src = self._store[k]
-            if not isinstance(olist, (list, tuple)):
-                olist = [olist]
-            for o in olist:
-                src.copyto(o)
+        telemetry.counter_inc("kvstore.pull")
+        with telemetry.span("kv_pull"):
+            keys, outs = _key_value(key, out, allow_list_value=True)
+            for k, olist in zip(keys, outs):
+                if k not in self._store:
+                    raise MXNetError("pull: key %r was not init()ed" % k)
+                src = self._store[k]
+                if not isinstance(olist, (list, tuple)):
+                    olist = [olist]
+                for o in olist:
+                    src.copyto(o)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Pull only the rows in row_ids (parity: kvstore.row_sparse_pull —

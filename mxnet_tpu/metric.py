@@ -11,6 +11,7 @@ import numpy as _numpy
 
 from .base import MXNetError, registry_create
 from .ndarray.ndarray import NDArray
+from . import telemetry
 
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
            "F1", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
@@ -139,7 +140,11 @@ class EvalMetric:
 
     def _flush_device(self):
         if getattr(self, "_dev_sum", None) is not None:
-            self.sum_metric += float(self._dev_sum)
+            # THE metric synchronisation point: the only blocking fetch
+            # the async accumulate paths ever issue
+            telemetry.record_host_sync("metric_fetch")
+            with telemetry.span("metric_fetch"):
+                self.sum_metric += float(self._dev_sum)
             self._dev_sum = None
 
     # -- whole-train-step fusion hooks -------------------------------------

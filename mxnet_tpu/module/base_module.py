@@ -13,6 +13,7 @@ import numpy as np
 
 from ..base import MXNetError
 from .. import metric as _metric
+from .. import telemetry
 from ..model import BatchEndParam
 from ..initializer import Uniform
 
@@ -105,6 +106,38 @@ class BaseModule:
         base class always phase-splits."""
         return False
 
+    def _note_fused_fallback(self):
+        """Account one phase-split step: count the fallback event in the
+        telemetry registry (keyed by the stable ``FusedFallback.code``)
+        and log it through log.py as a structured warning ONCE per
+        module per code — the reason used to sit silently in
+        ``_fused_fallback_reason``."""
+        reason = getattr(self, "_fused_fallback_reason", None)
+        if reason is None:
+            return
+        code = getattr(reason, "code", "unknown")
+        telemetry.record_fallback(code)
+        logged = self.__dict__.setdefault("_fused_fallback_logged", set())
+        if code not in logged:
+            logged.add(code)
+            from .. import log as _log
+            _log.get_logger("mxnet_tpu.module").warning(
+                "fused-step fallback code=%s: %s (detail: %s) — this "
+                "module trains phase-split (see "
+                "mx.mod.FUSED_FALLBACK_CODES)",
+                code, str(reason), getattr(reason, "detail", str(reason)))
+
+    def telemetry_snapshot(self):
+        """The process-wide ``telemetry.snapshot()`` (dispatch counts,
+        jit compiles vs. cache hits, fused-fallback codes, transfer
+        bytes, blocking host syncs, span p50/p95/p99) plus this module's
+        last fused-fallback reason/code."""
+        snap = telemetry.snapshot()
+        reason = getattr(self, "_fused_fallback_reason", None)
+        snap["fused_fallback_reason"] = None if reason is None else str(reason)
+        snap["fused_fallback_code"] = getattr(reason, "code", None)
+        return snap
+
     def fused_step(self, data, label=None, eval_metric=None):
         """Run ONE whole training step — forward, backward, optimizer
         update, and (when ``eval_metric`` can accumulate on device)
@@ -128,6 +161,7 @@ class BaseModule:
             data = DataBatch(data=d, label=lab)
         if self._fused_batch_step(data, eval_metric):
             return True
+        self._note_fused_fallback()
         self.forward_backward(data)
         self.update()
         if eval_metric is not None:
@@ -240,26 +274,29 @@ class BaseModule:
                 # dispatches while batch N executes, metric values are
                 # fetched lazily (sync happens only at epoch end and in
                 # callbacks that read the metric).
-                fused = self._fused_batch_step(data_batch, eval_metric)
-                if not fused:
-                    self.forward_backward(data_batch)
-                    self.update()
-                try:
-                    next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch,
-                                 sparse_row_id_fn=sparse_row_id_fn)
-                except StopIteration:
-                    end_of_batch = True
-                if not fused:
-                    self.update_metric(eval_metric, data_batch.label)
+                with telemetry.span("fit_batch"):
+                    fused = self._fused_batch_step(data_batch, eval_metric)
+                    if not fused:
+                        self._note_fused_fallback()
+                        self.forward_backward(data_batch)
+                        self.update()
+                    try:
+                        next_data_batch = next(data_iter)
+                        self.prepare(next_data_batch,
+                                     sparse_row_id_fn=sparse_row_id_fn)
+                    except StopIteration:
+                        end_of_batch = True
+                    if not fused:
+                        self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
                 if batch_end_callback is not None:
-                    param = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                          eval_metric=eval_metric,
-                                          locals=locals())
-                    for cb in _as_list(batch_end_callback):
-                        cb(param)
+                    with telemetry.span("callbacks"):
+                        param = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                              eval_metric=eval_metric,
+                                              locals=locals())
+                        for cb in _as_list(batch_end_callback):
+                            cb(param)
                 nbatch += 1
 
             for name, val in eval_metric.get_name_value():
@@ -274,9 +311,11 @@ class BaseModule:
             # ms/epoch on a relayed PJRT backend) buys nothing without a
             # consumer
             if epoch_end_callback is not None:
-                arg_p, aux_p = self.get_params()
-                for cb in _as_list(epoch_end_callback):
-                    cb(epoch, self.symbol, arg_p, aux_p)
+                with telemetry.span("epoch_sync"):
+                    arg_p, aux_p = self.get_params()
+                with telemetry.span("callbacks"):
+                    for cb in _as_list(epoch_end_callback):
+                        cb(epoch, self.symbol, arg_p, aux_p)
 
             if eval_data is not None:
                 res = self.score(eval_data, validation_metric,

@@ -91,7 +91,12 @@ class DataParallelExecutorGroup:
     def forward(self, data_batch, is_train=None):
         """Install the batch into bound storage and run the forward
         program (the old facade discarded the batch — any direct user
-        forward-ran stale data). Executor.forward owns the copy-in."""
+        forward-ran stale data). Executor.forward owns the copy-in and
+        the ``feed``/``step`` telemetry spans; the facade counts its
+        own traffic so the snapshot shows which surface drove the
+        executor."""
+        from .. import telemetry
+        telemetry.counter_inc("exec_group.forward")
         data = data_batch.data
         if not isinstance(data, (list, tuple)):
             data = [data]

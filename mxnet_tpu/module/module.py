@@ -21,6 +21,7 @@ from ..base import MXNetError
 from ..config import fused_fit
 from ..context import Context, cpu, current_context
 from ..executor import record_dispatch
+from .. import telemetry
 from ..initializer import Uniform, InitDesc
 from ..model import _create_kvstore, save_checkpoint, load_checkpoint
 from .. import optimizer as opt
@@ -311,6 +312,10 @@ class Module(BaseModule):
         self._exec.forward_backward()
 
     def _set_batch(self, data_batch):
+        with telemetry.span("feed"):
+            self._set_batch_impl(data_batch)
+
+    def _set_batch_impl(self, data_batch):
         data = data_batch.data
         if not isinstance(data, (list, tuple)):
             data = [data]
@@ -363,7 +368,9 @@ class Module(BaseModule):
         elif isinstance(src, NDArray):
             src.copyto(dst)
         else:
-            dst[:] = np.asarray(src)
+            raw = np.asarray(src)
+            telemetry.record_transfer(raw.nbytes)
+            dst[:] = raw
 
     def update(self):
         """Apply one optimizer step (parity: module.update →
@@ -381,16 +388,19 @@ class Module(BaseModule):
             return
         keys = [i for i, _ in live]
         grads = [grad_dict[name] for _, name in live]
-        if self._kvstore is not None and self._update_on_kvstore:
-            self._kvstore.push(keys, grads)
-            self._kvstore.pull(keys, out=[arg_dict[name] for _, name in live])
-        else:
-            if self._kvstore is not None:
+        with telemetry.span("opt_update"):
+            if self._kvstore is not None and self._update_on_kvstore:
                 self._kvstore.push(keys, grads)
-                self._kvstore.pull(keys, out=grads)
-            # one fused dispatch for the whole parameter set (FusedUpdater)
-            self._updater.update_batch(
-                keys, grads, [arg_dict[name] for _, name in live])
+                self._kvstore.pull(keys,
+                                   out=[arg_dict[name] for _, name in live])
+            else:
+                if self._kvstore is not None:
+                    self._kvstore.push(keys, grads)
+                    self._kvstore.pull(keys, out=grads)
+                # one fused dispatch for the whole parameter set
+                # (FusedUpdater)
+                self._updater.update_batch(
+                    keys, grads, [arg_dict[name] for _, name in live])
 
     # -- whole-step fused training -----------------------------------------
     def _fused_batch_step(self, data_batch, eval_metric=None):
@@ -690,22 +700,26 @@ class Module(BaseModule):
                 # fed default-device arrays would otherwise crash the
                 # program with mixed committed inputs; same-device puts
                 # are a no-op)
+                if isinstance(raw, np.ndarray):
+                    telemetry.record_transfer(raw.nbytes)
                 raw = jax.device_put(raw, dev)
             return raw
 
-        inputs = {}
-        for desc, arr in zip(self._data_shapes, data):
-            inputs[desc.name] = _raw(arr)
-        label_raws = []
-        if label is not None and self._label_shapes:
-            for desc, arr in zip(self._label_shapes, label):
-                r = _raw(arr)
-                # the jit signature carries only graph-consumed labels
-                if desc.name in plan["label_inputs"]:
-                    inputs[desc.name] = r
-                label_raws.append(r)
-        for name in self._state_names:
-            inputs[name] = arg_dict[name]._data
+        with telemetry.span("feed"):
+            inputs = {}
+            for desc, arr in zip(self._data_shapes, data):
+                inputs[desc.name] = _raw(arr)
+            label_raws = []
+            if label is not None and self._label_shapes:
+                for desc, arr in zip(self._label_shapes, label):
+                    r = _raw(arr)
+                    # the jit signature carries only graph-consumed
+                    # labels
+                    if desc.name in plan["label_inputs"]:
+                        inputs[desc.name] = r
+                    label_raws.append(r)
+            for name in self._state_names:
+                inputs[name] = arg_dict[name]._data
 
         # host-side bookkeeping exactly as the phase-split update() does
         # it — same Updater states, same count/lr/wd schedule, so a
@@ -739,9 +753,10 @@ class Module(BaseModule):
         rng = ex._step_key()
 
         record_dispatch("train_step")
-        new_params, new_states, new_acc, new_aux, outs, grads_out = \
-            plan["fn"](params_raw, states_raw, acc, aux_raw, inputs, rng,
-                       lrs, wds, ts, add_grads)
+        with telemetry.span("step"):
+            new_params, new_states, new_acc, new_aux, outs, grads_out = \
+                plan["fn"](params_raw, states_raw, acc, aux_raw, inputs, rng,
+                           lrs, wds, ts, add_grads)
 
         # donation invalidated the old buffers — reinstall everything
         for n in self._param_names:
@@ -780,8 +795,13 @@ class Module(BaseModule):
         return [gd[n] for n in self._data_names]
 
     def update_metric(self, eval_metric, labels):
-        eval_metric.update(labels if isinstance(labels, (list, tuple))
-                           else [labels], self.get_outputs())
+        # "metric_update" — the NON-blocking per-batch accumulate; the
+        # blocking host fetch records separately as "metric_fetch"
+        # (EvalMetric._flush_device), so the fetch histogram stays the
+        # stall detector PERF.md reads
+        with telemetry.span("metric_update"):
+            eval_metric.update(labels if isinstance(labels, (list, tuple))
+                               else [labels], self.get_outputs())
 
     # -- checkpoints -------------------------------------------------------
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):

@@ -28,6 +28,7 @@ from ..context import Context, current_context, cpu
 from ..ops import registry as _registry
 from ..ops.common import mx_dtype
 from .. import imperative as _imp
+from .. import telemetry
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "concatenate", "moveaxis", "waitall", "imresize", "onehot_encode"]
@@ -98,12 +99,16 @@ class NDArray:
     # -- sync points -------------------------------------------------------
     def wait_to_read(self):
         """Block until the value is computed (parity: NDArray::WaitToRead)."""
+        telemetry.record_host_sync("wait_to_read")
         jax.block_until_ready(self._data)
 
     wait_to_write = wait_to_read
 
     def asnumpy(self):
         """Copy to a numpy array; synchronises (parity: ndarray.py asnumpy)."""
+        telemetry.record_host_sync("asnumpy")
+        telemetry.record_transfer(self._data.size * self._data.dtype.itemsize,
+                                  direction="d2h")
         out = np.asarray(jax.device_get(self._data))
         return out
 

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
+from .. import telemetry
 
 __all__ = ["SPMDTrainer", "shard_params_rule", "DataParallelSpec",
            "dp_spec", "check_batch_divisible", "shard_put",
@@ -65,8 +66,12 @@ def shard_put(raw, sharding):
     """Sharded device_put of a GLOBAL batch array: each device receives
     only its shard (no host-side splitting, no full-batch replication —
     the TPU-native replacement for the reference's decide_slices copy
-    loop, executor_group.py:266)."""
-    return jax.device_put(raw, sharding)
+    loop, executor_group.py:266). Host-resident inputs count toward the
+    telemetry h2d-bytes register; device-side reshards do not."""
+    with telemetry.span("shard_put"):
+        if isinstance(raw, np.ndarray):
+            telemetry.record_transfer(raw.nbytes)
+        return jax.device_put(raw, sharding)
 
 
 def commit_dp_placements(executor, input_names, spec):

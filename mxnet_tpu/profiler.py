@@ -1,15 +1,20 @@
-"""Profiler — chrome-trace output of device execution.
+"""Profiler — chrome-trace output of device execution + host spans.
 
 Parity: reference ``src/engine/profiler.{h,cc}`` + ``python/mxnet/
 profiler.py`` (SURVEY.md §5.1; chrome://tracing JSON output). TPU-native
 design: wraps the JAX/XLA profiler, which records real device op spans
-(the reference stamped engine-op spans). ``dump()`` writes a
-chrome-trace-compatible ``.trace.json.gz`` plus TensorBoard-compatible
-artifacts in the output directory.
+(the reference stamped engine-op spans), and MERGES the telemetry host
+spans (feed/shard_put/step/metric_fetch/io_next/...) into the same
+chrome-trace JSON, so ``dump()`` yields ONE perfetto-loadable file where
+the host timeline (what Python dispatched when) lines up against the
+device timeline (what XLA executed when) — the view that found the 14x
+``Module.fit`` gap (PERF.md). TensorBoard-compatible artifacts stay in
+the output directory.
 """
 from __future__ import annotations
 
 import glob
+import json
 import os
 import time
 
@@ -40,6 +45,10 @@ def set_state(state="stop", profile_process="worker"):
             out_dir = os.path.splitext(_state["filename"])[0] + "_trace"
             os.makedirs(out_dir, exist_ok=True)
             jax.profiler.start_trace(out_dir)
+            # stamp the host-span window: the merged dump keeps only
+            # spans recorded while the device trace ran
+            from . import telemetry
+            telemetry.mark_trace_start()
             _state["dir"] = out_dir
             _state["running"] = True
     elif state == "stop":
@@ -54,20 +63,87 @@ def set_state(state="stop", profile_process="worker"):
 profiler_set_state = set_state
 
 
+def _host_events():
+    from . import telemetry
+    return telemetry.chrome_events()
+
+
+# any epoch-microsecond stamp after ~1973 exceeds this; a trace-relative
+# stamp would need a ~3-year-long trace to reach it
+_EPOCH_TS_FLOOR_US = 1e14
+
+
+def _aligned_host_events(device_events, host):
+    """Host span events on the device trace's timebase. Telemetry stamps
+    spans in epoch microseconds; XLA's trace converter may emit epoch-
+    based OR trace-relative timestamps depending on version. The two
+    cases are separated by MAGNITUDE (epoch stamps are ~1.7e15 us;
+    trace-relative ones start near zero — a first-device-op gap, e.g. a
+    minutes-long in-window compile, cannot cross that line): epoch-based
+    device stamps need no adjustment; trace-relative ones get the host
+    events shifted so the trace-start instant maps onto the earliest
+    device timestamp."""
+    from . import telemetry
+    t0_us = telemetry.trace_start_epoch_us()
+    dts = [e["ts"] for e in device_events
+           if e.get("ph") in ("X", "B") and "ts" in e]
+    if not dts or t0_us is None:
+        return host
+    dmin = min(dts)
+    if dmin > _EPOCH_TS_FLOOR_US:    # device stamps already epoch-based
+        return host
+    shift = dmin - t0_us
+    for e in host:
+        if "ts" in e:
+            e["ts"] = round(e["ts"] + shift, 3)
+    return host
+
+
 def _link_chrome_trace():
-    """Surface the chrome trace at the configured filename as plain JSON —
-    the reference emits an uncompressed chrome://tracing file (profiler.cc:161)."""
+    """Surface the chrome trace at the configured filename as plain JSON
+    — the reference emits an uncompressed chrome://tracing file
+    (profiler.cc:161) — with the telemetry HOST spans merged into the
+    device event list (one perfetto view, host track above the device
+    tracks). When the backend produced no ``.trace.json.gz`` (some
+    platforms/versions skip the converter), a host-span-only trace is
+    still written so the configured filename always materialises."""
     out_dir = _state["dir"]
     if not out_dir:
         return
     matches = glob.glob(os.path.join(out_dir, "**", "*.trace.json.gz"),
                         recursive=True)
-    if matches:
+    host = _host_events()
+    if matches and not any(e.get("ph") == "X" for e in host):
+        # nothing to merge (telemetry disabled / empty span window):
+        # stream the device dump through verbatim instead of paying a
+        # full parse+re-serialize of a potentially huge trace
         import gzip
         import shutil
         with gzip.open(sorted(matches)[-1], "rb") as src, \
                 open(_state["filename"], "wb") as dst:
             shutil.copyfileobj(src, dst)
+        return
+    trace = None
+    if matches:
+        import gzip
+        with gzip.open(sorted(matches)[-1], "rb") as src:
+            raw = src.read()
+        try:
+            trace = json.loads(raw.decode("utf-8", "replace"))
+        except ValueError:
+            # unparseable device dump: keep the reference behavior
+            # (surface it verbatim) rather than lose it to the merge
+            with open(_state["filename"], "wb") as dst:
+                dst.write(raw)
+            return
+    if not isinstance(trace, dict) or \
+            not isinstance(trace.get("traceEvents"), list):
+        events = trace if isinstance(trace, list) else []
+        trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+    trace["traceEvents"].extend(
+        _aligned_host_events(trace["traceEvents"], host))
+    with open(_state["filename"], "w") as dst:
+        json.dump(trace, dst)
 
 
 def dump(finished=True, profile_process="worker"):
@@ -86,17 +162,23 @@ def resume(profile_process="worker"):
 
 class Scope:
     """Annotate a region so it shows up in the device trace
-    (jax.profiler.TraceAnnotation under the hood)."""
+    (jax.profiler.TraceAnnotation under the hood) AND as a telemetry
+    host span (so the region also lands in the merged chrome dump and
+    the snapshot percentiles)."""
 
     def __init__(self, name):
         self._ann = jax.profiler.TraceAnnotation(name)
+        from . import telemetry
+        self._span = telemetry.span(name)
 
     def __enter__(self):
+        self._span.__enter__()
         self._ann.__enter__()
         return self
 
     def __exit__(self, *exc):
         self._ann.__exit__(*exc)
+        self._span.__exit__(*exc)
 
 
 def dump_profile():

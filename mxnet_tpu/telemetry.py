@@ -1,0 +1,418 @@
+"""Unified runtime telemetry: counters, host-span tracing, dispatch taps.
+
+No reference counterpart — the reference's only runtime window was the
+engine profiler's device spans (src/engine/profiler.cc). On a remoted
+PJRT backend the HOST side (feed, shard_put, dispatch, fallback
+decisions, blocking syncs) is where throughput goes to die — it is what
+hid the 14x ``Module.fit`` gap until round 5 (PERF.md) — so this module
+is the standing instrument every perf PR reads from:
+
+* a **counter registry** — jitted-program dispatches by kind, jit-cache
+  compiles vs. hits per ``_GraphProgram`` entry point, fused-step
+  fallback events keyed by their stable ``FusedFallback.code``,
+  host->device transfer bytes, blocking host syncs, kvstore traffic;
+* **host-side span tracing** — ``with telemetry.span("feed"): ...``
+  records wall-time intervals into a bounded ring buffer with a
+  per-name duration histogram and a p50/p95/p99 ``snapshot()`` API;
+* a **multi-subscriber dispatch registry** — ``on_dispatch(cb)`` /
+  ``remove_dispatch(cb)`` replaces the old single-slot
+  ``executor.dispatch_hook`` global (which probe, tests and telemetry
+  silently clobbered off each other; the legacy name still works as a
+  back-compat shim read by ``executor.record_dispatch``);
+* **chrome-trace export** — ``chrome_events()`` renders the span ring
+  as chrome://tracing ``X`` events; ``profiler.py`` merges them into
+  the XLA device dump so host and device timelines land in ONE
+  perfetto-loadable JSON.
+
+Everything here is stdlib-only (no jax import) and cheap when disabled:
+``MXNET_TELEMETRY=0`` (or ``disable()``) reduces every span to two
+attribute reads and every counter to one branch. Counters and spans are
+process-global — the fit loop, the kvstore and the io pipeline all feed
+one registry, which is exactly what makes the merged trace readable.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+
+__all__ = [
+    "enabled", "enable", "disable", "reset",
+    "counter_inc", "counters", "snapshot", "span", "span_stats",
+    "span_count", "span_durations",
+    "on_dispatch", "remove_dispatch", "dispatch_event",
+    "record_jit", "record_fallback", "record_transfer",
+    "record_host_sync", "chrome_events", "mark_trace_start",
+    "SPAN_RING_SIZE", "FIT_PHASE_SPANS",
+]
+
+# ring capacities: bound memory for arbitrarily long training runs. The
+# span ring keeps the most recent intervals for chrome export; duration
+# histograms keep more samples per name so percentiles stay meaningful
+# after the ring has wrapped.
+SPAN_RING_SIZE = 4096
+_DURATIONS_PER_NAME = 4096
+
+# the fit-loop phase span names — the ONE list the bench/probe artifact
+# summaries filter on, kept next to the code that records them so the
+# BENCH/MULTICHIP accountings can't silently diverge
+FIT_PHASE_SPANS = ("fit_batch", "feed", "step", "shard_put",
+                   "metric_update", "metric_fetch", "opt_update",
+                   "io_next", "callbacks", "epoch_sync",
+                   "kv_push", "kv_pull")
+
+
+class _State:
+    __slots__ = ("enabled",)
+
+    def __init__(self):
+        self.enabled = os.environ.get("MXNET_TELEMETRY", "1") not in (
+            "0", "false")
+
+
+_state = _State()
+_lock = threading.Lock()
+_counters = {}
+# span ring: (name, start_ns, end_ns, thread_id) in perf_counter_ns time
+_spans = collections.deque(maxlen=SPAN_RING_SIZE)
+_durations = {}          # name -> deque of duration seconds
+_span_total = {}         # name -> cumulative span count (uncapped)
+_dispatch_subs = []      # multi-subscriber dispatch registry
+_gen = 0                 # bumped by reset(): spans straddling a reset
+                         # belong to the OLD window and must not leak
+                         # into the freshly cleared registry
+
+# perf_counter<->epoch anchor, taken once at import: spans are stamped
+# in the monotonic perf_counter timebase (immune to clock steps); the
+# chrome exporter maps them back to epoch microseconds through this
+# anchor so they can align with the device trace
+_ANCHOR_PERF_NS = time.perf_counter_ns()
+_ANCHOR_EPOCH_NS = time.time_ns()
+
+# perf_counter_ns stamp of the last profiler trace start (chrome export
+# filters to spans inside the trace window)
+_trace_start_ns = None
+
+
+# ---------------------------------------------------------------------------
+# Enable/disable
+# ---------------------------------------------------------------------------
+
+def enabled():
+    """Whether spans and counters record (default on; MXNET_TELEMETRY=0
+    starts disabled). Dispatch SUBSCRIBERS fire regardless — they were
+    installed explicitly."""
+    return _state.enabled
+
+
+def enable():
+    _state.enabled = True
+
+
+def disable():
+    _state.enabled = False
+
+
+def reset():
+    """Clear every counter, span and histogram (subscribers stay).
+    Spans currently OPEN on any thread are dropped at their exit — a
+    pre-reset interval must not appear in the new accounting window."""
+    global _gen
+    with _lock:
+        _gen += 1
+        _counters.clear()
+        _spans.clear()
+        _durations.clear()
+        _span_total.clear()
+
+
+# ---------------------------------------------------------------------------
+# Counter registry
+# ---------------------------------------------------------------------------
+
+def counter_inc(name, n=1):
+    """Add ``n`` to counter ``name`` (no-op while disabled)."""
+    if not _state.enabled:
+        return
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def counters():
+    """Snapshot copy of the counter registry."""
+    with _lock:
+        return dict(_counters)
+
+
+def record_jit(kind, hit):
+    """One ``_GraphProgram``/updater jit-cache lookup: ``hit=False`` is
+    a program build (trace + XLA compile on first execution), ``hit=True``
+    a cached-program reuse. Fed by executor.py — the compile-vs-hit ratio
+    is the recompile-storm detector."""
+    if not _state.enabled:
+        return
+    what = "hit" if hit else "compile"
+    with _lock:
+        _counters["jit.%s" % what] = _counters.get("jit.%s" % what, 0) + 1
+        k = "jit.%s.%s" % (what, kind)
+        _counters[k] = _counters.get(k, 0) + 1
+
+
+def record_fallback(code):
+    """One fused-step fallback event, keyed by the stable
+    ``FusedFallback.code`` (module/base_module.FUSED_FALLBACK_CODES)."""
+    counter_inc("fused_fallback.%s" % code)
+
+
+def record_transfer(nbytes, direction="h2d"):
+    """Host<->device transfer accounting (bytes + event count)."""
+    if not _state.enabled:
+        return
+    with _lock:
+        _counters["transfer.%s_bytes" % direction] = \
+            _counters.get("transfer.%s_bytes" % direction, 0) + int(nbytes)
+        _counters["transfer.%s_count" % direction] = \
+            _counters.get("transfer.%s_count" % direction, 0) + 1
+
+
+def record_host_sync(what="host"):
+    """One BLOCKING host synchronisation (asnumpy/wait_to_read/metric
+    flush) — the async-pipeline stalls PERF.md hunts for."""
+    if not _state.enabled:
+        return
+    with _lock:
+        _counters["host_sync.blocking"] = \
+            _counters.get("host_sync.blocking", 0) + 1
+        k = "host_sync.%s" % what
+        _counters[k] = _counters.get(k, 0) + 1
+
+
+# ---------------------------------------------------------------------------
+# Dispatch registry (multi-subscriber; replaces the single-slot hook)
+# ---------------------------------------------------------------------------
+
+def on_dispatch(cb):
+    """Subscribe ``cb(kind)`` to every jitted-program dispatch
+    (``executor.record_dispatch``). Unlike the legacy single-slot
+    ``executor.dispatch_hook`` global, any number of subscribers coexist
+    — the probe, tests and telemetry no longer clobber each other.
+    Returns ``cb`` for symmetric ``remove_dispatch(cb)``."""
+    with _lock:
+        if cb not in _dispatch_subs:
+            _dispatch_subs.append(cb)
+    return cb
+
+
+def remove_dispatch(cb):
+    """Unsubscribe a callback; unknown callbacks are ignored."""
+    with _lock:
+        try:
+            _dispatch_subs.remove(cb)
+        except ValueError:
+            pass
+
+
+def dispatch_event(kind):
+    """Fan one dispatch out to the counter registry and every
+    subscriber. Called by ``executor.record_dispatch`` — the ONE
+    dispatch-reporting entry point (tools/run_checks.sh lints that no
+    other site grows a raw hook call)."""
+    if _state.enabled:
+        with _lock:
+            k = "dispatch.%s" % kind
+            _counters[k] = _counters.get(k, 0) + 1
+    if _dispatch_subs:
+        for cb in list(_dispatch_subs):
+            cb(kind)
+
+
+def dispatch_counts():
+    """{kind: count} view of the dispatch counters (the probe's
+    per-batch dispatch accounting reads this instead of installing its
+    own hook)."""
+    with _lock:
+        return {k[len("dispatch."):]: v for k, v in _counters.items()
+                if k.startswith("dispatch.")}
+
+
+# ---------------------------------------------------------------------------
+# Host-side span tracing
+# ---------------------------------------------------------------------------
+
+class _Span:
+    """Reentrant-per-instance-free timing scope; ~two perf_counter_ns
+    calls + two deque appends when enabled, two attribute reads when
+    disabled."""
+    __slots__ = ("name", "_t0", "_gen")
+
+    def __init__(self, name):
+        self.name = name
+        self._t0 = 0
+
+    def __enter__(self):
+        if _state.enabled:
+            self._t0 = time.perf_counter_ns()
+            self._gen = _gen
+        return self
+
+    def cancel(self):
+        """Drop this span: nothing is recorded at scope exit (e.g. an
+        epoch-end StopIteration is not io time)."""
+        self._t0 = 0
+
+    def __exit__(self, *exc):
+        # record only if telemetry is STILL enabled (a disable() mid-
+        # span pins the disabled leg clean) and no reset() started a
+        # new accounting window while this span was open
+        if self._t0 and _state.enabled and self._gen == _gen:
+            _record_span(self.name, self._t0, time.perf_counter_ns())
+        self._t0 = 0
+        return False
+
+
+def span(name):
+    """``with telemetry.span("feed"): ...`` — record one host wall-time
+    interval into the ring buffer and the per-name histogram."""
+    return _Span(name)
+
+
+def _record_span(name, t0_ns, t1_ns):
+    # deque.append and dict reads are GIL-atomic so the ring/histogram
+    # writes stay lock-free; the cumulative counter is a read-modify-
+    # write and takes the lock like every other counter
+    _spans.append((name, t0_ns, t1_ns, threading.get_ident()))
+    d = _durations.get(name)
+    if d is None:
+        with _lock:
+            d = _durations.setdefault(name, collections.deque(
+                maxlen=_DURATIONS_PER_NAME))
+    d.append((t1_ns - t0_ns) / 1e9)
+    with _lock:
+        _span_total[name] = _span_total.get(name, 0) + 1
+
+
+def span_count(name):
+    """CUMULATIVE number of spans recorded under ``name`` since the last
+    reset() — unlike ``span_stats()[name]['count']``, not capped by the
+    histogram ring, so windowed readers (TelemetryLogger) can tell how
+    many new samples landed since their last look."""
+    return _span_total.get(name, 0)
+
+
+def span_durations(name):
+    """Copy of the retained duration samples (seconds, oldest first) for
+    one span name — at most the last ``_DURATIONS_PER_NAME`` samples."""
+    with _lock:
+        d = _durations.get(name)
+        return list(d) if d is not None else []
+
+
+def _percentile(sorted_vals, q):
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, max(0, int(round(
+        q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[i]
+
+
+def span_stats(name=None):
+    """Per-span-name wall-time statistics over the retained histogram:
+    {name: {count, total_ms, mean_ms, p50_ms, p95_ms, p99_ms, max_ms}}.
+    ``name`` restricts to one span name."""
+    with _lock:
+        items = [(name, list(_durations[name]))] if name is not None \
+            and name in _durations else \
+            ([] if name is not None else
+             [(k, list(v)) for k, v in _durations.items()])
+    out = {}
+    for k, vals in items:
+        s = sorted(vals)
+        total = sum(s)
+        out[k] = {
+            "count": len(s),
+            "total_ms": round(total * 1e3, 3),
+            "mean_ms": round(total / len(s) * 1e3, 4) if s else 0.0,
+            "p50_ms": round(_percentile(s, 50) * 1e3, 4),
+            "p95_ms": round(_percentile(s, 95) * 1e3, 4),
+            "p99_ms": round(_percentile(s, 99) * 1e3, 4),
+            "max_ms": round(s[-1] * 1e3, 4) if s else 0.0,
+        }
+    return out
+
+
+def snapshot():
+    """One self-describing dict: counters + span percentiles. This is
+    what ``Module.telemetry_snapshot()`` returns, what ``bench.py``
+    embeds in the BENCH/MULTICHIP artifacts and what
+    ``callback.TelemetryLogger`` diffs per log line."""
+    return {
+        "enabled": _state.enabled,
+        "counters": counters(),
+        "spans": span_stats(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace export
+# ---------------------------------------------------------------------------
+
+def mark_trace_start():
+    """Stamp the profiler trace-start instant; ``chrome_events()`` then
+    exports only spans inside the trace window. Called by
+    ``profiler.set_state('run')``."""
+    global _trace_start_ns
+    _trace_start_ns = time.perf_counter_ns()
+    return _trace_start_ns
+
+
+def _epoch_us(perf_ns):
+    return (_ANCHOR_EPOCH_NS + (perf_ns - _ANCHOR_PERF_NS)) / 1e3
+
+
+def trace_start_epoch_us():
+    """Epoch-microsecond instant of the last mark_trace_start() (None
+    before any trace ran) — profiler.py aligns host events against the
+    device trace's own timebase through this."""
+    if _trace_start_ns is None:
+        return None
+    return _epoch_us(_trace_start_ns)
+
+
+def chrome_events(pid=None, since_trace_start=True):
+    """Render retained host spans as chrome://tracing complete events
+    (``ph: "X"``, ``ts``/``dur`` in microseconds, epoch timebase) plus
+    the process/thread metadata rows that label the track "mxnet_tpu
+    host" in perfetto. ``since_trace_start=True`` keeps only spans that
+    began after the last ``mark_trace_start()`` (everything, if no trace
+    was started)."""
+    if pid is None:
+        pid = os.getpid()
+    with _lock:
+        spans = list(_spans)
+    t0 = _trace_start_ns if since_trace_start else None
+    events = [{
+        "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+        "args": {"name": "mxnet_tpu host"},
+    }, {
+        "ph": "M", "name": "process_sort_index", "pid": pid, "tid": 0,
+        "args": {"sort_index": -1},
+    }]
+    tids = set()
+    for name, s_ns, e_ns, tid in spans:
+        if t0 is not None and s_ns < t0:
+            continue
+        tids.add(tid)
+        events.append({
+            "ph": "X", "cat": "host", "name": name,
+            "pid": pid, "tid": tid,
+            "ts": round(_epoch_us(s_ns), 3),
+            "dur": round((e_ns - s_ns) / 1e3, 3),
+        })
+    for tid in tids:
+        events.append({
+            "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+            "args": {"name": "host thread %d" % tid},
+        })
+    return events

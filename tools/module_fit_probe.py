@@ -20,7 +20,7 @@ DP-smoke lane:      python tools/module_fit_probe.py --dp-smoke \
   (tier-1 CI: tiny-MLP Module.fit on the virtual 8-device CPU mesh —
   the fused-SPMD data-parallel step vs the kvstore phase-split path;
   asserts dp-fused >= phase-split img/s and EXACTLY 1 jitted-program
-  dispatch per batch via executor.dispatch_hook)
+  dispatch per batch via the mx.telemetry dispatch registry)
 """
 import json
 import os
@@ -148,15 +148,18 @@ def _smoke_lane(lane, contexts, kvstore, rounds, nbatch, batch,
                 speed_key, extra=None, json_out=None):
     """The ONE tier-1 lane harness both smoke lanes share: tiny-MLP
     ``Module.fit``, fused whole-step program vs phase-split oracle, with
-    jitted-program dispatch counts per batch (``executor.dispatch_hook``)
-    and interleaved best-of timing (one epoch is a ~10ms window and
-    share-throttled CI boxes drift in sustained speed — timing the two
-    paths back to back inside each round keeps the RATIO honest under
-    drift, and the min converges on the dispatch floor under spike
+    jitted-program dispatch counts per batch AND per-phase host-span
+    timings read from the TELEMETRY registry (``mx.telemetry`` — the
+    probe used to install its own single-slot ``executor.dispatch_hook``
+    and duplicate the accounting; the multi-subscriber registry owns it
+    now), and interleaved best-of timing (one epoch is a ~10ms window
+    and share-throttled CI boxes drift in sustained speed — timing the
+    two paths back to back inside each round keeps the RATIO honest
+    under drift, and the min converges on the dispatch floor under spike
     noise). One JSON object on stdout (and to ``json_out``) — the
     artifact the CI lane banks each round. Returns (out, dispatch)."""
     import mxnet_tpu as mx
-    import mxnet_tpu.executor as _ex
+    from mxnet_tpu import telemetry
     from mxnet_tpu.io import DataIter, DataDesc, DataBatch
 
     d, c = 16, 4
@@ -222,10 +225,12 @@ def _smoke_lane(lane, contexts, kvstore, rounds, nbatch, batch,
                              "fallback code, got %r" % (lane, reason))
         return mod, metric, train
 
-    def epoch(state, fused, counts):
+    def epoch(state, fused):
         mod, metric, train = state
         os.environ["MXNET_MODULE_FUSED_STEP"] = "1" if fused else "0"
-        counts.clear()
+        # clean registry window: the counters/spans read after this
+        # epoch describe THIS epoch alone
+        telemetry.reset()
         t0 = time.perf_counter()
         mod.fit(train, eval_metric=metric, num_epoch=1, kvstore=kvstore,
                 optimizer="sgd", optimizer_params=opt_params)
@@ -238,16 +243,32 @@ def _smoke_lane(lane, contexts, kvstore, rounds, nbatch, batch,
     states = {True: setup(True), False: setup(False)}
     dts = {True: float("inf"), False: float("inf")}
     dispatch = {True: {}, False: {}}
-    _ex.dispatch_hook = None
+    phases = {True: {}, False: {}}
+    # the lane's accounting READS the registry, so recording must be on
+    # for its window regardless of the ambient MXNET_TELEMETRY pin
+    # (restored after — the lane must not flip the session's state)
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
     try:
         for _ in range(rounds):
             for f in (True, False):
-                counts = dispatch[f]
-                _ex.dispatch_hook = lambda kind: counts.__setitem__(
-                    kind, counts.get(kind, 0) + 1)
-                dts[f] = min(dts[f], epoch(states[f], f, counts))
+                dt = epoch(states[f], f)
+                if dt <= dts[f]:
+                    # bank the registry window of the BEST round, so
+                    # the per-phase timings in the artifact describe
+                    # the same epoch as the best-of img/s next to them
+                    dts[f] = dt
+                    dispatch[f] = telemetry.dispatch_counts()
+                    phases[f] = {
+                        name: {"count": s["count"],
+                               "total_ms": s["total_ms"],
+                               "p50_ms": s["p50_ms"],
+                               "p95_ms": s["p95_ms"]}
+                        for name, s in telemetry.span_stats().items()
+                        if name in telemetry.FIT_PHASE_SPANS}
     finally:
-        _ex.dispatch_hook = None
+        if not was_enabled:
+            telemetry.disable()
 
     def report(f):
         return {
@@ -255,6 +276,7 @@ def _smoke_lane(lane, contexts, kvstore, rounds, nbatch, batch,
             "dispatches_per_batch": round(
                 sum(dispatch[f].values()) / nbatch, 2),
             "dispatch_counts": dispatch[f],
+            "phase_spans": phases[f],
         }
 
     fused, split = report(True), report(False)
@@ -288,8 +310,9 @@ def dp_smoke(json_out=None, nbatch=12, batch=32):
     CPU mesh, the whole-step fused SPMD program (multi-context +
     subsumed ``device`` kvstore) vs the kvstore phase-split path.
     Asserts the two load-bearing dp properties — EXACTLY 1 dispatch per
-    batch on the fused path and dp-fused throughput >= the phase-split
-    path — and banks the JSON artifact stamped with the gate outcome
+    batch on the fused path (telemetry dispatch counters) and dp-fused
+    throughput >= the phase-split path — and banks the JSON artifact
+    stamped with the gate outcome
     (a gate-failing round must not read as a healthy record in the
     artifact dir; 5 rounds keeps the tier-1 lane's wall-clock small)."""
     import mxnet_tpu as mx

@@ -4,6 +4,18 @@
 set -e
 cd "$(dirname "$0")/.."
 
+echo "== telemetry dispatch lint"
+# every dispatch site must report through executor.record_dispatch (which
+# fans out to the telemetry registry); a raw single-slot hook CALL
+# anywhere else silently clobbers other subscribers
+if grep -rn "dispatch_hook(" --include='*.py' mxnet_tpu tools bench.py \
+        | grep -v "^mxnet_tpu/executor.py:"; then
+  echo "FAIL: raw dispatch_hook( call outside mxnet_tpu/executor.py —"
+  echo "      report dispatches via executor.record_dispatch /"
+  echo "      subscribe via telemetry.on_dispatch"
+  exit 1
+fi
+
 echo "== native build"
 make -s
 echo "== C++ unit tests"
