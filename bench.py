@@ -275,16 +275,28 @@ def child():
 
     # AOT-compile once; the SAME executable provides the FLOP count (its
     # own cost model), runs the warmup, AND runs the timing loop — one
-    # callable throughout, no reliance on jit-cache behaviour.
+    # callable throughout, no reliance on jit-cache behaviour. The
+    # executable's cost/memory analysis is captured as a PROGRAM CARD
+    # through the executor's shared card builder and registered in
+    # telemetry.programs(), so tools/mfu_capture.py reads the step's
+    # FLOPs/bytes straight from the bench line instead of requiring an
+    # xprof hlo_stats capture.
     step_flops = None
+    step_bytes = None
+    step_card = None
     run = step
     try:
+        from mxnet_tpu.executor import card_from_compiled
+        from mxnet_tpu import telemetry as _tel
+        t_c0 = time.perf_counter()
         compiled = step.lower(master, mom, pbf, x, y, rng).compile()
         run = compiled
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        step_flops = float(ca.get("flops", 0.0)) or None
+        step_card = card_from_compiled("bench_step", compiled,
+                                       entry="bench_step")
+        step_card["compile_ms"] = round((time.perf_counter() - t_c0) * 1e3, 1)
+        _tel.record_program(step_card)
+        step_flops = step_card["flops"] or None
+        step_bytes = step_card["bytes_accessed"] or None
     except Exception as e:
         print("bench: AOT compile/cost_analysis unavailable, using jit:", e,
               file=sys.stderr)
@@ -323,6 +335,18 @@ def child():
         out["tflops_per_s"] = round(flops_s / 1e12, 2)
         if peak:
             out["mfu"] = round(flops_s / peak, 4)
+    # per-step cost/memory card figures (mfu_capture's no-xprof path
+    # and the PERF.md "Memory & cost telemetry" table read these)
+    if step_flops:
+        out["step_flops"] = step_flops
+    if step_bytes:
+        out["step_bytes_accessed"] = step_bytes
+    if step_card is not None:
+        out["program_card"] = {
+            k: step_card.get(k) for k in
+            ("id", "kind", "flops", "bytes_accessed", "peak_bytes",
+             "argument_bytes", "output_bytes", "temp_bytes",
+             "generated_code_bytes", "compile_ms")}
     # Analytic-FLOP MFU (the externally comparable number — see the
     # ANALYTIC_FWD_FLOPS_PER_IMG_224 comment).
     analytic_step = (3.0 * ANALYTIC_FWD_FLOPS_PER_IMG_224
@@ -354,7 +378,10 @@ def _telemetry_summary():
     # keep the flag: a disabled-telemetry leg's all-zero counters must
     # read as "instrumentation off", not as a measured zero
     return {"enabled": snap["enabled"], "counters": snap["counters"],
-            "spans": spans}
+            "spans": spans,
+            # per-leg program cards + the online FLOP/s estimate: what a
+            # step COSTS, next to what it MEASURED
+            "programs": snap["programs"], "online": snap["online"]}
 
 
 def module_child():

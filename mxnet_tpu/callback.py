@@ -85,9 +85,18 @@ class TelemetryLogger:
     registry to read)::
 
         mod.fit(train, batch_end_callback=mx.callback.TelemetryLogger(50))
+
+    ``programs=True`` additionally logs every NEW program card the
+    moment it appears in ``telemetry.programs()`` — entry, kind,
+    trace/compile wall-time, cost-model GFLOPs and peak HBM — so a
+    recompile mid-training is visible in the training log, next to the
+    recompile-cause warning the executor emits::
+
+        mod.fit(train, batch_end_callback=mx.callback.TelemetryLogger(
+            50, programs=True))
     """
 
-    def __init__(self, frequent=50, logger=None):
+    def __init__(self, frequent=50, logger=None, programs=False):
         from . import telemetry
         self.frequent = int(max(1, frequent))
         self.logger = logger or logging.getLogger("mxnet_tpu.telemetry")
@@ -95,6 +104,8 @@ class TelemetryLogger:
         self._last_counters = {}
         self._last_nbatch = None
         self._last_step_total = 0
+        self._programs = bool(programs)
+        self._seen_programs = set()
 
     def _rebase(self, count):
         self._last_counters = self._telemetry.counters()
@@ -116,7 +127,27 @@ class TelemetryLogger:
         self._last_counters = cur
         return delta
 
+    def _log_new_programs(self):
+        """Report cards not seen before (cheap: one registry read per
+        callback, and new cards only appear on compiles)."""
+        for key, card in self._telemetry.programs().items():
+            if key in self._seen_programs:
+                continue
+            self._seen_programs.add(key)
+            flops = card.get("flops")
+            peak = card.get("peak_bytes")
+            self.logger.info(
+                "program card %s: kind=%s trace=%.1fms compile=%.1fms "
+                "flops=%s peak_hbm=%s donated=%d",
+                key, card.get("kind"),
+                card.get("trace_ms") or 0.0, card.get("compile_ms") or 0.0,
+                "%.4g" % flops if flops else None,
+                "%.2fMiB" % (peak / 2.0 ** 20) if peak else None,
+                len(card.get("donated") or ()))
+
     def __call__(self, param):
+        if self._programs:
+            self._log_new_programs()
         count = param.nbatch
         if self._last_nbatch is None or count < self._last_nbatch:
             # first call of an epoch (fit fires batch-end at nbatch=0,

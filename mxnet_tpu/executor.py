@@ -21,13 +21,17 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+import itertools
+import time
+
 from .base import MXNetError
 from .context import current_context
 from .ops.common import rng_scope, mx_dtype
 from . import random as _random
 from . import telemetry
 
-__all__ = ["Executor", "infer_graph_shapes", "record_dispatch"]
+__all__ = ["Executor", "infer_graph_shapes", "record_dispatch",
+           "card_from_compiled", "DeviceMemoryError"]
 
 
 # ---------------------------------------------------------------------------
@@ -52,6 +56,384 @@ def record_dispatch(kind):
     if dispatch_hook is not None:
         dispatch_hook(kind)
     telemetry.dispatch_event(kind)
+
+
+# ---------------------------------------------------------------------------
+# Instrumented program compilation (program cards)
+# ---------------------------------------------------------------------------
+# Every jitted entry point in this module compiles through
+# ``_InstrumentedProgram`` — explicit ``lower().compile()`` with the
+# trace and compile phases timed as telemetry spans and the compiled
+# executable's own cost/memory analysis captured into a PROGRAM CARD in
+# ``telemetry.programs()``. The card is the online counterpart of an
+# offline xprof capture: per-program FLOPs, bytes accessed, HBM
+# footprint, compile wall-time and dispatch count, available at every
+# ``telemetry.snapshot()`` — exactly the per-program features TPU cost
+# models are built on (Kaufman et al. arXiv:2008.01040, TVM
+# arXiv:1802.04799).
+
+_PROG_SEQ = itertools.count(1)
+
+# once-per-cause recompile warnings: (entry, path, change-kind) pairs
+# already reported through log.py
+_RECOMPILE_WARNED = set()
+
+
+class DeviceMemoryError(MXNetError):
+    """A device allocation failure (RESOURCE_EXHAUSTED / OOM) re-raised
+    with the live buffer ledger and the failing program's memory card
+    stitched into the message. The original backend error rides as
+    ``__cause__``."""
+
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "RESOURCE EXHAUSTED",
+                "Out of memory", "out of memory", "OOM")
+
+
+def _is_oom(exc):
+    s = str(exc)
+    return any(m in s for m in _OOM_MARKERS)
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return "%.1f%s" % (n, unit) if unit != "B" else "%dB" % n
+        n /= 1024.0
+    return "%d" % n
+
+
+def _enriched_oom(exc, card):
+    """Build the DeviceMemoryError for one dispatch-time OOM: the
+    failing program's memory card + the ledger's per-context totals and
+    top live buffers + PJRT device stats where the platform exposes
+    them. The raw backend message stays first so existing matching on
+    it keeps working."""
+    lines = ["device memory exhausted dispatching program %r: %s"
+             % (card.get("id", "?"), exc)]
+    lines.append(
+        "program memory card: peak_bytes=%s argument_bytes=%s "
+        "output_bytes=%s temp_bytes=%s generated_code_bytes=%s "
+        "flops=%s bytes_accessed=%s" % (
+            _fmt_bytes(card.get("peak_bytes")),
+            _fmt_bytes(card.get("argument_bytes")),
+            _fmt_bytes(card.get("output_bytes")),
+            _fmt_bytes(card.get("temp_bytes")),
+            _fmt_bytes(card.get("generated_code_bytes")),
+            card.get("flops"), card.get("bytes_accessed")))
+    led = telemetry.ledger()
+    if led:
+        lines.append("live device-buffer ledger:")
+        for ctx, st in sorted(led.items()):
+            lines.append("  %s: %d buffers alive / %s (peak %s)"
+                         % (ctx, st["alive_count"],
+                            _fmt_bytes(st["alive_bytes"]),
+                            _fmt_bytes(st["peak_bytes"])))
+    top = telemetry.ledger_top(8)
+    if top:
+        lines.append("top live buffers:")
+        for b in top:
+            lines.append("  %s %s %s %s [%s]"
+                         % (_fmt_bytes(b["nbytes"]),
+                            tuple(b["shape"] or ()), b["dtype"], b["ctx"],
+                            b["kind"]))
+    try:
+        from .storage import Storage
+        stats = Storage.device_stats()
+        if stats:
+            lines.append("pjrt device stats: %s" % stats)
+    except Exception:
+        pass
+    return DeviceMemoryError("\n".join(lines))
+
+
+def _leaf_key(leaf):
+    """Hashable (shape, dtype) of one argument leaf — the per-dispatch
+    cache key component. Python scalars key by type (jax weak-types
+    them; the value never changes the signature)."""
+    try:
+        return (leaf.shape, leaf.dtype)
+    except AttributeError:
+        return ((), type(leaf))
+
+
+def _compiled_cost(compiled):
+    """``Compiled.cost_analysis()`` normalised to one flat dict (older
+    jaxlibs return a one-element list). Raising backends propagate to
+    the caller's graceful-degradation path."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca or {})
+
+
+def _compiled_memory(compiled):
+    """``Compiled.memory_analysis()`` as a plain dict of byte counts."""
+    ma = compiled.memory_analysis()
+    return {
+        "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+        "argument_bytes": int(ma.argument_size_in_bytes),
+        "output_bytes": int(ma.output_size_in_bytes),
+        "alias_bytes": int(ma.alias_size_in_bytes),
+        "temp_bytes": int(ma.temp_size_in_bytes),
+    }
+
+
+def card_from_compiled(kind, compiled, entry=None, signature=None,
+                       donated=(), extra=None):
+    """Build one JSON-safe program card from an AOT-compiled
+    executable. The ONE card builder — the executor's instrumented
+    wrapper and bench.py's AOT step both use it, so the card schema
+    cannot drift between the user path and the bench lane. Cost and
+    memory analysis failures degrade to ``None`` fields (older jaxlib /
+    backend quirks must never break dispatch)."""
+    card = {
+        "id": entry or "%s@p%d" % (kind, next(_PROG_SEQ)),
+        "kind": kind,
+        "signature": signature,
+        "donated": sorted(donated),
+        "dispatches": 0,
+        "flops": None, "bytes_accessed": None, "transcendentals": None,
+        "peak_bytes": None, "argument_bytes": None, "output_bytes": None,
+        "alias_bytes": None, "temp_bytes": None,
+        "generated_code_bytes": None,
+    }
+    if extra:
+        card.update(extra)
+    try:
+        ca = _compiled_cost(compiled)
+        for field, key in (("flops", "flops"),
+                           ("bytes_accessed", "bytes accessed"),
+                           ("transcendentals", "transcendentals")):
+            if key in ca:
+                card[field] = float(ca[key])
+    except Exception:
+        pass
+    try:
+        mem = _compiled_memory(compiled)
+        card.update(mem)
+        # peak HBM while the program runs: arguments + outputs + XLA's
+        # temp arena + the program text itself, minus donated aliases
+        card["peak_bytes"] = (mem["argument_bytes"] + mem["output_bytes"]
+                              + mem["temp_bytes"]
+                              + mem["generated_code_bytes"]
+                              - mem["alias_bytes"])
+    except Exception:
+        pass
+    return card
+
+
+def _path_str(path, argnames):
+    """Human arg path for one signature entry: the top-level tuple
+    index renders as the entry point's argument NAME, the rest as
+    jax's keystr — so a recompile cause reads ``inputs['data']``, not
+    ``[4]['data']``."""
+    from jax.tree_util import keystr
+    head = ""
+    rest = path
+    if path and argnames:
+        idx = getattr(path[0], "idx", None)
+        if idx is not None and idx < len(argnames):
+            head = argnames[idx]
+            rest = path[1:]
+    return head + keystr(tuple(rest))
+
+
+class _InstrumentedProgram:
+    """One jitted entry point, compiled through explicit
+    ``lower().compile()`` with full introspection:
+
+    * per-signature AOT executables cached on (treedef, leaf
+      shapes/dtypes) — the same key jax's own dispatch cache uses,
+      minus sharding (an input moving devices under an unchanged
+      shape raises from the strict AOT executable and degrades that
+      signature to the plain jit path instead of mis-executing);
+    * trace and compile phases timed as ``jit_trace``/``jit_compile``
+      telemetry spans AND recorded on the card;
+    * a PROGRAM CARD per compile in ``telemetry.programs()``;
+    * a structured once-per-cause RECOMPILE warning through log.py
+      when a cache miss follows a prior compile, naming exactly which
+      argument's shape/dtype (or the signature structure) changed;
+    * dispatch-time RESOURCE_EXHAUSTED/OOM errors re-raised as
+      ``DeviceMemoryError`` enriched with the buffer ledger and the
+      program's memory card.
+    """
+
+    __slots__ = ("kind", "entry", "argnames", "_jitted", "_donate",
+                 "_cache", "_card", "_meta")
+
+    def __init__(self, kind, fn, jit_kwargs=None, argnames=None,
+                 meta=None):
+        self.kind = kind
+        self.entry = "%s@p%d" % (kind, next(_PROG_SEQ))
+        self.argnames = argnames or ()
+        kw = dict(jit_kwargs or {})
+        self._donate = tuple(kw.get("donate_argnums", ()) or ())
+        self._jitted = jax.jit(fn, **kw)   # the ONE instrumented jit site
+        self._cache = {}    # dispatch sig -> [callable, card, aot_bool]
+        self._card = None   # last-compiled card: the recompile-diff base
+        self._meta = dict(meta or {})
+
+    # -- compile -----------------------------------------------------------
+    def _signature_cards(self, args):
+        """Full named signature for the card: [[path, shape, dtype,
+        sharding], ...] — computed only at compile time."""
+        from jax.tree_util import tree_flatten_with_path
+        flat, _ = tree_flatten_with_path(args)
+        sig = []
+        for path, leaf in flat:
+            try:
+                shape = list(leaf.shape)
+                dtype = str(leaf.dtype)
+            except AttributeError:
+                shape, dtype = [], type(leaf).__name__
+            sh = getattr(leaf, "sharding", None)
+            sig.append([_path_str(path, self.argnames), shape, dtype,
+                        None if sh is None else str(sh)])
+        return sig
+
+    def _diff_signature(self, old, new):
+        """(path, change-kind, detail) tuples describing why the new
+        signature missed the cache against the prior card's."""
+        old_map = {e[0]: e for e in (old or [])}
+        new_map = {e[0]: e for e in (new or [])}
+        causes = []
+        for path, e in new_map.items():
+            o = old_map.get(path)
+            if o is None:
+                causes.append((path, "added", "new argument %s %s"
+                               % (tuple(e[1]), e[2])))
+                continue
+            if e[1] != o[1]:
+                causes.append((path, "shape", "shape %s -> %s"
+                               % (tuple(o[1]), tuple(e[1]))))
+            if e[2] != o[2]:
+                causes.append((path, "dtype", "dtype %s -> %s"
+                               % (o[2], e[2])))
+            if e[3] != o[3]:
+                causes.append((path, "sharding", "sharding %s -> %s"
+                               % (o[3], e[3])))
+        for path in old_map:
+            if path not in new_map:
+                causes.append((path, "removed", "argument gone"))
+        return causes
+
+    def _warn_recompile(self, card):
+        """The recompile-cause diagnosis: diff against the prior card
+        and report each changed field ONCE per (entry, field, kind)
+        through log.py — the recompile-storm detector's counters can
+        finally say WHY."""
+        telemetry.counter_inc("recompile.%s" % self.kind)
+        causes = self._diff_signature(self._card.get("signature"),
+                                      card.get("signature"))
+        if not causes:
+            causes = [("<unknown>", "unknown",
+                       "signature changed outside the argument list")]
+        card["recompile_causes"] = ["%s: %s" % (p, d)
+                                    for p, _, d in causes]
+        fresh = [(p, k, d) for p, k, d in causes
+                 if (self.entry, p, k) not in _RECOMPILE_WARNED]
+        if not fresh:
+            return
+        for p, k, _ in fresh:
+            _RECOMPILE_WARNED.add((self.entry, p, k))
+        from . import log as _log
+        _log.get_logger("mxnet_tpu.executor").warning(
+            "recompile entry=%s kind=%s cause=%s — the cached program "
+            "cannot serve the new signature; if this repeats every "
+            "batch, pad or bucket the offending input "
+            "(see telemetry.programs()[%r])",
+            self.entry, self.kind,
+            "; ".join("%s: %s" % (p, d) for p, _, d in fresh),
+            card["id"])
+
+    def _build(self, sig, args):
+        """Cache miss: explicit lower().compile(), card capture,
+        recompile diagnosis. AOT failures (backend quirks) degrade to
+        the plain jitted callable with a card whose analysis fields
+        stay None — dispatch must never break on introspection."""
+        card_sig = self._signature_cards(args)
+        entry_id = "%s/s%d" % (self.entry, len(self._cache))
+        aot = True
+        compiled = None
+        t0 = time.perf_counter()
+        try:
+            with telemetry.span("jit_trace"):
+                lowered = self._jitted.lower(*args)
+            t1 = time.perf_counter()
+            with telemetry.span("jit_compile"):
+                compiled = lowered.compile()
+            t2 = time.perf_counter()
+        except Exception as e:
+            aot = False
+            t1 = t2 = time.perf_counter()
+            aot_err = "%s: %s" % (type(e).__name__, e)
+        if aot:
+            card = card_from_compiled(
+                self.kind, compiled, entry=entry_id, signature=card_sig,
+                donated=self._donate, extra=self._meta)
+        else:
+            card = card_from_compiled(
+                self.kind, _NoAnalysis(), entry=entry_id,
+                signature=card_sig, donated=self._donate,
+                extra=dict(self._meta, aot_fallback=aot_err))
+        card["trace_ms"] = round((t1 - t0) * 1e3, 3)
+        card["compile_ms"] = round((t2 - t1) * 1e3, 3)
+        if self._card is not None:
+            self._warn_recompile(card)
+        self._card = card
+        telemetry.record_program(card)
+        rec = [compiled if aot else self._jitted, card, aot]
+        self._cache[sig] = rec
+        return rec
+
+    def lower(self, *args):
+        """AOT passthrough (jax.stages signature): callers that lower
+        for HLO inspection (tests, tuners) see the same program the
+        wrapper would compile."""
+        return self._jitted.lower(*args)
+
+    # -- dispatch ----------------------------------------------------------
+    def _invoke(self, fn, args):
+        """The one launch site (tests monkeypatch this to fake device
+        errors)."""
+        return fn(*args)
+
+    def __call__(self, *args):
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        sig = (treedef, tuple(_leaf_key(l) for l in leaves))
+        rec = self._cache.get(sig)
+        if rec is None:
+            rec = self._build(sig, args)
+        telemetry.program_dispatch(rec[1])
+        try:
+            return self._invoke(rec[0], args)
+        except Exception as e:
+            if _is_oom(e):
+                raise _enriched_oom(e, rec[1]) from e
+            if rec[2] and isinstance(e, (TypeError, ValueError)):
+                # strict AOT input check (an input moved devices under
+                # an unchanged shape/dtype): degrade this signature to
+                # the plain jit path, which re-commits inputs itself.
+                # The card is registered and shared — mutate it under
+                # the registry lock
+                rec[0], rec[2] = self._jitted, False
+                telemetry.card_update(rec[1],
+                                      aot_fallback="input mismatch: %s" % e)
+                return self._invoke(rec[0], args)
+            raise
+
+
+class _NoAnalysis:
+    """Stand-in 'compiled' whose analyses always fail — the degraded
+    card keeps every cost/memory field at None."""
+
+    def cost_analysis(self):
+        raise NotImplementedError
+
+    memory_analysis = cost_analysis
 
 
 # differentiable cross-device copy with static endpoints: the plain
@@ -302,7 +684,10 @@ class _GraphProgram:
                 return self.eval_graph(args, aux, rng, train)
             # grouped programs pin ops to concrete devices — eager
             # execution (per-op dispatch), not one jitted program
-            self._jit_cache[key] = fn if self.node_devices else jax.jit(fn)
+            self._jit_cache[key] = fn if self.node_devices else \
+                _InstrumentedProgram("forward", fn,
+                                     argnames=("args", "aux", "rng"),
+                                     meta={"train": bool(train)})
         return self._jit_cache[key]
 
     def _vjp_over_graph(self, grad_args, rest, aux, rng, train):
@@ -351,7 +736,11 @@ class _GraphProgram:
                         for g, (n, _) in zip(hg, self.output_entries))
                 grads = vjp(hg)[0]
                 return outs, grads, aux_up
-            self._jit_cache[key] = fn if self.node_devices else jax.jit(fn)
+            self._jit_cache[key] = fn if self.node_devices else \
+                _InstrumentedProgram(
+                    "fwd_bwd", fn,
+                    argnames=("args", "aux", "rng", "head_grads"),
+                    meta={"train": bool(train)})
         return self._jit_cache[key]
 
     def train_step_fn(self, update_names, add_names, input_dtypes, cache_key,
@@ -439,8 +828,13 @@ class _GraphProgram:
                 else metric_acc
             return new_params, new_states, new_acc, new_aux, outs, grads_out
 
+        step_argnames = ("params", "opt_states", "metric_acc", "aux",
+                         "inputs", "rng", "lrs", "wds", "ts", "add_grads")
         if spmd is None:
-            fn = jax.jit(step, donate_argnums=(0, 1, 2, 3))
+            fn = _InstrumentedProgram(
+                "train_step", step,
+                jit_kwargs={"donate_argnums": (0, 1, 2, 3)},
+                argnames=step_argnames)
         else:
             repl, dsh = spmd.repl_sharding, spmd.data_sharding
             # args: (params, opt_states, metric_acc, aux, inputs, rng,
@@ -451,10 +845,13 @@ class _GraphProgram:
             # shardings are propagated (params/state/acc come out
             # replicated, per-example outputs batch-sharded), which keeps
             # donation buffer-compatible.
-            fn = jax.jit(step,
-                         in_shardings=(repl, repl, repl, repl, dsh,
-                                       repl, repl, repl, repl, repl),
-                         donate_argnums=(0, 1, 2, 3))
+            fn = _InstrumentedProgram(
+                "train_step", step,
+                jit_kwargs={"in_shardings": (repl, repl, repl, repl, dsh,
+                                             repl, repl, repl, repl, repl),
+                            "donate_argnums": (0, 1, 2, 3)},
+                argnames=step_argnames,
+                meta={"spmd_devices": spmd.num_devices})
         self._jit_cache[key] = fn
         return fn
 

@@ -130,8 +130,11 @@ class BaseModule:
     def telemetry_snapshot(self):
         """The process-wide ``telemetry.snapshot()`` (dispatch counts,
         jit compiles vs. cache hits, fused-fallback codes, transfer
-        bytes, blocking host syncs, span p50/p95/p99) plus this module's
-        last fused-fallback reason/code."""
+        bytes, blocking host syncs, span p50/p95/p99, the PROGRAM CARDS
+        of every compiled XLA program with their cost/memory figures,
+        the online MFU estimate and the device-buffer ledger) plus this
+        module's last fused-fallback reason/code. JSON-serializable end
+        to end — bench/probe artifacts embed it per leg."""
         snap = telemetry.snapshot()
         reason = getattr(self, "_fused_fallback_reason", None)
         snap["fused_fallback_reason"] = None if reason is None else str(reason)

@@ -51,6 +51,21 @@ class NDArray:
         self._grad = None
         self._tape = None
         self._stype = "default"
+        # live device-buffer ledger: charge this wrapper's bytes to its
+        # context until the NDArray is collected (telemetry holds only
+        # a weakref.finalize — no reference cycle). Views (_SliceView)
+        # skip __init__ and alias the parent, so they are not charged;
+        # wrappers sharing one buffer (detach) each count — the ledger
+        # is the FRAMEWORK's upper-bound view, reconciled against PJRT
+        # by Storage.ledger_report().
+        if telemetry.enabled():
+            try:
+                nbytes = int(data.size) * data.dtype.itemsize
+                shape, dtype = data.shape, data.dtype
+            except AttributeError:
+                nbytes, shape, dtype = 0, None, None
+            telemetry.ledger_track(self, str(self._ctx), nbytes,
+                                   shape=shape, dtype=dtype)
 
     # -- internal ----------------------------------------------------------
     def _set_data(self, raw):
@@ -502,6 +517,11 @@ class NDArray:
         self._grad = None
         self._tape = None
         self._stype = "default"
+        if telemetry.enabled():   # unpickled arrays enter the ledger too
+            d = self._data
+            telemetry.ledger_track(self, str(ctx),
+                                   int(d.size) * d.dtype.itemsize,
+                                   shape=d.shape, dtype=d.dtype)
 
 
 # ---------------------------------------------------------------------------

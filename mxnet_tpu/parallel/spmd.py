@@ -67,11 +67,25 @@ def shard_put(raw, sharding):
     only its shard (no host-side splitting, no full-batch replication —
     the TPU-native replacement for the reference's decide_slices copy
     loop, executor_group.py:266). Host-resident inputs count toward the
-    telemetry h2d-bytes register; device-side reshards do not."""
+    telemetry h2d-bytes register; device-side reshards do not. Every
+    sharded batch also enters the live device-buffer LEDGER under its
+    mesh's context key (global bytes; released when the buffer dies),
+    so an OOM mid-feed names the in-flight batches alongside the
+    executor's resident arrays."""
     with telemetry.span("shard_put"):
         if isinstance(raw, np.ndarray):
             telemetry.record_transfer(raw.nbytes)
-        return jax.device_put(raw, sharding)
+        out = jax.device_put(raw, sharding)
+        if telemetry.enabled():
+            try:
+                n_dev = len(sharding.device_set)
+            except AttributeError:
+                n_dev = 0
+            telemetry.ledger_track(
+                out, "mesh(%ddev)" % n_dev,
+                int(out.size) * out.dtype.itemsize,
+                shape=out.shape, dtype=out.dtype, kind="shard_put")
+        return out
 
 
 def commit_dp_placements(executor, input_names, spec):

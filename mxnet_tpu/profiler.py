@@ -142,6 +142,16 @@ def _link_chrome_trace():
         trace = {"traceEvents": events, "displayTimeUnit": "ms"}
     trace["traceEvents"].extend(
         _aligned_host_events(trace["traceEvents"], host))
+    # program cards ride in the trace file's otherData (a chrome-trace
+    # field perfetto preserves): the cost/memory/compile figures of
+    # every program whose spans appear on the host track, so one file
+    # carries timeline AND cost model
+    from . import telemetry
+    cards = telemetry.programs()
+    if cards:
+        other = trace.setdefault("otherData", {})
+        if isinstance(other, dict):
+            other["mxnet_tpu_programs"] = cards
     with open(_state["filename"], "w") as dst:
         json.dump(trace, dst)
 

@@ -165,3 +165,40 @@ class Storage:
             return dict(d.memory_stats() or {})
         except (AttributeError, RuntimeError):
             return {}
+
+    @staticmethod
+    def ledger_report():
+        """The live device-buffer ledger (telemetry's per-context
+        alive/peak counters — the framework's view of device memory)
+        RECONCILED against PJRT's own per-device stats where the
+        platform exposes them (``bytes_in_use``): ``delta_bytes`` is
+        allocator-held minus ledger-tracked, i.e. memory the framework
+        does not account for (XLA temp arenas, donated-buffer slack,
+        untracked raw jax arrays) — the first thing to read when an
+        allocation fails unexpectedly."""
+        from . import telemetry
+        import jax
+        led = telemetry.ledger()
+        report = {"contexts": led, "devices": {},
+                  "top_buffers": telemetry.ledger_top(8)}
+        ledger_alive = sum(st["alive_bytes"] for st in led.values())
+        pjrt_in_use = 0
+        have_stats = False
+        try:
+            devices = jax.local_devices()
+        except RuntimeError:
+            devices = []
+        for d in devices:
+            stats = Storage.device_stats(d)
+            if stats:
+                report["devices"][str(d)] = stats
+                if "bytes_in_use" in stats:
+                    have_stats = True
+                    pjrt_in_use += int(stats["bytes_in_use"])
+        if have_stats:
+            report["reconciliation"] = {
+                "pjrt_bytes_in_use": pjrt_in_use,
+                "ledger_alive_bytes": ledger_alive,
+                "delta_bytes": pjrt_in_use - ledger_alive,
+            }
+        return report

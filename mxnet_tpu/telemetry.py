@@ -22,7 +22,22 @@ is the standing instrument every perf PR reads from:
 * **chrome-trace export** — ``chrome_events()`` renders the span ring
   as chrome://tracing ``X`` events; ``profiler.py`` merges them into
   the XLA device dump so host and device timelines land in ONE
-  perfetto-loadable JSON.
+  perfetto-loadable JSON;
+* a **program-card registry** — every XLA program the executor
+  compiles deposits a card (``record_program``) carrying its abstract
+  input signature, trace/compile wall-time, ``cost_analysis`` FLOPs/
+  bytes and ``memory_analysis`` footprint; ``program_dispatch`` bumps
+  the card's dispatch count per launch, and ``snapshot()`` derives an
+  ONLINE sustained-FLOP/s (and MFU, once ``set_peak_flops`` is told
+  the chip's ceiling) from card FLOPs x dispatches / step-span time —
+  the live counterpart of PERF.md's offline roofline table. Cards are
+  plain JSON-safe dicts built by executor.py (this module stays
+  stdlib-only and never imports jax);
+* a **live device-buffer ledger** — ``ledger_track(obj, ...)`` charges
+  a buffer to its context until ``obj`` is garbage-collected
+  (weakref.finalize), maintaining per-context alive-bytes/alive-count/
+  peak-bytes; ``ledger_top()`` lists the largest live buffers, which
+  is what the executor stitches into enriched OOM errors.
 
 Everything here is stdlib-only (no jax import) and cheap when disabled:
 ``MXNET_TELEMETRY=0`` (or ``disable()``) reduces every span to two
@@ -33,18 +48,22 @@ one registry, which is exactly what makes the merged trace readable.
 from __future__ import annotations
 
 import collections
+import itertools
 import os
 import threading
 import time
+import weakref
 
 __all__ = [
     "enabled", "enable", "disable", "reset",
     "counter_inc", "counters", "snapshot", "span", "span_stats",
-    "span_count", "span_durations",
+    "span_count", "span_durations", "span_seconds",
     "on_dispatch", "remove_dispatch", "dispatch_event",
     "record_jit", "record_fallback", "record_transfer",
     "record_host_sync", "chrome_events", "mark_trace_start",
-    "SPAN_RING_SIZE", "FIT_PHASE_SPANS",
+    "record_program", "program_dispatch", "programs", "card_update",
+    "set_peak_flops", "ledger_track", "ledger", "ledger_top",
+    "SPAN_RING_SIZE", "FIT_PHASE_SPANS", "MAX_PROGRAM_CARDS",
 ]
 
 # ring capacities: bound memory for arbitrarily long training runs. The
@@ -62,6 +81,11 @@ FIT_PHASE_SPANS = ("fit_batch", "feed", "step", "shard_put",
                    "io_next", "callbacks", "epoch_sync",
                    "kv_push", "kv_pull")
 
+# program-card registry bound: recompile storms must not grow the
+# registry without limit — the oldest card is dropped (its FLOPs x
+# dispatches folded into the online total so MFU stays right)
+MAX_PROGRAM_CARDS = 256
+
 
 class _State:
     __slots__ = ("enabled",)
@@ -78,10 +102,36 @@ _counters = {}
 _spans = collections.deque(maxlen=SPAN_RING_SIZE)
 _durations = {}          # name -> deque of duration seconds
 _span_total = {}         # name -> cumulative span count (uncapped)
+_span_seconds = {}       # name -> cumulative span seconds (uncapped) —
+                         # the online-MFU denominator must cover EVERY
+                         # step, not just the histogram ring's tail
 _dispatch_subs = []      # multi-subscriber dispatch registry
 _gen = 0                 # bumped by reset(): spans straddling a reset
                          # belong to the OLD window and must not leak
                          # into the freshly cleared registry
+
+# program cards: card["id"] -> card dict (insertion-ordered). The card
+# OBJECT is shared with the executor wrapper that built it — dispatch
+# bumps mutate it in place, and a reset() simply drops the registry
+# reference; the wrapper re-installs (with a fresh dispatch count) on
+# the next launch, so a windowed reset reads clean.
+_programs = {}
+_programs_dropped_flops = 0.0   # FLOPs x dispatches of evicted cards
+_peak_flops = None              # chip ceiling for the online MFU
+
+# live device-buffer ledger: per-context alive/peak counters plus the
+# individual live-buffer map that backs ledger_top() / OOM enrichment
+_ledger = {}        # ctx key -> {alive_bytes, alive_count, peak_bytes,
+                    #             tracked_total, tracked_bytes_total}
+_ledger_live = {}   # token -> (ctx_key, nbytes, shape, dtype, kind)
+_ledger_seq = itertools.count(1)
+# released tokens land here LOCK-FREE and are drained under _lock by
+# the next ledger operation. The finalize callback must NOT take
+# _lock: cyclic-GC (autograd tapes make NDArray cycles) can run the
+# finalizer synchronously on a thread that already HOLDS _lock (any
+# allocation inside a locked section can trip the GC threshold), and
+# the non-reentrant lock would deadlock the process mid-training.
+_ledger_pending = collections.deque()
 
 # perf_counter<->epoch anchor, taken once at import: spans are stamped
 # in the monotonic perf_counter timebase (immune to clock steps); the
@@ -115,16 +165,28 @@ def disable():
 
 
 def reset():
-    """Clear every counter, span and histogram (subscribers stay).
-    Spans currently OPEN on any thread are dropped at their exit — a
-    pre-reset interval must not appear in the new accounting window."""
-    global _gen
+    """Clear every counter, span, histogram and program card
+    (subscribers stay). Spans currently OPEN on any thread are dropped
+    at their exit — a pre-reset interval must not appear in the new
+    accounting window. The buffer LEDGER's live map survives (the
+    buffers are still alive and their finalizers will still fire);
+    its cumulative totals zero and peak rebases to the current alive
+    level, so a windowed reader sees this window's high-water mark."""
+    global _gen, _programs_dropped_flops
     with _lock:
         _gen += 1
         _counters.clear()
         _spans.clear()
         _durations.clear()
         _span_total.clear()
+        _span_seconds.clear()
+        _programs.clear()
+        _programs_dropped_flops = 0.0
+        _ledger_drain_locked()
+        for st in _ledger.values():
+            st["peak_bytes"] = st["alive_bytes"]
+            st["tracked_total"] = 0
+            st["tracked_bytes_total"] = 0
 
 
 # ---------------------------------------------------------------------------
@@ -290,6 +352,15 @@ def _record_span(name, t0_ns, t1_ns):
     d.append((t1_ns - t0_ns) / 1e9)
     with _lock:
         _span_total[name] = _span_total.get(name, 0) + 1
+        _span_seconds[name] = _span_seconds.get(name, 0.0) \
+            + (t1_ns - t0_ns) / 1e9
+
+
+def span_seconds(name):
+    """CUMULATIVE wall-seconds recorded under ``name`` since the last
+    reset() — unlike the histogram total, not capped by the duration
+    ring. The online-MFU denominator."""
+    return _span_seconds.get(name, 0.0)
 
 
 def span_count(name):
@@ -342,15 +413,202 @@ def span_stats(name=None):
     return out
 
 
+# ---------------------------------------------------------------------------
+# Program-card registry
+# ---------------------------------------------------------------------------
+
+def set_peak_flops(flops):
+    """Tell the registry the chip's peak FLOP/s so ``snapshot()`` can
+    turn the online sustained-FLOP/s into an MFU fraction. ``None``
+    clears it (MFU reads ``None`` again)."""
+    global _peak_flops
+    _peak_flops = None if flops is None else float(flops)
+
+
+def record_program(card):
+    """Install one program card (a JSON-safe dict built by
+    ``executor.card_from_compiled`` — this module never inspects jax
+    objects). ``card["id"]`` keys the registry; a re-record under the
+    same id replaces the entry. The registry is bounded at
+    ``MAX_PROGRAM_CARDS``: the oldest card is evicted with its
+    FLOPs x dispatches folded into the online total."""
+    global _programs_dropped_flops
+    if not _state.enabled or not isinstance(card, dict) \
+            or "id" not in card:
+        return
+    card.setdefault("dispatches", 0)
+    with _lock:
+        card["_gen"] = _gen
+        _programs[card["id"]] = card
+        while len(_programs) > MAX_PROGRAM_CARDS:
+            old = _programs.pop(next(iter(_programs)))   # oldest insert
+            _programs_dropped_flops += \
+                (old.get("flops") or 0.0) * old.get("dispatches", 0)
+
+
+def program_dispatch(card):
+    """One launch of a carded program: bump its dispatch count (under
+    the lock — cards are shared with ``programs()`` readers). If a
+    reset() opened a new accounting window since the card was
+    installed, the count restarts and the card re-registers — so a
+    windowed snapshot reads only this window's dispatches."""
+    if not _state.enabled or card is None:
+        return
+    with _lock:
+        if card.get("_gen") != _gen:
+            card["dispatches"] = 0
+            card["_gen"] = _gen
+            _programs[card["id"]] = card
+        card["dispatches"] = card.get("dispatches", 0) + 1
+
+
+def card_update(card, **fields):
+    """Mutate a (possibly registered) card under the registry lock —
+    the only safe way to add fields after ``record_program``, since
+    ``programs()`` iterates the shared dict objects."""
+    if card is None:
+        return
+    with _lock:
+        card.update(fields)
+
+
+def programs():
+    """{card_id: card} copy of the program-card registry (private
+    bookkeeping keys stripped — the result is JSON-serializable). The
+    per-card copies happen INSIDE the lock: cards are live objects
+    that dispatchers mutate under the same lock."""
+    with _lock:
+        return {k: {kk: vv for kk, vv in c.items()
+                    if not kk.startswith("_")}
+                for k, c in _programs.items()}
+
+
+def _online_stats():
+    """The live roofline estimate: FLOPs dispatched (card FLOPs x
+    dispatch count, plus evicted cards' share) over cumulative
+    step-span wall-time. ``mfu`` needs ``set_peak_flops`` — the chip
+    ceiling is not knowable from stdlib."""
+    with _lock:
+        flops = _programs_dropped_flops + sum(
+            (c.get("flops") or 0.0) * c.get("dispatches", 0)
+            for c in _programs.values())
+        step_s = _span_seconds.get("step", 0.0)
+        compile_s = _span_seconds.get("jit_compile", 0.0)
+    out = {
+        "flops_dispatched": flops,
+        "step_time_s": round(step_s, 6),
+        # first-launch compiles happen INSIDE the step span; reported so
+        # readers can judge how much of the window was warmup
+        "compile_time_s": round(compile_s, 6),
+        "model_flops_per_s": round(flops / step_s, 3) if step_s else None,
+        "peak_flops": _peak_flops,
+        # unrounded: a CPU-smoke MFU is ~1e-6 and must not read as 0.0
+        "mfu": flops / step_s / _peak_flops
+        if step_s and _peak_flops else None,
+    }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Live device-buffer ledger
+# ---------------------------------------------------------------------------
+
+def _ledger_release(token):
+    """weakref.finalize callback: LOCK-FREE (deque.append is GIL-
+    atomic) — see the _ledger_pending note for why taking _lock here
+    would deadlock under cyclic GC."""
+    try:
+        _ledger_pending.append(token)
+    except Exception:       # interpreter-shutdown finalizers must not raise
+        pass
+
+
+def _ledger_drain_locked():
+    """Apply pending releases to the counters. Caller holds _lock."""
+    while True:
+        try:
+            token = _ledger_pending.popleft()
+        except IndexError:
+            return
+        rec = _ledger_live.pop(token, None)
+        if rec is None:
+            continue
+        st = _ledger.get(rec[0])
+        if st is not None:
+            st["alive_bytes"] -= rec[1]
+            st["alive_count"] -= 1
+
+
+def ledger_track(obj, ctx_key, nbytes, shape=None, dtype=None,
+                 kind="ndarray"):
+    """Charge ``nbytes`` on context ``ctx_key`` until ``obj`` is
+    garbage-collected (weakref.finalize releases the charge). Tracks
+    the FRAMEWORK's view — aliasing wrappers (detach, shared _data)
+    each count, so alive-bytes is an upper bound of framework-held
+    device memory, reconciled against PJRT's own counters by
+    ``Storage.ledger_report()``. No-op while disabled (but releases
+    always run, so toggling never corrupts the counters)."""
+    if not _state.enabled:
+        return
+    nbytes = int(nbytes)
+    token = next(_ledger_seq)
+    try:
+        weakref.finalize(obj, _ledger_release, token)
+    except TypeError:       # obj not weakref-able: count cumulatively only
+        token = None
+    with _lock:
+        _ledger_drain_locked()
+        st = _ledger.get(ctx_key)
+        if st is None:
+            st = _ledger[ctx_key] = {
+                "alive_bytes": 0, "alive_count": 0, "peak_bytes": 0,
+                "tracked_total": 0, "tracked_bytes_total": 0}
+        st["tracked_total"] += 1
+        st["tracked_bytes_total"] += nbytes
+        if token is not None:
+            st["alive_bytes"] += nbytes
+            st["alive_count"] += 1
+            if st["alive_bytes"] > st["peak_bytes"]:
+                st["peak_bytes"] = st["alive_bytes"]
+            _ledger_live[token] = (ctx_key, nbytes, shape, dtype, kind)
+
+
+def ledger():
+    """{ctx: {alive_bytes, alive_count, peak_bytes, tracked_total,
+    tracked_bytes_total}} copy of the per-context ledger counters."""
+    with _lock:
+        _ledger_drain_locked()
+        return {k: dict(v) for k, v in _ledger.items()}
+
+
+def ledger_top(n=8):
+    """The ``n`` largest LIVE tracked buffers, biggest first:
+    [{ctx, nbytes, shape, dtype, kind}] — what the enriched OOM error
+    prints so an allocation failure names its suspects."""
+    with _lock:
+        _ledger_drain_locked()
+        live = list(_ledger_live.values())
+    live.sort(key=lambda r: -r[1])
+    return [{"ctx": r[0], "nbytes": r[1],
+             "shape": None if r[2] is None else list(r[2]),
+             "dtype": None if r[3] is None else str(r[3]),
+             "kind": r[4]} for r in live[:n]]
+
+
 def snapshot():
-    """One self-describing dict: counters + span percentiles. This is
-    what ``Module.telemetry_snapshot()`` returns, what ``bench.py``
-    embeds in the BENCH/MULTICHIP artifacts and what
-    ``callback.TelemetryLogger`` diffs per log line."""
+    """One self-describing dict: counters + span percentiles + program
+    cards + the online MFU estimate + the buffer ledger. This is what
+    ``Module.telemetry_snapshot()`` returns, what ``bench.py`` embeds
+    in the BENCH/MULTICHIP artifacts and what
+    ``callback.TelemetryLogger`` diffs per log line. Every value is
+    JSON-serializable end to end."""
     return {
         "enabled": _state.enabled,
         "counters": counters(),
         "spans": span_stats(),
+        "programs": programs(),
+        "online": _online_stats(),
+        "ledger": ledger(),
     }
 
 

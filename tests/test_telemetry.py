@@ -241,11 +241,13 @@ def test_telemetry_overhead_guard():
     of interleaving. The guard instead bounds the measured telemetry
     WORK against the measured batch time: count the actual per-batch
     registry operations the fit loop performs (the registry reports its
-    own op counts exactly), microbenchmark the per-op costs (min over
-    repeated tight loops — robust to throttle, which can only inflate
-    them), and assert ops x cost < 2% of the batch-time floor. A lock
-    storm or heavy span path in telemetry.py fails this immediately;
-    box noise cannot."""
+    own op counts exactly — spans, counters, AND the ISSUE-4 paths:
+    buffer-ledger tracks and program-card dispatch bumps),
+    microbenchmark the per-op costs (min over repeated tight loops —
+    robust to throttle, which can only inflate them), and assert
+    ops x cost < 2% of the batch-time floor. A lock storm or heavy
+    span/ledger/card path in telemetry.py fails this immediately; box
+    noise cannot."""
     batch, nbatch = 512, 12
     rs = np.random.RandomState(0)
     X = rs.uniform(-1, 1, (batch * nbatch, 64)).astype(np.float32)
@@ -275,6 +277,12 @@ def test_telemetry_overhead_guard():
     counter_ops = sum(v for k, v in counts.items()
                       if k.endswith("_count") or k.startswith(
                           ("dispatch.", "host_sync.", "jit."))) / nbatch
+    # ISSUE-4 instrumentation: buffer-ledger tracks (NDArray wraps,
+    # shard_put) and program-card dispatch bumps the epoch performed
+    ledger_ops = sum(st.get("tracked_total", 0)
+                     for st in telemetry.ledger().values()) / nbatch
+    card_ops = sum(c.get("dispatches", 0)
+                   for c in telemetry.programs().values()) / nbatch
 
     def op_cost(fn, iters=20000, reps=5):
         best = float("inf")
@@ -289,13 +297,27 @@ def test_telemetry_overhead_guard():
         with telemetry.span("_guard_probe"):
             pass
 
+    class _Obj:
+        pass
+
+    def one_track():
+        # full lifecycle: track + immediate finalize on refcount drop
+        telemetry.ledger_track(_Obj(), "cpu(0)", 128,
+                               shape=(32,), dtype="float32")
+
+    _card = {"id": "_guard_card"}
     span_s = op_cost(one_span)
     counter_s = op_cost(lambda: telemetry.counter_inc("_guard_probe"))
-    overhead_s = spans * span_s + counter_ops * counter_s
+    track_s = op_cost(one_track, iters=5000)
+    card_s = op_cost(lambda: telemetry.program_dispatch(_card))
+    overhead_s = spans * span_s + counter_ops * counter_s \
+        + ledger_ops * track_s + card_ops * card_s
     telemetry.reset()
     frac = overhead_s / batch_s
     assert frac < 0.02, \
         "telemetry work %.1fus/batch (%.1f spans x %.2fus + %.1f counter " \
-        "ops x %.2fus) is %.2f%% of the %.0fus batch floor — exceeds the " \
-        "2%% guard" % (overhead_s * 1e6, spans, span_s * 1e6, counter_ops,
-                       counter_s * 1e6, frac * 100, batch_s * 1e6)
+        "ops x %.2fus + %.1f ledger tracks x %.2fus + %.1f card bumps x " \
+        "%.2fus) is %.2f%% of the %.0fus batch floor — exceeds the 2%% " \
+        "guard" % (overhead_s * 1e6, spans, span_s * 1e6, counter_ops,
+                   counter_s * 1e6, ledger_ops, track_s * 1e6, card_ops,
+                   card_s * 1e6, frac * 100, batch_s * 1e6)

@@ -10,10 +10,16 @@ round-3's trace):
 Runs ``bench.py --child`` with MXTPU_BENCH_TRACE set, finds the
 resulting ``.xplane.pb``, aggregates per-op self time into the same
 categories PERF.md uses (convolution fusions / elementwise loop
-fusions / copy-and-data-formatting / other), and — when the chip's
-peak FLOP/s and the step's cost-model FLOPs are known — re-derives the
-memory-bound MFU ceiling from measured bytes if ``hlo_stats`` exposes
-them.
+fusions / copy-and-data-formatting / other), and re-derives the
+memory-bound MFU ceiling from the step's FLOPs and bytes.
+
+FLOPs/bytes come from the bench child's PROGRAM CARD first
+(``telemetry.programs()`` — the compile-time ``cost_analysis`` /
+``memory_analysis`` figures the child embeds in its JSON line as
+``step_flops``/``step_bytes_accessed``), so the roofline no longer
+NEEDS an xprof capture; the xplane ``hlo_stats`` aggregation remains
+as the fallback byte source (older children) and still feeds the
+per-category self-time table when a trace materialises.
 """
 import argparse
 import glob
@@ -163,43 +169,59 @@ def main():
                           "measurement", "bench": bench_line}))
         return 1
 
+    # the bench child's program card carries the step's compile-time
+    # FLOPs and bytes — the online source that makes the xprof capture
+    # optional for the roofline arithmetic
+    card_flops = bench_line.get("step_flops")
+    card_bytes = bench_line.get("step_bytes_accessed")
+
     xplane = find_xplane(trace_dir)
-    if not xplane:
-        print(json.dumps({"error": "no xplane.pb written",
+    if not xplane and not card_bytes:
+        print(json.dumps({"error": "no xplane.pb written and the bench "
+                          "child carried no program card",
                           "bench": bench_line}))
         return 1
 
-    rows = hlo_op_rows(xplane)
-    shares = {}
-    total_ps = 0
+    out = {"bench": bench_line, "xplane": xplane}
     bytes_total = 0.0
-    for row in rows:
-        total_ps += row["dur_ps"]
-        cat = categorise(row["name"], row.get("category", ""))
-        shares[cat] = shares.get(cat, 0) + row["dur_ps"]
-        bytes_total += row["bytes"]
-
-    top = sorted(rows, key=lambda r: -r["dur_ps"])[:8]
-    out = {
-        "bench": bench_line,
-        "xplane": xplane,
-        "hlo_rows": len(rows),
-        "op_time_total_ms": round(total_ps / 1e9, 2),
-        "self_time_share": {
-            k: round(v / total_ps, 4) for k, v in sorted(
-                shares.items(), key=lambda kv: -kv[1])} if total_ps else {},
-        "top_ops": [{"name": r["name"][:60],
-                     "ms": round(r["dur_ps"] / 1e9, 2)} for r in top],
-    }
+    if xplane:
+        rows = hlo_op_rows(xplane)
+        shares = {}
+        total_ps = 0
+        for row in rows:
+            total_ps += row["dur_ps"]
+            cat = categorise(row["name"], row.get("category", ""))
+            shares[cat] = shares.get(cat, 0) + row["dur_ps"]
+            bytes_total += row["bytes"]
+        top = sorted(rows, key=lambda r: -r["dur_ps"])[:8]
+        out.update({
+            "hlo_rows": len(rows),
+            "op_time_total_ms": round(total_ps / 1e9, 2),
+            "self_time_share": {
+                k: round(v / total_ps, 4) for k, v in sorted(
+                    shares.items(), key=lambda kv: -kv[1])}
+            if total_ps else {},
+            "top_ops": [{"name": r["name"][:60],
+                         "ms": round(r["dur_ps"] / 1e9, 2)} for r in top],
+        })
     # roofline ceiling re-derivation (PERF.md arithmetic, fresh inputs):
-    # FLOP/byte of the step vs the chip's break-even ratio
+    # FLOP/byte of the step vs the chip's break-even ratio. Byte source
+    # priority: program card (exact, compile-time) > xplane hlo_stats.
     from bench import peak_flops_for, ITERS  # noqa: E402
     peak = peak_flops_for(bench_line.get("device", ""))
     bw = hbm_bw_for(bench_line.get("device", ""))
-    if bytes_total and bench_line.get("tflops_per_s") and peak and bw:
+    if card_bytes:
+        bytes_per_step = float(card_bytes)
+        out["bytes_source"] = "program_card"
+    elif bytes_total:
         bytes_per_step = bytes_total / ITERS
+        out["bytes_source"] = "xplane_hlo_stats"
+    else:
+        bytes_per_step = None
+    if bytes_per_step and bench_line.get("tflops_per_s") and peak and bw:
         step_s = (bench_line["batch"] / bench_line["value"])
-        flops_per_step = bench_line["tflops_per_s"] * 1e12 * step_s
+        flops_per_step = (float(card_flops) if card_flops
+                          else bench_line["tflops_per_s"] * 1e12 * step_s)
         intensity = flops_per_step / bytes_per_step
         out["bytes_accessed_per_step"] = bytes_per_step
         out["flop_per_byte"] = round(intensity, 1)

@@ -244,6 +244,7 @@ def _smoke_lane(lane, contexts, kvstore, rounds, nbatch, batch,
     dts = {True: float("inf"), False: float("inf")}
     dispatch = {True: {}, False: {}}
     phases = {True: {}, False: {}}
+    cards = {True: {}, False: {}}
     # the lane's accounting READS the registry, so recording must be on
     # for its window regardless of the ambient MXNET_TELEMETRY pin
     # (restored after — the lane must not flip the session's state)
@@ -266,6 +267,15 @@ def _smoke_lane(lane, contexts, kvstore, rounds, nbatch, batch,
                                "p95_ms": s["p95_ms"]}
                         for name, s in telemetry.span_stats().items()
                         if name in telemetry.FIT_PHASE_SPANS}
+                    # program cards dispatched in the banked window:
+                    # what each leg's step COSTS (FLOPs / peak HBM)
+                    # rides next to what it measured
+                    cards[f] = {
+                        k: {kk: c.get(kk) for kk in
+                            ("kind", "flops", "bytes_accessed",
+                             "peak_bytes", "compile_ms", "dispatches")}
+                        for k, c in telemetry.programs().items()
+                        if c.get("dispatches")}
     finally:
         if not was_enabled:
             telemetry.disable()
@@ -277,6 +287,7 @@ def _smoke_lane(lane, contexts, kvstore, rounds, nbatch, batch,
                 sum(dispatch[f].values()) / nbatch, 2),
             "dispatch_counts": dispatch[f],
             "phase_spans": phases[f],
+            "program_cards": cards[f],
         }
 
     fused, split = report(True), report(False)

@@ -16,6 +16,19 @@ if grep -rn "dispatch_hook(" --include='*.py' mxnet_tpu tools bench.py \
   exit 1
 fi
 
+echo "== instrumented-jit lint"
+# every executor/module jitted program must compile through the
+# instrumented wrapper (_InstrumentedProgram: explicit lower().compile(),
+# program card, recompile-cause diagnosis, OOM enrichment) — a raw
+# jax.jit( in these layers would dodge every program-card guarantee
+if grep -n "jax\.jit(" mxnet_tpu/executor.py mxnet_tpu/module/*.py \
+        | grep -v "the ONE instrumented jit site"; then
+  echo "FAIL: raw jax.jit( call outside the executor's instrumented"
+  echo "      wrapper — route programs through _InstrumentedProgram"
+  echo "      so they get a program card (telemetry.programs())"
+  exit 1
+fi
+
 echo "== native build"
 make -s
 echo "== C++ unit tests"
