@@ -75,6 +75,9 @@ DP_TIMEOUT = 900       # the optional data-parallel fused-vs-kvstore A/B:
                        # so it gets the raw-child-scale budget; a kill
                        # mid-sweep truncates to the sizes already banked
                        # (stdout partials AND the artifact update per size)
+SERVE_TIMEOUT = 420    # the optional serving sweep (bucketed engine vs
+                       # sequential Predictor + open-loop offered-load
+                       # ladder); partial emission per load point
 TOTAL_DEADLINE = float(os.environ.get("MXTPU_BENCH_DEADLINE", "1500"))
 
 
@@ -86,7 +89,7 @@ def _apply_budget_args(argv):
     is clipped to the time remaining under it). Returns argv with the
     budget flags stripped; unknown phase names fail loudly."""
     global TOTAL_DEADLINE, PROBE_TIMEOUT, RAW_TIMEOUT, MODULE_TIMEOUT
-    global DP_TIMEOUT
+    global DP_TIMEOUT, SERVE_TIMEOUT
     vals, rest, i = [], [], 0
     while i < len(argv):
         a = argv[i]
@@ -103,14 +106,14 @@ def _apply_budget_args(argv):
         i += 1
     names = {"probe": "PROBE_TIMEOUT", "raw": "RAW_TIMEOUT",
              "module": "MODULE_TIMEOUT", "dp": "DP_TIMEOUT",
-             "total": "TOTAL_DEADLINE"}
+             "serve": "SERVE_TIMEOUT", "total": "TOTAL_DEADLINE"}
     for v in vals:
         for part in v.split(","):
             if "=" in part:
                 k, s = part.split("=", 1)
                 if k not in names:
                     raise SystemExit("--budget-s: unknown phase %r "
-                                     "(probe|raw|module|dp|total)" % k)
+                                     "(probe|raw|module|dp|serve|total)" % k)
             else:
                 k, s = "total", part
             try:
@@ -374,7 +377,7 @@ def _telemetry_summary():
         return {"error": str(e)}
     from mxnet_tpu import telemetry as _tel
     spans = {k: v for k, v in snap["spans"].items()
-             if k in _tel.FIT_PHASE_SPANS}
+             if k in _tel.FIT_PHASE_SPANS or k in _tel.SERVE_SPANS}
     # keep the flag: a disabled-telemetry leg's all-zero counters must
     # read as "instrumentation off", not as a measured zero
     return {"enabled": snap["enabled"], "counters": snap["counters"],
@@ -598,6 +601,157 @@ def dp_child():
     _write_dp_artifact(dict(out, ok=True, skipped=False))
 
 
+def serve_child():
+    """Inference-serving sweep: the bucketed micro-batching engine
+    (mxnet_tpu/serving.py) vs the one-request-at-a-time Predictor loop,
+    then an OPEN-LOOP offered-load ladder — requests arrive on a fixed
+    schedule regardless of completions (the serving regime where queue
+    depth and latency percentiles mean something), at fractions of the
+    measured burst capacity. Every phase's numbers print the moment
+    they exist ({"partial": true} lines), so a kill mid-ladder salvages
+    the points already measured; per-bucket program cards ride in the
+    artifact so a round records what each bucket COSTS next to what it
+    served. Smoke mode swaps ResNet-50 for a tiny MLP (harness-logic
+    check on CPU)."""
+    import numpy as np
+    import jax
+    dev = _init_device(jax)
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.predictor import Predictor
+    from mxnet_tpu.serving import InferenceEngine
+
+    rng = np.random.RandomState(0)
+    if SMOKE:
+        d = 16
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+        sym = mx.sym.SoftmaxOutput(net, name="softmax")
+        row = (d,)
+        n_req, max_batch = 256, 16
+    else:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "examples", "image-classification"))
+        from symbols.resnet import get_symbol
+        sym = get_symbol(num_classes=1000, num_layers=50,
+                         image_shape="3,%d,%d" % (IMG, IMG))
+        row = (3, IMG, IMG)
+        n_req, max_batch = 128, 32
+    arg_shapes, _, aux_shapes = sym.infer_shape_partial(
+        data=(1,) + row)
+    params = {}
+    for name, shape in zip(sym.list_arguments(), arg_shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        params["arg:" + name] = mx.nd.array(
+            rng.normal(0, 0.05, shape).astype(np.float32))
+    for name, shape in zip(sym.list_auxiliary_states(), aux_shapes):
+        # BatchNorm moving stats: mean 0 / var 1 keeps activations sane
+        fill = np.ones if name.endswith("moving_var") else np.zeros
+        params["aux:" + name] = mx.nd.array(fill(shape, np.float32))
+
+    out = {"lane": "serving", "device": dev.device_kind,
+           "n_requests": n_req, "max_batch": max_batch}
+    reqs = [rng.uniform(-1, 1, (1,) + row).astype(np.float32)
+            for _ in range(min(n_req, 64))]
+
+    def req_at(i):
+        return reqs[i % len(reqs)]
+
+    # leg 1: the one-request-at-a-time facade (the pre-engine baseline)
+    pred = Predictor(sym, params, {"data": (1,) + row})
+    pred.forward(data=req_at(0))
+    pred.get_output(0).asnumpy()          # compile outside the window
+    n_un = min(n_req, 48)
+    t0 = time.perf_counter()
+    for i in range(n_un):
+        pred.forward(data=req_at(i))
+        pred.get_output(0).asnumpy()
+    out["unbatched_req_s"] = round(n_un / (time.perf_counter() - t0), 2)
+    print(json.dumps(dict(out, partial=True)), flush=True)
+
+    # leg 2: burst capacity through the bucketed engine (all buckets
+    # AOT-compiled at construction — exactly one program per signature)
+    engine = InferenceEngine(sym, params, {"data": (1,) + row},
+                             max_batch=max_batch, max_wait_ms=2.0,
+                             max_inflight=4)
+    cards = engine.program_cards()
+    out["buckets"] = engine.buckets
+    out["program_cards"] = {
+        k: {kk: c.get(kk) for kk in
+            ("kind", "flops", "bytes_accessed", "peak_bytes",
+             "compile_ms", "dispatches")}
+        for k, c in cards.items()}
+    out["compiles_per_bucket"] = round(
+        len(cards) / len(engine.buckets), 2)
+    telemetry.reset()
+    t0 = time.perf_counter()
+    futs = [engine.submit(data=req_at(i)) for i in range(n_req)]
+    for f in futs:
+        f.result(timeout=600)
+    burst = n_req / (time.perf_counter() - t0)
+    out["burst_req_s"] = round(burst, 2)
+    out["serve_speedup"] = round(burst / out["unbatched_req_s"], 2) \
+        if out["unbatched_req_s"] else None
+    lat = telemetry.span_stats("serve_request").get("serve_request", {})
+    out["burst_latency_ms"] = {k: lat.get(k)
+                               for k in ("p50_ms", "p95_ms", "p99_ms")}
+    print(json.dumps(dict(out, partial=True)), flush=True)
+
+    # leg 3: open-loop ladder at fractions of burst capacity — arrivals
+    # on a fixed schedule; latency is measured from the SCHEDULED
+    # arrival (coordinated-omission-free)
+    out["offered_loads"] = {}
+    for frac in (0.5, 0.8, 0.95):
+        rate = burst * frac
+        telemetry.reset()
+        lats, t0 = [], time.perf_counter()
+        pend = []
+        for i in range(n_req):
+            sched = t0 + i / rate
+            now = time.perf_counter()
+            if sched > now:
+                time.sleep(sched - now)
+            fut = engine.submit(data=req_at(i))
+            # stamp at RESOLUTION (the done callback runs on the
+            # resolver thread at set_result) — collecting in submission
+            # order would charge an early-resolved request for every
+            # slower future ahead of it. list.append is GIL-atomic.
+            fut.add_done_callback(
+                lambda f, s=sched: lats.append(
+                    (time.perf_counter() - s) * 1e3))
+            pend.append(fut)
+        for fut in pend:
+            fut.result(timeout=600)
+        dt = time.perf_counter() - t0
+        lats.sort()
+        # per-load fill from THIS window's counters (engine.stats() is
+        # cumulative since construction)
+        c = telemetry.counters()
+        rows = c.get("serving.batch_rows", 0)
+        pad = c.get("serving.pad_rows", 0)
+        pct = telemetry._percentile      # the ONE percentile rule
+        out["offered_loads"]["%.2f" % frac] = {
+            "offered_req_s": round(rate, 2),
+            "achieved_req_s": round(n_req / dt, 2),
+            "latency_ms": {
+                "p50": round(pct(lats, 50), 3),
+                "p95": round(pct(lats, 95), 3),
+                "p99": round(pct(lats, 99), 3),
+            },
+            "batch_fill": round(rows / (rows + pad), 4)
+            if rows + pad else None,
+            "batches": c.get("serving.batches", 0),
+        }
+        print(json.dumps(dict(out, partial=True)), flush=True)
+    out["telemetry"] = _telemetry_summary()
+    engine.close()
+    print(json.dumps(out), flush=True)
+
+
 def _write_dp_artifact(obj):
     """MULTICHIP artifact schema superset: n_devices/ok/skipped plus the
     per-axis-size img/s table (ok=False+truncated=True until the sweep
@@ -758,6 +912,20 @@ def supervise():
             print("bench: dp phase yielded no number (raw result kept)",
                   file=sys.stderr, flush=True)
 
+    # serving sweep (bucketed micro-batching engine vs the sequential
+    # Predictor facade + the open-loop offered-load ladder) — optional,
+    # banked as partials like the module/dp phases
+    if (os.environ.get("MXTPU_BENCH_SERVE", "1") == "1"
+            and remaining() > 120):
+        sv_out, _ = _run_phase("--serve-child", phase_budget(SERVE_TIMEOUT))
+        if sv_out and sv_out.get("lane") == "serving":
+            out["serving"] = {k: v for k, v in sv_out.items()
+                              if k not in ("lane", "partial")}
+            print(json.dumps(dict(out, partial=True)), flush=True)
+        else:
+            print("bench: serve phase yielded no number (raw result kept)",
+                  file=sys.stderr, flush=True)
+
     # opportunistic A/B of the fused BN-tail kernel (PERF.md: the
     # end-to-end number, not the isolated pass, decides the knob)
     if (os.environ.get("MXTPU_BENCH_AB", "1") == "1"
@@ -787,5 +955,7 @@ if __name__ == "__main__":
         module_child()
     elif "--dp-child" in _argv:
         dp_child()
+    elif "--serve-child" in _argv:
+        serve_child()
     else:
         sys.exit(supervise())

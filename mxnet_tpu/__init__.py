@@ -72,6 +72,7 @@ from . import kvstore as kv          # noqa: E402  (reference: mx.kv)
 from .kvstore import KVStore         # noqa: E402
 from . import gradient_compression  # noqa: E402
 from . import predictor              # noqa: E402
+from . import serving                # noqa: E402
 from . import callback               # noqa: E402
 from . import model                  # noqa: E402
 from . import module                 # noqa: E402

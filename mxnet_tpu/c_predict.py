@@ -15,9 +15,9 @@ import numpy as np
 
 from .predictor import Predictor
 
-__all__ = ["create", "set_input", "forward", "output_ndim", "output_shape",
-           "output_size", "copy_output", "num_outputs", "ndlist_create",
-           "ndlist_len", "ndlist_entry"]
+__all__ = ["create", "set_input", "forward", "reshape", "output_ndim",
+           "output_shape", "output_size", "copy_output", "num_outputs",
+           "ndlist_create", "ndlist_len", "ndlist_entry"]
 
 
 def create(symbol_json, param_bytes, dev_type, dev_id, names, shapes,
@@ -57,6 +57,14 @@ def set_input(pred, name, addr, size):
 
 def forward(pred):
     pred.forward()
+
+
+def reshape(pred, names, shapes):
+    """(parity: MXPredReshape) — new predictor handle for new input
+    shapes. Shares the donor's compiled-program cache, so flipping
+    between shapes costs at most one XLA compile per signature."""
+    return pred.reshape({n: tuple(int(d) for d in s)
+                         for n, s in zip(names, shapes)})
 
 
 def num_outputs(pred):

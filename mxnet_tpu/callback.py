@@ -94,6 +94,15 @@ class TelemetryLogger:
 
         mod.fit(train, batch_end_callback=mx.callback.TelemetryLogger(
             50, programs=True))
+
+    The same object also understands the SERVING registry: hand it to a
+    ``serving.InferenceEngine`` and every ``frequent`` coalesced batches
+    it logs queue depth, batch-fill ratio, pad bytes and the request
+    p50/p95/p99 latency window (``log_serving``)::
+
+        engine = mx.serving.InferenceEngine(
+            sym, params, {"data": (1, 3, 224, 224)},
+            telemetry_logger=mx.callback.TelemetryLogger(100))
     """
 
     def __init__(self, frequent=50, logger=None, programs=False):
@@ -106,6 +115,8 @@ class TelemetryLogger:
         self._last_step_total = 0
         self._programs = bool(programs)
         self._seen_programs = set()
+        self._last_serving = None
+        self._last_serve_total = 0
 
     def _rebase(self, count):
         self._last_counters = self._telemetry.counters()
@@ -144,6 +155,62 @@ class TelemetryLogger:
                 "%.4g" % flops if flops else None,
                 "%.2fMiB" % (peak / 2.0 ** 20) if peak else None,
                 len(card.get("donated") or ()))
+
+    def log_serving(self, force=False):
+        """One serving-window log line (queue depth, batch fill, request
+        p50/p95/p99): a running ``serving.InferenceEngine`` built with
+        ``telemetry_logger=`` calls this after every coalesced batch;
+        every ``frequent`` batches one line lands. ``force=True`` (the
+        engine's close()) flushes a final partial window. Reads the same
+        process-global telemetry registry as the training path — the
+        ``serving.*`` counters and ``serve_request`` spans."""
+        t = self._telemetry
+        cur = t.counters()
+        batches = cur.get("serving.batches", 0)
+        if self._last_serving is None:
+            # first look: establish the window baseline
+            self._last_serving = cur
+            self._last_serve_total = t.span_count("serve_request")
+            if not force:
+                return
+        last = self._last_serving
+        nb = batches - last.get("serving.batches", 0)
+        if nb < 0:          # someone reset() the registry mid-window
+            self._last_serving = cur
+            self._last_serve_total = t.span_count("serve_request")
+            return
+        if not force and nb < self.frequent:
+            return
+        if nb == 0 and not force:
+            return
+        self._last_serving = cur
+        delta = {k: v - last.get(k, 0) for k, v in cur.items()
+                 if k.startswith("serving.")}
+        if self._programs:
+            self._log_new_programs()
+        rows = delta.get("serving.batch_rows", 0)
+        pad = delta.get("serving.pad_rows", 0)
+        depth = cur.get("serving.requests", 0) - cur.get(
+            "serving.resolved", 0)
+        # request-latency percentiles over THIS window's samples only
+        durs = t.span_durations("serve_request")
+        total = t.span_count("serve_request")
+        k = min(max(total - self._last_serve_total, 0), len(durs))
+        self._last_serve_total = total
+        window = sorted(durs[-k:]) if k else []
+        msg = ("serving: batches=%d requests=%d queue_depth=%d"
+               % (nb, delta.get("serving.requests", 0), depth))
+        if rows + pad:
+            msg += "\tbatch_fill=%.2f" % (rows / float(rows + pad))
+        if window:
+            pct = t._percentile            # the ONE percentile rule
+            msg += "\treq p50/p95/p99=%.2f/%.2f/%.2fms" % (
+                pct(window, 50) * 1e3, pct(window, 95) * 1e3,
+                pct(window, 99) * 1e3)
+        pad_b = delta.get("serving.pad_bytes", 0)
+        if pad_b:
+            msg += "\tpad=%.1fKiB" % (pad_b / 1024.0)
+        self.logger.info(msg)
 
     def __call__(self, param):
         if self._programs:

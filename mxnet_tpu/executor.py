@@ -262,7 +262,7 @@ class _InstrumentedProgram:
     """
 
     __slots__ = ("kind", "entry", "argnames", "_jitted", "_donate",
-                 "_cache", "_card", "_meta")
+                 "_cache", "_card", "_meta", "warn_recompile")
 
     def __init__(self, kind, fn, jit_kwargs=None, argnames=None,
                  meta=None):
@@ -275,6 +275,11 @@ class _InstrumentedProgram:
         self._cache = {}    # dispatch sig -> [callable, card, aot_bool]
         self._card = None   # last-compiled card: the recompile-diff base
         self._meta = dict(meta or {})
+        # deliberate multi-signature callers (the serving engine compiles
+        # one program per batch bucket BY DESIGN) flip this off so their
+        # planned compiles don't read as recompile storms in the log and
+        # the recompile.* counters
+        self.warn_recompile = True
 
     # -- compile -----------------------------------------------------------
     def _signature_cards(self, args):
@@ -381,7 +386,7 @@ class _InstrumentedProgram:
                 extra=dict(self._meta, aot_fallback=aot_err))
         card["trace_ms"] = round((t1 - t0) * 1e3, 3)
         card["compile_ms"] = round((t2 - t1) * 1e3, 3)
-        if self._card is not None:
+        if self._card is not None and self.warn_recompile:
             self._warn_recompile(card)
         self._card = card
         telemetry.record_program(card)
@@ -1122,10 +1127,23 @@ class Executor:
 
     @staticmethod
     def _bind(symbol, ctx, args, args_grad, grad_req, aux_states,
-              group2ctx=None):
+              group2ctx=None, shared_exec=None):
         from .ndarray.ndarray import NDArray
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
+        # shared_exec (reference bind parity): the new executor reuses
+        # the donor's _GraphProgram, so every signature already traced/
+        # compiled for the donor (the _InstrumentedProgram per-shape AOT
+        # cache) is a cache HIT for the new binding — this is what makes
+        # Predictor.reshape and the serving engine's bucket cache free of
+        # silent re-traces. Only valid when both executors run the same
+        # graph; grouped (group2ctx) programs pin concrete devices and
+        # cannot be shared across binds.
+        program = None
+        if shared_exec is not None and group2ctx is None \
+                and shared_exec._symbol is symbol \
+                and not shared_exec._prog.node_devices:
+            program = shared_exec._prog
 
         def _as_list(spec, names, what):
             if spec is None:
@@ -1157,7 +1175,7 @@ class Executor:
             aux_arrays = [a if a is not None else _z(s, ctx=ctx)
                           for a, s in zip(aux_arrays, aux_shapes)]
         return Executor(symbol, ctx, arg_arrays, grad_arrays, grad_req,
-                        aux_arrays, group2ctx=group2ctx,
+                        aux_arrays, program=program, group2ctx=group2ctx,
                         owns_arrays=auto_aux)
 
     # -- execution ---------------------------------------------------------

@@ -12,6 +12,7 @@ Components:
 - spmd:        sharded train-step compiler (dp/tp batch+param sharding)
 - ring_attention: sequence-parallel blockwise attention over ppermute
 """
+from .compat import shard_map
 from .mesh import make_mesh, default_mesh, mesh_from_contexts, barrier
 from .collectives import (all_reduce, all_gather, reduce_scatter, ppermute,
                           all_to_all)

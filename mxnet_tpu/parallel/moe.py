@@ -23,6 +23,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .compat import shard_map
+
 __all__ = ["moe_ffn"]
 
 
@@ -100,7 +102,7 @@ def moe_ffn(x, router_w, expert_w1, expert_w2, mesh, axis_name="ep",
     w1 = jax.device_put(w1, NamedSharding(mesh, P(axis_name)))
     w2 = jax.device_put(w2, NamedSharding(mesh, P(axis_name)))
 
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_local_moe, axis_name=axis_name,
                           capacity=capacity),
         mesh=mesh, in_specs=(xs, P(), P(axis_name), P(axis_name)),

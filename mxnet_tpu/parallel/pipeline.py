@@ -19,6 +19,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .compat import shard_map
+
 __all__ = ["pipeline_apply"]
 
 
@@ -56,7 +58,9 @@ def _pipe_local(params, x, stage_fn, axis_name, n_micro):
     def _varying(v):
         if hasattr(lax, "pcast"):
             return lax.pcast(v, (axis_name,), to="varying")
-        return lax.pvary(v, (axis_name,))
+        if hasattr(lax, "pvary"):
+            return lax.pvary(v, (axis_name,))
+        return v  # pre-vma JAX: shard_map has no varying/replicated types
 
     acc0 = _varying(jnp.zeros((n_micro,) + mb_shape, x.dtype))
     cur0 = _varying(jnp.zeros(mb_shape, x.dtype))
@@ -90,7 +94,7 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, axis_name="pp",
         stage_params)
     xr = jax.device_put(xr, NamedSharding(mesh, P()))
 
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_pipe_local, stage_fn=stage_fn,
                           axis_name=axis_name, n_micro=n_micro),
         mesh=mesh,

@@ -22,6 +22,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .compat import shard_map
+
 __all__ = ["attention", "ring_attention"]
 
 
@@ -205,7 +207,7 @@ def ring_attention(q, k, v, mesh, axis_name="sp", batch_axis_name=None,
     raw = [jax.device_put(x, NamedSharding(mesh, spec)) for x in raw]
 
     def build(flag):
-        return jax.shard_map(
+        return shard_map(
             functools.partial(_ring_attention_local, axis_name=axis_name,
                               causal=causal, scale=scale, use_pallas=flag),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

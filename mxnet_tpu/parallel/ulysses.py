@@ -23,6 +23,8 @@ import jax
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .compat import shard_map
+
 from .ring_attention import attention as _plain_attention
 
 __all__ = ["ulysses_attention"]
@@ -79,7 +81,7 @@ def ulysses_attention(q, k, v, mesh, axis_name="sp", batch_axis_name=None,
     # inputs committed to one device (NDArrays) must be laid out over the
     # mesh before shard_map will accept them
     raw = [jax.device_put(x, NamedSharding(mesh, spec)) for x in raw]
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_ulysses_local, axis_name=axis_name,
                           causal=causal, scale=scale,
                           use_pallas=use_pallas),

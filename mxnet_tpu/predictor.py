@@ -36,7 +36,7 @@ class Predictor:
     """
 
     def __init__(self, symbol_json, param_bytes, input_shapes, ctx=None,
-                 dtype=None):
+                 dtype=None, shared_exec=None):
         if isinstance(symbol_json, sym.Symbol):
             self._symbol = symbol_json
         else:
@@ -83,7 +83,8 @@ class Predictor:
             aux[name] = aux_params[name]
 
         self._executor = self._symbol.bind(
-            ctx, args, args_grad=None, grad_req="null", aux_states=aux)
+            ctx, args, args_grad=None, grad_req="null", aux_states=aux,
+            shared_exec=shared_exec)
         self._outputs = None
 
     # -- c_predict_api surface ---------------------------------------------
@@ -112,8 +113,11 @@ class Predictor:
         return self._outputs[index]
 
     def reshape(self, new_input_shapes):
-        """(parity: MXPredReshape) — rebind for new input shapes; weights
-        are reused, XLA recompiles once per new signature."""
+        """(parity: MXPredReshape) — rebind for new input shapes. The new
+        predictor SHARES this one's compiled-program cache (the
+        executor's per-signature AOT cache), so XLA compiles at most once
+        per (shape, dtype) signature across the whole reshape lineage —
+        bouncing between two shapes re-traces nothing."""
         shapes = dict(self._input_shapes)
         shapes.update({k: tuple(v) for k, v in new_input_shapes.items()})
         arg_params = {("arg:%s" % k): v
@@ -123,7 +127,15 @@ class Predictor:
         arg_params.update({("aux:%s" % k): v
                            for k, v in self._executor.aux_dict.items()})
         return Predictor(self._symbol, arg_params, shapes, ctx=self._ctx,
-                         dtype=self._dtype)
+                         dtype=self._dtype, shared_exec=self._executor)
+
+    def engine(self, **kwargs):
+        """A ``serving.InferenceEngine`` sharing this predictor's
+        programs and device-resident parameters — the batched serving
+        surface over the same compiled cache (kwargs: ``max_batch``,
+        ``max_wait_ms``, ...)."""
+        from .serving import InferenceEngine
+        return InferenceEngine(predictor=self, **kwargs)
 
 
 def _load_params(param_bytes):

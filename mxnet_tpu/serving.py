@@ -1,0 +1,495 @@
+"""High-throughput inference serving: bucketed AOT forward programs +
+a dynamic micro-batching engine.
+
+No reference counterpart — the reference's deployment surface
+(c_predict_api.cc, one synchronous executor per client) predates
+serving-scale inference. The TPU-native design follows the compiled-
+program serving playbook (TVM arXiv:1802.04799, Julia-to-TPU
+arXiv:1810.09868): pin the abstract signature, compile once, dispatch
+many. Concretely:
+
+* **bucketed AOT forward programs** — batch-dimension buckets (powers
+  of two up to ``max_batch``), each compiled ONCE through the
+  executor's instrumented wrapper (``executor._InstrumentedProgram``),
+  so every bucket gets a program card in ``telemetry.programs()``,
+  recompile diagnosis and ledger accounting for free. Parameters are
+  committed device-resident once and shared by all buckets; the
+  ``_GraphProgram`` is shared with any ``Predictor`` over the same
+  symbol (``Predictor.reshape`` rides the same cache — no re-trace).
+
+* **a dynamic micro-batcher** — ``submit()`` enqueues a request and
+  returns a ``concurrent.futures.Future``; a coalescer thread packs
+  pending requests into the smallest covering bucket (padding the
+  remainder with zeros), flushes when the pending rows fill
+  ``max_batch`` OR a ``max_wait_ms`` deadline expires, dispatches the
+  program asynchronously with up to ``max_inflight`` batches in
+  flight, and a resolver pool slices the padded output back into
+  per-request results after the (blocking) device-to-host fetch.
+
+* **telemetry** — counters ``serving.requests`` / ``serving.rows`` /
+  ``serving.batches`` / ``serving.batch_rows`` / ``serving.pad_rows``
+  / ``serving.pad_bytes`` / ``serving.resolved`` and the
+  ``serve_wait`` / ``serve_batch`` / ``serve_d2h`` /
+  ``serve_request`` spans (``telemetry.SERVE_SPANS``), so one
+  ``telemetry.snapshot()`` reports request p50/p95/p99 latency next
+  to throughput and the per-bucket program cards.
+
+Every graph output must be batch-major (dim 0 = batch) — true of the
+whole symbol zoo; the padded rows are sliced off before a future
+resolves, so callers never see them.
+"""
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+
+import numpy as np
+
+import jax
+
+from .base import MXNetError
+from . import telemetry
+from .executor import record_dispatch
+from .predictor import Predictor
+
+__all__ = ["InferenceEngine", "bucket_sizes"]
+
+
+def bucket_sizes(max_batch):
+    """The power-of-two batch buckets up to ``max_batch`` (inclusive;
+    ``max_batch`` itself is always a bucket so a full batch never pads)."""
+    max_batch = int(max_batch)
+    if max_batch < 1:
+        raise MXNetError("max_batch must be >= 1, got %d" % max_batch)
+    sizes, b = [], 1
+    while b < max_batch:
+        sizes.append(b)
+        b *= 2
+    sizes.append(max_batch)
+    return sizes
+
+
+class _Request:
+    __slots__ = ("arrays", "rows", "future", "wait_span", "req_span")
+
+    def __init__(self, arrays, rows):
+        self.arrays = arrays          # {input name: np.ndarray (rows,...)}
+        self.rows = rows
+        self.future = Future()
+        # spans are entered on the submitting thread and closed on the
+        # coalescer / resolver threads — _Span carries its own t0
+        self.wait_span = telemetry.span("serve_wait").__enter__()
+        self.req_span = telemetry.span("serve_request").__enter__()
+
+
+_FLUSH = object()
+_SHUTDOWN = object()
+
+
+class InferenceEngine:
+    """Dynamic micro-batching over bucketed AOT forward programs.
+
+    Parameters
+    ----------
+    symbol : Symbol | str — graph (or its JSON), as for ``Predictor``
+    params : dict | bytes | str — ``arg:``/``aux:`` blob, as for
+        ``Predictor``
+    input_shapes : dict name -> shape — per-input shape; dim 0 is the
+        batch dimension (its value only seeds shape inference, requests
+        may carry any row count up to ``max_batch``)
+    ctx : Context — device (default: current context)
+    max_batch : int — largest batch one program serves; buckets are the
+        powers of two up to it
+    max_wait_ms : float — coalescing deadline: a pending request waits
+        at most this long for co-batchable traffic before a partial
+        bucket is flushed
+    max_inflight : int — dispatched-but-unresolved batch bound (the
+        device-queue depth the coalescer may run ahead)
+    dtype : optional input dtype override (e.g. bfloat16), as for
+        ``Predictor``
+    warmup : bool — compile every bucket at construction (AOT); with
+        ``False`` buckets compile on first use
+    telemetry_logger : optional ``callback.TelemetryLogger`` — the
+        engine calls its ``log_serving()`` after every batch so a
+        running engine logs queue depth / fill / p95 periodically
+    predictor : optional existing ``Predictor`` to share programs and
+        device-resident parameters with (``symbol``/``params``/
+        ``input_shapes`` are then taken from it)
+    """
+
+    def __init__(self, symbol=None, params=None, input_shapes=None,
+                 ctx=None, max_batch=32, max_wait_ms=2.0, max_inflight=2,
+                 dtype=None, warmup=True, telemetry_logger=None,
+                 predictor=None):
+        if predictor is None:
+            if symbol is None or input_shapes is None:
+                raise MXNetError("InferenceEngine needs (symbol, params, "
+                                 "input_shapes) or predictor=")
+            predictor = Predictor(symbol, params or {}, input_shapes,
+                                  ctx=ctx, dtype=dtype)
+        self._predictor = predictor
+        ex = predictor._executor
+        self._prog = ex._prog
+        if self._prog.node_devices:
+            raise MXNetError("serving: grouped (group2ctx) programs run "
+                             "eagerly per segment and cannot be bucketed")
+        self._symbol = predictor._symbol
+        self._ctx = ex._ctx
+        self._device = self._ctx.jax_device()
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.buckets = bucket_sizes(self.max_batch)
+        self._input_names = list(predictor._input_names)
+        self._row_shapes = {n: tuple(predictor._input_shapes[n][1:])
+                            for n in self._input_names}
+        self._in_dtypes = {n: np.dtype(ex.arg_dict[n].dtype)
+                           for n in self._input_names}
+        # params/aux stay device-resident across ALL buckets: the raw
+        # arrays of the predictor's bound storage, shared (not copied)
+        auto = set(predictor._auto_args)
+        self._param_raw = {n: a._data for n, a in ex.arg_dict.items()
+                           if n not in self._input_names and n not in auto}
+        self._aux_raw = {n: a._data for n, a in ex.aux_dict.items()}
+        # inference-time dummies (loss-layer labels) are batch-shaped:
+        # one zero set per bucket, built lazily in _bucket_extras
+        self._auto_names = sorted(auto)
+        self._extras = {}
+        self._rng = ex._step_key()
+        self._forward = self._prog.forward_fn(False)
+
+        self._logger = telemetry_logger
+        self._lock = threading.Lock()
+        self._stats = collections.Counter()
+        self._bucket_batches = collections.Counter()
+        self._q = queue.Queue()
+        self._inflight = threading.Semaphore(max(1, int(max_inflight)))
+        self._closed = False
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, int(max_inflight)),
+            thread_name_prefix="mxtpu-serve-resolve")
+        self._thread = threading.Thread(target=self._coalesce_loop,
+                                        name="mxtpu-serve-coalesce",
+                                        daemon=True)
+        self._thread.start()
+        if warmup:
+            self.warmup()
+
+    # -- program cache ------------------------------------------------------
+    def warmup(self):
+        """Compile (and execute once, on zeros) every bucket's forward
+        program — after this, serving dispatches are all AOT cache hits
+        and ``program_cards()`` holds one card per bucket signature.
+        The recompile-cause warning is suppressed ONLY for the duration
+        (bucket compiles are planned signatures, not a storm); a
+        steady-state signature drift afterwards still warns, for this
+        engine and for any Predictor sharing the program."""
+        prev = getattr(self._forward, "warn_recompile", True)
+        if hasattr(self._forward, "warn_recompile"):
+            self._forward.warn_recompile = False
+        try:
+            for b in self.buckets:
+                args = dict(self._param_raw)
+                for n in self._input_names:
+                    args[n] = jax.device_put(
+                        np.zeros((b,) + self._row_shapes[n],
+                                 self._in_dtypes[n]), self._device)
+                args.update(self._bucket_extras(b))
+                outs, _ = self._forward(args, self._aux_raw, self._rng)
+                for o in outs:
+                    o.block_until_ready()
+        finally:
+            if hasattr(self._forward, "warn_recompile"):
+                self._forward.warn_recompile = prev
+
+    def _bucket_extras(self, bucket):
+        """Device-resident zero dummies (softmax labels etc.) at this
+        bucket's batch size, cached per bucket."""
+        cached = self._extras.get(bucket)
+        if cached is not None:
+            return cached
+        extras = {}
+        if self._auto_names:
+            known = {n: (bucket,) + self._row_shapes[n]
+                     for n in self._input_names}
+            known.update({n: tuple(v.shape)
+                          for n, v in self._param_raw.items()})
+            shapes, _, _ = self._symbol.infer_shape_partial(**known)
+            inferred = dict(zip(self._symbol.list_arguments(), shapes))
+            ex = self._predictor._executor
+            for n in self._auto_names:
+                shp = inferred.get(n)
+                if shp is None:
+                    raise MXNetError("serving: cannot infer dummy shape "
+                                     "for %r at bucket %d" % (n, bucket))
+                extras[n] = jax.device_put(
+                    np.zeros(shp, np.dtype(ex.arg_dict[n].dtype)),
+                    self._device)
+        self._extras[bucket] = extras
+        return extras
+
+    def bucket_for(self, rows):
+        """Smallest bucket covering ``rows``."""
+        for b in self.buckets:
+            if b >= rows:
+                return b
+        raise MXNetError("serving: %d rows exceed max_batch=%d"
+                         % (rows, self.max_batch))
+
+    def program_cards(self):
+        """{card_id: card} for THIS engine's forward programs — one card
+        per compiled (bucket, dtype) signature."""
+        entry = getattr(self._forward, "entry", None)
+        if entry is None:
+            return {}
+        return {k: c for k, c in telemetry.programs().items()
+                if k == entry or k.startswith(entry + "/")}
+
+    # -- request surface ----------------------------------------------------
+    def submit(self, *args, **kwargs):
+        """Enqueue one request; returns a Future resolving to the list
+        of per-output numpy arrays (each ``(rows, ...)``). Inputs go by
+        name (``submit(data=x)``); a single-input graph also accepts one
+        positional array. Each input must be ``(rows,) + row_shape``
+        with 1 <= rows <= max_batch."""
+        if self._closed:                 # fast path; re-checked under
+            raise MXNetError("serving: engine is closed")   # the lock
+        if args:
+            if len(args) != 1 or kwargs or len(self._input_names) != 1:
+                raise MXNetError("serving: pass inputs by name "
+                                 "(submit(name=array))")
+            kwargs = {self._input_names[0]: args[0]}
+        if set(kwargs) != set(self._input_names):
+            raise MXNetError("serving: inputs %s do not match engine "
+                             "inputs %s" % (sorted(kwargs),
+                                            sorted(self._input_names)))
+        arrays, rows = {}, None
+        for n, v in kwargs.items():
+            a = np.asarray(getattr(v, "asnumpy", lambda: v)())
+            want = self._row_shapes[n]
+            if a.shape == want:           # a single row without batch dim
+                a = a[None]
+            if a.ndim != len(want) + 1 or tuple(a.shape[1:]) != want:
+                raise MXNetError(
+                    "serving: input %r shape %s != (rows,)+%s"
+                    % (n, a.shape, want))
+            if rows is None:
+                rows = a.shape[0]
+            elif a.shape[0] != rows:
+                raise MXNetError("serving: inputs disagree on rows")
+            arrays[n] = np.ascontiguousarray(
+                a.astype(self._in_dtypes[n], copy=False))
+        if not rows:
+            raise MXNetError("serving: empty request")
+        if rows > self.max_batch:
+            raise MXNetError("serving: request rows %d exceed max_batch %d"
+                             % (rows, self.max_batch))
+        req = _Request(arrays, rows)
+        # the closed-check and the enqueue share the lock with close()'s
+        # flag-set + sentinel-put: a request that passes the check is
+        # guaranteed to land BEFORE the shutdown sentinel, so its future
+        # always resolves
+        with self._lock:
+            if self._closed:
+                raise MXNetError("serving: engine is closed")
+            self._stats["requests"] += 1
+            self._stats["rows"] += rows
+            self._q.put(req)
+        telemetry.counter_inc("serving.requests")
+        telemetry.counter_inc("serving.rows", rows)
+        return req.future
+
+    def predict(self, *args, **kwargs):
+        """Blocking convenience: ``submit(...).result()``."""
+        return self.submit(*args, **kwargs).result()
+
+    def flush(self):
+        """Ask the coalescer to dispatch whatever is pending now instead
+        of waiting out the deadline."""
+        self._q.put(_FLUSH)
+
+    def stats(self):
+        """Engine-side counters + the request-latency percentiles: what
+        a load balancer's health endpoint would export."""
+        with self._lock:
+            st = dict(self._stats)
+        rows = st.get("batch_rows", 0)
+        pad = st.get("pad_rows", 0)
+        lat = telemetry.span_stats("serve_request").get("serve_request", {})
+        return {
+            "requests": st.get("requests", 0),
+            "resolved": st.get("resolved", 0),
+            "queue_depth": st.get("requests", 0) - st.get("resolved", 0),
+            "batches": st.get("batches", 0),
+            "rows": st.get("rows", 0),
+            "pad_rows": pad,
+            "pad_bytes": st.get("pad_bytes", 0),
+            "batch_fill": round(rows / (rows + pad), 4) if rows + pad
+            else None,
+            "buckets": {str(k): v for k, v in
+                        sorted(self._bucket_batches.items())},
+            "latency_ms": {k: lat.get(k) for k in
+                           ("p50_ms", "p95_ms", "p99_ms")}
+            if lat else None,
+        }
+
+    # -- lifecycle ----------------------------------------------------------
+    def close(self):
+        """Drain and stop: already-submitted requests (queued, pending,
+        or in flight) all resolve before close() returns; later
+        ``submit`` calls raise."""
+        with self._lock:
+            if self._closed:
+                already = True
+            else:
+                already = False
+                self._closed = True
+                self._q.put(_SHUTDOWN)
+        if already:
+            return
+        self._thread.join()
+        self._pool.shutdown(wait=True)
+        if self._logger is not None:
+            try:
+                self._logger.log_serving(force=True)
+            except Exception:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- coalescer ----------------------------------------------------------
+    def _coalesce_loop(self):
+        pending, pending_rows = [], 0
+        deadline = None
+
+        def dispatch():
+            nonlocal pending, pending_rows, deadline
+            if pending:
+                batch, pending = pending, []
+                pending_rows = 0
+                deadline = None
+                self._dispatch(batch)
+
+        while True:
+            if pending:
+                try:
+                    item = self._q.get(
+                        timeout=max(0.0, deadline - time.monotonic()))
+                except queue.Empty:
+                    dispatch()        # deadline flush under trickle load
+                    continue
+            else:
+                item = self._q.get()
+            if item is _SHUTDOWN:
+                dispatch()
+                self._drain_after_shutdown()
+                break
+            if item is _FLUSH:
+                dispatch()
+                continue
+            if pending_rows + item.rows > self.max_batch:
+                dispatch()            # the new request doesn't fit
+            pending.append(item)
+            pending_rows += item.rows
+            if deadline is None:
+                deadline = time.monotonic() + self.max_wait_s
+            if pending_rows >= self.max_batch:
+                dispatch()
+
+    def _drain_after_shutdown(self):
+        """Backstop: submit() enqueues under the same lock close() uses
+        to set the flag and post the sentinel, so nothing should land
+        behind it — but nothing already enqueued may ever be left
+        unresolved, so drain defensively anyway."""
+        left = []
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _SHUTDOWN and item is not _FLUSH:
+                left.append(item)
+        while left:
+            batch, rows = [], 0
+            while left and rows + left[0].rows <= self.max_batch:
+                r = left.pop(0)
+                batch.append(r)
+                rows += r.rows
+            self._dispatch(batch)
+
+    def _dispatch(self, reqs):
+        """Pack ``reqs`` into the smallest covering bucket, launch the
+        bucket's program (async), and hand resolution to the pool."""
+        self._inflight.acquire()
+        try:
+            rows = sum(r.rows for r in reqs)
+            bucket = self.bucket_for(rows)
+            for r in reqs:
+                r.wait_span.__exit__(None, None, None)
+            args = dict(self._param_raw)
+            pad_bytes = 0
+            for n in self._input_names:
+                buf = np.zeros((bucket,) + self._row_shapes[n],
+                               self._in_dtypes[n])
+                off = 0
+                for r in reqs:
+                    buf[off:off + r.rows] = r.arrays[n]
+                    off += r.rows
+                pad_bytes += (bucket - rows) * buf[0].nbytes
+                telemetry.record_transfer(buf.nbytes)
+                args[n] = jax.device_put(buf, self._device)
+            args.update(self._bucket_extras(bucket))
+            record_dispatch("serve")
+            with telemetry.span("serve_batch"):
+                outs, _ = self._forward(args, self._aux_raw, self._rng)
+            with self._lock:
+                self._stats["batches"] += 1
+                self._stats["batch_rows"] += rows
+                self._stats["pad_rows"] += bucket - rows
+                self._stats["pad_bytes"] += pad_bytes
+                self._bucket_batches[bucket] += 1
+            telemetry.counter_inc("serving.batches")
+            telemetry.counter_inc("serving.batch_rows", rows)
+            telemetry.counter_inc("serving.pad_rows", bucket - rows)
+            telemetry.counter_inc("serving.pad_bytes", pad_bytes)
+            self._pool.submit(self._resolve, outs, reqs)
+        except BaseException as e:
+            self._inflight.release()
+            for r in reqs:
+                if not r.future.done():
+                    r.future.set_exception(e)
+        else:
+            if self._logger is not None:
+                try:
+                    self._logger.log_serving()
+                except Exception:
+                    pass
+
+    def _resolve(self, outs, reqs):
+        """Resolver-pool worker: blocking d2h of the whole padded batch,
+        then slice each request's rows off and resolve its future."""
+        try:
+            with telemetry.span("serve_d2h"):
+                host = [np.asarray(o) for o in outs]
+            off = 0
+            for r in reqs:
+                sl = [h[off:off + r.rows] for h in host]
+                off += r.rows
+                r.req_span.__exit__(None, None, None)
+                with self._lock:
+                    self._stats["resolved"] += 1
+                telemetry.counter_inc("serving.resolved")
+                r.future.set_result(sl)
+        except BaseException as e:
+            for r in reqs:
+                if not r.future.done():
+                    r.future.set_exception(e)
+        finally:
+            self._inflight.release()

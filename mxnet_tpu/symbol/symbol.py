@@ -298,10 +298,14 @@ class Symbol:
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None):
-        """Bind with existing arrays (parity: symbol.py bind:1518)."""
+        """Bind with existing arrays (parity: symbol.py bind:1518).
+        ``shared_exec`` shares the donor executor's compiled-program
+        cache — a rebind at a new shape reuses every signature already
+        compiled (the reference shared memory; here we share programs)."""
         from ..executor import Executor
         return Executor._bind(self, ctx, args, args_grad, grad_req,
-                              aux_states, group2ctx=group2ctx)
+                              aux_states, group2ctx=group2ctx,
+                              shared_exec=shared_exec)
 
     # -- eval / call -------------------------------------------------------
     def eval(self, ctx=None, **kwargs):

@@ -63,7 +63,8 @@ __all__ = [
     "record_host_sync", "chrome_events", "mark_trace_start",
     "record_program", "program_dispatch", "programs", "card_update",
     "set_peak_flops", "ledger_track", "ledger", "ledger_top",
-    "SPAN_RING_SIZE", "FIT_PHASE_SPANS", "MAX_PROGRAM_CARDS",
+    "SPAN_RING_SIZE", "FIT_PHASE_SPANS", "SERVE_SPANS",
+    "MAX_PROGRAM_CARDS",
 ]
 
 # ring capacities: bound memory for arbitrarily long training runs. The
@@ -80,6 +81,12 @@ FIT_PHASE_SPANS = ("fit_batch", "feed", "step", "shard_put",
                    "metric_update", "metric_fetch", "opt_update",
                    "io_next", "callbacks", "epoch_sync",
                    "kv_push", "kv_pull")
+
+# the serving-path span names (mxnet_tpu/serving.py): request time in
+# queue, program dispatch per coalesced batch, the blocking d2h fetch,
+# and the whole submit->resolve request latency whose p50/p95/p99 the
+# serving artifacts and TelemetryLogger report
+SERVE_SPANS = ("serve_wait", "serve_batch", "serve_d2h", "serve_request")
 
 # program-card registry bound: recompile storms must not grow the
 # registry without limit — the oldest card is dropped (its FLOPs x

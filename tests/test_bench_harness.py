@@ -105,6 +105,7 @@ def _patched_supervise(monkeypatch, phases, deadline=30.0, smoke=False,
     monkeypatch.setenv("MXTPU_BENCH_AB", "1" if ab else "0")
     # optional phases default OFF here; dedicated tests opt back in
     monkeypatch.setenv("MXTPU_BENCH_DP", "0")
+    monkeypatch.setenv("MXTPU_BENCH_SERVE", "0")
     monkeypatch.setattr(bench, "_run_phase", fake_phase)
     monkeypatch.setattr(bench, "TOTAL_DEADLINE", deadline)
     monkeypatch.setattr(bench, "SMOKE", smoke)
@@ -326,6 +327,7 @@ def test_supervise_dp_phase_merges(monkeypatch):
     monkeypatch.setenv("MXTPU_BENCH_AB", "0")
     monkeypatch.setenv("MXTPU_BENCH_MODULE", "0")
     monkeypatch.setenv("MXTPU_BENCH_DP", "1")
+    monkeypatch.setenv("MXTPU_BENCH_SERVE", "0")
     monkeypatch.setattr(bench, "_run_phase", fake_phase)
     monkeypatch.setattr(bench, "TOTAL_DEADLINE", 600.0)
     monkeypatch.setattr(bench, "SMOKE", False)
@@ -404,6 +406,92 @@ def test_budget_args_dp_phase(monkeypatch):
     monkeypatch.setattr(bench, "DP_TIMEOUT", bench.DP_TIMEOUT)
     rest = bench._apply_budget_args(["--budget-s", "dp=120"])
     assert rest == [] and bench.DP_TIMEOUT == 120.0
+
+
+def test_budget_args_serve_phase(monkeypatch):
+    monkeypatch.setattr(bench, "SERVE_TIMEOUT", bench.SERVE_TIMEOUT)
+    rest = bench._apply_budget_args(["--budget-s", "serve=90"])
+    assert rest == [] and bench.SERVE_TIMEOUT == 90.0
+
+
+def test_supervise_serve_phase_merges(monkeypatch):
+    """With budget left, the serving sweep child runs and its
+    throughput/latency table merges into the final line under
+    "serving"."""
+    sv = {"lane": "serving", "unbatched_req_s": 100.0,
+          "burst_req_s": 900.0, "serve_speedup": 9.0,
+          "burst_latency_ms": {"p50_ms": 4.0, "p95_ms": 9.0,
+                               "p99_ms": 11.0},
+          "offered_loads": {"0.80": {"achieved_req_s": 700.0}},
+          "compiles_per_bucket": 1.0}
+
+    def fake_phase(mode, timeout, env_extra=None):
+        if mode == "--probe":
+            return {"device": "x"}, False
+        if mode == "--child":
+            return {"value": 500.0, "unit": "img/s"}, False
+        assert mode == "--serve-child", mode
+        return dict(sv), False
+
+    import io
+    from contextlib import redirect_stdout
+    monkeypatch.setenv("MXTPU_BENCH_AB", "0")
+    monkeypatch.setenv("MXTPU_BENCH_MODULE", "0")
+    monkeypatch.setenv("MXTPU_BENCH_DP", "0")
+    monkeypatch.setenv("MXTPU_BENCH_SERVE", "1")
+    monkeypatch.setattr(bench, "_run_phase", fake_phase)
+    monkeypatch.setattr(bench, "TOTAL_DEADLINE", 600.0)
+    monkeypatch.setattr(bench, "SMOKE", False)
+    monkeypatch.setattr(bench, "PROBE_TIMEOUT", 1.0)
+    monkeypatch.setattr(bench, "PROBE_GAP", 0.0)
+    monkeypatch.setattr(bench, "RAW_MIN", 0.5)
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = bench.supervise()
+    assert rc == 0
+    out = bench._last_json_line(buf.getvalue())
+    assert out["value"] == 500.0
+    assert out["serving"]["serve_speedup"] == 9.0
+    assert out["serving"]["burst_latency_ms"]["p95_ms"] == 9.0
+    assert "lane" not in out["serving"]
+
+
+def test_serve_child_smoke_sweep(monkeypatch):
+    """serve_child end to end in smoke mode (tiny MLP on CPU): partial
+    emission per phase, one compile per bucket, p95 in the artifact and
+    the offered-load ladder populated."""
+    import io
+    from contextlib import redirect_stdout
+    monkeypatch.setattr(bench, "SMOKE", True)
+
+    class _Dev:
+        device_kind = "cpu"
+        platform = "cpu"
+
+    def init(jax):
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()[0]
+
+    monkeypatch.setattr(bench, "_init_device", init)
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        bench.serve_child()
+    lines = [json.loads(l) for l in buf.getvalue().splitlines()
+             if l.strip().startswith("{")]
+    partials = [l for l in lines if l.get("partial")]
+    # one partial per phase: unbatched, burst, 3 load points
+    assert len(partials) >= 5
+    out = lines[-1]
+    assert out["lane"] == "serving"
+    assert out["compiles_per_bucket"] == 1.0
+    assert out["unbatched_req_s"] > 0 and out["burst_req_s"] > 0
+    assert out["burst_latency_ms"]["p95_ms"] is not None
+    assert set(out["offered_loads"]) == {"0.50", "0.80", "0.95"}
+    for pt in out["offered_loads"].values():
+        assert pt["achieved_req_s"] > 0
+        assert pt["latency_ms"]["p95"] >= pt["latency_ms"]["p50"] >= 0
+    # the serving telemetry rode into the artifact summary
+    assert "serve_request" in out["telemetry"]["spans"]
 
 
 def test_module_child_marks_silent_fallback(monkeypatch):

@@ -86,8 +86,8 @@ def test_compressed_psum_on_mesh():
         s, new_res = compressed_psum(local, "dp", res, threshold=0.5)
         return s[None], new_res[None]
 
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(P("dp", None),),
-                       out_specs=(P("dp", None), P("dp", None)))
+    fn = parallel.shard_map(body, mesh=mesh, in_specs=(P("dp", None),),
+                            out_specs=(P("dp", None), P("dp", None)))
     s, res = fn(x)
     # every device contributed the same quantised value
     np.testing.assert_allclose(np.asarray(s)[0],

@@ -102,15 +102,17 @@ def test_spmd_trainer_dp_tp():
 
 
 def test_collectives_shard_map():
+    # parallel.shard_map is the version shim: jax.shard_map where the
+    # installed JAX has it, the jax.experimental implementation otherwise
     mesh = parallel.make_mesh({"dp": 8})
     x = jnp.arange(8.0)
 
     def f(v):
         return parallel.all_reduce(v, "dp")
 
-    out = jax.shard_map(f, mesh=mesh,
-                        in_specs=jax.sharding.PartitionSpec("dp"),
-                        out_specs=jax.sharding.PartitionSpec("dp"))(x)
+    out = parallel.shard_map(f, mesh=mesh,
+                             in_specs=jax.sharding.PartitionSpec("dp"),
+                             out_specs=jax.sharding.PartitionSpec("dp"))(x)
     np.testing.assert_allclose(np.asarray(out), np.full(8, 28.0))
 
 

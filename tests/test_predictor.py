@@ -60,6 +60,67 @@ def test_predictor_reshape(tmp_path):
     assert p2.get_output(0).shape == (4, 3)
 
 
+def test_predictor_reshape_one_compile_per_signature():
+    """reshape shares the donor's compiled-program cache: bouncing
+    between two shapes compiles each (shape, dtype) signature ONCE —
+    asserted via telemetry.programs() (one card per compiled signature)
+    and the jit compile/hit counters."""
+    from mxnet_tpu import telemetry
+    symbol = _mlp_symbol()
+    arg_params = _trained_params(symbol)
+    params = {("arg:%s" % k): v for k, v in arg_params.items()}
+    telemetry.reset()
+    pred = Predictor(symbol, params, {"data": (2, 5)})
+    rng = np.random.RandomState(3)
+    pred.forward(data=rng.normal(size=(2, 5)).astype(np.float32))
+    entry = pred._executor._prog.forward_fn(False).entry
+    p2 = pred.reshape({"data": (4, 5)})
+    p2.forward(data=rng.normal(size=(4, 5)).astype(np.float32))
+    p3 = p2.reshape({"data": (2, 5)})     # back to the original shape
+    p3.forward(data=rng.normal(size=(2, 5)).astype(np.float32))
+    p2.forward(data=rng.normal(size=(4, 5)).astype(np.float32))
+    cards = [k for k in telemetry.programs()
+             if k.startswith(entry + "/")]
+    assert len(cards) == 2, cards          # (2,5) and (4,5) — no more
+    counters = telemetry.counters()
+    # five forward_fn lookups on ONE shared program (4 forwards + the
+    # entry read above): 1 build + 4 hits
+    assert counters.get("jit.compile.forward", 0) == 1
+    assert counters.get("jit.hit.forward", 0) == 4
+
+
+def test_predictor_reshape_then_results_match_fresh_bind():
+    """The shared-cache reshape is numerically the same predictor a
+    fresh bind would build."""
+    symbol = _mlp_symbol()
+    arg_params = _trained_params(symbol)
+    params = {("arg:%s" % k): v for k, v in arg_params.items()}
+    pred = Predictor(symbol, params, {"data": (2, 5)})
+    p2 = pred.reshape({"data": (4, 5)})
+    x = np.random.RandomState(4).normal(size=(4, 5)).astype(np.float32)
+    p2.forward(data=x)
+    fresh = Predictor(symbol, params, {"data": (4, 5)})
+    fresh.forward(data=x)
+    np.testing.assert_array_equal(p2.get_output(0).asnumpy(),
+                                  fresh.get_output(0).asnumpy())
+
+
+def test_c_predict_reshape_helper():
+    """c_predict.reshape (MXPredReshape parity) routes through the
+    shared-cache Predictor.reshape."""
+    from mxnet_tpu import c_predict
+    symbol = _mlp_symbol()
+    arg_params = _trained_params(symbol)
+    pred = Predictor(symbol, {("arg:%s" % k): v
+                              for k, v in arg_params.items()},
+                     {"data": (2, 5)})
+    p2 = c_predict.reshape(pred, ["data"], [(4, 5)])
+    assert p2._input_shapes["data"] == (4, 5)
+    assert p2._executor._prog is pred._executor._prog
+    p2.forward(data=np.zeros((4, 5), np.float32))
+    assert p2.get_output(0).shape == (4, 3)
+
+
 def test_predictor_rejects_bad_shape():
     symbol = _mlp_symbol()
     arg_params = _trained_params(symbol)

@@ -411,14 +411,17 @@ def test_telemetry_logger_programs_mode(caplog):
 # ---------------------------------------------------------------------------
 
 def test_no_raw_jit_outside_instrumented_wrapper():
-    """Tier-1 mirror of the run_checks.sh lint: executor/module
-    programs must compile through _InstrumentedProgram (program card,
-    recompile diagnosis, OOM enrichment)."""
+    """Tier-1 mirror of the run_checks.sh lint: executor/module/
+    predictor/serving programs must compile through _InstrumentedProgram
+    (program card, recompile diagnosis, OOM enrichment — and on the
+    serving path, the one-compile-per-bucket accounting)."""
     import glob
     import os
     root = os.path.join(os.path.dirname(__file__), "..", "mxnet_tpu")
     offenders = []
-    for path in [os.path.join(root, "executor.py")] + \
+    for path in [os.path.join(root, "executor.py"),
+                 os.path.join(root, "predictor.py"),
+                 os.path.join(root, "serving.py")] + \
             glob.glob(os.path.join(root, "module", "*.py")):
         with open(path) as f:
             for i, line in enumerate(f, 1):

@@ -1,0 +1,351 @@
+"""Inference serving engine: bucketed AOT programs + micro-batcher.
+
+Equivalence methodology: XLA specializes kernels per batch SHAPE, so two
+programs at different batch sizes can differ by 1 ULP in row results
+even for a plain FC stack (fusion/vectorization choices — measured, not
+a batching artifact). The batching machinery itself must therefore be
+bit-exact at FIXED program shape:
+
+- requests whose rows fill a bucket exactly compare bit-exact against an
+  unbatched forward of the same rows (same signature -> same program);
+- padded dispatches compare bit-exact against the same padded batch fed
+  through a plain Predictor at the bucket shape, sliced;
+- cross-bucket-shape comparisons are ULP-tight (atol 1e-6) and exist to
+  document the kernel-specialization reality.
+"""
+import logging
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.predictor import Predictor
+from mxnet_tpu.serving import InferenceEngine, bucket_sizes
+
+D, C = 5, 3
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(net, num_hidden=C, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _params(symbol, dtype=np.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    shapes, _, _ = symbol.infer_shape_partial(data=(2, D))
+    out = {}
+    for name, shape in zip(symbol.list_arguments(), shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        out["arg:" + name] = mx.nd.array(
+            rng.normal(0, 0.5, shape).astype(np.float32)).astype(dtype)
+    return out
+
+
+def _engine(params=None, dtype=None, **kw):
+    sym = _mlp()
+    params = params if params is not None else _params(sym)
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_wait_ms", 20.0)
+    return sym, params, InferenceEngine(sym, params, {"data": (1, D)},
+                                        dtype=dtype, **kw)
+
+
+def test_bucket_sizes():
+    assert bucket_sizes(8) == [1, 2, 4, 8]
+    assert bucket_sizes(1) == [1]
+    # a non-pow2 max_batch stays a bucket so a full batch never pads
+    assert bucket_sizes(12) == [1, 2, 4, 8, 12]
+    with pytest.raises(mx.MXNetError):
+        bucket_sizes(0)
+
+
+def test_full_bucket_bit_exact_vs_unbatched():
+    """Requests coalescing to EXACTLY a bucket are bit-exact against an
+    unbatched forward of the same rows — same abstract signature, same
+    program."""
+    sym, params, eng = _engine(max_wait_ms=500.0)
+    rng = np.random.RandomState(1)
+    xs = [rng.normal(size=(1, D)).astype(np.float32) for _ in range(4)]
+    with eng:
+        futs = [eng.submit(data=x) for x in xs]
+        outs = [f.result(timeout=60) for f in futs]
+    assert eng.stats()["buckets"] == {"4": 1}
+    oracle = Predictor(sym, params, {"data": (4, D)})
+    oracle.forward(data=np.concatenate(xs, axis=0))
+    ref = oracle.get_output(0).asnumpy()
+    for i, o in enumerate(outs):
+        np.testing.assert_array_equal(o[0], ref[i:i + 1])
+
+
+@pytest.mark.parametrize("dtype", [np.float32, jnp.bfloat16])
+def test_padded_slice_bit_exact(dtype):
+    """bucket_size+1 rows land in the next bucket zero-padded; the
+    sliced result is bit-exact against the same padded batch through a
+    plain Predictor at the bucket shape (fp32 and bf16)."""
+    dtype = np.dtype(dtype)
+    sym = _mlp()
+    params = _params(sym, dtype=dtype)
+    eng = InferenceEngine(sym, params, {"data": (1, D)}, dtype=dtype,
+                          max_batch=8, max_wait_ms=10_000.0)
+    rng = np.random.RandomState(2)
+    x = rng.normal(size=(5, D)).astype(np.float32)  # 4+1: pads to 8
+    with eng:
+        fut = eng.submit(data=x)
+        eng.flush()
+        out = fut.result(timeout=60)
+    st = eng.stats()
+    assert st["buckets"] == {"8": 1}
+    assert st["pad_rows"] == 3
+    padded = np.zeros((8, D), np.float32)
+    padded[:5] = x
+    oracle = Predictor(sym, params, {"data": (8, D)}, dtype=dtype)
+    oracle.forward(data=padded)
+    ref = oracle.get_output(0).asnumpy()[:5]
+    np.testing.assert_array_equal(out[0], ref)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, jnp.bfloat16])
+def test_multi_request_packing_bit_exact(dtype):
+    """Mixed-row requests (1+2+1 rows) pack into one bucket in FIFO
+    order; each request's slice is bit-exact against the unbatched
+    forward of the packed batch (rows sum to the bucket — no padding)."""
+    dtype = np.dtype(dtype)
+    sym = _mlp()
+    params = _params(sym, dtype=dtype)
+    eng = InferenceEngine(sym, params, {"data": (1, D)}, dtype=dtype,
+                          max_batch=4, max_wait_ms=10_000.0)
+    rng = np.random.RandomState(3)
+    parts = [rng.normal(size=(r, D)).astype(np.float32) for r in (1, 2, 1)]
+    with eng:
+        futs = [eng.submit(data=p) for p in parts]
+        eng.flush()
+        outs = [f.result(timeout=60) for f in futs]
+    assert eng.stats()["batches"] == 1
+    oracle = Predictor(sym, params, {"data": (4, D)}, dtype=dtype)
+    oracle.forward(data=np.concatenate(parts, axis=0))
+    ref = oracle.get_output(0).asnumpy()
+    off = 0
+    for p, o in zip(parts, outs):
+        np.testing.assert_array_equal(o[0], ref[off:off + len(p)])
+        off += len(p)
+
+
+def test_cross_bucket_shape_ulp_tolerance():
+    """Engine output vs a PER-REQUEST unbatched forward crosses program
+    shapes (bucket 4 vs batch 1) — ULP-level agreement, not bitwise
+    (XLA's shape-specialized kernels; see module docstring)."""
+    sym, params, eng = _engine(max_wait_ms=500.0)
+    rng = np.random.RandomState(4)
+    xs = [rng.normal(size=(1, D)).astype(np.float32) for _ in range(4)]
+    with eng:
+        outs = [f.result(timeout=60)
+                for f in [eng.submit(data=x) for x in xs]]
+    oracle = Predictor(sym, params, {"data": (1, D)})
+    for x, o in zip(xs, outs):
+        oracle.forward(data=x)
+        np.testing.assert_allclose(
+            o[0], oracle.get_output(0).asnumpy(), rtol=0, atol=1e-6)
+
+
+def test_bucket_selection_boundaries():
+    """rows == bucket size -> that bucket, zero pad; rows == bucket
+    size + 1 -> next bucket, bucket-1 pad rows."""
+    sym, params, eng = _engine(max_batch=16, max_wait_ms=10_000.0)
+    with eng:
+        assert [eng.bucket_for(r) for r in (1, 2, 3, 4, 5, 8, 9, 16)] == \
+            [1, 2, 4, 4, 8, 8, 16, 16]
+        with pytest.raises(mx.MXNetError):
+            eng.bucket_for(17)
+        rng = np.random.RandomState(5)
+        f4 = eng.submit(data=rng.normal(size=(4, D)).astype(np.float32))
+        eng.flush()
+        f4.result(timeout=60)
+        st = eng.stats()
+        assert st["buckets"] == {"4": 1} and st["pad_rows"] == 0
+        f5 = eng.submit(data=rng.normal(size=(5, D)).astype(np.float32))
+        eng.flush()
+        f5.result(timeout=60)
+        st = eng.stats()
+        assert st["buckets"] == {"4": 1, "8": 1} and st["pad_rows"] == 3
+        assert st["batch_fill"] == pytest.approx(9.0 / 12.0)
+
+
+def test_deadline_flush_under_trickle_load():
+    """A lone request must not wait for co-batchable traffic forever:
+    the max_wait_ms deadline flushes a partial bucket."""
+    sym, params, eng = _engine(max_batch=8, max_wait_ms=30.0)
+    rng = np.random.RandomState(6)
+    with eng:
+        t0 = time.perf_counter()
+        out = eng.submit(data=rng.normal(size=(1, D)).astype(np.float32)) \
+            .result(timeout=60)
+        dt = time.perf_counter() - t0
+    assert out[0].shape == (1, C)
+    st = eng.stats()
+    assert st["batches"] == 1 and st["buckets"] == {"1": 1}
+    # generous CI bound: the deadline is 30ms, a stuck coalescer would
+    # only resolve at close()
+    assert dt < 30.0
+
+
+def test_fill_flush_coalesces_bursts():
+    """A burst under a long deadline coalesces on FILL: 16 one-row
+    requests at max_batch=8 dispatch as two full buckets."""
+    sym, params, eng = _engine(max_batch=8, max_wait_ms=5_000.0)
+    rng = np.random.RandomState(7)
+    xs = [rng.normal(size=(1, D)).astype(np.float32) for _ in range(16)]
+    with eng:
+        futs = [eng.submit(data=x) for x in xs]
+        for f in futs:
+            f.result(timeout=60)
+    st = eng.stats()
+    assert st["batches"] == 2
+    assert st["buckets"] == {"8": 2}
+    assert st["batch_fill"] == 1.0 and st["pad_rows"] == 0
+
+
+def test_clean_shutdown_with_inflight_requests():
+    """close() drains: every already-submitted future resolves, and
+    later submits raise."""
+    sym, params, eng = _engine(max_batch=4, max_wait_ms=10_000.0,
+                               max_inflight=2)
+    rng = np.random.RandomState(8)
+    futs = [eng.submit(data=rng.normal(size=(1, D)).astype(np.float32))
+            for _ in range(11)]
+    eng.close()
+    for f in futs:
+        assert f.result(timeout=60)[0].shape == (1, C)
+    st = eng.stats()
+    assert st["resolved"] == 11 and st["queue_depth"] == 0
+    with pytest.raises(mx.MXNetError):
+        eng.submit(data=rng.normal(size=(1, D)).astype(np.float32))
+    eng.close()  # idempotent
+
+
+def test_one_compile_per_bucket_signature():
+    """The bucket cache's load-bearing property: warmup compiles each
+    bucket ONCE; steady-state traffic (two rounds) adds no programs and
+    no jit compiles — asserted via telemetry.programs()."""
+    telemetry.reset()
+    sym, params, eng = _engine(max_batch=8, max_wait_ms=5.0)
+    with eng:
+        cards = eng.program_cards()
+        assert len(cards) == len(eng.buckets) == 4
+        assert all(c["kind"] == "forward" for c in cards.values())
+        # every program BUILD records a jit_compile span — the signal
+        # that catches a steady-state recompile (the jit.compile
+        # counter only counts _GraphProgram entry-point lookups, which
+        # the engine's cached dispatch path never repeats)
+        builds0 = telemetry.span_count("jit_compile")
+        rng = np.random.RandomState(9)
+        for _ in range(2):
+            futs = [eng.submit(
+                data=rng.normal(size=(1, D)).astype(np.float32))
+                for _ in range(12)]
+            for f in futs:
+                f.result(timeout=60)
+        cards = eng.program_cards()
+        assert len(cards) == 4, "steady-state traffic grew the cache"
+        assert telemetry.span_count("jit_compile") == builds0
+        # planned bucket compiles are not recompile storms
+        assert not any(k.startswith("recompile.")
+                       for k in telemetry.counters())
+        # dispatch accounting: every launch bumped its bucket's card
+        assert sum(c["dispatches"] for c in cards.values()) >= \
+            4 + eng.stats()["batches"]   # warmup + traffic
+
+
+def test_serving_telemetry_counters_and_spans():
+    """snapshot() carries the serving story: request/batch counters,
+    pad accounting and the serve_* span percentiles."""
+    telemetry.reset()
+    sym, params, eng = _engine(max_batch=4, max_wait_ms=10_000.0)
+    rng = np.random.RandomState(10)
+    with eng:
+        fut = eng.submit(data=rng.normal(size=(3, D)).astype(np.float32))
+        eng.flush()
+        fut.result(timeout=60)
+    snap = telemetry.snapshot()
+    c = snap["counters"]
+    assert c["serving.requests"] == 1 and c["serving.resolved"] == 1
+    assert c["serving.batches"] == 1
+    assert c["serving.batch_rows"] == 3 and c["serving.pad_rows"] == 1
+    assert c["serving.pad_bytes"] == D * 4
+    assert c["dispatch.serve"] == 1
+    for name in ("serve_wait", "serve_batch", "serve_d2h", "serve_request"):
+        assert snap["spans"][name]["count"] >= 1, name
+        assert snap["spans"][name]["p95_ms"] >= 0.0
+    st = eng.stats()
+    assert st["latency_ms"]["p95_ms"] is not None
+
+
+def test_request_validation():
+    sym, params, eng = _engine(max_batch=4)
+    rng = np.random.RandomState(11)
+    with eng:
+        with pytest.raises(mx.MXNetError, match="max_batch"):
+            eng.submit(data=rng.normal(size=(5, D)).astype(np.float32))
+        with pytest.raises(mx.MXNetError, match="shape"):
+            eng.submit(data=rng.normal(size=(1, D + 1)).astype(np.float32))
+        with pytest.raises(mx.MXNetError, match="inputs"):
+            eng.submit(bogus=rng.normal(size=(1, D)).astype(np.float32))
+        # a bare row without the batch dim is accepted as rows=1, and a
+        # single-input graph takes one positional array
+        out = eng.predict(np.zeros((D,), np.float32))
+        assert out[0].shape == (1, C)
+
+
+def test_predictor_engine_share_one_program_cache():
+    """Predictor.engine(): the engine and the predictor dispatch through
+    ONE _GraphProgram — a predictor forward at a bucket shape is a cache
+    hit for the engine and vice versa."""
+    telemetry.reset()
+    sym = _mlp()
+    params = _params(sym)
+    pred = Predictor(sym, params, {"data": (4, D)})
+    rng = np.random.RandomState(12)
+    x4 = rng.normal(size=(4, D)).astype(np.float32)
+    pred.forward(data=x4)                     # compiles signature (4, D)
+    eng = pred.engine(max_batch=8, max_wait_ms=500.0)
+    with eng:
+        cards = eng.program_cards()
+        # buckets 1/2/8 compiled fresh; bucket 4 reused the predictor's
+        # program — 4 signatures total, not 5
+        assert len(cards) == 4
+        futs = [eng.submit(data=x4[i:i + 1]) for i in range(4)]
+        ref = pred.get_output(0).asnumpy()
+        for i, f in enumerate(futs):
+            np.testing.assert_array_equal(f.result(timeout=60)[0],
+                                          ref[i:i + 1])
+
+
+def test_telemetry_logger_serving(caplog):
+    """A running engine with telemetry_logger= logs queue depth, fill
+    and the request p95 periodically."""
+    telemetry.reset()
+    logger = mx.callback.TelemetryLogger(frequent=1)
+    sym, params, eng = _engine(max_batch=4, max_wait_ms=20.0,
+                               telemetry_logger=logger)
+    rng = np.random.RandomState(13)
+    with caplog.at_level(logging.INFO, logger="mxnet_tpu.telemetry"):
+        with eng:
+            for _ in range(3):
+                futs = [eng.submit(
+                    data=rng.normal(size=(1, D)).astype(np.float32))
+                    for _ in range(4)]
+                for f in futs:
+                    f.result(timeout=60)
+    lines = [r.message for r in caplog.records
+             if r.message.startswith("serving:")]
+    assert lines, "engine logged no serving lines"
+    assert any("queue_depth=" in ln for ln in lines)
+    assert any("p50/p95/p99=" in ln for ln in lines)
+    assert any("batch_fill=" in ln for ln in lines)

@@ -1,0 +1,57 @@
+"""Tier-1 smoke lane for the serving path.
+
+Runs ``tools/serve_probe.py --serve-smoke`` (CPU backend, tiny MLP,
+256 one-row requests) as a subprocess and pins the ISSUE 5 acceptance
+numbers:
+
+- the micro-batched ``serving.InferenceEngine`` sustains >= 3x the
+  throughput of the one-request-at-a-time ``Predictor.forward`` loop at
+  max_batch >= 8;
+- EXACTLY one compiled program per bucket signature (the probe asserts
+  it via ``telemetry.programs()``) and zero compiles inside the timed
+  steady-state window;
+- request p95 latency lands in the JSON artifact.
+
+The probe's JSON banks as an artifact (``$MXTPU_ARTIFACT_DIR/
+serve_smoke.json``, default /tmp/mxtpu_artifacts) so the serving
+trajectory is recorded every round even when the TPU tunnel is down.
+"""
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_probe(art):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)   # single-device lane
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "serve_probe.py"),
+         "--serve-smoke", "--json-out", art],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, timeout=420, env=env, cwd=ROOT)
+    assert proc.returncode == 0, proc.stdout[-2000:]
+    with open(art) as f:
+        return json.loads(f.read())
+
+
+def test_serve_smoke_lane():
+    art_dir = os.environ.get("MXTPU_ARTIFACT_DIR", "/tmp/mxtpu_artifacts")
+    os.makedirs(art_dir, exist_ok=True)
+    art = os.path.join(art_dir, "serve_smoke.json")
+    try:
+        out = _run_probe(art)
+    except AssertionError:
+        out = _run_probe(art)   # one retry under CI timing noise
+    assert out["lane"] == "serve_smoke"
+    assert out["gates_passed"] is True, out
+    assert out["max_batch"] >= 8
+    # deterministic guards (no timing): one compile per bucket, none in
+    # the steady-state window, and the latency percentiles are banked
+    assert out["compiles_per_bucket"] == 1.0, out
+    assert out["telemetry"]["jit_compiles"] == 0, out
+    assert out["latency_ms"]["p95_ms"] is not None
+    assert out["batched_req_s"] > 0 and out["unbatched_req_s"] > 0
+    assert out["serve_speedup"] >= 3.0, out

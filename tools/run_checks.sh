@@ -17,11 +17,13 @@ if grep -rn "dispatch_hook(" --include='*.py' mxnet_tpu tools bench.py \
 fi
 
 echo "== instrumented-jit lint"
-# every executor/module jitted program must compile through the
+# every executor/module/serving jitted program must compile through the
 # instrumented wrapper (_InstrumentedProgram: explicit lower().compile(),
 # program card, recompile-cause diagnosis, OOM enrichment) — a raw
 # jax.jit( in these layers would dodge every program-card guarantee
-if grep -n "jax\.jit(" mxnet_tpu/executor.py mxnet_tpu/module/*.py \
+# (and, on the serving path, the one-compile-per-bucket accounting)
+if grep -n "jax\.jit(" mxnet_tpu/executor.py mxnet_tpu/predictor.py \
+        mxnet_tpu/serving.py mxnet_tpu/module/*.py \
         | grep -v "the ONE instrumented jit site"; then
   echo "FAIL: raw jax.jit( call outside the executor's instrumented"
   echo "      wrapper — route programs through _InstrumentedProgram"

@@ -1,0 +1,181 @@
+#!/usr/bin/env python3
+"""Serving-path probe: the micro-batching engine vs the one-request-
+at-a-time Predictor facade.
+
+Serve-smoke lane:   python tools/serve_probe.py --serve-smoke \
+                        [--json-out PATH]
+  (tier-1 CI: tiny-MLP on the CPU backend — the batched
+  ``serving.InferenceEngine`` vs a sequential ``Predictor.forward``
+  loop, interleaved best-of timing. Gates: batched sustained
+  throughput >= 3x unbatched at max_batch >= 8, and EXACTLY one
+  compile per bucket signature via ``telemetry.programs()``. The JSON
+  artifact banks both throughputs, the request p50/p95/p99 and the
+  per-bucket program cards every round.)
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.predictor import Predictor
+from mxnet_tpu.serving import InferenceEngine
+
+D, C, HID = 16, 4, 64
+N_REQ = 256
+MAX_BATCH = 16
+ROUNDS = 5
+SPEEDUP_GATE = 3.0
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=HID, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=C, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _params(symbol):
+    rng = np.random.RandomState(0)
+    shapes, _, _ = symbol.infer_shape_partial(data=(2, D))
+    return {"arg:" + n: mx.nd.array(rng.normal(0, 0.1, s)
+                                    .astype(np.float32))
+            for n, s in zip(symbol.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+
+
+def serve_smoke(json_out=None, n_req=N_REQ, rounds=ROUNDS):
+    sym = _mlp()
+    params = _params(sym)
+    rng = np.random.RandomState(1)
+    reqs = [rng.normal(size=(1, D)).astype(np.float32)
+            for _ in range(n_req)]
+
+    pred = Predictor(sym, params, {"data": (1, D)})
+    pred.forward(data=reqs[0])        # compile the unbatched signature
+    pred.get_output(0).asnumpy()
+    engine = InferenceEngine(sym, params, {"data": (1, D)},
+                             max_batch=MAX_BATCH, max_wait_ms=1.0,
+                             max_inflight=4)
+    # the bucket cache as warmup built it — captured BEFORE the timed
+    # windows (each window telemetry.reset() clears the registry; cards
+    # re-register on dispatch, so the post-traffic registry only shows
+    # the buckets the last window happened to use)
+    cards = engine.program_cards()
+
+    def unbatched_epoch():
+        t0 = time.perf_counter()
+        for x in reqs:
+            pred.forward(data=x)
+            pred.get_output(0).asnumpy()
+        return time.perf_counter() - t0
+
+    def batched_epoch():
+        t0 = time.perf_counter()
+        futs = [engine.submit(data=x) for x in reqs]
+        for f in futs:
+            f.result(timeout=300)
+        return time.perf_counter() - t0
+
+    # interleaved best-of (the module_fit_probe timing discipline:
+    # back-to-back legs keep the RATIO honest under CI share drift; the
+    # min converges on the dispatch floor under spike noise)
+    dt_un = dt_b = float("inf")
+    batched_window = {}
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    try:
+        for _ in range(rounds):
+            dt_un = min(dt_un, unbatched_epoch())
+            telemetry.reset()
+            dt = batched_epoch()
+            if dt <= dt_b:
+                dt_b = dt
+                snap = telemetry.snapshot()
+                batched_window = {
+                    "counters": {k: v for k, v in snap["counters"].items()
+                                 if k.startswith(("serving.",
+                                                  "dispatch."))},
+                    "spans": {k: v for k, v in snap["spans"].items()
+                              if k in telemetry.SERVE_SPANS},
+                    # _InstrumentedProgram._build times every program
+                    # build as a jit_compile span — the engine dispatch
+                    # path never touches the jit.compile COUNTER (that
+                    # counts _GraphProgram entry-point lookups), so the
+                    # span count is the one signal that catches a
+                    # per-batch recompile inside the timed window
+                    "jit_compiles": snap["spans"].get(
+                        "jit_compile", {}).get("count", 0),
+                }
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+
+    lat = batched_window.get("spans", {}).get("serve_request", {})
+    out = {
+        "lane": "serve_smoke",
+        "platform": jax.devices()[0].platform,
+        "n_requests": n_req,
+        "max_batch": MAX_BATCH,
+        "buckets": engine.buckets,
+        "unbatched_req_s": round(n_req / dt_un, 1),
+        "batched_req_s": round(n_req / dt_b, 1),
+        "serve_speedup": round(dt_un / dt_b, 2),
+        "latency_ms": {k: lat.get(k)
+                       for k in ("p50_ms", "p95_ms", "p99_ms")},
+        "batch_fill": engine.stats()["batch_fill"],
+        "telemetry": batched_window,
+        "program_cards": {
+            k: {kk: c.get(kk) for kk in
+                ("kind", "signature", "flops", "peak_bytes",
+                 "compile_ms", "dispatches")}
+            for k, c in cards.items()},
+        "compiles_per_bucket": round(len(cards) / len(engine.buckets), 2),
+    }
+    engine.close()
+    # the serving acceptance gates (ISSUE 5): exactly one compiled
+    # program per bucket signature, ZERO compiles inside the timed
+    # steady-state window (every dispatch a cache hit), and sustained
+    # batched throughput >= SPEEDUP_GATE x the sequential Predictor loop
+    try:
+        assert len(cards) == len(engine.buckets), \
+            ("compiles != buckets", sorted(cards), engine.buckets)
+        assert batched_window.get("jit_compiles", -1) == 0, batched_window
+        assert out["serve_speedup"] >= SPEEDUP_GATE, out["serve_speedup"]
+        out["gates_passed"] = True
+    except AssertionError:
+        out["gates_passed"] = False
+        raise
+    finally:
+        line = json.dumps(out)
+        print(line, flush=True)
+        if json_out:
+            with open(json_out, "w") as f:
+                f.write(line + "\n")
+    return out
+
+
+def _json_out_arg():
+    if "--json-out" not in sys.argv:
+        return None
+    i = sys.argv.index("--json-out") + 1
+    if i >= len(sys.argv) or sys.argv[i].startswith("--"):
+        raise SystemExit("--json-out: missing output path")
+    return sys.argv[i]
+
+
+if __name__ == "__main__":
+    if "--serve-smoke" in sys.argv:
+        serve_smoke(json_out=_json_out_arg())
+    else:
+        raise SystemExit("usage: serve_probe.py --serve-smoke "
+                         "[--json-out PATH]")
