@@ -79,6 +79,10 @@ SERVE_TIMEOUT = 420    # the optional serving sweep (bucketed engine vs
                        # sequential Predictor + open-loop offered-load
                        # ladder); partial emission per load point
 TOTAL_DEADLINE = float(os.environ.get("MXTPU_BENCH_DEADLINE", "1500"))
+# consecutive failed/timed-out probes before the supervisor stops
+# burning budget on a dead tunnel and emits the diagnostic immediately
+# (r03-r05 spent 10+ probes rediscovering the same outage)
+PROBE_FAIL_LIMIT = int(os.environ.get("MXTPU_BENCH_PROBE_FAILS", "3"))
 
 
 def _apply_budget_args(argv):
@@ -674,10 +678,18 @@ def serve_child():
     print(json.dumps(dict(out, partial=True)), flush=True)
 
     # leg 2: burst capacity through the bucketed engine (all buckets
-    # AOT-compiled at construction — exactly one program per signature)
+    # AOT-compiled at construction — exactly one program per signature;
+    # with the persisted compile cache populated from a prior round,
+    # construction deserializes instead of invoking XLA — the startup
+    # wall and compile-cache counters bank the cold-vs-warm trajectory)
+    t_eng = time.perf_counter()
     engine = InferenceEngine(sym, params, {"data": (1,) + row},
                              max_batch=max_batch, max_wait_ms=2.0,
                              max_inflight=4)
+    out["engine_startup_s"] = round(time.perf_counter() - t_eng, 3)
+    out["compile_cache"] = {
+        k: v for k, v in telemetry.counters().items()
+        if k.startswith("compile_cache.")}
     cards = engine.program_cards()
     out["buckets"] = engine.buckets
     out["program_cards"] = {
@@ -748,7 +760,18 @@ def serve_child():
         }
         print(json.dumps(dict(out, partial=True)), flush=True)
     out["telemetry"] = _telemetry_summary()
-    engine.close()
+    engine.close()        # appends the corpus record when one is configured
+    # the corpus-fed autotuner's plan for this round's traffic — what
+    # the NEXT round's engine would pick instead of pow-2 buckets
+    try:
+        from mxnet_tpu import compile_cache
+        from mxnet_tpu.tuner import plan_serving
+        out["autotune_plan"] = plan_serving(
+            compile_cache.corpus_records(kind="serving"),
+            max_batch=max_batch)
+    except Exception as e:
+        print("bench: autotune plan unavailable: %s" % e, file=sys.stderr)
+        out["autotune_plan"] = None
     print(json.dumps(out), flush=True)
 
 
@@ -780,6 +803,24 @@ def _last_json_line(text):
     return None
 
 
+def _phase_cache_env():
+    """Persisted compile cache for the executor-path children (module/
+    dp/serve): one dir under the artifact tree keeps it across rounds
+    on one box, so later rounds deserialize instead of re-invoking
+    XLA. Returned as CHILD env only — supervise() must not mutate its
+    own process env (the harness tests run supervise in-process, and
+    an inherited cache would leak into every later in-process test)."""
+    if os.environ.get("MXNET_COMPILE_CACHE"):
+        return {}
+    art_dir = os.environ.get("MXTPU_ARTIFACT_DIR", "/tmp/mxtpu_artifacts")
+    # uid-scoped: cache entries are pickles, and the default artifact
+    # tree lives under world-writable /tmp — a predictable shared path
+    # would let another local user plant deserialization payloads
+    # (compile_cache additionally refuses untrusted dirs at load)
+    return {"MXNET_COMPILE_CACHE": os.path.join(
+        art_dir, "compile_cache-uid%d" % os.getuid())}
+
+
 def _run_phase(mode, timeout, env_extra=None):
     """Run one child phase; return (parsed_json_or_None, timed_out)."""
     env = None
@@ -807,9 +848,14 @@ def supervise():
     that hangs says nothing about the NEXT process, so the supervisor
     probes cheaply (~75s child) in a loop for as long as the budget
     allows and launches the expensive raw child only after a probe
-    succeeds. A raw child that then fails sends us back to probing.
-    Whatever happens, exactly one final JSON line is printed — the
-    measurement, or an {"error": ...} diagnostic the driver can record.
+    succeeds — but PROBE_FAIL_LIMIT consecutive dead probes mark the
+    tunnel down for the round and the diagnostic is emitted
+    immediately instead of burning the whole deadline rediscovering it
+    (r03-r05 spent 10+ probes that way). A raw child that then fails
+    sends us back to probing. Whatever happens, exactly one final JSON
+    line is printed — the measurement, or an {"error": ...} diagnostic
+    the driver can record — and the cold-start seconds of every probe
+    attempt ride in it either way.
     """
     t0 = time.monotonic()
 
@@ -830,17 +876,32 @@ def supervise():
         return 1
 
     out = None
-    probes = fails = 0
+    probes = fails = consec_probe_fails = 0
+    probe_aborted = False
     probe_info = None
+    probe_seconds = []       # cold-start wall per probe attempt
     while out is None and remaining() > PROBE_TIMEOUT:
+        t_probe = time.monotonic()
         info, timed_out = _run_phase("--probe", phase_budget(PROBE_TIMEOUT))
         probes += 1
+        probe_seconds.append(round(time.monotonic() - t_probe, 1))
         if not info:
+            consec_probe_fails += 1
             print("bench: probe %d %s (%.0fs left)" %
                   (probes, "timed out" if timed_out else "failed",
                    remaining()), file=sys.stderr, flush=True)
+            if consec_probe_fails >= PROBE_FAIL_LIMIT:
+                # dead tunnel: every further probe would rediscover the
+                # same outage — emit the partial diagnostic NOW and
+                # hand the unburned budget back to the driver
+                probe_aborted = True
+                print("bench: %d consecutive dead probes — marking the "
+                      "backend down for this round" % consec_probe_fails,
+                      file=sys.stderr, flush=True)
+                break
             time.sleep(min(PROBE_GAP, max(0.0, remaining() - PROBE_TIMEOUT)))
             continue
+        consec_probe_fails = 0
         probe_info = info
         print("bench: probe %d ok: %s" % (probes, json.dumps(info)),
               file=sys.stderr, flush=True)
@@ -863,6 +924,9 @@ def supervise():
     if out is None:
         if probe_info is None:
             detail = "backend never initialised in any probe child"
+            if probe_aborted:
+                detail += (" (%d consecutive dead probes; remaining "
+                           "probes skipped)" % consec_probe_fails)
         elif fails:
             detail = "raw child failed after successful probe"
         else:
@@ -874,6 +938,8 @@ def supervise():
             # (a tunnel outage must not read as a regression)
             "skipped": probe_info is None,
             "probes": probes, "probe_ok": probe_info is not None,
+            "probe_seconds": probe_seconds,
+            "probe_aborted": probe_aborted,
             "raw_fails": fails, "deadline_s": TOTAL_DEADLINE,
             "detail": detail,
         }
@@ -881,6 +947,7 @@ def supervise():
             diag["probe_device"] = probe_info
         print(json.dumps(diag))
         return 1
+    out["probe_seconds"] = probe_seconds
 
     # partial-result emission: the raw number is banked on stdout NOW —
     # if a later optional phase hangs past the driver's window, the kill
@@ -890,7 +957,8 @@ def supervise():
     if (os.environ.get("MXTPU_BENCH_MODULE", "1") == "1"
             and remaining() > 180):
         mod_out, _ = _run_phase("--module-child",
-                                phase_budget(MODULE_TIMEOUT))
+                                phase_budget(MODULE_TIMEOUT),
+                                env_extra=_phase_cache_env())
         if mod_out and "module_fit_img_s" in mod_out:
             out.update((k, v) for k, v in mod_out.items()
                        if k.startswith("module_fit"))
@@ -903,7 +971,8 @@ def supervise():
     # size) — optional like the module phase, banked as partials
     if (os.environ.get("MXTPU_BENCH_DP", "1") == "1"
             and remaining() > 180):
-        dp_out, _ = _run_phase("--dp-child", phase_budget(DP_TIMEOUT))
+        dp_out, _ = _run_phase("--dp-child", phase_budget(DP_TIMEOUT),
+                               env_extra=_phase_cache_env())
         if dp_out and dp_out.get("dp"):
             out["dp"] = dp_out["dp"]
             out["dp_per_chip_batch"] = dp_out.get("per_chip_batch", BATCH)
@@ -917,7 +986,8 @@ def supervise():
     # banked as partials like the module/dp phases
     if (os.environ.get("MXTPU_BENCH_SERVE", "1") == "1"
             and remaining() > 120):
-        sv_out, _ = _run_phase("--serve-child", phase_budget(SERVE_TIMEOUT))
+        sv_out, _ = _run_phase("--serve-child", phase_budget(SERVE_TIMEOUT),
+                               env_extra=_phase_cache_env())
         if sv_out and sv_out.get("lane") == "serving":
             out["serving"] = {k: v for k, v in sv_out.items()
                               if k not in ("lane", "partial")}

@@ -262,10 +262,11 @@ class _InstrumentedProgram:
     """
 
     __slots__ = ("kind", "entry", "argnames", "_jitted", "_donate",
-                 "_cache", "_card", "_meta", "warn_recompile")
+                 "_cache", "_card", "_meta", "_graph_key",
+                 "warn_recompile")
 
     def __init__(self, kind, fn, jit_kwargs=None, argnames=None,
-                 meta=None):
+                 meta=None, graph_key=None):
         self.kind = kind
         self.entry = "%s@p%d" % (kind, next(_PROG_SEQ))
         self.argnames = argnames or ()
@@ -275,6 +276,14 @@ class _InstrumentedProgram:
         self._cache = {}    # dispatch sig -> [callable, card, aot_bool]
         self._card = None   # last-compiled card: the recompile-diff base
         self._meta = dict(meta or {})
+        # JSON-safe fingerprint of everything the traced graph depends
+        # on besides the arguments (the owner's symbol hash + entry-
+        # point statics): enables the persisted cache's TRACE-SKIP tier
+        # (compile_cache.quick_key). None = content-key tier only. A
+        # CALLABLE defers the (symbol-JSON hashing) work until the
+        # first build WITH the cache enabled — programs in cache-less
+        # processes must not pay for fingerprints nobody reads.
+        self._graph_key = graph_key
         # deliberate multi-signature callers (the serving engine compiles
         # one program per batch bucket BY DESIGN) flip this off so their
         # planned compiles don't read as recompile storms in the log and
@@ -358,22 +367,73 @@ class _InstrumentedProgram:
         """Cache miss: explicit lower().compile(), card capture,
         recompile diagnosis. AOT failures (backend quirks) degrade to
         the plain jitted callable with a card whose analysis fields
-        stay None — dispatch must never break on introspection."""
+        stay None — dispatch must never break on introspection.
+
+        With the persisted tier on (``MXNET_COMPILE_CACHE``), the
+        program is looked up in the on-disk executable store
+        (mxnet_tpu/compile_cache.py) and a hit DESERIALIZES instead of
+        invoking XLA (``jit_deserialize`` span, zero ``jit_compile``
+        spans — the warm-start contract): first via the trace-skip
+        quick key (graph fingerprint; no ``lower()`` at all), then via
+        the content key over the lowered StableHLO. A miss compiles
+        and persists the fresh executable (plus the quick-key index
+        entry) for the next process. Cache load/store failures degrade
+        inside compile_cache — only lower()/compile() errors reach the
+        AOT-fallback path here."""
+        from . import compile_cache
         card_sig = self._signature_cards(args)
         entry_id = "%s/s%d" % (self.entry, len(self._cache))
         aot = True
         compiled = None
+        source = "compiled"
+        cc_on = compile_cache.enabled() \
+            and compile_cache.persistable(self._donate)
+        qkey = None
+        if cc_on:
+            if callable(self._graph_key):
+                self._graph_key = self._graph_key()
+            qkey = compile_cache.quick_key(
+                self.kind, self._graph_key, signature=card_sig,
+                donated=self._donate)
+        trace_ms = compile_ms = deser_ms = 0.0
         t0 = time.perf_counter()
         try:
-            with telemetry.span("jit_trace"):
-                lowered = self._jitted.lower(*args)
-            t1 = time.perf_counter()
-            with telemetry.span("jit_compile"):
-                compiled = lowered.compile()
-            t2 = time.perf_counter()
+            if qkey is not None:
+                ikey = compile_cache.index_get(qkey)
+                if ikey is not None:
+                    compiled = compile_cache.load(ikey, kind=self.kind)
+                    if compiled is not None:
+                        source = "disk_cache"   # no trace ran at all
+                        deser_ms = (time.perf_counter() - t0) * 1e3
+            if compiled is None:
+                with telemetry.span("jit_trace"):
+                    lowered = self._jitted.lower(*args)
+                trace_ms = (time.perf_counter() - t0) * 1e3
+                ckey = None
+                if cc_on:
+                    ckey = compile_cache.lowered_key(
+                        self.kind, lowered, signature=card_sig,
+                        donated=self._donate)
+                    if ckey is not None:
+                        t1 = time.perf_counter()
+                        compiled = compile_cache.load(ckey, kind=self.kind)
+                        if compiled is not None:
+                            source = "disk_cache"
+                            deser_ms = (time.perf_counter() - t1) * 1e3
+                            compile_cache.index_put(qkey, ckey)
+                if compiled is None:
+                    t1 = time.perf_counter()
+                    with telemetry.span("jit_compile"):
+                        compiled = lowered.compile()
+                    compile_ms = (time.perf_counter() - t1) * 1e3
+                    if ckey is not None:
+                        compile_cache.store(ckey, compiled,
+                                            kind=self.kind,
+                                            entry=entry_id,
+                                            signature=card_sig)
+                        compile_cache.index_put(qkey, ckey)
         except Exception as e:
             aot = False
-            t1 = t2 = time.perf_counter()
             aot_err = "%s: %s" % (type(e).__name__, e)
         if aot:
             card = card_from_compiled(
@@ -384,8 +444,13 @@ class _InstrumentedProgram:
                 self.kind, _NoAnalysis(), entry=entry_id,
                 signature=card_sig, donated=self._donate,
                 extra=dict(self._meta, aot_fallback=aot_err))
-        card["trace_ms"] = round((t1 - t0) * 1e3, 3)
-        card["compile_ms"] = round((t2 - t1) * 1e3, 3)
+        card["trace_ms"] = round(trace_ms, 3)
+        card["compile_ms"] = round(compile_ms, 3)
+        card["source"] = source
+        if source == "disk_cache":
+            # the XLA compile never ran (compile_ms stays 0): the
+            # disk-load cost is its own figure
+            card["deserialize_ms"] = round(deser_ms, 3)
         if self._card is not None and self.warn_recompile:
             self._warn_recompile(card)
         self._card = card
@@ -399,6 +464,16 @@ class _InstrumentedProgram:
         for HLO inspection (tests, tuners) see the same program the
         wrapper would compile."""
         return self._jitted.lower(*args)
+
+    def build(self, *args):
+        """Ensure this signature's executable exists (disk-cache load
+        or fresh compile + card) WITHOUT dispatching it — the warmup
+        path: an engine pre-building its bucket programs should not pay
+        one execution per bucket just to force the compiles."""
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        sig = (treedef, tuple(_leaf_key(l) for l in leaves))
+        if sig not in self._cache:
+            self._build(sig, args)
 
     # -- dispatch ----------------------------------------------------------
     def _invoke(self, fn, args):
@@ -522,6 +597,50 @@ class _GraphProgram:
                     if child.op is None and \
                             id(child) not in self.node_devices:
                         self.node_devices[id(child)] = ndev
+
+    def graph_fingerprint(self):
+        """JSON-safe fingerprint of this program's GRAPH content for
+        the persisted compile cache's trace-skip tier: the symbol's
+        JSON plus the ambient layout default (ops consult it at trace
+        time). Everything else trace-time-relevant (op source code,
+        MXNET_* knobs, backend identity, the abstract signature) is
+        folded in by ``compile_cache.quick_key`` itself. None (tier
+        disabled) for grouped programs and symbols that cannot
+        serialize."""
+        cached = self.__dict__.get("_graph_fp", False)
+        if cached is not False:
+            return cached
+        fp = None
+        if not self.node_devices:
+            try:
+                import hashlib
+                from . import layout
+                js = self.symbol.tojson()
+                fp = [hashlib.sha256(js.encode()).hexdigest(),
+                      layout.get_default_layout()]
+            except Exception:
+                fp = None
+        self.__dict__["_graph_fp"] = fp
+        return fp
+
+    def _entry_graph_key(self, *statics):
+        """Graph key for one jitted entry point: the graph fingerprint
+        plus the entry's own statics (train flag, grad names, ...),
+        deep-normalised to JSON-safe values. Non-primitive statics fall
+        back to repr — a repr that varies per process (object
+        addresses) degrades to a quick-tier miss, never a false hit
+        (the content key still matches after the trace)."""
+        fp = self.graph_fingerprint()
+        if fp is None:
+            return None
+
+        def norm(s):
+            if isinstance(s, (str, int, float, bool, type(None))):
+                return s
+            if isinstance(s, (tuple, list)):
+                return [norm(x) for x in s]
+            return repr(s)
+        return [fp] + [norm(s) for s in statics]
 
     @property
     def uses_rng(self):
@@ -690,9 +809,12 @@ class _GraphProgram:
             # grouped programs pin ops to concrete devices — eager
             # execution (per-op dispatch), not one jitted program
             self._jit_cache[key] = fn if self.node_devices else \
-                _InstrumentedProgram("forward", fn,
-                                     argnames=("args", "aux", "rng"),
-                                     meta={"train": bool(train)})
+                _InstrumentedProgram(
+                    "forward", fn,
+                    argnames=("args", "aux", "rng"),
+                    meta={"train": bool(train)},
+                    graph_key=lambda: self._entry_graph_key(
+                        "fwd", bool(train)))
         return self._jit_cache[key]
 
     def _vjp_over_graph(self, grad_args, rest, aux, rng, train):
@@ -745,7 +867,9 @@ class _GraphProgram:
                 _InstrumentedProgram(
                     "fwd_bwd", fn,
                     argnames=("args", "aux", "rng", "head_grads"),
-                    meta={"train": bool(train)})
+                    meta={"train": bool(train)},
+                    graph_key=lambda: self._entry_graph_key(
+                        "fwdbwd", bool(train), tuple(grad_names)))
         return self._jit_cache[key]
 
     def train_step_fn(self, update_names, add_names, input_dtypes, cache_key,
@@ -835,11 +959,22 @@ class _GraphProgram:
 
         step_argnames = ("params", "opt_states", "metric_acc", "aux",
                          "inputs", "rng", "lrs", "wds", "ts", "add_grads")
+        # cache_key captures the optimizer/metric closure statics — its
+        # repr rides in the graph key (a per-process repr degrades to a
+        # quick-tier miss, never a false hit)
+        def step_graph_key():
+            return self._entry_graph_key(
+                "train_step", tuple(update_names),
+                tuple(sorted(add_names)),
+                tuple("%s=%s" % (k, v) for k, v in
+                      sorted(input_dtypes.items())), cache_key,
+                None if spmd is None else spmd.num_devices)
         if spmd is None:
             fn = _InstrumentedProgram(
                 "train_step", step,
                 jit_kwargs={"donate_argnums": (0, 1, 2, 3)},
-                argnames=step_argnames)
+                argnames=step_argnames,
+                graph_key=step_graph_key)
         else:
             repl, dsh = spmd.repl_sharding, spmd.data_sharding
             # args: (params, opt_states, metric_acc, aux, inputs, rng,
@@ -856,7 +991,8 @@ class _GraphProgram:
                                              repl, repl, repl, repl, repl),
                             "donate_argnums": (0, 1, 2, 3)},
                 argnames=step_argnames,
-                meta={"spmd_devices": spmd.num_devices})
+                meta={"spmd_devices": spmd.num_devices},
+                graph_key=step_graph_key)
         self._jit_cache[key] = fn
         return fn
 

@@ -41,6 +41,7 @@ resolves, so callers never see them.
 from __future__ import annotations
 
 import collections
+import contextlib
 import queue
 import threading
 import time
@@ -55,7 +56,7 @@ from . import telemetry
 from .executor import record_dispatch
 from .predictor import Predictor
 
-__all__ = ["InferenceEngine", "bucket_sizes"]
+__all__ = ["InferenceEngine", "bucket_sizes", "validate_buckets"]
 
 
 def bucket_sizes(max_batch):
@@ -70,6 +71,40 @@ def bucket_sizes(max_batch):
         b *= 2
     sizes.append(max_batch)
     return sizes
+
+
+def validate_buckets(buckets, max_batch):
+    """Normalise a custom bucket set (e.g. an autotuner plan): unique,
+    sorted, clamped to [1, max_batch], and always topped by
+    ``max_batch`` itself so a full batch never pads and every request
+    has a covering bucket."""
+    try:
+        bs = sorted({int(b) for b in buckets})
+    except (TypeError, ValueError):
+        raise MXNetError("serving: buckets must be a list of ints, got %r"
+                         % (buckets,))
+    bs = [b for b in bs if 1 <= b <= max_batch]
+    if not bs or bs[-1] != max_batch:
+        bs.append(int(max_batch))
+    return bs
+
+
+@contextlib.contextmanager
+def _quiet_recompile(fn):
+    """Suppress the instrumented wrapper's recompile-cause warning for
+    the duration of a PLANNED multi-signature compile run (warming one
+    program per bucket is a deliberate signature set, not a storm).
+    The flag is restored in a ``finally`` even when a bucket build
+    raises mid-warmup, and a forward callable WITHOUT the attribute
+    (a grouped/eager fn, or a test double) passes through untouched."""
+    prev = getattr(fn, "warn_recompile", None)
+    if prev is not None:
+        fn.warn_recompile = False
+    try:
+        yield
+    finally:
+        if prev is not None:
+            fn.warn_recompile = prev
 
 
 class _Request:
@@ -106,8 +141,10 @@ class InferenceEngine:
     max_wait_ms : float — coalescing deadline: a pending request waits
         at most this long for co-batchable traffic before a partial
         bucket is flushed
-    max_inflight : int — dispatched-but-unresolved batch bound (the
-        device-queue depth the coalescer may run ahead)
+    max_inflight : int | None — dispatched-but-unresolved batch bound
+        (the device-queue depth the coalescer may run ahead). ``None``
+        (the default) means 2, or the autotuner plan's choice when
+        ``autotune=True`` found one
     dtype : optional input dtype override (e.g. bfloat16), as for
         ``Predictor``
     warmup : bool — compile every bucket at construction (AOT); with
@@ -118,12 +155,22 @@ class InferenceEngine:
     predictor : optional existing ``Predictor`` to share programs and
         device-resident parameters with (``symbol``/``params``/
         ``input_shapes`` are then taken from it)
+    buckets : optional explicit batch-bucket list (e.g. an autotuner
+        plan's) replacing the pow-2 default; normalised through
+        ``validate_buckets`` (``max_batch`` always tops the set)
+    autotune : bool — derive ``buckets``/``max_inflight`` from the
+        persisted program-card corpus (``compile_cache.corpus_records``
+        → ``tuner.plan_serving``): measured rows-histogram and
+        per-bucket step-ms data replace the pow-2 default. Falls back
+        silently to the defaults when the corpus is absent or empty;
+        the chosen plan is stamped onto every bucket's program card
+        (``autotune_plan``) and reported by ``stats()``
     """
 
     def __init__(self, symbol=None, params=None, input_shapes=None,
-                 ctx=None, max_batch=32, max_wait_ms=2.0, max_inflight=2,
+                 ctx=None, max_batch=32, max_wait_ms=2.0, max_inflight=None,
                  dtype=None, warmup=True, telemetry_logger=None,
-                 predictor=None):
+                 predictor=None, buckets=None, autotune=False):
         if predictor is None:
             if symbol is None or input_shapes is None:
                 raise MXNetError("InferenceEngine needs (symbol, params, "
@@ -141,7 +188,19 @@ class InferenceEngine:
         self._device = self._ctx.jax_device()
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_ms) / 1e3
-        self.buckets = bucket_sizes(self.max_batch)
+        self._autotune_plan = None
+        if autotune and buckets is None:
+            plan = self._load_plan()
+            if plan and plan.get("buckets"):
+                self._autotune_plan = plan
+                buckets = plan["buckets"]
+                if max_inflight is None and plan.get("max_inflight"):
+                    max_inflight = plan["max_inflight"]
+        if max_inflight is None:
+            max_inflight = 2
+        self._max_inflight = max(1, int(max_inflight))
+        self.buckets = bucket_sizes(self.max_batch) if buckets is None \
+            else validate_buckets(buckets, self.max_batch)
         self._input_names = list(predictor._input_names)
         self._row_shapes = {n: tuple(predictor._input_shapes[n][1:])
                             for n in self._input_names}
@@ -164,11 +223,16 @@ class InferenceEngine:
         self._lock = threading.Lock()
         self._stats = collections.Counter()
         self._bucket_batches = collections.Counter()
+        # measured serving data the card corpus persists for the
+        # autotuner: coalesced-batch row counts (pre-padding) and
+        # dispatch->resolution wall-time per bucket
+        self._rows_hist = collections.Counter()
+        self._bucket_lat = {}        # bucket -> [total_seconds, count]
         self._q = queue.Queue()
-        self._inflight = threading.Semaphore(max(1, int(max_inflight)))
+        self._inflight = threading.Semaphore(self._max_inflight)
         self._closed = False
         self._pool = ThreadPoolExecutor(
-            max_workers=max(1, int(max_inflight)),
+            max_workers=self._max_inflight,
             thread_name_prefix="mxtpu-serve-resolve")
         self._thread = threading.Thread(target=self._coalesce_loop,
                                         name="mxtpu-serve-coalesce",
@@ -178,18 +242,41 @@ class InferenceEngine:
             self.warmup()
 
     # -- program cache ------------------------------------------------------
-    def warmup(self):
-        """Compile (and execute once, on zeros) every bucket's forward
-        program — after this, serving dispatches are all AOT cache hits
-        and ``program_cards()`` holds one card per bucket signature.
-        The recompile-cause warning is suppressed ONLY for the duration
-        (bucket compiles are planned signatures, not a storm); a
-        steady-state signature drift afterwards still warns, for this
-        engine and for any Predictor sharing the program."""
-        prev = getattr(self._forward, "warn_recompile", True)
-        if hasattr(self._forward, "warn_recompile"):
-            self._forward.warn_recompile = False
+    def _load_plan(self):
+        """The autotuner plan for this engine's ``max_batch`` from the
+        persisted card corpus, or None (no corpus / no serving records
+        / tuner failure — autotune must never break construction).
+        Records are filtered to THIS engine's graph fingerprint: the
+        corpus is shared per cache dir, and another model's rows
+        histogram / step-ms would plan pessimal buckets here."""
         try:
+            from . import compile_cache
+            from .tuner import plan_serving
+            records = compile_cache.corpus_records(kind="serving")
+            return plan_serving(records, max_batch=self.max_batch,
+                                graph=self._prog.graph_fingerprint())
+        except Exception as e:
+            from . import log as _log
+            _log.get_logger("mxnet_tpu.serving").warning(
+                "serving: autotune plan unavailable (%s); using pow-2 "
+                "bucket defaults", e)
+            return None
+
+    def warmup(self):
+        """Build every bucket's forward program — after this, serving
+        dispatches are all AOT cache hits and ``program_cards()`` holds
+        one card per bucket signature. Building does NOT execute when
+        the wrapper exposes ``build`` (an execution per bucket bought
+        nothing but startup wall); with the persisted compile cache on,
+        each bucket's program DESERIALIZES from disk instead of
+        invoking XLA (the zero-cold-start path). The recompile-cause
+        warning is suppressed ONLY for the duration (bucket compiles
+        are planned signatures, not a storm; restored in a finally even
+        when a bucket build raises); a steady-state signature drift
+        afterwards still warns, for this engine and for any Predictor
+        sharing the program."""
+        build = getattr(self._forward, "build", None)
+        with _quiet_recompile(self._forward):
             for b in self.buckets:
                 args = dict(self._param_raw)
                 for n in self._input_names:
@@ -197,12 +284,60 @@ class InferenceEngine:
                         np.zeros((b,) + self._row_shapes[n],
                                  self._in_dtypes[n]), self._device)
                 args.update(self._bucket_extras(b))
-                outs, _ = self._forward(args, self._aux_raw, self._rng)
-                for o in outs:
-                    o.block_until_ready()
-        finally:
-            if hasattr(self._forward, "warn_recompile"):
-                self._forward.warn_recompile = prev
+                if build is not None:
+                    build(args, self._aux_raw, self._rng)
+                else:
+                    outs, _ = self._forward(args, self._aux_raw,
+                                            self._rng)
+                    for o in outs:
+                        o.block_until_ready()
+        if self._autotune_plan is not None:
+            # stamp the plan onto every bucket card: a card reader sees
+            # WHY this bucket set exists next to what each bucket costs
+            for cid in self.program_cards():
+                telemetry.card_annotate(cid,
+                                        autotune_plan=self._autotune_plan)
+
+    def _infer_dummy_shapes(self, bucket):
+        """{arg name: inferred shape} at one batch size."""
+        known = {n: (bucket,) + self._row_shapes[n]
+                 for n in self._input_names}
+        known.update({n: tuple(v.shape)
+                      for n, v in self._param_raw.items()})
+        shapes, _, _ = self._symbol.infer_shape_partial(**known)
+        return dict(zip(self._symbol.list_arguments(), shapes))
+
+    def _extra_row_shapes(self):
+        """Per-dummy (row_shape_or_None, dtype, full_shape,
+        calibration_bucket) — the shape inference runs at most TWICE
+        (warming N buckets used to run the whole per-node walk N
+        times, a measurable slice of the cold/warm startup wall the
+        compile-cache tier exists to shrink). Batch-major detection
+        compares the smallest and largest bucket: a dummy whose
+        leading dim tracks BOTH probe sizes really scales with the
+        batch; a fixed shape that happens to equal one probe size
+        (e.g. a constant (1, K) state input at bucket 1) cannot fool
+        both, and falls back to per-bucket inference."""
+        cached = getattr(self, "_extra_rows", None)
+        if cached is not None:
+            return cached
+        b0, b1 = self.buckets[0], self.buckets[-1]
+        inf0 = self._infer_dummy_shapes(b0)
+        inf1 = self._infer_dummy_shapes(b1) if b1 != b0 else inf0
+        ex = self._predictor._executor
+        rows = {}
+        for n in self._auto_names:
+            s0, s1 = inf0.get(n), inf1.get(n)
+            if s0 is None or s1 is None:
+                raise MXNetError("serving: cannot infer dummy shape "
+                                 "for %r" % n)
+            batch_major = (b1 != b0 and len(s0) >= 1
+                           and s0[0] == b0 and s1[0] == b1
+                           and tuple(s0[1:]) == tuple(s1[1:]))
+            rows[n] = (tuple(s0[1:]) if batch_major else None,
+                       np.dtype(ex.arg_dict[n].dtype), tuple(s0), b0)
+        self._extra_rows = rows
+        return rows
 
     def _bucket_extras(self, bucket):
         """Device-resident zero dummies (softmax labels etc.) at this
@@ -212,21 +347,24 @@ class InferenceEngine:
             return cached
         extras = {}
         if self._auto_names:
-            known = {n: (bucket,) + self._row_shapes[n]
-                     for n in self._input_names}
-            known.update({n: tuple(v.shape)
-                          for n, v in self._param_raw.items()})
-            shapes, _, _ = self._symbol.infer_shape_partial(**known)
-            inferred = dict(zip(self._symbol.list_arguments(), shapes))
-            ex = self._predictor._executor
-            for n in self._auto_names:
-                shp = inferred.get(n)
-                if shp is None:
-                    raise MXNetError("serving: cannot infer dummy shape "
-                                     "for %r at bucket %d" % (n, bucket))
-                extras[n] = jax.device_put(
-                    np.zeros(shp, np.dtype(ex.arg_dict[n].dtype)),
-                    self._device)
+            reinferred = None
+            for n, (row, dt, full, cal_b) in \
+                    self._extra_row_shapes().items():
+                if row is not None:
+                    shp = (bucket,) + row
+                elif bucket == cal_b:
+                    shp = full       # the calibrated inference IS this bucket
+                else:
+                    # fixed-shape (non-batch-major) dummy: re-infer at
+                    # THIS bucket — the engine must not guess
+                    if reinferred is None:
+                        reinferred = self._infer_dummy_shapes(bucket)
+                    shp = reinferred.get(n)
+                    if shp is None:
+                        raise MXNetError("serving: cannot infer dummy "
+                                         "shape for %r at bucket %d"
+                                         % (n, bucket))
+                extras[n] = jax.device_put(np.zeros(shp, dt), self._device)
         self._extras[bucket] = extras
         return extras
 
@@ -315,6 +453,13 @@ class InferenceEngine:
         a load balancer's health endpoint would export."""
         with self._lock:
             st = dict(self._stats)
+            rows_hist = {str(k): v for k, v in
+                         sorted(self._rows_hist.items())}
+            bucket_ms = {str(b): {"count": c,
+                                  "total_ms": round(t * 1e3, 3),
+                                  "mean_ms": round(t / c * 1e3, 3)}
+                         for b, (t, c) in sorted(self._bucket_lat.items())
+                         if c}
         rows = st.get("batch_rows", 0)
         pad = st.get("pad_rows", 0)
         lat = telemetry.span_stats("serve_request").get("serve_request", {})
@@ -330,9 +475,54 @@ class InferenceEngine:
             else None,
             "buckets": {str(k): v for k, v in
                         sorted(self._bucket_batches.items())},
+            # the measured serving data the card corpus persists:
+            # coalesced row counts (pre-pad) and per-bucket step ms
+            "rows_hist": rows_hist,
+            "bucket_ms": bucket_ms,
+            "max_inflight": self._max_inflight,
+            "autotune_plan": self._autotune_plan,
             "latency_ms": {k: lat.get(k) for k in
                            ("p50_ms", "p95_ms", "p99_ms")}
             if lat else None,
+        }
+
+    def corpus_record(self):
+        """One JSON-safe record of this engine's measured serving data
+        for the persisted card corpus — the raw material
+        ``tuner.plan_serving`` turns into the next process's bucket
+        plan. None until at least one batch has dispatched (an idle
+        engine has nothing to teach the autotuner)."""
+        from . import compile_cache
+        st = self.stats()
+        if not st["batches"]:
+            return None
+        cards = {
+            k: {kk: c.get(kk) for kk in
+                ("kind", "flops", "bytes_accessed", "peak_bytes",
+                 "compile_ms", "deserialize_ms", "source", "dispatches")}
+            for k, c in self.program_cards().items()}
+        spans = {k: v for k, v in telemetry.span_stats().items()
+                 if k in telemetry.SERVE_SPANS}
+        return {
+            "kind": "serving",
+            "ts": time.time(),
+            "env": compile_cache.env_meta(),
+            # graph identity: plan_serving filters on it so a shared
+            # corpus never plans one model from another's traffic
+            "graph": self._prog.graph_fingerprint(),
+            "max_batch": self.max_batch,
+            "buckets": list(self.buckets),
+            "max_inflight": self._max_inflight,
+            "max_wait_ms": round(self.max_wait_s * 1e3, 3),
+            "requests": st["requests"],
+            "batches": st["batches"],
+            "batch_rows": st["rows"],
+            "pad_rows": st["pad_rows"],
+            "batch_fill": st["batch_fill"],
+            "rows_hist": st["rows_hist"],
+            "bucket_ms": st["bucket_ms"],
+            "spans": spans,
+            "cards": cards,
         }
 
     # -- lifecycle ----------------------------------------------------------
@@ -351,6 +541,18 @@ class InferenceEngine:
             return
         self._thread.join()
         self._pool.shutdown(wait=True)
+        # bank this engine's measured serving data into the persisted
+        # card corpus (when one is configured) so the NEXT process's
+        # autotuner plans from it — telemetry, never state: failures
+        # must not turn a clean shutdown into an error
+        try:
+            from . import compile_cache
+            if compile_cache.corpus_path() is not None:
+                rec = self.corpus_record()
+                if rec is not None:
+                    compile_cache.corpus_append(rec)
+        except Exception:
+            pass
         if self._logger is not None:
             try:
                 self._logger.log_serving(force=True)
@@ -455,11 +657,13 @@ class InferenceEngine:
                 self._stats["pad_rows"] += bucket - rows
                 self._stats["pad_bytes"] += pad_bytes
                 self._bucket_batches[bucket] += 1
+                self._rows_hist[rows] += 1
             telemetry.counter_inc("serving.batches")
             telemetry.counter_inc("serving.batch_rows", rows)
             telemetry.counter_inc("serving.pad_rows", bucket - rows)
             telemetry.counter_inc("serving.pad_bytes", pad_bytes)
-            self._pool.submit(self._resolve, outs, reqs)
+            self._pool.submit(self._resolve, outs, reqs, bucket,
+                              time.perf_counter())
         except BaseException as e:
             self._inflight.release()
             for r in reqs:
@@ -472,12 +676,21 @@ class InferenceEngine:
                 except Exception:
                     pass
 
-    def _resolve(self, outs, reqs):
+    def _resolve(self, outs, reqs, bucket=None, t_disp=None):
         """Resolver-pool worker: blocking d2h of the whole padded batch,
-        then slice each request's rows off and resolve its future."""
+        then slice each request's rows off and resolve its future.
+        The dispatch->fetched wall-time charges the bucket's measured
+        step-ms tally — the corpus figure the autotuner's cost model
+        interpolates over."""
         try:
             with telemetry.span("serve_d2h"):
                 host = [np.asarray(o) for o in outs]
+            if bucket is not None and t_disp is not None:
+                dt = time.perf_counter() - t_disp
+                with self._lock:
+                    lat = self._bucket_lat.setdefault(bucket, [0.0, 0])
+                    lat[0] += dt
+                    lat[1] += 1
             off = 0
             for r in reqs:
                 sl = [h[off:off + r.rows] for h in host]

@@ -62,8 +62,9 @@ __all__ = [
     "record_jit", "record_fallback", "record_transfer",
     "record_host_sync", "chrome_events", "mark_trace_start",
     "record_program", "program_dispatch", "programs", "card_update",
+    "card_annotate",
     "set_peak_flops", "ledger_track", "ledger", "ledger_top",
-    "SPAN_RING_SIZE", "FIT_PHASE_SPANS", "SERVE_SPANS",
+    "SPAN_RING_SIZE", "FIT_PHASE_SPANS", "SERVE_SPANS", "COMPILE_SPANS",
     "MAX_PROGRAM_CARDS",
 ]
 
@@ -87,6 +88,13 @@ FIT_PHASE_SPANS = ("fit_batch", "feed", "step", "shard_put",
 # and the whole submit->resolve request latency whose p50/p95/p99 the
 # serving artifacts and TelemetryLogger report
 SERVE_SPANS = ("serve_wait", "serve_batch", "serve_d2h", "serve_request")
+
+# the program-build span names (executor._InstrumentedProgram /
+# compile_cache): tracing, an actual XLA compile, and a disk-cache
+# deserialize. The warm-start lanes gate on the compile-vs-deserialize
+# split — a warm process serving every bucket must record ZERO
+# jit_compile spans and >= one jit_deserialize per program
+COMPILE_SPANS = ("jit_trace", "jit_compile", "jit_deserialize")
 
 # program-card registry bound: recompile storms must not grow the
 # registry without limit — the oldest card is dropped (its FLOPs x
@@ -479,6 +487,18 @@ def card_update(card, **fields):
         card.update(fields)
 
 
+def card_annotate(card_id, **fields):
+    """Annotate a REGISTERED card by id (callers that only hold the
+    ``programs()`` copy, e.g. the serving autotuner stamping its chosen
+    plan onto the bucket cards). Returns True when the card exists."""
+    with _lock:
+        card = _programs.get(card_id)
+        if card is None:
+            return False
+        card.update(fields)
+        return True
+
+
 def programs():
     """{card_id: card} copy of the program-card registry (private
     bookkeeping keys stripped — the result is JSON-serializable). The
@@ -501,12 +521,15 @@ def _online_stats():
             for c in _programs.values())
         step_s = _span_seconds.get("step", 0.0)
         compile_s = _span_seconds.get("jit_compile", 0.0)
+        deser_s = _span_seconds.get("jit_deserialize", 0.0)
     out = {
         "flops_dispatched": flops,
         "step_time_s": round(step_s, 6),
         # first-launch compiles happen INSIDE the step span; reported so
         # readers can judge how much of the window was warmup
         "compile_time_s": round(compile_s, 6),
+        # disk-cache loads (compile_cache) — the warm-start counterpart
+        "deserialize_time_s": round(deser_s, 6),
         "model_flops_per_s": round(flops / step_s, 3) if step_s else None,
         "peak_flops": _peak_flops,
         # unrounded: a CPU-smoke MFU is ~1e-6 and must not read as 0.0

@@ -518,3 +518,47 @@ def test_module_child_marks_silent_fallback(monkeypatch):
         bench.module_child()
     lines = [json.loads(l) for l in buf.getvalue().splitlines()]
     assert "module_fit_fused_fallback" not in lines[-1]
+
+
+def test_supervise_aborts_after_consecutive_dead_probes(monkeypatch):
+    """ISSUE 6: r03-r05 burned 10+ probes rediscovering the same dead
+    tunnel. After PROBE_FAIL_LIMIT consecutive failures the supervisor
+    must stop probing IMMEDIATELY (despite budget remaining) and emit
+    the diagnostic, with the cold-start seconds of every attempt
+    recorded."""
+    import time as _time
+
+    def failing_probe(n):
+        _time.sleep(0.05)
+        return None, True
+
+    monkeypatch.setattr(bench, "PROBE_FAIL_LIMIT", 3)
+    rc, calls, out = _patched_supervise(
+        monkeypatch, {"--probe": failing_probe}, deadline=600.0)
+    assert rc == 1
+    # the loop stopped at the limit, not at the (10-minute) deadline
+    assert calls.count("--probe") == 3
+    assert out["probe_aborted"] is True
+    assert out["skipped"] is True
+    assert len(out["probe_seconds"]) == 3
+    assert all(s >= 0 for s in out["probe_seconds"])
+
+
+def test_supervise_probe_fail_counter_resets_on_success(monkeypatch):
+    """Two dead probes, a good one, then the raw child measures: the
+    consecutive-failure counter resets on success so a flaky (but
+    live) tunnel is NOT declared down, and the probe cold-start
+    seconds ride in the successful JSON too."""
+    meas = {"value": 55.0, "unit": "img/s"}
+    monkeypatch.setenv("MXTPU_BENCH_MODULE", "0")
+    monkeypatch.setattr(bench, "PROBE_FAIL_LIMIT", 3)
+    rc, calls, out = _patched_supervise(
+        monkeypatch,
+        {"--probe": lambda n: ((None, True) if n <= 2
+                               else ({"device": "x"}, False)),
+         "--child": lambda n: (dict(meas), False)},
+        deadline=600.0)
+    assert rc == 0
+    assert calls.count("--probe") == 3
+    assert out["value"] == 55.0
+    assert len(out["probe_seconds"]) == 3

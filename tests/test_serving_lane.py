@@ -24,14 +24,14 @@ import sys
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_probe(art):
+def _run_probe(art, lane_flag="--serve-smoke"):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("XLA_FLAGS", None)   # single-device lane
     proc = subprocess.run(
         [sys.executable, os.path.join(ROOT, "tools", "serve_probe.py"),
-         "--serve-smoke", "--json-out", art],
+         lane_flag, "--json-out", art],
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-        text=True, timeout=420, env=env, cwd=ROOT)
+        text=True, timeout=900, env=env, cwd=ROOT)
     assert proc.returncode == 0, proc.stdout[-2000:]
     with open(art) as f:
         return json.loads(f.read())
@@ -55,3 +55,34 @@ def test_serve_smoke_lane():
     assert out["latency_ms"]["p95_ms"] is not None
     assert out["batched_req_s"] > 0 and out["unbatched_req_s"] > 0
     assert out["serve_speedup"] >= 3.0, out
+
+
+def test_warm_smoke_lane():
+    """The zero-cold-start acceptance lane (ISSUE 6): two fresh
+    processes over one shared compile-cache dir. The probe gates the
+    warm leg at zero ``jit_compile`` spans, deserialize hits >= bucket
+    count, bit-identical outputs and warm startup <= 25% of cold; this
+    test pins the artifact schema and the deterministic halves of the
+    gate (the wall-clock ratio gets the usual one retry under CI
+    noise)."""
+    art_dir = os.environ.get("MXTPU_ARTIFACT_DIR", "/tmp/mxtpu_artifacts")
+    os.makedirs(art_dir, exist_ok=True)
+    art = os.path.join(art_dir, "warm_smoke.json")
+    try:
+        out = _run_probe(art, "--warm-smoke")
+    except AssertionError:
+        out = _run_probe(art, "--warm-smoke")   # one retry under noise
+    assert out["lane"] == "warm_smoke"
+    assert out["gates_passed"] is True, out
+    # the deterministic contract, independent of the timing gate: a
+    # warm process serving every bucket never invokes XLA
+    assert out["warm"]["jit_compile_spans"] == 0, out
+    assert out["warm"]["jit_deserialize_spans"] >= out["n_buckets"], out
+    assert out["warm"]["compile_cache"].get(
+        "compile_cache.hit", 0) >= out["n_buckets"], out
+    assert out["cold"]["compile_cache"].get(
+        "compile_cache.store", 0) >= out["n_buckets"], out
+    assert out["warm"]["sources"] == ["disk_cache"], out
+    # deserialized executables compute the SAME function, bit for bit
+    assert out["warm"]["probe_sum"] == out["cold"]["probe_sum"], out
+    assert out["warm_vs_cold"] <= out["ratio_gate"], out

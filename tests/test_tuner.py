@@ -160,3 +160,114 @@ def test_ring_attention_tuned_impl(monkeypatch):
                                rtol=1e-5, atol=1e-5)
     recs = [r for r in tuner().records() if r[0] == "ring_attention.impl"]
     assert recs and recs[-1][2] == "xla"
+
+
+# ---------------------------------------------------------------------------
+# Card-corpus serving autotuner (ISSUE 6)
+# ---------------------------------------------------------------------------
+
+def _serving_rec(**over):
+    rec = {"kind": "serving", "max_batch": 16,
+           "rows_hist": {"3": 50, "10": 30, "16": 5},
+           "bucket_ms": {"4": {"total_ms": 40.0, "count": 10},
+                         "16": {"total_ms": 160.0, "count": 10}},
+           "spans": {"serve_d2h": {"total_ms": 100.0, "count": 10},
+                     "serve_batch": {"total_ms": 50.0, "count": 10}}}
+    rec.update(over)
+    return rec
+
+
+def test_plan_serving_deterministic_and_json_native():
+    from mxnet_tpu.tuner import plan_serving
+    recs = [_serving_rec()]
+    p1, p2 = plan_serving(recs), plan_serving(recs)
+    assert p1 == p2
+    # JSON-native: the plan round-trips through the JSONL corpus store
+    assert json.loads(json.dumps(p1)) == p1
+    assert p1["kind"] == "autotune_plan"
+
+
+def test_plan_serving_picks_observed_boundaries():
+    """Traffic at rows 3/10/16 with a linear-ish cost model: the
+    optimal bucket tops are exactly the observed row counts (any pow-2
+    set pads 3->4 and 10->16)."""
+    from mxnet_tpu.tuner import plan_serving
+    plan = plan_serving([_serving_rec()])
+    assert plan["buckets"] == [3, 10, 16]
+    assert plan["max_batch"] == 16
+    # max_batch ALWAYS tops the set so every request stays coverable
+    assert plan["buckets"][-1] == 16
+
+
+def test_plan_serving_merges_records_and_clamps():
+    from mxnet_tpu.tuner import plan_serving
+    # rows above max_batch (stale corpus from a larger engine) clamp out
+    recs = [_serving_rec(), _serving_rec(rows_hist={"3": 5, "99": 7})]
+    plan = plan_serving(recs, max_batch=16)
+    assert plan["buckets"][-1] == 16
+    assert all(b <= 16 for b in plan["buckets"])
+    assert plan["basis"]["records"] == 2
+
+
+def test_plan_serving_max_inflight_from_spans():
+    from mxnet_tpu.tuner import plan_serving
+    # d2h mean 10ms vs batch mean 5ms -> 1 + ceil(2) = 3
+    plan = plan_serving([_serving_rec()])
+    assert plan["max_inflight"] == 3
+    # no span data -> the default
+    rec = _serving_rec(spans={})
+    assert plan_serving([rec])["max_inflight"] == 2
+    assert plan_serving([rec], default_inflight=5)["max_inflight"] == 5
+
+
+def test_plan_serving_without_measured_ms_uses_linear_prior():
+    from mxnet_tpu.tuner import plan_serving
+    plan = plan_serving([_serving_rec(bucket_ms={})])
+    # rows-histogram-only corpus still plans (linear ms=batch prior)
+    assert plan is not None and plan["buckets"][-1] == 16
+
+
+def test_plan_serving_respects_max_buckets():
+    from mxnet_tpu.tuner import plan_serving
+    hist = {str(r): 10 for r in range(1, 17)}       # 16 distinct rows
+    plan = plan_serving([_serving_rec(rows_hist=hist)], max_buckets=4)
+    assert len(plan["buckets"]) <= 4
+    assert plan["buckets"][-1] == 16
+
+
+def test_plan_serving_empty_corpus():
+    from mxnet_tpu.tuner import plan_serving
+    assert plan_serving([]) is None
+    assert plan_serving(None) is None
+    assert plan_serving([{"kind": "programs"}]) is None
+    # a serving record with no histogram has nothing to plan from
+    assert plan_serving([_serving_rec(rows_hist={})]) is None
+
+
+def test_plan_serving_ignores_garbage_fields():
+    from mxnet_tpu.tuner import plan_serving
+    rec = _serving_rec(rows_hist={"3": 5, "bad": "x"},
+                       bucket_ms={"4": "not-a-dict",
+                                  "16": {"total_ms": "nope"}})
+    plan = plan_serving([rec])
+    assert plan is not None and plan["buckets"][-1] == 16
+
+
+def test_plan_serving_filters_by_graph_identity():
+    """A shared corpus must not plan one model from another model's
+    traffic: with ``graph=`` given, only records stamped with the SAME
+    fingerprint participate."""
+    from mxnet_tpu.tuner import plan_serving
+    mine = _serving_rec(graph=["hashA", "NHWC"])
+    other = _serving_rec(graph=["hashB", None],
+                         rows_hist={"7": 1000})
+    unstamped = _serving_rec(rows_hist={"2": 500})   # no graph field
+    plan = plan_serving([mine, other, unstamped],
+                        graph=["hashA", "NHWC"])
+    assert plan["basis"]["records"] == 1
+    assert plan["buckets"] == [3, 10, 16]       # mine only
+    assert plan["graph"] == ["hashA", "NHWC"]
+    # no matching records -> no plan, never a cross-model one
+    assert plan_serving([other], graph=["hashA", "NHWC"]) is None
+    # without graph, everything still pools (explicit opt-out)
+    assert plan_serving([mine, other])["basis"]["records"] == 2

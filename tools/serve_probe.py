@@ -1,6 +1,6 @@
 #!/usr/bin/env python3
 """Serving-path probe: the micro-batching engine vs the one-request-
-at-a-time Predictor facade.
+at-a-time Predictor facade, and the zero-cold-start compile tier.
 
 Serve-smoke lane:   python tools/serve_probe.py --serve-smoke \
                         [--json-out PATH]
@@ -10,11 +10,24 @@ Serve-smoke lane:   python tools/serve_probe.py --serve-smoke \
   throughput >= 3x unbatched at max_batch >= 8, and EXACTLY one
   compile per bucket signature via ``telemetry.programs()``. The JSON
   artifact banks both throughputs, the request p50/p95/p99 and the
-  per-bucket program cards every round.)
+  per-bucket program cards every round; the engine's measured serving
+  data lands in the card corpus for the autotuner.)
+
+Warm-smoke lane:    python tools/serve_probe.py --warm-smoke \
+                        [--json-out PATH]
+  (tier-1 CI for the PERSISTED compile cache, ISSUE 6: two fresh
+  processes construct the same serving engine over one shared
+  ``MXNET_COMPILE_CACHE`` dir. The first (cold) compiles and stores
+  every bucket program; the second (warm) must register ZERO
+  ``jit_compile`` spans, >= bucket-count deserialize hits, produce
+  bit-identical outputs, and start up in <= 25% of the cold wall.)
 """
 import json
 import os
+import shutil
+import subprocess
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
@@ -26,6 +39,7 @@ jax.config.update("jax_platforms", "cpu")
 
 import mxnet_tpu as mx
 from mxnet_tpu import telemetry
+from mxnet_tpu import compile_cache
 from mxnet_tpu.predictor import Predictor
 from mxnet_tpu.serving import InferenceEngine
 
@@ -34,6 +48,14 @@ N_REQ = 256
 MAX_BATCH = 16
 ROUNDS = 5
 SPEEDUP_GATE = 3.0
+
+# warm-smoke model: deep enough that XLA compile dominates a cold
+# start (the tier this lane gates exists to delete that cost); the
+# fixed startup work (bind, shape inference, rng key) is identical
+# across the legs
+WARM_LAYERS, WARM_HID, WARM_D = 32, 192, 32
+WARM_MAX_BATCH = 32
+WARM_RATIO_GATE = 0.25       # warm startup <= 25% of cold (ISSUE 6)
 
 
 def _mlp():
@@ -54,6 +76,12 @@ def _params(symbol):
 
 
 def serve_smoke(json_out=None, n_req=N_REQ, rounds=ROUNDS):
+    # bank this lane's measured serving data into the card corpus
+    # (engine.close() appends) so the autotuner has a trajectory even
+    # on rounds where nothing else served traffic
+    os.environ.setdefault("MXNET_CARD_CORPUS", os.path.join(
+        os.environ.get("MXTPU_ARTIFACT_DIR", "/tmp/mxtpu_artifacts"),
+        "card_corpus.jsonl"))
     sym = _mlp()
     params = _params(sym)
     rng = np.random.RandomState(1)
@@ -142,6 +170,15 @@ def serve_smoke(json_out=None, n_req=N_REQ, rounds=ROUNDS):
         "compiles_per_bucket": round(len(cards) / len(engine.buckets), 2),
     }
     engine.close()
+    # what the corpus-fed autotuner would plan from the recorded
+    # trajectory (informational here; unit-tested in test_tuner.py)
+    try:
+        from mxnet_tpu.tuner import plan_serving
+        out["autotune_plan"] = plan_serving(
+            compile_cache.corpus_records(kind="serving"),
+            max_batch=MAX_BATCH)
+    except Exception:
+        out["autotune_plan"] = None
     # the serving acceptance gates (ISSUE 5): exactly one compiled
     # program per bucket signature, ZERO compiles inside the timed
     # steady-state window (every dispatch a cache hit), and sustained
@@ -151,6 +188,132 @@ def serve_smoke(json_out=None, n_req=N_REQ, rounds=ROUNDS):
             ("compiles != buckets", sorted(cards), engine.buckets)
         assert batched_window.get("jit_compiles", -1) == 0, batched_window
         assert out["serve_speedup"] >= SPEEDUP_GATE, out["serve_speedup"]
+        out["gates_passed"] = True
+    except AssertionError:
+        out["gates_passed"] = False
+        raise
+    finally:
+        line = json.dumps(out)
+        print(line, flush=True)
+        if json_out:
+            with open(json_out, "w") as f:
+                f.write(line + "\n")
+    return out
+
+
+def _warm_mlp():
+    data = mx.sym.Variable("data")
+    net = data
+    for i in range(WARM_LAYERS):
+        net = mx.sym.FullyConnected(net, num_hidden=WARM_HID,
+                                    name="wfc%d" % i)
+        net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=C, name="whead")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def warm_child():
+    """One process's leg of the warm-smoke A/B: construct (and warm up)
+    the serving engine over the ambient ``MXNET_COMPILE_CACHE``, serve
+    a fixed probe request, and report the startup wall next to the
+    compile-vs-deserialize telemetry split. Cold or warm is decided
+    entirely by what the cache dir already holds."""
+    sym = _warm_mlp()
+    rng = np.random.RandomState(0)
+    shapes, _, _ = sym.infer_shape_partial(data=(2, WARM_D))
+    params = {"arg:" + n: mx.nd.array(rng.normal(0, 0.05, s)
+                                      .astype(np.float32))
+              for n, s in zip(sym.list_arguments(), shapes)
+              if n not in ("data", "softmax_label")}
+    probe_req = rng.normal(size=(1, WARM_D)).astype(np.float32)
+    telemetry.enable()
+    telemetry.reset()
+    t0 = time.perf_counter()
+    engine = InferenceEngine(sym, params, {"data": (1, WARM_D)},
+                             max_batch=WARM_MAX_BATCH, max_wait_ms=1.0,
+                             max_inflight=4)
+    startup_s = time.perf_counter() - t0
+    outs = engine.submit(data=probe_req).result(timeout=120)
+    snap = telemetry.snapshot()
+    spans = {k: snap["spans"].get(k, {}).get("count", 0)
+             for k in telemetry.COMPILE_SPANS}
+    out = {
+        "lane": "warm_child",
+        "cache_dir": compile_cache.cache_dir(),
+        "startup_s": round(startup_s, 3),
+        "buckets": engine.buckets,
+        "jit_trace_spans": spans["jit_trace"],
+        "jit_compile_spans": spans["jit_compile"],
+        "jit_deserialize_spans": spans["jit_deserialize"],
+        "compile_cache": {k: v for k, v in snap["counters"].items()
+                          if k.startswith("compile_cache.")},
+        "sources": sorted({c.get("source") for c in
+                           snap["programs"].values() if c.get("source")}),
+        # bit-exactness probe: the warm (deserialized) leg must produce
+        # exactly what the cold (compiled) leg produced
+        "probe_sum": float(np.float64(outs[0].astype(np.float64).sum())),
+    }
+    engine.close()
+    print(json.dumps(out), flush=True)
+    return out
+
+
+def warm_smoke(json_out=None):
+    """The warm-start acceptance lane (ISSUE 6): two FRESH processes
+    over one shared compile-cache dir. Process 1 (cold) populates the
+    store; process 2 (warm) must skip XLA entirely — zero
+    ``jit_compile`` spans, deserialize hits >= bucket count — match
+    the cold outputs bit-for-bit, and start in <= 25% of the cold
+    wall."""
+    cache = tempfile.mkdtemp(prefix="mxtpu_warm_smoke_cc_")
+    legs = {}
+    try:
+        for leg in ("cold", "warm"):
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       MXNET_COMPILE_CACHE=cache)
+            env.pop("XLA_FLAGS", None)       # single-device lane
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--warm-child"],
+                stdout=subprocess.PIPE, text=True, timeout=420, env=env)
+            parsed = None
+            for line in reversed(proc.stdout.splitlines()):
+                if line.strip().startswith("{"):
+                    parsed = json.loads(line)
+                    break
+            assert proc.returncode == 0 and parsed is not None, \
+                ("warm-smoke %s child failed" % leg, proc.returncode,
+                 proc.stdout[-2000:])
+            legs[leg] = parsed
+    finally:
+        shutil.rmtree(cache, ignore_errors=True)
+    cold, warm = legs["cold"], legs["warm"]
+    n_buckets = len(cold["buckets"])
+    out = {
+        "lane": "warm_smoke",
+        "platform": jax.devices()[0].platform,
+        "n_buckets": n_buckets,
+        "cold": cold,
+        "warm": warm,
+        "warm_vs_cold": round(warm["startup_s"] / cold["startup_s"], 3)
+        if cold["startup_s"] else None,
+        "ratio_gate": WARM_RATIO_GATE,
+    }
+    try:
+        # cold leg: every bucket compiled AND persisted
+        assert cold["jit_compile_spans"] >= n_buckets, cold
+        assert cold["compile_cache"].get(
+            "compile_cache.store", 0) >= n_buckets, cold
+        # warm leg: ZERO XLA compiles, every program a deserialize hit
+        assert warm["jit_compile_spans"] == 0, warm
+        assert warm["compile_cache"].get(
+            "compile_cache.hit", 0) >= n_buckets, warm
+        assert warm["jit_deserialize_spans"] >= n_buckets, warm
+        assert warm["sources"] == ["disk_cache"], warm
+        # the deserialized programs compute the SAME function
+        assert warm["probe_sum"] == cold["probe_sum"], (cold, warm)
+        # and the whole point: the warm start is a fraction of the cold
+        assert out["warm_vs_cold"] <= WARM_RATIO_GATE, out["warm_vs_cold"]
         out["gates_passed"] = True
     except AssertionError:
         out["gates_passed"] = False
@@ -176,6 +339,10 @@ def _json_out_arg():
 if __name__ == "__main__":
     if "--serve-smoke" in sys.argv:
         serve_smoke(json_out=_json_out_arg())
+    elif "--warm-smoke" in sys.argv:
+        warm_smoke(json_out=_json_out_arg())
+    elif "--warm-child" in sys.argv:
+        warm_child()
     else:
-        raise SystemExit("usage: serve_probe.py --serve-smoke "
-                         "[--json-out PATH]")
+        raise SystemExit("usage: serve_probe.py --serve-smoke|"
+                         "--warm-smoke [--json-out PATH]")
