@@ -391,6 +391,26 @@ def _telemetry_summary():
             "programs": snap["programs"], "online": snap["online"]}
 
 
+_ROBUSTNESS_PREFIXES = ("faults.", "serving.shed", "serving.retries",
+                        "serving.breaker", "serving.deadline",
+                        "serving.dispatch_failures", "checkpoint.",
+                        "divergence.", "training.preempted")
+
+
+def _robustness_counters():
+    """Per-leg fault/shed/resume counters (ISSUE 7): the robustness
+    trajectory banked NEXT to the throughput trajectory, so a BENCH
+    round records whether its numbers were measured under injected
+    faults / shedding / resumes (all zeros = a clean leg — still worth
+    recording, it's the claim the chaos lane checks against)."""
+    try:
+        from mxnet_tpu import telemetry
+        return {k: v for k, v in telemetry.counters().items()
+                if k.startswith(_ROBUSTNESS_PREFIXES)}
+    except Exception as e:                  # telemetry must never cost a run
+        return {"error": str(e)}
+
+
 def module_child():
     """Separate child for the OPTIONAL user-facing-path measurement:
     Module.fit through the whole-step fused program AND, budget
@@ -412,11 +432,13 @@ def module_child():
             # measured
             out["module_fit_fused_fallback"] = fallback
         out["telemetry"] = _telemetry_summary()
+        out["robustness"] = _robustness_counters()
         print(json.dumps(out), flush=True)
         os.environ["MXNET_MODULE_FUSED_STEP"] = "0"
         img_s, _ = _module_fit_throughput(dev)
         out["module_fit_phase_split_img_s"] = round(img_s, 2)
         out["telemetry_phase_split"] = _telemetry_summary()
+        out["robustness_phase_split"] = _robustness_counters()
         print(json.dumps(out), flush=True)
     finally:
         _restore_pin(old_pin)
@@ -760,6 +782,16 @@ def serve_child():
         }
         print(json.dumps(dict(out, partial=True)), flush=True)
     out["telemetry"] = _telemetry_summary()
+    # the robustness trajectory: overload-control + fault counters for
+    # this leg, plus the engine's own shed/retry/breaker accounting
+    st = engine.stats()
+    out["robustness"] = {
+        "counters": _robustness_counters(),
+        "engine": {k: st.get(k) for k in
+                   ("shed_requests", "shed_rows", "shed_by_cause",
+                    "retries", "dispatch_failures", "breaker",
+                    "queued_rows", "max_queue_rows", "deadline_ms")},
+    }
     engine.close()        # appends the corpus record when one is configured
     # the corpus-fed autotuner's plan for this round's traffic — what
     # the NEXT round's engine would pick instead of pow-2 buckets

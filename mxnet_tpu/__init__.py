@@ -84,6 +84,9 @@ __version__ = "0.1.0"
 from . import operator               # noqa: E402
 from . import rnn                    # noqa: E402
 from . import telemetry              # noqa: E402
+from . import faults                 # noqa: E402
+from . import checkpoint             # noqa: E402
+from .checkpoint import CheckpointManager  # noqa: E402
 from . import compile_cache          # noqa: E402
 from . import profiler               # noqa: E402
 from . import tuner                  # noqa: E402

@@ -190,8 +190,14 @@ class TelemetryLogger:
             self._log_new_programs()
         rows = delta.get("serving.batch_rows", 0)
         pad = delta.get("serving.pad_rows", 0)
-        depth = cur.get("serving.requests", 0) - cur.get(
-            "serving.resolved", 0)
+        # depth = admitted-but-unterminated (mirrors stats()):
+        # admission sheds never counted as requests; post-admission
+        # sheds and failed requests each terminated a counted request
+        depth = cur.get("serving.requests", 0) \
+            - cur.get("serving.resolved", 0) \
+            - (cur.get("serving.shed_requests", 0)
+               - cur.get("serving.shed.admission", 0)) \
+            - cur.get("serving.failed_requests", 0)
         # request-latency percentiles over THIS window's samples only
         durs = t.span_durations("serve_request")
         total = t.span_count("serve_request")
@@ -210,6 +216,17 @@ class TelemetryLogger:
         pad_b = delta.get("serving.pad_bytes", 0)
         if pad_b:
             msg += "\tpad=%.1fKiB" % (pad_b / 1024.0)
+        # overload-control window: shed/retry/breaker events are the
+        # degradation signal an operator tails the log for
+        shed = delta.get("serving.shed_requests", 0)
+        if shed:
+            msg += "\tshed=%d" % shed
+        retries = delta.get("serving.retries", 0)
+        if retries:
+            msg += "\tretries=%d" % retries
+        trips = delta.get("serving.breaker_trips", 0)
+        if trips:
+            msg += "\tbreaker_trips=%d" % trips
         self.logger.info(msg)
 
     def __call__(self, param):

@@ -57,6 +57,7 @@ import threading
 import time
 
 from . import telemetry
+from . import faults
 from .log import get_logger
 
 __all__ = ["enabled", "cache_dir", "lowered_key", "quick_key",
@@ -415,6 +416,13 @@ def load(key, kind=None):
     path = entry_path(key)
     if se is None or path is None or _trusted_dir() is None:
         return None
+    # chaos site: an injected raise behaves exactly like a mangled
+    # entry — the reject path fires and the caller compiles fresh (a
+    # cache must never be able to break dispatch, injected or not)
+    try:
+        faults.fire("compile_cache.load")
+    except faults.InjectedFault as e:
+        return _reject(key, "injected", str(e))
     if not os.path.exists(path):
         telemetry.counter_inc("compile_cache.miss")
         return None

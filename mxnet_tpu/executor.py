@@ -29,6 +29,7 @@ from .context import current_context
 from .ops.common import rng_scope, mx_dtype
 from . import random as _random
 from . import telemetry
+from . import faults
 
 __all__ = ["Executor", "infer_graph_shapes", "record_dispatch",
            "card_from_compiled", "DeviceMemoryError"]
@@ -488,6 +489,10 @@ class _InstrumentedProgram:
         if rec is None:
             rec = self._build(sig, args)
         telemetry.program_dispatch(rec[1])
+        # chaos site: an injected raise here looks exactly like a
+        # backend dispatch failure to every caller (the serving retry
+        # budget, the breaker, the fit loop) — which is the point
+        faults.fire("dispatch")
         try:
             return self._invoke(rec[0], args)
         except Exception as e:
@@ -514,6 +519,33 @@ class _NoAnalysis:
         raise NotImplementedError
 
     memory_analysis = cost_analysis
+
+
+# ---------------------------------------------------------------------------
+# Divergence sentinel kernel
+# ---------------------------------------------------------------------------
+
+_FINITE_PROG = None
+
+
+def finite_fold_fn():
+    """The divergence sentinel's device kernel: one jitted program
+    folding ``isfinite(x).all()`` over a list of arrays (loss heads,
+    gradients, parameters) into a single scalar bool — the whole check
+    ships ONE dispatch and fetches ONE byte, instead of pulling every
+    buffer to the host. Compiled through the instrumented wrapper like
+    every other program (card, OOM enrichment); one cached program per
+    leaf-signature, shared process-wide."""
+    global _FINITE_PROG
+    if _FINITE_PROG is None:
+        def _fold(leaves):
+            acc = jnp.asarray(True)
+            for x in leaves:
+                if jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact):
+                    acc = jnp.logical_and(acc, jnp.isfinite(x).all())
+            return acc
+        _FINITE_PROG = _InstrumentedProgram("finite_check", _fold)
+    return _FINITE_PROG
 
 
 # differentiable cross-device copy with static endpoints: the plain

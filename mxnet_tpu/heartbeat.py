@@ -24,7 +24,7 @@ __all__ = ["start_heartbeat", "stop_heartbeat", "count_dead"]
 ENV_DIR = "MXTPU_HEARTBEAT_DIR"
 DEFAULT_INTERVAL = 1.0
 
-_state = {"thread": None, "stop": None}
+_state = {"thread": None, "stop": None, "path": None}
 
 
 def _path(root, rank):
@@ -41,10 +41,17 @@ def start_heartbeat(rank, root=None, interval=DEFAULT_INTERVAL):
     stop = threading.Event()
 
     def beat():
+        # ATOMIC beat: write temp + rename. The old open(path, "w")
+        # truncated in place, so a concurrent count_dead() could stat
+        # the file mid-rewrite and read a zero-length/zero-mtime worker
+        # as dead — on shared filesystems (NFS/GCS fuse, exactly where
+        # this runs) the truncate→write window is milliseconds wide.
+        tmp = path + ".tmp"
         while not stop.is_set():
             try:
-                with open(path, "w") as f:
+                with open(tmp, "w") as f:
                     f.write(str(time.time()))
+                os.replace(tmp, path)
             except OSError:
                 pass
             stop.wait(interval)
@@ -54,13 +61,31 @@ def start_heartbeat(rank, root=None, interval=DEFAULT_INTERVAL):
     t.start()
     _state["thread"] = t
     _state["stop"] = stop
+    _state["path"] = path
 
 
 def stop_heartbeat():
-    if _state["stop"] is not None:
-        _state["stop"].set()
-        _state["thread"] = None
-        _state["stop"] = None
+    """Stop the beat AND remove this worker's file: a cleanly-stopped
+    worker must read as departed immediately, not linger as a stale
+    file that counts dead for ``timeout`` seconds first."""
+    if _state["stop"] is None:
+        return
+    _state["stop"].set()
+    thread, path = _state["thread"], _state["path"]
+    _state["thread"] = None
+    _state["stop"] = None
+    _state["path"] = None
+    if thread is not None:
+        # the beat loop wakes immediately on the event; join so a
+        # final in-flight rename cannot resurrect the file after the
+        # removal below
+        thread.join(timeout=5.0)
+    if path is not None:
+        for p in (path, path + ".tmp"):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
 
 
 def count_dead(num_workers, root=None, timeout=None):

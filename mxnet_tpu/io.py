@@ -24,6 +24,7 @@ from .base import MXNetError, registry_create
 from .ndarray import array as _nd_array
 from .ndarray.ndarray import NDArray
 from . import telemetry
+from . import faults
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
            "PrefetchingIter", "MNISTIter", "CSVIter", "LibSVMIter",
@@ -60,6 +61,22 @@ class DataBatch:
         self.provide_label = provide_label
 
 
+def _poison_batch(batch):
+    """The ``io_next`` site's ``nan`` payload transform: corrupt the
+    batch's DATA arrays (NDArray or numpy) via ``faults.poison``,
+    leaving labels intact — a poisoned label would fail loudly in the
+    loss layer instead of exercising the numeric-divergence path."""
+    data = batch.data
+    single = not isinstance(data, (list, tuple))
+    items = [data] if single else list(data)
+    for i, arr in enumerate(items):
+        if isinstance(arr, NDArray):
+            arr[:] = faults.poison([arr.asnumpy()])[0]
+        elif isinstance(arr, np.ndarray):
+            items[i] = faults.poison([arr])[0]
+    batch.data = items[0] if single else items
+
+
 class DataIter:
     """Base iterator (parity: io.DataIter)."""
 
@@ -86,10 +103,16 @@ class DataIter:
         # time it burned to the io phase before propagating
         with telemetry.span("io_next") as sp:
             try:
-                return self.next()
+                batch = self.next()
             except StopIteration:
                 sp.cancel()
                 raise
+            # chaos site: a raise is a broken input pipeline; "nan" is
+            # a corrupted batch (what the divergence sentinel exists to
+            # catch) — poisoning the DATA arrays, labels left intact
+            if faults.active() and faults.fire("io_next") == "nan":
+                _poison_batch(batch)
+            return batch
 
     def iter_next(self):
         raise NotImplementedError

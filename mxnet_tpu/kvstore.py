@@ -27,6 +27,7 @@ import pickle
 from .base import MXNetError
 from .ndarray.ndarray import NDArray, zeros as _zeros
 from . import telemetry
+from . import faults
 
 __all__ = ["KVStore", "create"]
 
@@ -98,6 +99,15 @@ class KVStore:
         per-device shard list; reduction = sum, as CommDevice does. A list
         of KEYS is one batched push: in dist mode all their cross-process
         reductions run as a single jitted collective."""
+        # chaos site: a raise is a lost push (dist wire failure); "nan"
+        # corrupts the pushed gradients in place — the divergence
+        # sentinel downstream is what should catch it
+        if faults.active() and faults.fire("kv_push") == "nan":
+            flat = value if isinstance(value, (list, tuple)) else [value]
+            for v in flat:
+                for x in (v if isinstance(v, (list, tuple)) else [v]):
+                    if isinstance(x, NDArray):
+                        x[:] = faults.poison([x.asnumpy()])[0]
         with telemetry.span("kv_push"):
             self._push_impl(key, value)
         telemetry.counter_inc("kvstore.push")
@@ -512,8 +522,8 @@ class KVStore:
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
             raise MXNetError("no optimizer set on kvstore")
-        with open(fname, "wb") as f:
-            f.write(self._updater.get_states(dump_optimizer))
+        from .checkpoint import atomic_write
+        atomic_write(fname, self._updater.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):
         if self._updater is None:

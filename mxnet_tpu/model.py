@@ -46,13 +46,23 @@ def _create_kvstore(kvstore, num_device, arg_params):
 
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
-    """(parity: model.save_checkpoint:366)"""
+    """(parity: model.save_checkpoint:366) — ATOMIC, unlike the
+    reference: every artifact lands via temp+fsync+rename
+    (mxnet_tpu/checkpoint.py), so a preemption mid-save never leaves a
+    truncated ``.params`` file poisoning the next start, and a
+    concurrent reader sees either the previous complete checkpoint or
+    the new one."""
+    from .checkpoint import atomic_write, atomic_save_ndarrays
+    from .filesystem import scheme_of
     if symbol is not None:
-        symbol.save("%s-symbol.json" % prefix)
+        if scheme_of(prefix):      # remote URIs cannot rename
+            symbol.save("%s-symbol.json" % prefix)
+        else:
+            atomic_write("%s-symbol.json" % prefix, symbol.tojson())
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
-    _nd_save(param_name, save_dict)
+    atomic_save_ndarrays(param_name, save_dict)
 
 
 def load_checkpoint(prefix, epoch):

@@ -238,10 +238,54 @@ class BaseModule:
             initializer=Uniform(0.01), arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, sparse_row_id_fn=None):
+            monitor=None, sparse_row_id_fn=None,
+            checkpoint=None, resume=None,
+            divergence_check_every=0, divergence_policy="halt"):
         """Train (parity: base_module.fit:376 — bind → init_params →
-        init_optimizer → per-batch forward_backward/update/metric loop)."""
+        init_optimizer → per-batch forward_backward/update/metric loop).
+
+        Fault-tolerance extensions (no reference counterpart):
+
+        - ``checkpoint``: a ``CheckpointManager`` (or prefix string)
+          that (a) saves an atomic keep-last-K checkpoint at every
+          epoch end and (b) ARMS SIGTERM/SIGINT for the duration of
+          fit: a signal sets a flag checked at batch boundaries, the
+          in-flight batch completes, a mid-epoch checkpoint
+          (epoch, nbatch) is written, and ``TrainingPreempted`` is
+          raised — the preemption grace window buys one atomic save,
+          not a stack unwind.
+        - ``resume``: ``True`` (resume from ``checkpoint``'s latest),
+          or a ``CheckpointManager``/prefix. Restores params,
+          optimizer states + per-parameter update counts, and the
+          global RNG key, then continues from the recorded
+          epoch+batch (already-applied batches of the resumed epoch
+          are consumed from the iterator without compute). No
+          checkpoint found = fresh start, not an error.
+        - ``divergence_check_every`` / ``divergence_policy``: every N
+          batches run the divergence sentinel (``finite_check()`` — a
+          device-side isfinite fold over the step outputs and, for
+          Module, every parameter). On non-finite values the policy
+          applies: ``"halt"`` raises ``DivergenceError``, ``"skip"``
+          logs + counts and keeps training, ``"rollback"`` restores
+          the ``checkpoint`` manager's latest checkpoint and
+          continues (halts when there is nothing to roll back to).
+        """
+        from ..checkpoint import CheckpointManager, TrainingPreempted
         assert num_epoch is not None, "please specify number of epochs"
+        if divergence_policy not in ("halt", "skip", "rollback"):
+            raise MXNetError("divergence_policy must be halt|skip|"
+                             "rollback, got %r" % (divergence_policy,))
+        ckpt = checkpoint
+        if isinstance(ckpt, str):
+            ckpt = CheckpointManager(ckpt)
+        rmgr = None
+        if resume is not None and resume is not False:
+            rmgr = ckpt if resume is True else resume
+            if isinstance(rmgr, str):
+                rmgr = CheckpointManager(rmgr)
+            if rmgr is None:
+                raise MXNetError("fit(resume=True) needs checkpoint=")
+        resume_meta = rmgr.latest() if rmgr is not None else None
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label, for_training=True,
                   force_rebind=force_rebind)
@@ -252,11 +296,41 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+        skip_batches = 0
+        if resume_meta is not None:
+            rmgr.restore(self, resume_meta)
+            begin_epoch = int(resume_meta["epoch"])
+            skip_batches = int(resume_meta.get("nbatch", 0))
+            self.logger.info(
+                "Resuming from checkpoint %s: epoch=%d nbatch=%d",
+                rmgr.prefix, begin_epoch, skip_batches)
         if validation_metric is None:
             validation_metric = eval_metric
         if not isinstance(eval_metric, _metric.EvalMetric):
             eval_metric = _metric.create(eval_metric)
 
+        if ckpt is not None:
+            ckpt.clear_preempt()
+            ckpt.arm_signals()
+        try:
+            self._fit_loop(train_data, eval_data, eval_metric,
+                           validation_metric, epoch_end_callback,
+                           batch_end_callback, eval_end_callback,
+                           eval_batch_end_callback, monitor,
+                           sparse_row_id_fn, begin_epoch, num_epoch,
+                           skip_batches, ckpt, divergence_check_every,
+                           divergence_policy)
+        finally:
+            if ckpt is not None:
+                ckpt.disarm_signals()
+
+    def _fit_loop(self, train_data, eval_data, eval_metric,
+                  validation_metric, epoch_end_callback,
+                  batch_end_callback, eval_end_callback,
+                  eval_batch_end_callback, monitor, sparse_row_id_fn,
+                  begin_epoch, num_epoch, skip_batches, ckpt,
+                  divergence_check_every, divergence_policy):
+        from ..checkpoint import TrainingPreempted
         train_data.reset()
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
@@ -264,7 +338,22 @@ class BaseModule:
             nbatch = 0
             data_iter = iter(train_data)
             end_of_batch = False
-            next_data_batch = next(data_iter)
+            if epoch == begin_epoch and skip_batches:
+                # mid-epoch resume: the checkpoint already holds these
+                # batches' updates — consume them without compute so
+                # the remaining epoch sees the SAME data the
+                # interrupted run would have
+                for _ in range(skip_batches):
+                    try:
+                        next(data_iter)
+                    except StopIteration:
+                        break
+                nbatch = skip_batches
+            try:
+                next_data_batch = next(data_iter)
+            except StopIteration:
+                end_of_batch = True
+                next_data_batch = None
             while not end_of_batch:
                 data_batch = next_data_batch
                 if monitor is not None:
@@ -293,6 +382,11 @@ class BaseModule:
                         self.update_metric(eval_metric, data_batch.label)
                 if monitor is not None:
                     monitor.toc_print()
+                if divergence_check_every > 0 \
+                        and (nbatch + 1) % divergence_check_every == 0 \
+                        and not self.finite_check():
+                    self._handle_divergence(divergence_policy, ckpt,
+                                            epoch, nbatch)
                 if batch_end_callback is not None:
                     with telemetry.span("callbacks"):
                         param = BatchEndParam(epoch=epoch, nbatch=nbatch,
@@ -301,6 +395,19 @@ class BaseModule:
                         for cb in _as_list(batch_end_callback):
                             cb(param)
                 nbatch += 1
+                # batch-boundary preemption point: the armed signal set
+                # the flag; nbatch batches of this epoch are applied, so
+                # (epoch, nbatch) resumes exactly here
+                if ckpt is not None and ckpt.preempt_requested:
+                    source = ckpt.preempt_requested
+                    ckpt.save(self, epoch, nbatch)
+                    telemetry.counter_inc("training.preempted")
+                    raise TrainingPreempted(
+                        "training preempted by %s at epoch %d batch %d; "
+                        "checkpoint saved under %r — fit(resume=...) "
+                        "continues from here" % (source, epoch, nbatch,
+                                                 ckpt.prefix),
+                        epoch=epoch, nbatch=nbatch, prefix=ckpt.prefix)
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
@@ -319,6 +426,9 @@ class BaseModule:
                 with telemetry.span("callbacks"):
                     for cb in _as_list(epoch_end_callback):
                         cb(epoch, self.symbol, arg_p, aux_p)
+            if ckpt is not None:
+                # epoch complete: resume point is the NEXT epoch's start
+                ckpt.save(self, epoch + 1, 0)
 
             if eval_data is not None:
                 res = self.score(eval_data, validation_metric,
@@ -329,6 +439,51 @@ class BaseModule:
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
                                      name, val)
             train_data.reset()
+
+    def finite_check(self):
+        """The divergence sentinel's predicate: True when the last
+        step's values are all finite. Base implementation folds the
+        OUTPUT heads on the host; ``Module`` overrides with a
+        device-side fold that also covers every parameter (a NaN
+        gradient poisons the params on the very step it appears, so
+        the fold catches it at the next check)."""
+        for o in self.get_outputs():
+            a = o.asnumpy()
+            if np.issubdtype(a.dtype, np.floating) \
+                    and not np.isfinite(a).all():
+                return False
+        return True
+
+    def _handle_divergence(self, policy, ckpt, epoch, nbatch):
+        """Apply the divergence policy after ``finite_check()`` failed:
+        count it, then skip / rollback / halt."""
+        from ..checkpoint import DivergenceError
+        telemetry.counter_inc("divergence.detected")
+        where = "epoch %d batch %d" % (epoch, nbatch)
+        from .. import log as _log
+        logger = _log.get_logger("mxnet_tpu.module")
+        if policy == "skip":
+            telemetry.counter_inc("divergence.skipped")
+            logger.warning(
+                "divergence sentinel: non-finite loss/params at %s — "
+                "policy=skip, continuing (the next finite batches may "
+                "recover, or may not: consider policy=rollback)", where)
+            return
+        if policy == "rollback":
+            if ckpt is not None and ckpt.latest() is not None:
+                meta = ckpt.restore(self)
+                telemetry.counter_inc("divergence.rollback")
+                logger.warning(
+                    "divergence sentinel: non-finite loss/params at %s "
+                    "— rolled back to checkpoint epoch=%d nbatch=%d",
+                    where, meta["epoch"], meta.get("nbatch", 0))
+                return
+            logger.warning(
+                "divergence sentinel: policy=rollback but no checkpoint "
+                "to roll back to — halting")
+        raise DivergenceError(
+            "divergence sentinel: non-finite loss/params at %s "
+            "(policy=%s)" % (where, policy))
 
     def prepare(self, data_batch, sparse_row_id_fn=None):
         pass

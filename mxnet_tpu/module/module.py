@@ -803,6 +803,28 @@ class Module(BaseModule):
             eval_metric.update(labels if isinstance(labels, (list, tuple))
                                else [labels], self.get_outputs())
 
+    def finite_check(self):
+        """Device-side divergence sentinel (overrides the base host
+        fold): ONE jitted program (``executor.finite_fold_fn``) folds
+        ``isfinite`` over the last step's outputs (the loss head),
+        every materialised gradient, and every parameter — a NaN
+        gradient poisons the params on the step it appears, so a
+        periodic check over params catches mid-interval divergence —
+        then fetches the single scalar verdict."""
+        from ..executor import finite_fold_fn
+        assert self.binded and self.params_initialized
+        ex = self._exec
+        leaves = [o._data for o in ex.outputs]
+        leaves += [g._data for g in ex.grad_dict.values()
+                   if g is not None]
+        leaves += [ex.arg_dict[n]._data for n in self._param_names]
+        if not leaves:
+            return True
+        record_dispatch("finite_check")
+        with telemetry.span("divergence_check"):
+            verdict = finite_fold_fn()(leaves)
+            return bool(np.asarray(verdict))
+
     # -- checkpoints -------------------------------------------------------
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
         """(parity: module.py save_checkpoint:164)"""
@@ -845,13 +867,15 @@ class Module(BaseModule):
         return [grads[name] for name in self._data_names if name in grads]
 
     def save_optimizer_states(self, fname):
-        """(parity: module.save_optimizer_states:759)"""
+        """(parity: module.save_optimizer_states:759) — atomic
+        (temp+fsync+rename) so a preemption mid-save never truncates
+        the previous states file."""
+        from ..checkpoint import atomic_write
         assert self.optimizer_initialized
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
-            with open(fname, "wb") as f:
-                f.write(self._updater.get_states())
+            atomic_write(fname, self._updater.get_states())
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized

@@ -31,5 +31,23 @@ def take_key():
     return sub
 
 
+def get_state():
+    """JSON-safe snapshot of the global generator (the raw key data as
+    a list of ints) — what a checkpoint persists so a resumed run
+    replays the SAME key sequence the interrupted run would have."""
+    import numpy as np
+    with _lock:
+        return np.asarray(jax.random.key_data(_key)).tolist()
+
+
+def set_state(state):
+    """Restore a :func:`get_state` snapshot (checkpoint resume)."""
+    global _key
+    import numpy as np
+    data = np.asarray(state, dtype=np.uint32)
+    with _lock:
+        _key = jax.random.wrap_key_data(data)
+
+
 # re-exported sampling helpers (mx.random.uniform etc.) are installed by
 # mxnet_tpu/__init__.py from the generated nd namespace.

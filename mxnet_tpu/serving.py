@@ -37,6 +37,39 @@ many. Concretely:
 Every graph output must be batch-major (dim 0 = batch) — true of the
 whole symbol zoo; the padded rows are sliced off before a future
 resolves, so callers never see them.
+
+**Overload control** (ISSUE 7): production serving melts at the EDGES,
+not in the steady state, so the engine degrades deliberately instead of
+queuing without bound:
+
+* **bounded admission** — ``max_queue_rows`` caps the rows waiting for
+  a bucket; past it, ``overload="shed"`` fails the submit fast with
+  :class:`QueueOverflow` (the load balancer's retry-elsewhere signal)
+  while ``overload="block"`` applies backpressure to the submitting
+  thread (bounded by the request's deadline, if any);
+* **deadlines** — ``submit(..., deadline_ms=)`` (or the engine-wide
+  ``deadline_ms`` default) is enforced three times: at admission
+  (blocked submits give up), at coalesce time (stale requests are shed
+  with :class:`DeadlineExceeded` BEFORE they pad a bucket and burn
+  device time), and at resolution (a result arriving past its deadline
+  resolves the future with ``DeadlineExceeded`` — the client stopped
+  caring, delivering late data as success would hide the overload);
+* **retry with backoff** — a TRANSIENT dispatch failure (an injected
+  ``faults.InjectedFault``, a flaky backend RPC) is retried up to
+  ``retry_budget`` times with exponential backoff; program errors
+  (shape/dtype/OOM) never retry;
+* **a breaker** — ``breaker_threshold`` CONSECUTIVE dispatch failures
+  trip the engine into fast-fail (:class:`CircuitOpen` at submit, no
+  device work) until ``breaker_reset_s`` elapses and a half-open trial
+  batch succeeds — a down backend costs microseconds per request, not
+  a timeout each.
+
+Counters: ``serving.shed_requests`` / ``serving.shed_rows`` (with
+``serving.shed.admission`` / ``.coalesce`` / ``.resolve`` causes),
+``serving.deadline_exceeded``, ``serving.retries``,
+``serving.dispatch_failures``, ``serving.breaker_trips``,
+``serving.breaker_fastfail`` — all in ``stats()`` and the telemetry
+registry, so the chaos lane asserts exact shed/retry trajectories.
 """
 from __future__ import annotations
 
@@ -53,10 +86,32 @@ import jax
 
 from .base import MXNetError
 from . import telemetry
-from .executor import record_dispatch
+from . import faults
+from .executor import record_dispatch, DeviceMemoryError
 from .predictor import Predictor
 
-__all__ = ["InferenceEngine", "bucket_sizes", "validate_buckets"]
+__all__ = ["InferenceEngine", "bucket_sizes", "validate_buckets",
+           "DeadlineExceeded", "QueueOverflow", "CircuitOpen",
+           "EngineClosed"]
+
+
+class DeadlineExceeded(MXNetError):
+    """The request's deadline passed before a result could be
+    delivered (shed in queue, or resolved too late)."""
+
+
+class QueueOverflow(MXNetError):
+    """Admission denied: the bounded queue (``max_queue_rows``) is full
+    and the overload policy is ``shed``."""
+
+
+class CircuitOpen(MXNetError):
+    """The dispatch breaker is open (too many consecutive failures) —
+    the engine fast-fails instead of queuing onto a dead backend."""
+
+
+class EngineClosed(MXNetError):
+    """``submit``/``flush`` after ``close()``."""
 
 
 def bucket_sizes(max_batch):
@@ -108,20 +163,48 @@ def _quiet_recompile(fn):
 
 
 class _Request:
-    __slots__ = ("arrays", "rows", "future", "wait_span", "req_span")
+    __slots__ = ("arrays", "rows", "future", "wait_span", "req_span",
+                 "deadline")
 
-    def __init__(self, arrays, rows):
+    def __init__(self, arrays, rows, deadline=None):
         self.arrays = arrays          # {input name: np.ndarray (rows,...)}
         self.rows = rows
+        self.deadline = deadline      # monotonic instant, or None
         self.future = Future()
         # spans are entered on the submitting thread and closed on the
         # coalescer / resolver threads — _Span carries its own t0
         self.wait_span = telemetry.span("serve_wait").__enter__()
         self.req_span = telemetry.span("serve_request").__enter__()
 
+    def expired(self, now=None):
+        return self.deadline is not None \
+            and (now if now is not None else time.monotonic()) \
+            > self.deadline
+
 
 _FLUSH = object()
 _SHUTDOWN = object()
+
+# substrings that mark a backend error as transient (worth a retry):
+# RPC-layer flakes on a remoted PJRT backend, never compiler/program
+# errors
+_TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED",
+                      "Connection reset", "connection", "socket closed")
+
+
+def _is_transient(exc):
+    """Whether a dispatch failure is worth retrying: injected faults
+    flagged ``transient`` (faults.InjectedFault) and RPC-ish backend
+    errors are; program errors (TypeError/ValueError — wrong
+    shape/dtype, they fail identically every time) and OOM
+    (DeviceMemoryError — retrying allocates the same bytes) never
+    are."""
+    if getattr(exc, "transient", False):
+        return True
+    if isinstance(exc, (TypeError, ValueError, DeviceMemoryError)):
+        return False
+    s = str(exc)
+    return any(m in s for m in _TRANSIENT_MARKERS)
 
 
 class InferenceEngine:
@@ -165,12 +248,33 @@ class InferenceEngine:
         silently to the defaults when the corpus is absent or empty;
         the chosen plan is stamped onto every bucket's program card
         (``autotune_plan``) and reported by ``stats()``
+    max_queue_rows : int | None — admission bound: rows allowed to wait
+        for a bucket (queued + pending, excludes in-flight batches).
+        ``None`` (default) keeps the legacy unbounded queue
+    deadline_ms : float | None — engine-wide default request deadline
+        (per-request ``submit(deadline_ms=)`` overrides); enforced at
+        admission, coalesce and resolution (``DeadlineExceeded``)
+    overload : "shed" | "block" — full-queue policy: fail the submit
+        fast (``QueueOverflow``) or backpressure the submitting thread
+        (bounded by the request deadline, if any)
+    retry_budget : int — max retries of one coalesced batch's dispatch
+        on TRANSIENT failures (injected faults, flaky backend RPCs);
+        program errors (shape/dtype/OOM) never retry
+    retry_backoff_ms : float — base backoff before retry k is
+        ``retry_backoff_ms * 2**k``
+    breaker_threshold : int — consecutive dispatch failures that trip
+        the breaker into fast-fail (``CircuitOpen``); 0 disables
+    breaker_reset_s : float — open-state cooldown before ONE half-open
+        trial batch is allowed through (success closes the breaker)
     """
 
     def __init__(self, symbol=None, params=None, input_shapes=None,
                  ctx=None, max_batch=32, max_wait_ms=2.0, max_inflight=None,
                  dtype=None, warmup=True, telemetry_logger=None,
-                 predictor=None, buckets=None, autotune=False):
+                 predictor=None, buckets=None, autotune=False,
+                 max_queue_rows=None, deadline_ms=None, overload="shed",
+                 retry_budget=2, retry_backoff_ms=5.0,
+                 breaker_threshold=5, breaker_reset_s=30.0):
         if predictor is None:
             if symbol is None or input_shapes is None:
                 raise MXNetError("InferenceEngine needs (symbol, params, "
@@ -219,8 +323,28 @@ class InferenceEngine:
         self._rng = ex._step_key()
         self._forward = self._prog.forward_fn(False)
 
+        if overload not in ("shed", "block"):
+            raise MXNetError("serving: overload must be 'shed' or "
+                             "'block', got %r" % (overload,))
+        self.max_queue_rows = None if max_queue_rows is None \
+            else max(1, int(max_queue_rows))
+        self.deadline_s = None if deadline_ms is None \
+            else float(deadline_ms) / 1e3
+        self.overload = overload
+        self._retry_budget = max(0, int(retry_budget))
+        self._retry_backoff_s = max(0.0, float(retry_backoff_ms)) / 1e3
+        self._breaker_threshold = max(0, int(breaker_threshold))
+        self._breaker_reset_s = float(breaker_reset_s)
+        self._breaker_open_at = None     # monotonic trip instant
+        self._breaker_probing = False    # one half-open trial at a time
+        self._consecutive_failures = 0
+        self._queued_rows = 0            # admitted, not yet dispatched
+
         self._logger = telemetry_logger
         self._lock = threading.Lock()
+        # admission backpressure: notified whenever queued rows leave
+        # the admission queue (dispatch or shed)
+        self._space = threading.Condition(self._lock)
         self._stats = collections.Counter()
         self._bucket_batches = collections.Counter()
         # measured serving data the card corpus persists for the
@@ -386,14 +510,48 @@ class InferenceEngine:
                 if k == entry or k.startswith(entry + "/")}
 
     # -- request surface ----------------------------------------------------
-    def submit(self, *args, **kwargs):
+    def _shed(self, req, cause, exc):
+        """Resolve one request's future with a structured shed error and
+        account it (engine stats + telemetry, by cause). The wait/req
+        spans still close — shed time is real queue time."""
+        if req.future.done():
+            return
+        req.wait_span.__exit__(None, None, None)
+        req.req_span.__exit__(None, None, None)
+        req.future.set_exception(exc)
+        with self._lock:
+            self._stats["shed_requests"] += 1
+            self._stats["shed_rows"] += req.rows
+            self._stats["shed.%s" % cause] += 1
+        telemetry.counter_inc("serving.shed_requests")
+        telemetry.counter_inc("serving.shed_rows", req.rows)
+        telemetry.counter_inc("serving.shed.%s" % cause)
+        if isinstance(exc, DeadlineExceeded):
+            telemetry.counter_inc("serving.deadline_exceeded")
+
+    def submit(self, *args, deadline_ms=None, **kwargs):
         """Enqueue one request; returns a Future resolving to the list
         of per-output numpy arrays (each ``(rows, ...)``). Inputs go by
         name (``submit(data=x)``); a single-input graph also accepts one
         positional array. Each input must be ``(rows,) + row_shape``
-        with 1 <= rows <= max_batch."""
+        with 1 <= rows <= max_batch.
+
+        ``deadline_ms`` bounds this request's whole submit→result life
+        (default: the engine's ``deadline_ms``); past it the future
+        resolves with ``DeadlineExceeded``. A full bounded queue sheds
+        (``QueueOverflow``) or blocks, per the ``overload`` policy; an
+        open breaker fast-fails with ``CircuitOpen``."""
         if self._closed:                 # fast path; re-checked under
-            raise MXNetError("serving: engine is closed")   # the lock
+            raise EngineClosed("serving: engine is closed")   # the lock
+        if self._breaker_tripped():
+            with self._lock:
+                self._stats["breaker_fastfail"] += 1
+            telemetry.counter_inc("serving.breaker_fastfail")
+            raise CircuitOpen(
+                "serving: breaker open after %d consecutive dispatch "
+                "failures — fast-failing instead of queuing onto a "
+                "failing backend (retries again %.1fs after the trip)"
+                % (self._consecutive_failures, self._breaker_reset_s))
         if args:
             if len(args) != 1 or kwargs or len(self._input_names) != 1:
                 raise MXNetError("serving: pass inputs by name "
@@ -424,16 +582,59 @@ class InferenceEngine:
         if rows > self.max_batch:
             raise MXNetError("serving: request rows %d exceed max_batch %d"
                              % (rows, self.max_batch))
-        req = _Request(arrays, rows)
+        dl_s = self.deadline_s if deadline_ms is None \
+            else float(deadline_ms) / 1e3
+        deadline = None if dl_s is None else time.monotonic() + dl_s
+        req = _Request(arrays, rows, deadline=deadline)
         # the closed-check and the enqueue share the lock with close()'s
         # flag-set + sentinel-put: a request that passes the check is
         # guaranteed to land BEFORE the shutdown sentinel, so its future
         # always resolves
-        with self._lock:
+        def _drop(exc, shed=False, deadline_hit=False):
+            # an admission-rejected request never enters the queue, but
+            # its spans were entered at _Request construction: close
+            # them (the rejection time is a real latency sample) and
+            # account the shed. Caller holds self._lock.
+            req.wait_span.__exit__(None, None, None)
+            req.req_span.__exit__(None, None, None)
+            if shed:
+                self._stats["shed_requests"] += 1
+                self._stats["shed_rows"] += rows
+                self._stats["shed.admission"] += 1
+                telemetry.counter_inc("serving.shed_requests")
+                telemetry.counter_inc("serving.shed_rows", rows)
+                telemetry.counter_inc("serving.shed.admission")
+                if deadline_hit:
+                    telemetry.counter_inc("serving.deadline_exceeded")
+            raise exc
+
+        with self._space:
             if self._closed:
-                raise MXNetError("serving: engine is closed")
+                _drop(EngineClosed("serving: engine is closed"))
+            # bounded admission: shed fast or backpressure (bounded by
+            # the request's own deadline)
+            while self.max_queue_rows is not None \
+                    and self._queued_rows + rows > self.max_queue_rows:
+                if self.overload == "shed":
+                    _drop(QueueOverflow(
+                        "serving: admission queue full (%d rows "
+                        "waiting, max_queue_rows=%d) — shedding"
+                        % (self._queued_rows, self.max_queue_rows)),
+                        shed=True)
+                timeout = None if deadline is None \
+                    else deadline - time.monotonic()
+                if timeout is not None and timeout <= 0 \
+                        or not self._space.wait(timeout):
+                    _drop(DeadlineExceeded(
+                        "serving: deadline expired while blocked on a "
+                        "full admission queue (max_queue_rows=%d)"
+                        % self.max_queue_rows), shed=True,
+                        deadline_hit=True)
+                if self._closed:
+                    _drop(EngineClosed("serving: engine is closed"))
             self._stats["requests"] += 1
             self._stats["rows"] += rows
+            self._queued_rows += rows
             self._q.put(req)
         telemetry.counter_inc("serving.requests")
         telemetry.counter_inc("serving.rows", rows)
@@ -445,8 +646,13 @@ class InferenceEngine:
 
     def flush(self):
         """Ask the coalescer to dispatch whatever is pending now instead
-        of waiting out the deadline."""
-        self._q.put(_FLUSH)
+        of waiting out the deadline. Fails fast with ``EngineClosed``
+        after ``close()`` (the unguarded version put a sentinel into a
+        dead queue nobody would ever drain)."""
+        with self._lock:
+            if self._closed:
+                raise EngineClosed("serving: engine is closed")
+            self._q.put(_FLUSH)
 
     def stats(self):
         """Engine-side counters + the request-latency percentiles: what
@@ -463,16 +669,48 @@ class InferenceEngine:
         rows = st.get("batch_rows", 0)
         pad = st.get("pad_rows", 0)
         lat = telemetry.span_stats("serve_request").get("serve_request", {})
+        with self._lock:
+            queued_rows = self._queued_rows
+            breaker_open = self._breaker_tripped()
+            consecutive = self._consecutive_failures
+        # depth = admitted requests not yet terminally resolved.
+        # Admission sheds never entered "requests" (they must not go
+        # negative here); coalesce/resolve/breaker sheds and failed
+        # requests DID, and each terminates its future.
+        admitted_sheds = st.get("shed_requests", 0) \
+            - st.get("shed.admission", 0)
         return {
             "requests": st.get("requests", 0),
             "resolved": st.get("resolved", 0),
-            "queue_depth": st.get("requests", 0) - st.get("resolved", 0),
+            "failed_requests": st.get("failed_requests", 0),
+            "queue_depth": st.get("requests", 0) - st.get("resolved", 0)
+            - admitted_sheds - st.get("failed_requests", 0),
             "batches": st.get("batches", 0),
             "rows": st.get("rows", 0),
             "pad_rows": pad,
             "pad_bytes": st.get("pad_bytes", 0),
             "batch_fill": round(rows / (rows + pad), 4) if rows + pad
             else None,
+            # overload-control trajectory: what the chaos lane and a
+            # load balancer's health endpoint read
+            "queued_rows": queued_rows,
+            "max_queue_rows": self.max_queue_rows,
+            "deadline_ms": None if self.deadline_s is None
+            else round(self.deadline_s * 1e3, 3),
+            "overload": self.overload,
+            "shed_requests": st.get("shed_requests", 0),
+            "shed_rows": st.get("shed_rows", 0),
+            "shed_by_cause": {k[len("shed."):]: v for k, v in st.items()
+                              if k.startswith("shed.")},
+            "retries": st.get("retries", 0),
+            "dispatch_failures": st.get("dispatch_failures", 0),
+            "breaker": {
+                "open": breaker_open,
+                "threshold": self._breaker_threshold,
+                "consecutive_failures": consecutive,
+                "trips": st.get("breaker_trips", 0),
+                "fastfail": st.get("breaker_fastfail", 0),
+            },
             "buckets": {str(k): v for k, v in
                         sorted(self._bucket_batches.items())},
             # the measured serving data the card corpus persists:
@@ -529,14 +767,17 @@ class InferenceEngine:
     def close(self):
         """Drain and stop: already-submitted requests (queued, pending,
         or in flight) all resolve before close() returns; later
-        ``submit`` calls raise."""
-        with self._lock:
+        ``submit``/``flush`` calls raise ``EngineClosed``. Submitters
+        blocked on a full queue (overload="block") are woken and fail
+        the same way."""
+        with self._space:
             if self._closed:
                 already = True
             else:
                 already = False
                 self._closed = True
                 self._q.put(_SHUTDOWN)
+                self._space.notify_all()
         if already:
             return
         self._thread.join()
@@ -567,6 +808,28 @@ class InferenceEngine:
         return False
 
     # -- coalescer ----------------------------------------------------------
+    def _launch(self, batch):
+        """Release a coalesced batch from the admission queue, shed the
+        stale members (their deadline passed while they waited — they
+        must not pad a bucket and burn device time on an answer nobody
+        reads), and dispatch the survivors."""
+        with self._space:
+            self._queued_rows -= sum(r.rows for r in batch)
+            self._space.notify_all()
+        now = time.monotonic()
+        live = []
+        for r in batch:
+            if r.expired(now):
+                self._shed(r, "coalesce", DeadlineExceeded(
+                    "serving: request deadline expired in queue "
+                    "(waited past %.1fms)" % (
+                        0.0 if r.deadline is None
+                        else (now - r.deadline) * 1e3)))
+            else:
+                live.append(r)
+        if live:
+            self._dispatch(live)
+
     def _coalesce_loop(self):
         pending, pending_rows = [], 0
         deadline = None
@@ -577,7 +840,7 @@ class InferenceEngine:
                 batch, pending = pending, []
                 pending_rows = 0
                 deadline = None
-                self._dispatch(batch)
+                self._launch(batch)
 
         while True:
             if pending:
@@ -624,11 +887,94 @@ class InferenceEngine:
                 r = left.pop(0)
                 batch.append(r)
                 rows += r.rows
-            self._dispatch(batch)
+            self._launch(batch)
+
+    # -- breaker ------------------------------------------------------------
+    def _breaker_tripped(self):
+        """True while the breaker is open AND still cooling (fast-fail
+        window). After ``breaker_reset_s`` the engine goes half-open:
+        submits are admitted again and ONE trial batch probes the
+        backend. Lock-free (monotonic reads) — stats() calls this under
+        the lock."""
+        opened = self._breaker_open_at
+        if opened is None:
+            return False
+        return (time.monotonic() - opened) < self._breaker_reset_s
+
+    def reset_breaker(self):
+        """Force the breaker closed (operator override)."""
+        with self._lock:
+            self._breaker_open_at = None
+            self._breaker_probing = False
+            self._consecutive_failures = 0
+
+    def _fail_requests(self, reqs, exc):
+        """Resolve every still-pending member future with ``exc`` and
+        count them: a failed request is neither resolved nor shed, and
+        without its own counter the queue-depth arithmetic would count
+        it as queued forever."""
+        failed = 0
+        for r in reqs:
+            if not r.future.done():
+                r.future.set_exception(exc)
+                failed += 1
+        if failed:
+            with self._lock:
+                self._stats["failed_requests"] += failed
+            telemetry.counter_inc("serving.failed_requests", failed)
+
+    def _dispatch_failed(self):
+        """One coalesced batch's pipeline failed for good — at LAUNCH
+        (retries exhausted / non-retryable) or at the RESOLUTION fetch
+        (on an async backend a dead device often surfaces at
+        ``np.asarray``, not at the dispatch call, so the fetch feeds
+        the breaker too): bump the consecutive count and trip/re-trip
+        the breaker at the threshold."""
+        with self._lock:
+            self._stats["dispatch_failures"] += 1
+            self._consecutive_failures += 1
+            self._breaker_probing = False
+            trip = (self._breaker_threshold > 0
+                    and self._consecutive_failures
+                    >= self._breaker_threshold)
+            if trip:
+                self._breaker_open_at = time.monotonic()
+                self._stats["breaker_trips"] += 1
+        telemetry.counter_inc("serving.dispatch_failures")
+        if trip:
+            telemetry.counter_inc("serving.breaker_trips")
+
+    def _dispatch_succeeded(self):
+        with self._lock:
+            self._consecutive_failures = 0
+            self._breaker_open_at = None
+            self._breaker_probing = False
 
     def _dispatch(self, reqs):
         """Pack ``reqs`` into the smallest covering bucket, launch the
-        bucket's program (async), and hand resolution to the pool."""
+        bucket's program (async, with the transient-failure retry
+        budget), and hand resolution to the pool. With the breaker open
+        the batch fast-fails (``CircuitOpen``) — except the one
+        half-open trial per cooldown that probes the backend."""
+        with self._lock:
+            opened = self._breaker_open_at
+            fastfail = False
+            if opened is not None:
+                cooling = (time.monotonic() - opened) \
+                    < self._breaker_reset_s
+                if cooling or self._breaker_probing:
+                    fastfail = True
+                else:
+                    self._breaker_probing = True    # the half-open trial
+        if fastfail:
+            with self._lock:
+                self._stats["breaker_fastfail"] += len(reqs)
+            telemetry.counter_inc("serving.breaker_fastfail", len(reqs))
+            exc = CircuitOpen(
+                "serving: breaker open — dispatch suppressed")
+            for r in reqs:
+                self._shed(r, "breaker", exc)
+            return
         self._inflight.acquire()
         try:
             rows = sum(r.rows for r in reqs)
@@ -648,9 +994,30 @@ class InferenceEngine:
                 telemetry.record_transfer(buf.nbytes)
                 args[n] = jax.device_put(buf, self._device)
             args.update(self._bucket_extras(bucket))
-            record_dispatch("serve")
-            with telemetry.span("serve_batch"):
-                outs, _ = self._forward(args, self._aux_raw, self._rng)
+            attempt = 0
+            while True:
+                try:
+                    record_dispatch("serve")
+                    with telemetry.span("serve_batch"):
+                        outs, _ = self._forward(args, self._aux_raw,
+                                                self._rng)
+                    break
+                except Exception as e:
+                    # retry ONLY transient faults, within the budget —
+                    # a program error (shape/dtype/OOM) fails the same
+                    # way every time and retrying it is pure waste
+                    if attempt >= self._retry_budget \
+                            or not _is_transient(e):
+                        raise
+                    attempt += 1
+                    with self._lock:
+                        self._stats["retries"] += 1
+                    telemetry.counter_inc("serving.retries")
+                    time.sleep(self._retry_backoff_s
+                               * (2 ** (attempt - 1)))
+            # success (and the breaker reset / half-open close) is
+            # declared in _resolve once the FETCH lands: on an async
+            # backend the launch returning proves nothing yet
             with self._lock:
                 self._stats["batches"] += 1
                 self._stats["batch_rows"] += rows
@@ -666,9 +1033,11 @@ class InferenceEngine:
                               time.perf_counter())
         except BaseException as e:
             self._inflight.release()
-            for r in reqs:
-                if not r.future.done():
-                    r.future.set_exception(e)
+            self._dispatch_failed()
+            # EVERY member's future resolves with the failure — a
+            # mid-flight dispatch error must never strand a pending
+            # Future.result()
+            self._fail_requests(reqs, e)
         else:
             if self._logger is not None:
                 try:
@@ -678,31 +1047,53 @@ class InferenceEngine:
 
     def _resolve(self, outs, reqs, bucket=None, t_disp=None):
         """Resolver-pool worker: blocking d2h of the whole padded batch,
-        then slice each request's rows off and resolve its future.
-        The dispatch->fetched wall-time charges the bucket's measured
-        step-ms tally — the corpus figure the autotuner's cost model
-        interpolates over."""
+        then slice each request's rows off and resolve its future — or
+        resolve with ``DeadlineExceeded`` when the result arrived past
+        the request's deadline (the client stopped caring; delivering
+        late data as success would hide the overload the deadline
+        exists to expose). The dispatch->fetched wall-time charges the
+        bucket's measured step-ms tally — the corpus figure the
+        autotuner's cost model interpolates over."""
         try:
+            # chaos site: a raise is a failed fetch (every member future
+            # resolves with it below); "nan" corrupts the host copy —
+            # what the chaos lane's divergence assertions feed on
+            act = faults.fire("d2h") if faults.active() else None
             with telemetry.span("serve_d2h"):
                 host = [np.asarray(o) for o in outs]
+            if act == "nan":
+                host = faults.poison(host)
+            # the fetch landing is the REAL success signal (async
+            # dispatch: a dead backend surfaces here, not at launch) —
+            # close the half-open trial / reset the breaker now
+            self._dispatch_succeeded()
             if bucket is not None and t_disp is not None:
                 dt = time.perf_counter() - t_disp
                 with self._lock:
                     lat = self._bucket_lat.setdefault(bucket, [0.0, 0])
                     lat[0] += dt
                     lat[1] += 1
+            now = time.monotonic()
             off = 0
             for r in reqs:
                 sl = [h[off:off + r.rows] for h in host]
                 off += r.rows
+                if r.expired(now):
+                    self._shed(r, "resolve", DeadlineExceeded(
+                        "serving: result arrived %.1fms past the "
+                        "request deadline"
+                        % ((now - r.deadline) * 1e3)))
+                    continue
                 r.req_span.__exit__(None, None, None)
                 with self._lock:
                     self._stats["resolved"] += 1
                 telemetry.counter_inc("serving.resolved")
                 r.future.set_result(sl)
         except BaseException as e:
-            for r in reqs:
-                if not r.future.done():
-                    r.future.set_exception(e)
+            # a failed FETCH is a batch-pipeline failure like a failed
+            # launch: it feeds the breaker's consecutive count and the
+            # futures resolve with the error (never strand)
+            self._dispatch_failed()
+            self._fail_requests(reqs, e)
         finally:
             self._inflight.release()

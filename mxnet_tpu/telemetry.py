@@ -59,7 +59,7 @@ __all__ = [
     "counter_inc", "counters", "snapshot", "span", "span_stats",
     "span_count", "span_durations", "span_seconds",
     "on_dispatch", "remove_dispatch", "dispatch_event",
-    "record_jit", "record_fallback", "record_transfer",
+    "record_jit", "record_fallback", "record_fault", "record_transfer",
     "record_host_sync", "chrome_events", "mark_trace_start",
     "record_program", "program_dispatch", "programs", "card_update",
     "card_annotate",
@@ -240,6 +240,15 @@ def record_fallback(code):
     """One fused-step fallback event, keyed by the stable
     ``FusedFallback.code`` (module/base_module.FUSED_FALLBACK_CODES)."""
     counter_inc("fused_fallback.%s" % code)
+
+
+def record_fault(site):
+    """One INJECTED fault fired at a named ``faults.py`` site — counted
+    as ``faults.injected.<site>`` (total under ``faults.injected``) so
+    the chaos lane's artifact carries exact fire counts next to the
+    shed/retry/resume counters the injections caused."""
+    counter_inc("faults.injected")
+    counter_inc("faults.injected.%s" % site)
 
 
 def record_transfer(nbytes, direction="h2d"):

@@ -1,0 +1,351 @@
+"""Preemption-safe training (ISSUE 7): atomic checkpoints,
+CheckpointManager rotation/latest/restore, signal-armed preemption,
+``Module.fit(resume=...)`` equivalence, and the divergence sentinel."""
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.checkpoint import (CheckpointManager, TrainingPreempted,
+                                  DivergenceError, atomic_write,
+                                  atomic_save_ndarrays)
+
+D, HID, C, N, BATCH = 4, 8, 2, 32, 8
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=HID, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=C, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _iter():
+    rng = np.random.RandomState(7)
+    X = rng.normal(size=(N, D)).astype(np.float32)
+    y = rng.randint(0, C, (N,)).astype(np.float32)
+    return mx.io.NDArrayIter(X, y, batch_size=BATCH, shuffle=False,
+                             label_name="softmax_label")
+
+
+def _fresh_module():
+    np.random.seed(0)
+    mx.random.seed(0)
+    return mx.mod.Module(_mlp(), label_names=["softmax_label"])
+
+
+FIT_KW = dict(optimizer="sgd",
+              optimizer_params=(("learning_rate", 0.1),
+                                ("momentum", 0.9)))
+
+
+# ---------------------------------------------------------------------------
+# Atomic writers
+# ---------------------------------------------------------------------------
+
+def test_atomic_write_replaces_and_leaves_no_temp(tmp_path):
+    p = tmp_path / "f.bin"
+    atomic_write(str(p), b"one")
+    atomic_write(str(p), b"two")
+    assert p.read_bytes() == b"two"
+    assert [x for x in os.listdir(tmp_path) if x != "f.bin"] == []
+
+
+def test_atomic_write_failure_keeps_previous_file(tmp_path, monkeypatch):
+    p = tmp_path / "f.bin"
+    atomic_write(str(p), b"good")
+
+    real_replace = os.replace
+
+    def boom(src, dst):
+        raise OSError("disk died mid-rename")
+
+    monkeypatch.setattr(os, "replace", boom)
+    with pytest.raises(OSError):
+        atomic_write(str(p), b"partial")
+    monkeypatch.setattr(os, "replace", real_replace)
+    assert p.read_bytes() == b"good"                 # old file intact
+    assert [x for x in os.listdir(tmp_path) if x != "f.bin"] == []
+
+
+def test_atomic_save_ndarrays_roundtrip(tmp_path):
+    p = str(tmp_path / "x.params")
+    atomic_save_ndarrays(p, {"arg:w": mx.nd.ones((2, 3))})
+    loaded = mx.nd.load(p)
+    assert np.allclose(loaded["arg:w"].asnumpy(), 1.0)
+    assert os.listdir(tmp_path) == ["x.params"]
+
+
+def test_model_save_checkpoint_is_atomic(tmp_path):
+    # the params file appears complete or not at all: the writer goes
+    # through a temp name, so a concurrent load of the FINAL name never
+    # sees a partial container
+    from mxnet_tpu.model import save_checkpoint, load_checkpoint
+    prefix = str(tmp_path / "m")
+    sym = _mlp()
+    arg = {"fc1_weight": mx.nd.ones((HID, D))}
+    save_checkpoint(prefix, 1, sym, arg, {})
+    s2, a2, x2 = load_checkpoint(prefix, 1)
+    assert np.allclose(a2["fc1_weight"].asnumpy(), 1.0)
+    leftovers = [f for f in os.listdir(tmp_path) if ".tmp" in f]
+    assert leftovers == []
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager
+# ---------------------------------------------------------------------------
+
+def _fitted_module(tmp_path, epochs=1):
+    mod = _fresh_module()
+    mod.fit(_iter(), num_epoch=epochs, **FIT_KW)
+    return mod
+
+
+def test_manager_save_latest_meta_schema(tmp_path):
+    mod = _fitted_module(tmp_path)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    meta = mgr.save(mod, epoch=1, nbatch=2)
+    assert meta["epoch"] == 1 and meta["nbatch"] == 2
+    got = mgr.latest()
+    assert got["epoch"] == 1 and got["nbatch"] == 2
+    assert got["optimizer_states"] is True
+    assert isinstance(got["rng_state"], list)
+    assert got["update_counts"]                      # sgd counts saved
+    for suffix in ("-0001.params", "-0001.states", "-0001.meta.json",
+                   "-symbol.json"):
+        assert os.path.exists(str(tmp_path / "ck") + suffix)
+
+
+def test_manager_keeps_last_k(tmp_path):
+    mod = _fitted_module(tmp_path)
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep_last=2)
+    for e in range(1, 6):
+        mgr.save(mod, epoch=e)
+    assert mgr.epochs() == [4, 5]
+    assert not os.path.exists(str(tmp_path / "ck") + "-0001.params")
+    assert os.path.exists(str(tmp_path / "ck") + "-0005.params")
+
+
+def test_latest_skips_corrupt_meta(tmp_path):
+    mod = _fitted_module(tmp_path)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(mod, epoch=1)
+    mgr.save(mod, epoch=2)
+    with open(str(tmp_path / "ck") + "-0002.meta.json", "w") as f:
+        f.write('{"trunc')                           # killed mid-write
+    assert mgr.latest()["epoch"] == 1
+
+
+def test_epochs_sees_wide_ids_and_metachar_prefixes(tmp_path):
+    # %04d widens past 4 digits at epoch 10000, and a prefix with glob
+    # metacharacters must still resolve — epochs() matches by regex
+    # over a listing, not by glob
+    sub = tmp_path / "run[1]"
+    sub.mkdir()
+    mgr = CheckpointManager(str(sub / "ck"), keep_last=10)
+    for e in (9999, 10000):
+        with open("%s-%04d.meta.json" % (mgr.prefix, e), "w") as f:
+            json.dump({"epoch": e, "nbatch": 0, "param_epoch": e}, f)
+    assert mgr.epochs() == [9999, 10000]
+    assert mgr.latest()["epoch"] == 10000
+
+
+def test_latest_none_when_empty(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    assert mgr.latest() is None
+    with pytest.raises(MXNetError):
+        mgr.load()
+
+
+def test_restore_roundtrips_params_states_and_rng(tmp_path):
+    mod = _fitted_module(tmp_path)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(mod, epoch=1)
+    arg0, aux0 = mod.get_params()
+    rng0 = mx.random.get_state()
+    counts0 = dict(mod._optimizer._index_update_count)
+    # wreck everything, then restore
+    mod.set_params({k: mx.nd.zeros(v.shape) for k, v in arg0.items()},
+                   aux0)
+    mx.random.seed(999)
+    meta = mgr.restore(mod)
+    arg1, _ = mod.get_params()
+    for k in arg0:
+        assert np.allclose(arg0[k].asnumpy(), arg1[k].asnumpy())
+    assert mx.random.get_state() == rng0
+    assert dict(mod._optimizer._index_update_count) == counts0
+    assert meta["epoch"] == 1
+
+
+def test_rng_state_roundtrip_replays_key_sequence():
+    mx.random.seed(3)
+    mx.random.take_key()
+    state = mx.random.get_state()
+    a = np.asarray(jax.random.key_data(mx.random.take_key()))
+    mx.random.set_state(state)
+    b = np.asarray(jax.random.key_data(mx.random.take_key()))
+    assert (a == b).all()
+
+
+# ---------------------------------------------------------------------------
+# Preemption
+# ---------------------------------------------------------------------------
+
+def test_programmatic_preempt_saves_and_raises(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mod = _fresh_module()
+
+    def preempt(param):
+        if param.epoch == 0 and param.nbatch == 1:
+            mgr.request_preempt("maintenance-poller")
+
+    with pytest.raises(TrainingPreempted) as ei:
+        mod.fit(_iter(), num_epoch=2, checkpoint=mgr,
+                batch_end_callback=preempt, **FIT_KW)
+    assert ei.value.epoch == 0 and ei.value.nbatch == 2
+    meta = mgr.latest()
+    assert meta["epoch"] == 0 and meta["nbatch"] == 2
+
+
+def test_sigterm_mid_epoch_then_resume_matches_uninterrupted(tmp_path):
+    """The ISSUE 7 acceptance scenario: SIGTERM mid-epoch → auto
+    checkpoint → ``fit(resume=...)`` in a fresh module reaches the SAME
+    parameters as an uninterrupted run (deterministic data, momentum
+    state + update counts + RNG restored)."""
+    # leg A: uninterrupted oracle
+    mod_a = _fresh_module()
+    mod_a.fit(_iter(), num_epoch=3, **FIT_KW)
+    arg_a, _ = mod_a.get_params()
+
+    # leg B: SIGTERM at epoch 1, batch 1 (the armed handler sets the
+    # flag; the loop finishes the batch, saves, raises)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mod_b = _fresh_module()
+
+    def kill(param):
+        if param.epoch == 1 and param.nbatch == 1:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    prev = signal.getsignal(signal.SIGTERM)
+    with pytest.raises(TrainingPreempted):
+        mod_b.fit(_iter(), num_epoch=3, checkpoint=mgr,
+                  batch_end_callback=kill, **FIT_KW)
+    # the armed handler is restored on the way out
+    assert signal.getsignal(signal.SIGTERM) == prev
+    meta = mgr.latest()
+    assert meta["epoch"] == 1 and meta["nbatch"] == 2
+
+    # leg B resumed, in a FRESH module (new process semantics)
+    mod_c = mx.mod.Module(_mlp(), label_names=["softmax_label"])
+    mod_c.fit(_iter(), num_epoch=3, checkpoint=mgr, resume=True,
+              **FIT_KW)
+    arg_c, _ = mod_c.get_params()
+    for k in arg_a:
+        np.testing.assert_allclose(
+            arg_a[k].asnumpy(), arg_c[k].asnumpy(),
+            rtol=1e-5, atol=1e-6,
+            err_msg="resumed run diverged from oracle at %s" % k)
+
+
+def test_resume_with_no_checkpoint_is_fresh_start(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mod = _fresh_module()
+    mod.fit(_iter(), num_epoch=1, checkpoint=mgr, resume=True, **FIT_KW)
+    assert mgr.latest()["epoch"] == 1        # epoch-end save happened
+
+
+def test_resume_requires_a_manager():
+    mod = _fresh_module()
+    with pytest.raises(MXNetError):
+        mod.fit(_iter(), num_epoch=1, resume=True, **FIT_KW)
+
+
+def test_epoch_end_saves_rotate(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep_last=2)
+    mod = _fresh_module()
+    mod.fit(_iter(), num_epoch=4, checkpoint=mgr, **FIT_KW)
+    assert mgr.epochs() == [3, 4]
+    assert mgr.latest()["epoch"] == 4 and mgr.latest()["nbatch"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Divergence sentinel
+# ---------------------------------------------------------------------------
+
+def test_finite_check_device_fold_detects_nan():
+    mod = _fresh_module()
+    it = _iter()
+    mod.fit(it, num_epoch=1, **FIT_KW)
+    assert mod.finite_check() is True
+    arg, aux = mod.get_params()
+    k = sorted(arg)[0]
+    host = arg[k].asnumpy().copy()
+    host.reshape(-1)[0] = np.nan
+    arg[k] = mx.nd.array(host)
+    mod.set_params(arg, aux)
+    assert mod.finite_check() is False
+
+
+def test_divergence_halt_policy_raises(tmp_path):
+    faults.configure("io_next:nan:n=2")      # poison the 2nd batch
+    mod = _fresh_module()
+    with pytest.raises(DivergenceError):
+        mod.fit(_iter(), num_epoch=1, divergence_check_every=1, **FIT_KW)
+
+
+def test_divergence_skip_policy_continues(tmp_path):
+    telemetry.enable()
+    base = telemetry.counters().get("divergence.skipped", 0)
+    faults.configure("io_next:nan:n=2")
+    mod = _fresh_module()
+    mod.fit(_iter(), num_epoch=1, divergence_check_every=1,
+            divergence_policy="skip", **FIT_KW)
+    # the poisoned batch's NaN sticks in the params, so every later
+    # check also skips — at least the first detection must have counted
+    assert telemetry.counters().get("divergence.skipped", 0) >= base + 1
+
+
+def test_divergence_rollback_policy_restores_checkpoint(tmp_path):
+    telemetry.enable()
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mod = _fresh_module()
+    mod.fit(_iter(), num_epoch=1, checkpoint=mgr, **FIT_KW)   # ck @ ep1
+    base = telemetry.counters().get("divergence.rollback", 0)
+    faults.configure("io_next:nan:n=2")      # one poisoned batch
+    mod.fit(_iter(), num_epoch=2, checkpoint=mgr, resume=True,
+            divergence_check_every=1, divergence_policy="rollback",
+            begin_epoch=1, **FIT_KW)
+    assert telemetry.counters().get("divergence.rollback", 0) == base + 1
+    assert mod.finite_check() is True        # recovered, finite params
+
+
+def test_divergence_rollback_without_checkpoint_halts():
+    faults.configure("io_next:nan:n=2")
+    mod = _fresh_module()
+    with pytest.raises(DivergenceError):
+        mod.fit(_iter(), num_epoch=1, divergence_check_every=1,
+                divergence_policy="rollback", **FIT_KW)
+
+
+def test_bad_divergence_policy_rejected():
+    mod = _fresh_module()
+    with pytest.raises(MXNetError):
+        mod.fit(_iter(), num_epoch=1, divergence_policy="explode",
+                **FIT_KW)

@@ -1,0 +1,57 @@
+"""Heartbeat liveness file semantics (ISSUE 7 satellite): atomic beat
+writes (no truncate-in-place window) and stop_heartbeat removing the
+worker file instead of leaving it to go stale."""
+import os
+import time
+
+from mxnet_tpu import heartbeat
+
+
+def _wait_for(pred, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def test_beat_writes_atomically_and_stop_removes_file(tmp_path):
+    root = str(tmp_path)
+    heartbeat.start_heartbeat(0, root=root, interval=0.05)
+    try:
+        path = os.path.join(root, "worker-0")
+        assert _wait_for(lambda: os.path.exists(path))
+        # the visible file is always COMPLETE: a reader never sees the
+        # zero-length truncate window the old in-place write had
+        for _ in range(20):
+            with open(path) as f:
+                content = f.read()
+            assert content and float(content) > 0
+            time.sleep(0.01)
+        assert heartbeat.count_dead(1, root=root, timeout=10) == 0
+    finally:
+        heartbeat.stop_heartbeat()
+    # stop removes the file (and its temp): the worker reads as
+    # departed immediately, not alive-until-stale
+    assert _wait_for(lambda: not os.path.exists(path))
+    assert not os.path.exists(path + ".tmp")
+    assert heartbeat.count_dead(1, root=root, timeout=10) == 1
+
+
+def test_stop_heartbeat_idempotent(tmp_path):
+    heartbeat.stop_heartbeat()          # no beat running: no-op
+    heartbeat.start_heartbeat(3, root=str(tmp_path), interval=0.05)
+    heartbeat.stop_heartbeat()
+    heartbeat.stop_heartbeat()          # second stop: still a no-op
+
+
+def test_count_dead_stale_file_still_counts(tmp_path):
+    # a worker that died WITHOUT a clean stop leaves a stale file — the
+    # timeout path still catches it
+    root = str(tmp_path)
+    path = os.path.join(root, "worker-0")
+    with open(path, "w") as f:
+        f.write(str(time.time() - 100))
+    os.utime(path, (time.time() - 100, time.time() - 100))
+    assert heartbeat.count_dead(1, root=root, timeout=10) == 1

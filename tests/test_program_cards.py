@@ -422,7 +422,9 @@ def test_no_raw_jit_outside_instrumented_wrapper():
     for path in [os.path.join(root, "executor.py"),
                  os.path.join(root, "predictor.py"),
                  os.path.join(root, "serving.py"),
-                 os.path.join(root, "compile_cache.py")] + \
+                 os.path.join(root, "compile_cache.py"),
+                 os.path.join(root, "faults.py"),
+                 os.path.join(root, "checkpoint.py")] + \
             glob.glob(os.path.join(root, "module", "*.py")):
         with open(path) as f:
             for i, line in enumerate(f, 1):

@@ -1,0 +1,418 @@
+"""Serving overload control + failure resolution (ISSUE 7): bounded
+admission, deadlines at coalesce/resolve, shed-vs-block, the transient
+retry budget, the dispatch breaker, and the no-hung-futures contract."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.serving import (InferenceEngine, DeadlineExceeded,
+                               QueueOverflow, CircuitOpen, EngineClosed)
+
+D, HID, C = 4, 8, 2
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=HID, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=C, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _params(sym):
+    rng = np.random.RandomState(0)
+    shapes, _, _ = sym.infer_shape_partial(data=(2, D))
+    return {"arg:" + n: mx.nd.array(rng.normal(0, 0.1, s)
+                                    .astype(np.float32))
+            for n, s in zip(sym.list_arguments(), shapes)
+            if n not in ("data", "softmax_label")}
+
+
+def _engine(**kw):
+    sym = _mlp()
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_wait_ms", 1.0)
+    return InferenceEngine(sym, _params(sym), {"data": (1, D)}, **kw)
+
+
+def _req():
+    return np.random.RandomState(1).normal(size=(1, D)).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# Deadlines
+# ---------------------------------------------------------------------------
+
+def test_deadline_shed_at_coalesce_time():
+    eng = _engine(max_wait_ms=10000)        # only flush() dispatches
+    try:
+        f = eng.submit(data=_req(), deadline_ms=10)
+        time.sleep(0.05)
+        eng.flush()
+        with pytest.raises(DeadlineExceeded):
+            f.result(timeout=10)
+        st = eng.stats()
+        assert st["shed_requests"] == 1 and st["shed_rows"] == 1
+        assert st["shed_by_cause"] == {"coalesce": 1}
+        # a shed request is not queue depth
+        assert st["queue_depth"] == 0
+    finally:
+        eng.close()
+
+
+def test_deadline_shed_at_resolve_time():
+    # delay the d2h fetch past the deadline: the batch DID run, but the
+    # result arrives late and must resolve DeadlineExceeded, not succeed
+    eng = _engine()
+    try:
+        faults.configure("d2h:delay=120")
+        with pytest.raises(DeadlineExceeded):
+            eng.submit(data=_req(), deadline_ms=30).result(timeout=10)
+        assert eng.stats()["shed_by_cause"] == {"resolve": 1}
+    finally:
+        eng.close()
+
+
+def test_engine_default_deadline_applies():
+    eng = _engine(max_wait_ms=10000, deadline_ms=10)
+    try:
+        f = eng.submit(data=_req())          # no per-request deadline
+        time.sleep(0.05)
+        eng.flush()
+        with pytest.raises(DeadlineExceeded):
+            f.result(timeout=10)
+    finally:
+        eng.close()
+
+
+def test_no_deadline_means_no_shedding():
+    eng = _engine(max_wait_ms=10000)
+    try:
+        f = eng.submit(data=_req())
+        time.sleep(0.05)
+        eng.flush()
+        assert f.result(timeout=10)[0].shape == (1, C)
+        assert eng.stats()["shed_requests"] == 0
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Bounded admission queue
+# ---------------------------------------------------------------------------
+
+def test_admission_shed_when_queue_full():
+    eng = _engine(max_wait_ms=10000, max_queue_rows=3)
+    try:
+        fs = [eng.submit(data=_req()) for _ in range(3)]
+        with pytest.raises(QueueOverflow):
+            eng.submit(data=_req())
+        st = eng.stats()
+        assert st["queued_rows"] == 3
+        assert st["shed_by_cause"] == {"admission": 1}
+        eng.flush()
+        for f in fs:                         # admitted requests resolve
+            assert f.result(timeout=10)[0].shape == (1, C)
+        assert eng.stats()["queued_rows"] == 0
+    finally:
+        eng.close()
+
+
+def test_block_policy_backpressures_until_space():
+    eng = _engine(max_wait_ms=10000, max_queue_rows=2, overload="block")
+    try:
+        fs = [eng.submit(data=_req()) for _ in range(2)]
+        done = threading.Event()
+        holder = {}
+
+        def blocked_submit():
+            holder["future"] = eng.submit(data=_req())
+            done.set()
+
+        t = threading.Thread(target=blocked_submit, daemon=True)
+        t.start()
+        assert not done.wait(0.1)            # genuinely blocked
+        eng.flush()                          # drains the queue -> space
+        assert done.wait(5)
+        eng.flush()
+        assert holder["future"].result(timeout=10)[0].shape == (1, C)
+        for f in fs:
+            assert f.result(timeout=10)[0].shape == (1, C)
+    finally:
+        eng.close()
+
+
+def test_block_policy_gives_up_at_deadline():
+    eng = _engine(max_wait_ms=10000, max_queue_rows=1, overload="block")
+    try:
+        f0 = eng.submit(data=_req())
+        t0 = time.perf_counter()
+        with pytest.raises(DeadlineExceeded):
+            eng.submit(data=_req(), deadline_ms=50)
+        assert time.perf_counter() - t0 >= 0.04
+        eng.flush()
+        assert f0.result(timeout=10)[0].shape == (1, C)
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Retry budget + breaker
+# ---------------------------------------------------------------------------
+
+def test_transient_dispatch_fault_retried_within_budget():
+    eng = _engine(retry_budget=2, retry_backoff_ms=1.0)
+    try:
+        faults.configure("dispatch:raise:n=1")
+        out = eng.submit(data=_req()).result(timeout=10)
+        assert out[0].shape == (1, C)
+        st = eng.stats()
+        assert st["retries"] == 1 and st["dispatch_failures"] == 0
+        assert faults.counts()["dispatch"]["fired"] == 1
+    finally:
+        eng.close()
+
+
+def test_retry_budget_exhausts_then_fails_structured():
+    eng = _engine(retry_budget=1, retry_backoff_ms=1.0,
+                  breaker_threshold=0)
+    try:
+        faults.configure("dispatch:raise")       # every attempt fails
+        with pytest.raises(faults.InjectedFault):
+            eng.submit(data=_req()).result(timeout=10)
+        st = eng.stats()
+        assert st["retries"] == 1 and st["dispatch_failures"] == 1
+        faults.clear()
+        # engine still usable after a failed batch (breaker disabled)
+        assert eng.submit(data=_req()).result(timeout=10)[0].shape \
+            == (1, C)
+    finally:
+        eng.close()
+
+
+def test_program_errors_never_retry():
+    eng = _engine(retry_budget=3, breaker_threshold=0)
+    try:
+        real = eng._forward
+
+        def broken(*a, **k):
+            raise ValueError("rank mismatch — a program error")
+
+        eng._forward = broken
+        with pytest.raises(ValueError):
+            eng.submit(data=_req()).result(timeout=10)
+        assert eng.stats()["retries"] == 0
+        eng._forward = real
+        assert eng.submit(data=_req()).result(timeout=10)[0].shape \
+            == (1, C)
+    finally:
+        eng.close()
+
+
+def test_breaker_trips_then_fast_fails_then_half_open_recovers():
+    eng = _engine(retry_budget=0, breaker_threshold=2,
+                  breaker_reset_s=0.15)
+    try:
+        faults.configure("dispatch:raise")
+        for _ in range(2):
+            with pytest.raises(faults.InjectedFault):
+                eng.submit(data=_req()).result(timeout=10)
+        st = eng.stats()
+        assert st["breaker"]["open"] is True
+        assert st["breaker"]["trips"] == 1
+        assert st["breaker"]["consecutive_failures"] == 2
+        # open breaker: submit fast-fails without touching the device
+        with pytest.raises(CircuitOpen):
+            eng.submit(data=_req())
+        assert eng.stats()["breaker"]["fastfail"] >= 1
+        # backend recovers; after the cooldown the half-open trial
+        # closes the breaker
+        faults.clear()
+        time.sleep(0.2)
+        assert eng.submit(data=_req()).result(timeout=10)[0].shape \
+            == (1, C)
+        st = eng.stats()
+        assert st["breaker"]["open"] is False
+        assert st["breaker"]["consecutive_failures"] == 0
+    finally:
+        eng.close()
+
+
+def test_breaker_fast_fails_queued_requests():
+    # a request that was ADMITTED before the trip still resolves (with
+    # CircuitOpen, at dispatch time) — an open breaker never strands a
+    # future, and new submits fast-fail at admission
+    eng = _engine(max_wait_ms=10000, retry_budget=0, breaker_threshold=1,
+                  breaker_reset_s=30.0)
+    try:
+        faults.configure("dispatch:raise")
+        f0 = eng.submit(data=_req())
+        eng.flush()
+        with pytest.raises(faults.InjectedFault):
+            f0.result(timeout=10)            # trips the breaker
+        faults.clear()
+        assert eng._breaker_tripped()
+        with pytest.raises(CircuitOpen):
+            eng.submit(data=_req())
+        # a request that races past admission before the trip reaches
+        # _dispatch with the breaker open: resolved, never stranded
+        from mxnet_tpu.serving import _Request
+        raced = _Request({"data": _req()}, 1)
+        eng._dispatch([raced])
+        with pytest.raises(CircuitOpen):
+            raced.future.result(timeout=10)
+        assert eng.stats()["breaker"]["fastfail"] >= 2
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# In-flight failure resolution (the no-hung-futures contract)
+# ---------------------------------------------------------------------------
+
+def test_midflight_failure_resolves_every_pending_future():
+    eng = _engine(max_wait_ms=10000, retry_budget=0, breaker_threshold=0)
+    try:
+        faults.configure("dispatch:raise")
+        futs = [eng.submit(data=_req()) for _ in range(5)]
+        eng.flush()
+        for f in futs:
+            with pytest.raises(faults.InjectedFault):
+                f.result(timeout=10)         # resolves, never hangs
+        assert all(f.done() for f in futs)
+        faults.clear()
+        # breaker never tripped (disabled): engine fully usable
+        f = eng.submit(data=_req())
+        eng.flush()
+        assert f.result(timeout=10)[0].shape == (1, C)
+    finally:
+        eng.close()
+
+
+def test_d2h_failure_resolves_every_pending_future():
+    eng = _engine(max_wait_ms=10000, breaker_threshold=0)
+    try:
+        faults.configure("d2h:raise:n=1")
+        futs = [eng.submit(data=_req()) for _ in range(3)]
+        eng.flush()
+        for f in futs:
+            with pytest.raises(faults.InjectedFault):
+                f.result(timeout=10)
+        faults.clear()
+        f = eng.submit(data=_req())
+        eng.flush()
+        assert f.result(timeout=10)[0].shape == (1, C)
+    finally:
+        eng.close()
+
+
+def test_d2h_nan_corruption_reaches_the_client():
+    eng = _engine()
+    try:
+        faults.configure("d2h:nan:n=1")
+        out = eng.submit(data=_req()).result(timeout=10)
+        assert np.isnan(np.asarray(out[0]).reshape(-1)[0])
+        out = eng.submit(data=_req()).result(timeout=10)
+        assert np.isfinite(np.asarray(out[0])).all()
+    finally:
+        eng.close()
+
+
+def test_queue_depth_stays_consistent_under_sheds_and_failures():
+    # admission sheds never entered the queue (depth must not go
+    # negative); failed requests terminated (depth must not stay
+    # inflated) — the number a load balancer's health endpoint reads
+    eng = _engine(max_wait_ms=10000, max_queue_rows=2,
+                  retry_budget=0, breaker_threshold=0)
+    try:
+        fs = [eng.submit(data=_req()) for _ in range(2)]
+        for _ in range(3):
+            with pytest.raises(QueueOverflow):
+                eng.submit(data=_req())
+        assert eng.stats()["queue_depth"] == 2      # not -1
+        faults.configure("dispatch:raise")
+        eng.flush()
+        for f in fs:
+            with pytest.raises(faults.InjectedFault):
+                f.result(timeout=10)
+        st = eng.stats()
+        assert st["failed_requests"] == 2
+        assert st["queue_depth"] == 0               # not 2 forever
+        assert st["shed_by_cause"] == {"admission": 3}
+    finally:
+        faults.clear()
+        eng.close()
+
+
+def test_fetch_failure_feeds_the_breaker():
+    # on an async backend a dead device surfaces at the d2h fetch, not
+    # at launch — the breaker must see those failures too
+    eng = _engine(retry_budget=0, breaker_threshold=2,
+                  breaker_reset_s=30.0)
+    try:
+        faults.configure("d2h:raise")
+        for _ in range(2):
+            with pytest.raises(faults.InjectedFault):
+                eng.submit(data=_req()).result(timeout=10)
+        st = eng.stats()
+        assert st["breaker"]["open"] is True
+        assert st["dispatch_failures"] == 2
+        with pytest.raises(CircuitOpen):
+            eng.submit(data=_req())
+    finally:
+        faults.clear()
+        eng.close()
+
+
+def test_admission_shed_still_lands_a_latency_sample():
+    # the shed-at-admission request's serve_request span closes — the
+    # overload percentiles include rejected requests, same as the
+    # coalesce/resolve shed paths
+    from mxnet_tpu import telemetry
+    telemetry.enable()
+    eng = _engine(max_wait_ms=10000, max_queue_rows=1)
+    try:
+        base = telemetry.span_count("serve_request")
+        f0 = eng.submit(data=_req())
+        with pytest.raises(QueueOverflow):
+            eng.submit(data=_req())
+        assert telemetry.span_count("serve_request") == base + 1
+        eng.flush()
+        f0.result(timeout=10)
+    finally:
+        eng.close()
+
+
+def test_close_resolves_inflight_then_fails_fast():
+    eng = _engine(max_wait_ms=10000)
+    futs = [eng.submit(data=_req()) for _ in range(3)]
+    eng.close()
+    for f in futs:                           # drained, resolved
+        assert f.result(timeout=10)[0].shape == (1, C)
+    with pytest.raises(EngineClosed):
+        eng.submit(data=_req())
+    with pytest.raises(EngineClosed):
+        eng.flush()
+    # EngineClosed is a structured MXNetError
+    assert issubclass(EngineClosed, MXNetError)
+
+
+def test_shed_errors_are_structured_mxnet_errors():
+    for cls in (DeadlineExceeded, QueueOverflow, CircuitOpen):
+        assert issubclass(cls, MXNetError)

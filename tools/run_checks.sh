@@ -24,6 +24,7 @@ echo "== instrumented-jit lint"
 # (and, on the serving path, the one-compile-per-bucket accounting)
 if grep -n "jax\.jit(" mxnet_tpu/executor.py mxnet_tpu/predictor.py \
         mxnet_tpu/serving.py mxnet_tpu/compile_cache.py \
+        mxnet_tpu/faults.py mxnet_tpu/checkpoint.py \
         mxnet_tpu/module/*.py \
         | grep -v "the ONE instrumented jit site"; then
   echo "FAIL: raw jax.jit( call outside the executor's instrumented"

@@ -21,6 +21,18 @@ Warm-smoke lane:    python tools/serve_probe.py --warm-smoke \
   every bucket program; the second (warm) must register ZERO
   ``jit_compile`` spans, >= bucket-count deserialize hits, produce
   bit-identical outputs, and start up in <= 25% of the cold wall.)
+
+Chaos-smoke lane:   python tools/serve_probe.py --chaos-smoke \
+                        [--json-out PATH]
+  (tier-1 CI for the OVERLOAD-CONTROL path, ISSUE 7: the engine runs
+  an open-loop offered-load ladder up to 2x its measured capacity with
+  ``MXNET_FAULTS``-style injected dispatch faults (a per-dispatch
+  delay throttling capacity + probabilistic raises exercising the
+  retry budget), a bounded admission queue and per-request deadlines.
+  Gates: ZERO hung futures (every submitted future resolves), shed
+  counters > 0 at 2x offered load, admitted-request p99 <= the
+  configured deadline, and the injected-fault telemetry counter equals
+  the registry's exact fire count.)
 """
 import json
 import os
@@ -327,6 +339,174 @@ def warm_smoke(json_out=None):
     return out
 
 
+# chaos-smoke knobs: the injected per-dispatch DELAY throttles the CPU
+# lane's capacity to something an open-loop schedule can actually
+# overload inside a CI window; the RAISE probability exercises the
+# retry budget; the bounded queue + deadline are what 2x offered load
+# then slams into
+CHAOS_DELAY_MS = 4.0
+CHAOS_RAISE_P = 0.12
+CHAOS_SEED = 11
+CHAOS_DEADLINE_MS = 150.0
+CHAOS_QUEUE_ROWS = 48
+CHAOS_N_REQ = 384
+CHAOS_SPEC = "dispatch:delay=%g" % CHAOS_DELAY_MS
+CHAOS_SPEC_FAULTY = CHAOS_SPEC + \
+    ";dispatch:raise:p=%g,seed=%d" % (CHAOS_RAISE_P, CHAOS_SEED)
+
+
+def chaos_smoke(json_out=None, n_req=CHAOS_N_REQ):
+    """The fault-tolerant-serving acceptance lane (ISSUE 7)."""
+    from mxnet_tpu import faults
+    from mxnet_tpu.serving import (DeadlineExceeded, QueueOverflow,
+                                   CircuitOpen)
+    sym = _mlp()
+    params = _params(sym)
+    rng = np.random.RandomState(1)
+    reqs = [rng.normal(size=(1, D)).astype(np.float32)
+            for _ in range(64)]
+    telemetry.enable()
+    engine = InferenceEngine(
+        sym, params, {"data": (1, D)}, max_batch=MAX_BATCH,
+        max_wait_ms=1.0, max_inflight=4,
+        max_queue_rows=CHAOS_QUEUE_ROWS,
+        deadline_ms=CHAOS_DEADLINE_MS, overload="shed",
+        retry_budget=2, retry_backoff_ms=1.0,
+        breaker_threshold=50)          # tripping would mask the ladder
+    out = {
+        "lane": "chaos_smoke",
+        "platform": jax.devices()[0].platform,
+        "n_requests": n_req,
+        "max_batch": MAX_BATCH,
+        "deadline_ms": CHAOS_DEADLINE_MS,
+        "max_queue_rows": CHAOS_QUEUE_ROWS,
+        "fault_spec": CHAOS_SPEC_FAULTY,
+        "offered_loads": {},
+    }
+    try:
+        # capacity under the injected dispatch DELAY (the throttle is
+        # part of the chaos environment, so the ladder's fractions are
+        # fractions of the environment's real capacity)
+        faults.configure(CHAOS_SPEC)
+        t0 = time.perf_counter()
+        done = 0
+        while done < n_req // 2:
+            # closed-loop waves under the admission bound: capacity is
+            # what the throttled engine sustains, measured without
+            # tripping the very shedding the ladder exists to test
+            wave = min(CHAOS_QUEUE_ROWS // 2, n_req // 2 - done)
+            futs = [engine.submit(data=reqs[i % len(reqs)])
+                    for i in range(wave)]
+            engine.flush()
+            for f in futs:
+                f.result(timeout=120)
+            done += wave
+        capacity = done / (time.perf_counter() - t0)
+        out["capacity_req_s"] = round(capacity, 1)
+
+        # open-loop ladder with raises on top of the delay; latency is
+        # measured from the SCHEDULED arrival (coordinated-omission-
+        # free), admission sheds raise synchronously at submit
+        faults.configure(CHAOS_SPEC_FAULTY)
+        for frac in (1.0, 2.0):
+            faults.reset_counts()
+            telemetry.reset()
+            rate = capacity * frac
+            pend, lats = [], []
+            admission_shed = 0
+            t0 = time.perf_counter()
+            for i in range(n_req):
+                sched = t0 + i / rate
+                now = time.perf_counter()
+                if sched > now:
+                    time.sleep(sched - now)
+                try:
+                    fut = engine.submit(data=reqs[i % len(reqs)])
+                except (QueueOverflow, CircuitOpen):
+                    admission_shed += 1
+                    continue
+                fut.add_done_callback(
+                    lambda f, s=sched: lats.append(
+                        (time.perf_counter() - s) * 1e3)
+                    if not f.exception() else None)
+                pend.append(fut)
+            engine.flush()
+            ok = shed = failed = hung = 0
+            for fut in pend:
+                try:
+                    fut.result(timeout=120)
+                    ok += 1
+                except DeadlineExceeded:
+                    shed += 1
+                except Exception:
+                    failed += 1
+            hung = sum(0 if f.done() else 1 for f in pend)
+            lats.sort()
+            pct = telemetry._percentile
+            st = engine.stats()
+            fired = faults.counts().get("dispatch", {}).get("fired", 0)
+            injected = telemetry.counters().get(
+                "faults.injected.dispatch", 0)
+            out["offered_loads"]["%.1f" % frac] = {
+                "offered_req_s": round(rate, 1),
+                "submitted": len(pend),
+                "ok": ok,
+                "shed_admission": admission_shed,
+                "shed_deadline": shed,
+                "failed": failed,
+                "hung": hung,
+                "shed_rate": round(
+                    (admission_shed + shed) / float(n_req), 4),
+                "admitted_latency_ms": {
+                    "p50": round(pct(lats, 50), 3),
+                    "p95": round(pct(lats, 95), 3),
+                    "p99": round(pct(lats, 99), 3),
+                } if lats else None,
+                "retries": st["retries"],
+                "dispatch_failures": st["dispatch_failures"],
+                "breaker": st["breaker"],
+                "faults_fired": fired,
+                "faults_injected_counter": injected,
+                "queued_rows": st["queued_rows"],
+            }
+            print(json.dumps(dict(out, partial=True)), flush=True)
+    finally:
+        faults.clear()
+        engine.close()
+    out["stats"] = {k: v for k, v in engine.stats().items()
+                    if k in ("requests", "resolved", "shed_requests",
+                             "shed_rows", "shed_by_cause", "retries",
+                             "dispatch_failures", "breaker")}
+    hot = out["offered_loads"]["2.0"]
+    try:
+        # the ISSUE 7 chaos gates, all deterministic:
+        # 1. zero hung futures at 2x offered load under injected faults
+        assert hot["hung"] == 0, hot
+        # 2. the engine SHED (bounded queue / deadlines actually bit)
+        assert hot["shed_admission"] + hot["shed_deadline"] > 0, hot
+        # 3. admitted requests kept their deadline promise
+        assert hot["admitted_latency_ms"]["p99"] <= CHAOS_DEADLINE_MS, hot
+        # 4. exact injection accounting: telemetry == registry, > 0
+        assert hot["faults_fired"] > 0, hot
+        assert hot["faults_injected_counter"] == hot["faults_fired"], hot
+        # 5. the bounded queue held
+        assert hot["queued_rows"] <= CHAOS_QUEUE_ROWS, hot
+        # 6. every admitted request resolved one way or the other
+        assert hot["ok"] + hot["shed_deadline"] + hot["failed"] \
+            == hot["submitted"], hot
+        out["gates_passed"] = True
+    except AssertionError:
+        out["gates_passed"] = False
+        raise
+    finally:
+        line = json.dumps(out)
+        print(line, flush=True)
+        if json_out:
+            with open(json_out, "w") as f:
+                f.write(line + "\n")
+    return out
+
+
 def _json_out_arg():
     if "--json-out" not in sys.argv:
         return None
@@ -343,6 +523,8 @@ if __name__ == "__main__":
         warm_smoke(json_out=_json_out_arg())
     elif "--warm-child" in sys.argv:
         warm_child()
+    elif "--chaos-smoke" in sys.argv:
+        chaos_smoke(json_out=_json_out_arg())
     else:
         raise SystemExit("usage: serve_probe.py --serve-smoke|"
-                         "--warm-smoke [--json-out PATH]")
+                         "--warm-smoke|--chaos-smoke [--json-out PATH]")
