@@ -266,7 +266,7 @@ def child():
                 new_pbf.append(w.astype(bf16))
         return new_master, new_mom, new_pbf, loss
 
-    step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+    step = jax.jit(train_step, donate_argnums=(0, 1, 2))   # mxlint: disable=jit-site -- standalone bench step: AOT-compiled below and registered via card_from_compiled, the card contract the wrapper exists for
 
     x = jax.device_put(
         np.random.uniform(-1, 1, (BATCH, IMG, IMG, 3)).astype(np.float32), dev)

@@ -1,0 +1,59 @@
+"""mxlint: AST-based static analysis for the runtime's own invariants.
+
+The runtime rests on conventions no type checker knows about: every
+jitted program must compile through ``executor._InstrumentedProgram``
+(the program-card / recompile-diagnosis / OOM-enrichment wrapper),
+lock-guarded shared state in the threaded serving/telemetry/cache
+layers must be touched under its lock, hot loops must not block on
+device values, donated buffers die at the call that donates them, and
+the fault-site / counter / fallback-code registries must stay in sync
+across five modules. Until ISSUE 8 these were enforced by two ``grep``
+stanzas in ``tools/run_checks.sh`` — which an aliased
+``from jax import jit`` walked straight past, and which could not see
+scopes, locks or dataflow at all.
+
+This package is the real analyzer (TVM's machine-checkable IR
+invariants, arXiv:1802.04799, applied to our own host runtime):
+
+* per-file :mod:`ast` passes plus cross-file registry passes;
+* ``# mxlint: disable=<rule> -- <justification>`` suppressions (the
+  justification text is REQUIRED — a bare disable is itself a finding);
+* a committed baseline file for grandfathered findings
+  (``tools/mxlint_baseline.json``) whose stale entries warn and are
+  pruned on ``--update-baseline`` instead of erroring;
+* text and JSON reports with stable exit codes (0 clean, 1 findings,
+  2 usage error) — see ``tools/mxlint.py``.
+
+Rules shipped (ids are stable; tests and suppressions key on them):
+
+==================== ======================================================
+``jit-site``         any ``jax.jit`` / ``pjit`` / ``jax.pmap`` call or
+                     decorator outside the ONE marked
+                     ``_InstrumentedProgram`` site, resolved through
+                     import aliases
+``dispatch-hook``    raw ``dispatch_hook(...)`` calls outside
+                     ``executor.py`` (report via
+                     ``executor.record_dispatch``)
+``lock-discipline``  ``# guarded by: <lock>`` attributes/globals read or
+                     written outside a ``with``-block on that lock
+                     (Condition aliases count), plus no lock acquisition
+                     inside a ``weakref.finalize`` callback (the PR 4
+                     finalizer-deadlock class)
+``host-sync``        ``.asnumpy()`` / ``.wait_to_read()`` /
+                     ``np.asarray(...)`` inside functions marked
+                     ``# mxlint: hot``
+``donation-safety``  reuse of a Python name after it was passed at a
+                     donated position of a donated-buffer program call
+``registry-consistency``
+                     ``faults.fire`` site strings vs ``faults.SITES``,
+                     ``FusedFallback`` codes vs ``FUSED_FALLBACK_CODES``,
+                     ``telemetry.counter_inc`` literals vs
+                     ``telemetry.COUNTERS`` — both directions (undeclared
+                     use AND unused declaration)
+==================== ======================================================
+"""
+from .core import (Finding, Source, Project, Baseline, Report, run,
+                   iter_python_files, ALL_RULE_IDS)
+
+__all__ = ["Finding", "Source", "Project", "Baseline", "Report", "run",
+           "iter_python_files", "ALL_RULE_IDS"]

@@ -1,0 +1,535 @@
+"""The mxlint framework: sources, suppressions, baseline, rule driver.
+
+Everything here is stdlib-only (``ast`` + ``json``) and import-light so
+the lint stage of ``tools/run_checks.sh`` runs without the native build
+or a jax import. The comment grammar this module parses out of raw
+source lines (the :mod:`ast` tree drops comments):
+
+* ``# mxlint: disable=<rule>[,<rule>...] -- <justification>`` —
+  suppress those rules' findings on this line (trailing form) or on the
+  next line (standalone-comment form). The justification text after
+  ``--`` is REQUIRED: a suppression that doesn't say why is reported as
+  an ``mxlint-suppression`` finding instead of honoured.
+* ``# guarded by: <lock expr>`` — trailing on an assignment: the
+  assigned attribute/global is only touched under ``with <lock expr>:``
+  (the lock-discipline rule's annotation).
+* ``# mxlint: hot`` — trailing on a ``def`` line (or standalone on the
+  line above it): the function is a hot path the host-sync rule polices.
+* ``# mxlint: donates <indices>`` — trailing on a call line: the call
+  donates the buffers at these 0-based positional indices (``0,1`` or
+  ``0-3``), for callees whose ``donate_argnums`` the analyzer cannot see
+  locally.
+* ``the ONE instrumented jit site`` — the executor's marker comment;
+  the jit-site rule allows exactly this site.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+
+# rule ids, in report order. The list lives here (not in the rules
+# package) so ``--list-rules``, suppression validation and the tests
+# share one source of truth.
+ALL_RULE_IDS = ("jit-site", "dispatch-hook", "lock-discipline",
+                "host-sync", "donation-safety", "registry-consistency")
+
+# the rule id bad suppression comments are reported under (not
+# suppressible itself — a broken suppression must not hide)
+SUPPRESSION_RULE = "mxlint-suppression"
+
+# rules the baseline may never cover either: a broken suppression or an
+# unparseable file means the gate itself is compromised, so neither
+# --update-baseline nor a hand-edited entry can grandfather them
+NEVER_BASELINED = frozenset((SUPPRESSION_RULE, "parse-error"))
+
+_DISABLE_RE = re.compile(
+    r"#\s*mxlint:\s*disable=([A-Za-z0-9_,\- ]+?)\s*(?:--\s*(.*))?$")
+_GUARD_RE = re.compile(r"#\s*guarded by:\s*([A-Za-z0-9_.\[\]'\"]+)\s*$")
+_HOT_RE = re.compile(r"#\s*mxlint:\s*hot\s*$")
+_DONATES_RE = re.compile(r"#\s*mxlint:\s*donates\s+([0-9,\- ]+)\s*$")
+JIT_SITE_MARKER = "the ONE instrumented jit site"
+
+
+class Finding:
+    """One rule violation at a source location. ``anchor`` (the stripped
+    text of the finding's line) is the line-drift-tolerant half of the
+    baseline identity ``(rule, path, anchor)`` — a finding keeps its
+    baseline entry when unrelated edits move it, and loses it when the
+    offending line itself changes (which is exactly when a human should
+    look again)."""
+
+    __slots__ = ("rule", "path", "line", "col", "message", "anchor")
+
+    def __init__(self, rule, path, line, col, message, anchor=""):
+        self.rule = rule
+        self.path = path
+        self.line = int(line)
+        self.col = int(col)
+        self.message = message
+        self.anchor = anchor
+
+    def key(self):
+        return (self.rule, self.path, self.anchor)
+
+    def to_dict(self):
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "anchor": self.anchor}
+
+    def render(self):
+        return "%s:%d:%d: %s: %s" % (self.path, self.line, self.col,
+                                     self.rule, self.message)
+
+    def __repr__(self):
+        return "Finding(%s)" % self.render()
+
+
+def _parse_donate_indices(spec):
+    """``"0,1"`` / ``"0-3"`` -> tuple of ints, or None on a bad spec."""
+    out = []
+    for term in spec.split(","):
+        term = term.strip()
+        if not term:
+            continue
+        if "-" in term:
+            lo, _, hi = term.partition("-")
+            try:
+                lo, hi = int(lo), int(hi)
+            except ValueError:
+                return None
+            if hi < lo:
+                return None
+            out.extend(range(lo, hi + 1))
+        else:
+            try:
+                out.append(int(term))
+            except ValueError:
+                return None
+    return tuple(sorted(set(out))) or None
+
+
+class Source:
+    """One parsed file: the AST plus everything the comment grammar
+    declares (suppressions, guard annotations, hot markers, donation
+    markers, the instrumented-jit-site marker)."""
+
+    def __init__(self, path, text, display_path=None):
+        self.path = path
+        self.display = display_path or path
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text)     # caller handles SyntaxError
+        # line -> (frozenset of rule ids, justification)
+        self.suppressions = {}
+        # findings produced by the comment grammar itself
+        self.grammar_findings = []
+        self.guards = {}                # line -> lock expr string
+        self.hot_lines = set()
+        self.donates = {}               # line -> tuple of donated indices
+        self.jit_marker_lines = set()
+        self._scan_comments()
+        self._parents = None
+        self._aliases = None
+
+    # -- comment grammar ----------------------------------------------------
+    def _scan_comments(self):
+        for i, raw in enumerate(self.lines, 1):
+            if "#" not in raw:
+                continue
+            # the marker only counts as a COMMENT (text after '#') — a
+            # string literal or docstring mentioning it is not a site
+            if JIT_SITE_MARKER in raw.split("#", 1)[1]:
+                self.jit_marker_lines.add(i)
+            stripped = raw.strip()
+            standalone = stripped.startswith("#")
+            m = _DISABLE_RE.search(raw)
+            if m:
+                rules = frozenset(r.strip() for r in m.group(1).split(",")
+                                  if r.strip())
+                just = (m.group(2) or "").strip()
+                bad = None
+                if not rules:
+                    bad = "no rule ids"
+                elif not all(r in ALL_RULE_IDS for r in rules):
+                    bad = "unknown rule id(s): %s" % ", ".join(
+                        sorted(r for r in rules if r not in ALL_RULE_IDS))
+                elif not just:
+                    bad = ("missing justification — write "
+                           "'# mxlint: disable=%s -- <why this is safe>'"
+                           % ",".join(sorted(rules)))
+                if bad:
+                    self.grammar_findings.append(Finding(
+                        SUPPRESSION_RULE, self.display, i, 0,
+                        "unusable suppression (%s); the finding it "
+                        "meant to silence will still report" % bad,
+                        anchor=stripped))
+                else:
+                    target = i + 1 if standalone else i
+                    self.suppressions.setdefault(target, []).append(
+                        (rules, just))
+            m = _GUARD_RE.search(raw)
+            if m:
+                self.guards[i] = m.group(1)
+            if _HOT_RE.search(raw):
+                # standalone marker arms the NEXT line's def; trailing
+                # marker arms its own line
+                self.hot_lines.add(i + 1 if standalone else i)
+            m = _DONATES_RE.search(raw)
+            if m:
+                idx = _parse_donate_indices(m.group(1))
+                if idx is None:
+                    self.grammar_findings.append(Finding(
+                        SUPPRESSION_RULE, self.display, i, 0,
+                        "unparseable '# mxlint: donates %s' marker"
+                        % m.group(1), anchor=stripped))
+                else:
+                    self.donates[i] = idx
+
+    def suppressed(self, rule, line):
+        """The justification string when ``rule`` is suppressed at
+        ``line``, else None."""
+        for rules, just in self.suppressions.get(line, ()):
+            if rule in rules:
+                return just
+        return None
+
+    def anchor_for(self, line):
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule, node_or_line, message):
+        line = getattr(node_or_line, "lineno", node_or_line)
+        col = getattr(node_or_line, "col_offset", 0)
+        return Finding(rule, self.display, line, col, message,
+                       anchor=self.anchor_for(line))
+
+    # -- shared AST helpers --------------------------------------------------
+    def parents(self):
+        """{child node: parent node} over the whole tree (built once)."""
+        if self._parents is None:
+            self._parents = {}
+            for parent in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(parent):
+                    self._parents[child] = parent
+        return self._parents
+
+    def import_aliases(self):
+        """{local name: dotted origin} for every import in the file —
+        ``import jax.experimental.pjit as P`` maps ``P`` to
+        ``jax.experimental.pjit``; ``from jax import jit as J`` maps
+        ``J`` to ``jax.jit``. Resolution is textual (no module is ever
+        imported). Built once — four of the six rules ask for it."""
+        if self._aliases is not None:
+            return self._aliases
+        aliases = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    aliases[a.asname or a.name] = \
+                        "%s.%s" % (node.module, a.name)
+        self._aliases = aliases
+        return aliases
+
+    def resolve(self, node, aliases):
+        """Dotted origin of a Name/Attribute expression under the
+        file's import aliases, or None (not import-rooted)."""
+        if isinstance(node, ast.Name):
+            return aliases.get(node.id, node.id)
+        if isinstance(node, ast.Attribute):
+            base = self.resolve(node.value, aliases)
+            if base is None:
+                return None
+            return "%s.%s" % (base, node.attr)
+        return None
+
+
+def expr_text(node):
+    """Canonical text of a small expression (lock names, with-items)."""
+    try:
+        return ast.unparse(node)
+    except Exception:       # pragma: no cover - malformed synthetic nodes
+        return ""
+
+
+def is_self_attr(node, name=None):
+    """True when ``node`` is ``self.<attr>`` (optionally a specific
+    attr) — shared by the lock-discipline and donation rules."""
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and (name is None or node.attr == name))
+
+
+def iter_python_files(paths):
+    """Expand files/directories into sorted .py file paths (dirs walk
+    recursively, ``__pycache__`` skipped). Nonexistent inputs raise
+    ``FileNotFoundError`` — a typo'd CLI path must not read as clean."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d != "__pycache__")
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+        elif os.path.isfile(p):
+            out.append(p)
+        else:
+            raise FileNotFoundError(p)
+    seen, uniq = set(), []
+    for p in out:
+        if p not in seen:
+            seen.add(p)
+            uniq.append(p)
+    return uniq
+
+
+class Project:
+    """Every parsed Source of one run — what cross-file registry passes
+    see. Files that fail to parse land in ``parse_errors`` as findings
+    (a syntax error in a linted file is a finding, not a crash)."""
+
+    def __init__(self, root=None):
+        self.root = root
+        self.sources = []
+        self.parse_errors = []
+
+    def add_file(self, path):
+        display = os.path.relpath(path, self.root) if self.root else path
+        display = display.replace(os.sep, "/")
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except OSError as e:
+            self.parse_errors.append(Finding(
+                "parse-error", display, 0, 0, "unreadable: %s" % e))
+            return None
+        try:
+            src = Source(path, text, display_path=display)
+        except SyntaxError as e:
+            self.parse_errors.append(Finding(
+                "parse-error", display, e.lineno or 0, e.offset or 0,
+                "syntax error: %s" % e.msg))
+            return None
+        self.sources.append(src)
+        return src
+
+
+class Baseline:
+    """The committed grandfather file: findings listed here report as
+    ``baselined`` (exit 0) instead of failing the run.
+
+    Entries are ``{rule, path, anchor, count}``; identity is
+    :meth:`Finding.key`. The loader TOLERATES entries that no longer
+    match any current finding — they surface as ``stale`` warnings and
+    are pruned by ``--update-baseline``, never an error (deleting the
+    offending code must not break the lint that flagged it)."""
+
+    def __init__(self, path=None):
+        self.path = path
+        self.entries = {}        # key -> allowed count
+        self.load_warnings = []
+
+    @classmethod
+    def load(cls, path):
+        bl = cls(path)
+        if path is None or not os.path.exists(path):
+            return bl
+        try:
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            bl.load_warnings.append("baseline %s unreadable (%s) — "
+                                    "running without it" % (path, e))
+            return bl
+        items = data.get("findings", []) if isinstance(data, dict) else []
+        for ent in items:
+            if not isinstance(ent, dict):
+                bl.load_warnings.append(
+                    "baseline entry %r is not an object — skipped" % (ent,))
+                continue
+            try:
+                key = (str(ent["rule"]), str(ent["path"]),
+                       str(ent["anchor"]))
+                count = max(1, int(ent.get("count", 1)))
+            except KeyError as e:
+                bl.load_warnings.append(
+                    "baseline entry missing field %s — skipped" % e)
+                continue
+            except (TypeError, ValueError):
+                bl.load_warnings.append(
+                    "baseline entry %r has a non-integer count — "
+                    "counted as 1" % (ent.get("anchor"),))
+                count = 1
+            bl.entries[key] = bl.entries.get(key, 0) + count
+        return bl
+
+    def partition(self, findings):
+        """(kept, baselined, stale) — ``kept`` are findings the baseline
+        does not cover; ``stale`` are baseline entries with no matching
+        current finding (candidates for pruning)."""
+        remaining = dict(self.entries)
+        kept, baselined = [], []
+        for f in findings:
+            k = f.key()
+            if f.rule in NEVER_BASELINED:
+                kept.append(f)
+            elif remaining.get(k, 0) > 0:
+                remaining[k] -= 1
+                baselined.append(f)
+            else:
+                kept.append(f)
+        stale = [{"rule": r, "path": p, "anchor": a, "count": n}
+                 for (r, p, a), n in sorted(remaining.items()) if n > 0]
+        return kept, baselined, stale
+
+    @staticmethod
+    def render(findings):
+        """The JSON document ``--update-baseline`` writes: every CURRENT
+        unsuppressed finding, stale entries implicitly pruned.
+        :data:`NEVER_BASELINED` rules are excluded — they must keep
+        failing the gate until the code is fixed."""
+        counts = {}
+        for f in findings:
+            if f.rule in NEVER_BASELINED:
+                continue
+            counts[f.key()] = counts.get(f.key(), 0) + 1
+        return {
+            "version": 1,
+            "comment": "grandfathered mxlint findings; regenerate with "
+                       "tools/mxlint.py --update-baseline <paths>",
+            "findings": [
+                {"rule": r, "path": p, "anchor": a, "count": n}
+                for (r, p, a), n in sorted(counts.items())],
+        }
+
+
+class Report:
+    """One run's outcome: what fails the gate (``findings``), what was
+    silenced and why (``suppressed``/``baselined``), and the baseline
+    hygiene warnings (``stale_baseline``)."""
+
+    def __init__(self, findings, suppressed, baselined, stale_baseline,
+                 warnings, paths, rules):
+        self.findings = findings
+        self.suppressed = suppressed      # [(finding, justification)]
+        self.baselined = baselined
+        self.stale_baseline = stale_baseline
+        self.warnings = warnings
+        self.paths = paths
+        self.rules = rules
+
+    @property
+    def clean(self):
+        return not self.findings
+
+    def counts(self):
+        out = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def to_dict(self):
+        return {
+            "version": 1,
+            "paths": list(self.paths),
+            "rules": list(self.rules),
+            "clean": self.clean,
+            "counts": self.counts(),
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [dict(f.to_dict(), justification=j)
+                           for f, j in self.suppressed],
+            "baselined": [f.to_dict() for f in self.baselined],
+            "stale_baseline": list(self.stale_baseline),
+            "warnings": list(self.warnings),
+        }
+
+    def render_text(self):
+        lines = []
+        for w in self.warnings:
+            lines.append("warning: %s" % w)
+        for ent in self.stale_baseline:
+            lines.append(
+                "warning: stale baseline entry (no longer found): "
+                "%(rule)s %(path)s %(anchor)r — prune with "
+                "--update-baseline" % ent)
+        for f in self.findings:
+            lines.append(f.render())
+        lines.append(
+            "mxlint: %d finding(s), %d suppressed, %d baselined, "
+            "%d stale baseline entr%s"
+            % (len(self.findings), len(self.suppressed),
+               len(self.baselined), len(self.stale_baseline),
+               "y" if len(self.stale_baseline) == 1 else "ies"))
+        return "\n".join(lines)
+
+
+def _load_rules(rule_ids=None):
+    from . import rules as _rules
+    table = _rules.rule_table()
+    ids = list(rule_ids) if rule_ids else list(ALL_RULE_IDS)
+    unknown = [r for r in ids if r not in table]
+    if unknown:
+        raise ValueError("unknown rule id(s): %s (known: %s)"
+                         % (", ".join(unknown), ", ".join(table)))
+    return [(rid, table[rid]) for rid in ids]
+
+
+def run(paths, rules=None, baseline=None, root=None):
+    """Analyze ``paths`` (files/dirs) with the given rule ids (default:
+    all) against ``baseline`` (a path, a :class:`Baseline`, or None).
+    Returns a :class:`Report`. ``root`` rebases display paths (the CLI
+    passes the repo root so baseline entries stay machine-independent).
+    """
+    files = iter_python_files(paths)
+    project = Project(root=root)
+    for path in files:
+        project.add_file(path)
+
+    selected = _load_rules(rules)
+    raw = list(project.parse_errors)
+    for src in project.sources:
+        raw.extend(src.grammar_findings)
+        for _rid, rule in selected:
+            check = getattr(rule, "check_source", None)
+            if check is not None:
+                raw.extend(check(src, project))
+    for _rid, rule in selected:
+        check = getattr(rule, "check_project", None)
+        if check is not None:
+            raw.extend(check(project))
+
+    raw.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    by_display = {s.display: s for s in project.sources}
+    unsuppressed, suppressed = [], []
+    for f in raw:
+        src = by_display.get(f.path)
+        just = None
+        if src is not None and f.rule != SUPPRESSION_RULE:
+            just = src.suppressed(f.rule, f.line)
+        if just is not None:
+            suppressed.append((f, just))
+        else:
+            unsuppressed.append(f)
+
+    if baseline is None or isinstance(baseline, Baseline):
+        bl = baseline or Baseline()
+    else:
+        bl = Baseline.load(baseline)
+    kept, baselined, stale = bl.partition(unsuppressed)
+    return Report(kept, suppressed, baselined, stale,
+                  list(bl.load_warnings),
+                  [p.replace(os.sep, "/") for p in paths],
+                  [rid for rid, _ in selected])

@@ -1,0 +1,33 @@
+"""The mxlint rule set. One module per rule; ``rule_table()`` maps the
+stable rule id to a singleton instance. Adding a rule:
+
+1. new module here with a class exposing ``id`` and ``check_source(src,
+   project)`` (per-file) and/or ``check_project(project)`` (cross-file,
+   runs once after every file parsed);
+2. register it in ``rule_table()`` below and in
+   ``core.ALL_RULE_IDS`` (report order);
+3. a seeded-violation + compliant-twin fixture pair under
+   ``tests/lint_fixtures/`` and assertions in ``tests/test_mxlint.py``.
+"""
+from ..core import ALL_RULE_IDS
+
+_TABLE = None
+
+
+def rule_table():
+    """{rule id: rule instance}; built lazily, one instance per process
+    (rules are stateless between runs)."""
+    global _TABLE
+    if _TABLE is None:
+        from . import (jit_site, dispatch_hook, lock_discipline,
+                       host_sync, donation, registry_sync)
+        instances = [jit_site.JitSiteRule(),
+                     dispatch_hook.DispatchHookRule(),
+                     lock_discipline.LockDisciplineRule(),
+                     host_sync.HostSyncRule(),
+                     donation.DonationRule(),
+                     registry_sync.RegistryConsistencyRule()]
+        _TABLE = {r.id: r for r in instances}
+        missing = set(ALL_RULE_IDS) - set(_TABLE)
+        assert not missing, "rules not registered: %s" % missing
+    return _TABLE

@@ -1,0 +1,39 @@
+"""dispatch-hook: one dispatch-reporting entry point.
+
+A raw ``dispatch_hook(...)`` CALL outside ``mxnet_tpu/executor.py``
+silently clobbers every other subscriber of the legacy single-slot
+hook. Dispatches report via ``executor.record_dispatch`` (which fans
+out to the multi-subscriber ``telemetry.on_dispatch`` registry AND the
+legacy shim); installing a hook (``executor.dispatch_hook = cb``) is an
+assignment, not a call, and stays legal for back-compat monkeypatching.
+
+Replaces the ``grep "dispatch_hook("`` stanza in run_checks.sh — the
+AST form additionally stops matching docstrings/comments that merely
+mention the name.
+"""
+import ast
+
+_EXECUTOR_FILE = "mxnet_tpu/executor.py"
+
+
+class DispatchHookRule:
+    id = "dispatch-hook"
+
+    def check_source(self, src, project):
+        if src.display.endswith(_EXECUTOR_FILE) \
+                or src.display == "executor.py":
+            return []
+        findings = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            if name == "dispatch_hook":
+                findings.append(src.finding(
+                    self.id, node,
+                    "raw dispatch_hook(...) call outside %s — report "
+                    "dispatches via executor.record_dispatch / subscribe "
+                    "via telemetry.on_dispatch" % _EXECUTOR_FILE))
+        return findings

@@ -1,0 +1,275 @@
+"""donation-safety: a donated buffer dies at the call that donates it.
+
+The fused train step, the SPMD sharded step and the fused optimizer
+update all pass buffers with ``donate_argnums`` — XLA aliases the input
+storage into the outputs, and the Python name still pointing at the old
+buffer is a use-after-free that jax only sometimes catches (a deleted-
+array error on a good day, silently stale data through the compile
+cache on a bad one). The rule tracks, per function:
+
+* names locally bound to a ``jax.jit(..., donate_argnums=(...))``
+  result (aliases through plain ``y = x`` assignments follow), called
+  in the same scope;
+* ``self.<attr>`` bound to such a result anywhere in the class;
+* call sites carrying an explicit ``# mxlint: donates 0,1`` marker —
+  for donated programs whose construction the analyzer cannot see
+  locally (``plan["fn"]`` from the module's fused plan, the
+  ``FusedUpdater``'s cached step).
+
+After the donating call's statement, any load of a name (or
+``self.<attr>``) that was passed at a donated position is a finding,
+until a statement rebinds it; rebinding in the donating statement
+itself (``w, s = step(w, s)``) is the idiomatic fix and is clean. A
+donating call inside a loop whose body never rebinds the donated name
+is flagged too — iteration two donates a dead buffer.
+
+Statement order is source order (control flow is not modelled): a use
+in an ``else`` branch the call cannot reach may need a justified
+disable — the conservative direction for a buffer-lifetime lint.
+"""
+import ast
+
+from ..core import expr_text, is_self_attr
+from .jit_site import resolve_jit_target
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _donate_indices(call):
+    """Literal donate_argnums of a jit call, or None when absent/
+    dynamic."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for el in v.elts:
+                if not (isinstance(el, ast.Constant)
+                        and isinstance(el.value, int)):
+                    return None
+                out.append(el.value)
+            return tuple(out)
+        return None
+    return None
+
+
+def _sub_stmts(stmt):
+    for child in ast.iter_child_nodes(stmt):
+        if isinstance(child, ast.stmt):
+            yield child
+        elif isinstance(child, (ast.excepthandler,) + (
+                (ast.match_case,) if hasattr(ast, "match_case") else ())):
+            # handler/case bodies hang off non-stmt wrapper nodes — an
+            # except-branch (the serving retry paths) must not be a
+            # blind spot for a buffer-lifetime lint
+            for s in child.body:
+                yield s
+
+
+def _linear_stmts(body, out):
+    """Statements in source order, not descending into nested function/
+    class scopes (they execute at another time)."""
+    for s in body:
+        out.append(s)
+        if isinstance(s, _SCOPE_NODES + (ast.ClassDef,)):
+            continue
+        _linear_stmts(list(_sub_stmts(s)), out)
+
+
+def _walk_same_scope(node):
+    """ast.walk that stops at nested function/class definitions."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, _SCOPE_NODES + (ast.ClassDef,)):
+                continue
+            stack.append(child)
+
+
+def _direct_nodes(stmt):
+    """Expression-level nodes belonging to THIS statement only (nested
+    sub-statements appear in the linear list in their own right)."""
+    stack = [stmt]
+    first = True
+    while stack:
+        n = stack.pop()
+        if not first and isinstance(n, ast.stmt):
+            continue
+        first = False
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, ast.stmt) \
+                    or isinstance(child, _SCOPE_NODES + (ast.ClassDef,)):
+                continue
+            stack.append(child)
+
+
+def _loads_stores(stmt, kind, name):
+    """(loads, stores) of the tracked entity within one statement."""
+    loads, stores = [], []
+    for n in _walk_same_scope(stmt):
+        if kind == "name" and isinstance(n, ast.Name) and n.id == name:
+            (stores if isinstance(n.ctx, (ast.Store, ast.Del))
+             else loads).append(n)
+        elif kind == "attr" and is_self_attr(n, name):
+            (stores if isinstance(n.ctx, (ast.Store, ast.Del))
+             else loads).append(n)
+    return loads, stores
+
+
+class DonationRule:
+    id = "donation-safety"
+
+    def check_source(self, src, project):
+        # cheap precondition: a donating callable needs the literal
+        # keyword "donate_argnums" (or an explicit marker) in the file
+        if "donate_argnums" not in src.text and not src.donates:
+            return []
+        parents = src.parents()
+        aliases = src.import_aliases()
+
+        def enclosing_function(node):
+            cur = parents.get(node)
+            while cur is not None:
+                if isinstance(cur, _SCOPE_NODES):
+                    return cur
+                cur = parents.get(cur)
+            return None
+
+        def enclosing_class(node):
+            cur = parents.get(node)
+            while cur is not None:
+                if isinstance(cur, ast.ClassDef):
+                    return cur
+                cur = parents.get(cur)
+            return None
+
+        # -- donating callables, by scope -----------------------------------
+        module_fns = {}                 # name -> indices
+        scope_fns = {}                  # FunctionDef -> {name: indices}
+        class_fns = {}                  # (ClassDef, attr) -> indices
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.value, ast.Call)):
+                continue
+            if not resolve_jit_target(src, node.value.func, aliases):
+                continue
+            idx = _donate_indices(node.value)
+            if not idx:
+                continue
+            target = node.targets[0]
+            if isinstance(target, ast.Name):
+                fn = enclosing_function(node)
+                if fn is None:
+                    module_fns[target.id] = idx
+                else:
+                    scope_fns.setdefault(fn, {})[target.id] = idx
+            elif is_self_attr(target):
+                cls = enclosing_class(node)
+                if cls is not None:
+                    class_fns[(cls, target.attr)] = idx
+
+        if not (module_fns or scope_fns or class_fns or src.donates):
+            return []
+
+        findings = []
+        scopes = [(None, src.tree.body)]
+        for node in ast.walk(src.tree):
+            if isinstance(node, _SCOPE_NODES):
+                scopes.append((node, node.body))
+        for fn, body in scopes:
+            findings.extend(self._check_scope(
+                src, fn, body, dict(module_fns), scope_fns.get(fn, {}),
+                class_fns, enclosing_class, parents))
+        return findings
+
+    def _check_scope(self, src, fn, body, tracked, local_tracked,
+                     class_fns, enclosing_class, parents):
+        tracked.update(local_tracked)
+        owner = enclosing_class(fn) if fn is not None else None
+        stmts = []
+        _linear_stmts(body, stmts)
+
+        # alias pass in source order: y = x copies x's donation info
+        for s in stmts:
+            if isinstance(s, ast.Assign) and len(s.targets) == 1 \
+                    and isinstance(s.targets[0], ast.Name) \
+                    and isinstance(s.value, ast.Name) \
+                    and s.value.id in tracked:
+                tracked[s.targets[0].id] = tracked[s.value.id]
+
+        findings = []
+        for pos, s in enumerate(stmts):
+            for call in (n for n in _direct_nodes(s)
+                         if isinstance(n, ast.Call)):
+                idx = None
+                if call.lineno in src.donates:
+                    idx = src.donates[call.lineno]
+                elif isinstance(call.func, ast.Name) \
+                        and call.func.id in tracked:
+                    idx = tracked[call.func.id]
+                elif is_self_attr(call.func) and owner is not None:
+                    idx = class_fns.get((owner, call.func.attr))
+                if not idx:
+                    continue
+                callee = expr_text(call.func)
+                for i in idx:
+                    if i >= len(call.args):
+                        continue
+                    arg = call.args[i]
+                    if isinstance(arg, ast.Name):
+                        kind, name = "name", arg.id
+                    elif is_self_attr(arg):
+                        kind, name = "attr", arg.attr
+                    else:
+                        continue
+                    findings.extend(self._track_after(
+                        src, stmts, pos, s, call, callee, i, kind, name,
+                        parents))
+        return findings
+
+    def _track_after(self, src, stmts, pos, call_stmt, call, callee,
+                     arg_i, kind, name, parents):
+        label = "self.%s" % name if kind == "attr" else "'%s'" % name
+
+        # rebound by the donating statement itself (w = step(w)): clean
+        _, stores_here = _loads_stores(call_stmt, kind, name)
+        if stores_here:
+            return []
+
+        # donating call in a loop, name never rebound in the loop body:
+        # iteration two donates an already-dead buffer
+        cur = parents.get(call)
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if isinstance(cur, (ast.For, ast.While)):
+                rebound = any(_loads_stores(b, kind, name)[1]
+                              for b in cur.body)
+                if not rebound:
+                    return [src.finding(
+                        self.id, call,
+                        "%s is donated to %s (arg %d) inside a loop "
+                        "that never rebinds it — the second iteration "
+                        "passes an already-donated buffer; rebind the "
+                        "result (e.g. unpack the call into %s)"
+                        % (label, callee, arg_i, label))]
+                break
+            cur = parents.get(cur)
+
+        for s in stmts[pos + 1:]:
+            loads, stores = _loads_stores(s, kind, name)
+            if loads:
+                return [src.finding(
+                    self.id, loads[0],
+                    "%s is used after being passed at donated position "
+                    "%d of %s (line %d) — donation invalidates the "
+                    "buffer; use the call's result, or rebind %s first"
+                    % (label, arg_i, callee, call.lineno, label))]
+            if stores:
+                return []
+        return []

@@ -1,0 +1,84 @@
+"""host-sync: hot paths must not block on device values.
+
+A ``.asnumpy()`` / ``.wait_to_read()`` / ``np.asarray(...)`` on a
+device value stalls the dispatch pipeline until the device (often a
+REMOTED PJRT backend, a network round-trip away) catches up — the exact
+stall class that hid the 14x ``Module.fit`` gap until round 5
+(PERF.md). Functions marked ``# mxlint: hot`` (the fit batch loop, the
+serving coalescer/launch/dispatch paths) are checked for all three
+forms; everything in them must stay async, with blocking fetches pushed
+to epoch boundaries, lazy metric flushes or the resolver pool.
+
+``np.asarray`` over an obvious host literal (list/tuple/dict display,
+comprehension, constant) is exempt — building a feed array from Python
+scalars is host work, not a device sync. Any remaining legitimate site
+(e.g. marshalling a client payload on the serving admission path)
+carries a justified ``# mxlint: disable=host-sync -- why``.
+"""
+import ast
+
+_BLOCKING_METHODS = {"asnumpy", "wait_to_read"}
+_HOST_LITERALS = (ast.List, ast.Tuple, ast.Dict, ast.Set, ast.ListComp,
+                  ast.SetComp, ast.DictComp, ast.GeneratorExp,
+                  ast.Constant)
+
+
+class HostSyncRule:
+    id = "host-sync"
+
+    def _hot_functions(self, src):
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            # a standalone marker above a DECORATED def arms the first
+            # decorator's line, not the `def` line — accept either so
+            # the marker is never silently inert
+            lines = {node.lineno}
+            if node.decorator_list:
+                lines.add(min(d.lineno for d in node.decorator_list))
+            if lines & src.hot_lines:
+                yield node
+
+    def check_source(self, src, project):
+        if not src.hot_lines:
+            return []
+        aliases = src.import_aliases()
+        np_names = {local for local, origin in aliases.items()
+                    if origin == "numpy"}
+        asarray_names = {local for local, origin in aliases.items()
+                         if origin == "numpy.asarray"}
+        findings = []
+        seen = set()
+        for fn in self._hot_functions(src):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                msg = None
+                if isinstance(f, ast.Attribute) \
+                        and f.attr in _BLOCKING_METHODS:
+                    msg = ".%s()" % f.attr
+                elif ((isinstance(f, ast.Attribute)
+                       and f.attr == "asarray"
+                       and isinstance(f.value, ast.Name)
+                       and f.value.id in np_names)
+                      or (isinstance(f, ast.Name)
+                          and f.id in asarray_names)):
+                    if node.args and isinstance(node.args[0],
+                                                _HOST_LITERALS):
+                        continue
+                    msg = "np.asarray(...)"
+                if msg is None:
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(src.finding(
+                    self.id, node,
+                    "blocking host sync %s inside hot function '%s' "
+                    "(# mxlint: hot) — this stalls the dispatch "
+                    "pipeline on the device; fetch lazily or move the "
+                    "sync off the hot path" % (msg, fn.name)))
+        return findings

@@ -1,0 +1,99 @@
+"""jit-site: every program compiles through the instrumented wrapper.
+
+Any ``jax.jit`` / ``jax.pmap`` / ``pjit`` CALL or DECORATOR — resolved
+through import aliases, so ``from jax import jit as J`` and
+``import jax.experimental.pjit as P`` are seen — is a finding unless it
+is the ONE site inside ``executor._InstrumentedProgram`` carrying the
+``"the ONE instrumented jit site"`` marker comment (which must live in
+``mxnet_tpu/executor.py`` — a marker anywhere else is itself a
+finding). A raw jit dodges every program-card guarantee: explicit
+``lower().compile()`` introspection, recompile-cause diagnosis, OOM
+enrichment, the persisted compile-cache tier and, on the serving path,
+the one-compile-per-bucket accounting.
+
+This replaces the ``grep "jax\\.jit("`` stanza in run_checks.sh, which
+an aliased import walked straight past and which could not see
+decorator form at all. Grandfathered pre-wrapper sites (component
+kernels in metric/optimizer/kvstore/gluon/ops/rtc/parallel) live in
+``tools/mxlint_baseline.json``.
+"""
+import ast
+
+# dotted origins that compile a program. jax.experimental.pjit.pjit is
+# the legacy spelling; jax.pjit the re-export.
+_TARGETS = {
+    "jax.jit": "jax.jit",
+    "jax.pmap": "jax.pmap",
+    "jax.pjit": "pjit",
+    "jax.experimental.pjit.pjit": "pjit",
+}
+
+_EXECUTOR_FILE = "mxnet_tpu/executor.py"
+
+
+def resolve_jit_target(src, node, aliases):
+    """The _TARGETS label for a Name/Attribute expr, or None."""
+    origin = src.resolve(node, aliases)
+    return _TARGETS.get(origin) if origin else None
+
+
+def partial_jit_target(src, call, aliases):
+    """The jit label wrapped by a ``functools.partial(jax.jit, ...)``
+    call, or None. The ``@functools.partial(jax.jit, static_argnums=…)``
+    decorator idiom builds a program factory just like a direct call —
+    flagging the partial construction covers the decorator, assignment
+    and immediate-call forms at once."""
+    if not isinstance(call, ast.Call) or not call.args:
+        return None
+    if src.resolve(call.func, aliases) not in ("functools.partial",
+                                               "partial"):
+        return None
+    return resolve_jit_target(src, call.args[0], aliases)
+
+
+class JitSiteRule:
+    id = "jit-site"
+
+    def check_source(self, src, project):
+        findings = []
+        aliases = src.import_aliases()
+        in_executor = src.display.endswith(_EXECUTOR_FILE) \
+            or src.display == "executor.py"
+        marked = set(src.jit_marker_lines)
+
+        def flag(node, label, how):
+            if node.lineno in marked and in_executor:
+                marked.discard(node.lineno)     # each marker covers ONE site
+                return
+            findings.append(src.finding(
+                self.id, node,
+                "raw %s %s outside the instrumented wrapper — route "
+                "programs through executor._InstrumentedProgram so they "
+                "get a program card (telemetry.programs()), recompile "
+                "diagnosis, OOM enrichment and the persisted compile "
+                "cache" % (label, how)))
+
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call):
+                label = resolve_jit_target(src, node.func, aliases)
+                if label:
+                    flag(node, label, "call")
+                else:
+                    label = partial_jit_target(src, node, aliases)
+                    if label:
+                        flag(node, label, "functools.partial wrap")
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    label = resolve_jit_target(src, target, aliases)
+                    if label:
+                        flag(dec, label, "decorator")
+
+        if not in_executor:
+            for line in sorted(src.jit_marker_lines):
+                findings.append(src.finding(
+                    self.id, line,
+                    "'%s' marker outside %s — the instrumented site is "
+                    "singular by contract"
+                    % ("the ONE instrumented jit site", _EXECUTOR_FILE)))
+        return findings

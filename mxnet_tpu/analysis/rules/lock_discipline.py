@@ -1,0 +1,343 @@
+"""lock-discipline: annotated shared state is only touched under its lock.
+
+The threaded layers (serving coalescer/resolver pool, the process-global
+telemetry registry, the compile cache, fault registry and checkpoint
+manager) guard shared state with ``threading.Lock`` objects — a
+convention nothing checked until now, and exactly the class of bug that
+shipped (and had to be hotfixed) in PR 4's ledger finalizer. The
+annotation grammar::
+
+    self._stats = Counter()        # guarded by: self._lock
+    _counters = {}                 # guarded by: _lock
+
+declares that every later read or write of that attribute (within its
+class) or module global (within its module) must sit lexically inside
+``with <lock>:`` — where ``<lock>`` is the annotated expression or a
+``threading.Condition`` constructed over it (``self._space =
+threading.Condition(self._lock)`` makes ``with self._space:`` count).
+
+Exemptions the checker grants (everything else needs a justified
+``# mxlint: disable=lock-discipline -- why``):
+
+* ``__init__`` methods / module top level — construction happens-before
+  publication to other threads;
+* functions whose name ends ``_locked`` — the documented
+  caller-holds-the-lock convention (``telemetry._ledger_drain_locked``);
+* for globals, functions where the name is a plain local (no ``global``
+  declaration) — that's a different variable.
+
+Plus the finalizer check: a callback handed to ``weakref.finalize``
+must NOT acquire any known lock — cyclic GC can run finalizers
+synchronously on a thread that already holds it (any allocation inside
+a locked section can trip the GC threshold), deadlocking the process;
+the PR 4 ledger hotfix is the in-repo precedent. Flagged on the
+``with``/``.acquire()`` inside the callback.
+"""
+import ast
+
+from ..core import expr_text, is_self_attr
+
+_LOCK_FACTORIES = {"threading.Lock", "threading.RLock",
+                   "threading.Condition"}
+
+
+class _Access:
+    __slots__ = ("node", "funcs", "classes", "withs", "is_store")
+
+    def __init__(self, node, funcs, classes, withs, is_store):
+        self.node = node
+        self.funcs = funcs          # tuple of enclosing FunctionDef nodes
+        self.classes = classes      # tuple of enclosing ClassDef nodes
+        self.withs = withs          # frozenset of canonical lock texts
+        self.is_store = is_store
+
+
+class _Walker(ast.NodeVisitor):
+    """One pass over the tree collecting every Name/Attribute access
+    with its enclosing (function, class, with-lock) context."""
+
+    def __init__(self, canonical):
+        self.canonical = canonical  # with-expr text -> canonical lock
+        self.funcs = []
+        self.classes = []
+        self.withs = []
+        self.accesses = []
+        self.finalize_calls = []    # (Call node, funcs snapshot)
+
+    def _snap(self, node, is_store):
+        self.accesses.append(_Access(
+            node, tuple(self.funcs), tuple(self.classes),
+            frozenset(self.withs), is_store))
+
+    def visit_FunctionDef(self, node):
+        # decorators/defaults evaluate at def time (under any held
+        # lock); the BODY runs later, without it — a callback defined
+        # inside `with lock:` and handed to a pool/finalizer must not
+        # inherit the lock context (the deferred-callback bug class)
+        for dec in node.decorator_list:
+            self.visit(dec)
+        self.visit(node.args)
+        self.funcs.append(node)
+        held, self.withs = self.withs, []
+        for stmt in node.body:
+            self.visit(stmt)
+        self.withs = held
+        self.funcs.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self.visit(node.args)
+        held, self.withs = self.withs, []
+        self.visit(node.body)
+        self.withs = held
+
+    def visit_ClassDef(self, node):
+        self.classes.append(node)
+        self.generic_visit(node)
+        self.classes.pop()
+
+    def visit_With(self, node):
+        # the with-items themselves evaluate BEFORE the lock is held
+        held = []
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+            text = expr_text(item.context_expr)
+            held.append(self.canonical.get(text, text))
+        self.withs.extend(held)
+        for stmt in node.body:
+            self.visit(stmt)
+        del self.withs[len(self.withs) - len(held):]
+
+    visit_AsyncWith = visit_With
+
+    def visit_Name(self, node):
+        self._snap(node, isinstance(node.ctx, (ast.Store, ast.Del)))
+
+    def visit_Attribute(self, node):
+        self._snap(node, isinstance(node.ctx, (ast.Store, ast.Del)))
+        self.visit(node.value)
+
+    def visit_Call(self, node):
+        fn = node.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        if name == "finalize" and len(node.args) >= 2:
+            self.finalize_calls.append((node, tuple(self.funcs)))
+        self.generic_visit(node)
+
+
+class LockDisciplineRule:
+    id = "lock-discipline"
+
+    def check_source(self, src, project):
+        # cheap precondition: locks (and Condition aliases) cannot exist
+        # without the word "threading" somewhere in the file — skip the
+        # full access walk for the ~90% of files without it
+        if "threading" not in src.text and not src.guards:
+            return []
+        aliases = src.import_aliases()
+        parents = src.parents()
+
+        def owner_class(node):
+            cur = parents.get(node)
+            while cur is not None:
+                if isinstance(cur, ast.ClassDef):
+                    return cur
+                cur = parents.get(cur)
+            return None
+
+        # -- pass 1: known locks + Condition aliasing -----------------------
+        # canonical: with-expr text -> the underlying lock's text
+        canonical = {}
+        known_locks = set()          # texts: "_lock", "self._lock", ...
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.value, ast.Call)):
+                continue
+            origin = src.resolve(node.value.func, aliases)
+            if origin not in _LOCK_FACTORIES:
+                continue
+            target = node.targets[0]
+            if isinstance(target, ast.Name) or is_self_attr(target):
+                text = expr_text(target)
+                known_locks.add(text)
+                canonical.setdefault(text, text)
+                if origin.endswith("Condition") and node.value.args:
+                    inner = expr_text(node.value.args[0])
+                    if inner:
+                        canonical[text] = inner
+                        known_locks.add(inner)
+
+        # -- pass 2: guard annotations -> entities --------------------------
+        # entity: ("global"|"attr", name, owner ClassDef or None, lock,
+        #          annotation line)
+        entities = []
+        findings = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign,
+                                     ast.AugAssign)):
+                continue
+            lock = src.guards.get(node.lineno)
+            if lock is None:
+                continue
+            lock = canonical.get(lock, lock)
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            matched = False
+            for t in targets:
+                if isinstance(t, ast.Name) and owner_class(node) is None \
+                        and not any(isinstance(p, (ast.FunctionDef,
+                                                   ast.AsyncFunctionDef))
+                                    for p in _ancestors(node, parents)):
+                    entities.append(("global", t.id, None, lock,
+                                     node.lineno))
+                    matched = True
+                elif is_self_attr(t):
+                    entities.append(("attr", t.attr, owner_class(node),
+                                     lock, node.lineno))
+                    matched = True
+            if not matched:
+                findings.append(src.finding(
+                    self.id, node,
+                    "'# guarded by:' annotation on an unsupported "
+                    "target — annotate a module-global or self.<attr> "
+                    "assignment"))
+
+        if not entities and not known_locks:
+            return findings
+
+        # -- pass 3: every access, with context -----------------------------
+        walker = _Walker(canonical)
+        walker.visit(src.tree)
+
+        func_locals = {}
+
+        def locals_of(fn):
+            got = func_locals.get(fn)
+            if got is None:
+                assigned, declared_global = set(), set()
+                for n in ast.walk(fn):
+                    if isinstance(n, ast.Name) \
+                            and isinstance(n.ctx, (ast.Store, ast.Del)):
+                        assigned.add(n.id)
+                    elif isinstance(n, (ast.Global, ast.Nonlocal)):
+                        declared_global.update(n.names)
+                    elif isinstance(n, ast.arg):
+                        assigned.add(n.arg)
+                got = func_locals[fn] = assigned - declared_global
+            return got
+
+        def exempt(acc):
+            if not acc.funcs:
+                return True                      # module level / class body
+            # INNERMOST function only: a closure defined inside
+            # __init__/*_locked but handed to a pool or finalizer runs
+            # later, outside both the constructor and the lock — it
+            # earns no exemption from its definition site
+            inner = acc.funcs[-1]
+            return inner.name == "__init__" \
+                or inner.name.endswith("_locked")
+
+        seen_lines = set()
+        for kind, name, owner, lock, _ann_line in entities:
+            for acc in walker.accesses:
+                node = acc.node
+                if kind == "global":
+                    if not (isinstance(node, ast.Name) and node.id == name):
+                        continue
+                    if not acc.funcs:
+                        continue                 # module-level init
+                    inner = acc.funcs[-1]
+                    if name in locals_of(inner):
+                        continue                 # a plain local shadows it
+                elif kind == "attr":
+                    if not is_self_attr(node, name):
+                        continue
+                    if owner is not None and owner not in acc.classes:
+                        continue                 # another class's attr
+                if exempt(acc):
+                    continue
+                if lock in acc.withs:
+                    continue
+                where = acc.funcs[-1].name if acc.funcs else "<module>"
+                dedup = (name, node.lineno, node.col_offset)
+                if dedup in seen_lines:
+                    continue
+                seen_lines.add(dedup)
+                label = "attribute 'self.%s'" % name if kind == "attr" \
+                    else "global '%s'" % name
+                findings.append(src.finding(
+                    self.id, node,
+                    "%s is annotated '# guarded by: %s' but is %s "
+                    "outside a with-block on that lock (in %s)"
+                    % (label, lock,
+                       "written" if acc.is_store else "read", where)))
+
+        # -- pass 4: weakref.finalize callbacks must not take a lock --------
+        findings.extend(self._check_finalizers(
+            src, walker, aliases, known_locks, canonical))
+        return findings
+
+    def _check_finalizers(self, src, walker, aliases, known_locks,
+                          canonical):
+        findings = []
+        module_funcs = {n.name: n for n in src.tree.body
+                        if isinstance(n, ast.FunctionDef)}
+        method_index = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef):
+                        method_index[item.name] = item
+
+        for call, _funcs in walker.finalize_calls:
+            origin = src.resolve(call.func, aliases)
+            if origin != "weakref.finalize" and not (
+                    isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "finalize"
+                    and origin is None):
+                continue
+            cb = call.args[1]
+            body = None
+            label = expr_text(cb)
+            if isinstance(cb, ast.Lambda):
+                body, label = cb, "<lambda>"
+            elif isinstance(cb, ast.Name):
+                body = module_funcs.get(cb.id)
+            elif is_self_attr(cb):
+                body = method_index.get(cb.attr)
+            if body is None:
+                continue
+            for n in ast.walk(body):
+                bad = None
+                if isinstance(n, (ast.With, ast.AsyncWith)):
+                    for item in n.items:
+                        text = expr_text(item.context_expr)
+                        if canonical.get(text, text) in known_locks:
+                            bad = "with %s" % text
+                elif isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr == "acquire":
+                    text = expr_text(n.func.value)
+                    if canonical.get(text, text) in known_locks:
+                        bad = "%s.acquire()" % text
+                if bad:
+                    findings.append(src.finding(
+                        self.id, n,
+                        "lock acquisition (%s) inside weakref.finalize "
+                        "callback '%s' — cyclic GC can run finalizers on "
+                        "a thread already holding the lock and deadlock "
+                        "the process (the PR 4 ledger bug); hand the "
+                        "work to a lock-free pending queue drained under "
+                        "the lock instead" % (bad, label)))
+        return findings
+
+
+def _ancestors(node, parents):
+    cur = parents.get(node)
+    while cur is not None:
+        yield cur
+        cur = parents.get(cur)

@@ -1,0 +1,261 @@
+"""registry-consistency: string registries stay in sync across modules.
+
+Three registries coordinate five-plus modules through bare string
+literals, where a typo compiles fine and silently never fires:
+
+* **fault sites** — ``faults.SITES`` declares the names the runtime
+  consults (``faults.fire("dispatch")`` in executor.py, ``"d2h"`` in
+  serving.py, ...). A ``fire()`` literal not in SITES is an injection
+  point that can never inject; a SITES entry no runtime module fires is
+  a chaos lane that tests nothing.
+* **fused-fallback codes** — ``FusedFallback("<code>", ...)``
+  constructions vs the declared ``FUSED_FALLBACK_CODES`` table (bench
+  lanes and tests assert on the stable codes).
+* **telemetry counters** — ``telemetry.counter_inc("<name>")`` literals
+  (and ``"prefix.%s" % x`` / f-string prefixes) vs the declared
+  ``telemetry.COUNTERS`` patterns, where a trailing ``.*`` covers
+  dynamic tails (codes, sites, causes, kinds).
+
+Both directions are checked: an UNDECLARED use reports at the call
+site; an UNUSED declaration reports at the registry. Declarations are
+found structurally (a top-level ``SITES`` / ``FUSED_FALLBACK_CODES`` /
+``COUNTERS`` literal in any scanned file), so the fixture corpus can
+carry miniature registries. Unused-entry checks only run when the scan
+actually saw at least one use of that registry kind — linting a single
+file must not report the whole world unused.
+"""
+import ast
+
+
+def _str_tuple(node):
+    if isinstance(node, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in node.elts):
+        return [e.value for e in node.elts]
+    return None
+
+
+def _str_dict_keys(node):
+    if isinstance(node, ast.Dict) and node.keys and all(
+            isinstance(k, ast.Constant) and isinstance(k.value, str)
+            for k in node.keys):
+        return [k.value for k in node.keys]
+    return None
+
+
+def _call_name(node):
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _literal_first_arg(node):
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        return node.args[0].value
+    return None
+
+
+def _format_prefix(node):
+    """The static prefix of a dynamic counter name: ``"a.b.%s" % x``
+    -> ``"a.b."`` (None when the first arg isn't a %-format or f-string
+    over a literal head)."""
+    if not node.args:
+        return None
+    a = node.args[0]
+    if isinstance(a, ast.BinOp) and isinstance(a.op, ast.Mod) \
+            and isinstance(a.left, ast.Constant) \
+            and isinstance(a.left.value, str):
+        return a.left.value.split("%", 1)[0]
+    if isinstance(a, ast.JoinedStr) and a.values \
+            and isinstance(a.values[0], ast.Constant) \
+            and isinstance(a.values[0].value, str) \
+            and len(a.values) > 1:
+        return a.values[0].value
+    return None
+
+
+def _pattern_covers_name(pattern, name):
+    if pattern.endswith(".*"):
+        return name.startswith(pattern[:-1]) or name == pattern[:-2]
+    return name == pattern
+
+
+def _pattern_covers_prefix(pattern, prefix):
+    """A dynamic use with static ``prefix`` is only guaranteed by a
+    wildcard whose stem contains the whole prefix."""
+    return pattern.endswith(".*") and prefix.startswith(pattern[:-1])
+
+
+class RegistryConsistencyRule:
+    id = "registry-consistency"
+
+    def check_project(self, project):
+        findings = []
+        decls = {"SITES": [], "FUSED_FALLBACK_CODES": [], "COUNTERS": []}
+        registry_stmt_strings = set()     # id()s of declaration nodes
+
+        for src in project.sources:
+            for node in src.tree.body:
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    continue
+                tname = node.targets[0].id
+                if tname in ("SITES", "COUNTERS"):
+                    vals = _str_tuple(node.value)
+                elif tname == "FUSED_FALLBACK_CODES":
+                    vals = _str_dict_keys(node.value)
+                else:
+                    continue
+                if vals is None:
+                    continue
+                decls[tname].append((src, node, vals))
+                for sub in ast.walk(node):
+                    registry_stmt_strings.add(id(sub))
+
+        # one declaration per registry kind per scan: silently binding
+        # an arbitrary one (e.g. a fixture mini-registry when tests/ and
+        # the runtime are scanned together) would judge every real use
+        # against the wrong table — duplicates are findings, and the
+        # cross-check proceeds against the FIRST in file order
+        def pick(tname):
+            found = decls[tname]
+            if not found:
+                return None
+            first = found[0]
+            for src, node, _vals in found[1:]:
+                findings.append(src.finding(
+                    self.id, node,
+                    "duplicate %s declaration in this scan — %s:%d "
+                    "already declares it and uses are cross-checked "
+                    "against that one; lint the conflicting path sets "
+                    "separately" % (tname, first[0].display,
+                                    first[1].lineno)))
+            return first
+
+        sites_decl = pick("SITES")
+        codes_decl = pick("FUSED_FALLBACK_CODES")
+        counters_decl = pick("COUNTERS")
+
+        # -- collect uses ----------------------------------------------------
+        fire_uses, code_uses, counter_uses = [], [], []
+        counter_prefix_uses = []
+        for src in project.sources:
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _call_name(node)
+                if name == "fire":
+                    lit = _literal_first_arg(node)
+                    if lit is not None:
+                        fire_uses.append((src, node, lit))
+                elif name == "FusedFallback":
+                    lit = _literal_first_arg(node)
+                    if lit is not None:
+                        code_uses.append((src, node, lit))
+                elif name in ("counter_inc", "record_fault_counter"):
+                    lit = _literal_first_arg(node)
+                    if lit is not None:
+                        counter_uses.append((src, node, lit))
+                    else:
+                        pfx = _format_prefix(node)
+                        if pfx:
+                            counter_prefix_uses.append((src, node, pfx))
+
+        # -- fault sites -----------------------------------------------------
+        if sites_decl is not None:
+            src, node, declared = sites_decl
+            dset = set(declared)
+            used = set()
+            for usrc, unode, lit in fire_uses:
+                used.add(lit)
+                if lit not in dset:
+                    findings.append(usrc.finding(
+                        self.id, unode,
+                        "faults.fire(%r): site not declared in "
+                        "faults.SITES (%s) — an undeclared site never "
+                        "fires; add it to SITES or fix the typo"
+                        % (lit, ", ".join(sorted(dset)))))
+            if used:
+                for missing in [s for s in declared if s not in used]:
+                    findings.append(src.finding(
+                        self.id, node,
+                        "faults.SITES entry %r is never consulted by "
+                        "any scanned faults.fire() call — dead chaos "
+                        "site; wire it in or drop the declaration"
+                        % missing))
+
+        # -- fused-fallback codes -------------------------------------------
+        if codes_decl is not None:
+            src, node, declared = codes_decl
+            dset = set(declared)
+            used = set()
+            for usrc, unode, lit in code_uses:
+                used.add(lit)
+                if lit not in dset:
+                    findings.append(usrc.finding(
+                        self.id, unode,
+                        "FusedFallback(%r): code not declared in "
+                        "FUSED_FALLBACK_CODES — bench lanes and tests "
+                        "key on the declared codes" % lit))
+            if used:
+                for missing in [c for c in declared if c not in used]:
+                    findings.append(src.finding(
+                        self.id, node,
+                        "FUSED_FALLBACK_CODES entry %r is never "
+                        "constructed by any scanned FusedFallback() "
+                        "call — dead fallback code" % missing))
+
+        # -- telemetry counters ---------------------------------------------
+        if counters_decl is not None:
+            src, node, declared = counters_decl
+            for usrc, unode, lit in counter_uses:
+                if not any(_pattern_covers_name(p, lit)
+                           for p in declared):
+                    findings.append(usrc.finding(
+                        self.id, unode,
+                        "counter_inc(%r): counter not declared in "
+                        "telemetry.COUNTERS — declare it (a '.*' "
+                        "pattern covers dynamic tails) or fix the "
+                        "name" % lit))
+            for usrc, unode, pfx in counter_prefix_uses:
+                if not any(_pattern_covers_prefix(p, pfx)
+                           for p in declared):
+                    findings.append(usrc.finding(
+                        self.id, unode,
+                        "counter_inc(%r...): dynamic counter prefix "
+                        "not covered by any telemetry.COUNTERS '.*' "
+                        "pattern" % pfx))
+            if counter_uses or counter_prefix_uses:
+                # the registry module's own internal writes (the
+                # record_* helpers format names straight into the
+                # locked dict) count as uses via their string constants
+                internal = set()
+                for n in ast.walk(src.tree):
+                    if id(n) in registry_stmt_strings:
+                        continue
+                    if isinstance(n, ast.Constant) \
+                            and isinstance(n.value, str):
+                        internal.add(n.value)
+                        if "%" in n.value:
+                            internal.add(n.value.split("%", 1)[0])
+                for p in declared:
+                    lits = [l for _s, _n, l in counter_uses]
+                    pfxs = [x for _s, _n, x in counter_prefix_uses]
+                    hit = (any(_pattern_covers_name(p, l) for l in lits)
+                           or any(_pattern_covers_prefix(p, x)
+                                  for x in pfxs)
+                           or any(_pattern_covers_name(p, l)
+                                  or _pattern_covers_prefix(p, l)
+                                  for l in internal))
+                    if not hit:
+                        findings.append(src.finding(
+                            self.id, node,
+                            "telemetry.COUNTERS pattern %r matches no "
+                            "scanned counter_inc() call — dead "
+                            "declaration" % p))
+        return findings

@@ -163,8 +163,12 @@ class CheckpointManager:
     def __init__(self, prefix, keep_last=3):
         self.prefix = str(prefix)
         self.keep_last = max(1, int(keep_last))
+        # NOT lock-guarded by design: the armed SIGTERM/SIGINT handler
+        # writes this flag, and a signal handler that takes a lock can
+        # deadlock against the interrupted frame holding it — single
+        # GIL-atomic str-or-None store, polled at batch boundaries
         self._preempt = None            # signal name once requested
-        self._armed = {}                # signum -> previous handler
+        self._armed = {}                # guarded by: self._lock
         self._lock = threading.Lock()
 
     # -- paths -------------------------------------------------------------

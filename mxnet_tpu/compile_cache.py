@@ -69,7 +69,7 @@ _log = get_logger("mxnet_tpu.compile_cache")
 
 # one structured warning per (key, cause-kind): a poisoned entry that
 # every bucket trips over must not log a storm
-_WARNED = set()
+_WARNED = set()      # guarded by: _lock
 _lock = threading.Lock()
 
 _MAGIC = b"MXTPUCC1"
@@ -89,7 +89,7 @@ def cache_dir():
     return d
 
 
-_DIR_TRUST = {}
+_DIR_TRUST = {}      # guarded by: _lock
 
 
 def _trusted_dir():
@@ -102,7 +102,8 @@ def _trusted_dir():
     d = cache_dir()
     if d is None:
         return None
-    t = _DIR_TRUST.get(d)
+    with _lock:
+        t = _DIR_TRUST.get(d)
     if t is None:
         try:
             st = os.stat(d)
@@ -118,7 +119,11 @@ def _trusted_dir():
                 "group/world-writable — the persisted executable tier "
                 "is DISABLED (a foreign-writable store could feed "
                 "arbitrary pickles to deserialization)", d)
-        _DIR_TRUST[d] = t
+        # the stat/warn runs unlocked (filesystem I/O must not hold the
+        # registry lock); a concurrent first-call races to the same
+        # verdict and the write below is idempotent
+        with _lock:
+            _DIR_TRUST[d] = t
     return d if t else None
 
 
@@ -225,7 +230,7 @@ def entry_path(key):
 # entry (which still verifies versions/backend/topology/checksum), so
 # the worst a stale index can do is a rejected load -> fresh compile.
 
-_SRC_FP = None
+_SRC_FP = None       # guarded by: _lock
 
 # cache/corpus/telemetry toggles do not change what a trace produces —
 # including them would split the cache for no reason
@@ -238,7 +243,9 @@ def source_fingerprint():
     mtime_ns) — any source edit (or a fresh checkout) invalidates the
     trace-skip tier, which then falls back to trace + content key."""
     global _SRC_FP
-    if _SRC_FP is None:
+    with _lock:
+        fp = _SRC_FP
+    if fp is None:
         root = os.path.dirname(os.path.abspath(__file__))
         items = []
         for dirpath, dirnames, filenames in os.walk(root):
@@ -253,9 +260,15 @@ def source_fingerprint():
                     continue
                 items.append([os.path.relpath(p, root), st.st_size,
                               st.st_mtime_ns])
-        _SRC_FP = hashlib.sha256(
+        fp = hashlib.sha256(
             json.dumps(items, sort_keys=True).encode()).hexdigest()
-    return _SRC_FP
+        # the tree walk runs unlocked; first writer wins (both racers
+        # hashed the same tree)
+        with _lock:
+            if _SRC_FP is None:
+                _SRC_FP = fp
+            fp = _SRC_FP
+    return fp
 
 
 def _graph_env():

@@ -121,10 +121,14 @@ class _Rule:
 
 
 _lock = threading.Lock()
-_rules = {}          # site -> [rule, ...]
-_calls = {}          # site -> call count (every consult, fired or not)
-_loaded = False      # env spec parsed?
-_spec = None         # the active spec string (for introspection)
+_rules = {}          # guarded by: _lock
+                     # site -> [rule, ...]
+_calls = {}          # guarded by: _lock
+                     # site -> call count (every consult, fired or not)
+_loaded = False      # guarded by: _lock
+                     # env spec parsed?
+_spec = None         # guarded by: _lock
+                     # the active spec string (for introspection)
 
 
 def _parse_rule(text):
@@ -209,7 +213,7 @@ def clear():
 
 def _ensure_loaded():
     global _loaded
-    if _loaded:
+    if _loaded:   # mxlint: disable=lock-discipline -- idempotent one-way latch; a racing loser re-runs configure() with the same env spec
         return
     env_spec = os.environ.get(ENV, "")
     if not env_spec:
@@ -230,13 +234,14 @@ def _ensure_loaded():
 def active():
     """Whether any rule is installed (after lazily reading the env)."""
     _ensure_loaded()
-    return bool(_rules)
+    return bool(_rules)   # mxlint: disable=lock-discipline -- GIL-atomic truthiness probe on the inert fast path; fire() re-reads under the lock
 
 
 def spec():
     """The active spec string, or None."""
     _ensure_loaded()
-    return _spec
+    with _lock:
+        return _spec
 
 
 def fire(site):
@@ -244,9 +249,9 @@ def fire(site):
     a delay already served), or ``"nan"`` when the caller should corrupt
     its payload with :func:`poison`; raises :class:`InjectedFault` for a
     ``raise`` rule. One dict lookup when no spec is configured."""
-    if not _loaded:
+    if not _loaded:   # mxlint: disable=lock-discipline -- GIL-atomic latch probe; the module must cost one read per site when inert
         _ensure_loaded()
-    if not _rules:
+    if not _rules:   # mxlint: disable=lock-discipline -- GIL-atomic emptiness probe (the documented one-dict-check fast path); rules re-read under the lock below
         return None
     with _lock:
         rules = _rules.get(site)

@@ -324,6 +324,7 @@ class BaseModule:
             if ckpt is not None:
                 ckpt.disarm_signals()
 
+    # mxlint: hot
     def _fit_loop(self, train_data, eval_data, eval_metric,
                   validation_metric, epoch_end_callback,
                   batch_end_callback, eval_end_callback,

@@ -663,7 +663,7 @@ class Module(BaseModule):
             "packed": packed,
         }
 
-    def _run_fused_step(self, plan, packed, data_batch, eval_metric):
+    def _run_fused_step(self, plan, packed, data_batch, eval_metric):   # mxlint: hot
         """Execute one whole-step fused program from a validated plan:
         marshal raw buffers, launch, reinstall the donated results."""
         ex = self._exec
@@ -683,7 +683,7 @@ class Module(BaseModule):
         dev = None if mesh is not None else self._context[0].jax_device()
 
         def _raw(arr):
-            raw = arr._data if isinstance(arr, NDArray) else np.asarray(arr)
+            raw = arr._data if isinstance(arr, NDArray) else np.asarray(arr)   # mxlint: disable=host-sync -- feed-path marshalling of a HOST-side batch array (lists/np inputs); device arrays take the _data branch
             if mesh is not None:
                 # one sharded device_put of the GLOBAL batch — each
                 # device receives its shard, no host-side splitting
@@ -755,7 +755,7 @@ class Module(BaseModule):
         record_dispatch("train_step")
         with telemetry.span("step"):
             new_params, new_states, new_acc, new_aux, outs, grads_out = \
-                plan["fn"](params_raw, states_raw, acc, aux_raw, inputs, rng,
+                plan["fn"](params_raw, states_raw, acc, aux_raw, inputs, rng,   # mxlint: donates 0-3
                            lrs, wds, ts, add_grads)
 
         # donation invalidated the old buffers — reinstall everything

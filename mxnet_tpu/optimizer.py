@@ -871,7 +871,7 @@ class FusedUpdater(Updater):
         raw_states = [tuple(x._data for x in tup) for tup in packed]
         from .executor import record_dispatch
         record_dispatch("opt_update")
-        new_ws, new_states = fn(raw_ws, raw_states, raw_gs, lrs, wds, ts)
+        new_ws, new_states = fn(raw_ws, raw_states, raw_gs, lrs, wds, ts)   # mxlint: donates 0,1
 
         for w, tup, nw, ntup in zip(weights, packed, new_ws, new_states):
             w._set_data(nw)

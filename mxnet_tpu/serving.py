@@ -335,26 +335,28 @@ class InferenceEngine:
         self._retry_backoff_s = max(0.0, float(retry_backoff_ms)) / 1e3
         self._breaker_threshold = max(0, int(breaker_threshold))
         self._breaker_reset_s = float(breaker_reset_s)
-        self._breaker_open_at = None     # monotonic trip instant
-        self._breaker_probing = False    # one half-open trial at a time
-        self._consecutive_failures = 0
-        self._queued_rows = 0            # admitted, not yet dispatched
+        self._breaker_open_at = None     # guarded by: self._lock
+        self._breaker_probing = False    # guarded by: self._lock
+        self._consecutive_failures = 0   # guarded by: self._lock
+        self._queued_rows = 0            # guarded by: self._lock
 
         self._logger = telemetry_logger
         self._lock = threading.Lock()
         # admission backpressure: notified whenever queued rows leave
-        # the admission queue (dispatch or shed)
+        # the admission queue (dispatch or shed). Condition over the
+        # SAME lock — ``with self._space:`` satisfies every
+        # ``guarded by: self._lock`` annotation above/below
         self._space = threading.Condition(self._lock)
-        self._stats = collections.Counter()
-        self._bucket_batches = collections.Counter()
+        self._stats = collections.Counter()            # guarded by: self._lock
+        self._bucket_batches = collections.Counter()   # guarded by: self._lock
         # measured serving data the card corpus persists for the
         # autotuner: coalesced-batch row counts (pre-padding) and
         # dispatch->resolution wall-time per bucket
-        self._rows_hist = collections.Counter()
-        self._bucket_lat = {}        # bucket -> [total_seconds, count]
+        self._rows_hist = collections.Counter()        # guarded by: self._lock
+        self._bucket_lat = {}            # guarded by: self._lock
         self._q = queue.Queue()
         self._inflight = threading.Semaphore(self._max_inflight)
-        self._closed = False
+        self._closed = False             # guarded by: self._lock
         self._pool = ThreadPoolExecutor(
             max_workers=self._max_inflight,
             thread_name_prefix="mxtpu-serve-resolve")
@@ -529,7 +531,7 @@ class InferenceEngine:
         if isinstance(exc, DeadlineExceeded):
             telemetry.counter_inc("serving.deadline_exceeded")
 
-    def submit(self, *args, deadline_ms=None, **kwargs):
+    def submit(self, *args, deadline_ms=None, **kwargs):   # mxlint: hot
         """Enqueue one request; returns a Future resolving to the list
         of per-output numpy arrays (each ``(rows, ...)``). Inputs go by
         name (``submit(data=x)``); a single-input graph also accepts one
@@ -541,17 +543,21 @@ class InferenceEngine:
         resolves with ``DeadlineExceeded``. A full bounded queue sheds
         (``QueueOverflow``) or blocks, per the ``overload`` policy; an
         open breaker fast-fails with ``CircuitOpen``."""
-        if self._closed:                 # fast path; re-checked under
-            raise EngineClosed("serving: engine is closed")   # the lock
+        if self._closed:   # mxlint: disable=lock-discipline -- lock-free fast path; re-checked under the lock before enqueue
+            raise EngineClosed("serving: engine is closed")
         if self._breaker_tripped():
             with self._lock:
                 self._stats["breaker_fastfail"] += 1
+                # capture under the SAME lock the failure path writes
+                # it under — the bare read could tear against a
+                # concurrent _dispatch_failed/_dispatch_succeeded
+                consecutive = self._consecutive_failures
             telemetry.counter_inc("serving.breaker_fastfail")
             raise CircuitOpen(
                 "serving: breaker open after %d consecutive dispatch "
                 "failures — fast-failing instead of queuing onto a "
                 "failing backend (retries again %.1fs after the trip)"
-                % (self._consecutive_failures, self._breaker_reset_s))
+                % (consecutive, self._breaker_reset_s))
         if args:
             if len(args) != 1 or kwargs or len(self._input_names) != 1:
                 raise MXNetError("serving: pass inputs by name "
@@ -563,7 +569,7 @@ class InferenceEngine:
                                             sorted(self._input_names)))
         arrays, rows = {}, None
         for n, v in kwargs.items():
-            a = np.asarray(getattr(v, "asnumpy", lambda: v)())
+            a = np.asarray(getattr(v, "asnumpy", lambda: v)())   # mxlint: disable=host-sync -- marshalling the CLIENT's payload to a host array is the request contract, not a device fetch
             want = self._row_shapes[n]
             if a.shape == want:           # a single row without batch dim
                 a = a[None]
@@ -590,11 +596,12 @@ class InferenceEngine:
         # flag-set + sentinel-put: a request that passes the check is
         # guaranteed to land BEFORE the shutdown sentinel, so its future
         # always resolves
-        def _drop(exc, shed=False, deadline_hit=False):
+        def _drop_locked(exc, shed=False, deadline_hit=False):
             # an admission-rejected request never enters the queue, but
             # its spans were entered at _Request construction: close
             # them (the rejection time is a real latency sample) and
-            # account the shed. Caller holds self._lock.
+            # account the shed. Caller holds self._lock (the _locked
+            # suffix is the lint-checked contract).
             req.wait_span.__exit__(None, None, None)
             req.req_span.__exit__(None, None, None)
             if shed:
@@ -610,13 +617,13 @@ class InferenceEngine:
 
         with self._space:
             if self._closed:
-                _drop(EngineClosed("serving: engine is closed"))
+                _drop_locked(EngineClosed("serving: engine is closed"))
             # bounded admission: shed fast or backpressure (bounded by
             # the request's own deadline)
             while self.max_queue_rows is not None \
                     and self._queued_rows + rows > self.max_queue_rows:
                 if self.overload == "shed":
-                    _drop(QueueOverflow(
+                    _drop_locked(QueueOverflow(
                         "serving: admission queue full (%d rows "
                         "waiting, max_queue_rows=%d) — shedding"
                         % (self._queued_rows, self.max_queue_rows)),
@@ -625,13 +632,13 @@ class InferenceEngine:
                     else deadline - time.monotonic()
                 if timeout is not None and timeout <= 0 \
                         or not self._space.wait(timeout):
-                    _drop(DeadlineExceeded(
+                    _drop_locked(DeadlineExceeded(
                         "serving: deadline expired while blocked on a "
                         "full admission queue (max_queue_rows=%d)"
                         % self.max_queue_rows), shed=True,
                         deadline_hit=True)
                 if self._closed:
-                    _drop(EngineClosed("serving: engine is closed"))
+                    _drop_locked(EngineClosed("serving: engine is closed"))
             self._stats["requests"] += 1
             self._stats["rows"] += rows
             self._queued_rows += rows
@@ -666,6 +673,10 @@ class InferenceEngine:
                                   "mean_ms": round(t / c * 1e3, 3)}
                          for b, (t, c) in sorted(self._bucket_lat.items())
                          if c}
+            # snapshot here too: the coalescer bumps this Counter per
+            # batch, and the bare read further down raced it
+            buckets = {str(k): v for k, v in
+                       sorted(self._bucket_batches.items())}
         rows = st.get("batch_rows", 0)
         pad = st.get("pad_rows", 0)
         lat = telemetry.span_stats("serve_request").get("serve_request", {})
@@ -711,8 +722,7 @@ class InferenceEngine:
                 "trips": st.get("breaker_trips", 0),
                 "fastfail": st.get("breaker_fastfail", 0),
             },
-            "buckets": {str(k): v for k, v in
-                        sorted(self._bucket_batches.items())},
+            "buckets": buckets,
             # the measured serving data the card corpus persists:
             # coalesced row counts (pre-pad) and per-bucket step ms
             "rows_hist": rows_hist,
@@ -808,7 +818,7 @@ class InferenceEngine:
         return False
 
     # -- coalescer ----------------------------------------------------------
-    def _launch(self, batch):
+    def _launch(self, batch):   # mxlint: hot
         """Release a coalesced batch from the admission queue, shed the
         stale members (their deadline passed while they waited — they
         must not pad a bucket and burn device time on an answer nobody
@@ -830,7 +840,7 @@ class InferenceEngine:
         if live:
             self._dispatch(live)
 
-    def _coalesce_loop(self):
+    def _coalesce_loop(self):   # mxlint: hot
         pending, pending_rows = [], 0
         deadline = None
 
@@ -896,7 +906,7 @@ class InferenceEngine:
         submits are admitted again and ONE trial batch probes the
         backend. Lock-free (monotonic reads) — stats() calls this under
         the lock."""
-        opened = self._breaker_open_at
+        opened = self._breaker_open_at   # mxlint: disable=lock-discipline -- GIL-atomic one-shot read on the submit fast path; stats() re-reads under the lock
         if opened is None:
             return False
         return (time.monotonic() - opened) < self._breaker_reset_s
@@ -950,7 +960,7 @@ class InferenceEngine:
             self._breaker_open_at = None
             self._breaker_probing = False
 
-    def _dispatch(self, reqs):
+    def _dispatch(self, reqs):   # mxlint: hot
         """Pack ``reqs`` into the smallest covering bucket, launch the
         bucket's program (async, with the transient-failure retry
         budget), and hand resolution to the pool. With the breaker open

@@ -65,7 +65,7 @@ __all__ = [
     "card_annotate",
     "set_peak_flops", "ledger_track", "ledger", "ledger_top",
     "SPAN_RING_SIZE", "FIT_PHASE_SPANS", "SERVE_SPANS", "COMPILE_SPANS",
-    "MAX_PROGRAM_CARDS",
+    "MAX_PROGRAM_CARDS", "COUNTERS",
 ]
 
 # ring capacities: bound memory for arbitrarily long training runs. The
@@ -101,6 +101,37 @@ COMPILE_SPANS = ("jit_trace", "jit_compile", "jit_deserialize")
 # dispatches folded into the online total so MFU stays right)
 MAX_PROGRAM_CARDS = 256
 
+# the DECLARED counter-name registry: every ``counter_inc`` literal in
+# the runtime must match one of these patterns (mxlint's
+# registry-consistency pass cross-checks both directions — an
+# undeclared name at the call site is a typo that never aggregates, a
+# declared-but-never-bumped pattern is a dead dashboard row). A
+# trailing ``.*`` covers a dynamic tail: fallback codes, fault sites,
+# reject causes, shed causes, dispatch/program kinds.
+COUNTERS = (
+    "dispatch.*", "jit.*", "recompile.*",
+    "fused_fallback.*",
+    "faults.injected", "faults.injected.*",
+    "transfer.*", "host_sync.*",
+    "kvstore.push", "kvstore.pull", "kvstore.wire_bytes",
+    "exec_group.forward",
+    "training.preempted",
+    "divergence.detected", "divergence.skipped", "divergence.rollback",
+    "checkpoint.save", "checkpoint.resume",
+    "compile_cache.hit", "compile_cache.miss",
+    "compile_cache.store", "compile_cache.store_fail",
+    "compile_cache.reject", "compile_cache.reject.*",
+    "compile_cache.bytes_read", "compile_cache.bytes_written",
+    "compile_cache.corpus_append",
+    "serving.requests", "serving.rows", "serving.batches",
+    "serving.batch_rows", "serving.pad_rows", "serving.pad_bytes",
+    "serving.resolved", "serving.failed_requests",
+    "serving.shed_requests", "serving.shed_rows", "serving.shed.*",
+    "serving.deadline_exceeded", "serving.retries",
+    "serving.dispatch_failures", "serving.breaker_trips",
+    "serving.breaker_fastfail",
+)
+
 
 class _State:
     __slots__ = ("enabled",)
@@ -112,16 +143,20 @@ class _State:
 
 _state = _State()
 _lock = threading.Lock()
-_counters = {}
-# span ring: (name, start_ns, end_ns, thread_id) in perf_counter_ns time
-_spans = collections.deque(maxlen=SPAN_RING_SIZE)
-_durations = {}          # name -> deque of duration seconds
-_span_total = {}         # name -> cumulative span count (uncapped)
-_span_seconds = {}       # name -> cumulative span seconds (uncapped) —
+_counters = {}           # guarded by: _lock
+# span ring: (name, start_ns, end_ns, thread_id) in perf_counter_ns
+# time. Appends are deliberately LOCK-FREE (GIL-atomic deque ops on the
+# per-batch hot path); see the _record_span disables.
+_spans = collections.deque(maxlen=SPAN_RING_SIZE)   # guarded by: _lock
+_durations = {}          # name -> deque of durations  # guarded by: _lock
+_span_total = {}         # name -> cumulative count    # guarded by: _lock
+_span_seconds = {}       # guarded by: _lock
+                         # name -> cumulative span seconds (uncapped) —
                          # the online-MFU denominator must cover EVERY
                          # step, not just the histogram ring's tail
-_dispatch_subs = []      # multi-subscriber dispatch registry
-_gen = 0                 # bumped by reset(): spans straddling a reset
+_dispatch_subs = []      # guarded by: _lock
+_gen = 0                 # guarded by: _lock
+                         # bumped by reset(): spans straddling a reset
                          # belong to the OLD window and must not leak
                          # into the freshly cleared registry
 
@@ -130,15 +165,17 @@ _gen = 0                 # bumped by reset(): spans straddling a reset
 # bumps mutate it in place, and a reset() simply drops the registry
 # reference; the wrapper re-installs (with a fresh dispatch count) on
 # the next launch, so a windowed reset reads clean.
-_programs = {}
-_programs_dropped_flops = 0.0   # FLOPs x dispatches of evicted cards
-_peak_flops = None              # chip ceiling for the online MFU
+_programs = {}                  # guarded by: _lock
+_programs_dropped_flops = 0.0   # guarded by: _lock
+_peak_flops = None              # guarded by: _lock
 
 # live device-buffer ledger: per-context alive/peak counters plus the
 # individual live-buffer map that backs ledger_top() / OOM enrichment
-_ledger = {}        # ctx key -> {alive_bytes, alive_count, peak_bytes,
+_ledger = {}        # guarded by: _lock
+                    # ctx key -> {alive_bytes, alive_count, peak_bytes,
                     #             tracked_total, tracked_bytes_total}
-_ledger_live = {}   # token -> (ctx_key, nbytes, shape, dtype, kind)
+_ledger_live = {}   # guarded by: _lock
+                    # token -> (ctx_key, nbytes, shape, dtype, kind)
 _ledger_seq = itertools.count(1)
 # released tokens land here LOCK-FREE and are drained under _lock by
 # the next ledger operation. The finalize callback must NOT take
@@ -146,7 +183,7 @@ _ledger_seq = itertools.count(1)
 # finalizer synchronously on a thread that already HOLDS _lock (any
 # allocation inside a locked section can trip the GC threshold), and
 # the non-reentrant lock would deadlock the process mid-training.
-_ledger_pending = collections.deque()
+_ledger_pending = collections.deque()   # guarded by: _lock
 
 # perf_counter<->epoch anchor, taken once at import: spans are stamped
 # in the monotonic perf_counter timebase (immune to clock steps); the
@@ -308,8 +345,11 @@ def dispatch_event(kind):
         with _lock:
             k = "dispatch.%s" % kind
             _counters[k] = _counters.get(k, 0) + 1
-    if _dispatch_subs:
-        for cb in list(_dispatch_subs):
+    # deliberately lock-free: list() is one GIL-atomic snapshot, and
+    # subscriber callbacks must NOT run under _lock (a callback that
+    # reads counters() would deadlock)
+    if _dispatch_subs:   # mxlint: disable=lock-discipline -- GIL-atomic emptiness probe of an append/remove-only list
+        for cb in list(_dispatch_subs):   # mxlint: disable=lock-discipline -- GIL-atomic snapshot copy; callbacks must run outside the lock
             cb(kind)
 
 
@@ -339,7 +379,7 @@ class _Span:
     def __enter__(self):
         if _state.enabled:
             self._t0 = time.perf_counter_ns()
-            self._gen = _gen
+            self._gen = _gen   # mxlint: disable=lock-discipline -- single GIL-atomic int read; a torn window only drops this one span
         return self
 
     def cancel(self):
@@ -351,7 +391,7 @@ class _Span:
         # record only if telemetry is STILL enabled (a disable() mid-
         # span pins the disabled leg clean) and no reset() started a
         # new accounting window while this span was open
-        if self._t0 and _state.enabled and self._gen == _gen:
+        if self._t0 and _state.enabled and self._gen == _gen:   # mxlint: disable=lock-discipline -- single GIL-atomic int compare; worst case one pre-reset span drops
             _record_span(self.name, self._t0, time.perf_counter_ns())
         self._t0 = 0
         return False
@@ -367,8 +407,8 @@ def _record_span(name, t0_ns, t1_ns):
     # deque.append and dict reads are GIL-atomic so the ring/histogram
     # writes stay lock-free; the cumulative counter is a read-modify-
     # write and takes the lock like every other counter
-    _spans.append((name, t0_ns, t1_ns, threading.get_ident()))
-    d = _durations.get(name)
+    _spans.append((name, t0_ns, t1_ns, threading.get_ident()))   # mxlint: disable=lock-discipline -- GIL-atomic bounded-deque append on the per-batch hot path
+    d = _durations.get(name)   # mxlint: disable=lock-discipline -- GIL-atomic dict probe; the insert below re-checks under the lock
     if d is None:
         with _lock:
             d = _durations.setdefault(name, collections.deque(
@@ -384,7 +424,8 @@ def span_seconds(name):
     """CUMULATIVE wall-seconds recorded under ``name`` since the last
     reset() — unlike the histogram total, not capped by the duration
     ring. The online-MFU denominator."""
-    return _span_seconds.get(name, 0.0)
+    with _lock:
+        return _span_seconds.get(name, 0.0)
 
 
 def span_count(name):
@@ -392,7 +433,8 @@ def span_count(name):
     reset() — unlike ``span_stats()[name]['count']``, not capped by the
     histogram ring, so windowed readers (TelemetryLogger) can tell how
     many new samples landed since their last look."""
-    return _span_total.get(name, 0)
+    with _lock:
+        return _span_total.get(name, 0)
 
 
 def span_durations(name):
@@ -446,7 +488,8 @@ def set_peak_flops(flops):
     turn the online sustained-FLOP/s into an MFU fraction. ``None``
     clears it (MFU reads ``None`` again)."""
     global _peak_flops
-    _peak_flops = None if flops is None else float(flops)
+    with _lock:
+        _peak_flops = None if flops is None else float(flops)
 
 
 def record_program(card):
@@ -531,6 +574,10 @@ def _online_stats():
         step_s = _span_seconds.get("step", 0.0)
         compile_s = _span_seconds.get("jit_compile", 0.0)
         deser_s = _span_seconds.get("jit_deserialize", 0.0)
+        # read the ceiling INSIDE the lock: the mfu and peak_flops
+        # fields below must come from the same value (a set_peak_flops
+        # racing the two bare reads used to be able to split them)
+        peak = _peak_flops
     out = {
         "flops_dispatched": flops,
         "step_time_s": round(step_s, 6),
@@ -540,10 +587,9 @@ def _online_stats():
         # disk-cache loads (compile_cache) — the warm-start counterpart
         "deserialize_time_s": round(deser_s, 6),
         "model_flops_per_s": round(flops / step_s, 3) if step_s else None,
-        "peak_flops": _peak_flops,
+        "peak_flops": peak,
         # unrounded: a CPU-smoke MFU is ~1e-6 and must not read as 0.0
-        "mfu": flops / step_s / _peak_flops
-        if step_s and _peak_flops else None,
+        "mfu": flops / step_s / peak if step_s and peak else None,
     }
     return out
 
@@ -557,7 +603,7 @@ def _ledger_release(token):
     atomic) — see the _ledger_pending note for why taking _lock here
     would deadlock under cyclic GC."""
     try:
-        _ledger_pending.append(token)
+        _ledger_pending.append(token)   # mxlint: disable=lock-discipline -- THE finalizer pattern: GIL-atomic append; taking _lock here deadlocks under cyclic GC (the PR 4 bug this rule exists to catch)
     except Exception:       # interpreter-shutdown finalizers must not raise
         pass
 

@@ -1,0 +1,13 @@
+"""Compliant twin: dispatches report through record_dispatch, and
+INSTALLING the legacy shim (an assignment, the documented back-compat
+monkeypatch) is not a call."""
+from mxnet_tpu import executor, telemetry
+
+
+def report(kind):
+    executor.record_dispatch(kind)          # the one entry point
+
+
+def install(cb):
+    executor.dispatch_hook = cb             # assignment: legal shim
+    telemetry.on_dispatch(cb)               # preferred registry

@@ -1,0 +1,12 @@
+"""Seeded dispatch-hook violations: a raw CALL of the legacy
+single-slot hook outside executor.py silently clobbers every other
+subscriber. Two findings expected."""
+from mxnet_tpu import executor
+
+
+def report(kind):
+    executor.dispatch_hook(kind)            # VIOLATION 1: attr call
+
+
+def report_local(dispatch_hook, kind):
+    dispatch_hook(kind)                     # VIOLATION 2: name call

@@ -1,0 +1,44 @@
+"""Compliant twin: the idiomatic shapes — rebind at the donating call
+(including through an alias, including self-attributes), rebind before
+the next use, and non-donated positions stay freely reusable.
+Zero findings expected."""
+import jax
+
+
+def train(loss_fn, params, state, batch):
+    step = jax.jit(loss_fn, donate_argnums=(0, 1))
+    run = step                            # alias still tracked
+    params, state = run(params, state, batch)   # rebind AT the call
+    return params, state, batch           # batch (arg 2) not donated
+
+
+def train_marked(plan, params, batch):
+    out, params = plan["fn"](params, batch)   # mxlint: donates 0
+    norm = sum(v.sum() for v in params.values())   # fresh binding: fine
+    return out, norm
+
+
+def warmup(fn, weights, batches):
+    run = jax.jit(fn, donate_argnums=(0,))
+    for b in batches:
+        weights, loss = run(weights, b)   # loop rebinds each iteration
+    return weights, loss
+
+
+class Trainer:
+    def __init__(self, fn):
+        self._step = jax.jit(fn, donate_argnums=(0,))
+        self.params = {}
+
+    def step(self, batch):
+        self.params, loss = self._step(self.params, batch)
+        return loss
+
+
+def retry(fn, params, batch):
+    run = jax.jit(fn, donate_argnums=(0,))
+    try:
+        out, params = run(params, batch)
+    except RuntimeError:
+        out, params = run(params, batch)   # handler rebinds too
+    return out, params

@@ -1,0 +1,36 @@
+"""Seeded donation-safety violations: a name reused after riding a
+donated position (locally-inferred donate_argnums AND the explicit
+``# mxlint: donates`` marker for opaque callees), and a donating call
+in a loop that never rebinds, and a use after an except-handler
+donation (handler bodies are part of the linear order). Four findings
+expected."""
+import jax
+
+
+def train(loss_fn, params, state, batch):
+    step = jax.jit(loss_fn, donate_argnums=(0, 1))
+    new_params, new_state = step(params, state, batch)
+    print(params.keys())                 # VIOLATION 1: use after donation
+    return new_params, new_state
+
+
+def train_marked(plan, params, batch):
+    out = plan["fn"](params, batch)      # mxlint: donates 0
+    norm = sum(v.sum() for v in params.values())   # VIOLATION 2
+    return out, norm
+
+
+def warmup(fn, weights, batches):
+    run = jax.jit(fn, donate_argnums=(0,))
+    for b in batches:
+        loss = run(weights, b)           # VIOLATION 3: loop, no rebind
+    return loss
+
+
+def retry(fn, params, batch):
+    run = jax.jit(fn, donate_argnums=(0,))
+    try:
+        out, params = run(params, batch)
+    except RuntimeError:
+        out = run(params, batch)     # donates params again, no rebind
+    return out, params               # VIOLATION 4: dead after except path

@@ -1,0 +1,18 @@
+"""Seeded host-sync violations: all three blocking forms inside a
+function marked ``# mxlint: hot`` (one DECORATED). Four findings expected."""
+import numpy as np
+
+
+def fit_batch_loop(batches, program):   # mxlint: hot
+    for batch in batches:
+        out = program(batch)
+        host = out.asnumpy()            # VIOLATION 1: blocking fetch
+        out.wait_to_read()              # VIOLATION 2: blocking sync
+        arr = np.asarray(out)           # VIOLATION 3: device->host
+        yield host, arr
+
+
+# mxlint: hot
+@property
+def hot_decorated(self):
+    return self._out.asnumpy()          # VIOLATION 4: marker above decorator

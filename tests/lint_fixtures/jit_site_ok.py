@@ -1,0 +1,24 @@
+"""Compliant twin: programs go through the instrumented wrapper, and
+names that merely LOOK like jit (a local helper, another module's
+attribute) do not fire."""
+import functools
+import jax.numpy as jnp
+
+from mxnet_tpu.executor import _InstrumentedProgram
+
+
+def compiled(fn):
+    # the sanctioned route: wrapper owns the one real jax.jit site
+    return _InstrumentedProgram("fixture", fn)
+
+
+def lookalikes(module, fn):
+    jit = module.build_jit                  # a local name, not jax.jit
+    out = jit(fn)                           # fine: not import-rooted
+    return out, jnp.asarray([1.0])          # jnp use is not a jit site
+
+
+def curried(fn, n):
+    # partial over a NON-jit callable is not a compile site
+    run = functools.partial(fn, n)
+    return run()

@@ -1,0 +1,33 @@
+"""Seeded jit-site violations: plain call, the ALIASED import form the
+old grep lint (`grep "jax\\.jit("`) walked straight past, pjit, pmap,
+decorator and functools.partial-wrap forms. Six findings expected."""
+import functools
+import jax
+from jax import jit as J                     # alias the grep never saw
+from jax.experimental.pjit import pjit as P
+
+
+def plain(fn):
+    return jax.jit(fn)                       # VIOLATION 1: direct call
+
+
+def aliased(fn):
+    return J(fn)                             # VIOLATION 2: aliased jit
+
+
+def sharded(fn):
+    return P(fn)                             # VIOLATION 3: aliased pjit
+
+
+def mapped(fn):
+    return jax.pmap(fn)                      # VIOLATION 4: pmap
+
+
+@jax.jit                                     # VIOLATION 5: decorator
+def decorated(x):
+    return x
+
+
+@functools.partial(jax.jit, static_argnums=(1,))   # VIOLATION 6: partial wrap
+def partial_decorated(x, n):
+    return x * n

@@ -1,0 +1,60 @@
+"""Compliant twin: every guarded access under the lock — including
+through a ``threading.Condition`` ALIAS of it — a ``_locked``-suffix
+helper that documents caller-holds-the-lock, an ``__init__``
+constructor, and the lock-free finalizer pattern (pending deque drained
+under the lock). Zero findings expected."""
+import collections
+import threading
+import weakref
+
+_lock = threading.Lock()
+_registry = {}                      # guarded by: _lock
+_pending = collections.deque()      # lock-free landing zone (unguarded)
+
+
+def lookup(key):
+    with _lock:
+        _drain_locked()
+        return _registry.get(key)
+
+
+def _drain_locked():
+    # caller holds _lock (the suffix is the lint-checked contract)
+    while _pending:
+        _registry.pop(_pending.popleft(), None)
+
+
+def _release(token):
+    _pending.append(token)          # GIL-atomic: NO lock in a finalizer
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._space = threading.Condition(self._lock)
+        self._stats = {}            # guarded by: self._lock
+
+    def bump(self, key):
+        with self._space:           # Condition over the SAME lock
+            self._stats[key] = self._stats.get(key, 0) + 1
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._stats)
+
+    def track(self, obj, token):
+        weakref.finalize(obj, _release, token)
+
+
+class Deferred:
+    def __init__(self, pool):
+        self._lock = threading.Lock()
+        self._jobs = []             # guarded by: self._lock
+        self._pool = pool
+
+    def kick(self):
+        with self._lock:
+            def cb():
+                with self._lock:    # re-acquired where the body RUNS
+                    self._jobs.append(1)
+            self._pool.submit(cb)

@@ -1,0 +1,54 @@
+"""Seeded lock-discipline violations: an unlocked read and write of a
+guarded attribute, an unlocked guarded-global read, the PR 4 deadlock
+class — a ``weakref.finalize`` callback that takes a lock — and a
+deferred callback whose body, defined under ``with lock:`` (or inside
+``__init__``), runs later without it. Six findings expected."""
+import threading
+import weakref
+
+_lock = threading.Lock()
+_registry = {}                      # guarded by: _lock
+
+
+def lookup(key):
+    return _registry.get(key)       # VIOLATION 1: unlocked global read
+
+
+def _release(token):
+    with _lock:                     # VIOLATION 4: lock in finalizer
+        _registry.pop(token, None)
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats = {}            # guarded by: self._lock
+
+    def bump(self, key):
+        self._stats[key] = self._stats.get(key, 0) + 1   # VIOLATIONS 2+3: unlocked write (and read)
+
+    def track(self, obj, token):
+        weakref.finalize(obj, _release, token)
+
+
+class Deferred:
+    def __init__(self, pool):
+        self._lock = threading.Lock()
+        self._jobs = []             # guarded by: self._lock
+        self._pool = pool
+
+    def kick(self):
+        with self._lock:
+            def cb():
+                self._jobs.append(1)   # VIOLATION 5: deferred body runs unlocked
+            self._pool.submit(cb)
+
+
+class InitCallback:
+    def __init__(self, pool):
+        self._lock = threading.Lock()
+        self._stats = {}                # guarded by: self._lock
+
+        def on_done(kind):
+            self._stats[kind] = 1       # VIOLATION 6: runs after __init__, unlocked
+        pool.submit(on_done)

@@ -1,0 +1,13 @@
+"""Compliant miniature registries: every declaration used, every use
+declared."""
+
+SITES = ("dispatch", "d2h")
+
+FUSED_FALLBACK_CODES = {
+    "monitor": "per-op monitor taps need the phase-split programs",
+}
+
+COUNTERS = (
+    "serving.requests",
+    "serving.shed.*",
+)

@@ -1,0 +1,11 @@
+"""Compliant user module: declared sites/codes/counters only, dynamic
+tails covered by a declared wildcard."""
+
+
+def work(faults, telemetry, FusedFallback, cause):
+    faults.fire("dispatch")
+    faults.fire("d2h")
+    FusedFallback("monitor", "monitor installed")
+    telemetry.counter_inc("serving.requests")
+    telemetry.counter_inc("serving.shed.%s" % cause)
+    telemetry.counter_inc("serving.shed.admission")

@@ -1,0 +1,15 @@
+"""Miniature registry module for the cross-file pass: one entry in
+each registry is declared but never used by the sibling user module
+(three unused-declaration findings anchor HERE)."""
+
+SITES = ("dispatch", "d2h", "kv_push")        # kv_push: never fired
+
+FUSED_FALLBACK_CODES = {
+    "monitor": "per-op monitor taps need the phase-split programs",
+    "group2ctx": "declared but never constructed",
+}
+
+COUNTERS = (
+    "serving.requests",
+    "faults.injected.*",                      # never bumped anywhere
+)

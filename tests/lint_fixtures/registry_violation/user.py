@@ -1,0 +1,13 @@
+"""User module with one undeclared use per registry kind (plus an
+uncovered dynamic counter prefix). Four findings anchor here."""
+
+
+def work(faults, telemetry, FusedFallback, cause):
+    faults.fire("dispatch")                       # declared: ok
+    faults.fire("d2h")                            # declared: ok
+    faults.fire("d2h_typo")                       # VIOLATION: not in SITES
+    FusedFallback("monitor", "monitor installed")     # declared: ok
+    FusedFallback("bad_code", "made-up reason")   # VIOLATION: unknown code
+    telemetry.counter_inc("serving.requests")     # declared: ok
+    telemetry.counter_inc("serving.requets")      # VIOLATION: typo
+    telemetry.counter_inc("serving.shed.%s" % cause)   # VIOLATION: no '.*'

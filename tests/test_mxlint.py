@@ -1,0 +1,408 @@
+"""mxlint: the AST static-analysis suite (ISSUE 8).
+
+Three layers:
+
+1. **fixture corpus** — every rule fires on its seeded-violation file
+   under ``tests/lint_fixtures/`` (exactly the seeded findings, at the
+   seeded lines — including the aliased ``from jax import jit as J``
+   form the old grep lint missed) and stays silent on the compliant
+   twin;
+2. **framework** — suppression grammar (justification REQUIRED),
+   baseline grandfathering, stale-baseline tolerance + pruning, parse
+   errors as findings, JSON shape, CLI exit codes;
+3. **tier-1 gate lane** — ``python tools/mxlint.py mxnet_tpu tools
+   bench.py`` exits 0 with ZERO unsuppressed findings, and the
+   ``--json`` artifact banks next to the bench JSONs
+   (``$MXTPU_ARTIFACT_DIR/mxlint.json``, default /tmp/mxtpu_artifacts)
+   so the lint trajectory is recorded every round.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mxnet_tpu.analysis import run, ALL_RULE_IDS
+from mxnet_tpu.analysis.core import Baseline, SUPPRESSION_RULE
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(ROOT, "tests", "lint_fixtures")
+MXLINT = os.path.join(ROOT, "tools", "mxlint.py")
+
+
+def _fixture(name, rules):
+    """Report over one fixture file/dir, no baseline."""
+    return run([os.path.join(FIXTURES, name)], rules=rules,
+               baseline=Baseline(), root=ROOT)
+
+
+def _lines(report, rule=None):
+    return sorted(f.line for f in report.findings
+                  if rule is None or f.rule == rule)
+
+
+# ---------------------------------------------------------------------------
+# Fixture corpus: seeded violation fires, compliant twin is silent
+# ---------------------------------------------------------------------------
+
+def test_jit_site_fixture_pair():
+    rep = _fixture("jit_site_violation.py", ["jit-site"])
+    # 6 seeded: direct call, ALIASED `from jax import jit as J` (the
+    # form the grep lint walked past), aliased pjit, pmap, decorator,
+    # and the @functools.partial(jax.jit, ...) wrap
+    assert _lines(rep) == [11, 15, 19, 23, 26, 31], \
+        [f.render() for f in rep.findings]
+    assert any("decorator" in f.message for f in rep.findings)
+    assert any("functools.partial" in f.message for f in rep.findings)
+    ok = _fixture("jit_site_ok.py", ["jit-site"])
+    assert ok.clean and not ok.suppressed, \
+        [f.render() for f in ok.findings]
+
+
+def test_dispatch_hook_fixture_pair():
+    rep = _fixture("dispatch_hook_violation.py", ["dispatch-hook"])
+    assert _lines(rep) == [8, 12], [f.render() for f in rep.findings]
+    ok = _fixture("dispatch_hook_ok.py", ["dispatch-hook"])
+    assert ok.clean, [f.render() for f in ok.findings]
+
+
+def test_lock_discipline_fixture_pair():
+    rep = _fixture("lock_discipline_violation.py", ["lock-discipline"])
+    # unlocked global read, finalizer-lock (the PR 4 deadlock class),
+    # the read+write halves of the unlocked `self._stats[k] = ...`, and
+    # a deferred callback defined under the lock but running without it
+    assert _lines(rep) == [14, 18, 28, 28, 43, 53], \
+        [f.render() for f in rep.findings]
+    assert any("weakref.finalize" in f.message for f in rep.findings)
+    ok = _fixture("lock_discipline_ok.py", ["lock-discipline"])
+    # Condition alias, _locked-suffix helper, lock-free finalizer,
+    # __init__ construction, callback re-acquiring where it runs: all
+    # clean with zero suppressions
+    assert ok.clean and not ok.suppressed, \
+        [f.render() for f in ok.findings]
+
+
+def test_host_sync_fixture_pair():
+    rep = _fixture("host_sync_violation.py", ["host-sync"])
+    # ...including the standalone marker above a DECORATED def (which
+    # arms the decorator's line, not the def's)
+    assert _lines(rep) == [9, 10, 11, 18], \
+        [f.render() for f in rep.findings]
+    msgs = " ".join(f.message for f in rep.findings)
+    for form in (".asnumpy()", ".wait_to_read()", "np.asarray"):
+        assert form in msgs
+    ok = _fixture("host_sync_ok.py", ["host-sync"])
+    assert ok.clean, [f.render() for f in ok.findings]
+    # the one justified disable in the twin is honoured AND recorded
+    assert len(ok.suppressed) == 1
+    assert ok.suppressed[0][1]          # justification text rides along
+
+
+def test_donation_fixture_pair():
+    rep = _fixture("donation_violation.py", ["donation-safety"])
+    # ...including the use after a donation that happens inside an
+    # except handler (handler bodies are in the linear statement order)
+    assert _lines(rep) == [13, 19, 26, 36], \
+        [f.render() for f in rep.findings]
+    assert any("loop" in f.message for f in rep.findings)
+    ok = _fixture("donation_ok.py", ["donation-safety"])
+    assert ok.clean, [f.render() for f in ok.findings]
+
+
+def test_registry_fixture_pair():
+    rep = _fixture("registry_violation", ["registry-consistency"])
+    msgs = [f.message for f in rep.findings]
+    assert len(msgs) == 7, [f.render() for f in rep.findings]
+    # one undeclared use per registry kind + the uncovered prefix...
+    assert any("'d2h_typo'" in m and "SITES" in m for m in msgs)
+    assert any("'bad_code'" in m for m in msgs)
+    assert any("'serving.requets'" in m for m in msgs)
+    assert any("dynamic counter prefix" in m for m in msgs)
+    # ...and one unused declaration per registry kind
+    assert any("'kv_push'" in m and "never consulted" in m for m in msgs)
+    assert any("'group2ctx'" in m and "never constructed" in m
+               for m in msgs)
+    assert any("'faults.injected.*'" in m and "dead" in m for m in msgs)
+    ok = _fixture("registry_ok", ["registry-consistency"])
+    assert ok.clean, [f.render() for f in ok.findings]
+
+
+# ---------------------------------------------------------------------------
+# Framework: suppressions, baseline, parse errors
+# ---------------------------------------------------------------------------
+
+def _write(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+_VIOLATION_SRC = "import jax\n\n\ndef f(fn):\n    return jax.jit(fn)%s\n"
+
+
+def test_suppression_requires_justification(tmp_path):
+    # bare disable: the finding STILL reports, plus a grammar finding
+    p = _write(tmp_path, "bare.py",
+               _VIOLATION_SRC % "   # mxlint: disable=jit-site")
+    rep = run([p], rules=["jit-site"], baseline=Baseline(),
+              root=str(tmp_path))
+    rules = sorted(f.rule for f in rep.findings)
+    assert rules == ["jit-site", SUPPRESSION_RULE], \
+        [f.render() for f in rep.findings]
+    assert "justification" in rep.findings[0].message \
+        or "justification" in rep.findings[1].message
+
+
+def test_suppression_with_justification_silences(tmp_path):
+    p = _write(tmp_path, "just.py",
+               _VIOLATION_SRC % "   # mxlint: disable=jit-site -- fixture")
+    rep = run([p], rules=["jit-site"], baseline=Baseline(),
+              root=str(tmp_path))
+    assert rep.clean
+    assert [(f.rule, j) for f, j in rep.suppressed] == \
+        [("jit-site", "fixture")]
+
+
+def test_standalone_suppression_covers_next_line(tmp_path):
+    src = ("import jax\n\n\ndef f(fn):\n"
+           "    # mxlint: disable=jit-site -- covers the next line\n"
+           "    return jax.jit(fn)\n")
+    p = _write(tmp_path, "standalone.py", src)
+    rep = run([p], rules=["jit-site"], baseline=Baseline(),
+              root=str(tmp_path))
+    assert rep.clean and len(rep.suppressed) == 1
+
+
+def test_unknown_rule_in_suppression_is_flagged(tmp_path):
+    p = _write(tmp_path, "typo.py",
+               _VIOLATION_SRC % "   # mxlint: disable=jit-sight -- typo")
+    rep = run([p], rules=["jit-site"], baseline=Baseline(),
+              root=str(tmp_path))
+    rules = sorted(f.rule for f in rep.findings)
+    assert rules == ["jit-site", SUPPRESSION_RULE]
+    assert any("unknown rule id" in f.message for f in rep.findings)
+
+
+def test_baseline_grandfathers_and_reports_stale(tmp_path):
+    p = _write(tmp_path, "old.py", _VIOLATION_SRC % "")
+    rep = run([p], rules=["jit-site"], baseline=Baseline(),
+              root=str(tmp_path))
+    assert len(rep.findings) == 1
+    doc = Baseline.render(rep.findings)
+    doc["findings"].append({"rule": "jit-site", "path": "gone.py",
+                            "anchor": "jax.jit(deleted_code)"})
+    bl_path = _write(tmp_path, "bl.json", json.dumps(doc))
+    rep2 = run([p], rules=["jit-site"], baseline=bl_path,
+               root=str(tmp_path))
+    # grandfathered: clean exit, the finding visible as baselined, and
+    # the entry whose code no longer exists WARNS instead of erroring
+    assert rep2.clean
+    assert len(rep2.baselined) == 1
+    assert len(rep2.stale_baseline) == 1
+    assert rep2.stale_baseline[0]["path"] == "gone.py"
+    assert "stale" in rep2.render_text()
+
+
+def test_baseline_loader_tolerates_garbage(tmp_path):
+    p = _write(tmp_path, "v.py", _VIOLATION_SRC % "")
+    bl_path = _write(tmp_path, "bad.json", "{not json")
+    rep = run([p], rules=["jit-site"], baseline=bl_path,
+              root=str(tmp_path))
+    # unreadable baseline: warn and lint WITHOUT it — never a crash
+    assert len(rep.findings) == 1
+    assert any("unreadable" in w for w in rep.warnings)
+    bl2 = _write(tmp_path, "odd.json",
+                 json.dumps({"findings": [42, {"rule": "jit-site"}]}))
+    rep2 = run([p], rules=["jit-site"], baseline=bl2, root=str(tmp_path))
+    assert len(rep2.findings) == 1 and len(rep2.warnings) == 2
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    p = _write(tmp_path, "broken.py", "def f(:\n")
+    rep = run([p], baseline=Baseline(), root=str(tmp_path))
+    assert [f.rule for f in rep.findings] == ["parse-error"]
+
+
+def test_baseline_never_hides_gate_compromising_rules(tmp_path):
+    """Neither --update-baseline nor a hand-edited entry may grandfather
+    a bare suppression or a parse error — those mean the gate itself is
+    compromised and must keep failing until the code is fixed."""
+    bare = _write(tmp_path, "bare.py",
+                  _VIOLATION_SRC % "   # mxlint: disable=jit-site")
+    broken = _write(tmp_path, "broken.py", "def f(:\n")
+    rep = run([bare, broken], rules=["jit-site"], baseline=Baseline(),
+              root=str(tmp_path))
+    rules = sorted(f.rule for f in rep.findings)
+    assert rules == ["jit-site", SUPPRESSION_RULE, "parse-error"]
+    # render (what --update-baseline writes) drops both forbidden rules
+    doc = Baseline.render(rep.findings)
+    assert [e["rule"] for e in doc["findings"]] == ["jit-site"]
+    # and even a hand-edited baseline listing them cannot hide them
+    doc["findings"].extend(
+        {"rule": f.rule, "path": f.path, "anchor": f.anchor}
+        for f in rep.findings if f.rule != "jit-site")
+    bl_path = _write(tmp_path, "bl.json", json.dumps(doc))
+    rep2 = run([bare, broken], rules=["jit-site"], baseline=bl_path,
+               root=str(tmp_path))
+    assert sorted(f.rule for f in rep2.findings) == \
+        [SUPPRESSION_RULE, "parse-error"], \
+        [f.render() for f in rep2.findings]
+
+
+def test_registry_duplicate_declaration_is_flagged(tmp_path):
+    """Two SITES declarations in one scan (e.g. a fixture mini-registry
+    next to the runtime's) must not silently bind an arbitrary one —
+    the duplicate is a finding and uses check against the FIRST."""
+    a = _write(tmp_path, "a.py",
+               'SITES = ("dispatch",)\n\n\ndef go(fire):\n'
+               '    fire("dispatch")\n')
+    b = _write(tmp_path, "b.py", 'SITES = ("other",)\n')
+    rep = run([a, b], rules=["registry-consistency"], baseline=Baseline(),
+              root=str(tmp_path))
+    msgs = [f.message for f in rep.findings]
+    assert any("duplicate SITES" in m for m in msgs), msgs
+    # the legitimate use against the first declaration stays clean
+    assert not any("not declared" in m for m in msgs), msgs
+
+
+def test_json_report_shape(tmp_path):
+    p = _write(tmp_path, "v.py", _VIOLATION_SRC % "")
+    rep = run([p], rules=["jit-site"], baseline=Baseline(),
+              root=str(tmp_path))
+    doc = rep.to_dict()
+    assert doc["clean"] is False
+    assert doc["counts"] == {"jit-site": 1}
+    f = doc["findings"][0]
+    assert set(f) == {"rule", "path", "line", "col", "message", "anchor"}
+    json.dumps(doc)                      # JSON-serializable end to end
+
+
+# ---------------------------------------------------------------------------
+# CLI: stable exit codes, JSON artifact, baseline update
+# ---------------------------------------------------------------------------
+
+def _cli(args, cwd=ROOT):
+    return subprocess.run([sys.executable, MXLINT] + args,
+                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                          text=True, timeout=300, cwd=cwd)
+
+
+def test_cli_exit_codes(tmp_path):
+    clean = _write(tmp_path, "clean.py", "x = 1\n")
+    dirty = _write(tmp_path, "dirty.py", _VIOLATION_SRC % "")
+    assert _cli(["--baseline", "none", clean]).returncode == 0
+    proc = _cli(["--baseline", "none", dirty])
+    assert proc.returncode == 1
+    assert "jit-site" in proc.stdout
+    assert _cli(["--no-such-flag", clean]).returncode == 2
+    assert _cli(["--baseline", "none",
+                 str(tmp_path / "missing.py")]).returncode == 2
+    assert _cli(["--rules", "not-a-rule", clean]).returncode == 2
+    assert _cli([]).returncode == 2
+
+
+def test_cli_json_operand_forms(tmp_path):
+    clean = _write(tmp_path, "clean.py", "x = 1\n")
+    # '-' means stdout: the report prints, nothing named '-' is linted
+    proc = _cli(["--baseline", "none", "--json", "-", clean])
+    assert proc.returncode == 0, proc.stderr
+    assert json.loads(proc.stdout)["clean"] is True
+    # with no operand the report also goes to stdout
+    proc = _cli(["--baseline", "none", "--json", clean])
+    assert proc.returncode == 0
+    assert json.loads(proc.stdout)["paths"] == [clean]
+    # an ambiguous operand (not '-', not *.json, not an existing lint
+    # path) is a usage error, never silently linted or guessed at
+    proc = _cli(["--baseline", "none", "--json",
+                 str(tmp_path / "report.out"), clean])
+    assert proc.returncode == 2
+    assert "--json operand" in proc.stderr
+
+
+def test_cli_list_rules():
+    proc = _cli(["--list-rules"])
+    assert proc.returncode == 0
+    assert proc.stdout.split() == list(ALL_RULE_IDS)
+
+
+def test_cli_update_baseline_prunes_stale(tmp_path):
+    dirty = _write(tmp_path, "dirty.py", _VIOLATION_SRC % "")
+    bl = str(tmp_path / "bl.json")
+    with open(bl, "w") as f:
+        json.dump({"findings": [{"rule": "jit-site", "path": "gone.py",
+                                 "anchor": "deleted"}]}, f)
+    proc = _cli(["--baseline", bl, "--update-baseline", dirty])
+    assert proc.returncode == 0, proc.stderr
+    with open(bl) as f:
+        doc = json.load(f)
+    anchors = [e["anchor"] for e in doc["findings"]]
+    assert anchors == ["return jax.jit(fn)"]        # stale entry pruned
+    # and the refreshed baseline makes the same run clean
+    assert _cli(["--baseline", bl, dirty]).returncode == 0
+
+
+def test_cli_update_baseline_partial_rules_preserves_others(tmp_path):
+    dirty = _write(tmp_path, "dirty.py", _VIOLATION_SRC % "")
+    bl = str(tmp_path / "bl.json")
+    assert _cli(["--baseline", bl,
+                 "--update-baseline", dirty]).returncode == 0
+    # a dispatch-hook-only refresh must not wipe the jit-site entry the
+    # full gate run depends on
+    proc = _cli(["--baseline", bl, "--rules", "dispatch-hook",
+                 "--update-baseline", dirty])
+    assert proc.returncode == 0, proc.stderr
+    with open(bl) as f:
+        doc = json.load(f)
+    assert [e["rule"] for e in doc["findings"]] == ["jit-site"]
+    assert _cli(["--baseline", bl, dirty]).returncode == 0
+
+
+def test_cli_update_baseline_needs_a_file(tmp_path):
+    dirty = _write(tmp_path, "dirty.py", _VIOLATION_SRC % "")
+    # '--baseline none' disabled the baseline: nothing to rewrite, and
+    # silently clobbering the default committed file would be worse
+    proc = _cli(["--baseline", "none", "--update-baseline", dirty])
+    assert proc.returncode == 2
+    assert "no file to write" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 gate lane: the whole runtime lints clean, artifact banked
+# ---------------------------------------------------------------------------
+
+def test_mxlint_gate_lane():
+    """`run_checks.sh lint` equivalent: zero unsuppressed findings over
+    mxnet_tpu/ tools/ bench.py against the committed baseline, with the
+    JSON report banked next to the bench artifacts."""
+    art_dir = os.environ.get("MXTPU_ARTIFACT_DIR", "/tmp/mxtpu_artifacts")
+    os.makedirs(art_dir, exist_ok=True)
+    art = os.path.join(art_dir, "mxlint.json")
+    proc = _cli(["--json", art, "mxnet_tpu", "tools", "bench.py"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    with open(art) as f:
+        doc = json.load(f)
+    assert doc["clean"] is True
+    assert doc["findings"] == []
+    assert doc["rules"] == list(ALL_RULE_IDS)
+    # every honoured suppression carries its justification text, and
+    # the committed baseline has no stale entries
+    assert doc["suppressed"], "expected justified disables in-tree"
+    assert all(s["justification"] for s in doc["suppressed"])
+    assert doc["stale_baseline"] == []
+    # the grandfathered raw-jit sites are visible, not silently gone
+    assert any(b["rule"] == "jit-site" for b in doc["baselined"])
+
+
+def test_gate_catches_a_seeded_regression(tmp_path):
+    """End-to-end negative control: drop an aliased-jit file into a
+    copy of the scan set and the gate exits 1 — proving the lane fails
+    when someone actually adds a raw compile site."""
+    bad = _write(tmp_path, "regression.py",
+                 "from jax import jit as J\n\n\ndef f(fn):\n"
+                 "    return J(fn)\n")
+    proc = _cli(["--baseline",
+                 os.path.join(ROOT, "tools", "mxlint_baseline.json"),
+                 bad])
+    assert proc.returncode == 1
+    assert "jit-site" in proc.stdout

@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""mxlint — AST static analysis for the runtime's own invariants.
+
+Usage::
+
+    python tools/mxlint.py [options] <paths...>
+
+    python tools/mxlint.py mxnet_tpu tools bench.py        # the CI gate
+    python tools/mxlint.py --json out.json mxnet_tpu       # JSON report
+    python tools/mxlint.py --rules jit-site mxnet_tpu      # one rule
+    python tools/mxlint.py --update-baseline mxnet_tpu tools bench.py
+
+Options:
+    --rules a,b,...      run only these rule ids (default: all)
+    --list-rules         print the rule ids and exit 0
+    --baseline PATH      grandfather file (default:
+                         tools/mxlint_baseline.json; 'none' disables)
+    --update-baseline    rewrite the baseline from the current findings
+                         (stale entries pruned) and exit 0
+    --json [PATH]        emit the JSON report to PATH (or stdout when no
+                         PATH follows); the text report is skipped
+
+Exit codes (stable; run_checks.sh and the tier-1 lane key on them):
+    0  clean — no unsuppressed, non-baselined findings (stale-baseline
+       entries and suppressed/baselined findings only warn)
+    1  findings
+    2  usage error (unknown flag/rule, missing path)
+
+Suppression grammar (the justification is REQUIRED)::
+
+    something_flagged()   # mxlint: disable=<rule> -- why this is safe
+
+The analyzer itself lives in ``mxnet_tpu/analysis`` (stdlib-only: no
+jax import, no native build — ``bash tools/run_checks.sh lint`` runs it
+standalone).
+"""
+import json
+import os
+import sys
+import types
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# import the analysis package WITHOUT executing mxnet_tpu/__init__.py
+# (which pulls in jax, ~5s and a hard dependency): a stub parent whose
+# __path__ points at the package directory lets the normal import
+# machinery load mxnet_tpu.analysis standalone — the lint stage of
+# run_checks.sh must work on a box with no jax and no native build
+if "mxnet_tpu" not in sys.modules:
+    _pkg = types.ModuleType("mxnet_tpu")
+    _pkg.__path__ = [os.path.join(ROOT, "mxnet_tpu")]
+    sys.modules["mxnet_tpu"] = _pkg
+
+from mxnet_tpu.analysis import run, ALL_RULE_IDS          # noqa: E402
+from mxnet_tpu.analysis.core import Baseline              # noqa: E402
+
+DEFAULT_BASELINE = os.path.join(ROOT, "tools", "mxlint_baseline.json")
+
+
+def usage(msg):
+    sys.stderr.write("mxlint: %s\n(see tools/mxlint.py --help)\n" % msg)
+    return 2
+
+
+def main(argv):
+    paths = []
+    rules = None
+    baseline = DEFAULT_BASELINE
+    update_baseline = False
+    json_path = None
+    want_json = False
+
+    args = list(argv)
+    while args:
+        a = args.pop(0)
+        if a in ("-h", "--help"):
+            print(__doc__)
+            return 0
+        if a == "--list-rules":
+            print("\n".join(ALL_RULE_IDS))
+            return 0
+        if a == "--rules":
+            if not args:
+                return usage("--rules needs a comma-separated id list")
+            rules = [r.strip() for r in args.pop(0).split(",") if r.strip()]
+            continue
+        if a == "--baseline":
+            if not args:
+                return usage("--baseline needs a path (or 'none')")
+            baseline = args.pop(0)
+            if baseline.lower() == "none":
+                baseline = None
+            continue
+        if a == "--update-baseline":
+            update_baseline = True
+            continue
+        if a == "--json":
+            want_json = True
+            if args and args[0] == "-":          # explicit stdout
+                json_path = args.pop(0)
+            elif args and not args[0].startswith("-"):
+                if args[0].endswith(".json"):
+                    json_path = args.pop(0)
+                elif not os.path.exists(args[0]):
+                    # neither an existing lint path nor a recognizable
+                    # output path — guessing either way silently does
+                    # the wrong thing, so refuse
+                    return usage(
+                        "--json operand %r is neither an existing lint "
+                        "path nor a .json output path; use '-' for "
+                        "stdout or an output path ending in .json"
+                        % args[0])
+            continue
+        if a.startswith("-"):
+            return usage("unknown option %r" % a)
+        paths.append(a)
+    if not paths:
+        return usage("no paths given")
+
+    # analysis runs with repo-relative display paths so baseline entries
+    # and reports are machine-independent; relative CLI paths resolve
+    # against the CWD as usual
+    abs_paths = [os.path.abspath(p) for p in paths]
+    missing = [p for p, ap in zip(paths, abs_paths)
+               if not os.path.exists(ap)]
+    if missing:
+        return usage("no such path(s): %s" % ", ".join(missing))
+
+    if update_baseline and baseline is None:
+        return usage("--update-baseline with '--baseline none' has no "
+                     "file to write; give --baseline a path")
+
+    try:
+        if update_baseline:
+            # partition against an EMPTY baseline: every current
+            # unsuppressed finding lands in the fresh file, stale
+            # entries implicitly pruned
+            report = run(abs_paths, rules=rules, baseline=Baseline(),
+                         root=ROOT)
+            out_path = baseline
+            doc = Baseline.render(report.findings)
+            if rules:
+                # a partial-rule run only refreshes ITS rules' entries —
+                # wiping the others would fail the next full gate run
+                prior = Baseline.load(out_path)
+                doc["findings"] = sorted(
+                    doc["findings"]
+                    + [{"rule": r, "path": p, "anchor": a, "count": n}
+                       for (r, p, a), n in prior.entries.items()
+                       if r not in set(report.rules)],
+                    key=lambda e: (e["rule"], e["path"], e["anchor"]))
+            with open(out_path, "w") as f:
+                json.dump(doc, f, indent=2, sort_keys=True)
+                f.write("\n")
+            print("mxlint: baseline %s rewritten with %d finding(s)"
+                  % (os.path.relpath(out_path), len(report.findings)))
+            return 0
+        report = run(abs_paths, rules=rules, baseline=baseline, root=ROOT)
+    except ValueError as e:          # unknown rule id
+        return usage(str(e))
+    except FileNotFoundError as e:
+        return usage("no such path: %s" % e)
+
+    if want_json:
+        doc = json.dumps(report.to_dict(), indent=2, sort_keys=True)
+        if json_path and json_path != "-":
+            with open(json_path, "w") as f:
+                f.write(doc + "\n")
+        else:
+            print(doc)
+    else:
+        print(report.render_text())
+    return 0 if report.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
