@@ -1,0 +1,602 @@
+"""mxflow's project-wide call graph (the interprocedural substrate).
+
+Everything interprocedural in mxlint — trace purity, transitive
+host-sync, lockset inference, donation propagation — runs over ONE
+graph built here, once per :class:`~.core.Project`:
+
+* an entity per ``def`` (module functions, methods, and NESTED
+  functions — the executor's traced closures are nested defs, so they
+  must be first-class nodes, not attributes of their parent);
+* name resolution through import aliases, absolute AND relative
+  (``from . import telemetry`` in ``mxnet_tpu/serving.py`` binds
+  ``telemetry`` to the scanned ``mxnet_tpu/telemetry.py``) — purely
+  textual, no module is ever imported;
+* method resolution via self-type inference: ``self.m()`` under
+  ``class C`` resolves to ``C.m`` (base classes defined in scanned
+  files are searched, bounded); ``x = ClassName(...)`` followed by
+  ``x.m()`` in the same function resolves through the local instance
+  type;
+* two edge kinds: ``call`` (the expression is invoked here) and
+  ``ref`` (a known function is passed as a VALUE argument —
+  ``jax.vjp(f, ...)``, ``jax.checkpoint(f)`` — the callee runs under
+  whoever receives it, which for tracing entry points means: during
+  the trace). Trace-purity traverses both; transitive host-sync
+  traverses only ``call`` edges (a callback handed to the resolver
+  pool legitimately blocks on its own thread);
+* BOUNDED dynamic calls: a call through a parameter, a dict lookup
+  (``plan["fn"](...)``) or an unresolvable attribute is recorded as a
+  dynamic call on the caller and never traversed — the explicit
+  comment grammar (``# mxlint: donates``, justified disables) remains
+  the escape hatch, and ``stats()`` reports how much of the graph is
+  dark;
+* Tarjan SCCs (iterative — no recursion limit risk) so bottom-up
+  summary passes and the tests can reason about recursion cycles.
+
+The graph is deliberately unsound-by-choice in the conservative
+direction each rule needs: edges only exist when resolution is
+certain, so a finding's chain is always a real call path in the
+source.
+"""
+from __future__ import annotations
+
+import ast
+
+from .core import resolve_origin
+
+# edge kinds
+CALL = "call"
+REF = "ref"
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+class FuncInfo:
+    """One function entity. Identity is the object itself; ``key``
+    (display path, qualname) is the stable cross-run name used in
+    reports and caches."""
+
+    __slots__ = ("src", "node", "qualname", "self_class", "line",
+                 "is_static")
+
+    def __init__(self, src, node, qualname, self_class):
+        self.src = src
+        self.node = node
+        self.qualname = qualname
+        self.self_class = self_class        # ClassInfo or None
+        self.line = node.lineno
+        # @staticmethod takes no bound receiver: donation positions
+        # need no self-shift at attribute call sites
+        self.is_static = any(
+            isinstance(d, ast.Name) and d.id == "staticmethod"
+            for d in node.decorator_list)
+
+    @property
+    def key(self):
+        return (self.src.display, self.qualname)
+
+    @property
+    def name(self):
+        return self.node.name
+
+    def label(self):
+        return "%s:%s" % (self.src.display, self.qualname)
+
+    def __repr__(self):
+        return "FuncInfo(%s)" % self.label()
+
+
+class ClassInfo:
+    __slots__ = ("src", "node", "qualname", "methods", "base_exprs")
+
+    def __init__(self, src, node, qualname):
+        self.src = src
+        self.node = node
+        self.qualname = qualname
+        self.methods = {}               # name -> FuncInfo
+        self.base_exprs = list(node.bases)
+
+    def __repr__(self):
+        return "ClassInfo(%s:%s)" % (self.src.display, self.qualname)
+
+
+def module_name_of(display):
+    """Dotted module name a repo-relative path would import as
+    (``mxnet_tpu/module/base_module.py`` -> ``mxnet_tpu.module.
+    base_module``; a package ``__init__.py`` names the package)."""
+    p = display
+    if p.endswith(".py"):
+        p = p[:-3]
+    parts = [x for x in p.split("/") if x]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _import_map(src):
+    """{local name: dotted origin} including RELATIVE imports (which
+    :meth:`Source.import_aliases` deliberately skips — jit-site wants
+    only absolute jax origins, the call graph wants everything).
+    Memoized on the Source: the graph builder and the effect-summary
+    extractor both ask, and the walk is a full-tree pass."""
+    got = getattr(src, "_rich_aliases", None)
+    if got is not None:
+        return got
+    out = dict(src.import_aliases())
+    module = module_name_of(src.display)
+    # the containing package: an __init__.py IS its package (its
+    # module name already dropped the '__init__' segment), so level=1
+    # resolves against the module name itself, not its parent —
+    # otherwise `from . import util` inside pkg/__init__.py binds
+    # 'util' instead of 'pkg.util' and every edge out of a package
+    # __init__ silently vanishes
+    if src.display.endswith("__init__.py"):
+        pkg_parts = module.split(".") if module else []
+    else:
+        pkg_parts = module.split(".")[:-1] if module else []
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.ImportFrom) and node.level > 0):
+            continue
+        # level=1: the containing package; level=2: its parent, ...
+        up = node.level - 1
+        base = pkg_parts[:len(pkg_parts) - up] if up else list(pkg_parts)
+        if node.module:
+            base = base + node.module.split(".")
+        prefix = ".".join(base)
+        for a in node.names:
+            if a.name == "*":
+                continue
+            origin = "%s.%s" % (prefix, a.name) if prefix else a.name
+            out[a.asname or a.name] = origin
+    src._rich_aliases = out
+    return out
+
+
+class _Collector(ast.NodeVisitor):
+    """One pass per file: every function/class entity with its
+    lexical scope chain."""
+
+    def __init__(self, graph, src):
+        self.graph = graph
+        self.src = src
+        self.scope = []                 # mix of FuncInfo / ClassInfo
+
+    def _qual(self, name):
+        if self.scope:
+            return "%s.%s" % (self.scope[-1].qualname, name)
+        return name
+
+    def _self_class(self):
+        # the class a `self` in this position would refer to: nearest
+        # enclosing ClassInfo reached only through functions (a class
+        # nested inside a method starts a fresh `self`)
+        for s in reversed(self.scope):
+            if isinstance(s, ClassInfo):
+                return s
+            if not isinstance(s, FuncInfo):
+                return None
+        return None
+
+    def visit_ClassDef(self, node):
+        ci = ClassInfo(self.src, node, self._qual(node.name))
+        self.graph._add_class(ci)
+        self.scope.append(ci)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.scope.pop()
+
+    def _visit_func(self, node):
+        owner = self.scope[-1] if self.scope else None
+        self_class = self._self_class()
+        fi = FuncInfo(self.src, node, self._qual(node.name), self_class)
+        self.graph._add_func(fi, enclosing=[s for s in self.scope
+                                            if isinstance(s, FuncInfo)])
+        if isinstance(owner, ClassInfo):
+            owner.methods.setdefault(node.name, fi)
+        self.scope.append(fi)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.scope.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+
+class CallGraph:
+    """Entities + resolved edges over one Project. Build with
+    :func:`build` (or ``project.callgraph()``)."""
+
+    def __init__(self):
+        self.functions = []             # all FuncInfo, file order
+        self.classes = []
+        self._by_key = {}               # (display, qualname) -> FuncInfo
+        self._module_index = {}         # dotted module name -> src
+        self._module_funcs = {}         # src -> {name: FuncInfo}
+        self._module_classes = {}       # src -> {name: ClassInfo}
+        self._nested = {}               # FuncInfo -> {name: FuncInfo}
+        self._enclosing = {}            # FuncInfo -> tuple of FuncInfo
+        self._node_func = {}            # (src, id(def node)) -> FuncInfo
+        self._imports = {}              # src -> import map
+        self._edges = {}                # FuncInfo -> [(callee, line, col, kind)]
+        self._redges = {}               # FuncInfo -> [(caller, line, col, kind)]
+        self.dynamic_calls = {}         # FuncInfo -> count
+        self._n_edges = 0
+        self._sccs = None
+        self._locals = {}               # FuncInfo -> frozenset of names
+        self._by_src = None             # src -> [FuncInfo]
+
+    # -- construction -------------------------------------------------------
+    def _add_class(self, ci):
+        self.classes.append(ci)
+        if ci.qualname.count(".") == 0:          # module-level classes only
+            self._module_classes.setdefault(ci.src, {})[ci.qualname] = ci
+
+    def _add_func(self, fi, enclosing):
+        self.functions.append(fi)
+        self._by_key.setdefault(fi.key, fi)
+        self._node_func[(fi.src, id(fi.node))] = fi
+        self._enclosing[fi] = tuple(enclosing)
+        if enclosing:
+            self._nested.setdefault(enclosing[-1], {})[fi.name] = fi
+        elif "." not in fi.qualname:             # plain module function
+            self._module_funcs.setdefault(fi.src, {})[fi.name] = fi
+
+    def _add_edge(self, caller, callee, node, kind):
+        self._edges.setdefault(caller, []).append(
+            (callee, node.lineno, node.col_offset, kind))
+        self._redges.setdefault(callee, []).append(
+            (caller, node.lineno, node.col_offset, kind))
+        self._n_edges += 1
+
+    # -- lookups ------------------------------------------------------------
+    def imports_of(self, src):
+        got = self._imports.get(src)
+        if got is None:
+            got = self._imports[src] = _import_map(src)
+        return got
+
+    def func_for_node(self, src, node):
+        """FuncInfo of a def node seen by a rule (or None)."""
+        return self._node_func.get((src, id(node)))
+
+    def functions_of(self, src):
+        """Every FuncInfo defined in one source file."""
+        if self._by_src is None:
+            self._by_src = {}
+            for fi in self.functions:
+                self._by_src.setdefault(fi.src, []).append(fi)
+        return self._by_src.get(src, ())
+
+    def callees(self, fi, kinds=(CALL,)):
+        return [(c, ln, col) for c, ln, col, k in self._edges.get(fi, ())
+                if k in kinds]
+
+    def callers(self, fi, kinds=(CALL,)):
+        return [(c, ln, col) for c, ln, col, k in self._redges.get(fi, ())
+                if k in kinds]
+
+    def resolve_dotted(self, origin):
+        """('func', FuncInfo) | ('class', ClassInfo) | None for a dotted
+        origin like ``mxnet_tpu.telemetry.counter_inc`` — matched
+        against the LONGEST scanned-module prefix."""
+        parts = origin.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            src = self._module_index.get(".".join(parts[:cut]))
+            if src is None:
+                continue
+            rest = parts[cut:]
+            if len(rest) == 1:
+                fi = self._module_funcs.get(src, {}).get(rest[0])
+                if fi is not None:
+                    return ("func", fi)
+                ci = self._module_classes.get(src, {}).get(rest[0])
+                if ci is not None:
+                    return ("class", ci)
+            elif len(rest) == 2:
+                ci = self._module_classes.get(src, {}).get(rest[0])
+                if ci is not None:
+                    m = self._lookup_method(ci, rest[1])
+                    if m is not None:
+                        return ("func", m)
+            return None
+        return None
+
+    def resolve_name(self, src, scope, name):
+        """What bare ``name`` means inside ``scope`` (a FuncInfo, or
+        None for module level): ('func', fi) | ('class', ci) | None.
+        Lexical nested defs shadow module functions shadow imports."""
+        if scope is not None:
+            chain = (self._enclosing.get(scope, ()) + (scope,))
+            for s in reversed(chain):
+                fi = self._nested.get(s, {}).get(name)
+                if fi is not None:
+                    return ("func", fi)
+            # any OTHER local binding (param, assignment, loop var)
+            # shadows module scope with a value the graph cannot see —
+            # resolving past it would fabricate an edge to the
+            # shadowed module function, breaking the 'every chain is a
+            # real call path' guarantee; None here lands in the
+            # caller's local-name-means-dynamic fallthrough
+            if name in self._locals_of(scope):
+                return None
+        fi = self._module_funcs.get(src, {}).get(name)
+        if fi is not None:
+            return ("func", fi)
+        ci = self._module_classes.get(src, {}).get(name)
+        if ci is not None:
+            return ("class", ci)
+        origin = self.imports_of(src).get(name)
+        if origin and origin != name:
+            return self.resolve_dotted(origin)
+        return None
+
+    def _lookup_method(self, ci, name, _depth=0):
+        """Method lookup through scanned base classes (bounded)."""
+        m = ci.methods.get(name)
+        if m is not None or _depth > 8:
+            return m
+        for base in ci.base_exprs:
+            target = None
+            if isinstance(base, ast.Name):
+                target = self.resolve_name(ci.src, None, base.id)
+            elif isinstance(base, ast.Attribute):
+                origin = self._resolve_attr_origin(ci.src, base)
+                if origin:
+                    target = self.resolve_dotted(origin)
+            if target and target[0] == "class":
+                m = self._lookup_method(target[1], name, _depth + 1)
+                if m is not None:
+                    return m
+        return None
+
+    def _resolve_attr_origin(self, src, node):
+        """Textual dotted origin of an Attribute chain under the
+        file's (absolute + relative) import map — routed through the
+        ONE shared resolver in core."""
+        return resolve_origin(node, self.imports_of(src))
+
+    def _locals_of(self, fi):
+        """Names bound locally in a function (params + stores +
+        nested defs + enclosing-function locals), for the
+        call-through-a-local-is-dynamic distinction."""
+        if fi is None:
+            return frozenset()
+        got = self._locals.get(fi)
+        if got is not None:
+            return got
+        names = set()
+        for scope in self._enclosing.get(fi, ()) + (fi,):
+            for n in _walk_same_scope(scope.node):
+                if isinstance(n, ast.Name) \
+                        and isinstance(n.ctx, (ast.Store, ast.Del)):
+                    names.add(n.id)
+                elif isinstance(n, ast.arg):
+                    names.add(n.arg)
+                elif isinstance(n, (ast.Global, ast.Nonlocal)):
+                    names.difference_update(n.names)
+                elif isinstance(n, _FUNC_NODES):
+                    names.add(n.name)
+        got = self._locals[fi] = frozenset(names)
+        return got
+
+    # -- edge extraction ----------------------------------------------------
+    def _local_instance_types(self, src, fi):
+        """{var name: ClassInfo} from direct ``x = ClassName(...)``
+        assignments in the function body (flow-insensitive; last
+        binding wins — enough for the constructor-then-use idiom)."""
+        out = {}
+        for n in _walk_same_scope(fi.node):
+            if not (isinstance(n, ast.Assign) and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)
+                    and isinstance(n.value, ast.Call)):
+                continue
+            target = None
+            f = n.value.func
+            if isinstance(f, ast.Name):
+                target = self.resolve_name(src, fi, f.id)
+            elif isinstance(f, ast.Attribute):
+                origin = self._resolve_attr_origin(src, f)
+                if origin:
+                    target = self.resolve_dotted(origin)
+            if target and target[0] == "class":
+                out[n.targets[0].id] = target[1]
+        return out
+
+    def _resolve_call_target(self, src, fi, func_expr, var_types):
+        """FuncInfo a call expression lands on, or the string
+        'dynamic' (plausibly in-project, unresolvable) or None
+        (external/builtin)."""
+        if isinstance(func_expr, ast.Name):
+            got = self.resolve_name(src, fi, func_expr.id)
+            if got is None:
+                # a bare name that is a known local/param: dynamic; an
+                # unknown global (builtin, star import): external
+                return "dynamic" if func_expr.id in self._locals_of(fi) \
+                    else None
+            if got[0] == "func":
+                return got[1]
+            # constructor call -> __init__ when scanned
+            init = self._lookup_method(got[1], "__init__")
+            return init
+        if isinstance(func_expr, ast.Attribute):
+            base = func_expr.value
+            if isinstance(base, ast.Name):
+                if base.id in ("self", "cls") and fi is not None \
+                        and fi.self_class is not None:
+                    m = self._lookup_method(fi.self_class, func_expr.attr)
+                    return m if m is not None else "dynamic"
+                ci = var_types.get(base.id)
+                if ci is not None:
+                    m = self._lookup_method(ci, func_expr.attr)
+                    return m if m is not None else "dynamic"
+            origin = self._resolve_attr_origin(src, func_expr)
+            if origin:
+                got = self.resolve_dotted(origin)
+                if got is not None:
+                    if got[0] == "func":
+                        return got[1]
+                    init = self._lookup_method(got[1], "__init__")
+                    return init               # constructor (or external)
+                # rooted at an import that is outside the scan: external
+                root = func_expr
+                while isinstance(root, ast.Attribute):
+                    root = root.value
+                if isinstance(root, ast.Name) \
+                        and root.id in self.imports_of(src):
+                    return None
+            # obj.method() on an untyped receiver: could be anywhere
+            # in-project — dynamic
+            return "dynamic"
+        # plan["fn"](...), (lambda ...)(...), chained calls: dynamic
+        return "dynamic"
+
+    def _extract_edges(self, fi):
+        src = fi.src
+        var_types = None
+        for n in _walk_same_scope(fi.node):
+            if not isinstance(n, ast.Call):
+                continue
+            if var_types is None:
+                var_types = self._local_instance_types(src, fi)
+            target = self._resolve_call_target(src, fi, n.func, var_types)
+            if isinstance(target, FuncInfo):
+                self._add_edge(fi, target, n, CALL)
+            elif target == "dynamic":
+                self.dynamic_calls[fi] = self.dynamic_calls.get(fi, 0) + 1
+            # function-valued ARGUMENTS: a known function passed as a
+            # value (jax.vjp(f), jax.checkpoint(f), partial(f, ...))
+            # runs under the receiver — a ref edge. Bound methods
+            # passed as values (jax.jit(self._kernel)) resolve through
+            # the same self-type machinery as self.m() call edges.
+            for arg in list(n.args) + [kw.value for kw in n.keywords]:
+                if isinstance(arg, ast.Name):
+                    got = self.resolve_name(src, fi, arg.id)
+                    if got is not None and got[0] == "func":
+                        self._add_edge(fi, got[1], n, REF)
+                elif isinstance(arg, ast.Attribute) \
+                        and isinstance(arg.value, ast.Name) \
+                        and arg.value.id in ("self", "cls") \
+                        and fi is not None \
+                        and fi.self_class is not None:
+                    m = self._lookup_method(fi.self_class, arg.attr)
+                    if m is not None:
+                        self._add_edge(fi, m, n, REF)
+
+    # -- SCCs (Tarjan, iterative) -------------------------------------------
+    def sccs(self, kinds=(CALL,)):
+        """List of SCCs (each a list of FuncInfo) in reverse
+        topological order (callees before callers) over the given edge
+        kinds."""
+        if self._sccs is not None and kinds == (CALL,):
+            return self._sccs
+        index = {}
+        low = {}
+        on_stack = set()
+        stack = []
+        out = []
+        counter = [0]
+
+        for root in self.functions:
+            if root in index:
+                continue
+            work = [(root, 0)]
+            while work:
+                node, pi = work.pop()
+                if pi == 0:
+                    index[node] = low[node] = counter[0]
+                    counter[0] += 1
+                    stack.append(node)
+                    on_stack.add(node)
+                recurse = False
+                succs = self.callees(node, kinds)
+                for i in range(pi, len(succs)):
+                    s = succs[i][0]
+                    if s not in index:
+                        work.append((node, i + 1))
+                        work.append((s, 0))
+                        recurse = True
+                        break
+                    if s in on_stack:
+                        low[node] = min(low[node], index[s])
+                if recurse:
+                    continue
+                if low[node] == index[node]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        comp.append(w)
+                        if w is node:
+                            break
+                    out.append(comp)
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+        if kinds == (CALL,):
+            self._sccs = out
+        return out
+
+    def stats(self):
+        sccs = self.sccs()
+        cyclic = [c for c in sccs if len(c) > 1]
+        return {
+            "functions": len(self.functions),
+            "classes": len(self.classes),
+            "call_edges": sum(len([e for e in v if e[3] == CALL])
+                              for v in self._edges.values()),
+            "ref_edges": sum(len([e for e in v if e[3] == REF])
+                             for v in self._edges.values()),
+            "dynamic_calls": sum(self.dynamic_calls.values()),
+            "sccs": len(sccs),
+            "cyclic_sccs": len(cyclic),
+            "largest_scc": max((len(c) for c in sccs), default=0),
+        }
+
+
+def _walk_same_scope(node):
+    """ast.walk from a def node, not descending into NESTED def/class
+    bodies (those are their own entities) but visiting decorator lists
+    and default expressions of nested defs (they evaluate here). The
+    ROOT def's own decorators, defaults, return annotation and
+    parameter annotations are NOT visited — they evaluate at def time
+    in the ENCLOSING scope, so a decorator stacked above ``@jax.jit``
+    (or a ``make_spec()`` call in a param annotation) must not become
+    a call edge of the traced function. Its ``ast.arg`` nodes ARE
+    yielded (locals collection needs the params) but their children
+    are not walked."""
+    if isinstance(node, _FUNC_NODES):
+        stack = list(node.body)
+        a = node.args
+        for arg in (list(getattr(a, "posonlyargs", [])) + list(a.args)
+                    + list(a.kwonlyargs)
+                    + ([a.vararg] if a.vararg else [])
+                    + ([a.kwarg] if a.kwarg else [])):
+            yield arg
+        yield node
+    else:
+        stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, _FUNC_NODES + (ast.ClassDef,)):
+            yield n                      # the def itself binds a name here
+            for dec in n.decorator_list:
+                stack.append(dec)
+            if isinstance(n, _FUNC_NODES):
+                stack.extend(n.args.defaults)
+                stack.extend(d for d in n.args.kw_defaults
+                             if d is not None)
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def build(project):
+    """Build the CallGraph for every parsed source in a Project."""
+    g = CallGraph()
+    for src in project.sources:
+        mod = module_name_of(src.display)
+        if mod:
+            g._module_index.setdefault(mod, src)
+        _Collector(g, src).visit(src.tree)
+    for fi in g.functions:
+        g._extract_edges(fi)
+    return g

@@ -25,15 +25,21 @@ source lines (the :mod:`ast` tree drops comments):
 from __future__ import annotations
 
 import ast
+import hashlib
 import json
 import os
 import re
+import time
 
 # rule ids, in report order. The list lives here (not in the rules
 # package) so ``--list-rules``, suppression validation and the tests
-# share one source of truth.
+# share one source of truth. ``lockset`` and ``trace-purity`` are the
+# mxflow interprocedural additions (ISSUE 9); ``host-sync`` and
+# ``donation-safety`` gained interprocedural layers under their
+# existing ids.
 ALL_RULE_IDS = ("jit-site", "dispatch-hook", "lock-discipline",
-                "host-sync", "donation-safety", "registry-consistency")
+                "lockset", "host-sync", "trace-purity",
+                "donation-safety", "registry-consistency")
 
 # the rule id bad suppression comments are reported under (not
 # suppressible itself — a broken suppression must not hide)
@@ -58,25 +64,36 @@ class Finding:
     baseline identity ``(rule, path, anchor)`` — a finding keeps its
     baseline entry when unrelated edits move it, and loses it when the
     offending line itself changes (which is exactly when a human should
-    look again)."""
+    look again).
 
-    __slots__ = ("rule", "path", "line", "col", "message", "anchor")
+    ``via``: for chain-bearing findings, the display paths the witness
+    chain passes through (root and intermediate hops). NOT part of the
+    baseline identity — it exists so ``--changed`` subset mode can keep
+    a sink-anchored finding whose chain crosses a touched file."""
 
-    def __init__(self, rule, path, line, col, message, anchor=""):
+    __slots__ = ("rule", "path", "line", "col", "message", "anchor",
+                 "via")
+
+    def __init__(self, rule, path, line, col, message, anchor="",
+                 via=()):
         self.rule = rule
         self.path = path
         self.line = int(line)
         self.col = int(col)
         self.message = message
         self.anchor = anchor
+        self.via = tuple(via)
 
     def key(self):
         return (self.rule, self.path, self.anchor)
 
     def to_dict(self):
-        return {"rule": self.rule, "path": self.path, "line": self.line,
-                "col": self.col, "message": self.message,
-                "anchor": self.anchor}
+        d = {"rule": self.rule, "path": self.path, "line": self.line,
+             "col": self.col, "message": self.message,
+             "anchor": self.anchor}
+        if self.via:
+            d["via"] = list(self.via)
+        return d
 
     def render(self):
         return "%s:%d:%d: %s: %s" % (self.path, self.line, self.col,
@@ -200,11 +217,11 @@ class Source:
             return self.lines[line - 1].strip()
         return ""
 
-    def finding(self, rule, node_or_line, message):
+    def finding(self, rule, node_or_line, message, via=()):
         line = getattr(node_or_line, "lineno", node_or_line)
         col = getattr(node_or_line, "col_offset", 0)
         return Finding(rule, self.display, line, col, message,
-                       anchor=self.anchor_for(line))
+                       anchor=self.anchor_for(line), via=via)
 
     # -- shared AST helpers --------------------------------------------------
     def parents(self):
@@ -243,14 +260,23 @@ class Source:
     def resolve(self, node, aliases):
         """Dotted origin of a Name/Attribute expression under the
         file's import aliases, or None (not import-rooted)."""
-        if isinstance(node, ast.Name):
-            return aliases.get(node.id, node.id)
-        if isinstance(node, ast.Attribute):
-            base = self.resolve(node.value, aliases)
-            if base is None:
-                return None
-            return "%s.%s" % (base, node.attr)
-        return None
+        return resolve_origin(node, aliases)
+
+
+def resolve_origin(node, aliases):
+    """Dotted origin of a Name/Attribute expression under an alias
+    map (falls back to the bare name chain), or None (not a
+    name-rooted chain). THE resolver: core, callgraph and summaries
+    all route through this one function so a fix here applies to the
+    direct and interprocedural layers alike."""
+    if isinstance(node, ast.Name):
+        return aliases.get(node.id, node.id)
+    if isinstance(node, ast.Attribute):
+        base = resolve_origin(node.value, aliases)
+        if base is None:
+            return None
+        return "%s.%s" % (base, node.attr)
+    return None
 
 
 def expr_text(node):
@@ -304,6 +330,31 @@ class Project:
         self.root = root
         self.sources = []
         self.parse_errors = []
+        self.timings = {}               # "callgraph"/"summaries" build s
+        self._graph = None
+        self._summaries = None
+
+    def callgraph(self):
+        """The mxflow call graph over every parsed source — built once
+        per run, on first demand (rules that never go interprocedural
+        never pay for it)."""
+        if self._graph is None:
+            from . import callgraph as _callgraph
+            t0 = time.perf_counter()
+            self._graph = _callgraph.build(self)
+            self.timings["callgraph"] = time.perf_counter() - t0
+        return self._graph
+
+    def summaries(self):
+        """Per-function effect summaries over :meth:`callgraph` —
+        built once per run, on first demand."""
+        if self._summaries is None:
+            from . import summaries as _summaries
+            graph = self.callgraph()
+            t0 = time.perf_counter()
+            self._summaries = _summaries.Summaries(self, graph)
+            self.timings["summaries"] = time.perf_counter() - t0
+        return self._summaries
 
     def add_file(self, path):
         display = os.path.relpath(path, self.root) if self.root else path
@@ -324,6 +375,171 @@ class Project:
             return None
         self.sources.append(src)
         return src
+
+
+# ---------------------------------------------------------------------------
+# dependency cache: makes --changed a subset PARSE, not just a subset
+# report. A full run banks per-file content hashes plus the file-level
+# reverse-edge map of the call graph; a later --changed run validates
+# the hashes of every UNtouched file against it, expands the touched
+# set through the cached reverse map, and parses that closure PLUS its
+# transitive import closure (plus the registry-declaring files, so
+# registry-consistency never reports phantom undeclared uses). Any
+# mismatch — absent cache, stale hash, version bump — falls back to
+# the full parse, which refreshes the cache. Soundness note: reverse
+# dependents are exactly the CALLERS of the touched files,
+# transitively, so lockset entry-locksets and chain roots are always
+# in the parse set; the import closure covers the CALLEE direction
+# (every call mxflow can resolve goes through an import or stays in
+# file), so effect summaries reasoned over in subset mode match the
+# full run's. The report is still filtered to touched files + reverse
+# dependents — plus any sink whose witness chain crosses one (see
+# Finding.via).
+
+DEP_CACHE_VERSION = 2
+
+
+def _text_sha(text):
+    return hashlib.sha1(
+        text.encode("utf-8", "surrogatepass")).hexdigest()
+
+
+def _registry_decl_files(project):
+    """Files declaring a string registry (top-level ``SITES`` /
+    ``FUSED_FALLBACK_CODES`` / ``COUNTERS``) — always parsed in
+    dep-cache subset mode."""
+    names = {"SITES", "FUSED_FALLBACK_CODES", "COUNTERS"}
+    out = set()
+    for src in project.sources:
+        for node in src.tree.body:
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target]
+                       if isinstance(node, ast.AnnAssign) else ())
+            if any(isinstance(t, ast.Name) and t.id in names
+                   for t in targets):
+                out.add(src.display)
+                break
+    return sorted(out)
+
+
+def _file_rev_map(graph):
+    """File-level reverse edges of the call graph: {callee file ->
+    caller files}, cross-file edges only. ONE implementation shared by
+    the cache writer and the in-memory expansion so the cache-hit and
+    cache-miss paths can never drift apart."""
+    rev = {}
+    for fi, edges in graph._edges.items():
+        for callee, _line, _col, _kind in edges:
+            if callee.src.display != fi.src.display:
+                rev.setdefault(callee.src.display,
+                               set()).add(fi.src.display)
+    return rev
+
+
+def _grow_closure(seed, edge_map):
+    """Expand ``seed`` (a set, mutated in place) by BFS over
+    ``edge_map`` (node -> iterable of neighbours)."""
+    queue = list(seed)
+    while queue:
+        d = queue.pop()
+        for dep in edge_map.get(d, ()):
+            if dep not in seed:
+                seed.add(dep)
+                queue.append(dep)
+
+
+def _parse_import_closure(project, files, display_fn):
+    """Grow a subset parse through its imports, transitively: effect
+    facts flow CALLEE-ward (a hot caller's blocking sink lives in the
+    helper file it calls into; a donation summary comes from the
+    builder a touched caller binds), and every call mxflow can resolve
+    crosses files only through an import — so closing the parse set
+    over in-scan-set imports restores the facts subset mode reasons
+    with. Touched files use their FRESH imports (just parsed), so a
+    newly added dependency is followed even though the dep cache
+    predates it."""
+    from . import callgraph as _cg
+    index = {}
+    for path in files:
+        d = display_fn(path)
+        index.setdefault(_cg.module_name_of(d), (d, path))
+    parsed = {s.display for s in project.sources}
+    queue = list(project.sources)
+    while queue:
+        src = queue.pop()
+        for origin in set(_cg._import_map(src).values()):
+            parts = origin.split(".")
+            for cut in range(len(parts), 0, -1):
+                hit = index.get(".".join(parts[:cut]))
+                if hit is None:
+                    continue
+                d, path = hit
+                if d not in parsed:
+                    parsed.add(d)
+                    nsrc = project.add_file(path)
+                    if nsrc is not None:
+                        queue.append(nsrc)
+                break
+
+
+def write_dep_cache(path, project, paths=(), force=False):
+    """Bank the dependency skeleton of a full-view run (best-effort:
+    returns False without raising when the graph was never built or the
+    write fails — the cache is an accelerator, never a requirement).
+
+    ``paths``: the normalized lint-path set the skeleton covers — a
+    later ``--changed`` run over a DIFFERENT path set must not trust
+    it. Unless ``force``, an existing cache covering a different path
+    set is left alone: a one-off narrow run (a fixture test, a single
+    file) must not clobber the developer's repo-wide pre-commit
+    accelerator. A --changed fallback passes ``force`` — its path set
+    is the canonical consumer, so it wins."""
+    graph = project._graph
+    if graph is None:
+        return False
+    paths = sorted(paths)
+    if not force:
+        existing = load_dep_cache(path)
+        if existing is not None and existing.get("paths") != paths:
+            return False
+    rev = _file_rev_map(graph)
+    doc = {
+        "version": DEP_CACHE_VERSION,
+        "paths": paths,
+        "files": {s.display: _text_sha(s.text)
+                  for s in project.sources},
+        "rev": {k: sorted(v) for k, v in sorted(rev.items())},
+        "registry_files": _registry_decl_files(project),
+    }
+    tmp = "%s.%d.tmp" % (path, os.getpid())
+    try:
+        with open(tmp, "w") as f:
+            json.dump(doc, f, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+    return True
+
+
+def load_dep_cache(path):
+    """The parsed cache document, or None on any problem (absent,
+    unreadable, wrong version, malformed) — the caller falls back to a
+    full parse either way."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) \
+            or doc.get("version") != DEP_CACHE_VERSION \
+            or not isinstance(doc.get("files"), dict) \
+            or not isinstance(doc.get("rev"), dict):
+        return None
+    return doc
 
 
 class Baseline:
@@ -421,7 +637,8 @@ class Report:
     hygiene warnings (``stale_baseline``)."""
 
     def __init__(self, findings, suppressed, baselined, stale_baseline,
-                 warnings, paths, rules):
+                 warnings, paths, rules, timings=None, callgraph=None,
+                 files=0, subset=None, dep_cache=None):
         self.findings = findings
         self.suppressed = suppressed      # [(finding, justification)]
         self.baselined = baselined
@@ -429,6 +646,11 @@ class Report:
         self.warnings = warnings
         self.paths = paths
         self.rules = rules
+        self.timings = dict(timings or {})    # rule/pass -> seconds
+        self.callgraph = dict(callgraph or {})  # graph + cache stats
+        self.files = files
+        self.subset = subset            # --changed: files actually linted
+        self.dep_cache = dep_cache      # None | "hit" | "miss:<why>"
 
     @property
     def clean(self):
@@ -453,6 +675,12 @@ class Report:
             "baselined": [f.to_dict() for f in self.baselined],
             "stale_baseline": list(self.stale_baseline),
             "warnings": list(self.warnings),
+            "files": self.files,
+            "timings": {k: round(v, 4)
+                        for k, v in sorted(self.timings.items())},
+            "callgraph": self.callgraph,
+            "subset": self.subset,
+            "dep_cache": self.dep_cache,
         }
 
     def render_text(self):
@@ -486,30 +714,142 @@ def _load_rules(rule_ids=None):
     return [(rid, table[rid]) for rid in ids]
 
 
-def run(paths, rules=None, baseline=None, root=None):
+def _timed_check(timings, rid, project, raw, thunk):
+    """Run one rule pass, charging its wall time to ``rid`` MINUS any
+    callgraph/summaries build it lazily triggered (those are reported
+    under their own keys — without the subtraction the first
+    interprocedural rule to run would double-count the whole build)."""
+    t0 = time.perf_counter()
+    build_before = sum(project.timings.values())
+    raw.extend(thunk())
+    spent = (time.perf_counter() - t0) \
+        - (sum(project.timings.values()) - build_before)
+    timings[rid] = timings.get(rid, 0.0) + max(spent, 0.0)
+
+
+def run(paths, rules=None, baseline=None, root=None, only=None,
+        expand_dependents=False, dep_cache=None):
     """Analyze ``paths`` (files/dirs) with the given rule ids (default:
     all) against ``baseline`` (a path, a :class:`Baseline`, or None).
     Returns a :class:`Report`. ``root`` rebases display paths (the CLI
     passes the repo root so baseline entries stay machine-independent).
+
+    ``only`` (``--changed`` mode): an iterable of display paths — per-
+    file rules run only on the subset and every reported finding is
+    filtered to it, except that a chain-bearing finding whose witness
+    chain crosses the subset is kept even when its sink anchors
+    elsewhere. With ``expand_dependents`` the subset grows by the
+    transitive REVERSE call-graph closure (files with a call edge into
+    a changed file): a changed callee changes its callers' effect
+    summaries, so their findings can change too. Stale-baseline hygiene
+    is skipped in subset mode: entries covering the unscanned remainder
+    would all read as stale.
+
+    ``dep_cache`` (a path): a full-view run banks the dependency
+    skeleton there; a subset run that validates against it parses ONLY
+    the expanded closure plus its transitive import closure (the
+    callee direction — summaries need real callee bodies) plus the
+    registry-declaring files, instead of the whole path set — the
+    fast pre-commit loop. Falls back to the full parse (and refreshes
+    the cache) on any mismatch.
     """
     files = iter_python_files(paths)
+
+    def _display(path):
+        d = os.path.relpath(path, root) if root else path
+        return d.replace(os.sep, "/")
+
+    only_set = None
+    cache_state = None
+    parse_only = None           # set of displays to parse (fast path)
+    norm_paths = sorted(_display(p) for p in paths)
+    if only is not None:
+        only_set = {p.replace(os.sep, "/") for p in only}
+        if expand_dependents and only_set and dep_cache:
+            cache = load_dep_cache(dep_cache)
+            if cache is None:
+                cache_state = "miss:absent"
+            elif cache.get("paths") != norm_paths:
+                # skeleton banked for a different lint-path set: its
+                # rev map may be missing whole directories
+                cache_state = "miss:paths"
+            elif only_set & set(cache.get("registry_files", ())):
+                # a registry-DECLARING file was touched: its uses live
+                # anywhere in the scan set with no call edge to follow
+                # (registry consistency is string-keyed, not called),
+                # so only the full parse can re-check every use site
+                cache_state = "miss:registry-decl-touched"
+            else:
+                stale = None
+                for path in files:
+                    d = _display(path)
+                    if d in only_set:
+                        continue        # touched files may differ freely
+                    want = cache["files"].get(d)
+                    if want is None:
+                        stale = d
+                        break
+                    try:
+                        with open(path, encoding="utf-8") as f:
+                            if _text_sha(f.read()) != want:
+                                stale = d
+                                break
+                    except OSError:
+                        stale = d
+                        break
+                if stale is not None:
+                    cache_state = "miss:stale"
+                else:
+                    # unchanged files match the cache exactly, so the
+                    # cached reverse map is valid for them — and edges
+                    # FROM touched files only ever point callee-ward,
+                    # which the callers-only closure never follows
+                    _grow_closure(only_set, cache["rev"])
+                    parse_only = only_set \
+                        | set(cache.get("registry_files", ()))
+                    cache_state = "hit"
+
     project = Project(root=root)
     for path in files:
+        if parse_only is not None and _display(path) not in parse_only:
+            continue
         project.add_file(path)
+    if parse_only is not None:
+        # the reverse closure restored the CALLERS; now restore the
+        # CALLEES — without them, summaries for touched functions are
+        # computed against thin air and interprocedural findings
+        # anchored in (or chained through) touched files are missed
+        _parse_import_closure(project, files, _display)
+
+    if only_set is not None and expand_dependents and parse_only is None:
+        _grow_closure(only_set, _file_rev_map(project.callgraph()))
 
     selected = _load_rules(rules)
+    timings = {}
     raw = list(project.parse_errors)
     for src in project.sources:
         raw.extend(src.grammar_findings)
-        for _rid, rule in selected:
+        if only_set is not None and src.display not in only_set:
+            continue
+        for rid, rule in selected:
             check = getattr(rule, "check_source", None)
             if check is not None:
-                raw.extend(check(src, project))
-    for _rid, rule in selected:
+                _timed_check(timings, rid, project, raw,
+                             lambda: check(src, project))
+    for rid, rule in selected:
         check = getattr(rule, "check_project", None)
         if check is not None:
-            raw.extend(check(project))
+            _timed_check(timings, rid, project, raw,
+                         lambda: check(project))
 
+    if only_set is not None:
+        # keep a finding when it is anchored in the subset OR its
+        # witness chain crosses it: a hot loop edited to call into an
+        # existing helper sinks in the UNtouched helper file, and that
+        # is precisely the regression --changed exists to catch
+        raw = [f for f in raw
+               if f.path in only_set
+               or any(v in only_set for v in f.via)]
     raw.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
 
     by_display = {s.display: s for s in project.sources}
@@ -529,7 +869,28 @@ def run(paths, rules=None, baseline=None, root=None):
     else:
         bl = Baseline.load(baseline)
     kept, baselined, stale = bl.partition(unsuppressed)
+    if only_set is not None:
+        stale = []
+    timings.update(project.timings)
+    stats = {}
+    if project._graph is not None:
+        stats = project._graph.stats()
+        from . import summaries as _summaries
+        stats["facts_cache"] = _summaries.cache_stats()
+    if dep_cache and parse_only is None and project._graph is not None:
+        # this run parsed the full path set and built the graph —
+        # refresh the skeleton so the next --changed run goes fast.
+        # A --changed fallback forces: its path set is the canonical
+        # consumer; a plain narrow run never clobbers a cache covering
+        # a different path set
+        write_dep_cache(dep_cache, project, paths=norm_paths,
+                        force=only is not None)
     return Report(kept, suppressed, baselined, stale,
                   list(bl.load_warnings),
                   [p.replace(os.sep, "/") for p in paths],
-                  [rid for rid, _ in selected])
+                  [rid for rid, _ in selected],
+                  timings=timings, callgraph=stats,
+                  files=len(project.sources),
+                  subset=sorted(only_set) if only_set is not None
+                  else None,
+                  dep_cache=cache_state)

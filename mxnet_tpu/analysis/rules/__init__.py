@@ -20,11 +20,14 @@ def rule_table():
     global _TABLE
     if _TABLE is None:
         from . import (jit_site, dispatch_hook, lock_discipline,
-                       host_sync, donation, registry_sync)
+                       lockset, host_sync, trace_purity, donation,
+                       registry_sync)
         instances = [jit_site.JitSiteRule(),
                      dispatch_hook.DispatchHookRule(),
                      lock_discipline.LockDisciplineRule(),
+                     lockset.LocksetRule(),
                      host_sync.HostSyncRule(),
+                     trace_purity.TracePurityRule(),
                      donation.DonationRule(),
                      registry_sync.RegistryConsistencyRule()]
         _TABLE = {r.id: r for r in instances}

@@ -26,6 +26,15 @@ is flagged too — iteration two donates a dead buffer.
 Statement order is source order (control flow is not modelled): a use
 in an ``else`` branch the call cannot reach may need a justified
 disable — the conservative direction for a buffer-lifetime lint.
+
+Since ISSUE 9 donation facts also propagate INTERPROCEDURALLY (the
+mxflow effect summaries): a call to an in-repo function that passes
+its parameter on at a donated position (``def fused(w): step(w)``
+donates ``w``), or through a name bound from a callee that RETURNS a
+donating program (``fn = self._build_step(...); fn(ws, states)``),
+donates with no ``# mxlint: donates`` marker — the marker grammar
+remains only for callees the analyzer genuinely cannot see (dict
+lookups like ``plan["fn"]``, dynamic dispatch).
 """
 import ast
 
@@ -126,9 +135,33 @@ class DonationRule:
     id = "donation-safety"
 
     def check_source(self, src, project):
-        # cheap precondition: a donating callable needs the literal
-        # keyword "donate_argnums" (or an explicit marker) in the file
-        if "donate_argnums" not in src.text and not src.donates:
+        # cheap PROJECT-level gate first: donation facts can only
+        # originate from a literal donate_argnums or an explicit
+        # marker somewhere in the scan — without one, skip the whole
+        # callgraph + summaries + donation-fixpoint build (cached on
+        # the project: check_source runs once per file)
+        possible = getattr(project, "_donation_possible", None)
+        if possible is None:
+            possible = any("donate_argnums" in s.text or s.donates
+                           for s in project.sources)
+            project._donation_possible = possible
+        if not possible:
+            return []
+        # interprocedural feed: donated call sites the effect
+        # summaries can prove for this file's functions (callee
+        # donates its param / callee returns a donating program)
+        graph = project.callgraph()
+        summ = project.summaries()
+        inter_sites = {}                # FunctionDef node -> {(l,c): idx}
+        for fi in graph.functions_of(src):
+            sites = summ.donated_sites(fi)
+            if sites:
+                inter_sites[fi.node] = sites
+        # cheap per-file precondition: a donating callable in THIS
+        # file needs the literal keyword, an explicit marker, or an
+        # interprocedurally inferred donated site
+        if "donate_argnums" not in src.text and not src.donates \
+                and not inter_sites:
             return []
         parents = src.parents()
         aliases = src.import_aliases()
@@ -174,7 +207,8 @@ class DonationRule:
                 if cls is not None:
                     class_fns[(cls, target.attr)] = idx
 
-        if not (module_fns or scope_fns or class_fns or src.donates):
+        if not (module_fns or scope_fns or class_fns or src.donates
+                or inter_sites):
             return []
 
         findings = []
@@ -185,11 +219,12 @@ class DonationRule:
         for fn, body in scopes:
             findings.extend(self._check_scope(
                 src, fn, body, dict(module_fns), scope_fns.get(fn, {}),
-                class_fns, enclosing_class, parents))
+                class_fns, enclosing_class, parents,
+                inter_sites.get(fn, {})))
         return findings
 
     def _check_scope(self, src, fn, body, tracked, local_tracked,
-                     class_fns, enclosing_class, parents):
+                     class_fns, enclosing_class, parents, inter_sites):
         tracked.update(local_tracked)
         owner = enclosing_class(fn) if fn is not None else None
         stmts = []
@@ -215,6 +250,13 @@ class DonationRule:
                     idx = tracked[call.func.id]
                 elif is_self_attr(call.func) and owner is not None:
                     idx = class_fns.get((owner, call.func.attr))
+                if not idx:
+                    # interprocedural: the effect summaries proved
+                    # this call site donating (callee passes its param
+                    # on, or the callable came from a function that
+                    # returns a donating program)
+                    idx = inter_sites.get((call.lineno,
+                                           call.col_offset))
                 if not idx:
                     continue
                 callee = expr_text(call.func)

@@ -14,13 +14,33 @@ comprehension, constant) is exempt — building a feed array from Python
 scalars is host work, not a device sync. Any remaining legitimate site
 (e.g. marshalling a client payload on the serving admission path)
 carries a justified ``# mxlint: disable=host-sync -- why``.
+
+Since ISSUE 9 the rule is TRANSITIVE (the mxflow layer): a hot
+function that reaches a blocking fetch through any chain of resolved
+calls is flagged too, with the chain printed in the finding. The
+finding anchors at the SINK line (where the fetch actually is) in the
+sink's file — that is where the fix or the justified disable belongs,
+and the baseline keys on it, so refactoring an intermediate caller
+never invalidates a grandfathered entry. Only ``call`` edges are
+traversed: a callback handed to the resolver pool blocks on its own
+thread, legally. Dynamic calls are not traversed (bounded).
 """
 import ast
 
-_BLOCKING_METHODS = {"asnumpy", "wait_to_read"}
-_HOST_LITERALS = (ast.List, ast.Tuple, ast.Dict, ast.Set, ast.ListComp,
-                  ast.SetComp, ast.DictComp, ast.GeneratorExp,
-                  ast.Constant)
+from ..callgraph import _walk_same_scope
+from ..core import Finding
+from ..summaries import classify_sync_call
+
+
+def _is_hot(node, src):
+    """Whether a def is # mxlint: hot-marked. A standalone marker
+    above a DECORATED def arms the first decorator's line, not the
+    `def` line — accept either so the marker is never silently
+    inert."""
+    lines = {node.lineno}
+    if node.decorator_list:
+        lines.add(min(d.lineno for d in node.decorator_list))
+    return bool(lines & src.hot_lines)
 
 
 class HostSyncRule:
@@ -28,16 +48,9 @@ class HostSyncRule:
 
     def _hot_functions(self, src):
         for node in ast.walk(src.tree):
-            if not isinstance(node, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef)):
-                continue
-            # a standalone marker above a DECORATED def arms the first
-            # decorator's line, not the `def` line — accept either so
-            # the marker is never silently inert
-            lines = {node.lineno}
-            if node.decorator_list:
-                lines.add(min(d.lineno for d in node.decorator_list))
-            if lines & src.hot_lines:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) \
+                    and _is_hot(node, src):
                 yield node
 
     def check_source(self, src, project):
@@ -51,24 +64,25 @@ class HostSyncRule:
         findings = []
         seen = set()
         for fn in self._hot_functions(src):
+            # a local binding (param, store, nested def name) in the
+            # hot function's OWN scope shadowing `np`/`asarray` means
+            # calls through it are NOT numpy. Same-scope walk only: a
+            # name bound inside a NESTED def shadows nothing out here
+            locals_ = set()
+            for n in _walk_same_scope(fn):
+                if isinstance(n, ast.arg):
+                    locals_.add(n.arg)
+                elif isinstance(n, ast.Name) \
+                        and isinstance(n.ctx, (ast.Store, ast.Del)):
+                    locals_.add(n.id)
+                elif isinstance(n, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)) and n is not fn:
+                    locals_.add(n.name)
             for node in ast.walk(fn):
                 if not isinstance(node, ast.Call):
                     continue
-                f = node.func
-                msg = None
-                if isinstance(f, ast.Attribute) \
-                        and f.attr in _BLOCKING_METHODS:
-                    msg = ".%s()" % f.attr
-                elif ((isinstance(f, ast.Attribute)
-                       and f.attr == "asarray"
-                       and isinstance(f.value, ast.Name)
-                       and f.value.id in np_names)
-                      or (isinstance(f, ast.Name)
-                          and f.id in asarray_names)):
-                    if node.args and isinstance(node.args[0],
-                                                _HOST_LITERALS):
-                        continue
-                    msg = "np.asarray(...)"
+                msg = classify_sync_call(node, np_names - locals_,
+                                         asarray_names - locals_)
                 if msg is None:
                     continue
                 key = (node.lineno, node.col_offset)
@@ -81,4 +95,63 @@ class HostSyncRule:
                     "(# mxlint: hot) — this stalls the dispatch "
                     "pipeline on the device; fetch lazily or move the "
                     "sync off the hot path" % (msg, fn.name)))
+        findings.extend(self._check_transitive(src, project))
+        return findings
+
+    def _check_transitive(self, src, project):
+        """Hot functions reaching a blocking fetch through callees —
+        anchored at the SINK, chain in the message."""
+        graph = project.callgraph()
+        summ = project.summaries()
+        findings = []
+        seen = set()
+        for fn in self._hot_functions(src):
+            fi = graph.func_for_node(src, fn)
+            if fi is None:
+                continue
+            for callee, line, _col in graph.callees(fi):
+                # a justified disable on the CALL LINE in the hot
+                # function cuts the chain there ("this call is allowed
+                # to block" — e.g. the opt-in divergence probe)
+                if src.suppressed(self.id, line) is not None:
+                    continue
+                # EVERY reachable sink function and EVERY sync site in
+                # it gets its own finding (suppression is per line): a
+                # justified disable on one fetch must not hide the
+                # unjustified one on the next line, or a farther sink
+                for chain, sink_fi, sites in summ.sync_witnesses(
+                        callee):
+                    # a hot-marked sink already gets the direct finding
+                    # (same def-or-decorator-line check as
+                    # _hot_functions, or a decorator-armed marker
+                    # would duplicate the finding at the sink line)
+                    if _is_hot(sink_fi.node, sink_fi.src):
+                        continue
+                    hops = ["%s (%s:%d)" % (fn.name, src.display,
+                                            fn.lineno)]
+                    via = {src.display}
+                    prev = fi
+                    for nxt, call_line in [(callee, line)] + chain:
+                        hops.append("%s (called at %s:%d)"
+                                    % (nxt.name, prev.src.display,
+                                       call_line))
+                        via.add(nxt.src.display)
+                        prev = nxt
+                    for sink_line, form in sites:
+                        key = (fi, sink_fi.src.display, sink_line)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        findings.append(Finding(
+                            self.id, sink_fi.src.display, sink_line, 0,
+                            "blocking host sync %s in '%s' is "
+                            "reachable from hot function '%s' "
+                            "(# mxlint: hot) through the call chain "
+                            "%s — this stalls the dispatch pipeline "
+                            "on the device; fetch lazily or move "
+                            "the sync off the hot path"
+                            % (form, sink_fi.name, fn.name,
+                               " -> ".join(hops)),
+                            anchor=sink_fi.src.anchor_for(sink_line),
+                            via=sorted(via)))
         return findings

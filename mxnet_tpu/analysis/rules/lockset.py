@@ -1,0 +1,181 @@
+"""lockset: infer missing ``# guarded by:`` annotations (RacerD-style).
+
+lock-discipline enforces the annotations that EXIST. This rule finds
+the shared state nobody remembered to annotate: a ``self.<attr>``
+that is accessed under a known lock on some paths and lock-free on
+others, in a class that owns a ``threading.Lock``/``RLock``/
+``Condition``. The inconsistency itself is the signal — either the
+lock-free access is a race, or the locked accesses are cargo cult;
+both deserve a human look, and the finding proposes the exact
+``# guarded by: <lock>`` annotation to add (after which
+lock-discipline enforces it everywhere, forever).
+
+Locksets are computed interprocedurally over the mxflow call graph:
+an access's effective lockset is the locks held LEXICALLY at it plus
+the function's ENTRY lockset — the intersection, over every resolved
+call site, of the locks held by the caller there. A private helper
+(``_drain``) called only from inside ``with self._lock:`` blocks
+therefore counts as locked without any annotation; one lock-free call
+site drops it to the meet (empty), exactly RacerD's treatment. Public
+methods and functions with unresolved callers start at the empty
+lockset (anyone may call them bare).
+
+Noise control, in the conservative-but-quiet direction:
+
+* only attributes with at least one WRITE among the considered
+  accesses are flagged (read-only config set in ``__init__`` is not a
+  race);
+* ``__init__`` bodies and ``*_locked``-suffix functions are exempt
+  (construction happens-before publication; the suffix is the
+  documented caller-holds-the-lock convention);
+* attributes already annotated ``# guarded by:`` anywhere in the
+  class belong to lock-discipline and are skipped here, as are the
+  lock/condition objects themselves.
+"""
+import ast
+
+from ..callgraph import REF
+from ..core import Finding
+
+
+def _annotated_attrs(src, class_node):
+    """Attr names with a '# guarded by:' annotation anywhere in the
+    class body (lock-discipline owns those)."""
+    out = set()
+    for node in ast.walk(class_node):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign,
+                                 ast.AugAssign)):
+            continue
+        if node.lineno not in src.guards:
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            if isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                out.add(t.attr)
+    return out
+
+
+def _exempt(fi):
+    return fi.name == "__init__" or fi.name.endswith("_locked")
+
+
+class LocksetRule:
+    id = "lockset"
+
+    def check_project(self, project):
+        graph = project.callgraph()
+        summ = project.summaries()
+
+        by_class = {}
+        for fi in graph.functions:
+            if fi.self_class is not None:
+                by_class.setdefault(fi.self_class, []).append(fi)
+
+        findings = []
+        for ci, members in by_class.items():
+            src = ci.src
+            known_locks, _canonical = summ.file_locks(src)
+            self_locks = frozenset(l for l in known_locks
+                                   if l.startswith("self."))
+            if not self_locks:
+                continue
+            findings.extend(self._check_class(
+                src, ci, members, graph, summ, self_locks))
+        findings.sort(key=lambda f: (f.path, f.line))
+        return findings
+
+    def _entry_locksets(self, ci, members, graph, summ, self_locks):
+        """Fixpoint: locks guaranteed held on ENTRY to each private
+        member, via the meet over resolved same-class call sites."""
+        member_set = set(members)
+
+        def eligible(fi):
+            # a method that ESCAPES as a value (ref edge: callback,
+            # Timer/Thread target) may be invoked bare by anyone — its
+            # locked call-edge callers guarantee nothing at entry
+            return fi.name.startswith("_") \
+                and not fi.name.startswith("__") \
+                and bool(graph.callers(fi)) \
+                and not graph.callers(fi, kinds=(REF,))
+
+        entry = {fi: (self_locks if eligible(fi) else frozenset())
+                 for fi in members}
+        for _round in range(len(members) + 2):
+            changed = False
+            for fi in members:
+                if not eligible(fi):
+                    continue
+                new = None
+                for caller, line, col in graph.callers(fi):
+                    if caller not in member_set:
+                        new = frozenset()       # callable from outside
+                        break
+                    held = summ.facts_of(caller).calls_held.get(
+                        (line, col), frozenset()) & self_locks
+                    eff = held | entry.get(caller, frozenset())
+                    new = eff if new is None else (new & eff)
+                if new is None:
+                    new = frozenset()
+                if new != entry[fi]:
+                    entry[fi] = new
+                    changed = True
+            if not changed:
+                break
+        return entry
+
+    def _check_class(self, src, ci, members, graph, summ, self_locks):
+        annotated = _annotated_attrs(src, ci.node)
+        lock_attrs = {l.split(".", 1)[1] for l in self_locks}
+        # self.<method>() references are calls, not state accesses
+        method_names = set(ci.methods)
+        entry = self._entry_locksets(ci, members, graph, summ,
+                                     self_locks)
+
+        # attr -> [(fi, line, col, is_store, effective lockset)]
+        per_attr = {}
+        for fi in members:
+            facts = summ.facts_of(fi)
+            base = entry.get(fi, frozenset())
+            for attr, line, col, is_store, held in facts.accesses:
+                if attr in annotated or attr in lock_attrs \
+                        or attr in method_names:
+                    continue
+                eff = (held & self_locks) | base
+                per_attr.setdefault(attr, []).append(
+                    (fi, line, col, is_store, eff))
+
+        findings = []
+        for attr, accs in sorted(per_attr.items()):
+            considered = [a for a in accs if not _exempt(a[0])]
+            locked = [a for a in considered if a[4]]
+            bare = [a for a in considered if not a[4]]
+            if not locked or not bare:
+                continue
+            if not any(a[3] for a in considered):
+                continue                    # no write anywhere: not a race
+            # propose the most common lock over the locked accesses
+            votes = {}
+            for _fi, _l, _c, _s, eff in locked:
+                for lock in eff:
+                    votes[lock] = votes.get(lock, 0) + 1
+            lock = max(sorted(votes), key=lambda k: votes[k])
+            ex_fi, ex_line = locked[0][0], locked[0][1]
+            first = min(bare, key=lambda a: (a[1], a[2]))
+            fi, line, col, is_store, _eff = first
+            findings.append(Finding(
+                self.id, src.display, line, col,
+                "attribute 'self.%s' of %s is accessed under %s in %d "
+                "place(s) (e.g. '%s' at line %d) but lock-free here in "
+                "'%s' (%s) — if it is shared state, annotate its "
+                "assignment '# guarded by: %s' so lock-discipline "
+                "enforces it everywhere; if the lock-free access is a "
+                "deliberate fast path, add a justified "
+                "'# mxlint: disable=lockset -- why'"
+                % (attr, ci.qualname, lock, len(locked), ex_fi.name,
+                   ex_line, fi.name,
+                   "written" if is_store else "read", lock),
+                anchor=src.anchor_for(line)))
+        return findings

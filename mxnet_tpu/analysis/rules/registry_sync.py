@@ -179,7 +179,8 @@ class RegistryConsistencyRule:
                         "faults.fire(%r): site not declared in "
                         "faults.SITES (%s) — an undeclared site never "
                         "fires; add it to SITES or fix the typo"
-                        % (lit, ", ".join(sorted(dset)))))
+                        % (lit, ", ".join(sorted(dset))),
+                        via=(src.display,)))
             if used:
                 for missing in [s for s in declared if s not in used]:
                     findings.append(src.finding(
@@ -201,7 +202,8 @@ class RegistryConsistencyRule:
                         self.id, unode,
                         "FusedFallback(%r): code not declared in "
                         "FUSED_FALLBACK_CODES — bench lanes and tests "
-                        "key on the declared codes" % lit))
+                        "key on the declared codes" % lit,
+                        via=(src.display,)))
             if used:
                 for missing in [c for c in declared if c not in used]:
                     findings.append(src.finding(
@@ -221,7 +223,7 @@ class RegistryConsistencyRule:
                         "counter_inc(%r): counter not declared in "
                         "telemetry.COUNTERS — declare it (a '.*' "
                         "pattern covers dynamic tails) or fix the "
-                        "name" % lit))
+                        "name" % lit, via=(src.display,)))
             for usrc, unode, pfx in counter_prefix_uses:
                 if not any(_pattern_covers_prefix(p, pfx)
                            for p in declared):
@@ -229,7 +231,7 @@ class RegistryConsistencyRule:
                         self.id, unode,
                         "counter_inc(%r...): dynamic counter prefix "
                         "not covered by any telemetry.COUNTERS '.*' "
-                        "pattern" % pfx))
+                        "pattern" % pfx, via=(src.display,)))
             if counter_uses or counter_prefix_uses:
                 # the registry module's own internal writes (the
                 # record_* helpers format names straight into the

@@ -1,0 +1,209 @@
+"""trace-purity: code reachable from a traced entry point stays pure.
+
+``jax.jit`` runs a function's Python body ONCE, at trace time, and
+bakes whatever it observed into the compiled program. A Python side
+effect inside that cone — writing ``self.<attr>`` or a module global,
+reading the wall clock or the global RNG, bumping a telemetry counter
+— executes once per COMPILE instead of once per step: the counter
+undercounts forever, the timestamp freezes, the mutated cache holds a
+tracer object. These bugs are invisible at the call site because the
+impurity can live three frames below the traced closure.
+
+The rule therefore goes interprocedural (the mxflow layer):
+
+* **roots** — every function the runtime traces: the ``fn`` handed to
+  an ``executor._InstrumentedProgram(kind, fn, ...)`` build, the
+  grandfathered raw ``jax.jit(fn)`` component kernels, and
+  ``@jax.jit`` / ``@functools.partial(jax.jit, ...)`` decorated
+  kernels;
+* **reachability** — BFS over the call graph, ``call`` AND ``ref``
+  edges (a function passed as a value to ``jax.vjp``/
+  ``jax.checkpoint`` inside the cone is traced too). Dynamic calls
+  are NOT traversed (bounded: the chain in a finding is always a real
+  call path);
+* **facts** — the per-function effect summaries: nonlocal mutations,
+  wall-clock reads, global-RNG draws, telemetry calls.
+
+Findings anchor at the impure STATEMENT (the sink), with the trace
+chain from the root printed in the message; the baseline keys on the
+sink line only, so refactoring an intermediate caller never
+invalidates a grandfathered entry. Deliberately impure trace-time code
+(e.g. a build-time cache write that never runs under the tracer)
+carries a justified ``# mxlint: disable=trace-purity -- why``.
+"""
+import ast
+from collections import deque
+
+from ..core import Finding
+from .. import callgraph as cg
+from .jit_site import resolve_jit_target, partial_jit_target
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+_KIND_LABEL = {
+    "mutates": "mutates non-local state (%s)",
+    "reads-clock": "reads the wall clock (%s)",
+    "reads-rng": "draws from the global RNG (%s)",
+    "calls-telemetry": "calls telemetry (%s)",
+}
+
+
+def _module_scope_calls(tree):
+    """Call nodes that execute at module import time (not inside any
+    def — class bodies included, they run at import)."""
+    stack = [tree]
+    while stack:
+        n = stack.pop()
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, _FUNC_NODES):
+                continue
+            if isinstance(child, ast.Call):
+                yield child
+            stack.append(child)
+
+
+class TracePurityRule:
+    id = "trace-purity"
+
+    def _roots(self, project, graph):
+        """[(FuncInfo, root description, registration file)] — every
+        function whose body the runtime traces into a compiled
+        program. The registration file (where the ``jax.jit`` /
+        ``_InstrumentedProgram`` call lives) can differ from the root
+        function's own file; findings carry it in ``via`` so a
+        ``--changed`` run touching only the registration site still
+        surfaces the finding."""
+        roots = []
+
+        def resolve_arg(src, scope, arg):
+            if isinstance(arg, ast.Name):
+                got = graph.resolve_name(src, scope, arg.id)
+                if got is not None and got[0] == "func":
+                    return got[1]
+            elif isinstance(arg, ast.Attribute) \
+                    and isinstance(arg.value, ast.Name) \
+                    and arg.value.id in ("self", "cls") \
+                    and scope is not None \
+                    and scope.self_class is not None:
+                # jax.jit(self._kernel): the bound method is traced
+                return graph._lookup_method(scope.self_class, arg.attr)
+            return None
+
+        def scan_calls(src, scope, calls):
+            aliases = src.import_aliases()
+            for call in calls:
+                f = call.func
+                name = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else None)
+                target = None
+                if name == "_InstrumentedProgram" and len(call.args) >= 2:
+                    target = resolve_arg(src, scope, call.args[1])
+                elif resolve_jit_target(src, f, aliases) and call.args:
+                    target = resolve_arg(src, scope, call.args[0])
+                if target is not None:
+                    roots.append((target, "traced at %s:%d"
+                                  % (src.display, call.lineno),
+                                  src.display))
+
+        for src in project.sources:
+            scan_calls(src, None, _module_scope_calls(src.tree))
+        for fi in graph.functions:
+            src = fi.src
+            aliases = src.import_aliases()
+            scan_calls(src, fi,
+                       (n for n in cg._walk_same_scope(fi.node)
+                        if isinstance(n, ast.Call)))
+            # decorator forms: the decorated function itself is traced
+            for dec in fi.node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if resolve_jit_target(src, target, aliases) or (
+                        isinstance(dec, ast.Call)
+                        and partial_jit_target(src, dec, aliases)):
+                    roots.append((fi, "jit-decorated at %s:%d"
+                                  % (src.display, dec.lineno),
+                                  src.display))
+        return roots
+
+    def check_project(self, project):
+        graph = project.callgraph()
+        summ = project.summaries()
+        roots = self._roots(project, graph)
+        if not roots:
+            return []
+
+        # BFS over call+ref edges from every root; first reacher wins
+        # (shortest chains, SCC-safe)
+        pred = {}                        # fi -> (parent fi, call line)
+        origin = {}                      # fi -> (root fi, desc, reg file)
+        queue = deque()
+        for fi, desc, reg in roots:
+            if fi not in origin:
+                origin[fi] = (fi, desc, reg)
+                pred[fi] = None
+                queue.append(fi)
+        while queue:
+            f = queue.popleft()
+            for callee, line, _col in graph.callees(
+                    f, kinds=(cg.CALL, cg.REF)):
+                if callee in origin:
+                    continue
+                # a justified disable ON THE CALL LINE cuts traversal:
+                # "this call does not happen under the tracer" (e.g. a
+                # runtime isinstance-Tracer guard) silences the whole
+                # subtree with ONE annotation at the guard site
+                if f.src.suppressed(self.id, line) is not None:
+                    continue
+                origin[callee] = origin[f]
+                pred[callee] = (f, line)
+                queue.append(callee)
+
+        findings = []
+        seen = set()
+        for fi in origin:
+            facts = summ.facts_of(fi)
+            for kind, line, desc in facts.impure_facts():
+                key = (fi.src.display, line, kind)
+                if key in seen:
+                    continue
+                seen.add(key)
+                root_fi, root_desc, reg_file = origin[fi]
+                chain = self._chain_text(fi, pred, root_fi)
+                # the registration file is part of the witness: a
+                # --changed run touching only the jit/build call site
+                # must still see this finding
+                via = {reg_file}
+                cur = fi
+                while True:
+                    via.add(cur.src.display)
+                    nxt = pred.get(cur)
+                    if nxt is None:
+                        break
+                    cur = nxt[0]
+                findings.append(Finding(
+                    self.id, fi.src.display, line, 0,
+                    "'%s' %s inside the trace cone of '%s' (%s)%s — "
+                    "a side effect under jax tracing runs once per "
+                    "COMPILE, not once per step, freezing a stale "
+                    "value into every run of the compiled program; "
+                    "hoist it out of the traced function or thread "
+                    "the value through as an argument"
+                    % (fi.name, _KIND_LABEL[kind] % desc,
+                       root_fi.name, root_desc, chain),
+                    anchor=fi.src.anchor_for(line),
+                    via=sorted(via)))
+        findings.sort(key=lambda f: (f.path, f.line))
+        return findings
+
+    def _chain_text(self, fi, pred, root_fi):
+        hops = []
+        cur = fi
+        while pred.get(cur) is not None:
+            parent, line = pred[cur]
+            hops.append("%s -> %s (%s:%d)"
+                        % (parent.name, cur.name,
+                           parent.src.display, line))
+            cur = parent
+        if not hops:
+            return ""
+        hops.reverse()
+        return "; call chain: " + ", ".join(hops)

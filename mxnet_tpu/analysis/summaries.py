@@ -1,0 +1,863 @@
+"""mxflow's per-function effect summaries.
+
+Every interprocedural rule consumes the same two layers built here:
+
+**Direct facts** (:class:`FunctionFacts`) — one AST pass per file,
+node-free and keyed by qualname so they are CACHEABLE across runs in
+one process (``_FACTS_CACHE``, keyed on the file's display path + a
+content hash; ``cache_stats()`` reports hits/misses and the unit tests
+pin the behaviour). Per function:
+
+* blocking host syncs (``.asnumpy()`` / ``.wait_to_read()`` /
+  ``np.asarray`` over a non-literal) with line + form;
+* nonlocal mutations: stores to ``self.<attr>``, to subscripts/
+  attributes of non-local names, to ``global``/``nonlocal`` declared
+  names, and mutating method calls (``append``/``update``/...) on
+  nonlocal receivers;
+* wall-clock reads (``time.time``-family, ``datetime.now``), global
+  RNG draws (``random.*``, ``np.random.*``, ``uuid``/``secrets``) and
+  telemetry calls (anything resolving into ``mxnet_tpu.telemetry``) —
+  the trace-purity facts: each of these, executed under a trace,
+  freezes one stale value into every future run of the compiled
+  program;
+* locks acquired, every ``self.<attr>`` access with the lockset
+  lexically held at it, and the lockset held at every call site (the
+  RacerD-style lockset rule's raw material);
+* donation plumbing: literal ``donate_argnums`` jit calls, local
+  names bound to them, call-through-name sites, return-value flow.
+
+**Transitive layer** (:class:`Summaries`) — graph-dependent, computed
+per run over the :mod:`~.callgraph` with worklist/BFS fixpoints (so
+recursion/SCCs terminate and propagate correctly, callees before
+callers):
+
+* ``sync_witnesses(fn)`` — EVERY sync-bearing function reachable from
+  ``fn`` over ``call`` edges only (ref edges excluded: a callback
+  handed to the resolver pool blocks on its own thread, legally), each
+  with a shortest witness chain and ALL of its blocking-fetch sites —
+  enumerating every site means a justified disable on one sync line
+  never hides an unjustified sync on the next, and a fully-suppressed
+  near sink never hides a farther one;
+* ``donates_params(fn)`` — the param positions a function passes on
+  at a donated position of some donated program (directly or through
+  callees), which is what lets callers drop their manual
+  ``# mxlint: donates`` markers;
+* ``returns_donating(fn)`` — functions whose RETURN VALUE is a
+  donating program (``return jax.jit(..., donate_argnums=...)`` or a
+  callee that does), so ``fn = self._build_step(...); fn(w, s)`` is
+  recognized as a donating call with no marker;
+* ``donated_sites(fn)`` — every call site in ``fn`` with inferred
+  donated positions, in call-site positional terms (bound-method
+  shifts applied) — the donation rule's interprocedural feed.
+"""
+from __future__ import annotations
+
+import ast
+from collections import deque
+
+from . import callgraph as cg
+from .core import expr_text, resolve_origin
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+_BLOCKING_METHODS = {"asnumpy", "wait_to_read"}
+_HOST_LITERALS = (ast.List, ast.Tuple, ast.Dict, ast.Set, ast.ListComp,
+                  ast.SetComp, ast.DictComp, ast.GeneratorExp,
+                  ast.Constant)
+
+
+def classify_sync_call(node, np_names, asarray_names):
+    """The blocking form of an ``ast.Call`` — ``'.asnumpy()'`` /
+    ``'.wait_to_read()'`` / ``'np.asarray(...)'`` — or None.
+    ``np.asarray`` over an obvious host literal is exempt (building a
+    feed array from Python scalars is host work, not a device sync).
+    ONE classifier feeding both the direct host-sync rule and the
+    transitive facts, so a new blocking form can never be caught
+    per-file yet missed through a call chain, or vice versa."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _BLOCKING_METHODS:
+        return ".%s()" % f.attr
+    if ((isinstance(f, ast.Attribute) and f.attr == "asarray"
+         and isinstance(f.value, ast.Name) and f.value.id in np_names)
+            or (isinstance(f, ast.Name) and f.id in asarray_names)):
+        if not (node.args and isinstance(node.args[0], _HOST_LITERALS)):
+            return "np.asarray(...)"
+    return None
+
+_CLOCK_ORIGINS = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns", "time.process_time",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.date.today",
+}
+
+# methods that mutate their receiver in place
+_MUTATING_METHODS = {
+    "append", "appendleft", "extend", "add", "insert", "update",
+    "setdefault", "pop", "popitem", "popleft", "remove", "discard",
+    "clear", "sort", "reverse", "write",
+}
+
+_LOCK_FACTORIES = {"threading.Lock", "threading.RLock",
+                   "threading.Condition"}
+
+_JIT_ORIGINS = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit",
+                "jax.pmap"}
+
+TELEMETRY_MODULE = "mxnet_tpu.telemetry"
+
+
+def _is_rng_origin(origin):
+    parts = origin.split(".")
+    if parts[0] == "random" and len(parts) == 2 and parts[1][:1].islower():
+        return True
+    if origin.startswith("numpy.random.") and parts[-1][:1].islower():
+        return True
+    if origin in ("uuid.uuid1", "uuid.uuid4"):
+        return True
+    if parts[0] == "secrets" and len(parts) == 2:
+        return True
+    return False
+
+
+# dotted origin under the rich (absolute + relative) import map —
+# the ONE shared resolver from core
+_resolve = resolve_origin
+
+
+def _jit_donate_indices(call):
+    """Literal donate_argnums of a call, or None."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            out = []
+            for el in v.elts:
+                if not (isinstance(el, ast.Constant)
+                        and isinstance(el.value, int)):
+                    return None
+                out.append(el.value)
+            return tuple(out)
+        return None
+    return None
+
+
+class FunctionFacts:
+    """Direct, node-free effect facts of ONE function (see module
+    docstring). All locations are (line, col) in the defining file."""
+
+    __slots__ = (
+        "qualname", "params", "syncs", "mutations", "clock", "rng",
+        "telemetry", "locks", "accesses", "calls_held",
+        "jit_call_donates", "marker_donates", "calls_by_name",
+        "name_bindings", "call_args", "call_form", "call_recv",
+        "return_call_sites", "return_names", "local_jit_names",
+    )
+
+    def __init__(self, qualname, params):
+        self.qualname = qualname
+        self.params = params            # positional param names, in order
+        self.syncs = []                 # [(line, col, form)]
+        self.mutations = []             # [(line, desc)]
+        self.clock = []                 # [(line, origin)]
+        self.rng = []                   # [(line, origin)]
+        self.telemetry = []             # [(line, origin)]
+        self.locks = set()              # canonical lock texts acquired
+        self.accesses = []              # [(attr, line, col, is_store, held)]
+        self.calls_held = {}            # (line, col) -> frozenset(held)
+        self.jit_call_donates = {}      # (line, col) -> indices
+        self.marker_donates = {}        # (line, col) -> indices
+        self.calls_by_name = {}         # (line, col) -> local callee name
+        self.name_bindings = {}         # name -> set of binding (line, col)
+        self.call_args = {}             # (line, col) -> tuple of descriptors
+        self.call_form = {}             # (line, col) -> "name" | "attr"
+        self.call_recv = {}             # (line, col) -> dotted receiver
+        self.return_call_sites = set()  # (line, col) of returned calls
+        self.return_names = set()       # names returned directly
+        self.local_jit_names = {}       # name -> donate indices
+
+    def impure_facts(self):
+        """[(kind, line, desc)] of everything trace-purity cares
+        about, in line order."""
+        out = [("mutates", ln, d) for ln, d in self.mutations]
+        out += [("reads-clock", ln, "%s()" % o) for ln, o in self.clock]
+        out += [("reads-rng", ln, "%s()" % o) for ln, o in self.rng]
+        out += [("calls-telemetry", ln, "%s()" % o)
+                for ln, o in self.telemetry]
+        out.sort(key=lambda t: t[1])
+        return out
+
+
+class _FileFacts:
+    __slots__ = ("functions", "canonical", "known_locks")
+
+    def __init__(self):
+        self.functions = {}             # (qualname, lineno) -> FunctionFacts
+        self.canonical = {}             # lock alias text -> canonical
+        self.known_locks = set()
+
+
+class _FactsWalker(ast.NodeVisitor):
+    """One pass over a file, attributing effect facts to the INNERMOST
+    enclosing function (nested defs own their bodies; their decorators
+    and defaults evaluate in the enclosing scope)."""
+
+    def __init__(self, src, amap, out):
+        self.src = src
+        self.amap = amap
+        self.out = out
+        self.scope_names = []
+        self.stack = []                 # FunctionFacts of enclosing defs
+        self.withs = []                 # canonical lock texts held
+        self.np_names = {n for n, o in amap.items() if o == "numpy"}
+        self.asarray_names = {n for n, o in amap.items()
+                              if o == "numpy.asarray"}
+        # per-function bookkeeping resolved at pop time
+        self._local_names = []          # stack of sets
+        self._declared_global = []      # stack of sets
+        self._pending = []              # stack of provisional mutations
+
+    # -- scope management ---------------------------------------------------
+    def visit_ClassDef(self, node):
+        for dec in node.decorator_list:
+            self.visit(dec)
+        self.scope_names.append(node.name)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.scope_names.pop()
+
+    def _visit_func(self, node):
+        for dec in node.decorator_list:
+            self.visit(dec)
+        for d in node.args.defaults:
+            self.visit(d)
+        for d in node.args.kw_defaults:
+            if d is not None:
+                self.visit(d)
+        self._note_local(node.name)     # the def binds its name here
+        qual = ".".join(self.scope_names + [node.name])
+        a = node.args
+        params = [x.arg for x in
+                  list(getattr(a, "posonlyargs", [])) + list(a.args)]
+        facts = FunctionFacts(qual, params)
+        local_names = set(params)
+        local_names.update(x.arg for x in a.kwonlyargs)
+        if a.vararg:
+            local_names.add(a.vararg.arg)
+        if a.kwarg:
+            local_names.add(a.kwarg.arg)
+        # keyed by (qualname, line): same-named defs (if/else variants,
+        # property getter/setter pairs) must not alias the LAST def's
+        # facts — an effect in an earlier variant would silently vanish
+        self.out.functions[(qual, node.lineno)] = facts
+        self.scope_names.append(node.name)
+        self.stack.append(facts)
+        self._local_names.append(local_names)
+        self._declared_global.append(set())
+        self._pending.append([])
+        held, self.withs = self.withs, []         # body runs later
+        for stmt in node.body:
+            self.visit(stmt)
+        self.withs = held
+        # resolve provisional (locality-dependent) mutations now that
+        # every local binding in the body has been seen
+        locals_ = self._local_names.pop()
+        declared = self._declared_global.pop()
+        for name, line, desc in self._pending.pop():
+            if name is None or name not in locals_ or name in declared:
+                facts.mutations.append((line, desc))
+        self.stack.pop()
+        self.scope_names.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Lambda(self, node):
+        # lambda bodies are opaque to the facts layer (no qualname);
+        # visit for completeness in the ENCLOSING context minus locks
+        held, self.withs = self.withs, []
+        self.generic_visit(node)
+        self.withs = held
+
+    # -- locks --------------------------------------------------------------
+    def visit_With(self, node):
+        held = []
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+            text = expr_text(item.context_expr)
+            canon = self.out.canonical.get(text, text)
+            held.append(canon)
+            if self.stack and canon in self.out.known_locks:
+                self.stack[-1].locks.add(canon)
+        self.withs.extend(held)
+        for stmt in node.body:
+            self.visit(stmt)
+        del self.withs[len(self.withs) - len(held):]
+
+    visit_AsyncWith = visit_With
+
+    # -- name/attr bookkeeping ----------------------------------------------
+    def visit_Global(self, node):
+        if self._declared_global:
+            self._declared_global[-1].update(node.names)
+
+    visit_Nonlocal = visit_Global
+
+    def _note_local(self, name):
+        if self._local_names:
+            self._local_names[-1].add(name)
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self._note_local(node.id)
+            # a plain store only mutates shared state when the name is
+            # declared global/nonlocal — decided at function pop
+            if self.stack and isinstance(node.ctx, ast.Store):
+                self._maybe_global_store(node)
+
+    def _maybe_global_store(self, node):
+        # ONLY the innermost frame: a `global`/`nonlocal` declaration
+        # does not inherit into nested defs — a nested function's plain
+        # store to the same name is a fresh local (this matches the
+        # pop-time pending resolution, which also uses one frame)
+        if self._declared_global \
+                and node.id in self._declared_global[-1]:
+            self.stack[-1].mutations.append(
+                (node.lineno, "writes global '%s'" % node.id))
+
+    def _in_constructor(self):
+        # writes to self.<attr> inside a constructor build the object
+        # being born — owned, happens-before publication, not a shared
+        # mutation (the lock rules make the same exemption)
+        return self.stack and self.stack[-1].qualname.rsplit(
+            ".", 1)[-1] in ("__init__", "__new__", "__setstate__")
+
+    def visit_Attribute(self, node):
+        if isinstance(node.value, ast.Name) and node.value.id == "self":
+            if self.stack:
+                self.stack[-1].accesses.append(
+                    (node.attr, node.lineno, node.col_offset,
+                     isinstance(node.ctx, (ast.Store, ast.Del)),
+                     frozenset(self.withs)))
+            if self.stack and isinstance(node.ctx, (ast.Store, ast.Del)) \
+                    and not self._in_constructor():
+                self.stack[-1].mutations.append(
+                    (node.lineno, "writes self.%s" % node.attr))
+        self.visit(node.value)
+
+    def _mutation_base(self, node):
+        """(root-name-to-check-or-None, description) when storing
+        through ``node`` can mutate non-local state — None root means
+        unconditional (rooted at self); (False, None) means local."""
+        if isinstance(node, ast.Attribute):
+            base, what = node, expr_text(node)
+        elif isinstance(node, ast.Subscript):
+            base, what = node.value, "%s[...]" % expr_text(node.value)
+        else:
+            return (False, None)
+        if _rooted_at_self(base):
+            return (None, "writes %s" % what)
+        root = node_root_name(base)
+        if root:
+            return (root, "writes %s" % what)
+        return (False, None)
+
+    def visit_Assign(self, node):
+        self._handle_store_targets(node.targets, node)
+        self._track_bindings(node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._handle_store_targets([node.target], node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        self._handle_store_targets([node.target], node)
+        self.generic_visit(node)
+
+    def _handle_store_targets(self, targets, node):
+        if not self.stack:
+            return
+        for t in targets:
+            for el in _flatten_targets(t):
+                if isinstance(el, (ast.Attribute, ast.Subscript)) \
+                        and not (isinstance(el, ast.Attribute)
+                                 and isinstance(el.value, ast.Name)
+                                 and el.value.id == "self"):
+                    name, desc = self._mutation_base(el)
+                    if desc is None:
+                        continue
+                    if name is None:
+                        if not self._in_constructor():
+                            self.stack[-1].mutations.append(
+                                (node.lineno, desc))
+                    else:
+                        self._pending[-1].append(
+                            (name, node.lineno, desc))
+                # a subscript store through a direct self.<attr> is a
+                # WRITE of that attribute for lockset purposes
+                if isinstance(el, ast.Subscript) \
+                        and isinstance(el.value, ast.Attribute) \
+                        and isinstance(el.value.value, ast.Name) \
+                        and el.value.value.id == "self":
+                    self.stack[-1].accesses.append(
+                        (el.value.attr, el.lineno, el.col_offset, True,
+                         frozenset(self.withs)))
+
+    def _track_bindings(self, node):
+        """``name = <call>`` bookkeeping for donation/return flow."""
+        if not self.stack or len(node.targets) != 1 \
+                or not isinstance(node.targets[0], ast.Name):
+            return
+        facts = self.stack[-1]
+        name = node.targets[0].id
+        v = node.value
+        if isinstance(v, ast.Call):
+            key = (v.lineno, v.col_offset)
+            facts.name_bindings.setdefault(name, set()).add(key)
+            root = v.func
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and any(
+                    root.id in frame for frame in self._local_names):
+                return          # local shadowing jax etc.: not a jit
+            origin = _resolve(v.func, self.amap)
+            if origin in _JIT_ORIGINS:
+                idx = _jit_donate_indices(v)
+                if idx:
+                    facts.local_jit_names[name] = idx
+
+    def visit_Return(self, node):
+        if self.stack and node.value is not None:
+            facts = self.stack[-1]
+            if isinstance(node.value, ast.Call):
+                facts.return_call_sites.add(
+                    (node.value.lineno, node.value.col_offset))
+            elif isinstance(node.value, ast.Name):
+                facts.return_names.add(node.value.id)
+        self.generic_visit(node)
+
+    # -- calls --------------------------------------------------------------
+    def visit_Call(self, node):
+        if self.stack:
+            self._classify_call(node)
+        self.generic_visit(node)
+
+    def _classify_call(self, node):
+        facts = self.stack[-1]
+        key = (node.lineno, node.col_offset)
+        facts.calls_held[key] = frozenset(self.withs)
+        f = node.func
+        # arg descriptors (donation inference)
+        descs = []
+        for a in node.args:
+            if isinstance(a, ast.Name):
+                descs.append(("name", a.id))
+            elif isinstance(a, ast.Attribute) \
+                    and isinstance(a.value, ast.Name) \
+                    and a.value.id == "self":
+                descs.append(("attr", a.attr))
+            else:
+                descs.append(None)
+        facts.call_args[key] = tuple(descs)
+        facts.call_form[key] = "attr" if isinstance(f, ast.Attribute) \
+            else "name"
+        if isinstance(f, ast.Attribute):
+            # receiver chain of an attr call, RAW dotted text — the
+            # transitive layer resolves it to tell an unbound
+            # Base.update(self, w) delegation (no binding consumed)
+            # from a bound obj.update(w) call. A receiver rooted at a
+            # local name, self or cls is a runtime object: not stored
+            r, root = f.value, f.value
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) \
+                    and root.id not in ("self", "cls") \
+                    and not any(root.id in frame
+                                for frame in self._local_names):
+                recv = resolve_origin(r, {})
+                if recv:
+                    facts.call_recv[key] = recv
+        if isinstance(f, ast.Name):
+            facts.calls_by_name[key] = f.id
+
+        marker = self.src.donates.get(node.lineno)
+        if marker:
+            facts.marker_donates[key] = marker
+
+        # a call rooted at a LOCAL binding (param, assignment, loop
+        # var — including one from an enclosing function) is a call on
+        # some runtime object, not on the shadowed module: classifying
+        # it as a global effect fabricates impurity on correct code
+        # (e.g. `def helper(random): random.random()`); same class as
+        # the callgraph's resolve-through-a-local fix
+        root = f
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        root_shadowed = isinstance(root, ast.Name) and any(
+            root.id in frame for frame in self._local_names)
+
+        # blocking host syncs (the host-sync rule's direct facts);
+        # the method forms (.asnumpy() on any receiver) stay — the
+        # receiver is SUPPOSED to be a local — only the np.asarray
+        # name-based form is shadow-sensitive
+        form = classify_sync_call(
+            node,
+            frozenset() if root_shadowed else self.np_names,
+            frozenset() if root_shadowed else self.asarray_names)
+        if form is not None:
+            facts.syncs.append((node.lineno, node.col_offset, form))
+
+        origin = None if root_shadowed else _resolve(f, self.amap)
+        if origin:
+            if origin in _JIT_ORIGINS:
+                idx = _jit_donate_indices(node)
+                if idx:
+                    facts.jit_call_donates[key] = idx
+            if origin in _CLOCK_ORIGINS:
+                facts.clock.append((node.lineno, origin))
+            elif _is_rng_origin(origin):
+                facts.rng.append((node.lineno, origin))
+            elif origin == TELEMETRY_MODULE \
+                    or origin.startswith(TELEMETRY_MODULE + "."):
+                facts.telemetry.append((node.lineno, origin))
+
+        # mutating method calls on non-local receivers
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATING_METHODS:
+            recv = f.value
+            if _rooted_at_self(recv):
+                if not self._in_constructor():
+                    facts.mutations.append(
+                        (node.lineno, "calls %s.%s()" % (expr_text(recv),
+                                                         f.attr)))
+                # a mutating method on a direct self.<attr> receiver
+                # is a WRITE of that attribute for lockset purposes
+                if isinstance(recv, ast.Attribute) \
+                        and isinstance(recv.value, ast.Name) \
+                        and recv.value.id == "self":
+                    facts.accesses.append(
+                        (recv.attr, recv.lineno, recv.col_offset, True,
+                         frozenset(self.withs)))
+            else:
+                root = node_root_name(recv)
+                if root:
+                    self._pending[-1].append(
+                        (root, node.lineno,
+                         "calls %s.%s()" % (expr_text(recv), f.attr)))
+
+
+def _flatten_targets(t):
+    if isinstance(t, (ast.Tuple, ast.List)):
+        for el in t.elts:
+            for x in _flatten_targets(el):
+                yield x
+    elif isinstance(t, ast.Starred):
+        for x in _flatten_targets(t.value):
+            yield x
+    else:
+        yield t
+
+
+def _rooted_at_self(node):
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return isinstance(node, ast.Name) and node.id == "self"
+
+
+def node_root_name(node):
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _scan_locks(src, amap, out):
+    """Known locks + Condition aliasing for a file (the lock-
+    discipline rule keeps its own copy of this logic; this one feeds
+    lockset inference and the lock-acquired summary)."""
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.value, ast.Call)):
+            continue
+        origin = _resolve(node.value.func, amap)
+        if origin not in _LOCK_FACTORIES:
+            continue
+        target = node.targets[0]
+        if isinstance(target, ast.Name) or (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"):
+            text = expr_text(target)
+            out.known_locks.add(text)
+            out.canonical.setdefault(text, text)
+            if origin.endswith("Condition") and node.value.args:
+                inner = expr_text(node.value.args[0])
+                if inner:
+                    out.canonical[text] = inner
+                    out.known_locks.add(inner)
+
+
+# {(display, text hash): _FileFacts}; the hit/miss counters back the
+# summary-cache unit tests and the JSON report's cache stats
+_FACTS_CACHE = {}
+_CACHE_STATS = {"hits": 0, "misses": 0}
+_FACTS_CACHE_MAX = 4096
+
+
+def file_facts(src):
+    key = (src.display, hash(src.text))
+    got = _FACTS_CACHE.get(key)
+    if got is not None:
+        _CACHE_STATS["hits"] += 1
+        return got
+    _CACHE_STATS["misses"] += 1
+    amap = cg._import_map(src)
+    out = _FileFacts()
+    _scan_locks(src, amap, out)
+    _FactsWalker(src, amap, out).visit(src.tree)
+    if len(_FACTS_CACHE) >= _FACTS_CACHE_MAX:
+        _FACTS_CACHE.clear()
+    _FACTS_CACHE[key] = out
+    return out
+
+
+def cache_stats():
+    return dict(_CACHE_STATS, entries=len(_FACTS_CACHE))
+
+
+class Summaries:
+    """The transitive layer over one Project + CallGraph."""
+
+    def __init__(self, project, graph):
+        self.project = project
+        self.graph = graph
+        self._file_facts = {}           # src -> _FileFacts
+        self._facts = {}                # FuncInfo -> FunctionFacts
+        self._empty = FunctionFacts("<unknown>", [])
+        for src in project.sources:
+            self._file_facts[src] = file_facts(src)
+        for fi in graph.functions:
+            ff = self._file_facts[fi.src].functions.get(
+                (fi.qualname, fi.node.lineno))
+            self._facts[fi] = ff if ff is not None else self._empty
+        self._sync_wit = {}             # FuncInfo -> witness list
+        self._donates = None            # FuncInfo -> set(param idx)
+        self._returns_donating = None   # FuncInfo -> indices or None
+        self._donated_sites = None      # FuncInfo -> {(line,col): indices}
+        self._edge_sites = {}           # FuncInfo -> {(line,col): callee}
+
+    def facts_of(self, fi):
+        return self._facts.get(fi, self._empty)
+
+    def file_locks(self, src):
+        ff = self._file_facts.get(src)
+        return (ff.known_locks, ff.canonical) if ff is not None \
+            else (set(), {})
+
+    # -- transitive host-sync -----------------------------------------------
+    def sync_witnesses(self, fi):
+        """Every sync-bearing function reachable from ``fi`` over
+        ``call`` edges (``fi`` itself included), each with a shortest
+        witness chain and ALL of its sync sites:
+        ``[(chain, sink_fi, [(sink_line, form), ...]), ...]`` where
+        chain is [(callee FuncInfo, call line in the CALLER's file),
+        ...] from ``fi`` down to the sink (empty chain = ``fi`` is the
+        sink). Enumerating every reachable sink and every site — not
+        just the nearest sink's first sync — is what keeps one
+        justified disable from hiding a different, unjustified
+        blocking fetch behind it. Forward BFS, SCC-safe, memoized per
+        entry (hot entry points are few, the graph is small)."""
+        cached = self._sync_wit.get(fi)
+        if cached is not None:
+            return cached
+        pred = {fi: None}               # BFS tree: shortest chains
+        order = [fi]
+        queue = deque([fi])
+        while queue:
+            f = queue.popleft()
+            for callee, line, _col in self.graph.callees(
+                    f, kinds=(cg.CALL,)):
+                if callee in pred:
+                    continue
+                pred[callee] = (f, line)
+                order.append(callee)
+                queue.append(callee)
+        out = []
+        for f in order:
+            syncs = self.facts_of(f).syncs
+            if not syncs:
+                continue
+            chain = []
+            cur = f
+            while pred[cur] is not None:
+                parent, line = pred[cur]
+                chain.append((cur, line))
+                cur = parent
+            chain.reverse()
+            out.append((chain, f,
+                        [(line, form) for line, _col, form in syncs]))
+        self._sync_wit[fi] = out
+        return out
+
+    # -- donation fixpoints --------------------------------------------------
+    def _edges_of(self, fi):
+        m = self._edge_sites.get(fi)
+        if m is None:
+            m = {(line, col): callee for callee, line, col
+                 in self.graph.callees(fi, kinds=(cg.CALL,))}
+            self._edge_sites[fi] = m
+        return m
+
+    def _site_indices(self, fi):
+        """Donated positions per call site in ``fi``, in CALL-SITE
+        positional terms, under the current donates/returns state.
+
+        NOTE: jit_call_donates sites are the PROGRAM CONSTRUCTIONS
+        (``jax.jit(fn, donate_argnums=...)``) — the construction call
+        does not donate its own args, so it never seeds this map; it
+        feeds local_jit_names / returns-donating instead."""
+        facts = self.facts_of(fi)
+        out = dict(facts.marker_donates)
+        edges = self._edges_of(fi)
+        # calls through a local name bound to a donating program
+        for key, name in facts.calls_by_name.items():
+            if key in out:
+                continue
+            idx = facts.local_jit_names.get(name)
+            if idx is None:
+                for bind in facts.name_bindings.get(name, ()):
+                    callee = edges.get(bind)
+                    if callee is not None \
+                            and self._returns_donating.get(callee):
+                        idx = self._returns_donating[callee]
+                        break
+            if idx:
+                out[key] = idx
+        # calls resolving to an in-scan callee that donates its params
+        for key, callee in edges.items():
+            if key in out:
+                continue
+            d = self._donates.get(callee)
+            if not d:
+                continue
+            facts_form = facts.call_form.get(key)
+            # bound-method shift: self is consumed by the binding at an
+            # attribute call site — but NOT for @staticmethod, whose
+            # params line up with the call args as written, and NOT
+            # for an unbound Base.update(self, w) delegation, where
+            # self is passed explicitly as arg 0
+            shift = 1 if (callee.self_class is not None
+                          and not callee.is_static
+                          and facts_form == "attr"
+                          and not self._class_receiver(fi, key)) else 0
+            idx = tuple(sorted(i - shift for i in d if i - shift >= 0))
+            if idx:
+                out[key] = idx
+        return out
+
+    def _class_receiver(self, fi, key):
+        """True when the attr call at ``key`` in ``fi`` has a CLASS as
+        its receiver (``Base.update(self, w)`` super-delegation): the
+        method is unbound, no argument is consumed by a binding."""
+        recv = self.facts_of(fi).call_recv.get(key)
+        if not recv:
+            return False
+        if "." in recv:
+            head, rest = recv.split(".", 1)
+            origin = self.graph.imports_of(fi.src).get(head, head)
+            got = self.graph.resolve_dotted("%s.%s" % (origin, rest))
+        else:
+            got = self.graph.resolve_name(fi.src, fi, recv)
+        return got is not None and got[0] == "class"
+
+    def _recompute_donation(self, fi):
+        """(param donations, returns-donating) of one function under
+        the current state."""
+        facts = self.facts_of(fi)
+        edges = self._edges_of(fi)
+        params = set()
+        for key, idx in self._site_indices(fi).items():
+            descs = facts.call_args.get(key, ())
+            for i in idx:
+                if i < len(descs) and descs[i] \
+                        and descs[i][0] == "name" \
+                        and descs[i][1] in facts.params:
+                    params.add(facts.params.index(descs[i][1]))
+        ret = None
+        for key in facts.return_call_sites:
+            if key in facts.jit_call_donates:
+                ret = facts.jit_call_donates[key]
+                break
+            callee = edges.get(key)
+            if callee is not None and self._returns_donating.get(callee):
+                ret = self._returns_donating[callee]
+                break
+        if ret is None:
+            for name in facts.return_names:
+                if name in facts.local_jit_names:
+                    ret = facts.local_jit_names[name]
+                    break
+                for bind in facts.name_bindings.get(name, ()):
+                    callee = edges.get(bind)
+                    if callee is not None \
+                            and self._returns_donating.get(callee):
+                        ret = self._returns_donating[callee]
+                        break
+                if ret:
+                    break
+        return params, ret
+
+    def _ensure_donation(self):
+        if self._donates is not None:
+            return
+        graph = self.graph
+        self._edge_sites = {}
+        self._donates = {}
+        self._returns_donating = {}
+        # worklist fixpoint: one pass over everything seeds the direct
+        # facts; a change to a function's summary re-enqueues only its
+        # CALLERS (donates/returns grow monotonically, so SCCs and
+        # recursion converge)
+        pending = deque(graph.functions)
+        queued = set(graph.functions)
+        while pending:
+            fi = pending.popleft()
+            queued.discard(fi)
+            params, ret = self._recompute_donation(fi)
+            changed = False
+            if params != self._donates.get(fi, set()):
+                self._donates[fi] = params
+                changed = True
+            if ret and not self._returns_donating.get(fi):
+                self._returns_donating[fi] = ret
+                changed = True
+            if changed:
+                for caller, _l, _c in graph.callers(fi,
+                                                    kinds=(cg.CALL,)):
+                    if caller not in queued:
+                        pending.append(caller)
+                        queued.add(caller)
+        self._donated_sites = {}
+
+    def donates_params(self, fi):
+        self._ensure_donation()
+        return tuple(sorted(self._donates.get(fi, ())))
+
+    def returns_donating(self, fi):
+        self._ensure_donation()
+        return self._returns_donating.get(fi)
+
+    def donated_sites(self, fi):
+        """{(line, col): donated positions} for every call site in
+        ``fi`` the analyzer can prove donating — the donation rule's
+        interprocedural feed (call-site positional terms). Memoized
+        per function after the fixpoint settles."""
+        self._ensure_donation()
+        got = self._donated_sites.get(fi)
+        if got is None:
+            got = self._donated_sites[fi] = self._site_indices(fi)
+        return got

@@ -581,7 +581,7 @@ def _device_transfer(v, src, dst):
             return (jax.device_put(g, src),)
 
         t.defvjp(t_fwd, t_bwd)
-        fn = _XFER_CACHE[key] = t
+        fn = _XFER_CACHE[key] = t   # mxlint: disable=trace-purity -- idempotent memoization of a per-(src,dst) transfer callable; the value is trace-independent
     return fn(v)
 
 

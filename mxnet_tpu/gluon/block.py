@@ -387,12 +387,12 @@ class _CachedOp:
                 for p, orig in zip(params, originals):
                     p._data._set_data(orig)
             if isinstance(out, (list, tuple)):
-                single_holder[0] = False
+                single_holder[0] = False   # mxlint: disable=trace-purity -- trace-time capture by design: populated once by the eval_shape probe below; holds a host bool, not a tracer
                 outs = list(out)
             else:
                 outs = [out]
-            aux_targets.clear()
-            aux_targets.extend(t for t, _ in collector)
+            aux_targets.clear()   # mxlint: disable=trace-purity -- trace-time capture by design: refreshed per trace so retraces stay consistent; holds graph targets, not tracers
+            aux_targets.extend(t for t, _ in collector)   # mxlint: disable=trace-purity -- trace-time capture by design: refreshed per trace so retraces stay consistent; holds graph targets, not tracers
             return tuple(o._data for o in outs), tuple(v for _, v in collector)
 
         fwd = jax.jit(run_block)

@@ -385,7 +385,7 @@ class BaseModule:
                     monitor.toc_print()
                 if divergence_check_every > 0 \
                         and (nbatch + 1) % divergence_check_every == 0 \
-                        and not self.finite_check():
+                        and not self.finite_check():   # mxlint: disable=host-sync -- opt-in divergence sentinel: the user asked for a blocking verdict once per divergence_check_every batches
                     self._handle_divergence(divergence_policy, ckpt,
                                             epoch, nbatch)
                 if batch_end_callback is not None:

@@ -57,13 +57,19 @@ class NDArray:
         # skip __init__ and alias the parent, so they are not charged;
         # wrappers sharing one buffer (detach) each count — the ledger
         # is the FRAMEWORK's upper-bound view, reconciled against PJRT
-        # by Storage.ledger_report().
-        if telemetry.enabled():
+        # by Storage.ledger_report(). Traced (abstract) payloads are
+        # SKIPPED: wrappers built under a jax trace (the gluon
+        # run_block path) would otherwise charge one phantom buffer
+        # per COMPILE — sized from the tracer's aval — and pin a
+        # finalizer on the tracer (found by mxlint trace-purity).
+        # mxlint: disable=trace-purity -- tracer-guarded: traced payloads take the early exit, nothing below runs under the tracer
+        if telemetry.enabled() and not isinstance(data, jax.core.Tracer):
             try:
                 nbytes = int(data.size) * data.dtype.itemsize
                 shape, dtype = data.shape, data.dtype
             except AttributeError:
                 nbytes, shape, dtype = 0, None, None
+            # mxlint: disable=trace-purity -- tracer-guarded above; also cuts the trace cone out of the ledger internals
             telemetry.ledger_track(self, str(self._ctx), nbytes,
                                    shape=shape, dtype=dtype)
 

@@ -130,7 +130,7 @@ def take_rng():
     if _STATE.rng_provider is not None:
         return _STATE.rng_provider()
     from .. import random as _random
-    return _random.take_key()
+    return _random.take_key()   # mxlint: disable=trace-purity -- eager-only: a traced graph installs rng_provider (rng_scope) and returns above
 
 
 class rng_scope:

@@ -871,7 +871,11 @@ class FusedUpdater(Updater):
         raw_states = [tuple(x._data for x in tup) for tup in packed]
         from .executor import record_dispatch
         record_dispatch("opt_update")
-        new_ws, new_states = fn(raw_ws, raw_states, raw_gs, lrs, wds, ts)   # mxlint: donates 0,1
+        # donated positions (0, 1) are INFERRED: fn comes from
+        # _build_step, whose returned program declares donate_argnums —
+        # mxflow's returns-donating summary tracks it through the
+        # cache-or-build binding, no manual marker needed
+        new_ws, new_states = fn(raw_ws, raw_states, raw_gs, lrs, wds, ts)
 
         for w, tup, nw, ntup in zip(weights, packed, new_ws, new_states):
             w._set_data(nw)

@@ -1,0 +1,37 @@
+"""Seeded interprocedural donation violations, NO ``# mxlint:
+donates`` markers anywhere: a wrapper that passes its params on at
+donated positions (callers inherit the donation), and a factory that
+RETURNS a donating program (calls through the bound name donate).
+Four findings expected."""
+import jax
+
+
+def fused_step(fn, w, s, batch):
+    step = jax.jit(fn, donate_argnums=(0, 1))
+    return step(w, s, batch)
+
+
+def train(fn, weights, states, batches):
+    for b in batches:
+        out = fused_step(fn, weights, states, b)    # VIOLATIONS 1+2: loop never rebinds either donated arg
+    return out
+
+
+def train_once(fn, weights, states, batch):
+    out = fused_step(fn, weights, states, batch)
+    norm = sum(weights.values())        # VIOLATION 3: use after donation
+    return out, norm
+
+
+def _update(w):
+    return w
+
+
+def make_updater():
+    return jax.jit(_update, donate_argnums=(0,))
+
+
+def apply_update(weights):
+    upd = make_updater()
+    upd(weights)
+    return weights                      # VIOLATION 4: dead after donation

@@ -1,0 +1,20 @@
+"""Compliant twin: the hot loop stays async. The blocking resolver is
+handed to the pool as a VALUE (a ref edge — it blocks on the pool's
+thread, legally, so it is not traversed), and the epoch-boundary fetch
+is not reachable from the hot function at all. Zero findings."""
+
+
+def hot_loop(batches, program, pool):   # mxlint: hot
+    outs = []
+    for b in batches:
+        outs.append(program(b))
+        pool.submit(resolve_one, outs[-1])
+    return outs
+
+
+def resolve_one(out):
+    return out.asnumpy()        # legal: runs on the resolver thread
+
+
+def epoch_end(outs):
+    return [o.asnumpy() for o in outs]   # legal: epoch boundary

@@ -1,0 +1,32 @@
+"""Seeded transitive host-sync violations: a hot loop reaching
+blocking fetches through a 3-deep call chain and through a mutually
+recursive (SCC) pair. The dynamic call through ``cb`` is NOT traversed
+(bounded). Two findings expected, both anchored at the SINK lines."""
+
+
+def hot_loop(batches, program, cb):   # mxlint: hot
+    for b in batches:
+        out = program(b)
+        log_metrics(out)
+        drain(out, 0)
+        cb(out)                 # dynamic: bounded, never traversed
+    return out
+
+
+def log_metrics(out):
+    summarize(out)
+
+
+def summarize(out):
+    return out.asnumpy()        # VIOLATION 1 (sink): 3-deep chain
+
+
+def drain(out, depth):
+    if depth > 3:
+        return fetch(out, depth)
+    return drain(out, depth + 1)
+
+
+def fetch(out, depth):
+    out.wait_to_read()          # VIOLATION 2 (sink): through the SCC
+    return drain(out, depth + 1)

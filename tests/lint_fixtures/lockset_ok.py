@@ -1,0 +1,30 @@
+"""Compliant twin: annotated attrs belong to lock-discipline (not
+re-flagged here), consistently-locked attrs are clean (a
+``threading.Condition`` alias counts as its lock), and init-once
+read-only config never trips the write requirement. Zero findings."""
+import threading
+
+
+class Stats:
+    def __init__(self, limit):
+        self._lock = threading.Lock()
+        self._space = threading.Condition(self._lock)
+        self._counts = {}       # guarded by: self._lock
+        self._total = 0
+        self.limit = limit      # init-once config, read-only after init
+
+    def add(self, key):
+        with self._lock:
+            self._counts[key] = self._counts.get(key, 0) + 1
+            self._total += 1
+
+    def wait_add(self, key):
+        with self._space:       # Condition over self._lock: counts
+            self._total += 1
+
+    def total(self):
+        with self._lock:
+            return self._total
+
+    def room_left(self):
+        return self.limit       # read-only config: no write, no race

@@ -1,0 +1,33 @@
+"""Seeded lockset violations: unannotated shared attributes written
+under ``self._lock`` on some paths — including through a private
+helper whose ENTRY lockset is inferred from its call sites — and
+accessed lock-free on others. Two findings expected, at the lock-free
+access lines, each proposing the ``# guarded by:`` annotation."""
+import threading
+
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts = {}
+        self._total = 0
+
+    def add(self, key):
+        with self._lock:
+            self._bump(key)
+
+    def add_many(self, keys):
+        with self._lock:
+            for k in keys:
+                self._bump(k)
+
+    def _bump(self, key):
+        # entry lockset {self._lock}: every call site holds it
+        self._counts[key] = self._counts.get(key, 0) + 1
+        self._total += 1
+
+    def peek(self, key):
+        return self._counts.get(key, 0)     # VIOLATION 1: lock-free read
+
+    def grand_total(self):
+        return self._total                  # VIOLATION 2: lock-free read

@@ -1,0 +1,28 @@
+"""Compliant twin: the trace cone stays pure — telemetry and clock
+reads live OUTSIDE the traced functions (at build time and around the
+program call), randomness enters as an explicit key argument, and the
+impure helper is only reachable from untraced code. Zero findings."""
+import time
+
+import jax
+
+from mxnet_tpu import telemetry
+
+
+def build(graph):
+    def step(args, key):
+        noise = jax.random.uniform(key)     # explicit key: pure
+        return scale(args, noise)
+    telemetry.counter_inc("fixture.builds")  # legal: build time, untraced
+    return _InstrumentedProgram("step", step)       # noqa: F821
+
+
+def scale(args, k):
+    return [a * k for a in args]
+
+
+def run_eager(prog, args, key):
+    t0 = time.time()                        # legal: untraced caller
+    out = prog(args, key)
+    telemetry.counter_inc("fixture.steps")  # legal: after the dispatch
+    return out, time.time() - t0

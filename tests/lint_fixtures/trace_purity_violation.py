@@ -1,0 +1,50 @@
+"""Seeded trace-purity violations: side effects inside the trace cone
+of an ``_InstrumentedProgram`` build and a ``@jax.jit`` kernel — one
+reached through a 3-deep call chain, one through a local-instance
+method call. Five findings expected, anchored at the impure lines."""
+import random
+import time
+
+import jax
+
+from mxnet_tpu import telemetry
+
+_STEP_COUNT = {}
+
+
+def build(graph):
+    def step(args):
+        return level1(graph, args)
+    return _InstrumentedProgram("step", step)       # noqa: F821
+
+
+def level1(graph, args):
+    return level2(graph, args)
+
+
+def level2(graph, args):
+    telemetry.counter_inc("fixture.step")   # VIOLATION 1: telemetry, 2 deep
+    return level3(args)
+
+
+def level3(args):
+    h = Holder()
+    h.bump(args)
+    _STEP_COUNT["n"] = len(args)            # VIOLATION 2: global, 3 deep
+    return args
+
+
+class Holder:
+    def __init__(self):
+        self.count = 0
+
+    def bump(self, x):
+        self.count += 1                     # VIOLATION 3: self mutation
+        return x
+
+
+@jax.jit
+def kernel(x):
+    stamp = time.time()                     # VIOLATION 4: wall clock
+    noise = random.random()                 # VIOLATION 5: global RNG
+    return x * noise + stamp

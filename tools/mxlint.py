@@ -9,6 +9,7 @@ Usage::
     python tools/mxlint.py --json out.json mxnet_tpu       # JSON report
     python tools/mxlint.py --rules jit-site mxnet_tpu      # one rule
     python tools/mxlint.py --update-baseline mxnet_tpu tools bench.py
+    python tools/mxlint.py --changed mxnet_tpu tools bench.py  # pre-commit
 
 Options:
     --rules a,b,...      run only these rule ids (default: all)
@@ -19,6 +20,27 @@ Options:
                          (stale entries pruned) and exit 0
     --json [PATH]        emit the JSON report to PATH (or stdout when no
                          PATH follows); the text report is skipped
+    --changed            lint only files touched vs the git merge-base
+                         PLUS their transitive reverse call-graph
+                         dependents (a changed callee changes its
+                         callers' effect summaries). Findings are
+                         filtered to the subset — keeping sinks whose
+                         witness chain crosses it — and stale-baseline
+                         hygiene is skipped. With a valid dep cache
+                         only the subset plus its import closure is
+                         PARSED (the fast pre-commit loop); otherwise
+                         the whole path set is parsed and the cache
+                         refreshed.
+    --changed-base REF   base ref for --changed (default: origin/main,
+                         falling back to main, then HEAD — on the
+                         default branch this means "what my working
+                         tree touches", the pre-commit loop)
+    --dep-cache PATH     dependency-skeleton cache written by full
+                         runs and consumed by --changed (default:
+                         .mxlint_depcache.json at the repo root;
+                         'none' disables). Purely an accelerator: a
+                         stale or absent cache falls back to the full
+                         parse, never to wrong results.
 
 Exit codes (stable; run_checks.sh and the tier-1 lane key on them):
     0  clean — no unsuppressed, non-baselined findings (stale-baseline
@@ -56,11 +78,55 @@ from mxnet_tpu.analysis import run, ALL_RULE_IDS          # noqa: E402
 from mxnet_tpu.analysis.core import Baseline              # noqa: E402
 
 DEFAULT_BASELINE = os.path.join(ROOT, "tools", "mxlint_baseline.json")
+DEFAULT_DEP_CACHE = os.path.join(ROOT, ".mxlint_depcache.json")
 
 
 def usage(msg):
     sys.stderr.write("mxlint: %s\n(see tools/mxlint.py --help)\n" % msg)
     return 2
+
+
+def _git(*args):
+    import subprocess
+    try:
+        proc = subprocess.run(["git"] + list(args), cwd=ROOT,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True,
+                              timeout=30)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return None, str(e)
+    if proc.returncode != 0:
+        return None, proc.stderr.strip()
+    return proc.stdout, None
+
+
+def changed_files(base_ref=None):
+    """Repo-relative .py paths touched vs the merge-base (committed,
+    staged, unstaged) plus untracked files, or (None, error)."""
+    base = None
+    for ref in ([base_ref] if base_ref else ["origin/main", "main"]):
+        out, _err = _git("merge-base", "HEAD", ref)
+        if out is not None:
+            base = out.strip()
+            break
+    if base is None and base_ref:
+        return None, "cannot resolve --changed-base %r" % base_ref
+    if base is None:
+        base = "HEAD"
+    # -z: NUL-separated, unquoted — a path with a space (or a name git
+    # would C-quote) must come back intact, not split into fragments
+    # that silently match nothing
+    out, err = _git("diff", "--name-only", "-z", base)
+    if out is None:
+        return None, "git diff failed: %s" % err
+    files = {f for f in out.split("\0") if f}
+    out, err = _git("ls-files", "--others", "--exclude-standard", "-z")
+    if out is not None:
+        files.update(f for f in out.split("\0") if f)
+    # deleted files stay in the set: a deleted callee changes its
+    # callers' effect summaries, and the dep cache's reverse map still
+    # knows who called it — the closure lints those callers
+    return sorted(f for f in files if f.endswith(".py")), None
 
 
 def main(argv):
@@ -70,6 +136,9 @@ def main(argv):
     update_baseline = False
     json_path = None
     want_json = False
+    changed = False
+    changed_base = None
+    dep_cache = DEFAULT_DEP_CACHE
 
     args = list(argv)
     while args:
@@ -94,6 +163,21 @@ def main(argv):
             continue
         if a == "--update-baseline":
             update_baseline = True
+            continue
+        if a == "--changed":
+            changed = True
+            continue
+        if a == "--changed-base":
+            if not args:
+                return usage("--changed-base needs a git ref")
+            changed_base = args.pop(0)
+            continue
+        if a == "--dep-cache":
+            if not args:
+                return usage("--dep-cache needs a path (or 'none')")
+            dep_cache = args.pop(0)
+            if dep_cache.lower() == "none":
+                dep_cache = None
             continue
         if a == "--json":
             want_json = True
@@ -130,6 +214,21 @@ def main(argv):
     if update_baseline and baseline is None:
         return usage("--update-baseline with '--baseline none' has no "
                      "file to write; give --baseline a path")
+    if changed and update_baseline:
+        return usage("--changed lints a partial view; refusing to "
+                     "rewrite the baseline from it")
+    if changed_base and not changed:
+        return usage("--changed-base only makes sense with --changed")
+
+    only = None
+    if changed:
+        only, err = changed_files(changed_base)
+        if only is None:
+            return usage(err)
+        if not only:
+            print("mxlint (--changed): no python files touched — "
+                  "nothing to lint")
+            return 0
 
     try:
         if update_baseline:
@@ -137,7 +236,7 @@ def main(argv):
             # unsuppressed finding lands in the fresh file, stale
             # entries implicitly pruned
             report = run(abs_paths, rules=rules, baseline=Baseline(),
-                         root=ROOT)
+                         root=ROOT, dep_cache=dep_cache)
             out_path = baseline
             doc = Baseline.render(report.findings)
             if rules:
@@ -156,12 +255,20 @@ def main(argv):
             print("mxlint: baseline %s rewritten with %d finding(s)"
                   % (os.path.relpath(out_path), len(report.findings)))
             return 0
-        report = run(abs_paths, rules=rules, baseline=baseline, root=ROOT)
+        report = run(abs_paths, rules=rules, baseline=baseline, root=ROOT,
+                     only=only, expand_dependents=changed,
+                     dep_cache=dep_cache)
     except ValueError as e:          # unknown rule id
         return usage(str(e))
     except FileNotFoundError as e:
         return usage("no such path: %s" % e)
 
+    if changed and not want_json:
+        print("mxlint (--changed): %d touched file(s), %d linted with "
+              "reverse call-graph dependents (dep cache %s: %d file(s) "
+              "parsed)"
+              % (len(only), len(report.subset or []),
+                 report.dep_cache or "off", report.files))
     if want_json:
         doc = json.dumps(report.to_dict(), indent=2, sort_keys=True)
         if json_path and json_path != "-":
