@@ -391,6 +391,35 @@ def _telemetry_summary():
             "programs": snap["programs"], "online": snap["online"]}
 
 
+# the executor-path children sample the flight recorder at this
+# interval so BENCH/MULTICHIP artifacts gain per-phase TIMELINES
+# (counter deltas, queue depth, ledger bytes, MFU per tick) next to
+# the endpoint snapshots
+BENCH_SAMPLER_MS = 100.0
+
+
+def _sampler_begin():
+    """Start (or restart the window of) the flight-recorder sampler for
+    one bench leg. Telemetry must never cost a run — failures degrade
+    to 'no series in the artifact'."""
+    try:
+        from mxnet_tpu import flight
+        flight.series_clear()
+        flight.sampler_start(BENCH_SAMPLER_MS)
+    except Exception as e:
+        print("bench: flight sampler unavailable: %s" % e,
+              file=sys.stderr)
+
+
+def _series_window(n=240):
+    """The sampler's banked time-series window for the current leg."""
+    try:
+        from mxnet_tpu import flight
+        return flight.series_window(n)
+    except Exception as e:
+        return {"error": str(e)}
+
+
 _ROBUSTNESS_PREFIXES = ("faults.", "serving.shed", "serving.retries",
                         "serving.breaker", "serving.deadline",
                         "serving.dispatch_failures", "checkpoint.",
@@ -424,6 +453,7 @@ def module_child():
     old_pin = os.environ.get("MXNET_MODULE_FUSED_STEP")
     try:
         os.environ["MXNET_MODULE_FUSED_STEP"] = "1"
+        _sampler_begin()
         img_s, fallback = _module_fit_throughput(dev)
         out = {"module_fit_img_s": round(img_s, 2)}
         if fallback is not None:
@@ -433,12 +463,16 @@ def module_child():
             out["module_fit_fused_fallback"] = fallback
         out["telemetry"] = _telemetry_summary()
         out["robustness"] = _robustness_counters()
+        # the leg's per-tick timeline next to its endpoint snapshot
+        out["series"] = _series_window()
         print(json.dumps(out), flush=True)
         os.environ["MXNET_MODULE_FUSED_STEP"] = "0"
+        _sampler_begin()
         img_s, _ = _module_fit_throughput(dev)
         out["module_fit_phase_split_img_s"] = round(img_s, 2)
         out["telemetry_phase_split"] = _telemetry_summary()
         out["robustness_phase_split"] = _robustness_counters()
+        out["series_phase_split"] = _series_window()
         print(json.dumps(out), flush=True)
     finally:
         _restore_pin(old_pin)
@@ -601,10 +635,12 @@ def dp_child():
             # so the table never reads as a kvstore measurement there
             entry = {"split_kvstore_active": k > 1}
             os.environ["MXNET_MODULE_FUSED_STEP"] = "1"
+            _sampler_begin()
             img_s, fallback = _module_fit_throughput(dev, contexts=contexts,
                                                      kvstore="device")
             entry["fused_img_s"] = round(img_s, 2)
             entry["telemetry"] = _telemetry_summary()
+            entry["series"] = _series_window()
             if fallback is not None:
                 # a silently fallen-back leg must not read as a fused
                 # number
@@ -704,6 +740,7 @@ def serve_child():
     # with the persisted compile cache populated from a prior round,
     # construction deserializes instead of invoking XLA — the startup
     # wall and compile-cache counters bank the cold-vs-warm trajectory)
+    _sampler_begin()      # per-tick timeline across burst + ladder
     t_eng = time.perf_counter()
     engine = InferenceEngine(sym, params, {"data": (1,) + row},
                              max_batch=max_batch, max_wait_ms=2.0,
@@ -782,6 +819,9 @@ def serve_child():
         }
         print(json.dumps(dict(out, partial=True)), flush=True)
     out["telemetry"] = _telemetry_summary()
+    # the per-tick timeline across burst + offered-load ladder: the
+    # perf trajectory gains per-phase timelines, not just endpoints
+    out["series"] = _series_window()
     # the robustness trajectory: overload-control + fault counters for
     # this leg, plus the engine's own shed/retry/breaker accounting
     st = engine.stats()

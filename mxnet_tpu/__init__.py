@@ -87,6 +87,11 @@ from . import telemetry              # noqa: E402
 from . import faults                 # noqa: E402
 from . import checkpoint             # noqa: E402
 from .checkpoint import CheckpointManager  # noqa: E402
+from . import flight                 # noqa: E402
+
+# flight recorder env knobs (MXNET_FLIGHT_DIR / MXNET_METRICS_INTERVAL_MS
+# / MXNET_METRICS_PORT) take effect at import; all three default off
+flight._maybe_autostart()
 from . import compile_cache          # noqa: E402
 from . import profiler               # noqa: E402
 from . import tuner                  # noqa: E402

@@ -117,6 +117,7 @@ class TelemetryLogger:
         self._seen_programs = set()
         self._last_serving = None
         self._last_serve_total = 0
+        self._last_series_ts = None
 
     def _rebase(self, count):
         self._last_counters = self._telemetry.counters()
@@ -190,14 +191,9 @@ class TelemetryLogger:
             self._log_new_programs()
         rows = delta.get("serving.batch_rows", 0)
         pad = delta.get("serving.pad_rows", 0)
-        # depth = admitted-but-unterminated (mirrors stats()):
-        # admission sheds never counted as requests; post-admission
-        # sheds and failed requests each terminated a counted request
-        depth = cur.get("serving.requests", 0) \
-            - cur.get("serving.resolved", 0) \
-            - (cur.get("serving.shed_requests", 0)
-               - cur.get("serving.shed.admission", 0)) \
-            - cur.get("serving.failed_requests", 0)
+        # admitted-but-unterminated: the ONE shared formula (same
+        # depth InferenceEngine.stats() and the flight sampler report)
+        depth = t.serving_queue_depth(cur)
         # request-latency percentiles over THIS window's samples only
         durs = t.span_durations("serve_request")
         total = t.span_count("serve_request")
@@ -227,6 +223,53 @@ class TelemetryLogger:
         trips = delta.get("serving.breaker_trips", 0)
         if trips:
             msg += "\tbreaker_trips=%d" % trips
+        self.logger.info(msg)
+
+    def log_series(self, force=False):
+        """One RATE log line from the flight recorder's sampler ring
+        (``mxnet_tpu/flight.py``) — req/s, sheds/s, dispatches/s and
+        the online MFU over the samples that landed since the last
+        call — instead of re-snapshotting the cumulative counters and
+        diffing them here: the sampler already banked the deltas on its
+        own clock, so this reads (not recomputes) the trajectory.
+        Nothing is logged until a new sample lands (``force=True``
+        logs whatever the newest sample says). Needs
+        ``flight.sampler_start()`` (or ``MXNET_METRICS_INTERVAL_MS``)
+        — without a running sampler this is a silent no-op."""
+        from . import flight
+        samples = flight.series()
+        if self._last_series_ts is not None:
+            samples = [s for s in samples
+                       if s["ts"] > self._last_series_ts]
+        if not samples:
+            if force and flight.series(1):
+                samples = flight.series(1)
+            else:
+                return
+        self._last_series_ts = samples[-1]["ts"]
+        dt = sum(s.get("dt_ms", 0.0) for s in samples) / 1e3
+        if dt <= 0:
+            return
+
+        def rate(key):
+            total = sum(s.get("counters", {}).get(key, 0)
+                        for s in samples)
+            return total / dt
+
+        last = samples[-1]
+        msg = ("series: window=%.1fs req/s=%.1f shed/s=%.1f "
+               "dispatch/s=%.1f queue_depth=%d"
+               % (dt, rate("serving.requests"),
+                  rate("serving.shed_requests"),
+                  sum(sum(v for k, v in s.get("counters", {}).items()
+                          if k.startswith("dispatch."))
+                      for s in samples) / dt,
+                  last.get("queue_depth", 0)))
+        mfu = last.get("mfu")
+        if mfu is not None:
+            msg += "\tmfu=%.4g" % mfu
+        if last.get("serving", {}).get("breaker_open"):
+            msg += "\tbreaker=OPEN"
         self.logger.info(msg)
 
     def __call__(self, param):

@@ -220,6 +220,8 @@ class CheckpointManager:
                                                         sort_keys=True))
         self.prune()
         telemetry.counter_inc("checkpoint.save")
+        telemetry.record_event("checkpoint.save", epoch=epoch,
+                               nbatch=nbatch)
         return meta
 
     # -- resolve / load ----------------------------------------------------
@@ -295,6 +297,9 @@ class CheckpointManager:
         if meta.get("rng_state"):
             _random.set_state(meta["rng_state"])
         telemetry.counter_inc("checkpoint.resume")
+        telemetry.record_event("checkpoint.resume",
+                               epoch=int(meta["epoch"]),
+                               nbatch=int(meta.get("nbatch", 0)))
         return meta
 
     def prune(self):

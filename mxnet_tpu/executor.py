@@ -497,7 +497,15 @@ class _InstrumentedProgram:
             return self._invoke(rec[0], args)
         except Exception as e:
             if _is_oom(e):
-                raise _enriched_oom(e, rec[1]) from e
+                err = _enriched_oom(e, rec[1])
+                # flight-recorder moment: the ledger/card evidence in
+                # the enriched error evaporates with the process — dump
+                # the window too (no-op without a flight dir)
+                from . import flight
+                flight.postmortem("device_memory_error", exc=err,
+                                  extra={"program": rec[1].get("id"),
+                                         "kind": self.kind})
+                raise err from e
             if rec[2] and isinstance(e, (TypeError, ValueError)):
                 # strict AOT input check (an input moved devices under
                 # an unchanged shape/dtype): degrade this signature to

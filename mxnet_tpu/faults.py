@@ -270,6 +270,11 @@ def fire(site):
     raise_after = False
     for r in firing:
         telemetry.record_fault(site)
+        # flight record: the postmortem's event ring shows WHICH call
+        # the chaos registry hit, interleaved with the sheds/retries/
+        # trips it caused
+        telemetry.record_event("fault.injected", site=site,
+                               action=r.action, call=call_no)
     for r in firing:
         if r.action == "delay":
             time.sleep(r.delay_ms / 1e3)

@@ -367,7 +367,13 @@ class BaseModule:
                 # dispatches while batch N executes, metric values are
                 # fetched lazily (sync happens only at epoch end and in
                 # callbacks that read the metric).
-                with telemetry.span("fit_batch"):
+                # The causal() scope stamps (epoch, nbatch) step ids on
+                # every span this batch records (fit_batch, feed, step,
+                # opt_update, ...) so the merged chrome trace links one
+                # step's spans with flow arrows and a postmortem's ring
+                # says which step each interval served.
+                with telemetry.causal(epoch=epoch, nbatch=nbatch), \
+                        telemetry.span("fit_batch"):
                     fused = self._fused_batch_step(data_batch, eval_metric)
                     if not fused:
                         self._note_fused_fallback()
@@ -403,6 +409,15 @@ class BaseModule:
                     source = ckpt.preempt_requested
                     ckpt.save(self, epoch, nbatch)
                     telemetry.counter_inc("training.preempted")
+                    telemetry.record_event("training.preempted",
+                                           source=source, epoch=epoch,
+                                           nbatch=nbatch)
+                    from .. import flight as _flight
+                    _flight.postmortem(
+                        "training_preempted",
+                        extra={"source": source, "epoch": epoch,
+                               "nbatch": nbatch,
+                               "prefix": ckpt.prefix})
                     raise TrainingPreempted(
                         "training preempted by %s at epoch %d batch %d; "
                         "checkpoint saved under %r — fit(resume=...) "
@@ -460,6 +475,8 @@ class BaseModule:
         count it, then skip / rollback / halt."""
         from ..checkpoint import DivergenceError
         telemetry.counter_inc("divergence.detected")
+        telemetry.record_event("divergence.detected", epoch=epoch,
+                               nbatch=nbatch, policy=policy)
         where = "epoch %d batch %d" % (epoch, nbatch)
         from .. import log as _log
         logger = _log.get_logger("mxnet_tpu.module")
@@ -482,9 +499,14 @@ class BaseModule:
             logger.warning(
                 "divergence sentinel: policy=rollback but no checkpoint "
                 "to roll back to — halting")
-        raise DivergenceError(
+        err = DivergenceError(
             "divergence sentinel: non-finite loss/params at %s "
             "(policy=%s)" % (where, policy))
+        from .. import flight as _flight
+        _flight.postmortem("divergence", exc=err,
+                           extra={"epoch": epoch, "nbatch": nbatch,
+                                  "policy": policy})
+        raise err
 
     def prepare(self, data_batch, sparse_row_id_fn=None):
         pass

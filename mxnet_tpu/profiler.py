@@ -64,6 +64,10 @@ profiler_set_state = set_state
 
 
 def _host_events():
+    """Telemetry host spans as chrome events — including the causal
+    FLOW events (``ph: s/t/f``) linking one serving request's or one
+    fit step's spans across threads; the alignment shift below applies
+    to those too (they carry ``ts`` like every slice)."""
     from . import telemetry
     return telemetry.chrome_events()
 
@@ -152,6 +156,15 @@ def _link_chrome_trace():
         other = trace.setdefault("otherData", {})
         if isinstance(other, dict):
             other["mxnet_tpu_programs"] = cards
+    # the flight recorder's recent time-series window rides too (when
+    # the sampler ran): the trace then carries timeline, cost model AND
+    # the metrics trajectory around the captured window
+    from . import flight
+    samples = flight.series(240)
+    if samples:
+        other = trace.setdefault("otherData", {})
+        if isinstance(other, dict):
+            other["mxnet_tpu_series"] = samples
     with open(_state["filename"], "w") as dst:
         json.dump(trace, dst)
 

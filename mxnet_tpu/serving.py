@@ -70,11 +70,21 @@ Counters: ``serving.shed_requests`` / ``serving.shed_rows`` (with
 ``serving.dispatch_failures``, ``serving.breaker_trips``,
 ``serving.breaker_fastfail`` — all in ``stats()`` and the telemetry
 registry, so the chaos lane asserts exact shed/retry trajectories.
+
+**Causal ids** (ISSUE 10): every ``submit()`` stamps a process-unique
+``req_id`` (surfaced on the returned future) that rides the request
+through coalesce → batch dispatch → d2h → resolve — the request spans
+carry it, the batch-level spans carry the member ``req_ids``, sheds
+and batch failures land in the telemetry event ring under it, and a
+TERMINAL batch failure (retries exhausted / failed fetch) or breaker
+trip dumps a flight-recorder postmortem naming the dying batch's
+members (``mxnet_tpu/flight.py``; inert without ``MXNET_FLIGHT_DIR``).
 """
 from __future__ import annotations
 
 import collections
 import contextlib
+import itertools
 import queue
 import threading
 import time
@@ -87,6 +97,7 @@ import jax
 from .base import MXNetError
 from . import telemetry
 from . import faults
+from . import flight
 from .executor import record_dispatch, DeviceMemoryError
 from .predictor import Predictor
 
@@ -162,19 +173,35 @@ def _quiet_recompile(fn):
             fn.warn_recompile = prev
 
 
+# process-global request-id source: the CAUSAL id that rides one
+# request through submit -> coalesce -> batch dispatch -> d2h ->
+# resolve. Process-global (not per-engine) so a postmortem covering two
+# engines never shows two requests under one id.
+_REQ_SEQ = itertools.count(1)
+
+
 class _Request:
     __slots__ = ("arrays", "rows", "future", "wait_span", "req_span",
-                 "deadline")
+                 "deadline", "req_id")
 
     def __init__(self, arrays, rows, deadline=None):
         self.arrays = arrays          # {input name: np.ndarray (rows,...)}
         self.rows = rows
         self.deadline = deadline      # monotonic instant, or None
+        self.req_id = next(_REQ_SEQ)
         self.future = Future()
+        # the causal id surfaces on the future too, so a client (and
+        # the postmortem lane) can join its outcome against the dump's
+        # member req_ids
+        self.future.req_id = self.req_id
         # spans are entered on the submitting thread and closed on the
-        # coalescer / resolver threads — _Span carries its own t0
-        self.wait_span = telemetry.span("serve_wait").__enter__()
-        self.req_span = telemetry.span("serve_request").__enter__()
+        # coalescer / resolver threads — _Span carries its own t0 and
+        # causal ctx (explicit: thread-local ids would not follow the
+        # request across threads)
+        ctx = {"req_id": self.req_id}
+        self.wait_span = telemetry.span("serve_wait", ctx=ctx).__enter__()
+        self.req_span = telemetry.span("serve_request",
+                                       ctx=ctx).__enter__()
 
     def expired(self, now=None):
         return self.deadline is not None \
@@ -364,6 +391,9 @@ class InferenceEngine:
                                         name="mxtpu-serve-coalesce",
                                         daemon=True)
         self._thread.start()
+        # the flight recorder's sampler/postmortems read this engine's
+        # queue/breaker state (weakly held — close() is not required)
+        flight.register_engine(self)
         if warmup:
             self.warmup()
 
@@ -528,6 +558,8 @@ class InferenceEngine:
         telemetry.counter_inc("serving.shed_requests")
         telemetry.counter_inc("serving.shed_rows", req.rows)
         telemetry.counter_inc("serving.shed.%s" % cause)
+        telemetry.record_event("serving.shed", req_id=req.req_id,
+                               cause=cause, rows=req.rows)
         if isinstance(exc, DeadlineExceeded):
             telemetry.counter_inc("serving.deadline_exceeded")
 
@@ -611,6 +643,9 @@ class InferenceEngine:
                 telemetry.counter_inc("serving.shed_requests")
                 telemetry.counter_inc("serving.shed_rows", rows)
                 telemetry.counter_inc("serving.shed.admission")
+                telemetry.record_event("serving.shed",
+                                       req_id=req.req_id,
+                                       cause="admission", rows=rows)
                 if deadline_hit:
                     telemetry.counter_inc("serving.deadline_exceeded")
             raise exc
@@ -684,18 +719,14 @@ class InferenceEngine:
             queued_rows = self._queued_rows
             breaker_open = self._breaker_tripped()
             consecutive = self._consecutive_failures
-        # depth = admitted requests not yet terminally resolved.
-        # Admission sheds never entered "requests" (they must not go
-        # negative here); coalesce/resolve/breaker sheds and failed
-        # requests DID, and each terminates its future.
-        admitted_sheds = st.get("shed_requests", 0) \
-            - st.get("shed.admission", 0)
         return {
             "requests": st.get("requests", 0),
             "resolved": st.get("resolved", 0),
             "failed_requests": st.get("failed_requests", 0),
-            "queue_depth": st.get("requests", 0) - st.get("resolved", 0)
-            - admitted_sheds - st.get("failed_requests", 0),
+            # admitted requests not yet terminally resolved — the ONE
+            # shared formula (TelemetryLogger and the flight sampler
+            # compute the same depth from the telemetry counters)
+            "queue_depth": telemetry.serving_queue_depth(st, prefix=""),
             "batches": st.get("batches", 0),
             "rows": st.get("rows", 0),
             "pad_rows": pad,
@@ -733,6 +764,21 @@ class InferenceEngine:
                            ("p50_ms", "p95_ms", "p99_ms")}
             if lat else None,
         }
+
+    def overload_state(self):
+        """Light lock-held view of the queue/breaker state — what the
+        flight recorder's sampler reads every tick and a postmortem
+        embeds (``stats()`` computes span percentiles per call, too
+        heavy for a 10 Hz sampler)."""
+        with self._lock:
+            return {
+                "queued_rows": self._queued_rows,
+                "max_queue_rows": self.max_queue_rows,
+                "breaker_open": self._breaker_tripped(),
+                "consecutive_failures": self._consecutive_failures,
+                "closed": self._closed,
+                "max_inflight": self._max_inflight,
+            }
 
     def corpus_record(self):
         """One JSON-safe record of this engine's measured serving data
@@ -944,15 +990,23 @@ class InferenceEngine:
             self._stats["dispatch_failures"] += 1
             self._consecutive_failures += 1
             self._breaker_probing = False
+            consecutive = self._consecutive_failures
             trip = (self._breaker_threshold > 0
-                    and self._consecutive_failures
-                    >= self._breaker_threshold)
+                    and consecutive >= self._breaker_threshold)
             if trip:
                 self._breaker_open_at = time.monotonic()
                 self._stats["breaker_trips"] += 1
         telemetry.counter_inc("serving.dispatch_failures")
         if trip:
             telemetry.counter_inc("serving.breaker_trips")
+            telemetry.record_event("serving.breaker_trip",
+                                   consecutive=consecutive)
+            # a tripping breaker is a flight-recorder moment: the
+            # backend just went from flaky to DOWN — dump the window
+            # (no-op without a flight dir; throttled against flapping)
+            flight.postmortem("breaker_trip",
+                              extra={"engine": self.overload_state(),
+                                     "consecutive": consecutive})
 
     def _dispatch_succeeded(self):
         with self._lock:
@@ -985,6 +1039,12 @@ class InferenceEngine:
             for r in reqs:
                 self._shed(r, "breaker", exc)
             return
+        # the dying batch's member ids: the serve_batch/serve_d2h spans
+        # carry them (flow events link each member's serve_wait ->
+        # serve_batch -> serve_d2h -> serve_request across threads) and
+        # a terminal failure's postmortem names them
+        ids = [r.req_id for r in reqs]
+        bucket = None
         self._inflight.acquire()
         try:
             rows = sum(r.rows for r in reqs)
@@ -1008,7 +1068,8 @@ class InferenceEngine:
             while True:
                 try:
                     record_dispatch("serve")
-                    with telemetry.span("serve_batch"):
+                    with telemetry.span("serve_batch",
+                                        ctx={"req_ids": ids}):
                         outs, _ = self._forward(args, self._aux_raw,
                                                 self._rng)
                     break
@@ -1039,6 +1100,9 @@ class InferenceEngine:
             telemetry.counter_inc("serving.batch_rows", rows)
             telemetry.counter_inc("serving.pad_rows", bucket - rows)
             telemetry.counter_inc("serving.pad_bytes", pad_bytes)
+            telemetry.record_event("serving.batch", req_ids=ids,
+                                   bucket=bucket, rows=rows,
+                                   pad_rows=bucket - rows)
             self._pool.submit(self._resolve, outs, reqs, bucket,
                               time.perf_counter())
         except BaseException as e:
@@ -1048,6 +1112,16 @@ class InferenceEngine:
             # mid-flight dispatch error must never strand a pending
             # Future.result()
             self._fail_requests(reqs, e)
+            telemetry.record_event("serving.batch_failed", req_ids=ids,
+                                   bucket=bucket,
+                                   error=type(e).__name__)
+            # a TERMINAL batch failure (retries exhausted or
+            # non-retryable) is exactly what the black box exists for:
+            # the dump names the dying batch's member req_ids and, for
+            # an injected fault, its site
+            flight.postmortem("serving_dispatch_failure", exc=e,
+                              extra={"req_ids": ids, "bucket": bucket,
+                                     "engine": self.overload_state()})
         else:
             if self._logger is not None:
                 try:
@@ -1069,7 +1143,9 @@ class InferenceEngine:
             # resolves with it below); "nan" corrupts the host copy —
             # what the chaos lane's divergence assertions feed on
             act = faults.fire("d2h") if faults.active() else None
-            with telemetry.span("serve_d2h"):
+            with telemetry.span("serve_d2h",
+                                ctx={"req_ids": [r.req_id
+                                                 for r in reqs]}):
                 host = [np.asarray(o) for o in outs]
             if act == "nan":
                 host = faults.poison(host)
@@ -1105,5 +1181,12 @@ class InferenceEngine:
             # futures resolve with the error (never strand)
             self._dispatch_failed()
             self._fail_requests(reqs, e)
+            ids = [r.req_id for r in reqs]
+            telemetry.record_event("serving.batch_failed", req_ids=ids,
+                                   bucket=bucket,
+                                   error=type(e).__name__)
+            flight.postmortem("serving_dispatch_failure", exc=e,
+                              extra={"req_ids": ids, "bucket": bucket,
+                                     "engine": self.overload_state()})
         finally:
             self._inflight.release()

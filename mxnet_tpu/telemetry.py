@@ -37,7 +37,23 @@ is the standing instrument every perf PR reads from:
   a buffer to its context until ``obj`` is garbage-collected
   (weakref.finalize), maintaining per-context alive-bytes/alive-count/
   peak-bytes; ``ledger_top()`` lists the largest live buffers, which
-  is what the executor stitches into enriched OOM errors.
+  is what the executor stitches into enriched OOM errors;
+* **causal ids** (the flight-recorder substrate, ISSUE 10) —
+  ``with telemetry.causal(req_id=7): ...`` stamps every span recorded
+  on the thread (or a span built with an explicit ``ctx=``, for spans
+  that cross threads) with the ids of the request or fit step it
+  serves. ``serving.submit()`` stamps a ``req_id`` that rides the
+  request through coalesce → batch dispatch → d2h → resolve (batch
+  spans carry the member ``req_ids``), ``Module.fit`` stamps
+  ``(epoch, nbatch)`` onto feed/step/opt spans, and
+  ``chrome_events()`` renders the shared ids as chrome-trace FLOW
+  events (``ph: s/t/f``) so perfetto draws arrows linking one
+  request's or step's spans across threads;
+* an **event ring** — ``record_event(kind, **data)`` appends one
+  discrete runtime event (a fault firing, a shed, a breaker trip, a
+  checkpoint save) into a bounded ring; together with
+  ``recent_spans()`` it is the last-N "what happened, when, to which
+  request" record a crash postmortem (``mxnet_tpu/flight.py``) dumps.
 
 Everything here is stdlib-only (no jax import) and cheap when disabled:
 ``MXNET_TELEMETRY=0`` (or ``disable()``) reduces every span to two
@@ -58,13 +74,16 @@ __all__ = [
     "enabled", "enable", "disable", "reset",
     "counter_inc", "counters", "snapshot", "span", "span_stats",
     "span_count", "span_durations", "span_seconds",
+    "causal", "current_causal", "record_event", "events",
+    "recent_spans", "serving_queue_depth",
     "on_dispatch", "remove_dispatch", "dispatch_event",
     "record_jit", "record_fallback", "record_fault", "record_transfer",
     "record_host_sync", "chrome_events", "mark_trace_start",
     "record_program", "program_dispatch", "programs", "card_update",
     "card_annotate",
     "set_peak_flops", "ledger_track", "ledger", "ledger_top",
-    "SPAN_RING_SIZE", "FIT_PHASE_SPANS", "SERVE_SPANS", "COMPILE_SPANS",
+    "SPAN_RING_SIZE", "EVENT_RING_SIZE", "FIT_PHASE_SPANS",
+    "SERVE_SPANS", "COMPILE_SPANS",
     "MAX_PROGRAM_CARDS", "COUNTERS",
 ]
 
@@ -74,6 +93,11 @@ __all__ = [
 # after the ring has wrapped.
 SPAN_RING_SIZE = 4096
 _DURATIONS_PER_NAME = 4096
+
+# event ring: the flight recorder's last-N discrete-event record
+# (faults, sheds, breaker trips, checkpoint saves, preemptions) — what
+# a crash postmortem dumps next to the span ring
+EVENT_RING_SIZE = 2048
 
 # the fit-loop phase span names — the ONE list the bench/probe artifact
 # summaries filter on, kept next to the code that records them so the
@@ -109,6 +133,7 @@ MAX_PROGRAM_CARDS = 256
 # trailing ``.*`` covers a dynamic tail: fallback codes, fault sites,
 # reject causes, shed causes, dispatch/program kinds.
 COUNTERS = (
+    "flight.postmortem", "flight.postmortem_fail",
     "dispatch.*", "jit.*", "recompile.*",
     "fused_fallback.*",
     "faults.injected", "faults.injected.*",
@@ -144,10 +169,19 @@ class _State:
 _state = _State()
 _lock = threading.Lock()
 _counters = {}           # guarded by: _lock
-# span ring: (name, start_ns, end_ns, thread_id) in perf_counter_ns
-# time. Appends are deliberately LOCK-FREE (GIL-atomic deque ops on the
-# per-batch hot path); see the _record_span disables.
+# span ring: (name, start_ns, end_ns, thread_id, causal_ctx_or_None)
+# in perf_counter_ns time. Appends are deliberately LOCK-FREE
+# (GIL-atomic deque ops on the per-batch hot path); see the
+# _record_span disables.
 _spans = collections.deque(maxlen=SPAN_RING_SIZE)   # guarded by: _lock
+# event ring: (perf_ns, kind, data_dict_or_None, thread_id). Appends
+# are lock-free for the same hot-path reason (some events fire under
+# OTHER locks — the serving admission path records sheds while holding
+# the engine lock, and stacking _lock under it per event buys nothing).
+_events = collections.deque(maxlen=EVENT_RING_SIZE)  # guarded by: _lock
+# per-thread causal ids (req_id / epoch+nbatch) stamped onto spans
+# recorded while a causal() scope is active on that thread
+_tls = threading.local()
 _durations = {}          # name -> deque of durations  # guarded by: _lock
 _span_total = {}         # name -> cumulative count    # guarded by: _lock
 _span_seconds = {}       # guarded by: _lock
@@ -229,6 +263,7 @@ def reset():
         _gen += 1
         _counters.clear()
         _spans.clear()
+        _events.clear()
         _durations.clear()
         _span_total.clear()
         _span_seconds.clear()
@@ -271,6 +306,23 @@ def record_jit(kind, hit):
         _counters["jit.%s" % what] = _counters.get("jit.%s" % what, 0) + 1
         k = "jit.%s.%s" % (what, kind)
         _counters[k] = _counters.get(k, 0) + 1
+
+
+def serving_queue_depth(counts, prefix="serving."):
+    """Admitted-but-unterminated serving requests, from a counter
+    mapping: requests − resolved − post-admission sheds − failed.
+    Admission sheds never entered ``requests`` (they must not drive
+    the depth negative); coalesce/resolve/breaker sheds and failed
+    requests DID, and each terminated its future. THE one copy of the
+    formula — ``InferenceEngine.stats()`` (over its engine-local stats,
+    ``prefix=""``), ``TelemetryLogger.log_serving`` and the flight
+    recorder's sampler all call this, so a new terminal cause is a
+    one-place change."""
+    def g(key):
+        return counts.get(prefix + key, 0)
+    return (g("requests") - g("resolved")
+            - (g("shed_requests") - g("shed.admission"))
+            - g("failed_requests"))
 
 
 def record_fallback(code):
@@ -363,23 +415,108 @@ def dispatch_counts():
 
 
 # ---------------------------------------------------------------------------
+# Causal ids + discrete-event ring (the flight-recorder substrate)
+# ---------------------------------------------------------------------------
+
+class _Causal:
+    """Scope installing causal ids (req_id / epoch+nbatch) as the
+    thread's ambient span context; nests (inner ids shadow, the outer
+    dict is restored on exit)."""
+    __slots__ = ("_ids", "_prev")
+
+    def __init__(self, ids):
+        self._ids = ids
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "ids", None)
+        _tls.ids = self._ids
+        return self
+
+    def __exit__(self, *exc):
+        _tls.ids = self._prev
+        return False
+
+
+def causal(**ids):
+    """``with telemetry.causal(epoch=2, nbatch=17): ...`` — every span
+    recorded on THIS thread inside the scope carries the given ids
+    (``chrome_events()`` renders shared ids as flow arrows; postmortems
+    and ``tools/flight_view.py`` group the ring by them). Spans that
+    cross threads pass ``span(name, ctx=...)`` explicitly instead."""
+    return _Causal(ids)
+
+
+def current_causal():
+    """The ambient causal-id dict of this thread (None outside any
+    ``causal()`` scope)."""
+    return getattr(_tls, "ids", None)
+
+
+def record_event(kind, **data):
+    """Append one discrete runtime event (a fault firing, a shed, a
+    breaker trip, a checkpoint save) to the bounded event ring — the
+    flight record a crash postmortem dumps. Lock-free (GIL-atomic
+    bounded-deque append): events fire from hot paths and from inside
+    OTHER locks (the serving admission path holds the engine lock).
+    No-op while disabled."""
+    if not _state.enabled:
+        return
+    _events.append((time.perf_counter_ns(), kind, data or None,   # mxlint: disable=lock-discipline -- GIL-atomic bounded-deque append; events fire under foreign locks
+                    threading.get_ident()))
+
+
+def events(n=None):
+    """The retained event ring as JSON-safe dicts (oldest first):
+    ``{"ts": epoch_s, "kind": ..., "tid": ..., "data": {...}|None}``.
+    ``n`` keeps only the newest n."""
+    with _lock:
+        evs = list(_events)
+    if n is not None:
+        evs = evs[-int(n):]
+    return [{"ts": round(_epoch_us(p_ns) / 1e6, 6), "kind": kind,
+             "tid": tid, "data": data}
+            for p_ns, kind, data, tid in evs]
+
+
+def recent_spans(n=None):
+    """The retained span ring as JSON-safe dicts (oldest first):
+    ``{"name", "ts" (epoch_s), "dur_ms", "tid", "ctx"}`` — the causal
+    ``ctx`` carries the req_id / step ids stamped by ``causal()`` or an
+    explicit ``span(ctx=)``. ``n`` keeps only the newest n."""
+    with _lock:
+        spans = list(_spans)
+    if n is not None:
+        spans = spans[-int(n):]
+    return [{"name": name, "ts": round(_epoch_us(s_ns) / 1e6, 6),
+             "dur_ms": round((e_ns - s_ns) / 1e6, 4), "tid": tid,
+             "ctx": None if ctx is None else dict(ctx)}
+            for name, s_ns, e_ns, tid, ctx in spans]
+
+
+# ---------------------------------------------------------------------------
 # Host-side span tracing
 # ---------------------------------------------------------------------------
 
 class _Span:
     """Reentrant-per-instance-free timing scope; ~two perf_counter_ns
     calls + two deque appends when enabled, two attribute reads when
-    disabled."""
-    __slots__ = ("name", "_t0", "_gen")
+    disabled. ``ctx`` pins explicit causal ids (for spans that are
+    entered on one thread and exited on another, e.g. the serving
+    request spans); without it the recording thread's ambient
+    ``causal()`` ids are captured at ENTER."""
+    __slots__ = ("name", "_t0", "_gen", "_ctx")
 
-    def __init__(self, name):
+    def __init__(self, name, ctx=None):
         self.name = name
         self._t0 = 0
+        self._ctx = ctx
 
     def __enter__(self):
         if _state.enabled:
             self._t0 = time.perf_counter_ns()
             self._gen = _gen   # mxlint: disable=lock-discipline -- single GIL-atomic int read; a torn window only drops this one span
+            if self._ctx is None:
+                self._ctx = getattr(_tls, "ids", None)
         return self
 
     def cancel(self):
@@ -392,22 +529,25 @@ class _Span:
         # span pins the disabled leg clean) and no reset() started a
         # new accounting window while this span was open
         if self._t0 and _state.enabled and self._gen == _gen:   # mxlint: disable=lock-discipline -- single GIL-atomic int compare; worst case one pre-reset span drops
-            _record_span(self.name, self._t0, time.perf_counter_ns())
+            _record_span(self.name, self._t0, time.perf_counter_ns(),
+                         self._ctx)
         self._t0 = 0
         return False
 
 
-def span(name):
+def span(name, ctx=None):
     """``with telemetry.span("feed"): ...`` — record one host wall-time
-    interval into the ring buffer and the per-name histogram."""
-    return _Span(name)
+    interval into the ring buffer and the per-name histogram. ``ctx``
+    attaches explicit causal ids (defaults to the recording thread's
+    ambient ``causal()`` scope)."""
+    return _Span(name, ctx)
 
 
-def _record_span(name, t0_ns, t1_ns):
+def _record_span(name, t0_ns, t1_ns, ctx=None):
     # deque.append and dict reads are GIL-atomic so the ring/histogram
     # writes stay lock-free; the cumulative counter is a read-modify-
     # write and takes the lock like every other counter
-    _spans.append((name, t0_ns, t1_ns, threading.get_ident()))   # mxlint: disable=lock-discipline -- GIL-atomic bounded-deque append on the per-batch hot path
+    _spans.append((name, t0_ns, t1_ns, threading.get_ident(), ctx))   # mxlint: disable=lock-discipline -- GIL-atomic bounded-deque append on the per-batch hot path
     d = _durations.get(name)   # mxlint: disable=lock-discipline -- GIL-atomic dict probe; the insert below re-checks under the lock
     if d is None:
         with _lock:
@@ -680,6 +820,13 @@ def ledger_top(n=8):
              "kind": r[4]} for r in live[:n]]
 
 
+def online():
+    """The live roofline estimate alone (``snapshot()["online"]``)
+    without the span-percentile sorts the full snapshot pays — what the
+    flight-recorder sampler reads every tick."""
+    return _online_stats()
+
+
 def snapshot():
     """One self-describing dict: counters + span percentiles + program
     cards + the online MFU estimate + the buffer ledger. This is what
@@ -723,13 +870,43 @@ def trace_start_epoch_us():
     return _epoch_us(_trace_start_ns)
 
 
+def _flow_ids(ctx):
+    """The flow identities one span's causal ctx binds it to: a request
+    id (``req_id`` on request spans, each member of ``req_ids`` on
+    batch-level spans) maps to ``req:<n>``; fit-step ids map to
+    ``step:<epoch>:<nbatch>``."""
+    if not ctx:
+        return ()
+    out = []
+    if ctx.get("req_id") is not None:
+        out.append(("req", "req:%s" % ctx["req_id"]))
+    for rid in ctx.get("req_ids") or ():
+        out.append(("req", "req:%s" % rid))
+    if ctx.get("epoch") is not None and ctx.get("nbatch") is not None:
+        out.append(("step", "step:%s:%s" % (ctx["epoch"], ctx["nbatch"])))
+    return out
+
+
+# the serving-pipeline order a request FLOW must chain in. Start-time
+# order would get it wrong: serve_request is ENTERED at submit (same
+# instant as serve_wait), so by start time the chain would terminate at
+# serve_d2h and the "request resolved" terminus would never be drawn.
+_SERVE_FLOW_RANK = {"serve_wait": 0, "serve_batch": 1, "serve_d2h": 2,
+                    "serve_request": 3}
+
+
 def chrome_events(pid=None, since_trace_start=True):
     """Render retained host spans as chrome://tracing complete events
     (``ph: "X"``, ``ts``/``dur`` in microseconds, epoch timebase) plus
     the process/thread metadata rows that label the track "mxnet_tpu
-    host" in perfetto. ``since_trace_start=True`` keeps only spans that
-    began after the last ``mark_trace_start()`` (everything, if no trace
-    was started)."""
+    host" in perfetto, plus FLOW events (``ph: "s"/"t"/"f"``) linking
+    the spans that share one causal id — one request's serve_wait →
+    serve_batch → serve_d2h → serve_request across the submit/coalesce/
+    resolve threads, one fit step's feed → step → opt spans — so
+    perfetto draws the request's/step's path as arrows.
+    ``since_trace_start=True`` keeps only spans that began after the
+    last ``mark_trace_start()`` (everything, if no trace was
+    started)."""
     if pid is None:
         pid = os.getpid()
     with _lock:
@@ -743,16 +920,52 @@ def chrome_events(pid=None, since_trace_start=True):
         "args": {"sort_index": -1},
     }]
     tids = set()
-    for name, s_ns, e_ns, tid in spans:
+    flows = {}            # flow id -> (label, [(s_ns, tid), ...])
+    for name, s_ns, e_ns, tid, ctx in spans:
         if t0 is not None and s_ns < t0:
             continue
         tids.add(tid)
-        events.append({
+        ev = {
             "ph": "X", "cat": "host", "name": name,
             "pid": pid, "tid": tid,
             "ts": round(_epoch_us(s_ns), 3),
             "dur": round((e_ns - s_ns) / 1e3, 3),
-        })
+        }
+        if ctx:
+            ev["args"] = dict(ctx)
+        events.append(ev)
+        for label, fid in _flow_ids(ctx):
+            # request flows chain in PIPELINE order (wait -> batch ->
+            # d2h -> request), not start order — serve_request opens at
+            # submit, so its start sorts next to serve_wait; its node
+            # binds near the span END (the resolution instant), which
+            # also keeps the drawn arrows chronologically forward.
+            # Other flows (fit steps) chain by start time.
+            rank = _SERVE_FLOW_RANK.get(name, -1) if label == "req" \
+                else -1
+            bind_ns = s_ns if name != "serve_request" \
+                else max(s_ns, e_ns - 1000)
+            flows.setdefault(fid, (label, []))[1].append(
+                (rank, bind_ns, tid))
+    for fid, (label, members) in flows.items():
+        if len(members) < 2:
+            continue          # an arrow needs two ends
+        members.sort()       # (rank, bind_ns, tid): pipeline order,
+                             # then time within a rank
+        last = len(members) - 1
+        for i, (_rank, bind_ns, tid) in enumerate(members):
+            # flow binding: ts inside the slice on the same thread —
+            # a slice's own start (or a point just before its end, for
+            # the serve_request terminus) is inside by definition
+            ev = {
+                "ph": "s" if i == 0 else ("f" if i == last else "t"),
+                "cat": "flow", "name": label, "id": fid,
+                "pid": pid, "tid": tid,
+                "ts": round(_epoch_us(bind_ns), 3),
+            }
+            if i == last:
+                ev["bp"] = "e"   # bind the finish to the enclosing slice
+            events.append(ev)
     for tid in tids:
         events.append({
             "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,

@@ -90,6 +90,53 @@ def test_chaos_smoke_lane():
     assert out["stats"]["shed_requests"] > 0
 
 
+def test_postmortem_smoke_lane():
+    """The flight-recorder acceptance lane (ISSUE 10): the chaos ladder
+    with an injected TERMINAL dispatch fault (raise:first outlasting
+    the retry budget) and the metrics sampler on. The probe gates: a
+    postmortem file appears, ``flight_view`` parses it (and rejects a
+    corrupted copy non-zero), the dump names the injected fault's site
+    and exactly the dying batch's member req_ids, the sampler banked a
+    non-empty series window, zero hung futures, and the recorder's
+    measured work stays under the <2% overhead guard. This test pins
+    the artifact schema, re-asserts the deterministic halves, and runs
+    the flight_view CLI over the banked dump itself."""
+    art_dir = os.environ.get("MXTPU_ARTIFACT_DIR", "/tmp/mxtpu_artifacts")
+    os.makedirs(art_dir, exist_ok=True)
+    art = os.path.join(art_dir, "postmortem_smoke.json")
+    try:
+        out = _run_probe(art, "--postmortem-smoke")
+    except AssertionError:
+        out = _run_probe(art, "--postmortem-smoke")  # one retry (noise)
+    assert out["lane"] == "postmortem_smoke"
+    assert out["gates_passed"] is True, out
+    # the injected terminal fault produced a REAL postmortem naming the
+    # fault's site and the dying batch's member req_ids
+    assert out["failed_requests"] > 0
+    assert out["view_summary"]["reason"] == "serving_dispatch_failure"
+    assert out["view_summary"]["exception"]["fault_site"] == "dispatch"
+    assert sorted(out["view_summary"]["extra"]["req_ids"]) \
+        == out["failed_req_ids"], out["view_summary"]
+    # the sampler banked a non-empty time-series window with samples
+    # shaped like the schema the bench artifacts embed
+    win = out["series_window"]
+    assert win["n"] > 0 and len(win["samples"]) == win["n"]
+    assert {"ts", "dt_ms", "counters", "queue_depth"} \
+        <= set(win["samples"][-1])
+    # no hung futures, and the flight-recorder work fits the <2% guard
+    assert out["hung"] == 0
+    assert out["overhead"]["frac"] < out["overhead"]["gate"], out
+    # the banked dump parses through the CLI end to end
+    pm = out["postmortem_path"]
+    assert pm and os.path.exists(pm), pm
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "flight_view.py"),
+         pm], stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    assert "slowest requests" in proc.stdout
+
+
 def test_warm_smoke_lane():
     """The zero-cold-start acceptance lane (ISSUE 6): two fresh
     processes over one shared compile-cache dir. The probe gates the

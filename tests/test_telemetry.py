@@ -241,13 +241,17 @@ def test_telemetry_overhead_guard():
     of interleaving. The guard instead bounds the measured telemetry
     WORK against the measured batch time: count the actual per-batch
     registry operations the fit loop performs (the registry reports its
-    own op counts exactly — spans, counters, AND the ISSUE-4 paths:
-    buffer-ledger tracks and program-card dispatch bumps),
+    own op counts exactly — spans, counters, the ISSUE-4 paths (buffer-
+    ledger tracks and program-card dispatch bumps) AND the ISSUE-10
+    flight-recorder paths: causal-id spans — the fit loop stamps
+    (epoch, nbatch) on every batch's spans now — discrete events, and
+    the metrics sampler's ticks, which run DURING the counted epoch),
     microbenchmark the per-op costs (min over repeated tight loops —
     robust to throttle, which can only inflate them), and assert
     ops x cost < 2% of the batch-time floor. A lock storm or heavy
-    span/ledger/card path in telemetry.py fails this immediately; box
-    noise cannot."""
+    span/ledger/card/sampler path fails this immediately; box noise
+    cannot."""
+    from mxnet_tpu import flight
     batch, nbatch = 512, 12
     rs = np.random.RandomState(0)
     X = rs.uniform(-1, 1, (batch * nbatch, 64)).astype(np.float32)
@@ -268,15 +272,26 @@ def test_telemetry_overhead_guard():
     # batch-time floor over a few epochs (min: throttle only inflates)
     batch_s = min(epoch() for _ in range(5)) / nbatch
 
-    # exact per-batch telemetry op counts from the steady-state epoch
+    # exact per-batch telemetry op counts from the steady-state epoch —
+    # with the flight-recorder sampler RUNNING, as the acceptance gate
+    # demands (its ticks are counted and costed like every other op)
     telemetry.reset()
-    epoch()
+    flight.series_clear()
+    sampler_interval_s = 0.02
+    flight.sampler_start(sampler_interval_s * 1e3)
+    try:
+        epoch()
+    finally:
+        flight.sampler_stop()
+    ticks = len(flight.series()) / nbatch
+    flight.series_clear()
     spans = sum(telemetry.span_count(n)
                 for n in telemetry.span_stats()) / nbatch
     counts = telemetry.counters()
     counter_ops = sum(v for k, v in counts.items()
                       if k.endswith("_count") or k.startswith(
                           ("dispatch.", "host_sync.", "jit."))) / nbatch
+    event_ops = len(telemetry.events()) / nbatch
     # ISSUE-4 instrumentation: buffer-ledger tracks (NDArray wraps,
     # shard_put) and program-card dispatch bumps the epoch performed
     ledger_ops = sum(st.get("tracked_total", 0)
@@ -294,6 +309,8 @@ def test_telemetry_overhead_guard():
         return best / 1e9
 
     def one_span():
+        # measured INSIDE a causal scope: every fit-loop span now pays
+        # the ambient-ids capture, so the probe must too
         with telemetry.span("_guard_probe"):
             pass
 
@@ -306,18 +323,26 @@ def test_telemetry_overhead_guard():
                                shape=(32,), dtype="float32")
 
     _card = {"id": "_guard_card"}
-    span_s = op_cost(one_span)
+    with telemetry.causal(epoch=0, nbatch=0):
+        span_s = op_cost(one_span)
     counter_s = op_cost(lambda: telemetry.counter_inc("_guard_probe"))
+    event_s = op_cost(lambda: telemetry.record_event("_guard_probe"))
     track_s = op_cost(one_track, iters=5000)
     card_s = op_cost(lambda: telemetry.program_dispatch(_card))
+    tick_s = op_cost(lambda: flight._build_sample({},
+                                                  sampler_interval_s),
+                     iters=500)
     overhead_s = spans * span_s + counter_ops * counter_s \
-        + ledger_ops * track_s + card_ops * card_s
+        + event_ops * event_s + ledger_ops * track_s \
+        + card_ops * card_s + ticks * tick_s
     telemetry.reset()
     frac = overhead_s / batch_s
     assert frac < 0.02, \
         "telemetry work %.1fus/batch (%.1f spans x %.2fus + %.1f counter " \
-        "ops x %.2fus + %.1f ledger tracks x %.2fus + %.1f card bumps x " \
-        "%.2fus) is %.2f%% of the %.0fus batch floor — exceeds the 2%% " \
+        "ops x %.2fus + %.1f events x %.2fus + %.1f ledger tracks x " \
+        "%.2fus + %.1f card bumps x %.2fus + %.2f sampler ticks x " \
+        "%.1fus) is %.2f%% of the %.0fus batch floor — exceeds the 2%% " \
         "guard" % (overhead_s * 1e6, spans, span_s * 1e6, counter_ops,
-                   counter_s * 1e6, ledger_ops, track_s * 1e6, card_ops,
-                   card_s * 1e6, frac * 100, batch_s * 1e6)
+                   counter_s * 1e6, event_ops, event_s * 1e6,
+                   ledger_ops, track_s * 1e6, card_ops, card_s * 1e6,
+                   ticks, tick_s * 1e6, frac * 100, batch_s * 1e6)

@@ -1,0 +1,268 @@
+#!/usr/bin/env python3
+"""flight_view: pretty-print one flight-recorder postmortem dump.
+
+Usage::
+
+    python tools/flight_view.py POSTMORTEM.json [--json]
+
+Renders the black box a crash left behind (``mxnet_tpu/flight.py``):
+
+* header — trigger reason, when, the exception (an injected fault's
+  site is surfaced), the trigger's extra facts (e.g. the dying batch's
+  member req_ids);
+* event timeline — the last-N discrete events (faults, sheds, breaker
+  trips, checkpoint saves) with time-to-crash offsets;
+* top counter deltas — summed over the recent time-series window (the
+  sampler's per-interval deltas), falling back to the cumulative
+  counters when no sampler ran;
+* slowest requests — per-req_id wait / batch / d2h / resolve breakdown
+  reconstructed from the causal span ring, with each request's bucket
+  padding joined from the batch events;
+* engine + fault-registry state.
+
+``--json`` emits the computed summary as JSON instead. Exit codes:
+0 = rendered, 2 = malformed dump (unreadable, unparseable, wrong
+schema, or missing required sections) — so a lane can gate "the
+postmortem a chaos run produced is a REAL one".
+
+Stdlib-only (the dump is plain JSON; no framework import needed).
+"""
+import json
+import os
+import sys
+import time
+
+REQUIRED = ("schema", "reason", "ts", "counters", "events", "spans")
+SCHEMA_PREFIX = "mxnet_tpu.flight/"
+
+# events shown in the timeline section (newest last)
+TIMELINE_EVENTS = 40
+TOP_COUNTERS = 15
+SLOWEST_REQUESTS = 10
+
+
+class MalformedDump(Exception):
+    pass
+
+
+def load_dump(path):
+    """Parse + validate one postmortem file; raises MalformedDump."""
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except OSError as e:
+        raise MalformedDump("cannot read %s: %s" % (path, e))
+    except ValueError as e:
+        raise MalformedDump("%s is not valid JSON: %s" % (path, e))
+    if not isinstance(rec, dict):
+        raise MalformedDump("%s: top-level value is not an object"
+                            % path)
+    missing = [k for k in REQUIRED if k not in rec]
+    if missing:
+        raise MalformedDump("%s: missing required keys: %s"
+                            % (path, ", ".join(missing)))
+    if not str(rec.get("schema", "")).startswith(SCHEMA_PREFIX):
+        raise MalformedDump("%s: schema %r is not a %s* dump"
+                            % (path, rec.get("schema"), SCHEMA_PREFIX))
+    if not isinstance(rec["events"], list) \
+            or not isinstance(rec["spans"], list) \
+            or not isinstance(rec["counters"], dict):
+        raise MalformedDump("%s: events/spans/counters have the wrong "
+                            "shape" % path)
+    return rec
+
+
+def counter_deltas(rec):
+    """{counter: delta} over the dump's time-series window; cumulative
+    counters when no sampler samples rode along."""
+    totals = {}
+    for sample in rec.get("series") or []:
+        for k, v in (sample.get("counters") or {}).items():
+            totals[k] = totals.get(k, 0) + v
+    if totals:
+        return totals, "series window (%d samples)" % len(rec["series"])
+    return dict(rec["counters"]), "cumulative counters (no sampler ran)"
+
+
+def _span_req_ids(span):
+    ctx = span.get("ctx") or {}
+    if ctx.get("req_id") is not None:
+        return [ctx["req_id"]]
+    return list(ctx.get("req_ids") or [])
+
+
+def request_breakdown(rec):
+    """Per-request latency breakdown from the causal span ring:
+    [{req_id, total_ms, wait_ms, batch_ms, d2h_ms, resolve_ms,
+    pad_rows, bucket}] sorted slowest-total first. ``resolve_ms`` is
+    the total minus the named phases — queueing on the resolver pool
+    plus slicing (the "inflight" slack)."""
+    per = {}
+    for span in rec["spans"]:
+        name = span.get("name")
+        if name not in ("serve_wait", "serve_batch", "serve_d2h",
+                        "serve_request"):
+            continue
+        for rid in _span_req_ids(span):
+            d = per.setdefault(rid, {})
+            # a request appears once per phase; keep the max defensively
+            d[name] = max(d.get(name, 0.0), span.get("dur_ms") or 0.0)
+    pads = {}
+    for ev in rec["events"]:
+        if ev.get("kind") != "serving.batch":
+            continue
+        data = ev.get("data") or {}
+        for rid in data.get("req_ids") or []:
+            pads[rid] = {"pad_rows": data.get("pad_rows"),
+                         "bucket": data.get("bucket")}
+    out = []
+    for rid, d in per.items():
+        total = d.get("serve_request")
+        if total is None:
+            continue          # still in flight when the process died
+        wait = d.get("serve_wait", 0.0)
+        batch = d.get("serve_batch", 0.0)
+        d2h = d.get("serve_d2h", 0.0)
+        out.append({
+            "req_id": rid,
+            "total_ms": round(total, 3),
+            "wait_ms": round(wait, 3),
+            "batch_ms": round(batch, 3),
+            "d2h_ms": round(d2h, 3),
+            "resolve_ms": round(max(0.0, total - wait - batch - d2h),
+                                3),
+            "pad_rows": pads.get(rid, {}).get("pad_rows"),
+            "bucket": pads.get(rid, {}).get("bucket"),
+        })
+    out.sort(key=lambda r: -r["total_ms"])
+    return out
+
+
+def summarize(rec):
+    """The machine-readable summary ``--json`` emits."""
+    deltas, source = counter_deltas(rec)
+    top = sorted(deltas.items(), key=lambda kv: -abs(kv[1]))
+    return {
+        "reason": rec["reason"],
+        "ts": rec["ts"],
+        "pid": rec.get("pid"),
+        "exception": rec.get("exception"),
+        "extra": rec.get("extra"),
+        "top_counters": top[:TOP_COUNTERS],
+        "counters_source": source,
+        "n_events": len(rec["events"]),
+        "n_spans": len(rec["spans"]),
+        "n_series": len(rec.get("series") or []),
+        "slowest_requests": request_breakdown(rec)[:SLOWEST_REQUESTS],
+        "engines": rec.get("engines"),
+        "faults": rec.get("faults"),
+    }
+
+
+def _fmt_ts(epoch_s):
+    try:
+        return time.strftime("%Y-%m-%d %H:%M:%S",
+                             time.localtime(epoch_s))
+    except (TypeError, ValueError, OverflowError):
+        return str(epoch_s)
+
+
+def _fmt_data(data, width=72):
+    if not data:
+        return ""
+    text = json.dumps(data, sort_keys=True)
+    return text if len(text) <= width else text[:width - 1] + "…"
+
+
+def render(rec, out=sys.stdout):
+    w = out.write
+    exc = rec.get("exception") or {}
+    w("flight postmortem: %s\n" % rec["reason"])
+    w("  at %s (pid %s)\n" % (_fmt_ts(rec["ts"]), rec.get("pid")))
+    if exc:
+        w("  exception: %s: %s\n" % (exc.get("type"),
+                                     (exc.get("message") or "")[:200]))
+        if exc.get("fault_site"):
+            w("  injected fault site: %s\n" % exc["fault_site"])
+    extra = rec.get("extra")
+    if extra:
+        w("  extra: %s\n" % _fmt_data(extra, width=200))
+
+    events = rec["events"][-TIMELINE_EVENTS:]
+    w("\nevent timeline (last %d of %d; dt = seconds before dump):\n"
+      % (len(events), len(rec["events"])))
+    for ev in events:
+        dt = rec["ts"] - ev.get("ts", rec["ts"])
+        w("  -%7.3fs  %-24s %s\n"
+          % (dt, ev.get("kind", "?"), _fmt_data(ev.get("data"))))
+    if not events:
+        w("  (empty ring)\n")
+
+    deltas, source = counter_deltas(rec)
+    w("\ntop counter deltas — %s:\n" % source)
+    for name, val in sorted(deltas.items(),
+                            key=lambda kv: -abs(kv[1]))[:TOP_COUNTERS]:
+        w("  %-44s %12s\n" % (name, val))
+    if not deltas:
+        w("  (none)\n")
+
+    reqs = request_breakdown(rec)
+    w("\nslowest requests (of %d resolved in the ring; ms):\n"
+      % len(reqs))
+    w("  %8s %9s %9s %9s %9s %9s %5s\n"
+      % ("req_id", "total", "wait", "batch", "d2h", "resolve", "pad"))
+    for r in reqs[:SLOWEST_REQUESTS]:
+        w("  %8s %9.2f %9.2f %9.2f %9.2f %9.2f %5s\n"
+          % (r["req_id"], r["total_ms"], r["wait_ms"], r["batch_ms"],
+             r["d2h_ms"], r["resolve_ms"],
+             "-" if r["pad_rows"] is None else r["pad_rows"]))
+    if not reqs:
+        w("  (no resolved requests in the span ring)\n")
+
+    engines = rec.get("engines") or []
+    if engines:
+        w("\nengines:\n")
+        for e in engines:
+            w("  queued_rows=%s/%s breaker_open=%s "
+              "consecutive_failures=%s closed=%s\n"
+              % (e.get("queued_rows"), e.get("max_queue_rows"),
+                 e.get("breaker_open"), e.get("consecutive_failures"),
+                 e.get("closed")))
+    faults = rec.get("faults") or {}
+    if faults.get("spec"):
+        w("\nfault registry: spec=%r counts=%s\n"
+          % (faults["spec"], faults.get("counts")))
+    w("\n")
+
+
+def main(argv):
+    args = [a for a in argv[1:] if not a.startswith("--")]
+    as_json = "--json" in argv[1:]
+    bad = [a for a in argv[1:] if a.startswith("--") and a != "--json"]
+    if bad or len(args) != 1:
+        print("usage: flight_view.py POSTMORTEM.json [--json]",
+              file=sys.stderr)
+        return 2
+    try:
+        rec = load_dump(args[0])
+    except MalformedDump as e:
+        print("flight_view: malformed dump: %s" % e, file=sys.stderr)
+        return 2
+    if as_json:
+        json.dump(summarize(rec), sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        render(rec)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main(sys.argv))
+    except BrokenPipeError:
+        # `flight_view.py dump | head` closes our stdout mid-render —
+        # that's the reader's prerogative, not an error. Point stdout
+        # at devnull so interpreter shutdown doesn't re-raise.
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+        sys.exit(0)

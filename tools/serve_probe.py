@@ -33,6 +33,19 @@ Chaos-smoke lane:   python tools/serve_probe.py --chaos-smoke \
   counters > 0 at 2x offered load, admitted-request p99 <= the
   configured deadline, and the injected-fault telemetry counter equals
   the registry's exact fire count.)
+
+Postmortem-smoke lane:  python tools/serve_probe.py --postmortem-smoke \
+                            [--json-out PATH]
+  (tier-1 CI for the FLIGHT RECORDER, ISSUE 10: the chaos ladder runs
+  with the metrics sampler on and an injected TERMINAL dispatch fault
+  — ``dispatch:raise:first=K`` outlasting the retry budget, so one
+  batch fails for good. Gates: a postmortem JSON appears in the flight
+  dir, ``tools/flight_view.py`` parses it (and REJECTS a corrupted
+  copy non-zero), the dump names the injected fault's site and exactly
+  the dying batch's member req_ids, the sampler banked a non-empty
+  time-series window, and the measured flight-recorder work — causal-
+  id spans, events, sampler ticks — stays under the <2% telemetry
+  overhead guard.)
 """
 import json
 import os
@@ -507,6 +520,215 @@ def chaos_smoke(json_out=None, n_req=CHAOS_N_REQ):
     return out
 
 
+# postmortem-smoke knobs: the raise rule must outlast the retry budget
+# on ONE batch (initial attempt + retry_budget retries all land inside
+# first=K) so the failure is TERMINAL; the delay keeps the CPU lane's
+# capacity overloadable like the chaos lane
+PM_RETRY_BUDGET = 1
+PM_RAISE_FIRST = PM_RETRY_BUDGET + 2     # every attempt of batch 1 + slack
+PM_SPEC_TERMINAL = "%s;dispatch:raise:first=%d" % (CHAOS_SPEC,
+                                                   PM_RAISE_FIRST)
+PM_SAMPLER_MS = 25.0
+PM_N_REQ = 192
+PM_OVERHEAD_FRAC = 0.02
+
+
+def postmortem_smoke(json_out=None, n_req=PM_N_REQ):
+    """The flight-recorder acceptance lane (ISSUE 10)."""
+    import subprocess as _subprocess
+    from mxnet_tpu import faults, flight
+    sym = _mlp()
+    params = _params(sym)
+    rng = np.random.RandomState(1)
+    reqs = [rng.normal(size=(1, D)).astype(np.float32)
+            for _ in range(64)]
+    art_dir = os.environ.get("MXTPU_ARTIFACT_DIR", "/tmp/mxtpu_artifacts")
+    fdir = os.path.join(art_dir, "flight")
+    os.makedirs(fdir, exist_ok=True)
+    for name in os.listdir(fdir):          # this RUN's dumps only
+        if name.startswith("postmortem-") and name.endswith(".json"):
+            os.unlink(os.path.join(fdir, name))
+    telemetry.enable()
+    telemetry.reset()
+    flight.configure(fdir)
+    flight.series_clear()
+    flight.sampler_start(PM_SAMPLER_MS)
+    out = {
+        "lane": "postmortem_smoke",
+        "platform": jax.devices()[0].platform,
+        "n_requests": n_req,
+        "max_batch": MAX_BATCH,
+        "fault_spec": PM_SPEC_TERMINAL,
+        "flight_dir": fdir,
+        "sampler_interval_ms": PM_SAMPLER_MS,
+    }
+    engine = InferenceEngine(
+        sym, params, {"data": (1, D)}, max_batch=MAX_BATCH,
+        max_wait_ms=1.0, max_inflight=4,
+        max_queue_rows=CHAOS_QUEUE_ROWS,
+        deadline_ms=CHAOS_DEADLINE_MS, overload="shed",
+        retry_budget=PM_RETRY_BUDGET, retry_backoff_ms=1.0,
+        breaker_threshold=0)       # the TERMINAL failure is the story,
+                                   # not a breaker fast-fail masking it
+    try:
+        # phase 1: the terminal fault — one batch's every attempt
+        # raises, its futures fail, the flight recorder dumps
+        faults.configure(PM_SPEC_TERMINAL)
+        doomed = [engine.submit(data=reqs[i % len(reqs)])
+                  for i in range(6)]
+        engine.flush()
+        failed_rids = []
+        for f in doomed:
+            try:
+                f.result(timeout=120)
+            except Exception:
+                failed_rids.append(f.req_id)
+        out["failed_requests"] = len(failed_rids)
+        out["failed_req_ids"] = sorted(failed_rids)
+
+        # phase 2: the chaos ladder under the delay throttle (faults
+        # still active minus the spent raise rule) — closed-loop waves
+        # like the chaos capacity phase; zero hung futures gates the
+        # recorder added no new stalls
+        faults.configure(CHAOS_SPEC)
+        t0 = time.perf_counter()
+        hung = 0
+        done = 0
+        while done < n_req:
+            wave = min(CHAOS_QUEUE_ROWS // 2, n_req - done)
+            futs = [engine.submit(data=reqs[i % len(reqs)])
+                    for i in range(wave)]
+            engine.flush()
+            for f in futs:
+                try:
+                    f.result(timeout=120)
+                except Exception:
+                    pass
+                if not f.done():
+                    hung += 1
+            done += wave
+        wall = time.perf_counter() - t0
+        out["ladder_req_s"] = round(done / wall, 1)
+        out["hung"] = hung
+
+        # phase 3: the flight-recorder work model (the <2% guard with
+        # the SAMPLER and CAUSAL IDS on): count the recorder ops the
+        # ladder actually performed, microbenchmark their unit costs
+        # (min over reps — throttle only inflates), and bound
+        # ops x cost against the measured wall
+        span_ops = sum(telemetry.span_count(n)
+                       for n in telemetry.span_stats())
+        # one counter_inc per event regardless of the value added:
+        # byte-valued counters (pad_bytes, h2d_bytes) are one op per
+        # event too, and their event counts already ride in the
+        # sibling unit counters — summing their VALUES would model
+        # each byte as a registry op
+        counter_ops = sum(v for k, v in telemetry.counters().items()
+                          if k.startswith(("serving.", "dispatch.",
+                                           "faults.", "transfer."))
+                          and not k.endswith("_bytes"))
+        event_ops = len(telemetry.events())
+        ticks = len(flight.series())
+
+        def op_cost(fn, iters=4000, reps=5):
+            best = float("inf")
+            for _ in range(reps):
+                t1 = time.perf_counter_ns()
+                for _ in range(iters):
+                    fn()
+                best = min(best, (time.perf_counter_ns() - t1) / iters)
+            return best / 1e9
+
+        ctx = {"req_id": 1}
+
+        def one_span():
+            with telemetry.span("_pm_probe", ctx=ctx):
+                pass
+
+        span_s = op_cost(one_span)
+        counter_s = op_cost(
+            lambda: telemetry.counter_inc("_pm_probe"))   # mxlint: disable=registry-consistency -- microbench probe counter (cost measurement), never a production metric
+
+        event_s = op_cost(
+            lambda: telemetry.record_event("_pm_probe", req_id=1))
+        tick_s = op_cost(lambda: flight._build_sample({}, 0.025),
+                         iters=200)
+        overhead_s = (span_ops * span_s + counter_ops * counter_s
+                      + event_ops * event_s + ticks * tick_s)
+        out["overhead"] = {
+            "span_ops": span_ops, "counter_ops": counter_ops,
+            "event_ops": event_ops, "sampler_ticks": ticks,
+            "span_us": round(span_s * 1e6, 3),
+            "counter_us": round(counter_s * 1e6, 3),
+            "event_us": round(event_s * 1e6, 3),
+            "tick_us": round(tick_s * 1e6, 3),
+            "work_ms": round(overhead_s * 1e3, 3),
+            "wall_s": round(wall, 3),
+            "frac": round(overhead_s / wall, 5),
+            "gate": PM_OVERHEAD_FRAC,
+        }
+    finally:
+        faults.clear()
+        flight.sampler_stop()
+        engine.close()
+        flight.configure(None)
+
+    out["series_window"] = flight.series_window(60)
+    pm_path = flight.last_postmortem()
+    out["postmortem_path"] = pm_path
+
+    view = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "flight_view.py")
+
+    def run_view(path, extra=()):
+        return _subprocess.run(
+            [sys.executable, view, path, *extra],
+            stdout=_subprocess.PIPE, stderr=_subprocess.PIPE,
+            text=True, timeout=60)
+
+    try:
+        # gate 1: the terminal fault produced a postmortem that PARSES
+        assert pm_path is not None and os.path.exists(pm_path), pm_path
+        proc = run_view(pm_path, ("--json",))
+        assert proc.returncode == 0, proc.stderr[-1000:]
+        summary = json.loads(proc.stdout)
+        out["view_summary"] = {k: summary.get(k) for k in
+                               ("reason", "exception", "extra",
+                                "n_events", "n_spans", "n_series")}
+        # gate 2: the dump names the injected fault's site...
+        assert summary["reason"] == "serving_dispatch_failure", summary
+        assert summary["exception"]["fault_site"] == "dispatch", summary
+        # ...and exactly the dying batch's member req_ids
+        assert failed_rids, "terminal fault failed no requests"
+        assert sorted(summary["extra"]["req_ids"]) \
+            == sorted(failed_rids), (summary["extra"], failed_rids)
+        # gate 3: a corrupted dump is REJECTED non-zero
+        bad = pm_path + ".corrupt"
+        with open(pm_path) as f:
+            with open(bad, "w") as g:
+                g.write(f.read()[:200])   # truncated JSON
+        proc_bad = run_view(bad)
+        os.unlink(bad)
+        assert proc_bad.returncode != 0, "flight_view accepted garbage"
+        # gate 4: the sampler banked a real time-series window
+        assert out["series_window"]["n"] > 0, out["series_window"]
+        # gate 5: zero hung futures, and the recorder work fits the
+        # existing <2% telemetry overhead guard
+        assert out["hung"] == 0, out
+        assert out["overhead"]["frac"] < PM_OVERHEAD_FRAC, out["overhead"]
+        out["gates_passed"] = True
+    except AssertionError:
+        out["gates_passed"] = False
+        raise
+    finally:
+        line = json.dumps(out)
+        print(line, flush=True)
+        if json_out:
+            with open(json_out, "w") as f:
+                f.write(line + "\n")
+    return out
+
+
 def _json_out_arg():
     if "--json-out" not in sys.argv:
         return None
@@ -525,6 +747,9 @@ if __name__ == "__main__":
         warm_child()
     elif "--chaos-smoke" in sys.argv:
         chaos_smoke(json_out=_json_out_arg())
+    elif "--postmortem-smoke" in sys.argv:
+        postmortem_smoke(json_out=_json_out_arg())
     else:
         raise SystemExit("usage: serve_probe.py --serve-smoke|"
-                         "--warm-smoke|--chaos-smoke [--json-out PATH]")
+                         "--warm-smoke|--chaos-smoke|--postmortem-smoke"
+                         " [--json-out PATH]")
