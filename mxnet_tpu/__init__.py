@@ -24,20 +24,14 @@ if _os.environ.get("MXNET_TPU_FORCE_CPU", "") in ("1", "true"):
     import jax as _jax
     _jax.config.update("jax_platforms", "cpu")
 
-if _os.environ.get("MXNET_TPU_COORDINATOR"):
-    # multi-process SPMD wiring, set by tools/launch.py (parity:
-    # KVStore::InitPSEnv reading DMLC_PS_ROOT_URI etc., kvstore.h:254).
-    # Must run before any backend touch, hence at import.
-    import jax as _jax
-
-    if not _jax.distributed.is_initialized():
-        # connection errors propagate: a worker that cannot reach the
-        # coordinator must die loudly, not train as a 1-process job
-        _jax.distributed.initialize(
-            coordinator_address=_os.environ["MXNET_TPU_COORDINATOR"],
-            num_processes=int(
-                _os.environ.get("MXNET_TPU_NUM_PROCESSES", "1")),
-            process_id=int(_os.environ.get("MXNET_TPU_PROCESS_ID", "0")))
+# multi-process SPMD wiring, set by tools/launch.py (parity:
+# KVStore::InitPSEnv reading DMLC_PS_ROOT_URI etc., kvstore.h:254).
+# Must run before any backend touch, hence at import. A no-op without
+# MXNET_TPU_COORDINATOR; connection errors propagate — a worker that
+# cannot reach the coordinator must die loudly, not train as a
+# 1-process job. See mxnet_tpu/dist.py for the elastic posture.
+from . import dist
+dist.init_from_env()
 
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus

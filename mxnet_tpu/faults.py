@@ -21,6 +21,13 @@ site                      where it fires
 ``io_next``               ``io.DataIter.__next__`` — one batch produced by
                           the input pipeline
 ``kv_push``               ``kvstore.KVStore.push`` — one gradient push
+``kv_collective``         ``heartbeat.CollectiveGate.arrive_and_wait`` —
+                          every pre-collective gate crossing (a raise kills
+                          the worker BEFORE it publishes its arrival, so
+                          peers see a deterministic mid-training death)
+``heartbeat``             ``heartbeat.start_heartbeat`` beat loop — a raise
+                          kills the beat thread (a zombie worker: computes,
+                          reads as dead), delay= stretches the beat gap
 ========================  ===================================================
 
 Spec grammar (``MXNET_FAULTS`` env var, or ``configure()``)::
@@ -70,7 +77,8 @@ ENV = "MXNET_FAULTS"
 
 # the named sites the runtime consults — a spec naming anything else is
 # a typo that would otherwise never fire, so parsing rejects it
-SITES = ("dispatch", "d2h", "compile_cache.load", "io_next", "kv_push")
+SITES = ("dispatch", "d2h", "compile_cache.load", "io_next", "kv_push",
+         "kv_collective", "heartbeat")
 
 _ACTIONS = ("raise", "delay", "nan")
 

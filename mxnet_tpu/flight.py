@@ -449,12 +449,27 @@ def _exc_record(exc):
     return rec
 
 
+def _process_identity():
+    """Which worker of a multi-process job wrote this dump (a pod-scale
+    postmortem is read next to its peers' — "whose flight recorder is
+    this" must not require correlating pids with launcher logs). Cheap
+    and import-safe: env-only when the dist runtime is absent."""
+    try:
+        from . import dist as _dist
+        return {"rank": _dist.rank(),
+                "num_processes": _dist.process_count(),
+                "dead_ranks": list(_dist.dead_ranks())}
+    except Exception:
+        return {"rank": 0, "num_processes": 1, "dead_ranks": []}
+
+
 def _build_record(reason, exc=None, extra=None):
     rec = {
         "schema": POSTMORTEM_SCHEMA,
         "reason": reason,
         "ts": round(time.time(), 6),
         "pid": os.getpid(),
+        "process": _process_identity(),
         "argv": list(sys.argv),
         "python": sys.version.split()[0],
         "exception": _exc_record(exc),

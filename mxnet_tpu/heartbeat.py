@@ -1,4 +1,4 @@
-"""Worker liveness heartbeats.
+"""Worker liveness: heartbeats + the pre-collective gate.
 
 Parity: the reference's only failure-visibility surface is
 ``KVStore::get_num_dead_node(node_id, timeout)`` backed by ps-lite
@@ -10,28 +10,125 @@ any process can count peers whose file is stale. ``tools/launch.py``
 provisions the directory for local/ssh jobs (a pod slice shares NFS/GCS
 fuse mounts the same way).
 
-Like the reference, this is VISIBILITY only — a dead worker still hangs
-collectives; recovery is checkpoint-restart (SURVEY.md §5.3/5.4).
+Beyond visibility (the reference stopped there — "a dead worker still
+hangs collectives"), this module is the LIVENESS substrate of elastic
+training: :class:`CollectiveGate` is a bounded-timeout barrier-file
+protocol every worker crosses BEFORE entering a cross-process
+collective. A peer that never arrives and whose heartbeat has gone
+stale raises :class:`DeadWorkerError` naming the dead ranks — the
+survivors abort the step they never entered (nothing hangs), re-mesh
+over the live membership and resume from the last atomic checkpoint
+(``Module.fit`` elastic path). Two failure-injection sites ride here:
+``kv_collective`` (fired at every gate crossing — the chaos lane's
+deterministic rank kill) and ``heartbeat`` (fired per beat — a raise
+kills the beat thread, simulating a zombie worker that computes but
+reads as dead).
+
+Staleness is judged against the FILESYSTEM's clock, not the reader's:
+ages compare a worker file's mtime to the mtime of a probe file the
+reader just wrote into the same directory. On NFS/GCS-fuse — exactly
+where this runs — a reader wall clock skewed from the file server
+would otherwise read every live peer as dead (or a dead one as
+forever-live). The beat payload's ``time.time()`` text is
+informational only.
 """
 from __future__ import annotations
 
 import os
+import re
 import threading
 import time
 
-__all__ = ["start_heartbeat", "stop_heartbeat", "count_dead"]
+from .base import MXNetError
+
+__all__ = ["start_heartbeat", "stop_heartbeat", "count_dead",
+           "alive_ranks", "stale_ranks", "CollectiveGate",
+           "DeadWorkerError"]
 
 ENV_DIR = "MXTPU_HEARTBEAT_DIR"
+ENV_INTERVAL = "MXTPU_HEARTBEAT_INTERVAL"
+ENV_TIMEOUT = "MXTPU_HEARTBEAT_TIMEOUT"
+ENV_GATE_TIMEOUT = "MXTPU_GATE_TIMEOUT"
 DEFAULT_INTERVAL = 1.0
+DEFAULT_TIMEOUT = 10.0
+# a peer missing from the gate whose heartbeat stays FRESH is slow
+# (compiling, GC pause), not dead — wait for it up to this hard cap
+DEFAULT_GATE_TIMEOUT = 300.0
+
+_WORKER_RE = re.compile(r"^worker-(\d+)$")
 
 _state = {"thread": None, "stop": None, "path": None}
+
+
+class DeadWorkerError(MXNetError):
+    """A cross-process collective was aborted before entry: peer
+    worker(s) are missing from the gate and their heartbeats are stale
+    (``ranks``), or the gate's hard timeout expired (``timed_out`` with
+    the still-missing ranks). ``channel``/``generation`` locate the
+    collective; ``epoch``/``nbatch`` are stamped by the fit loop where
+    known."""
+
+    def __init__(self, ranks, channel=None, generation=None,
+                 timed_out=False, evidence=None):
+        self.ranks = tuple(sorted(int(r) for r in ranks))
+        self.channel = channel
+        self.generation = generation
+        self.timed_out = timed_out
+        self.evidence = dict(evidence or {})
+        self.epoch = None
+        self.nbatch = None
+        what = ("gate timeout waiting for worker(s) %s (heartbeats still "
+                "fresh — raising anyway after the hard cap)"
+                if timed_out else
+                "worker(s) %s are dead (missing from the gate, heartbeat "
+                "stale)")
+        ev = ""
+        if self.evidence:
+            ev = " evidence: " + ", ".join(
+                "rank %s: %s" % (r, e)
+                for r, e in sorted(self.evidence.items()))
+        super().__init__(
+            ("collective aborted before entry: " + what +
+             " [channel=%r generation=%s].%s Surviving workers should "
+             "re-mesh and resume from the last checkpoint.")
+            % (list(self.ranks), channel, generation, ev))
 
 
 def _path(root, rank):
     return os.path.join(root, "worker-%d" % int(rank))
 
 
-def start_heartbeat(rank, root=None, interval=DEFAULT_INTERVAL):
+def _interval(interval):
+    if interval is not None:
+        return float(interval)
+    return float(os.environ.get(ENV_INTERVAL, DEFAULT_INTERVAL))
+
+
+def _timeout(timeout):
+    if timeout is not None:
+        return float(timeout)
+    return float(os.environ.get(ENV_TIMEOUT, DEFAULT_TIMEOUT))
+
+
+def _fs_now(root):
+    """The shared directory's OWN notion of "now": the mtime of a probe
+    file this process just wrote there. Comparing worker-file mtimes
+    against this (instead of the reader's ``time.time()``) makes
+    staleness immune to wall-clock skew between the reader and the
+    file server. Falls back to the local clock when the directory
+    is unwritable."""
+    probe = os.path.join(root, ".clock-probe-%d" % os.getpid())
+    tmp = probe + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            f.write("probe")
+        os.replace(tmp, probe)
+        return os.path.getmtime(probe)
+    except OSError:
+        return time.time()
+
+
+def start_heartbeat(rank, root=None, interval=None):
     """Start (idempotently) the daemon heartbeat for this process."""
     root = root or os.environ.get(ENV_DIR)
     if not root or _state["thread"] is not None:
@@ -39,15 +136,21 @@ def start_heartbeat(rank, root=None, interval=DEFAULT_INTERVAL):
     os.makedirs(root, exist_ok=True)
     path = _path(root, rank)
     stop = threading.Event()
+    interval = _interval(interval)
 
     def beat():
         # ATOMIC beat: write temp + rename. The old open(path, "w")
-        # truncated in place, so a concurrent count_dead() could stat
+        # truncated in place, so a concurrent staleness read could stat
         # the file mid-rewrite and read a zero-length/zero-mtime worker
         # as dead — on shared filesystems (NFS/GCS fuse, exactly where
         # this runs) the truncate→write window is milliseconds wide.
+        from . import faults
         tmp = path + ".tmp"
         while not stop.is_set():
+            # chaos site: a raise kills THIS thread — the worker keeps
+            # computing but its file goes stale, the zombie the liveness
+            # tier must treat as dead; delay= stretches the beat gap
+            faults.fire("heartbeat")
             try:
                 with open(tmp, "w") as f:
                     f.write(str(time.time()))
@@ -88,21 +191,205 @@ def stop_heartbeat():
                 pass
 
 
+def _scan(root, timeout):
+    """ONE pass over the heartbeat directory: ``(alive_set, ages)``
+    with a single probe write (every caller needing both freshness and
+    evidence ages shares it — per-poll double probe writes would be
+    sustained metadata churn on exactly the NFS/GCS mounts this
+    targets). Scans exact ``worker-<N>`` names — a leftover
+    ``worker-N.tmp`` from a writer that died mid-rename (or any other
+    stray file) is ignored — and judges freshness against the
+    directory's own clock (see :func:`_fs_now`)."""
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return set(), {}
+    now = _fs_now(root)
+    alive, ages = set(), {}
+    for name in names:
+        m = _WORKER_RE.match(name)
+        if not m:
+            continue
+        try:
+            age = now - os.path.getmtime(os.path.join(root, name))
+        except OSError:
+            continue
+        ages[int(m.group(1))] = age
+        if age <= timeout:
+            alive.add(int(m.group(1)))
+    return alive, ages
+
+
+def alive_ranks(root=None, timeout=None):
+    """Set of worker ranks with a FRESH heartbeat file (see
+    :func:`_scan` for the clock and ``.tmp`` discipline)."""
+    root = root or os.environ.get(ENV_DIR)
+    if not root:
+        return set()
+    return _scan(root, _timeout(timeout))[0]
+
+
+def stale_ranks(ranks, root=None, timeout=None):
+    """The subset of ``ranks`` whose heartbeat file is missing or
+    stale (same clock discipline as :func:`alive_ranks`)."""
+    root = root or os.environ.get(ENV_DIR)
+    if not root:
+        return []
+    alive = alive_ranks(root=root, timeout=timeout)
+    return [int(r) for r in ranks if int(r) not in alive]
+
+
 def count_dead(num_workers, root=None, timeout=None):
-    """Number of workers whose heartbeat is missing or older than
-    ``timeout`` seconds (parity: get_num_dead_node)."""
+    """Number of workers in ``range(num_workers)`` whose heartbeat is
+    missing or older than ``timeout`` seconds (parity:
+    get_num_dead_node). Staleness is judged against the heartbeat
+    directory's own clock and leftover ``*.tmp`` artifacts never count
+    as live workers."""
     root = root or os.environ.get(ENV_DIR)
     if not root:
         return 0
-    timeout = float(timeout if timeout is not None
-                    else os.environ.get("MXTPU_HEARTBEAT_TIMEOUT", 10.0))
-    now = time.time()
-    dead = 0
-    for rank in range(int(num_workers)):
-        path = _path(root, rank)
+    return len(stale_ranks(range(int(num_workers)), root=root,
+                           timeout=timeout))
+
+
+class CollectiveGate:
+    """Bounded-timeout barrier-file protocol crossed BEFORE every
+    cross-process collective.
+
+    Each member owns ONE file per channel
+    (``gate-<channel>-<members>/rank-<r>``) holding its latest
+    generation number, rewritten atomically each crossing — no per-step
+    file accumulation. ``arrive_and_wait()`` bumps the local
+    generation, publishes it, and polls until every peer's published
+    generation reaches it (a peer racing ahead has necessarily passed
+    this generation). A peer that has not arrived is judged by its
+    HEARTBEAT: stale → :class:`DeadWorkerError` naming the dead ranks
+    (the caller aborts the step it never entered — nothing hangs);
+    fresh → keep waiting (slow ≠ dead) up to the hard cap
+    (``MXTPU_GATE_TIMEOUT``), then raise with ``timed_out=True``.
+
+    The directory name embeds the member set, so a re-meshed group
+    (after a member loss) opens a fresh namespace and the dead peer's
+    old generation file cannot satisfy a new-generation wait.
+
+    The ``kv_collective`` fault site fires at every crossing BEFORE the
+    arrival is published: an injected raise kills this worker at a
+    deterministic collective index and its peers observe exactly what a
+    real mid-training death looks like — an absent arrival and a
+    heartbeat going stale.
+    """
+
+    def __init__(self, rank, members, root=None, channel="step",
+                 timeout=None, gate_timeout=None, poll=0.05):
+        self.rank = int(rank)
+        self.members = tuple(sorted(int(m) for m in members))
+        self.channel = str(channel)
+        self.root = root or os.environ.get(ENV_DIR)
+        self.timeout = _timeout(timeout)
+        self.gate_timeout = float(
+            gate_timeout if gate_timeout is not None
+            else os.environ.get(ENV_GATE_TIMEOUT, DEFAULT_GATE_TIMEOUT))
+        self.poll = float(poll)
+        self.generation = 0
+        # ranks whose heartbeat this gate has EVER observed: a missing
+        # file is only evidence of death for a peer we once saw — a
+        # slow joiner (still importing jax while we cross the first
+        # gate) has no file yet and must not read as dead
+        self._seen = set()
+        self._dir = None
+        if self.root:
+            tag = "-".join(str(m) for m in self.members)
+            self._dir = os.path.join(
+                self.root, "gate-%s-%s" % (self.channel, tag))
+
+    @property
+    def enabled(self):
+        """The file protocol needs the shared heartbeat directory and a
+        peer to guard against; otherwise crossings are (fault-site
+        consults followed by) no-ops."""
+        return self._dir is not None and len(self.members) > 1
+
+    def _member_path(self, rank):
+        return os.path.join(self._dir, "rank-%d" % int(rank))
+
+    def _publish(self, gen):
+        os.makedirs(self._dir, exist_ok=True)
+        path = self._member_path(self.rank)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(int(gen)))
+        os.replace(tmp, path)
+
+    def _peer_gen(self, rank):
         try:
-            if now - os.path.getmtime(path) > timeout:
-                dead += 1
-        except OSError:
-            dead += 1
-    return dead
+            with open(self._member_path(rank)) as f:
+                return int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            return -1
+
+    def arrive_and_wait(self):
+        """Cross the gate for the next collective. Returns the
+        generation entered; raises :class:`DeadWorkerError` instead of
+        letting the caller enter a collective a dead peer can never
+        join."""
+        from . import faults
+        # the chaos kill point: BEFORE publishing the arrival, so a
+        # killed worker is missing from this generation on every peer
+        faults.fire("kv_collective")
+        self.generation += 1
+        if not self.enabled:
+            return self.generation
+        gen = self.generation
+        self._publish(gen)
+        deadline = time.monotonic() + self.gate_timeout
+        peers = [m for m in self.members if m != self.rank]
+        # liveness verdicts need a directory scan + probe write — keep
+        # those to a few per second even while the arrival files poll
+        # fast (a slow-but-live peer can keep us here for minutes)
+        liveness_every = max(self.poll, 0.25)
+        next_liveness = time.monotonic()
+        while True:
+            missing = [p for p in peers if self._peer_gen(p) < gen]
+            if not missing:
+                return gen
+            if time.monotonic() >= next_liveness:
+                next_liveness = time.monotonic() + liveness_every
+                dead = self._dead_among(missing)
+                if dead:
+                    raise DeadWorkerError([r for r, _ in dead],
+                                          channel=self.channel,
+                                          generation=gen,
+                                          evidence=dict(dead))
+            if time.monotonic() > deadline:
+                raise DeadWorkerError(missing, channel=self.channel,
+                                      generation=gen, timed_out=True)
+            time.sleep(self.poll)
+
+    def _dead_among(self, ranks):
+        """``[(rank, evidence), ...]`` for the subset of ``ranks`` with
+        EVIDENCE of death: a stale existing heartbeat file (beats
+        stopped), or no file for a peer this gate has seen before
+        (crashed-and-cleaned or departed). A never-seen peer with no
+        file is a slow joiner — startup skew under load is not death;
+        the hard ``gate_timeout`` bounds how long we extend that
+        benefit of the doubt. The evidence string (file age vs the
+        directory clock) rides in the error: a false-positive report
+        must be diagnosable from one log line."""
+        alive, ages = _scan(self.root, self.timeout)
+        self._seen |= alive
+        dead = []
+        for r in ranks:
+            if int(r) in alive:
+                continue
+            age = ages.get(int(r))
+            if age is not None:
+                if age > self.timeout:
+                    dead.append((int(r),
+                                 "heartbeat file %.2fs stale (timeout "
+                                 "%.2fs)" % (age, self.timeout)))
+                # a fresh-but-not-alive age cannot happen from one
+                # scan; kept for clarity: fresh means not dead
+            elif int(r) in self._seen:
+                dead.append((int(r), "heartbeat file removed after "
+                                     "being seen alive"))
+        return dead

@@ -43,8 +43,14 @@ class KVStore:
         self._compression_params = None
         self._compression = None
         # bytes this process contributed to the last dist push's wire
-        # payload (0 for non-dist stores)
+        # payload (0 for non-dist stores); _raw is the uncompressed
+        # equivalent, so wire/raw is the live compression ratio
         self.wire_bytes_last_push = 0
+        self.wire_bytes_last_push_raw = 0
+        # elastic membership: None = every launched rank; a tuple after
+        # _remesh() dropped dead members
+        self._live_ranks = None
+        self._gate = None
         if kv_type.startswith("dist"):
             # liveness surface (parity: ps-lite scheduler heartbeats
             # behind get_num_dead_node, kvstore.h:338)
@@ -65,12 +71,22 @@ class KVStore:
     @property
     def num_workers(self):
         if self.type.startswith("dist"):
+            if self._live_ranks is not None:
+                return len(self._live_ranks)
             try:
                 import jax
                 return jax.process_count()
             except Exception:
                 return int(os.environ.get("DMLC_NUM_WORKER", 1))
         return 1
+
+    @property
+    def live_ranks(self):
+        """Current worker membership: every launched rank until
+        :meth:`_remesh` drops dead members."""
+        if self._live_ranks is not None:
+            return self._live_ranks
+        return tuple(range(self.num_workers))
 
     @property
     def fused_step_subsumable(self):
@@ -80,17 +96,112 @@ class KVStore:
         because the dp Module compiles ONE mesh-sharded program whose
         gradients come out of the step already all-reduced over ICI, so
         the software push/pull is an identity round-trip). ``dist_*``
-        stores cross worker processes outside the compiled program and
-        gradient compression changes the pushed values — both must keep
-        the explicit push/pull path."""
+        sync stores are subsumed the same way on a PROCESS-SPANNING
+        mesh (:attr:`fused_dist_step`); gradient compression changes
+        the pushed values and must keep the explicit wire path."""
         return not self.type.startswith("dist") and self._compression is None
+
+    @property
+    def fused_dist_step(self):
+        """True when the fused donated-buffer train step may span
+        worker processes for this store: the synchronous ``dist_*``
+        types, uncompressed. The SAME one-program step then jits over a
+        process-spanning dp mesh and XLA inserts the cross-host
+        gradient psum INSIDE the step — no software push/pull, the wire
+        is the compiled collective. ``dist_async`` keeps the explicit
+        path (its server-side async application is emulated over the
+        wire; SURVEY.md §2.3), and compression keeps it because the
+        2-bit/fp16 payload transform is part of the wire protocol."""
+        return (self.type.startswith("dist")
+                and self.type != "dist_async"
+                and self._compression is None)
+
+    def _remesh(self, live_ranks):
+        """Adopt the surviving membership after a member loss: the
+        worker count, the pre-collective gate and the compiled exchange
+        programs all rebuild against the new (smaller) process set."""
+        self._live_ranks = tuple(sorted(int(r) for r in live_ranks))
+        self._gate = None
+        self._reduce_cache = {}
+
+    def _collective_gate(self):
+        """The pre-collective liveness gate for the explicit dist wire
+        (lazy; rebuilt on remesh). See heartbeat.CollectiveGate."""
+        if self._gate is None:
+            from . import heartbeat
+            self._gate = heartbeat.CollectiveGate(
+                self.rank, self.live_ranks, channel="kv")
+        return self._gate
+
+    def _host_allgather(self, arr):
+        """Gather one small host array from every LIVE process
+        (``(n_live,) + arr.shape``, rank-major). The stock
+        ``multihost_utils.process_allgather`` enumerates every LAUNCHED
+        process — after an elastic re-mesh it would hang forever
+        against the dead members, exactly where the liveness gate just
+        promised nothing can hang — so the exchange runs over the
+        live-filtered ``_proc_mesh`` instead."""
+        import jax
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        a = np.asarray(arr)
+        mesh = self._proc_mesh()
+        if mesh.devices.size <= 1:
+            return a[None]
+        local_dev = next(d for d in mesh.devices.flat
+                         if d.process_index == jax.process_index())
+        local = jax.device_put(a, local_dev)
+        garr = jax.make_array_from_single_device_arrays(
+            (mesh.devices.size,) + a.shape,
+            NamedSharding(mesh, P("proc")), [local[None]])
+        cache = getattr(self, "_reduce_cache", None)
+        if cache is None:
+            cache = self._reduce_cache = {}
+        key = ("host_allgather", a.shape, str(a.dtype),
+               tuple(d.id for d in mesh.devices.flat))
+        fn = cache.get(key)
+        if fn is None:
+            fn = cache[key] = jax.jit(   # mxlint: disable=jit-site -- a bytes-sized host-metadata replication (the live-membership allgather), same component-kernel class as the grandfathered _global_reduce_batch exchange; a program card per tiny gather signature would be noise
+                lambda x: x, out_shardings=NamedSharding(mesh, P()))
+        return np.asarray(fn(garr).addressable_data(0))
 
     # -- core ops ----------------------------------------------------------
     def init(self, key, value):
-        """(parity: kvstore.init) one key or lists of keys/values."""
+        """(parity: kvstore.init) one key or lists of keys/values. In a
+        multi-process dist store, rank 0's value seeds EVERY worker
+        (parity: the ps-lite server is initialised once and workers
+        pull — without this, rank-dependent initialisation would
+        silently train divergent replicas). One host broadcast per key,
+        init-time only; ``MXNET_KVSTORE_DIST_BROADCAST_INIT=0`` opts
+        out. Like every dist operation, init must be called by all
+        workers symmetrically."""
         keys, values = _key_value(key, value)
+        broadcast = None
+        if self.type.startswith("dist") and len(self.live_ranks) > 1 \
+                and os.environ.get("MXNET_KVSTORE_DIST_BROADCAST_INIT",
+                                   "1") != "0":
+            try:
+                import jax
+                from . import dist as _dist
+                # never after a member loss: the broadcast spans EVERY
+                # launched process and a dead one would hang it (the
+                # survivors' values are already consistent — they came
+                # from the same checkpoint restore)
+                if jax.process_count() > 1 and not _dist.dead_ranks():
+                    from .parallel.spmd import broadcast_from_zero
+                    broadcast = broadcast_from_zero
+            except Exception:
+                broadcast = None
         for k, v in zip(keys, values):
             if k in self._store:
+                continue
+            if broadcast is not None and isinstance(v, NDArray) \
+                    and getattr(v, "stype", "default") == "default":
+                import jax.numpy as jnp
+                from .ndarray.ndarray import _wrap
+                synced = broadcast(v.asnumpy())
+                self._store[k] = _wrap(
+                    jnp.asarray(synced).astype(v._data.dtype), v.context)
                 continue
             self._store[k] = v.copy() if isinstance(v, NDArray) else v
 
@@ -114,6 +225,14 @@ class KVStore:
         if self.wire_bytes_last_push:
             telemetry.counter_inc("kvstore.wire_bytes",
                                   self.wire_bytes_last_push)
+            # dist wire accounting: one batched push = one cross-process
+            # collective; raw is the uncompressed-equivalent payload so
+            # wire/raw reads off the live compression ratio
+            telemetry.counter_inc("kvstore.dist.collectives")
+            telemetry.counter_inc("kvstore.dist.wire_bytes",
+                                  self.wire_bytes_last_push)
+            telemetry.counter_inc("kvstore.dist.wire_bytes_raw",
+                                  self.wire_bytes_last_push_raw)
 
     def _push_impl(self, key, value):
         keys, values = _key_value(key, value, allow_list_value=True)
@@ -175,17 +294,18 @@ class KVStore:
             for k, merged in zip(keys, merged_list):
                 self._store[k] = merged.copy()
 
-    # one reduction device per process: the first local device of each,
-    # a consistent choice on every rank
-    @staticmethod
-    def _proc_mesh():
+    # one reduction device per LIVE process: the first local device of
+    # each, a consistent choice on every rank (after an elastic remesh
+    # the dead processes' devices must not enter the exchange mesh)
+    def _proc_mesh(self):
         import jax
         import numpy as np
         from jax.sharding import Mesh
         by_proc = {}
         for d in jax.devices():
             by_proc.setdefault(d.process_index, d)
-        devs = [by_proc[i] for i in sorted(by_proc)]
+        live = set(self.live_ranks)
+        devs = [by_proc[i] for i in sorted(by_proc) if i in live]
         return Mesh(np.array(devs), ("proc",))
 
     @staticmethod
@@ -225,12 +345,13 @@ class KVStore:
         emulated synchronously under the same rule (SURVEY.md §2.3).
         """
         self.wire_bytes_last_push = 0
+        self.wire_bytes_last_push_raw = 0
         if not self.type.startswith("dist") or not merged_list:
             return merged_list
         import jax
         from .ndarray import sparse as _sp
         from .ndarray.ndarray import _wrap
-        if jax.process_count() <= 1:
+        if jax.process_count() <= 1 or len(self.live_ranks) <= 1:
             if self._compression is not None:
                 # one worker: quantisation semantics still apply (the
                 # reference worker would quantise toward its server)
@@ -242,8 +363,11 @@ class KVStore:
         import jax.numpy as jnp
         import numpy as np
         from jax.sharding import NamedSharding, PartitionSpec as P
-        from jax.experimental import multihost_utils
 
+        # liveness gate BEFORE the first collective (the discipline
+        # check's allgather is itself one): a dead peer raises
+        # DeadWorkerError here instead of hanging the exchange
+        self._collective_gate().arrive_and_wait()
         self._assert_push_discipline(keys, merged_list)
 
         mesh = self._proc_mesh()
@@ -261,13 +385,14 @@ class KVStore:
         if rsp_positions:
             local_counts = np.array(
                 [int(merged_list[i]._rsp_indices.shape[0])
-                 for i in rsp_positions], np.int64)
-            all_counts = multihost_utils.process_allgather(local_counts)
+                 for i in rsp_positions], np.int32)
+            all_counts = self._host_allgather(local_counts)
             for j, i in enumerate(rsp_positions):
                 pads[i] = self._row_bucket(int(all_counts[:, j].max()))
 
         flat = []          # local payload arrays
         recipe = []        # one entry per merged value
+        comp_saved = []    # bytes saved per compressed entry
         for i, (k, m) in enumerate(zip(keys, merged_list)):
             if isinstance(m, _sp.RowSparseNDArray):
                 pcount = pads[i]
@@ -289,12 +414,22 @@ class KVStore:
                 flat.append(packed)
                 recipe.append(("compressed", m.context,
                                (tuple(m.shape), str(m.dtype))))
+                # the transform's saving: full-precision fp32 payload
+                # minus what actually travels
+                comp_saved.append(
+                    int(m._data.size) * np.dtype(np.float32).itemsize
+                    - int(packed.size) * packed.dtype.itemsize)
             else:
                 flat.append(m._data)
                 recipe.append(("dense_sum", m.context, None))
 
         self.wire_bytes_last_push = int(sum(a.size * a.dtype.itemsize
                                             for a in flat))
+        # uncompressed equivalent: what the same payloads would have
+        # cost without the compression transform (sparse entries
+        # already ARE the reduced payload — raw == wire for them)
+        self.wire_bytes_last_push_raw = (self.wire_bytes_last_push
+                                         + sum(comp_saved))
 
         garrs = []
         for a in flat:
@@ -313,8 +448,9 @@ class KVStore:
             else:
                 ops.append("sum")
         thr = self._compression.threshold if self._compression else None
+        ctype = self._compression.type if self._compression else None
         sig = (tuple((tuple(a.shape), str(a.dtype)) for a in flat),
-               tuple(str(o) for o in ops), thr)
+               tuple(str(o) for o in ops), thr, ctype)
         cache = getattr(self, "_reduce_cache", None)
         if cache is None:
             cache = self._reduce_cache = {}
@@ -322,13 +458,17 @@ class KVStore:
         if fn is None:
             from .gradient_compression import dequantize_2bit
 
-            def _run(ts, _ops=tuple(ops), _thr=thr):
+            def _run(ts, _ops=tuple(ops), _thr=thr, _ctype=ctype):
                 outs = []
                 for t, op in zip(ts, _ops):
                     if op == "sum":
                         outs.append(t.sum(axis=0))
                     elif op == "gather":
                         outs.append(t)   # replication IS the all-gather
+                    elif _ctype == "fp16":
+                        # fp16 wire: dequantise is a widening cast; sum
+                        # in fp32 like the reference server would
+                        outs.append(t.astype(jnp.float32).sum(axis=0))
                     else:
                         shape = op[1]
                         deq = jax.vmap(lambda p: dequantize_2bit(
@@ -391,7 +531,6 @@ class KVStore:
             return
         import hashlib
         import numpy as np
-        from jax.experimental import multihost_utils
         desc = repr([(str(k), getattr(m, "stype", "default"),
                       tuple(m.shape), str(m.dtype))
                      for k, m in zip(keys, merged_list)])
@@ -399,7 +538,7 @@ class KVStore:
         # silently truncated in the gather and never compare equal
         h = np.frombuffer(hashlib.sha256(desc.encode()).digest()[:16],
                           dtype=np.int32).copy()
-        all_h = np.asarray(multihost_utils.process_allgather(h))
+        all_h = np.asarray(self._host_allgather(h))
         if not (all_h == all_h[0]).all():
             raise MXNetError(
                 "kvstore dist push discipline violated: workers pushed "
@@ -411,13 +550,18 @@ class KVStore:
                 + desc)
 
     def barrier(self):
-        """Block until every worker reaches this point (parity:
-        KVStore::Barrier via ps-lite Postoffice)."""
+        """Block until every LIVE worker reaches this point (parity:
+        KVStore::Barrier via ps-lite Postoffice). Liveness-gated like
+        every collective — a dead peer raises instead of hanging — and
+        the rendezvous itself is a live-mesh gather (the stock
+        ``sync_global_devices`` spans every launched process and would
+        hang against members a previous re-mesh dropped)."""
         if self.type.startswith("dist"):
             import jax
-            if jax.process_count() > 1:
-                from jax.experimental import multihost_utils
-                multihost_utils.sync_global_devices("kvstore_barrier")
+            import numpy as np
+            if jax.process_count() > 1 and len(self.live_ranks) > 1:
+                self._collective_gate().arrive_and_wait()
+                self._host_allgather(np.zeros((1,), np.int32))
 
     def pull(self, key, out=None, priority=0, row_ids=None):
         """Broadcast current value into out arrays (parity: kvstore.pull)."""
@@ -505,15 +649,19 @@ class KVStore:
         return _wrap(deq) if isinstance(v, NDArray) else deq
 
     def num_dead_node(self, node_id=0, timeout=None):
-        """Count workers with stale/missing heartbeats (parity:
-        KVStore::get_num_dead_node, kvstore.h:338 — visibility only; a
-        dead peer still hangs collectives, recovery is
-        checkpoint-restart). node_id is accepted for API parity; the
-        heartbeat dir covers all workers."""
+        """Count CURRENT members with stale/missing heartbeats (parity:
+        KVStore::get_num_dead_node, kvstore.h:338). Unlike the
+        reference this is not visibility-only: the pre-collective gate
+        turns a dead peer into ``DeadWorkerError`` instead of a hung
+        collective, and ``Module.fit`` re-meshes over the survivors.
+        node_id is accepted for API parity; the heartbeat dir covers
+        all workers. Members dropped by a previous re-mesh no longer
+        count."""
         if not self.type.startswith("dist"):
             return 0
         from . import heartbeat
-        return heartbeat.count_dead(self.num_workers, timeout=timeout)
+        return len(heartbeat.stale_ranks(self.live_ranks,
+                                         timeout=timeout))
 
     # -- sync / lifecycle --------------------------------------------------
     def send_command_to_servers(self, head, body):

@@ -309,17 +309,34 @@ class BaseModule:
         if not isinstance(eval_metric, _metric.EvalMetric):
             eval_metric = _metric.create(eval_metric)
 
+        from ..heartbeat import DeadWorkerError
         if ckpt is not None:
             ckpt.clear_preempt()
             ckpt.arm_signals()
         try:
-            self._fit_loop(train_data, eval_data, eval_metric,
-                           validation_metric, epoch_end_callback,
-                           batch_end_callback, eval_end_callback,
-                           eval_batch_end_callback, monitor,
-                           sparse_row_id_fn, begin_epoch, num_epoch,
-                           skip_batches, ckpt, divergence_check_every,
-                           divergence_policy)
+            while True:
+                try:
+                    self._fit_loop(train_data, eval_data, eval_metric,
+                                   validation_metric, epoch_end_callback,
+                                   batch_end_callback, eval_end_callback,
+                                   eval_batch_end_callback, monitor,
+                                   sparse_row_id_fn, begin_epoch,
+                                   num_epoch, skip_batches, ckpt,
+                                   divergence_check_every,
+                                   divergence_policy)
+                    break
+                except DeadWorkerError as e:
+                    # ELASTIC RECOVERY: a peer died before a collective
+                    # (the liveness gate aborted the step — nothing is
+                    # hung). Postmortem the death, re-mesh over the
+                    # survivors, restore the last atomic checkpoint and
+                    # continue the SAME fit call from its (epoch,
+                    # nbatch). Work since that checkpoint is lost —
+                    # that is the recovery contract (README
+                    # "Distributed training").
+                    meta = self._elastic_recover(e, ckpt)
+                    begin_epoch = int(meta["epoch"])
+                    skip_batches = int(meta.get("nbatch", 0))
         finally:
             if ckpt is not None:
                 ckpt.disarm_signals()
@@ -332,6 +349,7 @@ class BaseModule:
                   begin_epoch, num_epoch, skip_batches, ckpt,
                   divergence_check_every, divergence_policy):
         from ..checkpoint import TrainingPreempted
+        from ..heartbeat import DeadWorkerError
         train_data.reset()
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
@@ -372,21 +390,30 @@ class BaseModule:
                 # opt_update, ...) so the merged chrome trace links one
                 # step's spans with flow arrows and a postmortem's ring
                 # says which step each interval served.
-                with telemetry.causal(epoch=epoch, nbatch=nbatch), \
-                        telemetry.span("fit_batch"):
-                    fused = self._fused_batch_step(data_batch, eval_metric)
-                    if not fused:
-                        self._note_fused_fallback()
-                        self.forward_backward(data_batch)
-                        self.update()
-                    try:
-                        next_data_batch = next(data_iter)
-                        self.prepare(next_data_batch,
-                                     sparse_row_id_fn=sparse_row_id_fn)
-                    except StopIteration:
-                        end_of_batch = True
-                    if not fused:
-                        self.update_metric(eval_metric, data_batch.label)
+                try:
+                    with telemetry.causal(epoch=epoch, nbatch=nbatch), \
+                            telemetry.span("fit_batch"):
+                        fused = self._fused_batch_step(data_batch,
+                                                       eval_metric)
+                        if not fused:
+                            self._note_fused_fallback()
+                            self.forward_backward(data_batch)
+                            self.update()
+                        try:
+                            next_data_batch = next(data_iter)
+                            self.prepare(next_data_batch,
+                                         sparse_row_id_fn=sparse_row_id_fn)
+                        except StopIteration:
+                            end_of_batch = True
+                        if not fused:
+                            self.update_metric(eval_metric,
+                                               data_batch.label)
+                except DeadWorkerError as e:
+                    # stamp the step the death aborted — the elastic
+                    # handler's postmortem names it
+                    if e.epoch is None:
+                        e.epoch, e.nbatch = epoch, nbatch
+                    raise
                 if monitor is not None:
                     monitor.toc_print()
                 if divergence_check_every > 0 \
@@ -455,6 +482,60 @@ class BaseModule:
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
                                      name, val)
             train_data.reset()
+
+    def _elastic_remesh(self, dead_ranks):
+        """Adopt the surviving membership after a member loss. The base
+        class has no mesh to rebuild; ``Module`` overrides with the
+        real detach + re-mesh."""
+        from .. import dist as _dist
+        _dist.mark_member_lost(dead_ranks)
+
+    def _elastic_recover(self, e, ckpt):
+        """Handle a :class:`heartbeat.DeadWorkerError` raised by the
+        pre-collective liveness gate: write the postmortem naming the
+        dead rank(s) and the step they died on, re-mesh over the
+        survivors, restore the last atomic checkpoint and return its
+        meta (the resume point). Re-raises when there is no checkpoint
+        to recover from — a member loss without a checkpoint is fatal
+        by design (there is nothing consistent to resume)."""
+        telemetry.counter_inc("elastic.dead_workers", len(e.ranks))
+        telemetry.record_event("elastic.dead_worker",
+                               dead=list(e.ranks), channel=e.channel,
+                               generation=e.generation, epoch=e.epoch,
+                               nbatch=e.nbatch,
+                               timed_out=bool(e.timed_out))
+        from .. import flight as _flight
+        from .. import dist as _dist
+        _flight.postmortem(
+            "dead_worker", exc=e,
+            extra={"dead_ranks": list(e.ranks),
+                   "channel": e.channel,
+                   "generation": e.generation,
+                   "epoch": e.epoch, "nbatch": e.nbatch,
+                   "timed_out": bool(e.timed_out),
+                   "survivor_rank": _dist.rank(),
+                   "live_ranks": [r for r in _dist.live_ranks()
+                                  if r not in e.ranks]})
+        from .. import log as _log
+        logger = _log.get_logger("mxnet_tpu.module")
+        if ckpt is None or ckpt.latest() is None:
+            logger.error(
+                "worker(s) %s died at epoch %s batch %s and no "
+                "checkpoint manager (fit(checkpoint=...)) is armed — "
+                "cannot re-mesh without a consistent state to resume "
+                "from", list(e.ranks), e.epoch, e.nbatch)
+            raise e
+        logger.warning(
+            "worker(s) %s died at epoch %s batch %s — re-meshing over "
+            "the survivors and resuming from the last checkpoint",
+            list(e.ranks), e.epoch, e.nbatch)
+        self._elastic_remesh(e.ranks)
+        meta = ckpt.restore(self)
+        telemetry.counter_inc("elastic.resumed")
+        telemetry.record_event("elastic.resumed",
+                               epoch=int(meta["epoch"]),
+                               nbatch=int(meta.get("nbatch", 0)))
+        return meta
 
     def finite_check(self):
         """The divergence sentinel's predicate: True when the last

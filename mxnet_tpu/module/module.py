@@ -71,6 +71,15 @@ class Module(BaseModule):
         self._repl_sharding = None
         self._fused_fallback_reason = None
         self._fused_plan = None
+        # the dist tier (multi-process dist_* kvstore): a PROCESS-
+        # SPANNING dp mesh the fused step jits over, committed lazily
+        # and dropped whenever a step must phase-split (the explicit
+        # kvstore wire needs LOCAL gradients, not psummed ones)
+        self._dist_spec = None
+        self._dist_committed = False
+        self._dist_synced = False
+        self._step_gate = None
+        self._dist_sync_handle = None
 
     # -- introspection -----------------------------------------------------
     @property
@@ -206,6 +215,175 @@ class Module(BaseModule):
             | set(self._state_names)
         _spmd.commit_dp_placements(self._exec, input_names, self._dp_spec)
 
+    # -- multi-process dist mesh (the elastic dist_* tier) -----------------
+    def _input_name_set(self):
+        return set(self._data_names) | set(self._label_names) \
+            | set(self._state_names)
+
+    def _init_dist_spec(self):
+        """Build the PROCESS-SPANNING dp mesh for a multi-process
+        ``dist_*`` sync store: every live worker's context devices
+        become a slab of one global ``dp`` axis, so the SAME fused
+        donated-buffer train step jits across processes and XLA
+        compiles the cross-host gradient psum INTO the step (the
+        kvstore wire path becomes the recovery/compression fallback,
+        not the steady state). A single-process job (or the last
+        survivor after re-meshes) keeps ``_dist_spec=None`` and runs
+        the plain local program."""
+        from .. import dist as _dist
+        from ..parallel import spmd as _spmd
+        kv = self._kvstore
+        live = kv.live_ranks if kv is not None else (0,)
+        if len(live) <= 1 or _dist.process_count() <= 1:
+            self._dist_spec = None
+            return
+        for d in self._data_shapes + self._label_shapes:
+            if d.shape:
+                _spmd.check_batch_divisible(
+                    d.shape[0], max(1, len(self._context)),
+                    "local batch size")
+        self._dist_spec = _spmd.dist_dp_spec(self._context,
+                                             live_ranks=live)
+        self._step_gate = None
+
+    def _dist_gate(self):
+        """Per-module pre-collective liveness gate for the fused dist
+        step (channel ``step``; the kvstore wire path gates on its own
+        ``kv`` channel). Lazy; rebuilt after a re-mesh."""
+        if self._step_gate is None:
+            from .. import heartbeat
+            kv = self._kvstore
+            self._step_gate = heartbeat.CollectiveGate(
+                kv.rank, kv.live_ranks, channel="step")
+        return self._step_gate
+
+    def _await_dist_step(self, handle):
+        """Liveness-aware completion wait for the previous spanning
+        step: poll readiness alongside peer heartbeats, so a member
+        that dies INSIDE an in-flight exchange (SIGKILL between its
+        gate crossing and its part of the collective) surfaces as
+        ``DeadWorkerError`` instead of an unbounded silent block.
+        Best-effort beyond that point: the wedged execution cannot be
+        aborted runtime-side, so recovery may still require the
+        launcher-level restart — but the death is named, postmortem'd
+        and bounded."""
+        if not hasattr(handle, "is_ready"):
+            import jax
+            jax.block_until_ready(handle)
+            return
+        import time as _time
+        from .. import heartbeat
+        kv = self._kvstore
+        peers = [r for r in kv.live_ranks if r != kv.rank]
+        next_liveness = _time.monotonic() + 0.25
+        while not handle.is_ready():
+            if _time.monotonic() >= next_liveness:
+                next_liveness = _time.monotonic() + 0.25
+                dead = heartbeat.stale_ranks(peers)
+                if dead:
+                    raise heartbeat.DeadWorkerError(
+                        dead, channel="step-execution",
+                        generation=self._dist_gate().generation,
+                        evidence={r: "died with the collective "
+                                     "in flight" for r in dead})
+            _time.sleep(0.002)
+
+    def _ensure_dist_placement(self):
+        """Commit the executor's storage onto the process-spanning mesh
+        (idempotent). The FIRST commit broadcasts rank 0's replicated
+        state to every worker (parity: kv.init server seeding) — after
+        that the SPMD discipline keeps replicas identical and
+        re-commits (post-fallback, post-re-mesh) are local-only."""
+        if self._dist_spec is None or self._dist_committed:
+            return
+        from .. import dist as _dist
+        from ..parallel import spmd as _spmd
+        # the broadcast spans every LAUNCHED process — after a member
+        # loss it would hang on the dead ones, and the survivors'
+        # values are already consistent (same checkpoint restore)
+        sync = not self._dist_synced and not _dist.dead_ranks()
+        _spmd.commit_dp_placements(self._exec, self._input_name_set(),
+                                   self._dist_spec, sync=sync)
+        self._dist_synced = True
+        self._dist_committed = True
+
+    def _drop_dist_placement(self):
+        """Detach every bound array from the process-spanning mesh back
+        to this worker's LOCAL placement (replicated values read
+        locally, batch-sharded values keep their local rows). Runs
+        before any phase-split step — the explicit kvstore wire needs
+        LOCAL gradients, a globally-committed executor would psum them
+        inside forward_backward and the push would double-reduce — and
+        during elastic recovery, where arrays still committed to a mesh
+        containing dead devices would hang any eager op."""
+        if not self._dist_committed:
+            return
+        import jax
+        from ..parallel import spmd as _spmd
+        ex = self._exec
+        input_names = self._input_name_set()
+
+        def _localize(arr, name=None):
+            if arr is None:
+                return
+            val = _spmd.local_value(arr._data)
+            if self._mesh is not None:
+                sh = self._data_sharding if name in input_names \
+                    else self._repl_sharding
+                arr._set_data(jax.device_put(val, sh))
+            else:
+                arr._set_data(jax.device_put(
+                    val, self._context[0].jax_device()))
+
+        for name, arr in ex.arg_dict.items():
+            _localize(arr, name)
+        for arr in list(ex.grad_arrays) + list(ex.aux_arrays):
+            _localize(arr)
+        # optimizer state lives with the updater; kvstore weight copies
+        # with the store — both were donated into the spanning program
+        updater = self._kvstore._updater \
+            if (self._kvstore is not None and self._update_on_kvstore) \
+            else self._updater
+        for st in (getattr(updater, "states", None) or {}).values():
+            for leaf in _flatten_state(st):
+                _localize(leaf)
+        if self._kvstore is not None:
+            for arr in self._kvstore._store.values():
+                if isinstance(arr, NDArray) \
+                        and getattr(arr, "stype", "default") == "default":
+                    _localize(arr)
+        ex.outputs = [_wrap(jax.device_put(
+            _spmd.local_value(o._data), self._context[0].jax_device()),
+            o.context) for o in ex.outputs]
+        self._dist_committed = False
+
+    def _elastic_remesh(self, dead_ranks):
+        """Adopt the surviving membership after a member loss: record
+        the dead ranks, detach from the dead mesh, rebuild the dp spec
+        over the survivors (or drop to the local program when this
+        worker is the last one standing) and invalidate the fused
+        plan. The caller (``fit``'s elastic path) then restores the
+        last checkpoint and resumes."""
+        from .. import dist as _dist
+        _dist.mark_member_lost(dead_ranks)
+        live = _dist.live_ranks()
+        kv = self._kvstore
+        if kv is not None:
+            kv._remesh(live)
+        self._drop_dist_placement()
+        self._fused_plan = None
+        self._dist_sync_handle = None
+        self._step_gate = None
+        self._dist_spec = None
+        if kv is not None and kv.fused_dist_step:
+            self._init_dist_spec()
+        telemetry.counter_inc("elastic.remesh")
+        telemetry.record_event("elastic.remesh",
+                               dead=list(dead_ranks), live=list(live))
+        self.logger.warning(
+            "elastic re-mesh: worker(s) %s dead, continuing on %s "
+            "(%d live)", sorted(dead_ranks), list(live), len(live))
+
     # -- params ------------------------------------------------------------
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
                     aux_params=None, allow_missing=False, force_init=False,
@@ -278,18 +456,28 @@ class Module(BaseModule):
                                    param_idx2name=idx2name,
                                    **optimizer_params)
         self._optimizer = optimizer
-        self._kvstore = kv
-        self._update_on_kvstore = update_on_kvstore
-        self._updater = None
         if kv is not None:
-            if kv.type == "dist_sync" or update_on_kvstore:
-                pass
+            if kv.type.startswith("dist"):
+                # EVERY dist_* type runs the optimizer kvstore-side
+                # (reference semantics: the server applies updates for
+                # dist_sync, dist_sync_device, dist_device_sync AND
+                # dist_async alike). The old predicate named only
+                # "dist_sync" and let the other dist types ride
+                # whatever _create_kvstore defaulted to — the same
+                # outcome today, silently, and one heuristic change
+                # away from divergent update paths across workers.
+                update_on_kvstore = True
             for i, name in enumerate(self._param_names):
                 kv.init(i, arg_dict[name])
             if update_on_kvstore:
                 kv.set_optimizer(self._optimizer)
+        self._kvstore = kv
+        self._update_on_kvstore = update_on_kvstore
+        self._updater = None
         if not update_on_kvstore:
             self._updater = opt.get_updater(optimizer)
+        if kv is not None and kv.fused_dist_step:
+            self._init_dist_spec()
         self.optimizer_initialized = True
 
     # -- compute -----------------------------------------------------------
@@ -298,6 +486,10 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized
         if is_train is None:
             is_train = self.for_training
+        # phase-split surface: runs on LOCAL placement (score/predict
+        # between dist epochs, monitors) — no-op unless the fused dist
+        # step left a process-spanning commit behind
+        self._drop_dist_placement()
         self._set_batch(data_batch)
         self._exec.forward(is_train=is_train)
 
@@ -308,6 +500,7 @@ class Module(BaseModule):
     def forward_backward(self, data_batch):
         """Fused single-XLA-program step (overrides the base two-call path)."""
         assert self.binded and self.params_initialized
+        self._drop_dist_placement()
         self._set_batch(data_batch)
         self._exec.forward_backward()
 
@@ -404,6 +597,17 @@ class Module(BaseModule):
 
     # -- whole-step fused training -----------------------------------------
     def _fused_batch_step(self, data_batch, eval_metric=None):
+        """Fused-step entry: run the impl, and on ANY fallback drop the
+        process-spanning placement first — the phase-split oracle
+        computes LOCAL gradients for the explicit kvstore wire, and a
+        globally-committed executor would psum them inside
+        forward_backward so the push would double-reduce."""
+        ok = self._fused_batch_step_impl(data_batch, eval_metric)
+        if not ok:
+            self._drop_dist_placement()
+        return ok
+
+    def _fused_batch_step_impl(self, data_batch, eval_metric=None):
         """Forward + backward + optimizer update (+ metric accumulation
         when the metric has a device kernel) as ONE jitted XLA program
         with params/optimizer-state/metric/aux buffers donated
@@ -453,15 +657,34 @@ class Module(BaseModule):
             return False
         kv = self._kvstore
         if kv is not None and not kv.fused_step_subsumable:
-            if kv.type.startswith("dist"):
+            if kv.fused_dist_step:
+                # the dist sync tier: the SAME fused donated-buffer
+                # step jits over the process-spanning dp mesh with the
+                # cross-host psum inside the program (a single-process
+                # job or the last survivor runs it locally) — dist_sync
+                # no longer falls back. EXCEPT when this module never
+                # committed a spanning mesh (borrowed optimizer /
+                # bucketing switch paths skip _init_dist_spec): fusing
+                # LOCALLY there would silently train divergent
+                # replicas, so the explicit wire stays
+                if self._dist_spec is None and len(kv.live_ranks) > 1:
+                    self._fused_fallback_reason = FusedFallback(
+                        "kvstore_dist", "kvstore-mediated update",
+                        "multi-process dist store without a committed "
+                        "process-spanning mesh (borrowed optimizer / "
+                        "bucketing)")
+                    return False
+            elif kv.type.startswith("dist"):
                 self._fused_fallback_reason = FusedFallback(
                     "kvstore_dist", "kvstore-mediated update",
-                    "kvstore type %r crosses worker processes" % kv.type)
+                    "kvstore type %r keeps the explicit wire path "
+                    "(async application is wire-emulated)" % kv.type)
+                return False
             else:
                 self._fused_fallback_reason = FusedFallback(
                     "kvstore_compression", "kvstore-mediated update",
                     "gradient compression changes the pushed values")
-            return False
+                return False
         # an in-process kvstore's reduce is subsumed by the SPMD step;
         # with update_on_kvstore the kvstore's server-side updater owns
         # the optimizer state, so the plan runs THAT updater's kernels
@@ -631,12 +854,16 @@ class Module(BaseModule):
                 return kernel([ins[n] for n in label_names], list(outs), acc)
             return metric_fn
 
+        # the dist tier overrides the local dp spec: ONE program over
+        # the process-spanning mesh, cross-host psum compiled inside
+        spmd_spec = self._dist_spec if self._dist_spec is not None \
+            else self._dp_spec
         fn = ex._prog.train_step_fn(
             update_names, add_names, input_dtypes, cache_key,
             build_update_fn=lambda: opt._make_batch_update(
                 kname, dict(statics), list(mp), list(inner_n)),
             build_metric_fn=build_metric_fn if kernel is not None else None,
-            spmd=self._dp_spec)
+            spmd=spmd_spec)
         # a SUBSUMED update_on_kvstore store holds its own canonical
         # weight copies (push updates them, pull serves them); the fused
         # step keeps them coherent with zero-cost pointer swaps so a
@@ -658,6 +885,12 @@ class Module(BaseModule):
             "mp": tuple(mp), "inner_n": tuple(inner_n),
             "kernel": kernel, "fn": fn,
             "label_inputs": frozenset(label_inputs),
+            "spmd_spec": spmd_spec,
+            # per-process gradient payload of the in-program psum (the
+            # dist wire-bytes estimate bumped per spanning step)
+            "dist_wire_bytes": sum(
+                int(w._data.size) * w._data.dtype.itemsize
+                for w in weights),
             # the state gathered above, consumed (popped) by the step
             # that built the plan — later steps re-gather fresh
             "packed": packed,
@@ -677,20 +910,42 @@ class Module(BaseModule):
         if label is not None and not isinstance(label, (list, tuple)):
             label = [label]
 
-        mesh = self._mesh
-        sharding = self._data_sharding
+        from ..parallel import spmd as _spmd
+        spec = plan["spmd_spec"]
+        spanning = spec is not None \
+            and _spmd.is_process_spanning(spec.mesh)
+        mesh = spec.mesh if spec is not None else None
+        sharding = spec.data_sharding if spec is not None else None
         import jax
         dev = None if mesh is not None else self._context[0].jax_device()
+        if spanning:
+            self._ensure_dist_placement()
+            if self._dist_sync_handle is not None:
+                # complete the PREVIOUS spanning step before gating:
+                # every member that crosses the gate has finished its
+                # part of step N-1's collective, so a member that dies
+                # at the gate can never leave peers hung inside an
+                # in-flight exchange — the price is one host sync per
+                # dist step (they are wire-bound anyway)
+                self._await_dist_step(self._dist_sync_handle)
+                self._dist_sync_handle = None
+            # liveness gate BEFORE entering the collective step: a dead
+            # peer raises DeadWorkerError here (elastic recovery), a
+            # live job pays two tiny file writes + a poll
+            self._dist_gate().arrive_and_wait()
 
         def _raw(arr):
             raw = arr._data if isinstance(arr, NDArray) else np.asarray(arr)   # mxlint: disable=host-sync -- feed-path marshalling of a HOST-side batch array (lists/np inputs); device arrays take the _data branch
-            if mesh is not None:
+            if spanning:
+                # this worker's LOCAL rows become its shard of the
+                # global batch (no host gather, no peer traffic)
+                raw = _spmd.dist_shard_put(np.asarray(raw), spec)   # mxlint: disable=host-sync -- same feed-path marshalling: the process-local constructor needs the host view of the local batch
+            elif mesh is not None:
                 # one sharded device_put of the GLOBAL batch — each
                 # device receives its shard, no host-side splitting
-                from ..parallel import spmd as _spmd
                 if raw.shape:
                     _spmd.check_batch_divisible(
-                        raw.shape[0], self._dp_spec.num_devices,
+                        raw.shape[0], spec.num_devices,
                         "batch size")
                 raw = _spmd.shard_put(raw, sharding)
             else:
@@ -748,15 +1003,40 @@ class Module(BaseModule):
                 # device module must not introduce a default-device
                 # operand)
                 acc = jnp.zeros((), jnp.float32)
-                if dev is not None:
+                if spanning:
+                    acc = _spmd.put_replicated_local(acc, spec)
+                elif dev is not None:
                     acc = jax.device_put(acc, dev)
         rng = ex._step_key()
+        if spanning:
+            # per-step scalars install as replicated WITHOUT a
+            # collective (every worker computes identical values —
+            # the SPMD discipline put_replicated_local documents);
+            # letting jit auto-commit them would pay a cross-host
+            # equality collective per array per step
+            rng = _spmd.put_replicated_local(rng, spec)
+            lrs = _spmd.put_replicated_local(lrs, spec)
+            wds = _spmd.put_replicated_local(wds, spec)
+            ts = _spmd.put_replicated_local(ts, spec)
 
         record_dispatch("train_step")
         with telemetry.span("step"):
             new_params, new_states, new_acc, new_aux, outs, grads_out = \
                 plan["fn"](params_raw, states_raw, acc, aux_raw, inputs, rng,   # mxlint: donates 0-3
                            lrs, wds, ts, add_grads)
+        if spanning:
+            # the in-program cross-host psum IS the dist wire now:
+            # account it next to the explicit push path's counters, and
+            # keep a handle for the pre-gate sync of the NEXT step
+            self._dist_sync_handle = \
+                new_params[plan["update_names"][0]] \
+                if plan["update_names"] else None
+            telemetry.counter_inc("kvstore.dist.fused_steps")
+            telemetry.counter_inc("kvstore.dist.collectives")
+            telemetry.counter_inc("kvstore.dist.wire_bytes",
+                                  plan["dist_wire_bytes"])
+            telemetry.counter_inc("kvstore.dist.wire_bytes_raw",
+                                  plan["dist_wire_bytes"])
 
         # donation invalidated the old buffers — reinstall everything
         for n in self._param_names:
@@ -904,6 +1184,19 @@ class Module(BaseModule):
         if getattr(self, "_preloaded_params", None) and self.binded:
             arg_p, aux_p = self._preloaded_params
             self.set_params(arg_p, aux_p)
+
+
+def _flatten_state(st):
+    """NDArray leaves of one updater state entry (states are NDArrays,
+    tuples of them — multi-precision nests master weights — or None)."""
+    if st is None:
+        return []
+    if isinstance(st, (list, tuple)):
+        out = []
+        for x in st:
+            out.extend(_flatten_state(x))
+        return out
+    return [st] if isinstance(st, NDArray) else []
 
 
 def _as_desc(d):

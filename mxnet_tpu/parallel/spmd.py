@@ -16,13 +16,15 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
 from .. import telemetry
 
 __all__ = ["SPMDTrainer", "shard_params_rule", "DataParallelSpec",
-           "dp_spec", "check_batch_divisible", "shard_put",
+           "dp_spec", "dist_dp_spec", "is_process_spanning",
+           "check_batch_divisible", "shard_put", "dist_shard_put",
+           "put_replicated_local", "broadcast_from_zero", "local_value",
            "commit_dp_placements", "DP_AXIS"]
 
 # the canonical data-parallel axis name shared by the Module mesh path,
@@ -50,6 +52,132 @@ def dp_spec(mesh, data_axis=DP_AXIS):
     return DataParallelSpec(mesh,
                             NamedSharding(mesh, P(data_axis)),
                             NamedSharding(mesh, P()))
+
+
+def is_process_spanning(mesh):
+    """Whether the mesh crosses worker processes — the dist tier: batch
+    assembly must go through the process-local constructors and the
+    fit loop must gate collectives on worker liveness."""
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
+def _mesh_local_devices(mesh):
+    """This process's devices within the mesh, in mesh order."""
+    me = jax.process_index()
+    return [d for d in mesh.devices.flat if d.process_index == me]
+
+
+def dist_dp_spec(contexts, data_axis=DP_AXIS, live_ranks=None):
+    """Process-spanning DataParallelSpec: ONE dp mesh over every live
+    worker process — the TPU-native reading of the reference's
+    worker set (each ps-lite worker's device group becomes a
+    contiguous slab of the ``dp`` axis of ONE program's mesh, so the
+    cross-host gradient all-reduce compiles INTO the train step).
+
+    Every process contributes the same number of devices (SPMD jobs
+    are symmetric): this process uses its bound ``contexts``, remote
+    processes their first ``len(contexts)`` devices by id.
+    ``live_ranks`` restricts membership — the elastic re-mesh after a
+    member loss builds the smaller mesh from exactly the surviving
+    process set."""
+    local_devs = [c.jax_device() for c in contexts] if contexts \
+        else jax.local_devices()[:1]
+    n_local = len(local_devs)
+    by_proc = {}
+    for d in jax.devices():
+        by_proc.setdefault(d.process_index, []).append(d)
+    live = sorted(by_proc) if live_ranks is None \
+        else sorted(int(r) for r in live_ranks)
+    me = jax.process_index()
+    devs = []
+    for p in live:
+        plist = sorted(by_proc.get(p, []), key=lambda d: d.id)[:n_local]
+        if len(plist) < n_local:
+            raise MXNetError(
+                "dist mesh needs %d devices on process %d, found %d "
+                "(SPMD jobs must be symmetric)"
+                % (n_local, p, len(plist)))
+        if p == me and plist != local_devs:
+            # every process must derive the IDENTICAL global mesh from
+            # its own view, and a peer's actual binding is unknowable —
+            # so the first-N-by-id convention is mandatory. A worker
+            # bound to other (or reordered) local devices would build a
+            # mesh its peers disagree with: cross-process collectives
+            # over mismatched device orders hang or mis-place shards.
+            raise MXNetError(
+                "dist mesh requires each worker to bind its first %d "
+                "local device(s) in id order (got %s, expected %s): "
+                "every process derives the global mesh by that "
+                "convention" % (n_local, local_devs, plist))
+        devs.extend(plist)
+    return dp_spec(Mesh(np.array(devs), (data_axis,)), data_axis)
+
+
+def dist_shard_put(raw, spec):
+    """Assemble the GLOBAL batch from this process's LOCAL portion on a
+    process-spanning mesh: each worker feeds only its own rows (its
+    data iterator's batch); the constructor places them as this
+    process's shard of the global array — no cross-process transfer,
+    no host-side gather. The global batch dim is
+    ``local_rows x live_processes``."""
+    with telemetry.span("shard_put"):
+        raw = np.asarray(raw)   # mxlint: disable=host-sync -- feed-path marshalling of the LOCAL host batch (the iterator's rows); device arrays are a view, not a fetch
+        telemetry.record_transfer(raw.nbytes)
+        locals_ = _mesh_local_devices(spec.mesh)
+        check_batch_divisible(raw.shape[0], len(locals_),
+                              "local batch size")
+        factor = spec.mesh.devices.size // len(locals_)
+        global_shape = (raw.shape[0] * factor,) + tuple(raw.shape[1:])
+        out = jax.make_array_from_process_local_data(
+            spec.data_sharding, raw, global_shape)
+        if telemetry.enabled():
+            telemetry.ledger_track(
+                out, "mesh(%ddev)" % spec.mesh.devices.size,
+                int(out.size) * out.dtype.itemsize,
+                shape=out.shape, dtype=out.dtype, kind="shard_put")
+        return out
+
+
+def put_replicated_local(raw, spec):
+    """Global REPLICATED array from a value every process already
+    holds, with NO collective: each process installs its local copy on
+    its mesh devices and the constructor declares them one replicated
+    array. Correct only under the SPMD discipline (every worker
+    computes the same replicated values in the same order — true for
+    params/optimizer state/step scalars after the one-time
+    :func:`broadcast_from_zero` at commit); the zero per-step cost is
+    why the fused dist step can feed lrs/ts/rng without a cross-host
+    round trip."""
+    if isinstance(raw, (int, float)):
+        raw = np.asarray(raw)   # mxlint: disable=host-sync -- host scalar literal, no device buffer involved
+    shards = [jax.device_put(raw, d) for d in _mesh_local_devices(spec.mesh)]
+    return jax.make_array_from_single_device_arrays(
+        tuple(np.shape(raw)), spec.repl_sharding, shards)
+
+
+def broadcast_from_zero(tree):
+    """One host-level broadcast of a pytree from process 0 to all
+    (parity: the reference's kv.init server seeding + worker pull —
+    every worker starts from rank 0's values). A no-op outside
+    multi-process runs."""
+    if jax.process_count() <= 1:
+        return tree
+    from jax.experimental import multihost_utils
+    return multihost_utils.broadcast_one_to_all(tree)
+
+
+def local_value(garr):
+    """This process's host-side view of a (possibly process-spanning)
+    array: the full value when replicated, the locally-addressable rows
+    (concatenated in shard order) when batch-sharded. Never talks to a
+    peer — safe in elastic recovery when some mesh members are dead."""
+    if not hasattr(garr, "sharding"):
+        return np.asarray(garr)   # mxlint: disable=host-sync -- detach/commit path by design: placement transitions NEED the host value (runs per commit/fallback/re-mesh, not per step)
+    if garr.sharding.is_fully_replicated:
+        return np.asarray(garr.addressable_data(0))   # mxlint: disable=host-sync -- same: the local replica read IS the detach
+    shards = sorted(garr.addressable_shards,
+                    key=lambda s: s.index[0].start or 0)
+    return np.concatenate([np.asarray(s.data) for s in shards], axis=0)   # mxlint: disable=host-sync -- same: local shard reads on the detach path
 
 
 def check_batch_divisible(batch_dim, n_devices, what="batch size"):
@@ -88,7 +216,7 @@ def shard_put(raw, sharding):
         return out
 
 
-def commit_dp_placements(executor, input_names, spec):
+def commit_dp_placements(executor, input_names, spec, sync=True):
     """Commit the dp-mesh placements on ONE bound executor's storage:
     batch-like inputs (data/labels/states, all batch-major) shard over
     the data axis, params/grads/aux replicate. The ONE owner of the
@@ -96,13 +224,47 @@ def commit_dp_placements(executor, input_names, spec):
     DataParallelExecutorGroup facade both call this, so the two can
     never drift. GSPMD propagates from these committed placements for
     every program the executor runs."""
+    if not is_process_spanning(spec.mesh):
+        for name, arr in executor.arg_dict.items():
+            sh = spec.data_sharding if name in input_names \
+                else spec.repl_sharding
+            arr._set_data(jax.device_put(arr._data, sh))
+        for arr in list(executor.grad_arrays) + list(executor.aux_arrays):
+            if arr is not None:
+                arr._set_data(jax.device_put(arr._data, spec.repl_sharding))
+        return
+    # process-spanning commit (the dist tier): replicated state is
+    # synchronised from rank 0 in ONE host broadcast — parity with the
+    # reference's kv.init-then-pull worker seeding, and the guarantee
+    # behind put_replicated_local's no-collective puts — then installed
+    # via the process-local constructors; batch-like inputs install this
+    # worker's local rows as its shard of the global batch
+    repl, batch = {}, {}
     for name, arr in executor.arg_dict.items():
-        sh = spec.data_sharding if name in input_names \
-            else spec.repl_sharding
-        arr._set_data(jax.device_put(arr._data, sh))
-    for arr in list(executor.grad_arrays) + list(executor.aux_arrays):
+        (batch if name in input_names else repl)[name] = \
+            local_value(arr._data)
+    grads = {i: local_value(a._data)
+             for i, a in enumerate(executor.grad_arrays) if a is not None}
+    auxes = {i: local_value(a._data)
+             for i, a in enumerate(executor.aux_arrays) if a is not None}
+    synced = {"params": repl, "grads": grads, "aux": auxes}
+    if sync:
+        # sync=False is the elastic re-mesh path: the broadcast spans
+        # EVERY launched process (dead members would hang it), and the
+        # survivors' replicated values are already identical — the
+        # checkpoint restore that follows overwrites them anyway
+        synced = broadcast_from_zero(synced)
+    for name, arr in executor.arg_dict.items():
+        if name in input_names:
+            arr._set_data(dist_shard_put(batch[name], spec))
+        else:
+            arr._set_data(put_replicated_local(synced["params"][name], spec))
+    for i, arr in enumerate(executor.grad_arrays):
         if arr is not None:
-            arr._set_data(jax.device_put(arr._data, spec.repl_sharding))
+            arr._set_data(put_replicated_local(synced["grads"][i], spec))
+    for i, arr in enumerate(executor.aux_arrays):
+        if arr is not None:
+            arr._set_data(put_replicated_local(synced["aux"][i], spec))
 
 
 def shard_params_rule(params, mesh, tp_axis=None):

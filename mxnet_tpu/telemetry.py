@@ -139,6 +139,9 @@ COUNTERS = (
     "faults.injected", "faults.injected.*",
     "transfer.*", "host_sync.*",
     "kvstore.push", "kvstore.pull", "kvstore.wire_bytes",
+    "kvstore.dist.collectives", "kvstore.dist.wire_bytes",
+    "kvstore.dist.wire_bytes_raw", "kvstore.dist.fused_steps",
+    "elastic.dead_workers", "elastic.remesh", "elastic.resumed",
     "exec_group.forward",
     "training.preempted",
     "divergence.detected", "divergence.skipped", "divergence.rollback",

@@ -1,0 +1,188 @@
+"""dist_* kvstore tier satellites (ISSUE 12).
+
+Pins the pieces of the dist wire the 2-process lane cannot conveniently
+isolate:
+
+- the push-discipline guard's ERROR path (workers pushed different key
+  sets — the SPMD collective requirement the reference's parameter
+  server never had);
+- gradient compression ROUND-TRIP semantics on the dist wire path
+  (2-bit with error-feedback residuals, and the new fp16 wire cast) —
+  previously only the non-dist path was pinned;
+- ``Module.init_optimizer``'s dist predicate: EVERY ``dist_*`` type
+  forces update-on-kvstore explicitly (the old predicate named only
+  ``dist_sync`` and let ``dist_sync_device`` et al ride the
+  ``_create_kvstore`` default);
+- the fused-step eligibility split: sync dist types fuse, ``dist_async``
+  and compressed stores keep the explicit wire.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore as kvs
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gradient_compression import (GradientCompression,
+                                            dequantize_2bit, quantize_2bit)
+from mxnet_tpu.io import DataDesc
+
+
+# ---------------------------------------------------------------------------
+# push discipline
+# ---------------------------------------------------------------------------
+
+def _mismatched_allgather(self, h):
+    """Fake a 2-worker gather where the peer pushed something else.
+    Patches ``KVStore._host_allgather`` — the LIVE-membership gather
+    every dist host exchange (discipline check, row-sparse counts,
+    barrier) routes through."""
+    h = np.asarray(h)
+    return np.stack([h, h + 1])
+
+
+def _matching_allgather(self, h):
+    h = np.asarray(h)
+    return np.stack([h, h])
+
+
+def test_push_discipline_violation_raises(monkeypatch):
+    kv = kvs.create("dist_sync")
+    monkeypatch.setattr(kvs.KVStore, "_host_allgather",
+                        _mismatched_allgather)
+    vals = [mx.nd.array(np.ones((4,), np.float32))]
+    with pytest.raises(MXNetError) as ei:
+        kv._assert_push_discipline(["w0"], vals)
+    msg = str(ei.value)
+    assert "push discipline violated" in msg
+    # the error must name THIS worker's push signature so the two sides
+    # of the mismatch can be diffed from two logs
+    assert "w0" in msg and "(4,)" in msg and "float32" in msg
+
+
+def test_push_discipline_matching_passes(monkeypatch):
+    kv = kvs.create("dist_sync")
+    monkeypatch.setattr(kvs.KVStore, "_host_allgather",
+                        _matching_allgather)
+    vals = [mx.nd.array(np.ones((4,), np.float32))]
+    kv._assert_push_discipline(["w0"], vals)   # no raise
+
+
+def test_push_discipline_env_kill_switch(monkeypatch):
+    def _boom(self, _):
+        raise AssertionError("guard must be skipped")
+
+    monkeypatch.setenv("MXNET_KVSTORE_CHECK_PUSH", "0")
+    monkeypatch.setattr(kvs.KVStore, "_host_allgather", _boom)
+    kv = kvs.create("dist_sync")
+    kv._assert_push_discipline(["w0"],
+                               [mx.nd.array(np.ones((2,), np.float32))])
+
+
+# ---------------------------------------------------------------------------
+# gradient compression on the dist wire path
+# ---------------------------------------------------------------------------
+
+def test_dist_wire_2bit_roundtrip_with_residual():
+    """A dist_sync push quantises the merged gradient toward the wire
+    (single-worker: the reference worker would quantise toward its
+    server) — the stored value equals an explicit
+    quantize->dequantize, and the SECOND push carries the first push's
+    residual (error feedback across steps)."""
+    kv = kvs.create("dist_sync")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    g1 = np.array([0.3, 0.7, -0.9, 0.1], np.float32)
+    g2 = np.array([0.4, -0.2, 0.6, 0.2], np.float32)
+    kv.init("w", mx.nd.array(np.zeros(4, np.float32)))
+
+    kv.push("w", mx.nd.array(g1))
+    out = mx.nd.array(np.zeros(4, np.float32))
+    kv.pull("w", out=out)
+    p1, r1 = quantize_2bit(jnp.asarray(g1), jnp.zeros(4), 0.5)
+    want1 = np.asarray(dequantize_2bit(p1, (4,), 0.5))
+    np.testing.assert_allclose(out.asnumpy(), want1, rtol=1e-6)
+
+    kv.push("w", mx.nd.array(g2))
+    kv.pull("w", out=out)
+    p2, _ = quantize_2bit(jnp.asarray(g2), r1, 0.5)
+    want2 = np.asarray(dequantize_2bit(p2, (4,), 0.5))
+    np.testing.assert_allclose(out.asnumpy(), want2, rtol=1e-6)
+
+
+def test_dist_wire_fp16_roundtrip():
+    """fp16 wire: a half-precision cast each way — values round to
+    fp16 resolution, nothing else changes."""
+    kv = kvs.create("dist_sync")
+    kv.set_gradient_compression({"type": "fp16"})
+    g = np.array([0.30001, -1.5, 3.14159, 0.125], np.float32)
+    kv.init("w", mx.nd.array(np.zeros(4, np.float32)))
+    kv.push("w", mx.nd.array(g))
+    out = mx.nd.array(np.zeros(4, np.float32))
+    kv.pull("w", out=out)
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  g.astype(np.float16)
+                                  .astype(np.float32))
+
+
+def test_fp16_compressor_unit():
+    c = GradientCompression(type="fp16")
+    g = jnp.asarray(np.linspace(-2, 2, 37, dtype=np.float32))
+    packed = c.compress("k", g)
+    assert packed.dtype == jnp.float16
+    back = c.decompress(packed, g.shape)
+    np.testing.assert_array_equal(np.asarray(back),
+                                  np.asarray(g, np.float16)
+                                  .astype(np.float32))
+
+
+def test_unknown_compression_type_rejected():
+    with pytest.raises(MXNetError):
+        GradientCompression(type="1bit")
+
+
+# ---------------------------------------------------------------------------
+# Module dist predicate + fused-step eligibility
+# ---------------------------------------------------------------------------
+
+def _bound_module(kv):
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[DataDesc("data", (4, 3))],
+             label_shapes=[DataDesc("softmax_label", (4,))],
+             for_training=True)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(kvstore=kv, optimizer="sgd")
+    return mod
+
+
+@pytest.mark.parametrize("kv_type", ["dist_sync", "dist_sync_device",
+                                     "dist_device_sync", "dist_async"])
+def test_all_dist_types_force_update_on_kvstore(kv_type):
+    """Regression (ISSUE 12 satellite): the old predicate
+    ``kv.type == "dist_sync" or update_on_kvstore`` named ONE dist type
+    and let the others ride whatever ``_create_kvstore`` defaulted to.
+    Every ``dist_*`` type must force update-on-kvstore explicitly —
+    reference semantics: the server applies updates."""
+    mod = _bound_module(kvs.create(kv_type))
+    assert mod._update_on_kvstore is True
+    # kvstore-side application really is wired: the store owns the
+    # optimizer's updater
+    assert mod._kvstore._updater is not None
+    assert mod._updater is None
+
+
+def test_fused_dist_step_eligibility_split():
+    """Sync dist types fuse; dist_async and compressed dist stores keep
+    the explicit wire path."""
+    assert kvs.create("dist_sync").fused_dist_step
+    assert kvs.create("dist_sync_device").fused_dist_step
+    assert kvs.create("dist_device_sync").fused_dist_step
+    assert not kvs.create("dist_async").fused_dist_step
+    kv = kvs.create("dist_sync")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    assert not kv.fused_dist_step
+    # and none of the dist types are in-process subsumable
+    assert not kvs.create("dist_sync").fused_step_subsumable

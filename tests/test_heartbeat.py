@@ -1,10 +1,21 @@
-"""Heartbeat liveness file semantics (ISSUE 7 satellite): atomic beat
-writes (no truncate-in-place window) and stop_heartbeat removing the
-worker file instead of leaving it to go stale."""
+"""Heartbeat liveness semantics.
+
+ISSUE 7 satellite: atomic beat writes (no truncate-in-place window) and
+stop_heartbeat removing the worker file instead of leaving it to go
+stale. ISSUE 12: staleness judged against the heartbeat directory's OWN
+clock (a reader wall clock skewed from the file server must not read
+every live peer as dead), leftover ``worker-*.tmp`` files from a writer
+that died mid-rename never count as live workers, and the
+pre-collective CollectiveGate barrier-file protocol detects dead vs
+slow peers with a bounded timeout."""
 import os
+import threading
 import time
 
-from mxnet_tpu import heartbeat
+import pytest
+
+from mxnet_tpu import faults, heartbeat
+from mxnet_tpu.heartbeat import CollectiveGate, DeadWorkerError
 
 
 def _wait_for(pred, timeout=5.0):
@@ -55,3 +66,179 @@ def test_count_dead_stale_file_still_counts(tmp_path):
         f.write(str(time.time() - 100))
     os.utime(path, (time.time() - 100, time.time() - 100))
     assert heartbeat.count_dead(1, root=root, timeout=10) == 1
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 12 satellites: clock-skew tolerance, .tmp hygiene, liveness scan
+# ---------------------------------------------------------------------------
+
+def _fresh_worker(root, rank, age=0.0):
+    path = os.path.join(root, "worker-%d" % rank)
+    with open(path, "w") as f:
+        f.write(str(time.time()))
+    if age:
+        t = time.time() - age
+        os.utime(path, (t, t))
+    return path
+
+
+def test_count_dead_ignores_leftover_tmp_files(tmp_path):
+    """A writer that died mid-rename leaves ``worker-N.tmp`` — it must
+    never read as a live worker (and a dead rank with ONLY a .tmp file
+    still counts dead)."""
+    root = str(tmp_path)
+    _fresh_worker(root, 0)
+    with open(os.path.join(root, "worker-1.tmp"), "w") as f:
+        f.write(str(time.time()))
+    assert heartbeat.alive_ranks(root=root, timeout=10) == {0}
+    assert heartbeat.count_dead(2, root=root, timeout=10) == 1
+
+
+def test_staleness_is_clock_skew_tolerant(tmp_path, monkeypatch):
+    """Staleness compares worker-file mtimes against a PROBE file's
+    mtime in the same directory — the reader's wall clock is never
+    consulted, so a reader skewed hours from the file server (NFS /
+    GCS-fuse) neither reads live peers as dead nor dead peers as
+    forever-live."""
+    root = str(tmp_path)
+    _fresh_worker(root, 0)            # fresh
+    _fresh_worker(root, 1, age=100)   # genuinely stale
+    real_time = time.time
+    # reader clock skewed far ahead AND far behind: the verdicts of the
+    # old now-vs-payload (and now-vs-mtime with a local now) comparison
+    # would flip; the probe-based comparison cannot
+    for skew in (+3600.0, -3600.0):
+        monkeypatch.setattr(time, "time", lambda: real_time() + skew)
+        assert heartbeat.count_dead(2, root=root, timeout=10) == 1
+        assert heartbeat.alive_ranks(root=root, timeout=10) == {0}
+    monkeypatch.setattr(time, "time", real_time)
+
+
+def test_staleness_uses_mtime_not_payload(tmp_path):
+    """The beat payload text is informational only: a file with a
+    bogus (skewed-writer) timestamp payload but a fresh mtime is a
+    LIVE worker."""
+    root = str(tmp_path)
+    path = _fresh_worker(root, 0)
+    with open(path, "w") as f:
+        f.write(str(time.time() - 99999.0))   # skewed payload
+    assert heartbeat.count_dead(1, root=root, timeout=10) == 0
+
+
+def test_stale_ranks_subset(tmp_path):
+    root = str(tmp_path)
+    _fresh_worker(root, 0)
+    _fresh_worker(root, 2, age=50)
+    assert heartbeat.stale_ranks([0, 1, 2], root=root, timeout=10) == [1, 2]
+    # no root configured: no verdicts (the surface is inert)
+    assert heartbeat.stale_ranks([0, 1], root=None, timeout=10) == []
+
+
+# ---------------------------------------------------------------------------
+# CollectiveGate: the pre-collective barrier-file protocol
+# ---------------------------------------------------------------------------
+
+def test_gate_both_members_pass(tmp_path):
+    root = str(tmp_path)
+    _fresh_worker(root, 0)
+    _fresh_worker(root, 1)
+    g0 = CollectiveGate(0, (0, 1), root=root, poll=0.01)
+    g1 = CollectiveGate(1, (0, 1), root=root, poll=0.01)
+    out = {}
+
+    def cross(gate, key):
+        out[key] = gate.arrive_and_wait()
+
+    t = threading.Thread(target=cross, args=(g1, "r1"))
+    t.start()
+    cross(g0, "r0")
+    t.join(5)
+    assert out == {"r0": 1, "r1": 1}
+    # a second crossing bumps the generation — same files, rewritten
+    t = threading.Thread(target=cross, args=(g1, "r1"))
+    t.start()
+    cross(g0, "r0")
+    t.join(5)
+    assert out == {"r0": 2, "r1": 2}
+
+
+def test_gate_detects_dead_peer(tmp_path):
+    root = str(tmp_path)
+    _fresh_worker(root, 0)
+    _fresh_worker(root, 1, age=100)   # peer's heartbeat is stale
+    g0 = CollectiveGate(0, (0, 1), root=root, timeout=10, poll=0.01)
+    with pytest.raises(DeadWorkerError) as ei:
+        g0.arrive_and_wait()
+    assert ei.value.ranks == (1,)
+    assert ei.value.channel == "step"
+    assert ei.value.generation == 1
+    assert not ei.value.timed_out
+
+
+def test_gate_waits_for_slow_but_live_peer_then_hard_timeout(tmp_path):
+    """A missing peer whose heartbeat stays FRESH is slow, not dead —
+    the gate keeps waiting, and only the hard cap raises (flagged
+    ``timed_out`` so the caller can tell the two apart)."""
+    root = str(tmp_path)
+    _fresh_worker(root, 0)
+    _fresh_worker(root, 1)            # fresh heartbeat, never arrives
+    g0 = CollectiveGate(0, (0, 1), root=root, timeout=10,
+                        gate_timeout=0.3, poll=0.01)
+    t0 = time.monotonic()
+    with pytest.raises(DeadWorkerError) as ei:
+        g0.arrive_and_wait()
+    assert time.monotonic() - t0 >= 0.25
+    assert ei.value.timed_out
+    assert ei.value.ranks == (1,)
+
+
+def test_gate_disabled_without_root_or_peers(tmp_path):
+    # no heartbeat dir: crossings are no-ops (still generation-counted)
+    g = CollectiveGate(0, (0, 1), root=None)
+    assert not g.enabled
+    assert g.arrive_and_wait() == 1
+    # single member: nothing to guard
+    g = CollectiveGate(0, (0,), root=str(tmp_path))
+    assert not g.enabled
+    assert g.arrive_and_wait() == 1
+
+
+def test_gate_kv_collective_fault_site_fires_before_arrival(tmp_path):
+    """The chaos lane's deterministic kill point: an injected raise at
+    ``kv_collective`` fires BEFORE the arrival is published, so peers
+    observe an absent arrival — exactly a mid-training death."""
+    root = str(tmp_path)
+    _fresh_worker(root, 0)
+    g = CollectiveGate(0, (0, 1), root=root, poll=0.01)
+    faults.configure("kv_collective:raise:n=1")
+    try:
+        with pytest.raises(faults.InjectedFault):
+            g.arrive_and_wait()
+        assert not os.path.exists(g._member_path(0))
+        assert faults.counts()["kv_collective"]["fired"] == 1
+    finally:
+        faults.clear()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_heartbeat_fault_site_kills_the_beat(tmp_path):
+    """``heartbeat:raise`` kills the beat thread: the worker computes
+    on but reads as dead — the zombie case the liveness tier must
+    treat as a member loss. (The thread dying on the injected raise is
+    the point — its unhandled-exception warning is expected.)"""
+    root = str(tmp_path)
+    faults.configure("heartbeat:raise:first=1000000")
+    try:
+        heartbeat.start_heartbeat(0, root=root, interval=0.02)
+        deadline = time.time() + 5
+        while time.time() < deadline \
+                and not faults.counts().get("heartbeat", {}).get("fired"):
+            time.sleep(0.02)
+        assert faults.counts()["heartbeat"]["fired"] >= 1
+        # the raise fired before the first write: no live file ever
+        assert heartbeat.alive_ranks(root=root, timeout=10) == set()
+        assert heartbeat.count_dead(1, root=root, timeout=10) == 1
+    finally:
+        faults.clear()
+        heartbeat.stop_heartbeat()

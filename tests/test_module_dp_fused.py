@@ -227,10 +227,12 @@ def test_dp_fallback_continuity_mid_training():
 # ---------------------------------------------------------------------------
 
 def test_dp_fallback_code_dist_kvstore():
-    """dist_* stores cross worker processes — the step must phase-split
-    with the stable ``kvstore_dist`` code, and still train."""
+    """``dist_async`` keeps the explicit wire path (async application
+    is wire-emulated) — the step must phase-split with the stable
+    ``kvstore_dist`` code, and still train. (``dist_sync`` no longer
+    falls back: the fused step spans processes — ISSUE 12.)"""
     with _pin("1"):
-        mod = _make_module(2, "dist_sync")
+        mod = _make_module(2, "dist_async")
         before = np.asarray(mod._exec.arg_dict["fc1_weight"]._data).copy()
         assert not mod.fused_step(_batches(1)[0])
         reason = mod._fused_fallback_reason
@@ -239,6 +241,26 @@ def test_dp_fallback_code_dist_kvstore():
         assert reason == "kvstore-mediated update"  # legacy text pinned
         after = np.asarray(mod._exec.arg_dict["fc1_weight"]._data)
         assert not np.array_equal(before, after), "fallback must train"
+
+
+def test_dist_sync_fuses_single_process():
+    """The dist tier (ISSUE 12): ``dist_sync`` rides the fused
+    donated-buffer step — in a single-process job the process-spanning
+    mesh degenerates to the local program (``_dist_spec`` is None) and
+    the step fuses with NO ``kvstore_dist`` fallback event."""
+    from mxnet_tpu import telemetry
+    with _pin("1"):
+        telemetry.reset()
+        mod = _make_module(2, "dist_sync")
+        assert mod._update_on_kvstore        # dist_* forces kvstore-side
+        assert mod._dist_spec is None        # one process: local program
+        before = np.asarray(mod._exec.arg_dict["fc1_weight"]._data).copy()
+        assert mod.fused_step(_batches(1)[0])
+        assert mod._fused_fallback_reason is None
+        assert telemetry.counters().get("fused_fallback.kvstore_dist",
+                                        0) == 0
+        after = np.asarray(mod._exec.arg_dict["fc1_weight"]._data)
+        assert not np.array_equal(before, after), "fused step must train"
 
 
 def test_fallback_codes_are_stable_and_enumerable():

@@ -246,7 +246,12 @@ def test_launch_dead_node_visibility(tmp_path):
     script.write_text(
         "import sys, time, os; sys.path.insert(0, %r)\n" % REPO +
         "import mxnet_tpu as mx\n"
-        "os.environ['MXTPU_HEARTBEAT_TIMEOUT'] = '2'\n"
+        # fast beats + a WIDE staleness margin (25 beats): this test
+        # pins visibility semantics, not detection latency — under
+        # full-suite load a 1s-interval beat thread can gap past a 2s
+        # timeout and a live peer reads as dead (flaky)
+        "os.environ['MXTPU_HEARTBEAT_INTERVAL'] = '0.2'\n"
+        "os.environ['MXTPU_HEARTBEAT_TIMEOUT'] = '5'\n"
         "kv = mx.kv.create('dist_sync')\n"
         "kv.barrier()\n"
         "assert kv.num_dead_node() == 0, kv.num_dead_node()\n"

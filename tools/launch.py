@@ -14,6 +14,14 @@ Role env vars are still exported (DMLC_ROLE=worker, DMLC_NUM_WORKER,
 DMLC_WORKER_ID) so reference launch scripts keep working; servers
 (``-s``) are accepted and ignored with a note, since all-reduce replaces
 the parameter server.
+
+Elastic posture: each worker heartbeats into ``--heartbeat-dir`` (shared
+filesystem) and gates every cross-process collective on peer liveness
+(mxnet_tpu/heartbeat.py). A worker that dies mid-training is detected
+within ``--heartbeat-timeout`` seconds by its peers, which re-mesh over
+the survivors and resume from the last checkpoint when the training
+script passes ``fit(checkpoint=...)`` — see README "Distributed
+training" for what is lost on a member death.
 """
 import argparse
 import os
@@ -35,8 +43,11 @@ def build_env(rank, args):
         "MXNET_TPU_NUM_PROCESSES": str(args.num_workers),
         "MXNET_TPU_PROCESS_ID": str(rank),
         # liveness surface (mxnet_tpu/heartbeat.py; reference
-        # get_num_dead_node via scheduler heartbeats, kvstore.h:338)
+        # get_num_dead_node via scheduler heartbeats, kvstore.h:338 —
+        # promoted to the pre-collective gate + elastic re-mesh)
         "MXTPU_HEARTBEAT_DIR": args.heartbeat_dir,
+        "MXTPU_HEARTBEAT_INTERVAL": str(args.heartbeat_interval),
+        "MXTPU_HEARTBEAT_TIMEOUT": str(args.heartbeat_timeout),
     })
     if args.force_cpu:
         env["MXNET_TPU_FORCE_CPU"] = "1"
@@ -107,6 +118,12 @@ def main():
     parser.add_argument("--heartbeat-dir", type=str, default=None,
                         help="shared dir for worker liveness heartbeats "
                              "(default: a per-port tempdir, wiped at launch)")
+    parser.add_argument("--heartbeat-interval", type=float, default=1.0,
+                        help="seconds between liveness beats")
+    parser.add_argument("--heartbeat-timeout", type=float, default=10.0,
+                        help="beat staleness after which a worker is "
+                             "declared dead (drives how fast survivors "
+                             "re-mesh)")
     parser.add_argument("--devices-per-worker", type=int, default=1)
     parser.add_argument("command", nargs=argparse.REMAINDER)
     args = parser.parse_args()

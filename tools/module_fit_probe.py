@@ -32,6 +32,13 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 SMOKE = os.environ.get("MXTPU_PROBE_SMOKE", "") == "1"
 FIT_SMOKE = "--fit-smoke" in sys.argv
 DP_SMOKE = "--dp-smoke" in sys.argv
+DIST_SMOKE = "--dist-smoke" in sys.argv
+DIST_CHILD = "--dist-child" in sys.argv
+# a dist child that dies on an injected fault exits THROUGH
+# mx.dist.abort with this code (destructor-free death: a crashing
+# worker must not drag survivors into the coordination shutdown
+# barrier); the parent gates on it
+DIST_FAULT_RC = 21
 N_DEV = 8
 BATCH = 8 if SMOKE else 128
 IMG = 32 if SMOKE else 224
@@ -49,7 +56,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-if SMOKE or FIT_SMOKE or DP_SMOKE:
+if SMOKE or FIT_SMOKE or DP_SMOKE or DIST_SMOKE or DIST_CHILD:
     jax.config.update("jax_platforms", "cpu")
 
 import mxnet_tpu as mx
@@ -417,6 +424,368 @@ def dp_smoke(json_out=None, nbatch=12, batch=32):
                 f.write(json.dumps(out) + "\n")
 
 
+# ---------------------------------------------------------------------------
+# dist-smoke: 2-process fused dist_sync + elastic chaos leg (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+DIST_D, DIST_C = 16, 4
+
+
+def _dist_mlp():
+    import mxnet_tpu as mx
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=DIST_C, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _dist_arg(name, default=None, cast=str):
+    if name not in sys.argv:
+        return default
+    i = sys.argv.index(name) + 1
+    if i >= len(sys.argv):
+        raise SystemExit("%s: missing value" % name)
+    return cast(sys.argv[i])
+
+
+def dist_child():
+    """ONE worker of the dist lane: deterministic global batches, this
+    rank's slice fed locally, fused dist_sync Module.fit. Writes a JSON
+    result (params as float64 lists so the parent can gate bit-equality
+    across ranks and rtol vs the single-process oracle). Run with the
+    MXNET_TPU_COORDINATOR trio in the env for the 2-process legs, or
+    without it as the single-process oracle."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry, dist as mxdist
+    from mxnet_tpu.io import DataIter, DataDesc, DataBatch
+
+    json_out = _dist_arg("--json-out")
+    nproc = _dist_arg("--dist-nproc", 1, int)
+    epochs = _dist_arg("--dist-epochs", 2, int)
+    nbatch = _dist_arg("--dist-nbatch", 6, int)
+    global_batch = _dist_arg("--dist-global-batch", 32, int)
+    seed = _dist_arg("--dist-seed", 1234, int)
+    ckpt_dir = _dist_arg("--dist-ckpt")
+    rank = mxdist.rank()
+    local = global_batch // nproc
+    sl = slice(rank * local, (rank + 1) * local)
+
+    rs = np.random.RandomState(seed)
+    batches = [(rs.uniform(-1, 1, (global_batch, DIST_D))
+                .astype(np.float32),
+                rs.randint(0, DIST_C, global_batch).astype(np.float32))
+               for _ in range(nbatch)]
+
+    class _It(DataIter):
+        def __init__(self):
+            super().__init__(local)
+            self.i = 0
+
+        @property
+        def provide_data(self):
+            return [DataDesc("data", (local, DIST_D))]
+
+        @property
+        def provide_label(self):
+            return [DataDesc("softmax_label", (local,))]
+
+        def reset(self):
+            self.i = 0
+
+        def next(self):
+            if self.i >= nbatch:
+                raise StopIteration
+            x, y = batches[self.i]
+            self.i += 1
+            return DataBatch([mx.nd.array(x[sl])],
+                             [mx.nd.array(y[sl])], pad=0)
+
+    telemetry.enable()
+    # Xavier draws from numpy's GLOBAL generator — identical init across
+    # ranks and across the oracle leg needs an explicit seed (the dist
+    # commit also broadcasts rank 0's values, but the oracle leg has no
+    # one to broadcast from)
+    np.random.seed(seed)
+    mgr = None
+    if ckpt_dir:
+        mgr = mx.CheckpointManager(
+            os.path.join(ckpt_dir, "r%d" % rank, "model"), keep_last=3)
+    mod = mx.mod.Module(_dist_mlp(), context=mx.cpu())
+    metric = mx.metric.Accuracy()
+    mod.fit(_It(), eval_metric=metric, num_epoch=epochs,
+            kvstore="dist_sync", initializer=mx.initializer.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            checkpoint=mgr)
+    reason = mod._fused_fallback_reason
+    snap = telemetry.counters()
+    params, _ = mod.get_params()
+    res = {
+        "rank": rank,
+        "nproc": nproc,
+        "fallback_code": getattr(reason, "code", None),
+        "kvstore_dist_fallbacks": snap.get("fused_fallback.kvstore_dist",
+                                           0),
+        "dist_counters": {k: int(v) for k, v in snap.items()
+                          if k.startswith(("kvstore.dist", "elastic"))},
+        "acc": metric.get()[1],
+        "finite": bool(all(
+            np.isfinite(np.asarray(v.asnumpy())).all()
+            for v in params.values())),
+        "params": {k: np.asarray(v.asnumpy(), np.float64).tolist()
+                   for k, v in sorted(params.items())},
+        "completed": True,
+    }
+    if json_out:
+        with open(json_out, "w") as f:
+            json.dump(res, f)
+    mxdist.finalize()
+    print("dist child rank=%d done" % rank, flush=True)
+
+
+def _dist_child_main():
+    import traceback
+    try:
+        dist_child()
+    except BaseException:
+        traceback.print_exc()
+        sys.stderr.flush()
+        from mxnet_tpu import dist as mxdist
+        if mxdist.initialized():
+            # die WITHOUT destructors: a crashing worker that tears
+            # down its coordination client drags every survivor into
+            # the fatal shutdown barrier — exactly what the elastic
+            # tier exists to avoid
+            mxdist.abort(DIST_FAULT_RC)
+        raise
+
+
+def dist_smoke(json_out=None):
+    """Tier-1 dist lane: real 2-process ``dist_sync`` on one box
+    (``jax.distributed`` over localhost, gloo CPU collectives).
+
+    Leg A (fused): both workers run the fused donated-buffer train step
+    over the process-spanning dp mesh — gates zero ``kvstore_dist``
+    fallback events and BIT-EQUAL params across ranks.
+    Leg B (oracle): a single-process run at the same global batch —
+    gates params equal at rtol=1e-5 (the cross-host psum reassociates
+    the batch reduction; bit-equality is reported, not required).
+    Leg C (chaos): rank 1 is killed deterministically mid-epoch by an
+    injected ``kv_collective`` fault — gates that rank 0 detects the
+    death via the liveness gate, re-meshes, resumes from the last
+    atomic checkpoint, FINISHES the run (exit 0, finite params,
+    elastic counters), and that the postmortem names rank 1 and parses
+    via tools/flight_view.py. Every leg runs under a hard timeout: a
+    hung process fails the lane."""
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    work = tempfile.mkdtemp(prefix="mxtpu-dist-smoke-")
+    out = {"lane": "module_fit_dist_smoke", "platform": "cpu"}
+    epochs, nbatch, gbatch = 2, 6, 32
+
+    def _free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def _spawn(tag, rank, nproc, port, args, env_extra):
+        env = dict(os.environ, JAX_PLATFORMS="cpu", MXNET_TELEMETRY="1")
+        env.pop("XLA_FLAGS", None)
+        env.pop("MXNET_FAULTS", None)
+        hb = os.path.join(work, "hb-%s" % tag)
+        os.makedirs(hb, exist_ok=True)
+        if nproc > 1:
+            env.update({
+                "MXNET_TPU_COORDINATOR": "127.0.0.1:%d" % port,
+                "MXNET_TPU_NUM_PROCESSES": str(nproc),
+                "MXNET_TPU_PROCESS_ID": str(rank),
+                "MXTPU_HEARTBEAT_DIR": hb,
+                # 15 beats of staleness margin: a share-throttled box
+                # can gap a beat thread well past one interval
+                "MXTPU_HEARTBEAT_INTERVAL": "0.2",
+                "MXTPU_HEARTBEAT_TIMEOUT": "3.0",
+                "MXTPU_GATE_TIMEOUT": "60",
+            })
+        env.update(env_extra)
+        jout = os.path.join(work, "%s-r%d.json" % (tag, rank))
+        cmd = [sys.executable,
+               os.path.join(root, "tools", "module_fit_probe.py"),
+               "--dist-child", "--json-out", jout,
+               "--dist-nproc", str(nproc), "--dist-epochs", str(epochs),
+               "--dist-nbatch", str(nbatch),
+               "--dist-global-batch", str(gbatch)] + args
+        log = open(os.path.join(work, "%s-r%d.log" % (tag, rank)), "wb")
+        p = subprocess.Popen(cmd, stdout=log, stderr=log, env=env,
+                             cwd=root)
+        p._mxtpu_json = jout
+        p._mxtpu_log = log
+        return p
+
+    def _leg(tag, procs, timeout_s):
+        """Wait for every proc under ONE deadline; kill stragglers —
+        a hung worker is a lane FAILURE, never a hung lane."""
+        import time as _time
+        deadline = _time.monotonic() + timeout_s
+        rcs, results = [], []
+        try:
+            for p in procs:
+                left = max(1.0, deadline - _time.monotonic())
+                try:
+                    p.wait(timeout=left)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+                    raise SystemExit(
+                        "dist-smoke[%s]: worker hung past %ds (killed); "
+                        "logs under %s" % (tag, timeout_s, work))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                p._mxtpu_log.close()
+        for p in procs:
+            rcs.append(p.returncode)
+            try:
+                with open(p._mxtpu_json) as f:
+                    results.append(json.load(f))
+            except (OSError, ValueError):
+                results.append(None)
+        return rcs, results
+
+    try:
+        # -- leg A: 2-process fused dist_sync ---------------------------
+        port = _free_port()
+        procs = [_spawn("fused", r, 2, port, [], {}) for r in (0, 1)]
+        rcs, res = _leg("fused", procs, 240)
+        a0, a1 = res
+        out["fused"] = {
+            "rcs": rcs,
+            "fallback_codes": [r and r["fallback_code"] for r in res],
+            "kvstore_dist_fallbacks": [
+                r["kvstore_dist_fallbacks"] if r else None for r in res],
+            "dist_counters": a0 and a0["dist_counters"],
+            "acc": [r and r["acc"] for r in res],
+        }
+
+        # -- leg B: single-process oracle, same global batch ------------
+        procs = [_spawn("single", 0, 1, 0, [], {})]
+        rcs_s, res_s = _leg("single", procs, 180)
+        single = res_s[0]
+        out["single"] = {"rcs": rcs_s, "acc": single and single["acc"]}
+
+        # -- leg C: chaos — kill rank 1 mid-epoch, rank 0 recovers ------
+        # one gate crossing per fused step: nbatch gens per epoch, so
+        # n = nbatch + 3 dies in epoch 1 at batch index 2, AFTER the
+        # epoch-0-end checkpoint exists
+        chaos_epochs = 3
+        fault_n = nbatch + 3
+        flight = os.path.join(work, "flight0")
+        os.makedirs(flight, exist_ok=True)
+        ckpt = os.path.join(work, "ckpt")
+        port = _free_port()
+        epochs = chaos_epochs
+        procs = [
+            _spawn("chaos", 0, 2, port, ["--dist-ckpt", ckpt],
+                   {"MXNET_FLIGHT_DIR": flight}),
+            _spawn("chaos", 1, 2, port, ["--dist-ckpt", ckpt],
+                   {"MXNET_FAULTS": "kv_collective:raise:n=%d" % fault_n}),
+        ]
+        rcs_c, res_c = _leg("chaos", procs, 300)
+        c0 = res_c[0]
+        pms = sorted(f for f in os.listdir(flight)
+                     if f.endswith("dead_worker.json"))
+        pm_summary = None
+        if pms:
+            view = subprocess.run(
+                [sys.executable,
+                 os.path.join(root, "tools", "flight_view.py"),
+                 os.path.join(flight, pms[0]), "--json"],
+                stdout=subprocess.PIPE, text=True, timeout=60, cwd=root)
+            if view.returncode == 0:
+                pm_summary = json.loads(view.stdout)
+        out["chaos"] = {
+            "rcs": rcs_c,
+            "survivor": c0 and {
+                "completed": c0["completed"], "finite": c0["finite"],
+                "elastic": c0["dist_counters"]},
+            "postmortems": pms,
+            "postmortem_extra": pm_summary and pm_summary.get("extra"),
+        }
+
+        # -- gates ------------------------------------------------------
+        try:
+            # A: fused across processes, zero dist fallbacks, replicas
+            # bit-equal
+            assert rcs == [0, 0], out["fused"]
+            assert all(r and r["completed"] for r in res), out["fused"]
+            assert [r["fallback_code"] for r in res] == [None, None], \
+                out["fused"]
+            assert [r["kvstore_dist_fallbacks"] for r in res] == [0, 0], \
+                out["fused"]
+            assert a0["dist_counters"].get("kvstore.dist.fused_steps") \
+                == 2 * nbatch, a0["dist_counters"]
+            bit_equal_ranks = all(
+                np.array_equal(np.array(a0["params"][k]),
+                               np.array(a1["params"][k]))
+                for k in a0["params"])
+            assert bit_equal_ranks, "replicas diverged across ranks"
+            # B: matches the single-process oracle at the same global
+            # batch (psum reassociation noise only)
+            assert rcs_s == [0] and single and single["completed"]
+            max_abs = max(
+                float(np.abs(np.array(a0["params"][k])
+                             - np.array(single["params"][k])).max())
+                for k in a0["params"])
+            out["oracle_max_abs_diff"] = max_abs
+            out["oracle_bit_equal"] = all(
+                np.array_equal(np.array(a0["params"][k]),
+                               np.array(single["params"][k]))
+                for k in a0["params"])
+            assert all(
+                np.allclose(np.array(a0["params"][k]),
+                            np.array(single["params"][k]),
+                            rtol=1e-5, atol=1e-6)
+                for k in a0["params"]), "2-proc vs single: %r" % max_abs
+            # C: deterministic kill, detected, re-meshed, resumed,
+            # finished; postmortem names rank 1
+            assert rcs_c[1] == DIST_FAULT_RC, rcs_c
+            assert rcs_c[0] == 0, rcs_c
+            assert c0 and c0["completed"] and c0["finite"], out["chaos"]
+            el = c0["dist_counters"]
+            assert el.get("elastic.dead_workers") == 1, el
+            assert el.get("elastic.remesh") == 1, el
+            assert el.get("elastic.resumed") == 1, el
+            assert pms, "no dead_worker postmortem written"
+            assert pm_summary is not None, "flight_view failed to parse"
+            extra = pm_summary["extra"]
+            assert extra["dead_ranks"] == [1], extra
+            assert extra["epoch"] == 1 and extra["nbatch"] == 2, extra
+            out["gates_passed"] = True
+        except AssertionError:
+            out["gates_passed"] = False
+            raise
+    finally:
+        # params are bulky and served their purpose — keep the artifact
+        # readable
+        line = json.dumps(out)
+        print(line, flush=True)
+        if json_out:
+            with open(json_out, "w") as f:
+                f.write(line + "\n")
+        if out.get("gates_passed"):
+            shutil.rmtree(work, ignore_errors=True)
+        else:
+            print("dist-smoke: logs kept under %s" % work, flush=True)
+    return out
+
+
 def _json_out_arg():
     if "--json-out" not in sys.argv:
         return None
@@ -427,7 +796,11 @@ def _json_out_arg():
 
 
 if __name__ == "__main__":
-    if DP_SMOKE:
+    if DIST_CHILD:
+        _dist_child_main()
+    elif DIST_SMOKE:
+        dist_smoke(json_out=_json_out_arg())
+    elif DP_SMOKE:
         dp_smoke(json_out=_json_out_arg())
     elif FIT_SMOKE:
         fit_smoke(json_out=_json_out_arg())
