@@ -50,7 +50,29 @@ Rules shipped (ids are stable; tests and suppressions key on them):
                      ``telemetry.counter_inc`` literals vs
                      ``telemetry.COUNTERS`` — both directions (undeclared
                      use AND unused declaration)
+``lockset``          (mxflow) RacerD-style inference: a ``self.<attr>``
+                     locked on some paths and bare on others, with the
+                     missing ``# guarded by:`` line proposed
+``trace-purity``     (mxflow) side effects reachable from a traced entry
+                     point over call+ref edges
+``thread-race``      (mxsync) a ``self.<attr>``/module global written
+                     under one THREAD ROOT (Thread/Timer/pool-submit/
+                     HTTP-handler/atexit/signal/excepthook/finalizer,
+                     propagated over call+ref edges) and touched under a
+                     different root with an empty lockset intersection —
+                     both witness chains in the finding
+``collective-discipline``
+                     (mxsync) host-level cross-process collectives
+                     (``_host_allgather``, ``# mxsync: collective
+                     channel=<c>``-marked primitives) must be dominated
+                     by a matching-channel ``CollectiveGate`` crossing,
+                     and no rank/clock/fault-derived branch may make its
+                     arms reach different collective sequences
 ==================== ======================================================
+
+``host-sync`` and ``donation-safety`` also carry interprocedural
+layers (mxflow): transitive blocking fetches with the witness chain,
+and donation facts propagated through in-repo callees.
 """
 from .core import (Finding, Source, Project, Baseline, Report, run,
                    iter_python_files, ALL_RULE_IDS)

@@ -223,6 +223,7 @@ class CallGraph:
         self._sccs = None
         self._locals = {}               # FuncInfo -> frozenset of names
         self._by_src = None             # src -> [FuncInfo]
+        self._scope_nodes = {}          # FuncInfo -> tuple of scope nodes
 
     # -- construction -------------------------------------------------------
     def _add_class(self, ci):
@@ -257,6 +258,17 @@ class CallGraph:
     def func_for_node(self, src, node):
         """FuncInfo of a def node seen by a rule (or None)."""
         return self._node_func.get((src, id(node)))
+
+    def nodes_of(self, fi):
+        """The function's same-scope AST nodes, materialized once —
+        the mxsync models each need several passes over every
+        function, and re-walking the tree per pass dominated their
+        build time."""
+        got = self._scope_nodes.get(fi)
+        if got is None:
+            got = self._scope_nodes[fi] = tuple(
+                _walk_same_scope(fi.node))
+        return got
 
     def functions_of(self, src):
         """Every FuncInfo defined in one source file."""

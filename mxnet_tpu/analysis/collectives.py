@@ -1,0 +1,559 @@
+"""mxsync's SPMD collective model: site index, gates, divergence.
+
+A multi-process SPMD runtime dies two ways that no unit test shows: a
+cross-process collective entered while a peer is already dead (cluster
+hang — PR 11's ``CollectiveGate`` exists precisely to turn that into
+``DeadWorkerError``), and a rank- or time-dependent branch that makes
+one process run a DIFFERENT collective sequence than its peers (one
+rank skips a psum; everyone else blocks in it forever). This module
+indexes the collective surface statically so the
+``collective-discipline`` rule can police both:
+
+* **collective sites** — calls to ``KVStore._host_allgather`` (channel
+  ``kv``), calls to functions whose ``def`` line carries a
+  ``# mxsync: collective channel=<c>`` marker (the declarative index:
+  ``spmd.broadcast_from_zero`` is marked ``kv``; a call-line marker
+  overrides per site), and ``jax.lax`` device collectives
+  (``psum``/``all_gather``/... — channel ``step``). Host-level sites
+  (the first two) participate in gate-coverage checking; ``lax.*``
+  sites live inside traced programs whose *dispatch* is what the gate
+  protects, so they feed only the divergence/sequence checks;
+* **gate crossings** — ``<gate>.arrive_and_wait()`` where the receiver
+  resolves to a ``CollectiveGate(...)`` construction (local binding,
+  ``self.<attr>``, a gate-returning method, or a direct chained call),
+  with the channel read off the construction's ``channel=`` literal
+  (default ``step``, matching the class);
+* **entry-gated channels** — the meet, over every resolved call site,
+  of the channels a function's callers have crossed before the call
+  (lexically-earlier crossing in the caller, or the caller's own entry
+  set): ``_assert_push_discipline`` is entry-gated ``kv`` because its
+  only caller crosses the kv gate first. A function with a ref-edge
+  caller or no callers starts ungated (anyone may reach it bare);
+* **reachable-collective summaries** — per function, every collective
+  label reachable over call edges; the divergence check compares the
+  two arms of a rank/clock/fault-derived branch (plus the fallthrough
+  suffix for arms that return/raise) and flags arms whose reachable
+  collective sets differ.
+
+Lexical position (line order within one function) stands in for
+dominance — the runtime's gate-then-exchange code is straight-line —
+and every reported chain is a real call path (dynamic calls are never
+traversed), matching mxflow's conservative posture.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import callgraph as cg
+from .core import expr_text, resolve_origin
+from .summaries import _CLOCK_ORIGINS, _is_rng_origin
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+ANY_CHANNEL = "<any>"
+
+# jax.lax device collectives: inside a compiled program, protected by
+# gating the DISPATCH (invisible statically) — indexed for sequence/
+# divergence checks only, never for gate coverage
+_LAX_COLLECTIVES = {
+    "jax.lax.psum", "jax.lax.pmean", "jax.lax.pmax", "jax.lax.pmin",
+    "jax.lax.all_gather", "jax.lax.psum_scatter", "jax.lax.all_to_all",
+    "jax.lax.ppermute", "jax.lax.pshuffle",
+}
+
+# names whose value differs per process: a branch on one of these can
+# desynchronise the collective sequence across ranks
+_TAINT_NAMES = {"rank", "process_id", "process_index"}
+_TAINT_CALL_BASENAMES = {"process_index", "getpid", "gethostname"}
+
+
+class Crossing:
+    __slots__ = ("line", "col", "channel")
+
+    def __init__(self, line, col, channel):
+        self.line = line
+        self.col = col
+        self.channel = channel          # None = unresolved gate: wildcard
+
+
+class Site:
+    __slots__ = ("line", "col", "channel", "kind", "host")
+
+    def __init__(self, line, col, channel, kind, host):
+        self.line = line
+        self.col = col
+        self.channel = channel
+        self.kind = kind                # "host_allgather"/"psum"/func name
+        self.host = host                # True: gate-coverage checked
+
+    def label(self):
+        return "%s[%s]" % (self.kind, self.channel)
+
+
+def _gate_channel(call):
+    """The ``channel=`` literal of a CollectiveGate construction
+    (default "step", the class default); None for a non-literal."""
+    for kw in call.keywords:
+        if kw.arg == "channel":
+            if isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                return kw.value.value
+            return None
+    return "step"
+
+
+def _is_gate_ctor(call):
+    f = call.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    return name == "CollectiveGate"
+
+
+def _terminates(stmts):
+    return any(isinstance(s, (ast.Return, ast.Raise, ast.Break,
+                              ast.Continue)) for s in stmts)
+
+
+class CollectiveModel:
+    """Sites, crossings, entry-gated channels and reachable-collective
+    summaries over one Project's call graph."""
+
+    def __init__(self, project, graph):
+        self.project = project
+        self.graph = graph
+        self._gate_attrs = {}       # (ClassInfo, attr) -> channel
+        self._gate_methods = {}     # (ClassInfo, name) -> channel
+        self._fn = {}               # FuncInfo -> (crossings, sites)
+        self._labels = {}           # FuncInfo -> {(l,c): set(labels)}
+        self._edges = {}            # FuncInfo -> {(l,c): callee}
+        self._alias_edges = {}      # FuncInfo -> {(l,c): callee} via local
+        self._reach = {}            # FuncInfo -> frozenset(labels)
+        self._entry = {}            # FuncInfo -> frozenset(channels)
+        self._index_gates()
+        for fi in graph.functions:
+            self._scan_function(fi)
+        self._fix_reach()
+        self._fix_entry()
+
+    # -- gate constructions --------------------------------------------------
+    def _index_gates(self):
+        for fi in self.graph.functions:
+            if fi.self_class is None:
+                continue
+            nodes = self.graph.nodes_of(fi)
+            for n in nodes:
+                if not (isinstance(n, ast.Call) and _is_gate_ctor(n)):
+                    continue
+                # a construction anywhere in a method makes the method
+                # gate-returning (the `self._collective_gate()` idiom)
+                self._gate_methods[(fi.self_class, fi.name)] = \
+                    _gate_channel(n)
+            # `self.X = CollectiveGate(...)` binds the attribute
+            for n in nodes:
+                if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                        and isinstance(n.value, ast.Call) \
+                        and _is_gate_ctor(n.value):
+                    t = n.targets[0]
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        self._gate_attrs[(fi.self_class, t.attr)] = \
+                            _gate_channel(n.value)
+
+    def _crossing_channel(self, fi, call, local_gates):
+        """Channel of an ``X.arrive_and_wait()`` crossing, or None
+        (unresolved gate = wildcard crossing)."""
+        recv = call.func.value
+        ci = fi.self_class if fi is not None else None
+        if isinstance(recv, ast.Name):
+            if recv.id in local_gates:
+                return local_gates[recv.id]
+        elif isinstance(recv, ast.Attribute) \
+                and isinstance(recv.value, ast.Name) \
+                and recv.value.id == "self" and ci is not None:
+            got = self._gate_attrs.get((ci, recv.attr))
+            if got is not None:
+                return got
+        elif isinstance(recv, ast.Call):
+            if _is_gate_ctor(recv):
+                return _gate_channel(recv)
+            rf = recv.func
+            if isinstance(rf, ast.Attribute) \
+                    and isinstance(rf.value, ast.Name) \
+                    and rf.value.id == "self" and ci is not None:
+                got = self._gate_methods.get((ci, rf.attr))
+                if got is not None:
+                    return got
+        return None
+
+    # -- per-function scan ---------------------------------------------------
+    def _resolved_callee(self, fi, call, edge_map):
+        key = (call.lineno, call.col_offset)
+        return edge_map.get(key) or self._alias_edges.get(fi, {}).get(key)
+
+    def _scan_function(self, fi):
+        src = fi.src
+        graph = self.graph
+        amap = graph.imports_of(src)
+        edge_map = {(l, c): callee
+                    for callee, l, c in graph.callees(fi)}
+        self._edges[fi] = edge_map
+
+        nodes = self.graph.nodes_of(fi)
+        # flow-insensitive local bindings: gates and function aliases
+        local_gates = {}
+        local_fns = {}
+        for n in nodes:
+            if not (isinstance(n, ast.Assign) and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)):
+                continue
+            name = n.targets[0].id
+            v = n.value
+            if isinstance(v, ast.Call) and _is_gate_ctor(v):
+                local_gates[name] = _gate_channel(v)
+            elif isinstance(v, ast.Call) and not _is_gate_ctor(v):
+                rf = v.func
+                if isinstance(rf, ast.Attribute) \
+                        and isinstance(rf.value, ast.Name) \
+                        and rf.value.id == "self" \
+                        and fi.self_class is not None:
+                    got = self._gate_methods.get(
+                        (fi.self_class, rf.attr))
+                    if got is not None:
+                        local_gates[name] = got
+            elif isinstance(v, (ast.Name, ast.Attribute)):
+                # `broadcast = broadcast_from_zero`: calls through the
+                # local name are calls to the bound function
+                target = None
+                if isinstance(v, ast.Name):
+                    got = graph.resolve_name(src, fi, v.id)
+                    if got is not None and got[0] == "func":
+                        target = got[1]
+                else:
+                    origin = resolve_origin(v, amap)
+                    if origin:
+                        got = graph.resolve_dotted(origin)
+                        if got is not None and got[0] == "func":
+                            target = got[1]
+                if target is not None:
+                    local_fns[name] = target
+
+        crossings, sites = [], []
+        alias_edges = {}
+        for n in nodes:
+            if not isinstance(n, ast.Call):
+                continue
+            key = (n.lineno, n.col_offset)
+            f = n.func
+            if isinstance(f, ast.Attribute) \
+                    and f.attr == "arrive_and_wait":
+                crossings.append(Crossing(
+                    n.lineno, n.col_offset,
+                    self._crossing_channel(fi, n, local_gates)))
+                continue
+            # calls through a local function alias become resolvable
+            if isinstance(f, ast.Name) and f.id in local_fns \
+                    and key not in edge_map:
+                alias_edges[key] = local_fns[f.id]
+
+            site = self._classify_site(fi, n, amap, edge_map,
+                                       alias_edges)
+            if site is not None:
+                sites.append(site)
+        if alias_edges:
+            self._alias_edges[fi] = alias_edges
+        self._fn[fi] = (crossings, sites)
+
+        labels = {}
+        for c in crossings:
+            labels.setdefault((c.line, c.col), set()).add(
+                "gate[%s]" % (c.channel or "?"))
+        for s in sites:
+            labels.setdefault((s.line, s.col), set()).add(s.label())
+        self._labels[fi] = labels
+
+    def _classify_site(self, fi, call, amap, edge_map, alias_edges):
+        src = fi.src
+        key = (call.lineno, call.col_offset)
+        f = call.func
+        # explicit call-line marker wins (channel override / opaque
+        # dynamic collective)
+        mark = src.collective_marks.get(call.lineno)
+        callee = edge_map.get(key) or alias_edges.get(key)
+        def_mark = None
+        if callee is not None:
+            def_mark = callee.src.collective_marks.get(callee.node.lineno)
+        if mark is not None or def_mark is not None:
+            name = callee.name if callee is not None else (
+                f.attr if isinstance(f, ast.Attribute) else
+                f.id if isinstance(f, ast.Name) else "<dynamic>")
+            return Site(call.lineno, call.col_offset,
+                        mark or def_mark, name, host=True)
+        # the live-membership host exchange: any _host_allgather call
+        if (isinstance(f, ast.Attribute)
+                and f.attr == "_host_allgather") \
+                or (callee is not None
+                    and callee.name == "_host_allgather"):
+            return Site(call.lineno, call.col_offset, "kv",
+                        "host_allgather", host=True)
+        origin = resolve_origin(f, amap) \
+            if isinstance(f, (ast.Name, ast.Attribute)) else None
+        if origin in _LAX_COLLECTIVES:
+            return Site(call.lineno, call.col_offset, "step",
+                        origin.rsplit(".", 1)[1], host=False)
+        return None
+
+    # -- fixpoints -----------------------------------------------------------
+    def _fix_reach(self):
+        graph = self.graph
+        reach = {fi: set().union(*self._labels.get(fi, {}).values())
+                 if self._labels.get(fi) else set()
+                 for fi in graph.functions}
+        from collections import deque
+        pending = deque(fi for fi in graph.functions if reach[fi])
+        queued = set(pending)
+        while pending:
+            fi = pending.popleft()
+            queued.discard(fi)
+            for caller, _l, _c in graph.callers(fi):
+                if not reach[fi] - reach[caller]:
+                    continue
+                reach[caller] |= reach[fi]
+                if caller not in queued:
+                    pending.append(caller)
+                    queued.add(caller)
+            # alias edges are callers too (invisible to graph.callers)
+        # fold alias edges with a bounded extra sweep: alias calls are
+        # rare (one in-tree), so a simple repeated pass converges fast
+        for _round in range(4):
+            changed = False
+            for fi, amap_edges in self._alias_edges.items():
+                for callee in amap_edges.values():
+                    add = reach.get(callee, set()) - reach[fi]
+                    if add:
+                        reach[fi] |= add
+                        changed = True
+            if not changed:
+                break
+        self._reach = {fi: frozenset(v) for fi, v in reach.items()}
+
+    def _gated_at(self, fi, line):
+        """Channels guaranteed crossed before ``line`` in ``fi``."""
+        out = set(self._entry.get(fi, ()))
+        for c in self._fn.get(fi, ((), ()))[0]:
+            if c.line < line:
+                out.add(c.channel if c.channel is not None
+                        else ANY_CHANNEL)
+        return out
+
+    def _fix_entry(self):
+        graph = self.graph
+        # only functions from which a HOST-level site is reachable
+        # matter for gate coverage; bound the fixpoint to them
+        relevant = set()
+        from collections import deque
+        seeds = [fi for fi, (_c, sites) in self._fn.items()
+                 if any(s.host for s in sites)]
+        queue = deque(seeds)
+        relevant.update(seeds)
+        while queue:
+            fi = queue.popleft()
+            for caller, _l, _c in graph.callers(fi):
+                if caller not in relevant:
+                    relevant.add(caller)
+                    queue.append(caller)
+
+        universe = frozenset(
+            [ANY_CHANNEL]
+            + [s.channel for _c, ss in self._fn.values() for s in ss]
+            + [c.channel for cs, _s in self._fn.values() for c in cs
+               if c.channel is not None])
+
+        def eligible(fi):
+            return bool(graph.callers(fi)) \
+                and not graph.callers(fi, kinds=(cg.REF,))
+
+        entry = {fi: (universe if eligible(fi) else frozenset())
+                 for fi in relevant}
+        self._entry = entry
+        for _round in range(len(relevant) + 2):
+            changed = False
+            for fi in relevant:
+                if not eligible(fi):
+                    continue
+                new = None
+                for caller, line, _col in graph.callers(fi):
+                    got = frozenset(self._gated_at(caller, line)) \
+                        if caller in relevant \
+                        else frozenset(
+                            c.channel if c.channel is not None
+                            else ANY_CHANNEL
+                            for c in self._fn.get(caller, ((), ()))[0]
+                            if c.line < line)
+                    new = got if new is None else (new & got)
+                if new is None:
+                    new = frozenset()
+                if new != entry[fi]:
+                    entry[fi] = new
+                    changed = True
+            if not changed:
+                break
+
+    # -- queries for the rule ------------------------------------------------
+    def coverage(self):
+        """[(fi, site, prior_channels)] for every HOST-level site NOT
+        covered by a matching-channel (or wildcard) crossing:
+        ``prior_channels`` is what IS crossed on the path — non-empty
+        means a channel mismatch, empty means fully ungated."""
+        out = []
+        for fi, (_crossings, sites) in sorted(
+                self._fn.items(), key=lambda kv: (kv[0].src.display,
+                                                  kv[0].line)):
+            for s in sites:
+                if not s.host:
+                    continue
+                prior = self._gated_at(fi, s.line)
+                if s.channel in prior or ANY_CHANNEL in prior:
+                    continue
+                out.append((fi, s, frozenset(prior)))
+        return out
+
+    def ungated_chain(self, fi, channel):
+        """One real call chain from an ungated caller down to ``fi``:
+        ``[(caller FuncInfo, call line), ...]`` outermost first. Empty
+        when ``fi`` itself is the exposed entry."""
+        graph = self.graph
+        hops = []
+        cur = fi
+        seen = {fi}
+        for _ in range(12):
+            nxt = None
+            for caller, line, _col in graph.callers(cur):
+                if caller in seen:
+                    continue
+                gated = self._gated_at(caller, line)
+                if channel not in gated and ANY_CHANNEL not in gated:
+                    nxt = (caller, line)
+                    break
+            if nxt is None:
+                break
+            hops.append(nxt)
+            seen.add(nxt[0])
+            cur = nxt[0]
+        hops.reverse()
+        return hops
+
+    def reach(self, fi):
+        return self._reach.get(fi, frozenset())
+
+    # -- divergence ----------------------------------------------------------
+    def _taint_locals(self, fi, amap):
+        tainted = {}
+        for _round in range(2):
+            for n in self.graph.nodes_of(fi):
+                if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                        and isinstance(n.targets[0], ast.Name):
+                    reason = self._taint_reason(n.value, tainted, amap)
+                    if reason:
+                        tainted.setdefault(n.targets[0].id, reason)
+        return tainted
+
+    def _taint_reason(self, expr, tainted_locals, amap):
+        """Why this expression's value can differ across processes (a
+        human-readable source description), or None."""
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Attribute) and n.attr in _TAINT_NAMES \
+                    and isinstance(n.ctx, ast.Load):
+                return "the process rank ('%s')" % expr_text(n)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                if n.id in tainted_locals:
+                    return tainted_locals[n.id]
+                if n.id in _TAINT_NAMES:
+                    return "the process rank ('%s')" % n.id
+            if isinstance(n, ast.Call):
+                f = n.func
+                origin = resolve_origin(f, amap) \
+                    if isinstance(f, (ast.Name, ast.Attribute)) else None
+                base = origin.rsplit(".", 1)[-1] if origin else (
+                    f.attr if isinstance(f, ast.Attribute) else None)
+                if origin in _CLOCK_ORIGINS:
+                    return "the wall clock (%s())" % origin
+                if origin and _is_rng_origin(origin):
+                    return "the global RNG (%s())" % origin
+                if base in _TAINT_CALL_BASENAMES:
+                    return "the process identity (%s())" % (origin or base)
+                if base == "fire" and origin \
+                        and origin.endswith("faults.fire"):
+                    return "fault injection (%s())" % origin
+        return None
+
+    def _arm_labels(self, fi, stmts):
+        """Collective labels reachable from a statement list (direct
+        events + call-edge closures; nested defs excluded)."""
+        labels = set()
+        direct = self._labels.get(fi, {})
+        edges = self._edges.get(fi, {})
+        alias = self._alias_edges.get(fi, {})
+        stack = list(stmts)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, _FUNC_NODES + (ast.ClassDef,)):
+                continue
+            if isinstance(n, ast.Call):
+                key = (n.lineno, n.col_offset)
+                labels |= direct.get(key, set())
+                callee = edges.get(key) or alias.get(key)
+                if callee is not None:
+                    labels |= self._reach.get(callee, frozenset())
+            stack.extend(ast.iter_child_nodes(n))
+        return labels
+
+    def divergences(self, fi):
+        """[(If node, taint reason, arm-a labels, arm-b labels)] for
+        every branch in ``fi`` whose condition derives from per-process
+        state and whose arms reach DIFFERENT collective sequences. An
+        arm that falls through (no return/raise/break/continue) also
+        reaches the statements after the branch, so `if rank != 0:
+        return` before a psum diverges too."""
+        src = fi.src
+        amap = self.graph.imports_of(src)
+        tainted = self._taint_locals(fi, amap)
+        out = []
+
+        def walk_block(stmts):
+            for i, st in enumerate(stmts):
+                if isinstance(st, _FUNC_NODES + (ast.ClassDef,)):
+                    continue
+                if isinstance(st, ast.If):
+                    reason = self._taint_reason(st.test, tainted, amap)
+                    if reason:
+                        suffix = self._arm_labels(fi, stmts[i + 1:])
+                        a = self._arm_labels(fi, st.body)
+                        b = self._arm_labels(fi, st.orelse)
+                        if not _terminates(st.body):
+                            a = a | suffix
+                        if not _terminates(st.orelse):
+                            b = b | suffix
+                        if a != b:
+                            out.append((st, reason, a, b))
+                for field, value in ast.iter_fields(st):
+                    if isinstance(value, list) and value \
+                            and isinstance(value[0], ast.stmt):
+                        walk_block(value)
+                    elif isinstance(value, list):
+                        for v in value:
+                            if isinstance(v, ast.excepthandler):
+                                walk_block(v.body)
+        walk_block(fi.node.body)
+        return out
+
+    def stats(self):
+        n_sites = sum(len(s) for _c, s in self._fn.values())
+        n_host = sum(1 for _c, ss in self._fn.values()
+                     for s in ss if s.host)
+        n_cross = sum(len(c) for c, _s in self._fn.values())
+        return {
+            "collective_sites": n_sites,
+            "collective_host_sites": n_host,
+            "gate_crossings": n_cross,
+        }

@@ -36,10 +36,12 @@ import time
 # share one source of truth. ``lockset`` and ``trace-purity`` are the
 # mxflow interprocedural additions (ISSUE 9); ``host-sync`` and
 # ``donation-safety`` gained interprocedural layers under their
-# existing ids.
+# existing ids; ``thread-race`` and ``collective-discipline`` are the
+# mxsync concurrency families (ISSUE 13).
 ALL_RULE_IDS = ("jit-site", "dispatch-hook", "lock-discipline",
-                "lockset", "host-sync", "trace-purity",
-                "donation-safety", "registry-consistency")
+                "lockset", "thread-race", "host-sync", "trace-purity",
+                "donation-safety", "collective-discipline",
+                "registry-consistency")
 
 # the rule id bad suppression comments are reported under (not
 # suppressible itself — a broken suppression must not hide)
@@ -55,6 +57,13 @@ _DISABLE_RE = re.compile(
 _GUARD_RE = re.compile(r"#\s*guarded by:\s*([A-Za-z0-9_.\[\]'\"]+)\s*$")
 _HOT_RE = re.compile(r"#\s*mxlint:\s*hot\s*$")
 _DONATES_RE = re.compile(r"#\s*mxlint:\s*donates\s+([0-9,\- ]+)\s*$")
+# mxsync's collective-channel marker: trailing on a ``def`` line it
+# declares the function a cross-process collective primitive (every
+# call to it is a collective site on that channel); trailing on a CALL
+# line it marks/overrides that one site's channel. Standalone-comment
+# form arms the next line, like ``# mxlint: hot``.
+_COLLECTIVE_RE = re.compile(
+    r"#\s*mxsync:\s*collective(?:\s+channel=([A-Za-z0-9_\-]+))?\s*$")
 JIT_SITE_MARKER = "the ONE instrumented jit site"
 
 
@@ -145,6 +154,7 @@ class Source:
         self.guards = {}                # line -> lock expr string
         self.hot_lines = set()
         self.donates = {}               # line -> tuple of donated indices
+        self.collective_marks = {}      # line -> channel string
         self.jit_marker_lines = set()
         self._scan_comments()
         self._parents = None
@@ -203,6 +213,12 @@ class Source:
                         % m.group(1), anchor=stripped))
                 else:
                     self.donates[i] = idx
+            m = _COLLECTIVE_RE.search(raw)
+            if m:
+                # channel defaults to "step" (the fused-step channel,
+                # matching CollectiveGate's own default)
+                self.collective_marks[i + 1 if standalone else i] = \
+                    m.group(1) or "step"
 
     def suppressed(self, rule, line):
         """The justification string when ``rule`` is suppressed at
@@ -331,8 +347,11 @@ class Project:
         self.sources = []
         self.parse_errors = []
         self.timings = {}               # "callgraph"/"summaries" build s
+        self.extra_stats = {}           # mxsync model stats for the report
         self._graph = None
         self._summaries = None
+        self._threads = None
+        self._collectives = None
 
     def callgraph(self):
         """The mxflow call graph over every parsed source — built once
@@ -355,6 +374,34 @@ class Project:
             self._summaries = _summaries.Summaries(self, graph)
             self.timings["summaries"] = time.perf_counter() - t0
         return self._summaries
+
+    def threads(self):
+        """The mxsync thread model (thread roots + runs-on-roots sets)
+        over :meth:`callgraph` — built once per run, on first demand.
+        Banks its stats (roots found, rooted functions) into
+        ``extra_stats`` for the JSON report."""
+        if self._threads is None:
+            from . import threads as _threads
+            graph = self.callgraph()
+            t0 = time.perf_counter()
+            self._threads = _threads.ThreadModel(self, graph)
+            self.timings["threads"] = time.perf_counter() - t0
+            self.extra_stats.update(self._threads.stats())
+        return self._threads
+
+    def collectives(self):
+        """The mxsync collective model (site index, gate crossings,
+        entry-gated channels) — built once per run, on first demand.
+        Banks its stats (sites indexed, crossings) into
+        ``extra_stats``."""
+        if self._collectives is None:
+            from . import collectives as _collectives
+            graph = self.callgraph()
+            t0 = time.perf_counter()
+            self._collectives = _collectives.CollectiveModel(self, graph)
+            self.timings["collectives"] = time.perf_counter() - t0
+            self.extra_stats.update(self._collectives.stats())
+        return self._collectives
 
     def add_file(self, path):
         display = os.path.relpath(path, self.root) if self.root else path
@@ -392,7 +439,12 @@ class Project:
 # in the parse set; the import closure covers the CALLEE direction
 # (every call mxflow can resolve goes through an import or stays in
 # file), so effect summaries reasoned over in subset mode match the
-# full run's. The report is still filtered to touched files + reverse
+# full run's. The same two directions cover mxsync: a thread ROOT's
+# registration site refs its target (the rev map records ref edges
+# too, so registration files are reverse dependents), races are
+# class-/file-scoped, gate crossings live in callers (reverse
+# closure) and collective def-markers in callees (import closure).
+# The report is still filtered to touched files + reverse
 # dependents — plus any sink whose witness chain crosses one (see
 # Finding.via).
 
@@ -638,7 +690,7 @@ class Report:
 
     def __init__(self, findings, suppressed, baselined, stale_baseline,
                  warnings, paths, rules, timings=None, callgraph=None,
-                 files=0, subset=None, dep_cache=None):
+                 files=0, subset=None, dep_cache=None, closure=None):
         self.findings = findings
         self.suppressed = suppressed      # [(finding, justification)]
         self.baselined = baselined
@@ -651,6 +703,7 @@ class Report:
         self.files = files
         self.subset = subset            # --changed: files actually linted
         self.dep_cache = dep_cache      # None | "hit" | "miss:<why>"
+        self.closure = closure          # --changed: what was linted, audited
 
     @property
     def clean(self):
@@ -681,6 +734,7 @@ class Report:
             "callgraph": self.callgraph,
             "subset": self.subset,
             "dep_cache": self.dep_cache,
+            "closure": self.closure,
         }
 
     def render_text(self):
@@ -842,14 +896,20 @@ def run(paths, rules=None, baseline=None, root=None, only=None,
             _timed_check(timings, rid, project, raw,
                          lambda: check(project))
 
+    via_kept = 0
     if only_set is not None:
         # keep a finding when it is anchored in the subset OR its
         # witness chain crosses it: a hot loop edited to call into an
         # existing helper sinks in the UNtouched helper file, and that
         # is precisely the regression --changed exists to catch
-        raw = [f for f in raw
-               if f.path in only_set
-               or any(v in only_set for v in f.via)]
+        kept_raw = []
+        for f in raw:
+            if f.path in only_set:
+                kept_raw.append(f)
+            elif any(v in only_set for v in f.via):
+                kept_raw.append(f)
+                via_kept += 1
+        raw = kept_raw
     raw.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
 
     by_display = {s.display: s for s in project.sources}
@@ -877,6 +937,7 @@ def run(paths, rules=None, baseline=None, root=None, only=None,
         stats = project._graph.stats()
         from . import summaries as _summaries
         stats["facts_cache"] = _summaries.cache_stats()
+        stats.update(project.extra_stats)   # mxsync model stats
     if dep_cache and parse_only is None and project._graph is not None:
         # this run parsed the full path set and built the graph —
         # refresh the skeleton so the next --changed run goes fast.
@@ -885,6 +946,20 @@ def run(paths, rules=None, baseline=None, root=None, only=None,
         # a different path set
         write_dep_cache(dep_cache, project, paths=norm_paths,
                         force=only is not None)
+    closure = None
+    if only_set is not None:
+        # the audit record for a "0 findings" on a partial view: what
+        # was touched, what the reverse closure expanded it to, what
+        # was actually parsed, and how many sink-elsewhere findings
+        # only survived because their witness chain crossed the subset
+        touched = sorted({p.replace(os.sep, "/") for p in only})
+        closure = {
+            "touched": touched,
+            "linted": sorted(only_set),
+            "dependents": len(only_set) - len(set(touched) & only_set),
+            "parsed": sorted(s.display for s in project.sources),
+            "via_kept": via_kept,
+        }
     return Report(kept, suppressed, baselined, stale,
                   list(bl.load_warnings),
                   [p.replace(os.sep, "/") for p in paths],
@@ -893,4 +968,4 @@ def run(paths, rules=None, baseline=None, root=None, only=None,
                   files=len(project.sources),
                   subset=sorted(only_set) if only_set is not None
                   else None,
-                  dep_cache=cache_state)
+                  dep_cache=cache_state, closure=closure)

@@ -20,15 +20,17 @@ def rule_table():
     global _TABLE
     if _TABLE is None:
         from . import (jit_site, dispatch_hook, lock_discipline,
-                       lockset, host_sync, trace_purity, donation,
-                       registry_sync)
+                       lockset, thread_race, host_sync, trace_purity,
+                       donation, collective, registry_sync)
         instances = [jit_site.JitSiteRule(),
                      dispatch_hook.DispatchHookRule(),
                      lock_discipline.LockDisciplineRule(),
                      lockset.LocksetRule(),
+                     thread_race.ThreadRaceRule(),
                      host_sync.HostSyncRule(),
                      trace_purity.TracePurityRule(),
                      donation.DonationRule(),
+                     collective.CollectiveDisciplineRule(),
                      registry_sync.RegistryConsistencyRule()]
         _TABLE = {r.id: r for r in instances}
         missing = set(ALL_RULE_IDS) - set(_TABLE)

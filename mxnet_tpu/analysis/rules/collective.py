@@ -1,0 +1,107 @@
+"""collective-discipline: SPMD collective hygiene (mxsync family b).
+
+Three finding shapes over the collective model (:mod:`..collectives`):
+
+1. **ungated collective** — a host-level cross-process collective site
+   (``KVStore._host_allgather``, a ``# mxsync: collective``-marked
+   function like ``spmd.broadcast_from_zero``) reachable along a call
+   path with NO ``CollectiveGate.arrive_and_wait()`` crossing before
+   it: a peer that died earlier turns the exchange into a cluster
+   hang instead of a ``DeadWorkerError``;
+2. **channel mismatch** — the path IS gated, but only on the wrong
+   channel ("step" gate guarding a "kv" exchange): generations on the
+   two channels advance independently, so the crossing proves nothing
+   about the peer this exchange is about to wait on;
+3. **rank-divergent collective sequence** — a branch whose condition
+   derives from the process rank, the wall clock, fault injection or
+   the global RNG, and whose arms (including the fallthrough suffix
+   for arms that return/raise) reach DIFFERENT collective sequences:
+   one rank skips a psum its peers are blocking in — the one-rank-
+   skips-a-collective hang class. A deliberately rank-divergent region
+   (rank-0-only checkpoint/logging that calls no collective) compares
+   equal and is never flagged; one that genuinely diverges carries a
+   justified ``# mxlint: disable=collective-discipline -- why``.
+
+``jax.lax`` device collectives live inside compiled programs whose
+*dispatch* the gate protects (invisible statically), so they feed
+shape 3 only, never shapes 1/2.
+"""
+from ..core import Finding
+from ..collectives import ANY_CHANNEL
+
+
+class CollectiveDisciplineRule:
+    id = "collective-discipline"
+
+    def check_project(self, project):
+        graph = project.callgraph()
+        cm = project.collectives()
+        findings = []
+
+        # shapes 1 + 2: gate coverage of host-level sites
+        for fi, site, prior in cm.coverage():
+            src = fi.src
+            hops = cm.ungated_chain(fi, site.channel)
+            via = {src.display}
+            chain_text = ""
+            if hops:
+                parts = []
+                for caller, line in hops:
+                    via.add(caller.src.display)
+                    parts.append("%s (%s:%d)" % (caller.name,
+                                                 caller.src.display,
+                                                 line))
+                chain_text = "; reachable ungated from '%s' via %s" % (
+                    hops[0][0].name,
+                    " -> ".join(parts + [fi.name]))
+            prior_real = sorted(p for p in prior if p != ANY_CHANNEL)
+            if prior_real:
+                msg = ("collective '%s' exchanges on channel '%s' but "
+                       "the path only crosses a CollectiveGate on "
+                       "channel %s%s — gate generations advance per "
+                       "channel, so the wrong-channel crossing proves "
+                       "nothing about the peers this exchange will "
+                       "wait on; cross the matching-channel gate "
+                       "first (or fix the gate's channel)"
+                       % (site.kind, site.channel,
+                          ", ".join("'%s'" % p for p in prior_real),
+                          chain_text))
+            else:
+                msg = ("cross-process collective '%s' (channel '%s') "
+                       "is reachable with NO CollectiveGate crossing "
+                       "before it%s — a peer that died earlier turns "
+                       "this exchange into a cluster hang instead of "
+                       "a DeadWorkerError; cross the matching "
+                       "'%s'-channel gate before the exchange, or "
+                       "justify with '# mxlint: "
+                       "disable=collective-discipline -- why'"
+                       % (site.kind, site.channel, chain_text,
+                          site.channel))
+            findings.append(Finding(
+                self.id, src.display, site.line, site.col, msg,
+                anchor=src.anchor_for(site.line), via=sorted(via)))
+
+        # shape 3: rank-divergent collective sequences
+        for fi in graph.functions:
+            if not cm.reach(fi):
+                continue
+            src = fi.src
+            for node, reason, a, b in cm.divergences(fi):
+                only_a = sorted(a - b)
+                only_b = sorted(b - a)
+                findings.append(src.finding(
+                    self.id, node,
+                    "branch condition in '%s' derives from %s and its "
+                    "arms reach DIFFERENT collective sequences "
+                    "(if-arm only: %s; else/fallthrough only: %s) — "
+                    "a process taking the other arm skips or adds a "
+                    "cross-process collective its peers are blocking "
+                    "in (cluster hang, not a crash); make the "
+                    "collective sequence rank-invariant, or justify a "
+                    "deliberately divergent region with '# mxlint: "
+                    "disable=collective-discipline -- why'"
+                    % (fi.name, reason,
+                       ", ".join(only_a) or "(none)",
+                       ", ".join(only_b) or "(none)")))
+        findings.sort(key=lambda f: (f.path, f.line))
+        return findings

@@ -34,8 +34,8 @@ Noise control, in the conservative-but-quiet direction:
 """
 import ast
 
-from ..callgraph import REF
 from ..core import Finding
+from ..threads import entry_locksets
 
 
 def _annotated_attrs(src, class_node):
@@ -87,52 +87,16 @@ class LocksetRule:
         findings.sort(key=lambda f: (f.path, f.line))
         return findings
 
-    def _entry_locksets(self, ci, members, graph, summ, self_locks):
-        """Fixpoint: locks guaranteed held on ENTRY to each private
-        member, via the meet over resolved same-class call sites."""
-        member_set = set(members)
-
-        def eligible(fi):
-            # a method that ESCAPES as a value (ref edge: callback,
-            # Timer/Thread target) may be invoked bare by anyone — its
-            # locked call-edge callers guarantee nothing at entry
-            return fi.name.startswith("_") \
-                and not fi.name.startswith("__") \
-                and bool(graph.callers(fi)) \
-                and not graph.callers(fi, kinds=(REF,))
-
-        entry = {fi: (self_locks if eligible(fi) else frozenset())
-                 for fi in members}
-        for _round in range(len(members) + 2):
-            changed = False
-            for fi in members:
-                if not eligible(fi):
-                    continue
-                new = None
-                for caller, line, col in graph.callers(fi):
-                    if caller not in member_set:
-                        new = frozenset()       # callable from outside
-                        break
-                    held = summ.facts_of(caller).calls_held.get(
-                        (line, col), frozenset()) & self_locks
-                    eff = held | entry.get(caller, frozenset())
-                    new = eff if new is None else (new & eff)
-                if new is None:
-                    new = frozenset()
-                if new != entry[fi]:
-                    entry[fi] = new
-                    changed = True
-            if not changed:
-                break
-        return entry
-
     def _check_class(self, src, ci, members, graph, summ, self_locks):
         annotated = _annotated_attrs(src, ci.node)
         lock_attrs = {l.split(".", 1)[1] for l in self_locks}
         # self.<method>() references are calls, not state accesses
         method_names = set(ci.methods)
-        entry = self._entry_locksets(ci, members, graph, summ,
-                                     self_locks)
+        # entry locksets via the SHARED RacerD-style meet (threads.py):
+        # a method that escapes as a value (ref edge) or is callable
+        # from outside the class starts at the empty lockset
+        entry = entry_locksets(graph, summ, members, self_locks,
+                               member_set=set(members))
 
         # attr -> [(fi, line, col, is_store, effective lockset)]
         per_attr = {}

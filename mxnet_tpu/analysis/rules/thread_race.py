@@ -1,0 +1,212 @@
+"""thread-race: cross-thread shared-state races (mxsync family a).
+
+The lockset rule flags INTERNAL inconsistency — an attribute locked on
+some paths and bare on others. This rule reports the real thing: a
+``self.<attr>`` or module global WRITTEN under one thread root and
+read or written under a DIFFERENT root with an empty lockset
+intersection. Thread roots come from the static thread model
+(:mod:`..threads`): ``threading.Thread``/``Timer`` targets, pool
+``submit`` callbacks, HTTP-server handler methods, ``atexit``/signal/
+excepthook registrations, ``weakref.finalize`` callbacks — with
+*runs-on-roots* propagated through ``call`` AND ``ref`` edges, so a
+method the coalescer thread hands onward as a callback still carries
+the coalescer's root. The main thread is a root of its own.
+
+An access's effective lockset is the locks held lexically at it plus
+the function's ENTRY lockset (the shared RacerD meet in
+:func:`..threads.entry_locksets`). Noise control mirrors lockset's:
+
+* attributes/globals already annotated ``# guarded by:`` belong to
+  lock-discipline (which enforces every access) and are skipped;
+* constructor bodies (``__init__``/``__new__``/``__setstate__``) are
+  construction-before-publication; lock/Condition objects, method
+  names and ``threading.local()`` globals are not shared state;
+* at least one of the two accesses must be a WRITE, and the two must
+  be attributable to two DISTINCT roots.
+
+The finding anchors at the racing WRITE, carries BOTH witness chains
+(root registration site -> ... -> accessing function) and proposes the
+exact ``# guarded by:`` line — after which lock-discipline enforces it
+everywhere, forever. Deliberate lock-free fast paths (GIL-atomic deque
+appends, monotonic flag reads) carry a justified
+``# mxlint: disable=thread-race -- why`` on the write line.
+"""
+import ast
+
+from ..core import Finding
+from ..threads import MAIN_ROOT, entry_locksets
+from .lockset import _annotated_attrs
+
+_CTOR_NAMES = ("__init__", "__new__", "__setstate__")
+
+
+class _Access:
+    __slots__ = ("fi", "line", "col", "is_store", "eff", "roots")
+
+    def __init__(self, fi, line, col, is_store, eff, roots):
+        self.fi = fi
+        self.line = line
+        self.col = col
+        self.is_store = is_store
+        self.eff = eff                  # effective lockset
+        self.roots = roots              # frozenset of root ids
+
+
+def _annotated_globals(src):
+    """Module-global names whose top-level assignment carries a
+    '# guarded by:' annotation (lock-discipline owns those)."""
+    out = set()
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+        else:
+            continue
+        if node.lineno not in src.guards:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+    return out
+
+
+class ThreadRaceRule:
+    id = "thread-race"
+
+    def check_project(self, project):
+        graph = project.callgraph()
+        summ = project.summaries()
+        tm = project.threads()
+        if not tm.roots:
+            return []
+        findings = []
+        findings.extend(self._check_classes(graph, summ, tm))
+        findings.extend(self._check_globals(project, graph, summ, tm))
+        findings.sort(key=lambda f: (f.path, f.line))
+        return findings
+
+    # -- self.<attr> races ---------------------------------------------------
+    def _check_classes(self, graph, summ, tm):
+        by_class = {}
+        for fi in graph.functions:
+            if fi.self_class is not None:
+                by_class.setdefault(fi.self_class, []).append(fi)
+        findings = []
+        for ci, members in by_class.items():
+            src = ci.src
+            known_locks, _canon = summ.file_locks(src)
+            self_locks = frozenset(l for l in known_locks
+                                   if l.startswith("self."))
+            annotated = _annotated_attrs(src, ci.node)
+            lock_attrs = {l.split(".", 1)[1] for l in self_locks}
+            method_names = set(ci.methods)
+            entry = entry_locksets(graph, summ, members, self_locks,
+                                   member_set=set(members))
+            per_attr = {}
+            for fi in members:
+                if fi.name in _CTOR_NAMES:
+                    continue
+                facts = summ.facts_of(fi)
+                base = entry.get(fi, frozenset())
+                roots = tm.effective_roots(fi)
+                for attr, line, col, is_store, held in facts.accesses:
+                    if attr in annotated or attr in lock_attrs \
+                            or attr in method_names:
+                        continue
+                    per_attr.setdefault(attr, []).append(_Access(
+                        fi, line, col, is_store,
+                        (held & self_locks) | base, roots))
+            proposal = sorted(self_locks)[0] if self_locks \
+                else "self._lock"
+            for attr, accs in sorted(per_attr.items()):
+                f = self._race_finding(
+                    src, "attribute 'self.%s' of %s" % (attr,
+                                                        ci.qualname),
+                    accs, tm, proposal, self_locks)
+                if f is not None:
+                    findings.append(f)
+        return findings
+
+    # -- module-global races -------------------------------------------------
+    def _check_globals(self, project, graph, summ, tm):
+        findings = []
+        for src in project.sources:
+            module_globals, threadlocal = summ.file_globals(src)
+            if not module_globals:
+                continue
+            known_locks, _canon = summ.file_locks(src)
+            glocks = frozenset(l for l in known_locks
+                               if not l.startswith("self."))
+            annotated = _annotated_globals(src)
+            skip = annotated | threadlocal | set(known_locks)
+            members = list(graph.functions_of(src))
+            entry = entry_locksets(graph, summ, members, glocks,
+                                   member_set=set(members))
+            per_name = {}
+            for fi in members:
+                facts = summ.facts_of(fi)
+                base = entry.get(fi, frozenset())
+                roots = tm.effective_roots(fi)
+                for name, line, col, is_store, held \
+                        in facts.global_accesses:
+                    if name in skip:
+                        continue
+                    per_name.setdefault(name, []).append(_Access(
+                        fi, line, col, is_store,
+                        (held & glocks) | base, roots))
+            proposal = sorted(glocks)[0] if glocks else "_lock"
+            for name, accs in sorted(per_name.items()):
+                f = self._race_finding(
+                    src, "module global '%s'" % name, accs, tm,
+                    proposal, glocks)
+                if f is not None:
+                    findings.append(f)
+        return findings
+
+    # -- the pair search -----------------------------------------------------
+    def _race_finding(self, src, label, accs, tm, proposal, locks):
+        writes = sorted((a for a in accs if a.is_store),
+                        key=lambda a: (a.line, a.col))
+        if not writes:
+            return None
+        others = sorted(accs, key=lambda a: (a.line, a.col))
+        for w in writes:
+            for a in others:
+                if a.fi is w.fi and a.line == w.line and a.col == w.col:
+                    continue
+                if len(w.roots | a.roots) < 2:
+                    continue            # same single root: sequential
+                if w.eff & a.eff:
+                    continue            # a common lock serialises them
+                return self._render(src, label, w, a, tm, proposal,
+                                    locks)
+        return None
+
+    def _render(self, src, label, w, a, tm, proposal, locks):
+        # pick a concrete distinct root pair, preferring to show a
+        # real (non-main) root on the write side
+        pairs = [(r1, r2) for r1 in w.roots for r2 in a.roots
+                 if r1 != r2]
+        rw, ra = sorted(pairs, key=lambda p: (p[0] == MAIN_ROOT,
+                                              p[1] == MAIN_ROOT,
+                                              str(p[0]), str(p[1])))[0]
+        wdesc, wvia = tm.describe(rw, w.fi)
+        adesc, avia = tm.describe(ra, a.fi)
+        via = {src.display} | wvia | avia
+        lock_note = "no lock is held at either access" if not locks \
+            else "their locksets do not intersect"
+        return Finding(
+            self.id, src.display, w.line, w.col,
+            "%s is written in '%s' (line %d) running under %s, and %s "
+            "in '%s' (%s:%d) running under %s — %s, so this is a "
+            "cross-thread data race; guard both accesses with %s and "
+            "annotate the assignment '# guarded by: %s' so "
+            "lock-discipline enforces it everywhere, or justify a "
+            "deliberate lock-free fast path with "
+            "'# mxlint: disable=thread-race -- why'"
+            % (label, w.fi.name, w.line, wdesc,
+               "written" if a.is_store else "read", a.fi.name,
+               a.fi.src.display, a.line, adesc, lock_note, proposal,
+               proposal),
+            anchor=src.anchor_for(w.line), via=sorted(via))

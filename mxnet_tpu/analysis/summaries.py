@@ -155,6 +155,7 @@ class FunctionFacts:
         "jit_call_donates", "marker_donates", "calls_by_name",
         "name_bindings", "call_args", "call_form", "call_recv",
         "return_call_sites", "return_names", "local_jit_names",
+        "global_accesses",
     )
 
     def __init__(self, qualname, params):
@@ -178,6 +179,12 @@ class FunctionFacts:
         self.return_call_sites = set()  # (line, col) of returned calls
         self.return_names = set()       # names returned directly
         self.local_jit_names = {}       # name -> donate indices
+        # module-global touches with the lockset lexically held:
+        # [(name, line, col, is_store, held)] — stores are `global`-
+        # declared rebinds, writes THROUGH the global (subscript/attr
+        # store, mutating method call), loads are plain reads; local
+        # shadowing resolved away (mxsync's thread-race raw material)
+        self.global_accesses = []
 
     def impure_facts(self):
         """[(kind, line, desc)] of everything trace-purity cares
@@ -192,12 +199,15 @@ class FunctionFacts:
 
 
 class _FileFacts:
-    __slots__ = ("functions", "canonical", "known_locks")
+    __slots__ = ("functions", "canonical", "known_locks",
+                 "module_globals", "threadlocal_globals")
 
     def __init__(self):
         self.functions = {}             # (qualname, lineno) -> FunctionFacts
         self.canonical = {}             # lock alias text -> canonical
         self.known_locks = set()
+        self.module_globals = set()     # top-level assigned names
+        self.threadlocal_globals = set()  # bound to threading.local()
 
 
 class _FactsWalker(ast.NodeVisitor):
@@ -219,6 +229,17 @@ class _FactsWalker(ast.NodeVisitor):
         self._local_names = []          # stack of sets
         self._declared_global = []      # stack of sets
         self._pending = []              # stack of provisional mutations
+        # provisional module-global touches: (facts, kind, name, line,
+        # col, held) with kind "store" (plain rebind — real only when
+        # `global`-declared), "deref" (write through the object) or
+        # "load". facts is None while the entry sits in its OWN
+        # frame's list; an entry the innermost frame cannot resolve
+        # (not local, not declared) is passed UP with its origin facts
+        # attached — a closure read of an ENCLOSING function's local
+        # that shadows a module global must not be classified as a
+        # global access (Python scoping walks every enclosing frame)
+        self._gpending = []
+        self.module_globals = out.module_globals
 
     # -- scope management ---------------------------------------------------
     def visit_ClassDef(self, node):
@@ -258,6 +279,7 @@ class _FactsWalker(ast.NodeVisitor):
         self._local_names.append(local_names)
         self._declared_global.append(set())
         self._pending.append([])
+        self._gpending.append([])
         held, self.withs = self.withs, []         # body runs later
         for stmt in node.body:
             self.visit(stmt)
@@ -269,6 +291,29 @@ class _FactsWalker(ast.NodeVisitor):
         for name, line, desc in self._pending.pop():
             if name is None or name not in locals_ or name in declared:
                 facts.mutations.append((line, desc))
+        parent_gpending = self._gpending[-2] if len(self._gpending) > 1 \
+            else None
+        for tfacts, kind, name, line, col, gheld in self._gpending.pop():
+            owner = tfacts if tfacts is not None else facts
+            if kind == "store":
+                # a plain rebind is global only when THIS frame
+                # declared it (a nested def never inherits `global`)
+                if tfacts is None and name in declared:
+                    owner.global_accesses.append(
+                        (name, line, col, True, gheld))
+                continue
+            if name in declared:
+                owner.global_accesses.append(
+                    (name, line, col, kind == "deref", gheld))
+            elif name in locals_:
+                pass        # a local (or closure var) shadows the global
+            elif parent_gpending is not None:
+                # undecided: let the enclosing frame's locals rule on it
+                parent_gpending.append(
+                    (owner, kind, name, line, col, gheld))
+            else:
+                owner.global_accesses.append(
+                    (name, line, col, kind == "deref", gheld))
         self.stack.pop()
         self.scope_names.pop()
 
@@ -319,6 +364,15 @@ class _FactsWalker(ast.NodeVisitor):
             # declared global/nonlocal — decided at function pop
             if self.stack and isinstance(node.ctx, ast.Store):
                 self._maybe_global_store(node)
+                if node.id in self.module_globals:
+                    self._gpending[-1].append(
+                        (None, "store", node.id, node.lineno,
+                         node.col_offset, frozenset(self.withs)))
+        elif isinstance(node.ctx, ast.Load) and self.stack \
+                and node.id in self.module_globals:
+            self._gpending[-1].append(
+                (None, "load", node.id, node.lineno, node.col_offset,
+                 frozenset(self.withs)))
 
     def _maybe_global_store(self, node):
         # ONLY the innermost frame: a `global`/`nonlocal` declaration
@@ -399,6 +453,12 @@ class _FactsWalker(ast.NodeVisitor):
                     else:
                         self._pending[-1].append(
                             (name, node.lineno, desc))
+                        if name in self.module_globals:
+                            # a write THROUGH the global's object
+                            self._gpending[-1].append(
+                                (None, "deref", name, el.lineno,
+                                 el.col_offset,
+                                 frozenset(self.withs)))
                 # a subscript store through a direct self.<attr> is a
                 # WRITE of that attribute for lockset purposes
                 if isinstance(el, ast.Subscript) \
@@ -549,6 +609,10 @@ class _FactsWalker(ast.NodeVisitor):
                     self._pending[-1].append(
                         (root, node.lineno,
                          "calls %s.%s()" % (expr_text(recv), f.attr)))
+                    if root in self.module_globals:
+                        self._gpending[-1].append(
+                            (None, "deref", root, node.lineno,
+                             node.col_offset, frozenset(self.withs)))
 
 
 def _flatten_targets(t):
@@ -608,6 +672,28 @@ _CACHE_STATS = {"hits": 0, "misses": 0}
 _FACTS_CACHE_MAX = 4096
 
 
+def _scan_module_globals(src, amap, out):
+    """Top-level assigned names — the candidate shared module state the
+    thread-race rule reasons about. Names bound to ``threading.local``
+    are remembered separately (thread-confined by construction)."""
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+        else:
+            continue
+        for t in targets:
+            for el in _flatten_targets(t):
+                if isinstance(el, ast.Name):
+                    out.module_globals.add(el.id)
+                    if isinstance(node, ast.Assign) \
+                            and isinstance(node.value, ast.Call) \
+                            and _resolve(node.value.func, amap) \
+                            == "threading.local":
+                        out.threadlocal_globals.add(el.id)
+
+
 def file_facts(src):
     key = (src.display, hash(src.text))
     got = _FACTS_CACHE.get(key)
@@ -618,6 +704,7 @@ def file_facts(src):
     amap = cg._import_map(src)
     out = _FileFacts()
     _scan_locks(src, amap, out)
+    _scan_module_globals(src, amap, out)
     _FactsWalker(src, amap, out).visit(src.tree)
     if len(_FACTS_CACHE) >= _FACTS_CACHE_MAX:
         _FACTS_CACHE.clear()
@@ -645,6 +732,7 @@ class Summaries:
                 (fi.qualname, fi.node.lineno))
             self._facts[fi] = ff if ff is not None else self._empty
         self._sync_wit = {}             # FuncInfo -> witness list
+        self._entry_cache = {}          # threads.entry_locksets memo
         self._donates = None            # FuncInfo -> set(param idx)
         self._returns_donating = None   # FuncInfo -> indices or None
         self._donated_sites = None      # FuncInfo -> {(line,col): indices}
@@ -657,6 +745,13 @@ class Summaries:
         ff = self._file_facts.get(src)
         return (ff.known_locks, ff.canonical) if ff is not None \
             else (set(), {})
+
+    def file_globals(self, src):
+        """(module-global names, thread-local-bound names) of a file —
+        the thread-race rule's module-scope universe."""
+        ff = self._file_facts.get(src)
+        return (ff.module_globals, ff.threadlocal_globals) \
+            if ff is not None else (set(), set())
 
     # -- transitive host-sync -----------------------------------------------
     def sync_witnesses(self, fi):

@@ -1,0 +1,437 @@
+"""mxsync's thread model: static thread roots + runs-on-roots sets.
+
+The runtime is quietly very threaded — the serving coalescer and its
+resolver pool, the flight sampler and metrics HTTP server, the
+heartbeat beat loop, io/dataloader prefetch workers, plus the
+asynchronous entry points Python itself provides (``atexit``/signal
+handlers, ``sys.excepthook``/``threading.excepthook``,
+``weakref.finalize`` callbacks, which cyclic GC may run on any
+thread). Every one of those is a THREAD ROOT: a function whose body
+executes concurrently with (or asynchronously to) the main control
+flow. This module enumerates them statically:
+
+* ``threading.Thread(target=f)`` / ``threading.Timer(t, f)``;
+* ``pool.submit(f, ...)`` where the receiver is a
+  ``concurrent.futures.ThreadPoolExecutor`` (local construction or a
+  ``self.<attr>`` constructed anywhere in the class);
+* ``ThreadingHTTPServer((host, port), Handler)`` — every method of the
+  handler class runs on a server thread;
+* ``atexit.register(f)``, ``signal.signal(sig, f)``,
+  ``weakref.finalize(obj, f)``;
+* ``sys.excepthook = f`` / ``threading.excepthook = f`` assignments.
+
+From each root's target the *runs-on-roots* relation propagates over
+``call`` AND ``ref`` edges of the mxflow call graph (a function a
+thread-rooted function passes somewhere as a value runs under that
+root too). Functions reachable from no root run under the implicit
+``<main>`` root; a function reachable both ways carries both. The
+``thread-race`` rule then reports a ``self.<attr>``/module-global
+written under one root and touched under a different root with an
+empty lockset intersection — with BOTH witness chains (root
+registration site -> ... -> access) in the finding.
+
+Also here (shared with the ``lockset`` rule): the RacerD-style
+ENTRY-lockset fixpoint — the meet, over every resolved call site, of
+the locks a function's callers hold at the call.
+"""
+from __future__ import annotations
+
+import ast
+
+from . import callgraph as cg
+from .core import resolve_origin
+
+MAIN_ROOT = "<main>"
+
+# constructors whose instances fan work out to worker threads via
+# ``.submit(fn, ...)``
+_POOL_FACTORIES = {"concurrent.futures.ThreadPoolExecutor",
+                   "concurrent.futures.thread.ThreadPoolExecutor"}
+
+_SERVER_FACTORIES = {"http.server.ThreadingHTTPServer",
+                     "http.server.HTTPServer",
+                     "socketserver.ThreadingTCPServer"}
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+class ThreadRoot:
+    """One statically-discovered thread entry point."""
+
+    __slots__ = ("kind", "target", "src", "line", "index")
+
+    def __init__(self, kind, target, src, line, index):
+        self.kind = kind                # "thread"/"timer"/"pool"/...
+        self.target = target            # FuncInfo whose body runs there
+        self.src = src                  # registration file
+        self.line = line                # registration line
+        self.index = index
+
+    def label(self):
+        return "%s '%s' (registered at %s:%d)" % (
+            self.kind, self.target.qualname, self.src.display, self.line)
+
+    def __repr__(self):
+        return "ThreadRoot(%s)" % self.label()
+
+
+def entry_locksets(graph, summ, members, self_locks, member_set=None,
+                   require_private=True):
+    """Locks guaranteed held on ENTRY to each of ``members``, via the
+    meet over resolved call sites (RacerD's treatment): a helper called
+    only from inside ``with lock:`` blocks counts as locked with no
+    annotation; ONE bare call site (or an escape as a value — a ref
+    edge means anyone may invoke it later, lock-free) drops it to the
+    empty meet. ``member_set`` bounds the trusted caller universe (the
+    class for attr locksets, the file for module-global locksets) —
+    a caller outside it contributes the empty set. ONE implementation
+    shared by the ``lockset`` inference rule and the ``thread-race``
+    reports so their notions of "locked" can never drift — and ONE
+    memoized result per (members, locks) on the Summaries object, so
+    the two rules computing the same class's meet in one run pay for
+    it once."""
+    members = list(members)
+    trusted = set(member_set if member_set is not None else members)
+    cache = getattr(summ, "_entry_cache", None)
+    cache_key = None
+    if cache is not None:
+        # fi.line disambiguates branch-defined same-named defs that
+        # share a (display, qualname) key
+        cache_key = (tuple(sorted((f.key, f.line) for f in members)),
+                     tuple(sorted(self_locks)),
+                     tuple(sorted((f.key, f.line) for f in trusted)),
+                     require_private)
+        got = cache.get(cache_key)
+        if got is not None:
+            return got
+
+    def eligible(fi):
+        if require_private and (not fi.name.startswith("_")
+                                or fi.name.startswith("__")):
+            return False
+        return bool(graph.callers(fi)) \
+            and not graph.callers(fi, kinds=(cg.REF,))
+
+    entry = {fi: (self_locks if eligible(fi) else frozenset())
+             for fi in members}
+    for _round in range(len(members) + 2):
+        changed = False
+        for fi in members:
+            if not eligible(fi):
+                continue
+            new = None
+            for caller, line, col in graph.callers(fi):
+                if caller not in trusted:
+                    new = frozenset()       # callable from outside
+                    break
+                held = summ.facts_of(caller).calls_held.get(
+                    (line, col), frozenset()) & self_locks
+                eff = held | entry.get(caller, frozenset())
+                new = eff if new is None else (new & eff)
+            if new is None:
+                new = frozenset()
+            if new != entry[fi]:
+                entry[fi] = new
+                changed = True
+        if not changed:
+            break
+    if cache is not None:
+        cache[cache_key] = entry
+    return entry
+
+
+def _module_scope_nodes(tree, types):
+    """Nodes of the given types executing at import time (class bodies
+    included, function bodies NOT — those belong to their FuncInfo's
+    own scan, with the right scope for registration-edge exclusion)."""
+    stack = [tree]
+    while stack:
+        n = stack.pop()
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, _FUNC_NODES):
+                continue
+            if isinstance(child, types):
+                yield child
+            stack.append(child)
+
+
+class ThreadModel:
+    """Thread roots + runs-on-roots over one Project's call graph."""
+
+    def __init__(self, project, graph):
+        self.project = project
+        self.graph = graph
+        self.roots = []                  # [ThreadRoot]
+        self._roots_of = {}              # FuncInfo -> set of root indices
+        self._pred = {}                  # root idx -> {fi: (parent, line)}
+        self._reg_edges = set()          # (caller, callee, line, col)
+        self._main = set()               # FuncInfo on the main root
+        self._targets = set()
+        self._collect_roots()
+        self._propagate()
+
+    # -- root discovery -----------------------------------------------------
+    def _resolve_callback(self, src, scope, arg):
+        """FuncInfo a callback expression lands on, or None. Mirrors
+        the ref-edge resolution in the call graph (Name, self/cls
+        attribute) so a root's target is exactly the node the ref edge
+        points at."""
+        graph = self.graph
+        if isinstance(arg, ast.Name):
+            got = graph.resolve_name(src, scope, arg.id)
+            if got is not None and got[0] == "func":
+                return got[1]
+        elif isinstance(arg, ast.Attribute) \
+                and isinstance(arg.value, ast.Name) \
+                and arg.value.id in ("self", "cls") \
+                and scope is not None and scope.self_class is not None:
+            return graph._lookup_method(scope.self_class, arg.attr)
+        return None
+
+    def _handler_class_methods(self, src, arg):
+        """Every method of a server handler CLASS passed by name —
+        matched within the same file (handler classes are typically
+        nested inside the function starting the server, so scoped
+        resolution cannot see them)."""
+        if not isinstance(arg, ast.Name):
+            return []
+        out = []
+        for ci in self.graph.classes:
+            if ci.src is src and ci.node.name == arg.id:
+                out.extend(ci.methods.values())
+        return out
+
+    def _pool_attrs(self):
+        """{(ClassInfo, attr name)} of self-attributes constructed as
+        thread pools anywhere in their class."""
+        out = set()
+        for fi in self.graph.functions:
+            if fi.self_class is None:
+                continue
+            amap = self.graph.imports_of(fi.src)
+            for n in self.graph.nodes_of(fi):
+                if not (isinstance(n, ast.Assign) and len(n.targets) == 1
+                        and isinstance(n.value, ast.Call)):
+                    continue
+                t = n.targets[0]
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self" \
+                        and resolve_origin(n.value.func, amap) \
+                        in _POOL_FACTORIES:
+                    out.add((fi.self_class, t.attr))
+        return out
+
+    def _add_root(self, kind, target, src, call_or_line, scope):
+        if target is None:
+            return
+        line = getattr(call_or_line, "lineno", call_or_line)
+        col = getattr(call_or_line, "col_offset", 0)
+        root = ThreadRoot(kind, target, src, line, len(self.roots))
+        self.roots.append(root)
+        self._targets.add(target)
+        if scope is not None:
+            # the ref edge the call graph drew for this registration
+            # must not carry the MAIN root into the target's body: the
+            # registration runs on the registering thread, the TARGET
+            # runs on the new root
+            self._reg_edges.add((scope, target, line, col))
+
+    def _scan_calls(self, src, scope, calls, pool_attrs, local_pools):
+        amap = self.graph.imports_of(src)
+        for call in calls:
+            f = call.func
+            kwargs = {kw.arg: kw.value for kw in call.keywords if kw.arg}
+            origin = resolve_origin(f, amap) \
+                if isinstance(f, (ast.Name, ast.Attribute)) else None
+            if origin == "threading.Thread":
+                cb = kwargs.get("target")
+                self._add_root("thread",
+                               self._resolve_callback(src, scope, cb),
+                               src, call, scope)
+            elif origin == "threading.Timer":
+                cb = kwargs.get("function") or (
+                    call.args[1] if len(call.args) > 1 else None)
+                self._add_root("timer",
+                               self._resolve_callback(src, scope, cb),
+                               src, call, scope)
+            elif origin == "weakref.finalize" and len(call.args) >= 2:
+                self._add_root("finalizer",
+                               self._resolve_callback(src, scope,
+                                                      call.args[1]),
+                               src, call, scope)
+            elif origin == "atexit.register" and call.args:
+                self._add_root("atexit",
+                               self._resolve_callback(src, scope,
+                                                      call.args[0]),
+                               src, call, scope)
+            elif origin == "signal.signal" and len(call.args) >= 2:
+                self._add_root("signal-handler",
+                               self._resolve_callback(src, scope,
+                                                      call.args[1]),
+                               src, call, scope)
+            elif origin in _SERVER_FACTORIES and len(call.args) >= 2:
+                for m in self._handler_class_methods(src, call.args[1]):
+                    self._add_root("http-handler", m, src, call, scope)
+            elif isinstance(f, ast.Attribute) and f.attr == "submit" \
+                    and call.args:
+                recv = f.value
+                is_pool = False
+                if isinstance(recv, ast.Attribute) \
+                        and isinstance(recv.value, ast.Name) \
+                        and recv.value.id == "self" \
+                        and scope is not None \
+                        and (scope.self_class, recv.attr) in pool_attrs:
+                    is_pool = True
+                elif isinstance(recv, ast.Name) \
+                        and recv.id in local_pools:
+                    is_pool = True
+                if is_pool:
+                    self._add_root(
+                        "pool-worker",
+                        self._resolve_callback(src, scope, call.args[0]),
+                        src, call, scope)
+
+    def _scan_hook_assigns(self, src, scope, nodes):
+        """``sys.excepthook = f`` / ``threading.excepthook = f``."""
+        amap = self.graph.imports_of(src)
+        for n in nodes:
+            if not isinstance(n, ast.Assign):
+                continue
+            for t in n.targets:
+                if isinstance(t, ast.Attribute) and resolve_origin(
+                        t, amap) in ("sys.excepthook",
+                                     "threading.excepthook"):
+                    self._add_root(
+                        "excepthook",
+                        self._resolve_callback(src, scope, n.value),
+                        src, n, scope)
+
+    def _local_pools(self, src, nodes):
+        amap = self.graph.imports_of(src)
+        out = set()
+        for n in nodes:
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name) \
+                    and isinstance(n.value, ast.Call) \
+                    and resolve_origin(n.value.func, amap) \
+                    in _POOL_FACTORIES:
+                out.add(n.targets[0].id)
+        return out
+
+    def _collect_roots(self):
+        pool_attrs = self._pool_attrs()
+        for src in self.project.sources:
+            self._scan_calls(src, None,
+                             _module_scope_nodes(src.tree, ast.Call),
+                             pool_attrs, set())
+            # MODULE-scope assigns only: a hook assignment inside a
+            # function body is that function's registration (scanned
+            # below with its scope, so the reg edge is excluded from
+            # main propagation) — walking the whole tree here would
+            # register the same root twice and fabricate cross-root
+            # races between the two clones
+            self._scan_hook_assigns(
+                src, None, _module_scope_nodes(src.tree, ast.Assign))
+        for fi in self.graph.functions:
+            src = fi.src
+            nodes = self.graph.nodes_of(fi)
+            self._scan_calls(src, fi,
+                             (n for n in nodes
+                              if isinstance(n, ast.Call)),
+                             pool_attrs, self._local_pools(src, nodes))
+            self._scan_hook_assigns(
+                src, fi, (n for n in nodes if isinstance(n, ast.Assign)))
+
+    # -- propagation ---------------------------------------------------------
+    def _propagate(self):
+        graph = self.graph
+        # thread roots flow target -> callees over call AND ref edges —
+        # except REGISTRATION edges: thread-rooted code spawning a NEW
+        # thread (Thread(target=self._inner) inside a Thread target)
+        # hands _inner to the new thread, not to its own; following
+        # that edge would fabricate a cross-root race between two
+        # points of one sequential spawn chain (_inner gets its own
+        # root from its own registration)
+        for root in self.roots:
+            pred = {root.target: None}
+            queue = [root.target]
+            while queue:
+                f = queue.pop()
+                self._roots_of.setdefault(f, set()).add(root.index)
+                for callee, line, col in graph.callees(
+                        f, kinds=(cg.CALL, cg.REF)):
+                    if callee in pred:
+                        continue
+                    if (f, callee, line, col) in self._reg_edges:
+                        continue
+                    pred[callee] = (f, line)
+                    queue.append(callee)
+            self._pred[root.index] = pred
+        # the implicit main root: seeded at functions nobody in-graph
+        # calls that are not thread targets themselves (public API,
+        # module-level-invoked helpers), flowing over call edges and
+        # over ref edges that are NOT thread registrations
+        seeds = [fi for fi in graph.functions
+                 if fi not in self._targets
+                 and not graph.callers(fi, kinds=(cg.CALL,))]
+        queue = list(seeds)
+        self._main.update(seeds)
+        while queue:
+            f = queue.pop()
+            for callee, line, col in graph.callees(
+                    f, kinds=(cg.CALL, cg.REF)):
+                if callee in self._main:
+                    continue
+                if (f, callee, line, col) in self._reg_edges:
+                    continue
+                self._main.add(callee)
+                queue.append(callee)
+
+    # -- queries -------------------------------------------------------------
+    def effective_roots(self, fi):
+        """Root indices ``fi`` may run under; ``MAIN_ROOT`` stands in
+        for the main thread. Never empty: a function the model cannot
+        place defaults to main (conservative-quiet)."""
+        out = set(self._roots_of.get(fi, ()))
+        if fi in self._main or not out:
+            out.add(MAIN_ROOT)
+        return frozenset(out)
+
+    def chain(self, root_index, fi):
+        """Witness hops from the root's target down to ``fi``:
+        ``[(FuncInfo, call line in the parent's file), ...]`` —
+        empty when ``fi`` IS the target."""
+        if root_index == MAIN_ROOT:
+            return []
+        pred = self._pred.get(root_index, {})
+        hops = []
+        cur = fi
+        while pred.get(cur) is not None:
+            parent, line = pred[cur]
+            hops.append((cur, line))
+            cur = parent
+        hops.reverse()
+        return hops
+
+    def describe(self, root_index, fi):
+        """Human chain text 'root ... -> fn' plus the display files the
+        chain crosses (for Finding.via)."""
+        if root_index == MAIN_ROOT:
+            return ("the main thread", {fi.src.display})
+        root = self.roots[root_index]
+        via = {root.src.display, root.target.src.display, fi.src.display}
+        text = root.label()
+        prev = root.target
+        for hop, line in self.chain(root_index, fi):
+            text += " -> %s (called at %s:%d)" % (
+                hop.name, prev.src.display, line)
+            via.add(hop.src.display)
+            prev = hop
+        return (text, via)
+
+    def stats(self):
+        return {
+            "thread_roots": len(self.roots),
+            "thread_rooted_functions": len(self._roots_of),
+        }

@@ -290,12 +290,18 @@ class CollectiveGate:
             gate_timeout if gate_timeout is not None
             else os.environ.get(ENV_GATE_TIMEOUT, DEFAULT_GATE_TIMEOUT))
         self.poll = float(poll)
-        self.generation = 0
+        # the gate's mutable state is shared the moment a gate object
+        # is reachable from more than one thread (an elastic-recovery
+        # watcher reading .generation while the fit thread crosses):
+        # guard it explicitly instead of relying on today's single-
+        # threaded use (mxsync annotation satellite, ISSUE 13)
+        self._lock = threading.Lock()
+        self.generation = 0     # guarded by: self._lock
         # ranks whose heartbeat this gate has EVER observed: a missing
         # file is only evidence of death for a peer we once saw — a
         # slow joiner (still importing jax while we cross the first
         # gate) has no file yet and must not read as dead
-        self._seen = set()
+        self._seen = set()      # guarded by: self._lock
         self._dir = None
         if self.root:
             tag = "-".join(str(m) for m in self.members)
@@ -336,10 +342,11 @@ class CollectiveGate:
         # the chaos kill point: BEFORE publishing the arrival, so a
         # killed worker is missing from this generation on every peer
         faults.fire("kv_collective")
-        self.generation += 1
+        with self._lock:
+            self.generation += 1
+            gen = self.generation
         if not self.enabled:
-            return self.generation
-        gen = self.generation
+            return gen
         self._publish(gen)
         deadline = time.monotonic() + self.gate_timeout
         peers = [m for m in self.members if m != self.rank]
@@ -376,7 +383,9 @@ class CollectiveGate:
         directory clock) rides in the error: a false-positive report
         must be diagnosable from one log line."""
         alive, ages = _scan(self.root, self.timeout)
-        self._seen |= alive
+        with self._lock:
+            self._seen |= alive
+            seen = set(self._seen)
         dead = []
         for r in ranks:
             if int(r) in alive:
@@ -389,7 +398,7 @@ class CollectiveGate:
                                  "%.2fs)" % (age, self.timeout)))
                 # a fresh-but-not-alive age cannot happen from one
                 # scan; kept for clarity: fresh means not dead
-            elif int(r) in self._seen:
+            elif int(r) in seen:
                 dead.append((int(r), "heartbeat file removed after "
                                      "being seen alive"))
         return dead

@@ -304,6 +304,11 @@ class PrefetchingIter(DataIter):
             raise MXNetError("PrefetchingIter supports a single backing iter "
                              "in the TPU build")
         self.iter = iters[0]
+        self._prefetch_depth = prefetch_depth
+        # how long reset() waits for the old worker to die before
+        # raising (it can be wedged inside backing.next(), where a
+        # replacement worker could not run safely)
+        self.reset_join_timeout = 5.0
         self._queue = _queue.Queue(maxsize=prefetch_depth)
         self._stop = threading.Event()
         self._thread = None
@@ -318,29 +323,65 @@ class PrefetchingIter(DataIter):
         return self.iter.provide_label
 
     def _start(self):
+        # the worker owns ITS stop event and queue as locals, bound at
+        # start: reset() rebinding self._stop/self._queue can never be
+        # observed mid-loop by a still-draining old worker (the
+        # thread-race mxsync flagged — the old worker could miss the
+        # swapped-in event and keep consuming the shared backing iter
+        # concurrently with its replacement)
+        stop, queue = self._stop, self._queue
+        backing = self.iter
+
         def worker():
-            while not self._stop.is_set():
+            while not stop.is_set():
                 try:
-                    batch = self.iter.next()
+                    batch = backing.next()
                 except StopIteration:
-                    self._queue.put(None)
+                    queue.put(None)
                     return
-                self._queue.put(batch)
+                queue.put(batch)
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
 
     def reset(self):
+        import time as _time
         self._stop.set()
-        try:
-            while True:
-                self._queue.get_nowait()
-        except _queue.Empty:
-            pass
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        # drain UNTIL the worker is dead: a worker blocked in
+        # queue.put() (queue full) only wakes when a slot frees, so a
+        # single drain-then-join(5) could time out and leave the old
+        # worker alive to race the replacement on the backing iter.
+        # Bounded overall (reset_join_timeout): a worker wedged INSIDE
+        # backing.next() (stalled data source) cannot observe the stop
+        # event, and reset() must not hang the epoch boundary — but it
+        # must not proceed either: the wedged worker's in-flight
+        # next() would complete later, concurrently with the
+        # replacement worker on the same non-thread-safe backing
+        # iterator (silently stealing a batch / corrupting the
+        # cursor). Raising is re-entrant: once the source unblocks the
+        # worker exits on its own (its closure-captured stop is set),
+        # and a later reset() proceeds cleanly.
+        deadline = _time.monotonic() + self.reset_join_timeout
+        while self._thread is not None and self._thread.is_alive():
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except _queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
+            if _time.monotonic() > deadline and self._thread.is_alive():
+                raise MXNetError(
+                    "PrefetchingIter.reset(): prefetch worker did not "
+                    "exit within %.1fs — it is blocked inside the "
+                    "backing iterator's next() (stalled data source?), "
+                    "and resetting now would race it on the shared "
+                    "backing iterator. Wait for the source to unblock "
+                    "(or raise .reset_join_timeout) and call reset() "
+                    "again." % self.reset_join_timeout)
         self.iter.reset()
         self._stop = threading.Event()
-        self._queue = _queue.Queue(maxsize=2)
+        # keep the CONFIGURED depth (the old code silently dropped a
+        # custom prefetch_depth to 2 on the first reset)
+        self._queue = _queue.Queue(maxsize=self._prefetch_depth)
         self._start()
 
     def next(self):

@@ -192,6 +192,18 @@ class KVStore:
                     broadcast = broadcast_from_zero
             except Exception:
                 broadcast = None
+        if broadcast is not None \
+                and any(k not in self._store for k in keys):
+            # liveness gate BEFORE this call's init broadcast(s)
+            # (mxsync's collective-discipline check drove this): the
+            # broadcast spans every launched process, so a worker that
+            # died — even undetected, with dead_ranks() still empty —
+            # would hang it forever; the gate turns that into
+            # DeadWorkerError. Per init CALL with new keys, so params
+            # created later (a second fit, post-recovery keys) are
+            # protected too; every worker calls init symmetrically, so
+            # the crossing is symmetric
+            self._collective_gate().arrive_and_wait()
         for k, v in zip(keys, values):
             if k in self._store:
                 continue

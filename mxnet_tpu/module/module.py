@@ -302,8 +302,13 @@ class Module(BaseModule):
         # loss it would hang on the dead ones, and the survivors'
         # values are already consistent (same checkpoint restore)
         sync = not self._dist_synced and not _dist.dead_ranks()
+        # the first-commit broadcast is a cross-process collective:
+        # cross the step gate before it so a peer that died during
+        # startup raises DeadWorkerError instead of hanging the sync
         _spmd.commit_dp_placements(self._exec, self._input_name_set(),
-                                   self._dist_spec, sync=sync)
+                                   self._dist_spec, sync=sync,
+                                   gate=self._dist_gate() if sync
+                                   else None)
         self._dist_synced = True
         self._dist_committed = True
 

@@ -155,11 +155,15 @@ def put_replicated_local(raw, spec):
         tuple(np.shape(raw)), spec.repl_sharding, shards)
 
 
-def broadcast_from_zero(tree):
+def broadcast_from_zero(tree):   # mxsync: collective channel=kv
     """One host-level broadcast of a pytree from process 0 to all
     (parity: the reference's kv.init server seeding + worker pull —
     every worker starts from rank 0's values). A no-op outside
-    multi-process runs."""
+    multi-process runs. Indexed as a cross-process collective for
+    mxsync's collective-discipline rule (default channel ``kv``; the
+    fused-step commit path overrides per call site): every caller must
+    be dominated by a matching CollectiveGate crossing, or a peer that
+    died earlier hangs the broadcast."""
     if jax.process_count() <= 1:
         return tree
     from jax.experimental import multihost_utils
@@ -216,14 +220,22 @@ def shard_put(raw, sharding):
         return out
 
 
-def commit_dp_placements(executor, input_names, spec, sync=True):
+def commit_dp_placements(executor, input_names, spec, sync=True,
+                         gate=None):
     """Commit the dp-mesh placements on ONE bound executor's storage:
     batch-like inputs (data/labels/states, all batch-major) shard over
     the data axis, params/grads/aux replicate. The ONE owner of the
     placement rule — Module._shard_exec_arrays and the multi-context
     DataParallelExecutorGroup facade both call this, so the two can
     never drift. GSPMD propagates from these committed placements for
-    every program the executor runs."""
+    every program the executor runs.
+
+    ``gate``: the caller's pre-collective :class:`CollectiveGate`,
+    crossed before the rank-0 sync broadcast on the process-spanning
+    path — a peer that died before the first commit must surface as
+    ``DeadWorkerError`` here, not hang the broadcast (mxsync's
+    collective-discipline check drove this). In-process callers (the
+    local dp facade) have no cross-process exchange and pass None."""
     if not is_process_spanning(spec.mesh):
         for name, arr in executor.arg_dict.items():
             sh = spec.data_sharding if name in input_names \
@@ -253,7 +265,9 @@ def commit_dp_placements(executor, input_names, spec, sync=True):
         # EVERY launched process (dead members would hang it), and the
         # survivors' replicated values are already identical — the
         # checkpoint restore that follows overwrites them anyway
-        synced = broadcast_from_zero(synced)
+        if gate is not None:
+            gate.arrive_and_wait()
+        synced = broadcast_from_zero(synced)   # mxsync: collective channel=step
     for name, arr in executor.arg_dict.items():
         if name in input_names:
             arr._set_data(dist_shard_put(batch[name], spec))

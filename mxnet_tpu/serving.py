@@ -344,9 +344,14 @@ class InferenceEngine:
                            if n not in self._input_names and n not in auto}
         self._aux_raw = {n: a._data for n, a in ex.aux_dict.items()}
         # inference-time dummies (loss-layer labels) are batch-shaped:
-        # one zero set per bucket, built lazily in _bucket_extras
+        # one zero set per bucket, built lazily in _bucket_extras —
+        # from the MAIN thread (warmup) and the coalescer/drain threads
+        # (dispatch), so the cache has its own tiny lock (not the
+        # admission lock: a first-touch device_put must not stall
+        # submit())
         self._auto_names = sorted(auto)
-        self._extras = {}
+        self._extras_lock = threading.Lock()
+        self._extras = {}                # guarded by: self._extras_lock
         self._rng = ex._step_key()
         self._forward = self._prog.forward_fn(False)
 
@@ -497,7 +502,16 @@ class InferenceEngine:
 
     def _bucket_extras(self, bucket):
         """Device-resident zero dummies (softmax labels etc.) at this
-        bucket's batch size, cached per bucket."""
+        bucket's batch size, cached per bucket. Serialised on the
+        extras lock: warmup (main thread) and dispatch (coalescer /
+        shutdown-drain threads) race on first touch of a bucket, and
+        an unlocked check-then-set could publish a half-built dict or
+        build the same dummies twice (the thread-race mxsync
+        flagged)."""
+        with self._extras_lock:
+            return self._bucket_extras_locked(bucket)
+
+    def _bucket_extras_locked(self, bucket):
         cached = self._extras.get(bucket)
         if cached is not None:
             return cached

@@ -246,11 +246,11 @@ def enabled():
 
 
 def enable():
-    _state.enabled = True
+    _state.enabled = True   # mxlint: disable=thread-race -- GIL-atomic bool flip, read lock-free by every hot-path probe by design (PR 3's enabled() gate); a lock here would serialise every counter/span fast path
 
 
 def disable():
-    _state.enabled = False
+    _state.enabled = False   # mxlint: disable=thread-race -- same GIL-atomic flag flip as enable()
 
 
 def reset():

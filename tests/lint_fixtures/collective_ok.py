@@ -1,0 +1,59 @@
+"""Compliant twin of collective_violation.py: every host exchange is
+dominated by a matching-channel gate crossing (lexically, or at ENTRY
+through the private-helper meet), the marked broadcast primitive is
+gated at its call site, and the rank-conditional arm calls no
+collective — both arms reach the same sequence, so nothing diverges."""
+import numpy as np
+from jax import lax
+
+
+class CollectiveGate:
+    def __init__(self, rank, members, channel="step"):
+        self.rank = rank
+        self.members = members
+        self.channel = channel
+
+    def arrive_and_wait(self):
+        return 0
+
+
+def broadcast_from_zero(tree):   # mxsync: collective channel=kv
+    return tree
+
+
+class KV:
+    def __init__(self, rank, members):
+        self.rank = rank
+        self.members = members
+        self._gate = None
+
+    def _collective_gate(self):
+        if self._gate is None:
+            self._gate = CollectiveGate(self.rank, self.members,
+                                        channel="kv")
+        return self._gate
+
+    def _host_allgather(self, arr):
+        return arr[None]
+
+    def push(self, grads):
+        self._collective_gate().arrive_and_wait()
+        self._check(grads)
+        return self._host_allgather(grads)
+
+    def _check(self, grads):
+        # entry-gated: every call site crossed the kv gate first
+        self._host_allgather(np.zeros((1,), np.int32))
+
+    def seed(self, tree):
+        self._collective_gate().arrive_and_wait()
+        return broadcast_from_zero(tree)
+
+    def fit_step(self, rank, x):
+        y = lax.psum(x, "dp")
+        if rank == 0:
+            self._log(y)
+        return y
+
+    def _log(self, y):
+        return y

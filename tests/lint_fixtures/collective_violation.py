@@ -1,0 +1,39 @@
+"""Seeded collective-discipline violations (mxsync ISSUE 13): an
+UNGATED _host_allgather reachable from a public entry, a channel
+MISMATCH (step gate guarding a kv exchange), and a rank-divergent
+branch whose arms reach different collective sequences (one rank
+skips the psum its peers block in). See test_mxlint.py."""
+import numpy as np
+from jax import lax
+
+
+class CollectiveGate:
+    def __init__(self, rank, members, channel="step"):
+        self.rank = rank
+        self.members = members
+        self.channel = channel
+
+    def arrive_and_wait(self):
+        return 0
+
+
+class KV:
+    def __init__(self, rank, members):
+        self.rank = rank
+        self.members = members
+        self._gate = CollectiveGate(rank, members, channel="step")
+
+    def _host_allgather(self, arr):
+        return arr[None]
+
+    def push(self, grads):
+        return self._host_allgather(grads)
+
+    def barrier(self):
+        self._gate.arrive_and_wait()
+        self._host_allgather(np.zeros((1,), np.int32))
+
+    def fit_step(self, rank, x):
+        if rank == 0:
+            return x
+        return lax.psum(x, "dp")

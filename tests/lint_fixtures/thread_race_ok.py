@@ -1,0 +1,51 @@
+"""Compliant twin of thread_race_violation.py: the coalescer state is
+locked on BOTH sides (and annotated, so lock-discipline owns it), and
+the finalizer uses the PR-4 lock-free pending pattern — a GIL-atomic
+deque append with a justified disable, drained under the lock (a
+finalizer taking the lock would deadlock under cyclic GC)."""
+import collections
+import threading
+import weakref
+
+_lock = threading.Lock()
+_pending_gc = collections.deque()
+
+
+class Coalescer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._depth = 0     # guarded by: self._lock
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            self._schedule(self._flush)
+
+    def _schedule(self, cb):
+        cb()
+
+    def _flush(self):
+        with self._lock:
+            self._depth += 1
+
+    def depth(self):
+        with self._lock:
+            return self._depth
+
+
+def track(obj):
+    weakref.finalize(obj, _note_gc)
+
+
+def _note_gc():
+    _pending_gc.append(1)   # mxlint: disable=thread-race -- GIL-atomic deque append from the finalizer; the reader drains under _lock (the PR 4 lock-free finalizer pattern)
+
+
+def drain():
+    with _lock:
+        n = len(_pending_gc)
+        for _ in range(n):
+            _pending_gc.popleft()
+        return n

@@ -1,0 +1,47 @@
+"""Seeded thread-race violations (mxsync ISSUE 13): a write under a
+thread root reached THROUGH A REF EDGE (a method the thread loop hands
+onward as a callback value) racing a main-thread read, and a
+weakref.finalize callback (finalizer thread root) writing a module
+global the main thread reads. See test_mxlint.py."""
+import threading
+import weakref
+
+_last_gc = None     # written by the finalizer, read from main
+
+
+class Coalescer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._batches = []
+        self._depth = 0
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            # _flush ESCAPES AS A VALUE: the race rule must carry the
+            # thread root across this ref edge
+            self._schedule(self._flush)
+
+    def _schedule(self, cb):
+        cb()
+
+    def _flush(self):
+        self._depth = len(self._batches)
+
+    def depth(self):
+        return self._depth
+
+
+def track(obj):
+    weakref.finalize(obj, _on_gc)
+
+
+def _on_gc():
+    global _last_gc
+    _last_gc = 1
+
+
+def report():
+    return _last_gc

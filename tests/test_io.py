@@ -6,6 +6,7 @@ import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import nd, recordio
+from mxnet_tpu.base import MXNetError
 from mxnet_tpu.io import (NDArrayIter, ResizeIter, PrefetchingIter,
                           ImageRecordIter, CSVIter)
 
@@ -57,6 +58,65 @@ def test_prefetching_iter():
     p.reset()
     batches2 = list(p)
     assert len(batches2) == 3
+
+
+def test_prefetching_iter_reset_survives_wedged_backing():
+    """reset() must neither hang NOR proceed when the worker is
+    blocked INSIDE backing.next() (stalled data source): a
+    replacement worker would race the wedged one's in-flight next()
+    on the shared backing iterator. It waits reset_join_timeout, then
+    raises a diagnosable error; once the source unblocks (the worker
+    exits via its closure-captured stop), reset() is re-entrant and
+    the next epoch is a full clean pass."""
+    import threading
+    import time
+
+    release = threading.Event()
+    base = NDArrayIter(np.arange(24).reshape(12, 2).astype(np.float32),
+                       np.zeros(12), batch_size=4)
+
+    class Wedged:
+        """First next() after arming blocks until released."""
+        batch_size = 4
+
+        def __init__(self):
+            self.armed = False
+
+        @property
+        def provide_data(self):
+            return base.provide_data
+
+        @property
+        def provide_label(self):
+            return base.provide_label
+
+        def reset(self):
+            base.reset()
+
+        def next(self):
+            if self.armed:
+                release.wait()
+            return base.next()
+
+    w = Wedged()
+    p = PrefetchingIter([w], prefetch_depth=1)
+    p.next()                      # worker running
+    w.armed = True
+    p.next()                      # steer the worker into a blocked next()
+    time.sleep(0.05)
+    w.armed = False               # after the wedge clears, stay clear
+    p.reset_join_timeout = 0.3
+    t0 = time.monotonic()
+    with pytest.raises(MXNetError, match="blocked inside the backing"):
+        p.reset()                 # bounded: raises, never hangs/races
+    took = time.monotonic() - t0
+    assert took < 3.0, took
+    release.set()                 # source unblocks; worker sees ITS
+    time.sleep(0.2)               # stop (set by the failed reset), dies
+    p.reset()                     # re-entrant retry: clean this time
+    assert len(list(p)) == 3      # full epoch, nothing stolen
+    p.reset()
+    assert len(list(p)) == 3
 
 
 def test_recordio_roundtrip(tmp_path):

@@ -181,6 +181,46 @@ def test_donation_interproc_fixture_pair():
         [f.render() for f in ok.findings]
 
 
+def test_thread_race_fixture_pair():
+    rep = _fixture("thread_race_violation.py", ["thread-race"])
+    # the attr race (write under a thread root reached THROUGH A REF
+    # EDGE — _flush escapes as a value) anchors at the racing write;
+    # the finalizer-thread global write is the second finding
+    assert _lines(rep) == [31, 43], [f.render() for f in rep.findings]
+    msgs = {f.line: f.message for f in rep.findings}
+    # both witness chains ride in the message, with the registration
+    # site named, and the finding proposes the exact annotation
+    assert "registered at" in msgs[31]
+    assert "_flush" in msgs[31] and "depth" in msgs[31]
+    assert "# guarded by: self._lock" in msgs[31]
+    assert "finalizer" in msgs[43]
+    assert "main thread" in msgs[43]
+    assert "# guarded by: _lock" in msgs[43]
+    ok = _fixture("thread_race_ok.py", ["thread-race"])
+    # locked+annotated attr, lock-free finalizer pending deque with
+    # ONE justified disable (the PR 4 pattern): clean
+    assert ok.clean, [f.render() for f in ok.findings]
+    assert len(ok.suppressed) == 1 and ok.suppressed[0][1]
+
+
+def test_collective_discipline_fixture_pair():
+    rep = _fixture("collective_violation.py", ["collective-discipline"])
+    # ungated _host_allgather from a public entry, step-gate guarding
+    # a kv exchange (channel mismatch), rank-divergent psum
+    assert _lines(rep) == [30, 34, 37], \
+        [f.render() for f in rep.findings]
+    msgs = {f.line: f.message for f in rep.findings}
+    assert "NO CollectiveGate crossing" in msgs[30]
+    assert "channel 'kv'" in msgs[34] and "channel 'step'" in msgs[34]
+    assert "DIFFERENT collective sequences" in msgs[37]
+    assert "psum" in msgs[37] and "rank" in msgs[37]
+    ok = _fixture("collective_ok.py", ["collective-discipline"])
+    # lexical crossing, ENTRY-gated private helper, gated call to the
+    # marked broadcast primitive, rank-arm with no collectives: clean
+    assert ok.clean and not ok.suppressed, \
+        [f.render() for f in ok.findings]
+
+
 def test_registry_fixture_pair():
     rep = _fixture("registry_violation", ["registry-consistency"])
     msgs = [f.message for f in rep.findings]
@@ -496,6 +536,8 @@ def test_gate_catches_a_seeded_regression(tmp_path):
     ("host_sync_chain_violation.py", "host-sync"),
     ("lockset_violation.py", "lockset"),
     ("donation_interproc_violation.py", "donation-safety"),
+    ("thread_race_violation.py", "thread-race"),
+    ("collective_violation.py", "collective-discipline"),
 ])
 def test_gate_catches_each_interprocedural_seed(fixture, rule):
     """Negative control per NEW rule: each seeded fixture fails the
@@ -790,6 +832,41 @@ def test_changed_keeps_chain_sink_in_untouched_file(tmp_path):
                expand_dependents=True,
                **dict(kw, dep_cache=None))
     assert [(f.path, f.line) for f in rep2.findings] == [("util.py", 2)]
+
+
+def test_changed_closure_is_audited(tmp_path):
+    """--changed reports WHAT it linted: the touched files, the
+    reverse-dependent expansion, the parsed set and how many findings
+    anchored outside the subset were kept only via chain crossings —
+    so a '0 findings' on a partial view is auditable; --json carries
+    the closure record verbatim."""
+    kw = _dep_proj(tmp_path)
+    run([str(tmp_path)], **kw)                 # primes the cache
+    (tmp_path / "hot.py").write_text(          # edit the CALLER only
+        "from util import fetch\n\n\n"
+        "def loop(batches, log):   # mxlint: hot\n"
+        "    for b in batches:\n"
+        "        log(fetch(b))\n")
+    rep = run([str(tmp_path)], only=["hot.py"],
+              expand_dependents=True, **kw)
+    c = rep.closure
+    assert c["touched"] == ["hot.py"]
+    assert c["linted"] == ["hot.py"] and c["dependents"] == 0
+    assert "util.py" in c["parsed"]            # the callee was parsed
+    assert c["via_kept"] == 1                  # sink-elsewhere finding
+    assert rep.to_dict()["closure"] == c
+    # touching the CALLEE expands to its reverse dependent
+    (tmp_path / "util.py").write_text(
+        "def fetch(b):\n"
+        "    return b.asnumpy()\n")
+    rep2 = run([str(tmp_path)], only=["util.py"],
+               expand_dependents=True, **kw)
+    c2 = rep2.closure
+    assert c2["touched"] == ["util.py"]
+    assert c2["linted"] == ["hot.py", "util.py"]
+    assert c2["dependents"] == 1
+    # a full (non-subset) run has no closure record
+    assert run([str(tmp_path)], **kw).closure is None
 
 
 def test_local_shadowing_never_fabricates_a_call_edge(tmp_path):
@@ -1384,6 +1461,167 @@ def test_donation_gate_skips_graph_on_donation_free_tree(tmp_path):
     assert "callgraph" in rep.timings, rep.timings
 
 
+def test_divergence_sees_fallthrough_suffix(tmp_path):
+    """`if rank != 0: return` BEFORE a psum diverges too: a
+    terminating arm skips the block's suffix, the fallthrough arm
+    inherits it — sequence comparison must include both."""
+    kw = dict(rules=["collective-discipline"], baseline=Baseline(),
+              root=str(tmp_path))
+    (tmp_path / "early.py").write_text(
+        "from jax import lax\n\n\n"
+        "def step(rank, x):\n"
+        "    if rank != 0:\n"
+        "        return x\n"
+        "    return lax.psum(x, 'dp')\n")
+    rep = run([str(tmp_path)], **kw)
+    assert [f.line for f in rep.findings] == [5], \
+        [f.render() for f in rep.findings]
+    assert "DIFFERENT collective sequences" in rep.findings[0].message
+    (tmp_path / "early.py").write_text(   # rank-invariant control:
+        "from jax import lax\n\n\n"       # both arms reach the psum
+        "def step(rank, x):\n"
+        "    if rank != 0:\n"
+        "        x = x * 2\n"
+        "    return lax.psum(x, 'dp')\n")
+    rep = run([str(tmp_path)], **kw)
+    assert rep.clean, [f.render() for f in rep.findings]
+
+
+def test_collective_call_site_channel_override(tmp_path):
+    """A call-line `# mxsync: collective channel=...` overrides the
+    def-line default: the step-gated commit path calling a kv-default
+    primitive mismatches without the override and is clean with it."""
+    src_tmpl = (
+        "class CollectiveGate:\n"
+        "    def __init__(self, channel='step'):\n"
+        "        self.channel = channel\n\n"
+        "    def arrive_and_wait(self):\n"
+        "        return 0\n\n\n"
+        "def bcast(tree):   # mxsync: collective channel=kv\n"
+        "    return tree\n\n\n"
+        "def commit(tree):\n"
+        "    gate = CollectiveGate(channel='step')\n"
+        "    gate.arrive_and_wait()\n"
+        "    return bcast(tree)%s\n")
+    kw = dict(rules=["collective-discipline"], baseline=Baseline(),
+              root=str(tmp_path))
+    (tmp_path / "ov.py").write_text(src_tmpl % "")
+    rep = run([str(tmp_path)], **kw)
+    assert len(rep.findings) == 1, [f.render() for f in rep.findings]
+    assert "channel 'kv'" in rep.findings[0].message
+    assert "'step'" in rep.findings[0].message
+    (tmp_path / "ov.py").write_text(
+        src_tmpl % "   # mxsync: collective channel=step")
+    rep = run([str(tmp_path)], **kw)
+    assert rep.clean, [f.render() for f in rep.findings]
+
+
+def test_thread_spawned_from_thread_keeps_its_own_root(tmp_path):
+    """A Thread target spawning ANOTHER thread hands the inner target
+    to the NEW thread — following that registration edge during root
+    propagation would fabricate a cross-root race between two points
+    of one sequential spawn chain."""
+    (tmp_path / "spawn.py").write_text(
+        "import threading\n\n\n"
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._outer).start()\n\n"
+        "    def _outer(self):\n"
+        "        threading.Thread(target=self._inner).start()\n\n"
+        "    def _inner(self):\n"
+        "        self._n = 1\n"
+        "        self._report()\n\n"
+        "    def _report(self):\n"
+        "        return self._n\n")
+    rep = run([str(tmp_path)], rules=["thread-race"],
+              baseline=Baseline(), root=str(tmp_path))
+    assert rep.clean, [f.render() for f in rep.findings]
+
+
+def test_closure_read_of_shadowing_local_is_not_a_global(tmp_path):
+    """A nested worker reading an ENCLOSING function's local that
+    shadows a module-global name touches the closure variable, not the
+    global — Python scoping walks every enclosing frame, so must the
+    global-access resolution."""
+    kw = dict(rules=["thread-race"], baseline=Baseline(),
+              root=str(tmp_path))
+    (tmp_path / "closure.py").write_text(
+        "import threading\n\n"
+        "_buf = []\n\n\n"
+        "def start():\n"
+        "    _buf = []\n"
+        "    def worker():\n"
+        "        return len(_buf)\n"
+        "    threading.Thread(target=worker).start()\n\n\n"
+        "def writeback():\n"
+        "    global _buf\n"
+        "    _buf = [1]\n")
+    rep = run([str(tmp_path)], **kw)
+    assert rep.clean, [f.render() for f in rep.findings]
+    (tmp_path / "closure.py").write_text(   # positive control: no
+        "import threading\n\n"              # shadowing local — the
+        "_buf = []\n\n\n"                   # worker reads the global
+        "def start():\n"
+        "    def worker():\n"
+        "        return len(_buf)\n"
+        "    threading.Thread(target=worker).start()\n\n\n"
+        "def writeback():\n"
+        "    global _buf\n"
+        "    _buf = [1]\n")
+    rep = run([str(tmp_path)], **kw)
+    assert [f.rule for f in rep.findings] == ["thread-race"], \
+        [f.render() for f in rep.findings]
+
+
+def test_function_level_excepthook_registers_one_root(tmp_path):
+    """A hook assignment inside a function must register exactly ONE
+    thread root (with the function as scope, so the registration ref
+    edge is excluded from main propagation) — the whole-tree module
+    scan used to see it too, and the two clone roots fabricated a
+    cross-root race for code that only ever runs under the hook."""
+    (tmp_path / "hook.py").write_text(
+        "import sys\n\n\n"
+        "def _hook(t, v, tb):\n"
+        "    pass\n\n\n"
+        "def install():\n"
+        "    sys.excepthook = _hook\n")
+    from mxnet_tpu.analysis.core import Project, iter_python_files
+    proj = Project(root=str(tmp_path))
+    for p in iter_python_files([str(tmp_path)]):
+        proj.add_file(p)
+    tm = proj.threads()
+    assert len(tm.roots) == 1, [r.label() for r in tm.roots]
+    assert tm.roots[0].kind == "excepthook"
+
+
+def test_pool_submit_is_a_thread_root(tmp_path):
+    """`self._pool.submit(self._resolve, ...)` on a ThreadPoolExecutor
+    attr makes _resolve a thread root — the serving resolver-pool
+    shape — so its unlocked writes race main-thread reads."""
+    (tmp_path / "pool.py").write_text(
+        "import threading\n"
+        "from concurrent.futures import ThreadPoolExecutor\n\n\n"
+        "class Engine:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._pool = ThreadPoolExecutor(2)\n"
+        "        self._done = 0\n\n"
+        "    def dispatch(self, batch):\n"
+        "        self._pool.submit(self._resolve, batch)\n\n"
+        "    def _resolve(self, batch):\n"
+        "        self._done += 1\n\n"
+        "    def done(self):\n"
+        "        return self._done\n")
+    rep = run([str(tmp_path)], rules=["thread-race"],
+              baseline=Baseline(), root=str(tmp_path))
+    assert len(rep.findings) == 1, [f.render() for f in rep.findings]
+    assert "pool-worker" in rep.findings[0].message
+    assert "_resolve" in rep.findings[0].message
+
+
 def test_lint_wall_time_guard():
     """The full-repo mxflow run stays inside its wall-time budget
     (MXLINT_BUDGET_S, default 60s — ~10x the measured cost, so only a
@@ -1399,9 +1637,22 @@ def test_lint_wall_time_guard():
     for rule in ALL_RULE_IDS:
         assert rule in doc["timings"], doc["timings"]
     assert "callgraph" in doc["timings"] and "summaries" in doc["timings"]
+    # the mxsync models are timed under their own keys (like callgraph/
+    # summaries) so rule timings never double-count the builds
+    assert "threads" in doc["timings"] and "collectives" in doc["timings"]
     cg = doc["callgraph"]
     for key in ("functions", "call_edges", "ref_edges", "dynamic_calls",
-                "sccs", "cyclic_sccs", "largest_scc", "facts_cache"):
+                "sccs", "cyclic_sccs", "largest_scc", "facts_cache",
+                "thread_roots", "thread_rooted_functions",
+                "collective_sites", "collective_host_sites",
+                "gate_crossings"):
         assert key in cg, cg
     assert cg["functions"] > 1000        # the graph really covers the repo
     assert cg["call_edges"] > 500
+    # the mxsync models really cover the runtime: the coalescer/
+    # sampler/heartbeat/pool roots and the kvstore/spmd collective
+    # surface are all discoverable statically
+    assert cg["thread_roots"] >= 10, cg
+    assert cg["collective_sites"] >= 5, cg
+    assert cg["collective_host_sites"] >= 4, cg
+    assert cg["gate_crossings"] >= 4, cg

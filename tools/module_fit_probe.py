@@ -681,11 +681,16 @@ def dist_smoke(json_out=None):
         out["single"] = {"rcs": rcs_s, "acc": single and single["acc"]}
 
         # -- leg C: chaos — kill rank 1 mid-epoch, rank 0 recovers ------
-        # one gate crossing per fused step: nbatch gens per epoch, so
-        # n = nbatch + 3 dies in epoch 1 at batch index 2, AFTER the
-        # epoch-0-end checkpoint exists
+        # gate crossings before the steps: one kv-channel crossing per
+        # broadcasting kv.init call (the probe net has 4 params —
+        # fc1/fc2 weight+bias — initialised one call each) + one
+        # step-channel crossing at the first dist commit (both added
+        # by the mxsync collective-discipline fixes), then one step
+        # crossing per fused step — nbatch gens per epoch.
+        # n = 5 + nbatch + 3 dies in epoch 1 at batch index 2, AFTER
+        # the epoch-0-end checkpoint exists
         chaos_epochs = 3
-        fault_n = nbatch + 3
+        fault_n = 5 + nbatch + 3
         flight = os.path.join(work, "flight0")
         os.makedirs(flight, exist_ok=True)
         ckpt = os.path.join(work, "ckpt")

@@ -264,11 +264,17 @@ def main(argv):
         return usage("no such path: %s" % e)
 
     if changed and not want_json:
-        print("mxlint (--changed): %d touched file(s), %d linted with "
-              "reverse call-graph dependents (dep cache %s: %d file(s) "
-              "parsed)"
-              % (len(only), len(report.subset or []),
-                 report.dep_cache or "off", report.files))
+        # the audit line for a "0 findings" on a partial view: exactly
+        # what closure was linted (touched + reverse dependents), what
+        # was parsed to support it, and how many findings anchored
+        # OUTSIDE the subset survived only via their witness chains
+        c = report.closure or {}
+        print("mxlint (--changed): %d touched + %d reverse "
+              "dependent(s) = %d file(s) linted, %d parsed (dep cache "
+              "%s); %d chain finding(s) kept from outside the subset"
+              % (len(c.get("touched", only)), c.get("dependents", 0),
+                 len(report.subset or []), report.files,
+                 report.dep_cache or "off", c.get("via_kept", 0)))
     if want_json:
         doc = json.dumps(report.to_dict(), indent=2, sort_keys=True)
         if json_path and json_path != "-":
