@@ -48,6 +48,8 @@ CALL = "call"
 REF = "ref"
 
 _FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+_TRY_NODES = (ast.Try,) + ((ast.TryStar,)
+                           if hasattr(ast, "TryStar") else ())
 
 
 class FuncInfo:
@@ -224,6 +226,7 @@ class CallGraph:
         self._locals = {}               # FuncInfo -> frozenset of names
         self._by_src = None             # src -> [FuncInfo]
         self._scope_nodes = {}          # FuncInfo -> tuple of scope nodes
+        self._try_maps = {}             # FuncInfo -> {id(node): ctx}
 
     # -- construction -------------------------------------------------------
     def _add_class(self, ci):
@@ -268,6 +271,20 @@ class CallGraph:
         if got is None:
             got = self._scope_nodes[fi] = tuple(
                 _walk_same_scope(fi.node))
+        return got
+
+    def try_map_of(self, fi):
+        """{id(same-scope node): tuple of (Try node, region)} —
+        outermost-first exception context of every node in the
+        function's own scope. ``region`` is ``"try"`` (guarded by the
+        Try's handlers, if any), ``"handler"``, ``"orelse"`` or
+        ``"final"`` (all three propagate past their own Try). Nested
+        def/class bodies are their own scope and are not descended
+        into. Materialized once per function (the mxlife rules each
+        ask several times per function)."""
+        got = self._try_maps.get(fi)
+        if got is None:
+            got = self._try_maps[fi] = _build_try_map(fi.node)
         return got
 
     def functions_of(self, src):
@@ -599,6 +616,34 @@ def _walk_same_scope(node):
             continue
         yield n
         stack.extend(ast.iter_child_nodes(n))
+
+
+def _build_try_map(func_node):
+    """See :meth:`CallGraph.try_map_of`."""
+    out = {}
+
+    def visit(n, ctx):
+        out[id(n)] = ctx
+        if isinstance(n, _TRY_NODES):
+            for s in n.body:
+                visit(s, ctx + ((n, "try"),))
+            for h in n.handlers:
+                out[id(h)] = ctx
+                for s in h.body:
+                    visit(s, ctx + ((n, "handler"),))
+            for s in n.orelse:
+                visit(s, ctx + ((n, "orelse"),))
+            for s in n.finalbody:
+                visit(s, ctx + ((n, "final"),))
+            return
+        if isinstance(n, _FUNC_NODES + (ast.ClassDef,)):
+            return                      # nested scope: its own map
+        for child in ast.iter_child_nodes(n):
+            visit(child, ctx)
+
+    for stmt in func_node.body:
+        visit(stmt, ())
+    return out
 
 
 def build(project):

@@ -37,11 +37,14 @@ import time
 # mxflow interprocedural additions (ISSUE 9); ``host-sync`` and
 # ``donation-safety`` gained interprocedural layers under their
 # existing ids; ``thread-race`` and ``collective-discipline`` are the
-# mxsync concurrency families (ISSUE 13).
+# mxsync concurrency families (ISSUE 13); ``future-lifecycle``,
+# ``resource-release`` and ``torn-state-on-raise`` are the mxlife
+# typestate/exception-path family (ISSUE 14).
 ALL_RULE_IDS = ("jit-site", "dispatch-hook", "lock-discipline",
                 "lockset", "thread-race", "host-sync", "trace-purity",
                 "donation-safety", "collective-discipline",
-                "registry-consistency")
+                "future-lifecycle", "resource-release",
+                "torn-state-on-raise", "registry-consistency")
 
 # the rule id bad suppression comments are reported under (not
 # suppressible itself — a broken suppression must not hide)
@@ -352,6 +355,7 @@ class Project:
         self._summaries = None
         self._threads = None
         self._collectives = None
+        self._lifecycle = None
 
     def callgraph(self):
         """The mxflow call graph over every parsed source — built once
@@ -402,6 +406,21 @@ class Project:
             self.timings["collectives"] = time.perf_counter() - t0
             self.extra_stats.update(self._collectives.stats())
         return self._collectives
+
+    def lifecycle(self):
+        """The mxlife lifecycle model (future classes, typestate sims,
+        discharge fixpoint) over :meth:`callgraph` — built once per
+        run, on first demand. Banks its stats (future classes,
+        resolver functions, may-raise functions) into ``extra_stats``
+        for the JSON report."""
+        if self._lifecycle is None:
+            from . import lifecycle as _lifecycle
+            graph = self.callgraph()
+            t0 = time.perf_counter()
+            self._lifecycle = _lifecycle.LifecycleModel(self, graph)
+            self.timings["lifecycle"] = time.perf_counter() - t0
+            self.extra_stats.update(self._lifecycle.stats())
+        return self._lifecycle
 
     def add_file(self, path):
         display = os.path.relpath(path, self.root) if self.root else path

@@ -1,0 +1,707 @@
+"""mxlife's lifecycle model: future typestate over exception paths.
+
+The runtime makes hard lifecycle promises the dynamic lanes can only
+spot-check: serving promises zero hung futures (every admitted
+``_Request``'s future resolves exactly once, on EVERY path including
+the exception paths), checkpointing promises temp+fsync+rename
+atomicity with unlink-on-failure, the flight recorder assumes every
+entered span exits. This module is the shared substrate the three
+mxlife rules (``future-lifecycle``, ``resource-release``,
+``torn-state-on-raise``) consume:
+
+* **future classes** — classes whose constructor binds
+  ``self.<attr> = concurrent.futures.Future()`` (the ``_Request``
+  shape). Their construction is an OWNERSHIP event; attrs the same
+  class binds to ``<scope>.__enter__()`` results are its *entered
+  scopes* (the serving wait/req spans), which terminal resolvers are
+  expected to close.
+
+* **a per-function typestate simulator** (:class:`_Sim`) — an
+  abstract interpretation of one function body tracking owned
+  objects through ``U`` (unresolved) → resolved/discharged, with
+  REAL exception edges: a call site whose in-scan callee
+  :meth:`~.summaries.Summaries.may_raise` forks a raised state that
+  walks the enclosing try/except/finally structure (handlers catch,
+  ``finally`` runs on both legs, an unhandled raise is an
+  exceptional function exit). Ownership starts at a future-class
+  construction, a dequeue-shaped binding (``.get()`` / ``.pop()`` /
+  ``.popleft()``) or a loop variable over a parameter; it discharges
+  on resolve (``set_result``/``set_exception``), on transfer
+  (``append``/``put``/store-to-attr/return/closure capture/pass to
+  an unknown callee) or on a pass to an in-scan callee that
+  *discharges* that parameter on every path. A path reaching a
+  function exit with an owned object still ``U`` is a STRAND; a
+  second unconditional resolve on one path is a DOUBLE-RESOLVE.
+  ``if v.future.done():`` guards and ``v is SENTINEL`` comparisons
+  discharge on the appropriate branch (a done future is someone
+  else's resolution; a sentinel is not a request) — conservative-
+  quiet, like the rest of mxflow: a finding's path is a real path.
+
+* **a discharge fixpoint** — ``discharges_params(fn)`` (the param
+  positions a function resolves-or-transfers on every normal path)
+  propagates caller-ward over the call graph with a worklist, so
+  ``self._shed(req, ...)`` counts as resolving ``req`` with no
+  annotation, exactly like the donation and lockset fixpoints.
+
+Only objects that touch the future machinery somewhere in the
+function (a resolve site, a pass to a discharging callee) are
+reported on — a dict ``.get()`` or an ordinary loop variable never
+becomes a phantom obligation.
+"""
+from __future__ import annotations
+
+import ast
+from collections import deque
+
+from . import callgraph as cg
+from .core import expr_text, resolve_origin
+
+_FUTURE_ORIGINS = {"concurrent.futures.Future",
+                   "concurrent.futures._base.Future"}
+_DEQUEUE_METHODS = {"get", "get_nowait", "pop", "popleft"}
+_TRANSFER_METHODS = {"append", "appendleft", "put", "put_nowait",
+                     "add", "insert", "extend"}
+RESOLVE_METHODS = ("set_result", "set_exception")
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# states
+U = "U"          # owned, unresolved
+R = "R"          # resolved once
+
+# simulator blow-up guard: a function whose abstract state set grows
+# past this is skipped entirely (no findings, no discharge assertions)
+# rather than reasoned about half-way
+_MAX_STATES = 128
+
+
+def file_has_lifecycle_surface(src):
+    """Cheap text gate: does this file mention the future machinery at
+    all? (The rule skips the graph build on trees with no resolve
+    sites — the donation rule's cheap-gate pattern.)"""
+    return any(m in src.text for m in RESOLVE_METHODS)
+
+
+def resolve_target(node):
+    """(root var name, via_future) of a resolve call's receiver —
+    ``v.set_result(...)`` -> ("v", False); ``v.future.set_result(...)``
+    -> ("v", True); anything deeper/unrooted -> (None, False)."""
+    f = node.func
+    if not (isinstance(f, ast.Attribute) and f.attr in RESOLVE_METHODS):
+        return (None, False)
+    recv = f.value
+    if isinstance(recv, ast.Name):
+        return (recv.id, False)
+    if isinstance(recv, ast.Attribute) and recv.attr == "future" \
+            and isinstance(recv.value, ast.Name):
+        return (recv.value.id, True)
+    return (None, False)
+
+
+def _done_test(test):
+    """(var, positive) when ``test`` is a ``v.done()`` /
+    ``v.future.done()`` probe (possibly ``not``-wrapped), else None.
+    ``positive`` True means the TRUE branch is the already-resolved
+    side."""
+    positive = True
+    while isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        positive = not positive
+        test = test.operand
+    if isinstance(test, ast.Call) and isinstance(test.func, ast.Attribute) \
+            and test.func.attr == "done" and not test.args:
+        recv = test.func.value
+        if isinstance(recv, ast.Name):
+            return (recv.id, positive)
+        if isinstance(recv, ast.Attribute) and recv.attr == "future" \
+                and isinstance(recv.value, ast.Name):
+            return (recv.value.id, positive)
+    return None
+
+
+def _is_test(test):
+    """(var, is_branch_true) for ``v is X`` / ``v is not X`` sentinel
+    comparisons — on the ``is`` side the object is a known sentinel,
+    not a request."""
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 \
+            and isinstance(test.left, ast.Name):
+        if isinstance(test.ops[0], ast.Is):
+            return (test.left.id, True)
+        if isinstance(test.ops[0], ast.IsNot):
+            return (test.left.id, False)
+    return None
+
+
+class _Outcome:
+    """State sets leaving one block, by exit class."""
+
+    __slots__ = ("normal", "returns", "raises", "breaks", "continues")
+
+    def __init__(self):
+        self.normal = set()
+        self.returns = []               # (state, line)
+        self.raises = []                # (state, line, why)
+        self.breaks = set()
+        self.continues = set()
+
+
+class _SimResult:
+    __slots__ = ("strands", "doubles", "discharged_params", "interest",
+                 "gave_up")
+
+    def __init__(self):
+        self.strands = []     # (var, own_line, exit_line, why)
+        self.doubles = []     # (var, line, first_line)
+        self.discharged_params = frozenset()
+        # (var, line) of every touch of the future machinery — LINE-
+        # keyed so a reused loop-variable name in another loop of the
+        # same function never inherits interest it did not earn
+        self.interest = set()
+        self.gave_up = False
+
+
+class _Sim:
+    """One function's typestate pass (see module docstring)."""
+
+    def __init__(self, model, fi):
+        self.model = model
+        self.graph = model.graph
+        self.summ = model.summ
+        self.fi = fi
+        self.facts = model.summ.facts_of(fi)
+        self.edges = {(l, c): callee for callee, l, c
+                      in model.graph.callees(fi, kinds=(cg.CALL,))}
+        self.res = _SimResult()
+        self.own_line = {}              # var -> ownership line
+        self.first_resolve = {}         # var -> line of first resolve seen
+
+    # -- state helpers -------------------------------------------------------
+    @staticmethod
+    def _set(state, var, st):
+        d = dict(state)
+        d[var] = st
+        return tuple(sorted(d.items()))
+
+    @staticmethod
+    def _drop(state, var):
+        return tuple((k, v) for k, v in state if k != var)
+
+    @staticmethod
+    def _get(state, var):
+        for k, v in state:
+            if k == var:
+                return v
+        return None
+
+    def _guard(self, states):
+        if len(states) > _MAX_STATES:
+            self.res.gave_up = True
+            return set(list(states)[:_MAX_STATES])
+        return states
+
+    # -- events --------------------------------------------------------------
+    def _callee_discharges(self, key, call):
+        """Call-arg positions (as written) this call discharges, or
+        None for an unknown/dynamic callee (which discharges every
+        bare-Name arg, conservative-quiet)."""
+        callee = self.edges.get(key)
+        if callee is None:
+            return None
+        d = self.model._discharges.get(callee, frozenset())
+        if not d:
+            return frozenset()
+        shift = 1 if (callee.self_class is not None
+                      and not callee.is_static
+                      and isinstance(call.func, ast.Attribute)) else 0
+        return frozenset(i - shift for i in d if i - shift >= 0)
+
+    def _apply_call(self, call, state, out):
+        """Apply one call's events to ``state``; exceptional fork is
+        recorded into ``out.raises`` by the caller (the statement
+        executor), which knows the try context structurally."""
+        key = (call.lineno, call.col_offset)
+        var, _viaf = resolve_target(call)
+        if var is not None and self._get(state, var) is not None:
+            self.res.interest.add((var, call.lineno))
+            st = self._get(state, var)
+            if st == U:
+                state = self._set(state, var, R)
+                self.first_resolve.setdefault(var, call.lineno)
+            else:
+                self.res.doubles.append(
+                    (var, call.lineno,
+                     self.first_resolve.get(var, call.lineno)))
+            return state
+        f = call.func
+        # transfer-shaped method calls: buf.append(v), q.put(v)
+        if isinstance(f, ast.Attribute) and f.attr in _TRANSFER_METHODS:
+            for a in call.args:
+                if isinstance(a, ast.Name) \
+                        and self._get(state, a.id) is not None:
+                    state = self._drop(state, a.id)
+            return state
+        # bare-Name args: discharge via a discharging callee (interest)
+        # or via an unknown callee (ownership may transfer; no interest)
+        discharges = self._callee_discharges(key, call)
+        for i, a in enumerate(call.args):
+            if not (isinstance(a, ast.Name)
+                    and self._get(state, a.id) is not None):
+                continue
+            if discharges is None:
+                state = self._drop(state, a.id)
+            elif i in discharges:
+                self.res.interest.add((a.id, call.lineno))
+                state = self._drop(state, a.id)
+        for kw in call.keywords:
+            if isinstance(kw.value, ast.Name) \
+                    and self._get(state, kw.value.id) is not None \
+                    and discharges is None:
+                state = self._drop(state, kw.value.id)
+        return state
+
+    def _owning_value(self, value):
+        """Does binding from this expression START an ownership?
+        ("ctor" for a future-class construction, "dequeue" for a
+        get/pop-shaped call), else None."""
+        if not isinstance(value, ast.Call):
+            return None
+        key = (value.lineno, value.col_offset)
+        callee = self.edges.get(key)
+        if callee is not None and callee.name == "__init__" \
+                and callee.self_class in self.model.future_classes:
+            return "ctor"
+        f = value.func
+        if isinstance(f, ast.Attribute) and f.attr in _DEQUEUE_METHODS:
+            return "dequeue"
+        return None
+
+    def _calls_in(self, node):
+        """Call nodes inside ``node``, source order, not descending
+        into nested def/class bodies (their own scope)."""
+        out = []
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, _FUNC_NODES + (ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(n, ast.Call):
+                out.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        out.sort(key=lambda c: (c.lineno, c.col_offset))
+        return out
+
+    def _captured_names(self, defnode):
+        """Names a nested def/lambda loads — an owned var captured by
+        a closure escapes the analyzer's sight (discharge)."""
+        names = set()
+        for n in ast.walk(defnode):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                names.add(n.id)
+        return names
+
+    def _exec_simple(self, stmt, states, out):
+        """Linear statement: apply each call event in source order,
+        forking a raised state at each in-scan may-raise site."""
+        calls = self._calls_in(stmt)
+        new_states = set()
+        for state in states:
+            cur = {state}
+            for call in calls:
+                key = (call.lineno, call.col_offset)
+                callee = self.edges.get(key)
+                nxt = set()
+                for st in cur:
+                    if callee is not None and self.summ.may_raise(callee):
+                        out.raises.append(
+                            (st, call.lineno, ("call", callee)))
+                    nxt.add(self._apply_call(call, st, out))
+                cur = nxt
+            new_states |= cur
+        # binding effects after the value's calls ran
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            t, v = stmt.targets[0], stmt.value
+            owned = self._owning_value(v) if isinstance(t, ast.Name) \
+                else None
+            if owned is not None:
+                self.own_line.setdefault(t.id, stmt.lineno)
+                new_states = {self._set(s, t.id, U) for s in new_states}
+            elif isinstance(v, ast.Name):
+                if isinstance(t, ast.Name):
+                    # alias rename: w = v moves the obligation
+                    renamed = set()
+                    for s in new_states:
+                        st = self._get(s, v.id)
+                        if st is not None:
+                            s = self._set(self._drop(s, v.id), t.id, st)
+                            self.own_line.setdefault(
+                                t.id, self.own_line.get(v.id,
+                                                        stmt.lineno))
+                        renamed.add(s)
+                    new_states = renamed
+                elif isinstance(t, (ast.Attribute, ast.Subscript)):
+                    # escape: stored beyond the frame
+                    new_states = {self._drop(s, v.id)
+                                  for s in new_states}
+        # a nested def capturing an owned var escapes it
+        if isinstance(stmt, _FUNC_NODES):
+            captured = self._captured_names(stmt)
+            pruned = set()
+            for s in new_states:
+                for name in captured:
+                    if self._get(s, name) is not None:
+                        s = self._drop(s, name)
+                pruned.add(s)
+            new_states = pruned
+        return self._guard(new_states)
+
+    # -- control flow --------------------------------------------------------
+    def exec_block(self, stmts, states):
+        out = _Outcome()
+        cur = set(states)
+        for stmt in stmts:
+            if not cur:
+                break
+            cur = self._exec_stmt(stmt, cur, out)
+        out.normal = cur
+        return out
+
+    def _merge(self, out, sub):
+        out.returns.extend(sub.returns)
+        out.raises.extend(sub.raises)
+        out.breaks |= sub.breaks
+        out.continues |= sub.continues
+
+    def _exec_stmt(self, stmt, states, out):
+        if isinstance(stmt, ast.Return):
+            nxt = self._exec_simple(stmt, states, out)
+            if isinstance(stmt.value, ast.Name):
+                nxt = {self._drop(s, stmt.value.id) for s in nxt}
+            out.returns.extend((s, stmt.lineno) for s in nxt)
+            return set()
+        if isinstance(stmt, ast.Raise):
+            nxt = self._exec_simple(stmt, states, out)
+            why = ("raise", expr_text(stmt.exc.func
+                                      if isinstance(stmt.exc, ast.Call)
+                                      else stmt.exc)
+                   if stmt.exc is not None else "re-raise")
+            out.raises.extend((s, stmt.lineno, why) for s in nxt)
+            return set()
+        if isinstance(stmt, ast.Break):
+            out.breaks |= states
+            return set()
+        if isinstance(stmt, ast.Continue):
+            out.continues |= states
+            return set()
+        if isinstance(stmt, ast.If):
+            return self._exec_if(stmt, states, out)
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            return self._exec_for(stmt, states, out)
+        if isinstance(stmt, ast.While):
+            return self._exec_while(stmt, states, out)
+        if isinstance(stmt, (ast.Try,) + ((ast.TryStar,)
+                                          if hasattr(ast, "TryStar")
+                                          else ())):
+            return self._exec_try(stmt, states, out)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            mid = states
+            for item in stmt.items:
+                mid = self._exec_simple(ast.Expr(
+                    value=item.context_expr), mid, out)
+            sub = self.exec_block(stmt.body, mid)
+            self._merge(out, sub)
+            return sub.normal
+        return self._exec_simple(stmt, states, out)
+
+    def _exec_if(self, stmt, states, out):
+        states = self._exec_simple(ast.Expr(value=stmt.test), states,
+                                   out)
+        done = _done_test(stmt.test)
+        sentinel = _is_test(stmt.test)
+        true_states, false_states = set(states), set(states)
+        if done is not None:
+            # the done side: someone already resolved it — discharge.
+            # the NOT-done side: a state where WE already resolved (R)
+            # is runtime-infeasible there (done() would return True) —
+            # prune it, or a guarded late resolve after an earlier
+            # resolve would report a phantom double
+            var, positive = done
+            if positive:
+                true_states = {self._drop(s, var) for s in true_states}
+                false_states = {s for s in false_states
+                                if self._get(s, var) != R}
+            else:
+                false_states = {self._drop(s, var)
+                                for s in false_states}
+                true_states = {s for s in true_states
+                               if self._get(s, var) != R}
+            self.res.interest.add((var, stmt.lineno))
+        if sentinel is not None:
+            var, is_true = sentinel
+            if is_true:
+                true_states = {self._drop(s, var) for s in true_states}
+            else:
+                false_states = {self._drop(s, var)
+                                for s in false_states}
+        sub_t = self.exec_block(stmt.body, true_states)
+        sub_f = self.exec_block(stmt.orelse, false_states)
+        self._merge(out, sub_t)
+        self._merge(out, sub_f)
+        return self._guard(sub_t.normal | sub_f.normal)
+
+    def _iter_root(self, node):
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        return node.id if isinstance(node, ast.Name) else None
+
+    def _exec_for(self, stmt, states, out):
+        states = self._exec_simple(ast.Expr(value=stmt.iter), states,
+                                   out)
+        root = self._iter_root(stmt.iter)
+        if root is not None:
+            # iterating a tracked collection discharges the collection
+            # itself — the per-element obligations take over
+            states = {self._drop(s, root) for s in states}
+        loopvar = stmt.target.id if isinstance(stmt.target, ast.Name) \
+            else None
+        body_states = states
+        if loopvar is not None:
+            self.own_line.setdefault(loopvar, stmt.lineno)
+            body_states = {self._set(s, loopvar, U) for s in states}
+        sub = self.exec_block(stmt.body, body_states)
+        # one iteration's end (fall-through or continue) must have
+        # discharged the element — the next iteration rebinds it
+        for s in sub.normal | sub.continues:
+            if loopvar is not None and self._get(s, loopvar) == U:
+                self.res.strands.append(
+                    (loopvar, stmt.lineno, stmt.lineno,
+                     ("loop", stmt.lineno,
+                      getattr(stmt, "end_lineno", stmt.lineno))))
+        out.raises.extend(sub.raises)
+        out.returns.extend(sub.returns)
+        after = {self._drop(s, loopvar) if loopvar is not None else s
+                 for s in sub.normal | sub.continues | sub.breaks}
+        after |= states                  # zero iterations
+        sub_else = self.exec_block(stmt.orelse, after)
+        self._merge(out, sub_else)
+        return self._guard(sub_else.normal)
+
+    def _exec_while(self, stmt, states, out):
+        states = self._exec_simple(ast.Expr(value=stmt.test), states,
+                                   out)
+        sub = self.exec_block(stmt.body, states)
+        out.raises.extend(sub.raises)
+        out.returns.extend(sub.returns)
+        after = states | sub.normal | sub.continues | sub.breaks
+        sub_else = self.exec_block(stmt.orelse, after)
+        self._merge(out, sub_else)
+        return self._guard(sub_else.normal)
+
+    def _exec_try(self, stmt, states, out):
+        body = self.exec_block(stmt.body, states)
+        raised_states = {s for s, _l, _w in body.raises}
+        escaped = []
+        handler_normal = set()
+        returns = list(body.returns)
+        breaks = set(body.breaks)
+        continues = set(body.continues)
+        if stmt.handlers:
+            for h in stmt.handlers:
+                sub = self.exec_block(h.body, raised_states)
+                handler_normal |= sub.normal
+                escaped.extend(sub.raises)
+                returns.extend(sub.returns)
+                breaks |= sub.breaks
+                continues |= sub.continues
+        else:
+            escaped = list(body.raises)
+        sub_else = self.exec_block(stmt.orelse, body.normal)
+        escaped.extend(sub_else.raises)
+        normal = sub_else.normal | handler_normal
+        returns.extend(sub_else.returns)
+        breaks |= sub_else.breaks
+        continues |= sub_else.continues
+        if stmt.finalbody:
+            # EVERY leg runs the finally: fall-through, the exception
+            # leg (then re-raises), and the return/break/continue legs
+            # (then resumes the exit) — a future resolved in a finally
+            # covers a `return` inside the try too
+            fin = self.exec_block(stmt.finalbody, normal)
+            self._merge(out, fin)
+            normal = fin.normal
+
+            def _through_final(items, emit):
+                for item in items:
+                    fsub = self.exec_block(stmt.finalbody, {item[0]})
+                    out.returns.extend(fsub.returns)
+                    out.raises.extend(fsub.raises)
+                    for s2 in fsub.normal:
+                        emit(s2, item)
+
+            new_escaped = []
+            _through_final(escaped,
+                          lambda s2, it: new_escaped.append(
+                              (s2, it[1], it[2])))
+            escaped = new_escaped
+            new_returns = []
+            _through_final(returns,
+                          lambda s2, it: new_returns.append(
+                              (s2, it[1])))
+            returns = new_returns
+            for legs, sink in ((breaks, "breaks"),
+                               (continues, "continues")):
+                passed = set()
+                for s in legs:
+                    fsub = self.exec_block(stmt.finalbody, {s})
+                    out.returns.extend(fsub.returns)
+                    out.raises.extend(fsub.raises)
+                    passed |= fsub.normal
+                if sink == "breaks":
+                    breaks = passed
+                else:
+                    continues = passed
+        out.raises.extend(escaped)
+        out.returns.extend(returns)
+        out.breaks |= breaks
+        out.continues |= continues
+        return self._guard(normal)
+
+    # -- entry ---------------------------------------------------------------
+    def run(self):
+        params = [p for p in self.facts.params if p != "self"]
+        entry = tuple(sorted((p, U) for p in params))
+        out = self.exec_block(self.fi.node.body, {entry})
+        res = self.res
+        if res.gave_up:
+            return res
+        exits = [(s, line, ("return", line))
+                 for s, line in out.returns]
+        exits += [(s, None, ("return", None)) for s in out.normal]
+        raise_exits = [(s, line, why) for s, line, why in out.raises]
+        # discharged params: resolved or gone from EVERY normal exit
+        # state (a param left in R was resolved — that IS the caller's
+        # discharge; only a still-U param keeps the obligation there)
+        still = set()
+        for s, _line, _why in exits:
+            for var, st in s:
+                if st == U:
+                    still.add(var)
+        res.discharged_params = frozenset(
+            i for i, p in enumerate(self.facts.params)
+            if p != "self" and p not in still)
+        # strands: owned-with-interest vars alive at an exit. Raise
+        # exits report the raising site; normal exits the return line.
+        for s, line, why in exits + raise_exits:
+            for var, st in s:
+                if st != U or var in self.facts.params:
+                    continue
+                res.strands.append(
+                    (var, self.own_line.get(var, self.fi.line),
+                     line if line is not None else self.fi.line, why))
+        return res
+
+
+class LifecycleModel:
+    """Future classes + per-function typestate results over one
+    Project (built once per run via ``project.lifecycle()``)."""
+
+    def __init__(self, project, graph):
+        self.project = project
+        self.graph = graph
+        self.summ = project.summaries()
+        self.future_classes = {}        # ClassInfo -> {"attrs", "scopes"}
+        self.resolve_sites = {}         # FuncInfo -> [resolve Call nodes]
+        self.scope_exits = {}           # FuncInfo -> set of attr names
+        self._discharges = {}           # FuncInfo -> frozenset(param idx)
+        self.results = {}               # FuncInfo -> _SimResult
+        self._collect()
+        self._fixpoint()
+
+    # -- collection ----------------------------------------------------------
+    def _collect(self):
+        for ci in self.graph.classes:
+            amap = self.graph.imports_of(ci.src)
+            attrs, scopes = set(), set()
+            for m in ci.methods.values():
+                for n in self.graph.nodes_of(m):
+                    if not (isinstance(n, ast.Assign)
+                            and len(n.targets) == 1):
+                        continue
+                    t, v = n.targets[0], n.value
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                            and isinstance(v, ast.Call)):
+                        continue
+                    origin = resolve_origin(v.func, amap)
+                    if origin in _FUTURE_ORIGINS:
+                        attrs.add(t.attr)
+                    if isinstance(v.func, ast.Attribute) \
+                            and v.func.attr == "__enter__":
+                        scopes.add(t.attr)
+            if attrs:
+                self.future_classes[ci] = {"attrs": attrs,
+                                           "scopes": scopes}
+        for fi in self.graph.functions:
+            sites, exits = [], set()
+            for n in self.graph.nodes_of(fi):
+                if not isinstance(n, ast.Call):
+                    continue
+                var, _viaf = resolve_target(n)
+                if var is not None:
+                    sites.append(n)
+                f = n.func
+                if isinstance(f, ast.Attribute) and f.attr == "__exit__" \
+                        and isinstance(f.value, ast.Attribute):
+                    exits.add(f.value.attr)
+            if sites:
+                self.resolve_sites[fi] = sites
+            if exits:
+                self.scope_exits[fi] = exits
+
+    # -- fixpoint ------------------------------------------------------------
+    def _fixpoint(self):
+        candidates = set(self.resolve_sites)
+        # functions constructing a future class are owners too
+        ctor_inits = {self.graph._lookup_method(ci, "__init__")
+                      for ci in self.future_classes}
+        for fi in self.graph.functions:
+            for callee, _l, _c in self.graph.callees(fi,
+                                                     kinds=(cg.CALL,)):
+                if callee in ctor_inits:
+                    candidates.add(fi)
+        pending = deque(candidates)
+        queued = set(pending)
+        rounds = 0
+        limit = max(64, 8 * (len(candidates) + 1))
+        while pending and rounds < limit:
+            rounds += 1
+            fi = pending.popleft()
+            queued.discard(fi)
+            res = _Sim(self, fi).run()
+            self.results[fi] = res
+            if res.discharged_params != self._discharges.get(
+                    fi, frozenset()):
+                self._discharges[fi] = res.discharged_params
+                for caller, _l, _c in self.graph.callers(
+                        fi, kinds=(cg.CALL,)):
+                    candidates.add(caller)
+                    if caller not in queued:
+                        pending.append(caller)
+                        queued.add(caller)
+
+    # -- queries -------------------------------------------------------------
+    def discharges_params(self, fi):
+        return self._discharges.get(fi, frozenset())
+
+    def span_attr_universe(self):
+        out = set()
+        for rec in self.future_classes.values():
+            out |= rec["scopes"]
+        return out
+
+    def stats(self):
+        return {
+            "lifecycle_future_classes": len(self.future_classes),
+            "lifecycle_resolver_functions": len(self.resolve_sites),
+            "lifecycle_simulated_functions": len(self.results),
+            "may_raise_functions": self.summ.may_raise_count(),
+        }

@@ -3,7 +3,9 @@ stable rule id to a singleton instance. Adding a rule:
 
 1. new module here with a class exposing ``id`` and ``check_source(src,
    project)`` (per-file) and/or ``check_project(project)`` (cross-file,
-   runs once after every file parsed);
+   runs once after every file parsed), plus ``fixture_basenames``
+   naming its violation/compliant fixture pair under
+   ``tests/lint_fixtures/`` (the meta-test and ``--explain`` read it);
 2. register it in ``rule_table()`` below and in
    ``core.ALL_RULE_IDS`` (report order);
 3. a seeded-violation + compliant-twin fixture pair under
@@ -21,7 +23,8 @@ def rule_table():
     if _TABLE is None:
         from . import (jit_site, dispatch_hook, lock_discipline,
                        lockset, thread_race, host_sync, trace_purity,
-                       donation, collective, registry_sync)
+                       donation, collective, future_lifecycle,
+                       resource_release, torn_state, registry_sync)
         instances = [jit_site.JitSiteRule(),
                      dispatch_hook.DispatchHookRule(),
                      lock_discipline.LockDisciplineRule(),
@@ -31,6 +34,9 @@ def rule_table():
                      trace_purity.TracePurityRule(),
                      donation.DonationRule(),
                      collective.CollectiveDisciplineRule(),
+                     future_lifecycle.FutureLifecycleRule(),
+                     resource_release.ResourceReleaseRule(),
+                     torn_state.TornStateRule(),
                      registry_sync.RegistryConsistencyRule()]
         _TABLE = {r.id: r for r in instances}
         missing = set(ALL_RULE_IDS) - set(_TABLE)

@@ -32,6 +32,7 @@ from ..collectives import ANY_CHANNEL
 
 class CollectiveDisciplineRule:
     id = "collective-discipline"
+    fixture_basenames = ("collective_violation.py", "collective_ok.py")
 
     def check_project(self, project):
         graph = project.callgraph()

@@ -18,6 +18,7 @@ _EXECUTOR_FILE = "mxnet_tpu/executor.py"
 
 class DispatchHookRule:
     id = "dispatch-hook"
+    fixture_basenames = ("dispatch_hook_violation.py", "dispatch_hook_ok.py")
 
     def check_source(self, src, project):
         if src.display.endswith(_EXECUTOR_FILE) \

@@ -133,6 +133,9 @@ def _loads_stores(stmt, kind, name):
 
 class DonationRule:
     id = "donation-safety"
+    fixture_basenames = ("donation_violation.py", "donation_ok.py",
+                         "donation_interproc_violation.py",
+                         "donation_interproc_ok.py")
 
     def check_source(self, src, project):
         # cheap PROJECT-level gate first: donation facts can only

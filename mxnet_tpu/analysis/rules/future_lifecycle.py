@@ -1,0 +1,171 @@
+"""future-lifecycle: every owned future resolves exactly once, on
+every path (mxlife family a).
+
+The chaos/postmortem lanes gate "zero hung futures" dynamically; this
+rule proves it statically, including on the exception paths the lanes
+cannot enumerate. Over the :mod:`..lifecycle` typestate model:
+
+* **strand** — a code path that OWNS a request (constructed a
+  future-bearing object, dequeued/popped one, or iterates a batch
+  parameter) and reaches a function exit — a return, an own
+  ``raise``, or the raise-edge of an in-scan callee that
+  :meth:`~..summaries.Summaries.may_raise` — with the future neither
+  resolved (``set_result``/``set_exception``) nor handed onward
+  (transfer to a container/attr/unknown callee, or a pass to an
+  in-scan callee that discharges that parameter on every path). The
+  finding names the stranding exit and, for a raise-edge, the full
+  witness chain down to the origin ``raise``.
+
+* **double-resolve** — one path resolving the same future twice,
+  unconditionally (a resolve under an ``if not v.future.done():``
+  re-check is the sanctioned idempotent form and never reports).
+
+* **resolution hygiene** — a future class that parks entered scopes
+  on itself (``self.wait_span = telemetry.span(...).__enter__()``,
+  the serving ``_Request`` shape) must have every TERMINAL resolver
+  close at least one of them: when sibling resolvers in the scan
+  pair ``v.future.set_*`` with ``v.<span>.__exit__`` and one
+  resolver closes none, the requests failing through that path leak
+  their entered spans (the flight recorder's "every entered span
+  exits" promise, and the latency percentiles, silently exclude
+  exactly the interesting requests).
+
+Deliberate fire-and-forget futures carry a justified
+``# mxlint: disable=future-lifecycle -- why`` on the owning line.
+"""
+from ..core import Finding
+from ..lifecycle import file_has_lifecycle_surface, resolve_target
+
+
+def _chain_text(summ, callee, via):
+    """' (may raise: chain...)' suffix for a raise-edge exit."""
+    chain = summ.raise_chain(callee)
+    if chain is None:
+        return ""
+    hops, line, exc = chain
+    text = "'%s'" % callee.name
+    prev = callee
+    for hop, hline in hops:
+        text += " -> %s (called at %s:%d)" % (hop.name,
+                                              prev.src.display, hline)
+        via.add(hop.src.display)
+        prev = hop
+    via.add(prev.src.display)
+    text += " raises %s at %s:%d" % (exc or "an exception",
+                                     prev.src.display, line)
+    return text
+
+
+class FutureLifecycleRule:
+    id = "future-lifecycle"
+    fixture_basenames = ("future_lifecycle_violation.py",
+                         "future_lifecycle_ok.py")
+
+    def check_project(self, project):
+        if not any(file_has_lifecycle_surface(s)
+                   for s in project.sources):
+            return []
+        model = project.lifecycle()
+        summ = project.summaries()
+        findings = []
+        for fi, res in sorted(model.results.items(),
+                              key=lambda kv: (kv[0].src.display,
+                                              kv[0].line)):
+            if res.gave_up:
+                continue
+            src = fi.src
+            seen = set()
+            for var, own_line, exit_line, why in res.strands:
+                # interest filter: the object must touch the future
+                # machinery — for a loop element, WITHIN that loop
+                # (a reused variable name in another loop of the same
+                # function earns nothing)
+                if why[0] == "loop":
+                    lo, hi = why[1], why[2]
+                    if not any(v == var and lo <= l <= hi
+                               for v, l in res.interest):
+                        continue
+                elif not any(v == var for v, _l in res.interest):
+                    continue
+                key = (var, exit_line, why[0])
+                if key in seen:
+                    continue
+                seen.add(key)
+                via = {src.display}
+                if why[0] == "call":
+                    how = ("'%s' (called at line %d) can raise — %s — "
+                           "and the exception escapes '%s'"
+                           % (why[1].name, exit_line,
+                              _chain_text(summ, why[1], via), fi.name))
+                elif why[0] == "loop":
+                    how = ("the loop iteration ending at line %d moves "
+                           "to the next element" % exit_line)
+                elif why[0] == "raise":
+                    how = "'%s' raises %s at line %d" % (
+                        fi.name, why[1], exit_line)
+                else:
+                    how = ("'%s' returns at line %s" % (
+                        fi.name, exit_line))
+                findings.append(src.finding(
+                    self.id, exit_line,
+                    "'%s' owns request '%s' (acquired at line %d) but "
+                    "this path leaves its future UNRESOLVED: %s. Every "
+                    "outgoing path must set_result/set_exception "
+                    "exactly once or hand ownership to a resolving "
+                    "callee; resolve it in an except/finally, or "
+                    "justify a deliberate fire-and-forget with "
+                    "'# mxlint: disable=future-lifecycle -- why'"
+                    % (fi.name, var, own_line, how),
+                    via=sorted(via)))
+            for var, line, first_line in res.doubles:
+                findings.append(src.finding(
+                    self.id, line,
+                    "'%s' resolves request '%s' a SECOND time here "
+                    "(first resolved at line %d) on one path — the "
+                    "second set_result/set_exception raises "
+                    "InvalidStateError at runtime; guard the late "
+                    "resolve with 'if not %s.future.done():' or "
+                    "restructure so each path resolves once"
+                    % (fi.name, var, first_line, var)))
+        findings.extend(self._check_span_hygiene(project, model))
+        findings.sort(key=lambda f: (f.path, f.line))
+        return findings
+
+    # -- resolution hygiene --------------------------------------------------
+    def _check_span_hygiene(self, project, model):
+        spans = model.span_attr_universe()
+        if not spans:
+            return []
+        # pairing evidence: some resolver in the scan closes them
+        paired = [fi for fi in model.resolve_sites
+                  if model.scope_exits.get(fi, set()) & spans]
+        if not paired:
+            return []
+        example = paired[0]
+        findings = []
+        for fi, sites in sorted(model.resolve_sites.items(),
+                                key=lambda kv: (kv[0].src.display,
+                                                kv[0].line)):
+            if fi.name == "__init__":
+                continue
+            if model.scope_exits.get(fi, set()) & spans:
+                continue
+            # only var-rooted terminal resolvers (v.future.set_*)
+            site = next((s for s in sites
+                         if resolve_target(s)[1]), None)
+            if site is None:
+                continue
+            var, _viaf = resolve_target(site)
+            findings.append(fi.src.finding(
+                self.id, site,
+                "'%s' terminally resolves '%s.future' without closing "
+                "any of the request's entered scopes (%s) — sibling "
+                "resolver '%s' (%s:%d) closes them, so requests "
+                "failing through THIS path leak their entered spans "
+                "(the recorder's every-entered-span-exits promise, "
+                "and the latency percentiles, silently exclude them); "
+                "call the span __exit__s before resolving"
+                % (fi.name, var, ", ".join(sorted(spans)),
+                   example.name, example.src.display, example.line),
+                via=sorted({fi.src.display, example.src.display})))
+        return findings

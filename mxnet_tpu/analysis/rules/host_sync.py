@@ -45,6 +45,9 @@ def _is_hot(node, src):
 
 class HostSyncRule:
     id = "host-sync"
+    fixture_basenames = ("host_sync_violation.py", "host_sync_ok.py",
+                         "host_sync_chain_violation.py",
+                         "host_sync_chain_ok.py")
 
     def _hot_functions(self, src):
         for node in ast.walk(src.tree):

@@ -53,6 +53,7 @@ def partial_jit_target(src, call, aliases):
 
 class JitSiteRule:
     id = "jit-site"
+    fixture_basenames = ("jit_site_violation.py", "jit_site_ok.py")
 
     def check_source(self, src, project):
         findings = []

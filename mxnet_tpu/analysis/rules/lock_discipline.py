@@ -131,6 +131,7 @@ class _Walker(ast.NodeVisitor):
 
 class LockDisciplineRule:
     id = "lock-discipline"
+    fixture_basenames = ("lock_discipline_violation.py", "lock_discipline_ok.py")
 
     def check_source(self, src, project):
         # cheap precondition: locks (and Condition aliases) cannot exist

@@ -64,6 +64,7 @@ def _exempt(fi):
 
 class LocksetRule:
     id = "lockset"
+    fixture_basenames = ("lockset_violation.py", "lockset_ok.py")
 
     def check_project(self, project):
         graph = project.callgraph()

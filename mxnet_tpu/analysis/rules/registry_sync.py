@@ -92,6 +92,7 @@ def _pattern_covers_prefix(pattern, prefix):
 
 class RegistryConsistencyRule:
     id = "registry-consistency"
+    fixture_basenames = ("registry_violation", "registry_ok")
 
     def check_project(self, project):
         findings = []

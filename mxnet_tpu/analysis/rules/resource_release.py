@@ -1,0 +1,362 @@
+"""resource-release: typestate pairing for locks, entered scopes,
+temp files and threads (mxlife family b).
+
+Four acquisition shapes whose release must survive the exception
+paths, checked per function over the call graph's try-region map and
+the ``may_raise`` summaries:
+
+* **bare lock acquire** — ``<known lock>.acquire()`` outside a
+  ``with``: the matching ``release()`` must sit in a ``finally``
+  (anywhere in the function); otherwise any raise between acquire
+  and release leaves the lock held forever. The fix is almost always
+  ``with lock:``.
+* **entered scope** — a LOCAL bound to ``....__enter__()`` (a
+  ``telemetry.span`` entered by hand because it crosses threads,
+  a context entered conditionally): its ``__exit__`` must either sit
+  in a ``finally`` or have no in-scan may-raise call between enter
+  and exit. A scope parked on ``self.<attr>`` escapes the frame and
+  is the ``future-lifecycle`` hygiene check's business instead.
+* **temp file** — a name bound from ``tempfile.mkstemp`` or an
+  expression carrying a ``".tmp"`` literal, later ``os.replace``/
+  ``os.rename``d (the checkpoint/index_put protocol): an
+  ``os.unlink``/``os.remove`` of it must exist in an except handler
+  or ``finally`` — a crash between create and rename must not leave
+  the artifact behind (on the shared filesystems the heartbeat tier
+  targets, leftover ``.tmp`` files are exactly what the scanner has
+  to defend against).
+* **thread join/daemon** — a LOCAL ``threading.Thread``/``Timer``
+  constructed without ``daemon=True`` and ``.start()``ed must reach
+  its ``join()`` on every path: a may-raise call between start and a
+  non-finally join leaks a non-daemon thread that blocks interpreter
+  exit. Threads stored on ``self``/returned escape to an owner with
+  its own lifecycle and are exempt.
+
+Deliberate exceptions carry a justified
+``# mxlint: disable=resource-release -- why`` on the acquisition.
+"""
+import ast
+
+from ..core import expr_text, resolve_origin
+
+_THREAD_ORIGINS = {"threading.Thread", "threading.Timer"}
+
+
+def _in_region(try_map, node, regions=("handler", "final")):
+    ctx = try_map.get(id(node), ())
+    return any(region in regions for _t, region in ctx)
+
+
+def _tmp_literal(value):
+    """Does this bound expression carry a '.tmp' string literal?"""
+    for n in ast.walk(value):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                and ".tmp" in n.value:
+            return True
+    return False
+
+
+class ResourceReleaseRule:
+    id = "resource-release"
+    fixture_basenames = ("resource_release_violation.py",
+                         "resource_release_ok.py")
+
+    def check_project(self, project):
+        graph = project.callgraph()
+        summ = project.summaries()
+        unlinkers = self._unlink_param_map(graph)
+        findings = []
+        for fi in graph.functions:
+            findings.extend(self._check_function(fi, graph, summ,
+                                                 unlinkers))
+        findings.sort(key=lambda f: (f.path, f.line))
+        return findings
+
+    def _unlink_param_map(self, graph):
+        """{FuncInfo: positions of params it os.unlink/os.remove}s —
+        an extracted quiet-unlink helper (heartbeat._unlink_quiet)
+        counts as cleanup at its call sites, same as a literal
+        unlink."""
+        from .. import summaries as _summaries
+        out = {}
+        for fi in graph.functions:
+            amap = graph.imports_of(fi.src)
+            params = _summaries.file_facts(fi.src).functions.get(
+                (fi.qualname, fi.node.lineno))
+            if params is None:
+                continue
+            params = params.params
+            positions = set()
+            for n in graph.nodes_of(fi):
+                if isinstance(n, ast.Call) and n.args \
+                        and isinstance(n.args[0], ast.Name) \
+                        and resolve_origin(n.func, amap) \
+                        in ("os.unlink", "os.remove") \
+                        and n.args[0].id in params:
+                    positions.add(params.index(n.args[0].id))
+            if positions:
+                out[fi] = positions
+        return out
+
+    # -- shared scanning -----------------------------------------------------
+    def _calls(self, graph, fi):
+        return [n for n in graph.nodes_of(fi) if isinstance(n, ast.Call)]
+
+    def _risky_lines(self, graph, summ, fi):
+        """Lines of unguarded in-scan may-raise call sites, with the
+        callee (for the witness)."""
+        facts = summ.facts_of(fi)
+        out = []
+        for callee, line, col in graph.callees(fi):
+            if (line, col) in facts.guarded_calls:
+                continue
+            if summ.may_raise(callee):
+                out.append((line, callee))
+        return out
+
+    def _check_function(self, fi, graph, summ, unlinkers):
+        src = fi.src
+        calls = self._calls(graph, fi)
+        try_map = graph.try_map_of(fi)
+        findings = []
+        findings.extend(self._check_locks(fi, src, calls, try_map,
+                                          summ))
+        findings.extend(self._check_scopes(fi, src, graph, summ, calls,
+                                           try_map))
+        findings.extend(self._check_tmp_files(fi, src, graph, calls,
+                                              try_map, unlinkers))
+        findings.extend(self._check_threads(fi, src, graph, summ,
+                                            calls, try_map))
+        return findings
+
+    # -- (a) bare lock acquire ----------------------------------------------
+    def _check_locks(self, fi, src, calls, try_map, summ):
+        known, canonical = summ.file_locks(src)
+        if not known:
+            return []
+        acquires, releases = [], []
+        for c in calls:
+            f = c.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            recv = expr_text(f.value)
+            recv = canonical.get(recv, recv)
+            if recv not in known:
+                continue
+            if f.attr == "acquire":
+                acquires.append((c, recv))
+            elif f.attr == "release":
+                releases.append((c, recv))
+        out = []
+        for c, recv in acquires:
+            ok = any(r == recv and _in_region(try_map, rc, ("final",))
+                     for rc, r in releases)
+            if not ok:
+                out.append(src.finding(
+                    self.id, c,
+                    "'%s' acquires %s outside a 'with' and no "
+                    "finally-guarded %s.release() exists — any raise "
+                    "between acquire and release leaves the lock held "
+                    "forever (every later taker deadlocks); use "
+                    "'with %s:' (or release in a finally)"
+                    % (fi.name, recv, recv, recv)))
+        return out
+
+    # -- (b) entered scopes --------------------------------------------------
+    def _check_scopes(self, fi, src, graph, summ, calls, try_map):
+        enters = {}                     # var -> enter Call node
+        exits = {}                      # var -> [exit Call nodes]
+        escapes = set()
+        for n in graph.nodes_of(fi):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name) \
+                    and isinstance(n.value, ast.Call) \
+                    and isinstance(n.value.func, ast.Attribute) \
+                    and n.value.func.attr == "__enter__":
+                enters.setdefault(n.targets[0].id, n.value)
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "__exit__" \
+                    and isinstance(n.func.value, ast.Name):
+                exits.setdefault(n.func.value.id, []).append(n)
+        if not enters:
+            return []
+        # escapes: the name stored beyond the frame or passed onward
+        for n in graph.nodes_of(fi):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)) \
+                            and isinstance(n.value, ast.Name):
+                        escapes.add(n.value.id)
+            elif isinstance(n, ast.Return) \
+                    and isinstance(n.value, ast.Name):
+                escapes.add(n.value.id)
+            elif isinstance(n, ast.Call):
+                for a in list(n.args) + [kw.value for kw in n.keywords]:
+                    if isinstance(a, ast.Name):
+                        escapes.add(a.id)
+        risky = self._risky_lines(graph, summ, fi)
+        out = []
+        for var, enter in sorted(enters.items()):
+            var_exits = exits.get(var, [])
+            if not var_exits:
+                if var in escapes:
+                    continue
+                out.append(src.finding(
+                    self.id, enter,
+                    "'%s' enters a scope into '%s' via __enter__ and "
+                    "never exits it on any path — the span/context "
+                    "stays open forever; pair it with a "
+                    "finally-guarded %s.__exit__ (or use 'with')"
+                    % (fi.name, var, var)))
+                continue
+            if any(_in_region(try_map, x, ("final",))
+                   for x in var_exits):
+                continue
+            first_exit = min(x.lineno for x in var_exits)
+            hit = next((rc for rc in risky
+                        if enter.lineno < rc[0] < first_exit), None)
+            if hit is not None:
+                out.append(src.finding(
+                    self.id, enter,
+                    "'%s' enters a scope into '%s' at line %d but "
+                    "'%s' (line %d) can raise before the __exit__ at "
+                    "line %d and no finally guards it — the scope "
+                    "leaks on the exception path; move the exit into "
+                    "a finally (or use 'with')"
+                    % (fi.name, var, enter.lineno, hit[1].name, hit[0],
+                       first_exit)))
+        return out
+
+    # -- (c) temp files ------------------------------------------------------
+    def _check_tmp_files(self, fi, src, graph, calls, try_map,
+                         unlinkers):
+        amap = graph.imports_of(src)
+        tmp_vars = {}                   # var -> binding node
+        for n in graph.nodes_of(fi):
+            if not isinstance(n, ast.Assign) or len(n.targets) != 1:
+                continue
+            t, v = n.targets[0], n.value
+            if isinstance(t, ast.Tuple) and len(t.elts) == 2 \
+                    and isinstance(t.elts[1], ast.Name) \
+                    and isinstance(v, ast.Call) \
+                    and resolve_origin(v.func, amap) \
+                    == "tempfile.mkstemp":
+                tmp_vars.setdefault(t.elts[1].id, n)
+            elif isinstance(t, ast.Name) and not isinstance(v, ast.Call) \
+                    and _tmp_literal(v):
+                tmp_vars.setdefault(t.id, n)
+        if not tmp_vars:
+            return []
+        edges = {(line, col): callee for callee, line, col
+                 in graph.callees(fi)}
+        renamed, cleaned = set(), set()
+        for c in calls:
+            origin = resolve_origin(c.func, amap)
+            first = c.args[0] if c.args else None
+            if not isinstance(first, ast.Name):
+                continue
+            if origin in ("os.replace", "os.rename"):
+                renamed.add(first.id)
+                continue
+            if not _in_region(try_map, c, ("handler", "final")):
+                continue
+            if origin in ("os.unlink", "os.remove"):
+                cleaned.add(first.id)
+                continue
+            # an in-scan cleanup HELPER counts too: the call sits in a
+            # handler/finally and the callee unlinks the position the
+            # tmp name rides in (heartbeat._unlink_quiet)
+            callee = edges.get((c.lineno, c.col_offset))
+            if callee is not None and 0 in unlinkers.get(callee, ()):
+                cleaned.add(first.id)
+        out = []
+        for var, node in sorted(tmp_vars.items()):
+            if var not in renamed or var in cleaned:
+                continue
+            out.append(src.finding(
+                self.id, node,
+                "'%s' creates temp file '%s' and renames it into "
+                "place, but no except/finally unlinks it on failure — "
+                "a raise between create and rename leaves the .tmp "
+                "artifact behind (the atomic-write protocol "
+                "checkpoint.atomic_write follows: write tmp, fsync, "
+                "replace, unlink-on-failure); add 'os.unlink(%s)' to "
+                "the failure path" % (fi.name, var, var)))
+        return out
+
+    # -- (d) threads ---------------------------------------------------------
+    def _check_threads(self, fi, src, graph, summ, calls, try_map):
+        amap = graph.imports_of(src)
+        threads = {}                    # var -> (ctor node, daemon)
+        for n in graph.nodes_of(fi):
+            if not (isinstance(n, ast.Assign) and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)
+                    and isinstance(n.value, ast.Call)):
+                continue
+            if resolve_origin(n.value.func, amap) not in _THREAD_ORIGINS:
+                continue
+            daemon = any(
+                kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+                for kw in n.value.keywords)
+            threads[n.targets[0].id] = (n, daemon)
+        if not threads:
+            return []
+        escapes, started, joined, daemonized = set(), {}, {}, set()
+        for n in graph.nodes_of(fi):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)) \
+                            and isinstance(n.value, ast.Name):
+                        escapes.add(n.value.id)
+                    if isinstance(t, ast.Attribute) \
+                            and t.attr == "daemon" \
+                            and isinstance(t.value, ast.Name):
+                        daemonized.add(t.value.id)
+            elif isinstance(n, ast.Return) \
+                    and isinstance(n.value, ast.Name):
+                escapes.add(n.value.id)
+            elif isinstance(n, ast.Call):
+                f = n.func
+                if isinstance(f, ast.Attribute) \
+                        and isinstance(f.value, ast.Name):
+                    if f.attr == "start":
+                        started.setdefault(f.value.id, n)
+                    elif f.attr == "join":
+                        joined.setdefault(f.value.id, n)
+                for a in list(n.args) + [kw.value for kw in n.keywords]:
+                    if isinstance(a, ast.Name):
+                        escapes.add(a.id)
+        risky = self._risky_lines(graph, summ, fi)
+        out = []
+        for var, (node, daemon) in sorted(threads.items()):
+            if daemon or var in daemonized or var not in started:
+                continue
+            start = started[var]
+            join = joined.get(var)
+            if join is None:
+                if var in escapes:
+                    continue
+                out.append(src.finding(
+                    self.id, start,
+                    "'%s' starts non-daemon thread '%s' and neither "
+                    "joins it nor marks it daemon — a raise after "
+                    "start() leaks a thread that blocks interpreter "
+                    "exit; join it in a finally, pass daemon=True, or "
+                    "hand it to an owner" % (fi.name, var)))
+                continue
+            if _in_region(try_map, join, ("final",)):
+                continue
+            hit = next((rc for rc in risky
+                        if start.lineno < rc[0] < join.lineno), None)
+            if hit is not None:
+                out.append(src.finding(
+                    self.id, start,
+                    "'%s' starts non-daemon thread '%s' at line %d, "
+                    "but '%s' (line %d) can raise before the join at "
+                    "line %d and no finally guards it — the "
+                    "exception path leaks the thread; join in a "
+                    "finally or pass daemon=True"
+                    % (fi.name, var, start.lineno, hit[1].name, hit[0],
+                       join.lineno)))
+        return out

@@ -73,6 +73,7 @@ def _annotated_globals(src):
 
 class ThreadRaceRule:
     id = "thread-race"
+    fixture_basenames = ("thread_race_violation.py", "thread_race_ok.py")
 
     def check_project(self, project):
         graph = project.callgraph()

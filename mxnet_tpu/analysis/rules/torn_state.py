@@ -1,0 +1,190 @@
+"""torn-state-on-raise: a mutation whose restore only runs on the
+fall-through path (mxlife family c).
+
+The bug class behind several past review fixes (queue-depth
+accounting, breaker counters): a ``self.<attr>`` (or a subscript
+through one) is written, an in-scan callee that
+:meth:`~..summaries.Summaries.may_raise` runs, and the
+restoring/second write to the SAME target sits later in the same
+suite with NO enclosing try — an exception between the two writes
+tears the state (the counter stays bumped, the flag stays set) and
+every later reader sees the torn value.
+
+Shape matched, deliberately narrow (conservative-quiet):
+
+* first write and restoring write target the same ``self``-rooted
+  expression text, at the SAME suite level (``self._depth += 1``
+  ... ``self._depth -= 1`` is the canonical instance);
+* the risky call between them is an UNGUARDED in-scan may-raise
+  site with no enclosing try at all — any try (a handler might
+  restore, a finally might) silences the finding rather than
+  reasoning about what the handler does;
+* constructors are exempt (construction happens-before
+  publication), as are targets whose two writes straddle suite
+  levels (the restore-on-one-branch shape is legitimate
+  state-machine code too often to report on).
+
+The finding anchors at the FIRST write and carries the raise
+witness chain. Fix with try/finally (restore in the finally), or
+justify a deliberate tear with
+``# mxlint: disable=torn-state-on-raise -- why``.
+"""
+import ast
+
+from ..core import expr_text
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+_CTOR_NAMES = ("__init__", "__new__", "__setstate__")
+
+
+def _self_target_text(node):
+    """Canonical text of a self-rooted store target (attribute or
+    subscript-through-attribute), or None."""
+    base = node
+    while isinstance(base, (ast.Attribute, ast.Subscript)):
+        base = base.value
+    if not (isinstance(base, ast.Name) and base.id == "self"):
+        return None
+    return expr_text(node)
+
+
+class TornStateRule:
+    id = "torn-state-on-raise"
+    fixture_basenames = ("torn_state_violation.py",
+                         "torn_state_ok.py")
+
+    def check_project(self, project):
+        graph = project.callgraph()
+        summ = project.summaries()
+        findings = []
+        for fi in graph.functions:
+            if fi.name in _CTOR_NAMES:
+                continue
+            findings.extend(self._check_function(fi, graph, summ))
+        findings.sort(key=lambda f: (f.path, f.line))
+        return findings
+
+    def _check_function(self, fi, graph, summ):
+        # unguarded may-raise call sites with NO enclosing try at all
+        facts = summ.facts_of(fi)
+        try_map = graph.try_map_of(fi)
+        edges = [(callee, line, col) for callee, line, col
+                 in graph.callees(fi)
+                 if (line, col) not in facts.guarded_calls
+                 and summ.may_raise(callee)]
+        if not edges:
+            return []
+        call_index = {(n.lineno, n.col_offset): n
+                      for n in graph.nodes_of(fi)
+                      if isinstance(n, ast.Call)}
+        risky = []
+        for callee, line, col in edges:
+            node = call_index.get((line, col))
+            if node is None or try_map.get(id(node), ()):
+                continue
+            risky.append((line, callee))
+        if not risky:
+            return []
+        findings = []
+        for suite in self._suites(fi.node):
+            findings.extend(self._check_suite(fi, suite, risky, summ))
+        return findings
+
+    def _suites(self, func_node):
+        """Every statement list at any nesting level of the function's
+        own scope (nested defs excluded — their bodies are their own
+        functions)."""
+        out = [func_node.body]
+        stack = list(func_node.body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, _FUNC_NODES + (ast.ClassDef,)):
+                continue
+            for field in ("body", "orelse", "finalbody"):
+                suite = getattr(n, field, None)
+                if isinstance(suite, list) and suite \
+                        and isinstance(suite[0], ast.stmt):
+                    out.append(suite)
+                    stack.extend(suite)
+            for h in getattr(n, "handlers", ()):
+                out.append(h.body)
+                stack.extend(h.body)
+        return out
+
+    def _stores_in(self, stmt):
+        """(target text, value-is-a-constant) per self-rooted store of
+        a DIRECT statement (not descending into nested suites)."""
+        out = []
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AugAssign):
+            targets, value = [stmt.target], None    # a mutation, never
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        else:
+            return out
+        is_const = isinstance(value, ast.Constant)
+        for t in targets:
+            text = _self_target_text(t)
+            if text is not None:
+                out.append((text, is_const))
+        return out
+
+    def _check_suite(self, fi, suite, risky, summ):
+        # per target: first-write index -> later risky stmt -> restore
+        writes = []                     # (idx, line, target, is_const)
+        for idx, stmt in enumerate(suite):
+            for text, is_const in self._stores_in(stmt):
+                writes.append((idx, stmt.lineno, text, is_const))
+        if len(writes) < 2:
+            return []
+        findings = []
+        reported = set()
+        for i, (wi, wline, wtext, wconst) in enumerate(writes):
+            if wtext in reported:
+                continue
+            restore = next(
+                ((ri, rline, rconst) for ri, rline, rtext, rconst
+                 in writes[i + 1:] if rtext == wtext and ri > wi), None)
+            if restore is None:
+                continue
+            if wconst and not restore[2]:
+                # initialize-to-constant then publish-a-computed-value:
+                # the exception leaves the value the function CHOSE as
+                # its reset state (the kvstore wire-byte idiom), not a
+                # torn one. set-flag/restore-flag (const/const) and
+                # bump/unbump (aug/aug) pairs still report.
+                continue
+            lo = suite[wi].lineno
+            hi = suite[restore[0]].lineno
+            hit = next(((line, callee) for line, callee in risky
+                        if lo < line < hi), None)
+            if hit is None:
+                continue
+            reported.add(wtext)
+            line, callee = hit
+            chain = summ.raise_chain(callee)
+            why = "'%s'" % callee.name
+            via = {fi.src.display, callee.src.display}
+            if chain is not None:
+                hops, rline, exc = chain
+                prev = callee
+                for hop, hline in hops:
+                    why += " -> %s (called at %s:%d)" % (
+                        hop.name, prev.src.display, hline)
+                    via.add(hop.src.display)
+                    prev = hop
+                why += ", which raises %s at %s:%d" % (
+                    exc or "an exception", prev.src.display, rline)
+            findings.append(fi.src.finding(
+                self.id, suite[wi],
+                "'%s' mutates %s here, then calls %s (line %d) with "
+                "no enclosing try, and only restores %s on the "
+                "fall-through path (line %d) — an exception between "
+                "the two writes tears the state for every later "
+                "reader; wrap the call in try/finally and restore in "
+                "the finally, or justify with "
+                "'# mxlint: disable=torn-state-on-raise -- why'"
+                % (fi.name, wtext, why, line, wtext, restore[1]),
+                via=sorted(via)))
+        return findings

@@ -64,6 +64,7 @@ def _module_scope_calls(tree):
 
 class TracePurityRule:
     id = "trace-purity"
+    fixture_basenames = ("trace_purity_violation.py", "trace_purity_ok.py")
 
     def _roots(self, project, graph):
         """[(FuncInfo, root description, registration file)] — every

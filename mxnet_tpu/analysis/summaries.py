@@ -24,7 +24,14 @@ pin the behaviour). Per function:
   lexically held at it, and the lockset held at every call site (the
   RacerD-style lockset rule's raw material);
 * donation plumbing: literal ``donate_argnums`` jit calls, local
-  names bound to them, call-through-name sites, return-value flow.
+  names bound to them, call-through-name sites, return-value flow;
+* exception flow (the mxlife raw material): ``raise`` statements not
+  swallowed by an enclosing try-with-handlers, and the set of call
+  sites whose exceptions ARE swallowed (``guarded_calls``) — a try
+  with ANY except handler is treated as guarding its try body
+  (conservative-quiet: a typed handler that would miss the callee's
+  class never fabricates a finding); handler/else/finally bodies are
+  NOT guarded by their own try.
 
 **Transitive layer** (:class:`Summaries`) — graph-dependent, computed
 per run over the :mod:`~.callgraph` with worklist/BFS fixpoints (so
@@ -48,7 +55,14 @@ callers):
   recognized as a donating call with no marker;
 * ``donated_sites(fn)`` — every call site in ``fn`` with inferred
   donated positions, in call-site positional terms (bound-method
-  shifts applied) — the donation rule's interprocedural feed.
+  shifts applied) — the donation rule's interprocedural feed;
+* ``may_raise(fn)`` / ``raise_chain(fn)`` — whether an exception can
+  escape ``fn`` (an unguarded own ``raise``, or an unguarded call
+  site reaching a may-raise callee, transitively over ``call`` edges
+  via one reverse-BFS propagation), with the witness chain down to
+  the origin ``raise``. ONLY in-scan raises seed it — an external
+  callee (stdlib, jax) never fabricates a raise edge, the same
+  certainty contract as the call graph's.
 """
 from __future__ import annotations
 
@@ -155,7 +169,7 @@ class FunctionFacts:
         "jit_call_donates", "marker_donates", "calls_by_name",
         "name_bindings", "call_args", "call_form", "call_recv",
         "return_call_sites", "return_names", "local_jit_names",
-        "global_accesses",
+        "global_accesses", "raises", "guarded_calls",
     )
 
     def __init__(self, qualname, params):
@@ -185,6 +199,11 @@ class FunctionFacts:
         # store, mutating method call), loads are plain reads; local
         # shadowing resolved away (mxsync's thread-race raw material)
         self.global_accesses = []
+        # exception flow (mxlife): raise statements an enclosing
+        # try-with-handlers does NOT swallow [(line, exc text)], and
+        # the call sites whose exceptions ARE swallowed {(line, col)}
+        self.raises = []
+        self.guarded_calls = set()
 
     def impure_facts(self):
         """[(kind, line, desc)] of everything trace-purity cares
@@ -222,6 +241,7 @@ class _FactsWalker(ast.NodeVisitor):
         self.scope_names = []
         self.stack = []                 # FunctionFacts of enclosing defs
         self.withs = []                 # canonical lock texts held
+        self._guard = []                # per-frame try-with-handlers depth
         self.np_names = {n for n, o in amap.items() if o == "numpy"}
         self.asarray_names = {n for n, o in amap.items()
                               if o == "numpy.asarray"}
@@ -281,8 +301,13 @@ class _FactsWalker(ast.NodeVisitor):
         self._pending.append([])
         self._gpending.append([])
         held, self.withs = self.withs, []         # body runs later
+        # the body's exception flow is its OWN: a nested def inside a
+        # try body raises at CALL time, to its callers, not into the
+        # lexical try it was defined under
+        self._guard.append(0)
         for stmt in node.body:
             self.visit(stmt)
+        self._guard.pop()
         self.withs = held
         # resolve provisional (locality-dependent) mutations now that
         # every local binding in the body has been seen
@@ -345,6 +370,36 @@ class _FactsWalker(ast.NodeVisitor):
         del self.withs[len(self.withs) - len(held):]
 
     visit_AsyncWith = visit_With
+
+    # -- exception flow ------------------------------------------------------
+    def visit_Try(self, node):
+        # ONLY the try body is guarded by the handlers; the handler
+        # bodies, else and finally propagate to whatever encloses THEM
+        guarded = bool(node.handlers) and bool(self._guard)
+        if guarded:
+            self._guard[-1] += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if guarded:
+            self._guard[-1] -= 1
+        for h in node.handlers:
+            self.visit(h)
+        for stmt in node.orelse:
+            self.visit(stmt)
+        for stmt in node.finalbody:
+            self.visit(stmt)
+
+    visit_TryStar = visit_Try
+
+    def visit_Raise(self, node):
+        if self.stack and not (self._guard and self._guard[-1]):
+            exc = node.exc
+            text = "re-raise"
+            if exc is not None:
+                f = exc.func if isinstance(exc, ast.Call) else exc
+                text = expr_text(f) or "re-raise"
+            self.stack[-1].raises.append((node.lineno, text))
+        self.generic_visit(node)
 
     # -- name/attr bookkeeping ----------------------------------------------
     def visit_Global(self, node):
@@ -512,6 +567,8 @@ class _FactsWalker(ast.NodeVisitor):
         facts = self.stack[-1]
         key = (node.lineno, node.col_offset)
         facts.calls_held[key] = frozenset(self.withs)
+        if self._guard and self._guard[-1]:
+            facts.guarded_calls.add(key)
         f = node.func
         # arg descriptors (donation inference)
         descs = []
@@ -733,6 +790,7 @@ class Summaries:
             self._facts[fi] = ff if ff is not None else self._empty
         self._sync_wit = {}             # FuncInfo -> witness list
         self._entry_cache = {}          # threads.entry_locksets memo
+        self._may_raise = None          # FuncInfo -> origin record
         self._donates = None            # FuncInfo -> set(param idx)
         self._returns_donating = None   # FuncInfo -> indices or None
         self._donated_sites = None      # FuncInfo -> {(line,col): indices}
@@ -797,6 +855,65 @@ class Summaries:
                         [(line, form) for line, _col, form in syncs]))
         self._sync_wit[fi] = out
         return out
+
+    # -- exception flow (may_raise) ------------------------------------------
+    def _ensure_may_raise(self):
+        """One reverse-BFS propagation: functions with an unguarded own
+        ``raise`` seed the set; a caller joins when SOME call site to a
+        may-raise callee is unguarded (a caller whose every such site
+        sits in a try-with-handlers stays out). Each member remembers
+        ONE origin hop so :meth:`raise_chain` can reconstruct a real
+        witness path lazily."""
+        if self._may_raise is not None:
+            return
+        self._may_raise = {}
+        queue = deque()
+        for fi in self.graph.functions:
+            facts = self.facts_of(fi)
+            if facts.raises:
+                line, exc = facts.raises[0]
+                self._may_raise[fi] = ("own", line, exc)
+                queue.append(fi)
+        while queue:
+            callee = queue.popleft()
+            for caller, line, col in self.graph.callers(
+                    callee, kinds=(cg.CALL,)):
+                if caller in self._may_raise:
+                    continue
+                if (line, col) in self.facts_of(caller).guarded_calls:
+                    continue
+                self._may_raise[caller] = ("call", line, callee)
+                queue.append(caller)
+
+    def may_raise(self, fi):
+        """True when an exception can escape ``fi`` (own unguarded
+        raise, or transitively through an unguarded call site)."""
+        self._ensure_may_raise()
+        return fi in self._may_raise
+
+    def raise_chain(self, fi):
+        """Witness down to the origin raise:
+        ``([(callee FuncInfo, call line in the CALLER's file), ...],
+        origin_line, exc_text)`` — the hop list is empty when ``fi``
+        itself raises. None when ``fi`` cannot raise."""
+        self._ensure_may_raise()
+        rec = self._may_raise.get(fi)
+        if rec is None:
+            return None
+        hops = []
+        seen = {fi}
+        while rec[0] == "call":
+            _kind, line, callee = rec
+            hops.append((callee, line))
+            if callee in seen:          # SCC safety: cut the cycle
+                return (hops, rec[1], "re-raise")
+            seen.add(callee)
+            rec = self._may_raise[callee]
+        return (hops, rec[1], rec[2])
+
+    def may_raise_count(self):
+        self._ensure_may_raise()
+        return len(self._may_raise)
 
     # -- donation fixpoints --------------------------------------------------
     def _edges_of(self, fi):

@@ -110,6 +110,17 @@ def _timeout(timeout):
     return float(os.environ.get(ENV_TIMEOUT, DEFAULT_TIMEOUT))
 
 
+def _unlink_quiet(path):
+    """Best-effort removal of a temp artifact on a failure path — the
+    shared unlink-on-failure half of the write-tmp/fsync/rename
+    protocol (mxlife resource-release: a failed rename must not leave
+    ``.tmp`` litter on the shared mount peers scan forever)."""
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
 def _fs_now(root):
     """The shared directory's OWN notion of "now": the mtime of a probe
     file this process just wrote there. Comparing worker-file mtimes
@@ -125,6 +136,10 @@ def _fs_now(root):
         os.replace(tmp, probe)
         return os.path.getmtime(probe)
     except OSError:
+        # a failed rename must not leave the probe's .tmp behind on
+        # the shared mount — leftover artifacts are exactly what the
+        # scanner has to defend against (mxlife resource-release)
+        _unlink_quiet(tmp)
         return time.time()
 
 
@@ -156,7 +171,11 @@ def start_heartbeat(rank, root=None, interval=None):
                     f.write(str(time.time()))
                 os.replace(tmp, path)
             except OSError:
-                pass
+                # a beat that failed between create and rename must
+                # not leave its .tmp behind: a worker that then DIES
+                # would leak the artifact onto the shared mount
+                # forever (stop_heartbeat only cleans a clean stop)
+                _unlink_quiet(tmp)
             stop.wait(interval)
 
     t = threading.Thread(target=beat, daemon=True,
@@ -322,9 +341,16 @@ class CollectiveGate:
         os.makedirs(self._dir, exist_ok=True)
         path = self._member_path(self.rank)
         tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(str(int(gen)))
-        os.replace(tmp, path)
+        try:
+            with open(tmp, "w") as f:
+                f.write(str(int(gen)))
+            os.replace(tmp, path)
+        except BaseException:
+            # gate-publish failure is fatal to the crossing (the
+            # caller raises), but the .tmp must not linger on the
+            # shared mount — peers scan this directory forever
+            _unlink_quiet(tmp)
+            raise
 
     def _peer_gen(self, rank):
         try:

@@ -196,7 +196,14 @@ class Scope:
 
     def __enter__(self):
         self._span.__enter__()
-        self._ann.__enter__()
+        try:
+            self._ann.__enter__()
+        except BaseException:
+            # the device annotation failing to arm (profiler state,
+            # backend teardown) must not leave the host span entered
+            # forever — every entered span exits (mxlife)
+            self._span.__exit__(None, None, None)
+            raise
         return self
 
     def __exit__(self, *exc):

@@ -389,6 +389,12 @@ class InferenceEngine:
         self._q = queue.Queue()
         self._inflight = threading.Semaphore(self._max_inflight)
         self._closed = False             # guarded by: self._lock
+        # close() ran to completion (joined + pool down + corpus
+        # flushed). Distinct from _closed: a coalescer death closes
+        # the ENGINE (submits fast-fail) but the first close() call
+        # must still shut the pool down and flush — only a completed
+        # close() makes later calls no-ops.
+        self._close_done = False         # guarded by: self._lock
         self._pool = ThreadPoolExecutor(
             max_workers=self._max_inflight,
             thread_name_prefix="mxtpu-serve-resolve")
@@ -841,15 +847,19 @@ class InferenceEngine:
         blocked on a full queue (overload="block") are woken and fail
         the same way."""
         with self._space:
-            if self._closed:
-                already = True
-            else:
-                already = False
+            already = self._close_done
+            self._close_done = True
+            if not self._closed:
                 self._closed = True
                 self._q.put(_SHUTDOWN)
                 self._space.notify_all()
         if already:
             return
+        # after a coalescer death the thread is already dead (join
+        # returns immediately) and the queue is drained — but the
+        # pool shutdown below still waits out in-flight resolves, and
+        # the corpus/logger flush still runs: the first close() call
+        # keeps its full contract either way
         self._thread.join()
         self._pool.shutdown(wait=True)
         # bank this engine's measured serving data into the persisted
@@ -882,23 +892,32 @@ class InferenceEngine:
         """Release a coalesced batch from the admission queue, shed the
         stale members (their deadline passed while they waited — they
         must not pad a bucket and burn device time on an answer nobody
-        reads), and dispatch the survivors."""
+        reads), and dispatch the survivors. On an unexpected raise the
+        released rows are RE-CHARGED before propagating: the caller
+        hands the batch back to the coalescer's terminal cleanup,
+        whose uniform decrement must not double-count (a negative
+        queued_rows would corrupt the postmortem's engine snapshot)."""
         with self._space:
             self._queued_rows -= sum(r.rows for r in batch)
             self._space.notify_all()
-        now = time.monotonic()
-        live = []
-        for r in batch:
-            if r.expired(now):
-                self._shed(r, "coalesce", DeadlineExceeded(
-                    "serving: request deadline expired in queue "
-                    "(waited past %.1fms)" % (
-                        0.0 if r.deadline is None
-                        else (now - r.deadline) * 1e3)))
-            else:
-                live.append(r)
-        if live:
-            self._dispatch(live)
+        try:
+            now = time.monotonic()
+            live = []
+            for r in batch:
+                if r.expired(now):
+                    self._shed(r, "coalesce", DeadlineExceeded(
+                        "serving: request deadline expired in queue "
+                        "(waited past %.1fms)" % (
+                            0.0 if r.deadline is None
+                            else (now - r.deadline) * 1e3)))
+                else:
+                    live.append(r)
+            if live:
+                self._dispatch(live)
+        except BaseException:
+            with self._space:
+                self._queued_rows += sum(r.rows for r in batch)
+            raise
 
     def _coalesce_loop(self):   # mxlint: hot
         pending, pending_rows = [], 0
@@ -910,33 +929,95 @@ class InferenceEngine:
                 batch, pending = pending, []
                 pending_rows = 0
                 deadline = None
-                self._launch(batch)
-
-        while True:
-            if pending:
                 try:
-                    item = self._q.get(
-                        timeout=max(0.0, deadline - time.monotonic()))
-                except queue.Empty:
-                    dispatch()        # deadline flush under trickle load
+                    self._launch(batch)
+                except BaseException:
+                    # hand the dying batch back so the coalescer's
+                    # terminal cleanup can fail its futures — swapped
+                    # out above, it would otherwise be unreachable
+                    # (_launch re-charges the rows it had released,
+                    # so the cleanup's uniform decrement stays exact)
+                    pending = batch + pending
+                    raise
+
+        try:
+            while True:
+                if pending:
+                    try:
+                        item = self._q.get(
+                            timeout=max(0.0,
+                                        deadline - time.monotonic()))
+                    except queue.Empty:
+                        dispatch()    # deadline flush under trickle load
+                        continue
+                else:
+                    item = self._q.get()
+                if item is _SHUTDOWN:
+                    dispatch()
+                    self._drain_after_shutdown()
+                    break
+                if item is _FLUSH:
+                    dispatch()
                     continue
-            else:
-                item = self._q.get()
-            if item is _SHUTDOWN:
-                dispatch()
-                self._drain_after_shutdown()
+                if pending_rows + item.rows > self.max_batch:
+                    try:
+                        dispatch()    # the new request doesn't fit
+                    except BaseException:
+                        # the dequeued item is in neither pending nor
+                        # the queue yet — hand it to the terminal
+                        # cleanup with the restored batch, or its
+                        # future strands
+                        pending.append(item)
+                        raise
+                pending.append(item)
+                pending_rows += item.rows
+                if deadline is None:
+                    deadline = time.monotonic() + self.max_wait_s
+                if pending_rows >= self.max_batch:
+                    dispatch()
+        except BaseException as e:
+            # the coalescer is the ONLY consumer of the admission
+            # queue: if it dies, every queued/pending future hangs
+            # forever. Fail them all instead (the zero-hung-futures
+            # promise the mxlife audit checks path-by-path), close the
+            # engine so later submits fast-fail rather than queue into
+            # a dead queue, and leave the black box — then re-raise so
+            # threading.excepthook still sees the death.
+            self._coalescer_died(pending, e)
+            raise
+
+    def _coalescer_died(self, pending, exc):
+        """Terminal cleanup for a dying coalescer thread (see above):
+        every pending + still-queued request resolves with a
+        structured error, blocked submitters wake into EngineClosed,
+        and a postmortem names the count."""
+        # close FIRST, under the same lock submit() enqueues under:
+        # a request admitted after the drain below would sit in a
+        # dead queue forever — the hung future this cleanup exists
+        # to prevent
+        with self._space:
+            self._closed = True
+            self._space.notify_all()
+        left = list(pending)
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
                 break
-            if item is _FLUSH:
-                dispatch()
-                continue
-            if pending_rows + item.rows > self.max_batch:
-                dispatch()            # the new request doesn't fit
-            pending.append(item)
-            pending_rows += item.rows
-            if deadline is None:
-                deadline = time.monotonic() + self.max_wait_s
-            if pending_rows >= self.max_batch:
-                dispatch()
+            if item is not _SHUTDOWN and item is not _FLUSH:
+                left.append(item)
+        with self._space:
+            self._queued_rows -= sum(r.rows for r in left)
+            self._space.notify_all()
+        err = MXNetError(
+            "serving: coalescer thread died (%s: %s) — the engine is "
+            "closed and this request was never dispatched"
+            % (type(exc).__name__, exc))
+        for r in left:
+            self._shed(r, "coalescer_death", err)
+        flight.postmortem("coalescer_death", exc=exc,
+                          extra={"engine": self.overload_state(),
+                                 "failed_requests": len(left)})
 
     def _drain_after_shutdown(self):
         """Backstop: submit() enqueues under the same lock close() uses
@@ -957,7 +1038,15 @@ class InferenceEngine:
                 r = left.pop(0)
                 batch.append(r)
                 rows += r.rows
-            self._launch(batch)
+            try:
+                self._launch(batch)
+            except BaseException:
+                # hand everything not yet launched back through the
+                # queue the coalescer's terminal cleanup drains — a
+                # drain-time failure must not strand the rest
+                for r in batch + left:
+                    self._q.put(r)
+                raise
 
     # -- breaker ------------------------------------------------------------
     def _breaker_tripped(self):
@@ -986,6 +1075,16 @@ class InferenceEngine:
         failed = 0
         for r in reqs:
             if not r.future.done():
+                # the spans entered at admission close on EVERY
+                # terminal path — without this, a failed batch's
+                # serve_request spans never recorded, so the latency
+                # percentiles and the flight recorder silently
+                # excluded exactly the interesting requests (mxlife
+                # future-lifecycle). _Span.__exit__ is idempotent, so
+                # the launch-failure leg (where _dispatch already
+                # closed wait_span) double-exits harmlessly.
+                r.wait_span.__exit__(None, None, None)
+                r.req_span.__exit__(None, None, None)
                 r.future.set_exception(exc)
                 failed += 1
         if failed:

@@ -1,0 +1,59 @@
+"""Compliant twin of future_lifecycle_violation.py: the exception
+path resolves in the handler, a sentinel-checked dequeue is not a
+request, transfer to a resolving callee discharges, the done-guard
+makes late resolution idempotent, and every terminal resolver closes
+the entered spans. Parsed, never imported."""
+from concurrent.futures import Future
+
+from mxnet_tpu import telemetry
+
+_STOP = object()
+
+
+class Request:
+    def __init__(self, rows):
+        self.rows = rows
+        self.future = Future()
+        self.span = telemetry.span("serve_request").__enter__()
+
+
+def risky(batch):
+    if not batch:
+        raise ValueError("empty batch")
+    return len(batch)
+
+
+def worker(q, out):
+    req = q.get()
+    try:
+        n = risky(out)
+    except Exception as e:
+        req.span.__exit__(None, None, None)
+        req.future.set_exception(e)
+        return
+    req.span.__exit__(None, None, None)
+    req.future.set_result(n)
+
+
+def drain(q, out):
+    item = q.get()
+    if item is _STOP:
+        return
+    out.append(item)
+
+
+def launch(batch):
+    live = []
+    for r in batch:
+        if r.rows:
+            shed(r, ValueError("stale"))
+        else:
+            live.append(r)
+    return live
+
+
+def shed(req, exc):
+    if req.future.done():
+        return
+    req.span.__exit__(None, None, None)
+    req.future.set_exception(exc)

@@ -1,0 +1,48 @@
+"""Seeded future-lifecycle violations (mxlife family a): a strand
+through a may-raise callee's exception edge, a strand on a bare
+return path, a double resolve, and a terminal resolver that skips
+the request's entered spans. Parsed, never imported."""
+from concurrent.futures import Future
+
+from mxnet_tpu import telemetry
+
+
+class Request:
+    def __init__(self, rows):
+        self.rows = rows
+        self.future = Future()
+        self.span = telemetry.span("serve_request").__enter__()
+
+
+def risky(batch):
+    if not batch:
+        raise ValueError("empty batch")
+    return len(batch)
+
+
+def worker(q, out):
+    req = q.get()
+    n = risky(out)
+    req.span.__exit__(None, None, None)
+    req.future.set_result(n)
+    req.future.set_result(n)
+
+
+def maybe_resolve(q):
+    req = q.get()
+    if req.rows:
+        req.future.set_result(req.rows)
+    return None
+
+
+def fail_all(reqs, exc):
+    for r in reqs:
+        if not r.future.done():
+            r.future.set_exception(exc)
+
+
+def shed(req, exc):
+    if req.future.done():
+        return
+    req.span.__exit__(None, None, None)
+    req.future.set_exception(exc)

@@ -1,0 +1,101 @@
+"""Compliant twin of resource_release_violation.py: with-statement
+locks (and acquire with a finally release), finally-guarded span
+exits, unlink-on-failure for the temp+rename protocol, daemon
+threads, finally-guarded joins, and an escape to an owner. Parsed,
+never imported."""
+import os
+import threading
+
+from mxnet_tpu import telemetry
+
+_lock = threading.Lock()
+
+
+def must_raise(x):
+    if x < 0:
+        raise ValueError(x)
+    return x
+
+
+def bump(stats):
+    with _lock:
+        stats["n"] += 1
+
+
+def bump_manual(stats):
+    _lock.acquire()
+    try:
+        stats["n"] += 1
+    finally:
+        _lock.release()
+
+
+def measure2(x):
+    s = telemetry.span("work").__enter__()
+    try:
+        return must_raise(x)
+    finally:
+        s.__exit__(None, None, None)
+
+
+def handoff():
+    # ownership escapes to the caller, who pairs the exit
+    return telemetry.span("work").__enter__()
+
+
+def write_state(path, payload):
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def _unlink_quiet(path):
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+def write_state_helper(path, payload):
+    # cleanup through an extracted in-scan helper counts too
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+    except OSError:
+        _unlink_quiet(tmp)
+        raise
+
+
+def fire_daemon(work):
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+
+
+def run_with_risk(work, x):
+    t = threading.Thread(target=work)
+    t.start()
+    try:
+        must_raise(x)
+    finally:
+        t.join()
+
+
+class Owner:
+    def __init__(self, work):
+        self._thread = None
+        self._work = work
+
+    def start(self, work):
+        t = threading.Thread(target=work)
+        self._thread = t
+        t.start()

@@ -1,0 +1,54 @@
+"""Seeded resource-release violations (mxlife family b): a bare lock
+acquire with no finally release, an entered span that never exits,
+an exit a may-raise callee can jump over, a temp file renamed with
+no unlink-on-failure, and non-daemon threads leaked on the exception
+path. Parsed, never imported."""
+import os
+import threading
+
+from mxnet_tpu import telemetry
+
+_lock = threading.Lock()
+
+
+def must_raise(x):
+    if x < 0:
+        raise ValueError(x)
+    return x
+
+
+def bump(stats):
+    _lock.acquire()
+    stats["n"] += 1
+    _lock.release()
+
+
+def measure(fn, x):
+    s = telemetry.span("work").__enter__()
+    return fn(x)
+
+
+def measure2(x):
+    s = telemetry.span("work").__enter__()
+    y = must_raise(x)
+    s.__exit__(None, None, None)
+    return y
+
+
+def write_state(path, payload):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(payload)
+    os.replace(tmp, path)
+
+
+def fire_and_forget(work):
+    t = threading.Thread(target=work)
+    t.start()
+
+
+def run_with_risk(work, x):
+    t = threading.Thread(target=work)
+    t.start()
+    must_raise(x)
+    t.join()

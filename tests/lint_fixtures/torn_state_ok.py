@@ -1,0 +1,45 @@
+"""Compliant twin of torn_state_violation.py: the restore runs in a
+finally (so the raise path restores too), the risky call is guarded
+by a try, the initialize-to-constant-then-publish idiom keeps its
+chosen reset value on a raise, and a lone mutation with no restore
+pairs with nothing. Parsed, never imported."""
+
+
+def boom(x):
+    if x:
+        raise RuntimeError("boom")
+    return x
+
+
+class Tracker:
+    def __init__(self):
+        self._depth = 0
+        self._busy = False
+        self._bytes = 0
+        self._count = 0
+
+    def step(self, x):
+        self._depth += 1
+        try:
+            boom(x)
+        finally:
+            self._depth -= 1
+
+    def flagged(self, x):
+        self._busy = True
+        try:
+            boom(x)
+        except RuntimeError:
+            pass
+        self._busy = False
+
+    def publish(self, items):
+        # initialize-to-constant then publish-a-computed-value: a
+        # raise leaves the chosen reset value, not a torn one
+        self._bytes = 0
+        boom(len(items))
+        self._bytes = sum(items)
+
+    def tally(self, x):
+        self._count += 1
+        return boom(x)

@@ -1,0 +1,26 @@
+"""Seeded torn-state-on-raise violations (mxlife family c): a depth
+counter bumped and only un-bumped on the fall-through path, and a
+busy flag set and only cleared on the fall-through path, with an
+unguarded may-raise callee in between. Parsed, never imported."""
+
+
+def boom(x):
+    if x:
+        raise RuntimeError("boom")
+    return x
+
+
+class Tracker:
+    def __init__(self):
+        self._depth = 0
+        self._busy = False
+
+    def step(self, x):
+        self._depth += 1
+        boom(x)
+        self._depth -= 1
+
+    def flagged(self, x):
+        self._busy = True
+        boom(x)
+        self._busy = False

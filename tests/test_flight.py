@@ -229,13 +229,18 @@ def test_openmetrics_endpoint_loopback_scrape():
     telemetry.counter_inc("serving.requests", 7)
     # two ledger contexts: the labeled gauge family must emit its
     # '# TYPE' metadata line exactly ONCE (a duplicate is invalid
-    # OpenMetrics and Prometheus rejects the whole scrape)
+    # OpenMetrics and Prometheus rejects the whole scrape).
+    # SYNTHETIC ctx keys, not cpu(N): reset() deliberately preserves
+    # the ledger's ALIVE map (the buffers are still alive), so real
+    # device contexts carry whatever earlier tests still hold live —
+    # with the native build enabled that made these exact-value
+    # asserts order-dependent
     class _Buf:      # bare object() is not weakref-able
         pass
 
     holders = [_Buf(), _Buf()]
-    telemetry.ledger_track(holders[0], "cpu(0)", 64)
-    telemetry.ledger_track(holders[1], "cpu(1)", 128)
+    telemetry.ledger_track(holders[0], "ledgertest(0)", 64)
+    telemetry.ledger_track(holders[1], "ledgertest(1)", 128)
     port = flight.metrics_http_start(0)   # ephemeral, loopback-only
     try:
         body = urllib.request.urlopen(
@@ -246,8 +251,10 @@ def test_openmetrics_endpoint_loopback_scrape():
         assert "mxnet_tpu_serving_queue_depth" in text
         assert text.count(
             "# TYPE mxnet_tpu_ledger_alive_bytes gauge") == 1
-        assert 'mxnet_tpu_ledger_alive_bytes{ctx="cpu(0)"} 64' in text
-        assert 'mxnet_tpu_ledger_alive_bytes{ctx="cpu(1)"} 128' in text
+        assert 'mxnet_tpu_ledger_alive_bytes{ctx="ledgertest(0)"} 64' \
+            in text
+        assert 'mxnet_tpu_ledger_alive_bytes{ctx="ledgertest(1)"} 128' \
+            in text
         assert text.rstrip().endswith("# EOF")
         with pytest.raises(urllib.error.HTTPError):
             urllib.request.urlopen(

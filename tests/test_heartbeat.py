@@ -242,3 +242,66 @@ def test_heartbeat_fault_site_kills_the_beat(tmp_path):
     finally:
         faults.clear()
         heartbeat.stop_heartbeat()
+
+
+# ---------------------------------------------------------------------------
+# mxlife resource-release regressions: unlink-on-failure for every
+# temp+rename site (a failed rename must never leave .tmp artifacts
+# on the shared mount — ISSUE 14)
+# ---------------------------------------------------------------------------
+
+def test_fs_now_failed_rename_leaves_no_tmp(tmp_path, monkeypatch):
+    root = str(tmp_path)
+
+    def _boom(src, dst):
+        raise OSError("replace failed")
+
+    monkeypatch.setattr(heartbeat.os, "replace", _boom)
+    t0 = time.time()
+    now = heartbeat._fs_now(root)
+    assert now >= t0 - 1.0             # fell back to the local clock
+    assert not [n for n in os.listdir(root) if n.endswith(".tmp")]
+
+
+def test_beat_failed_rename_leaves_no_tmp(tmp_path, monkeypatch):
+    root = str(tmp_path)
+    real_replace = os.replace
+    fails = []
+
+    def _boom(src, dst):
+        if dst.endswith("worker-7"):
+            fails.append(dst)
+            raise OSError("replace failed")
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(heartbeat.os, "replace", _boom)
+    heartbeat.start_heartbeat(7, root=root, interval=0.02)
+    try:
+        # generous window: the 0.02s beat loop needs two failed beats,
+        # but a loaded CI box can stall daemon threads for seconds
+        assert _wait_for(lambda: len(fails) >= 2, timeout=20.0)
+        # every failed beat cleans its temp — POLL for the absence:
+        # fails.append runs inside the patched os.replace, i.e. while
+        # the .tmp still exists, so a one-shot listdir can race the
+        # beat thread's except-clause unlink
+        assert _wait_for(lambda: not [n for n in os.listdir(root)
+                                      if n.endswith(".tmp")])
+        # the worker file itself never appeared (all renames failed)
+        assert not os.path.exists(os.path.join(root, "worker-7"))
+    finally:
+        heartbeat.stop_heartbeat()
+
+
+def test_gate_publish_failure_cleans_tmp_and_raises(tmp_path,
+                                                    monkeypatch):
+    root = str(tmp_path)
+    g = CollectiveGate(0, (0, 1), root=root, poll=0.01)
+
+    def _boom(src, dst):
+        raise OSError("replace failed")
+
+    monkeypatch.setattr(heartbeat.os, "replace", _boom)
+    with pytest.raises(OSError):
+        g._publish(1)
+    # the crossing failed loudly AND left nothing for peers to scan
+    assert not [n for n in os.listdir(root) if n.endswith(".tmp")]

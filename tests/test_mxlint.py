@@ -221,6 +221,92 @@ def test_collective_discipline_fixture_pair():
         [f.render() for f in ok.findings]
 
 
+def test_future_lifecycle_fixture_pair():
+    rep = _fixture("future_lifecycle_violation.py", ["future-lifecycle"])
+    # strand through risky()'s raise edge, double resolve, return-path
+    # strand, and two resolvers skipping the request's entered spans
+    assert _lines(rep) == [25, 28, 34, 35, 41], \
+        [f.render() for f in rep.findings]
+    msgs = {f.line: f.message for f in rep.findings}
+    assert "UNRESOLVED" in msgs[25] and "risky" in msgs[25]
+    assert "raises ValueError" in msgs[25]       # the witness chain
+    assert "SECOND time" in msgs[28]
+    assert "returns at line 35" in msgs[35]
+    assert "entered scopes" in msgs[41] and "span" in msgs[41]
+    ok = _fixture("future_lifecycle_ok.py", ["future-lifecycle"])
+    # handler-path resolution, sentinel dequeue, transfer to the
+    # resolving shed(), done-guarded late resolve: all clean
+    assert ok.clean and not ok.suppressed, \
+        [f.render() for f in ok.findings]
+
+
+def test_resource_release_fixture_pair():
+    rep = _fixture("resource_release_violation.py", ["resource-release"])
+    # bare acquire, never-exited span, jumpable exit, tmp without
+    # unlink-on-failure, leaked non-daemon thread, jumpable join
+    assert _lines(rep) == [21, 27, 32, 39, 47, 52], \
+        [f.render() for f in rep.findings]
+    msgs = {f.line: f.message for f in rep.findings}
+    assert "with _lock" in msgs[21]
+    assert "never exits" in msgs[27]
+    assert "must_raise" in msgs[32] and "finally" in msgs[32]
+    assert "unlink" in msgs[39]
+    assert "non-daemon" in msgs[47]
+    assert "join" in msgs[52]
+    ok = _fixture("resource_release_ok.py", ["resource-release"])
+    # with-lock, finally-release, finally-exit, escape-to-owner,
+    # unlink-on-failure, daemon thread, finally-join: all clean
+    assert ok.clean and not ok.suppressed, \
+        [f.render() for f in ok.findings]
+
+
+def test_torn_state_fixture_pair():
+    rep = _fixture("torn_state_violation.py", ["torn-state-on-raise"])
+    # bump/unbump and set/clear pairs straddling an unguarded boom()
+    assert _lines(rep) == [19, 24], [f.render() for f in rep.findings]
+    msgs = {f.line: f.message for f in rep.findings}
+    assert "self._depth" in msgs[19] and "boom" in msgs[19]
+    assert "raises RuntimeError" in msgs[19]     # the witness chain
+    assert "self._busy" in msgs[24]
+    ok = _fixture("torn_state_ok.py", ["torn-state-on-raise"])
+    # finally-restore, guarded call, init-then-publish idiom, lone
+    # mutation: all clean
+    assert ok.clean and not ok.suppressed, \
+        [f.render() for f in ok.findings]
+
+
+def test_every_rule_has_an_exercised_fixture_pair():
+    """Meta-test guarding the NEXT rule family from shipping
+    fixture-less: every id in ALL_RULE_IDS declares its fixture pair
+    (``fixture_basenames``), every declared fixture exists on disk
+    with the violation/compliant twin convention, every fixture file
+    in the corpus is declared by some rule, and every fixture is
+    actually exercised by a test in this file."""
+    from mxnet_tpu.analysis.rules import rule_table
+    table = rule_table()
+    declared = set()
+    for rid in ALL_RULE_IDS:
+        rule = table[rid]
+        names = getattr(rule, "fixture_basenames", ())
+        assert names, "rule %s declares no fixtures" % rid
+        assert len(names) % 2 == 0 and any(
+            "violation" in n for n in names) and any(
+            "ok" in n for n in names), (rid, names)
+        for n in names:
+            assert os.path.exists(os.path.join(FIXTURES, n)), \
+                "rule %s: fixture %s missing" % (rid, n)
+        declared.update(names)
+    on_disk = {n for n in os.listdir(FIXTURES) if n != "README.md"}
+    undeclared = on_disk - declared
+    assert not undeclared, \
+        "fixtures no rule declares (stale?): %s" % sorted(undeclared)
+    with open(os.path.abspath(__file__), encoding="utf-8") as f:
+        test_src = f.read()
+    unexercised = {n for n in on_disk if n not in test_src}
+    assert not unexercised, \
+        "fixtures never exercised by a test: %s" % sorted(unexercised)
+
+
 def test_registry_fixture_pair():
     rep = _fixture("registry_violation", ["registry-consistency"])
     msgs = [f.message for f in rep.findings]
@@ -538,6 +624,9 @@ def test_gate_catches_a_seeded_regression(tmp_path):
     ("donation_interproc_violation.py", "donation-safety"),
     ("thread_race_violation.py", "thread-race"),
     ("collective_violation.py", "collective-discipline"),
+    ("future_lifecycle_violation.py", "future-lifecycle"),
+    ("resource_release_violation.py", "resource-release"),
+    ("torn_state_violation.py", "torn-state-on-raise"),
 ])
 def test_gate_catches_each_interprocedural_seed(fixture, rule):
     """Negative control per NEW rule: each seeded fixture fails the
@@ -1637,15 +1726,18 @@ def test_lint_wall_time_guard():
     for rule in ALL_RULE_IDS:
         assert rule in doc["timings"], doc["timings"]
     assert "callgraph" in doc["timings"] and "summaries" in doc["timings"]
-    # the mxsync models are timed under their own keys (like callgraph/
-    # summaries) so rule timings never double-count the builds
+    # the mxsync/mxlife models are timed under their own keys (like
+    # callgraph/summaries) so rule timings never double-count the builds
     assert "threads" in doc["timings"] and "collectives" in doc["timings"]
+    assert "lifecycle" in doc["timings"]
     cg = doc["callgraph"]
     for key in ("functions", "call_edges", "ref_edges", "dynamic_calls",
                 "sccs", "cyclic_sccs", "largest_scc", "facts_cache",
                 "thread_roots", "thread_rooted_functions",
                 "collective_sites", "collective_host_sites",
-                "gate_crossings"):
+                "gate_crossings", "lifecycle_future_classes",
+                "lifecycle_resolver_functions",
+                "lifecycle_simulated_functions", "may_raise_functions"):
         assert key in cg, cg
     assert cg["functions"] > 1000        # the graph really covers the repo
     assert cg["call_edges"] > 500
@@ -1656,3 +1748,238 @@ def test_lint_wall_time_guard():
     assert cg["collective_sites"] >= 5, cg
     assert cg["collective_host_sites"] >= 4, cg
     assert cg["gate_crossings"] >= 4, cg
+    # ...and the mxlife model: the serving _Request class, its
+    # resolver set, and the runtime's real may-raise surface
+    assert cg["lifecycle_future_classes"] >= 1, cg
+    assert cg["lifecycle_resolver_functions"] >= 2, cg
+    assert cg["may_raise_functions"] >= 100, cg
+
+
+# ---------------------------------------------------------------------------
+# mxlife: may_raise summaries, typestate semantics, --explain
+# ---------------------------------------------------------------------------
+
+def test_may_raise_propagates_through_unguarded_calls(tmp_path):
+    """An unguarded own raise seeds may_raise; it propagates to
+    callers through UNGUARDED call sites only — a try with ANY except
+    handler swallows (conservative-quiet), while handler bodies and
+    finally bodies propagate past their own try."""
+    (tmp_path / "m.py").write_text(
+        "def origin(x):\n"
+        "    raise ValueError(x)\n\n\n"
+        "def unguarded(x):\n"
+        "    return origin(x)\n\n\n"
+        "def guarded(x):\n"
+        "    try:\n"
+        "        return origin(x)\n"
+        "    except Exception:\n"
+        "        return None\n\n\n"
+        "def in_handler(x):\n"
+        "    try:\n"
+        "        return 1\n"
+        "    except Exception:\n"
+        "        return origin(x)\n\n\n"
+        "def in_finally(x):\n"
+        "    try:\n"
+        "        return 1\n"
+        "    finally:\n"
+        "        origin(x)\n")
+    proj = _project_of([tmp_path / "m.py"], tmp_path)
+    g = proj.callgraph()
+    summ = proj.summaries()
+    by = {fi.name: fi for fi in g.functions}
+    assert summ.may_raise(by["origin"])
+    assert summ.may_raise(by["unguarded"])
+    assert not summ.may_raise(by["guarded"])
+    assert summ.may_raise(by["in_handler"])
+    assert summ.may_raise(by["in_finally"])
+    # the witness chain bottoms out at the origin raise
+    hops, line, exc = summ.raise_chain(by["unguarded"])
+    assert [h.name for h, _l in hops] == ["origin"]
+    assert line == 2 and exc == "ValueError"
+
+
+def test_future_lifecycle_resolving_callee_discharges(tmp_path):
+    """Passing an owned request to an in-scan callee that resolves its
+    parameter on every path discharges the obligation (the _shed
+    pattern) — and the same code WITHOUT the resolving callee is a
+    strand."""
+    common = (
+        "from concurrent.futures import Future\n\n\n"
+        "class Req:\n"
+        "    def __init__(self):\n"
+        "        self.future = Future()\n\n\n"
+        "def risky(x):\n"
+        "    if x:\n"
+        "        raise RuntimeError(x)\n\n\n"
+        "def shed(req, exc):\n"
+        "    if not req.future.done():\n"
+        "        req.future.set_exception(exc)\n\n\n")
+    kw = dict(rules=["future-lifecycle"], baseline=Baseline(),
+              root=str(tmp_path))
+    (tmp_path / "m.py").write_text(
+        common
+        + "def drive(q, x):\n"
+        "    req = q.get()\n"
+        "    try:\n"
+        "        risky(x)\n"
+        "    except Exception as e:\n"
+        "        shed(req, e)\n"
+        "        return\n"
+        "    req.future.set_result(x)\n")
+    rep = run([str(tmp_path)], **kw)
+    assert rep.clean, [f.render() for f in rep.findings]
+    (tmp_path / "m.py").write_text(        # positive control: the
+        common                             # handler forgets the request
+        + "def drive(q, x):\n"
+        "    req = q.get()\n"
+        "    try:\n"
+        "        risky(x)\n"
+        "    except Exception:\n"
+        "        return\n"
+        "    req.future.set_result(x)\n")
+    rep = run([str(tmp_path)], **kw)
+    assert [f.rule for f in rep.findings] == ["future-lifecycle"], \
+        [f.render() for f in rep.findings]
+    assert "UNRESOLVED" in rep.findings[0].message
+
+
+def test_future_lifecycle_finally_resolution_is_clean(tmp_path):
+    """A finally-guarded resolve covers the raise leg too — the
+    linearized try/except/finally walk must see it."""
+    (tmp_path / "m.py").write_text(
+        "from concurrent.futures import Future\n\n\n"
+        "class Req:\n"
+        "    def __init__(self):\n"
+        "        self.future = Future()\n\n\n"
+        "def risky(x):\n"
+        "    if x:\n"
+        "        raise RuntimeError(x)\n\n\n"
+        "def drive(q, x):\n"
+        "    req = q.get()\n"
+        "    out = None\n"
+        "    try:\n"
+        "        out = risky(x)\n"
+        "    finally:\n"
+        "        if not req.future.done():\n"
+        "            req.future.set_result(out)\n")
+    rep = run([str(tmp_path)], rules=["future-lifecycle"],
+              baseline=Baseline(), root=str(tmp_path))
+    assert rep.clean, [f.render() for f in rep.findings]
+
+
+def test_cli_explain(tmp_path):
+    """--explain <rule> prints the rule's doc, finding format and its
+    fixture pair paths; exit 2 on an unknown rule id."""
+    proc = _cli(["--explain", "future-lifecycle"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "future-lifecycle" in proc.stdout
+    assert "future_lifecycle_violation.py" in proc.stdout
+    assert "future_lifecycle_ok.py" in proc.stdout
+    assert "rule, path, line, col, message" in proc.stdout
+    proc = _cli(["--explain", "no-such-rule"])
+    assert proc.returncode == 2
+    assert "unknown rule" in proc.stderr
+    # every rule id explains without error (doc + fixtures wired)
+    for rid in ALL_RULE_IDS:
+        assert _cli(["--explain", rid]).returncode == 0, rid
+
+
+def test_changed_refinds_lifecycle_strand_through_callee_edit(tmp_path):
+    """mxlife rides the --changed machinery: touching only the CALLEE
+    whose may_raise summary creates the caller's strand must re-find
+    the caller's finding through the reverse-dependent closure, on the
+    dep-cache fast path."""
+    (tmp_path / "util.py").write_text(
+        "def risky(x):\n"
+        "    if x:\n"
+        "        raise RuntimeError(x)\n")
+    (tmp_path / "worker.py").write_text(
+        "from concurrent.futures import Future\n"
+        "from util import risky\n\n\n"
+        "class Req:\n"
+        "    def __init__(self):\n"
+        "        self.future = Future()\n\n\n"
+        "def drive(q, x):\n"
+        "    req = q.get()\n"
+        "    risky(x)\n"
+        "    req.future.set_result(x)\n")
+    kw = dict(rules=["future-lifecycle"], baseline=Baseline(),
+              root=str(tmp_path),
+              dep_cache=str(tmp_path / "dep.json"))
+    full = run([str(tmp_path)], **kw)
+    assert [(f.path, f.line) for f in full.findings] \
+        == [("worker.py", 12)], [f.render() for f in full.findings]
+    (tmp_path / "util.py").write_text(       # edit ONLY the callee
+        "def risky(x):\n"
+        "    x = x + 1\n"
+        "    if x:\n"
+        "        raise RuntimeError(x)\n")
+    rep = run([str(tmp_path)], only=["util.py"],
+              expand_dependents=True, **kw)
+    assert rep.dep_cache == "hit"
+    assert [(f.path, f.line) for f in rep.findings] \
+        == [("worker.py", 12)], [f.render() for f in rep.findings]
+    # the witness names the EDITED origin raise line
+    assert "util.py:4" in rep.findings[0].message
+
+
+def test_finally_resolution_covers_return_legs(tmp_path):
+    """A future resolved in a finally covers a `return` INSIDE the try
+    too — the return leg runs the finalbody before exiting, so no
+    strand may report (the rule's own recommended fix must not keep
+    firing)."""
+    (tmp_path / "m.py").write_text(
+        "from concurrent.futures import Future\n\n\n"
+        "class Req:\n"
+        "    def __init__(self):\n"
+        "        self.future = Future()\n\n\n"
+        "def risky(x):\n"
+        "    if x:\n"
+        "        raise RuntimeError(x)\n\n\n"
+        "def drive(q, x):\n"
+        "    req = q.get()\n"
+        "    try:\n"
+        "        if x:\n"
+        "            return 1\n"
+        "        risky(x)\n"
+        "    finally:\n"
+        "        if not req.future.done():\n"
+        "            req.future.set_result(x)\n"
+        "    return 0\n")
+    rep = run([str(tmp_path)], rules=["future-lifecycle"],
+              baseline=Baseline(), root=str(tmp_path))
+    assert rep.clean, [f.render() for f in rep.findings]
+
+
+def test_done_guarded_late_resolve_is_not_a_double(tmp_path):
+    """A resolve under `if not v.future.done():` AFTER an earlier
+    resolve on the same path is the sanctioned idempotent form — the
+    R-state is runtime-infeasible on the not-done branch and must not
+    report a phantom double-resolve; the truly unguarded second
+    resolve still does."""
+    common = (
+        "from concurrent.futures import Future\n\n\n"
+        "class Req:\n"
+        "    def __init__(self):\n"
+        "        self.future = Future()\n\n\n")
+    kw = dict(rules=["future-lifecycle"], baseline=Baseline(),
+              root=str(tmp_path))
+    (tmp_path / "m.py").write_text(
+        common
+        + "def drive(q, x, exc):\n"
+        "    req = q.get()\n"
+        "    req.future.set_result(x)\n"
+        "    if not req.future.done():\n"
+        "        req.future.set_exception(exc)\n")
+    rep = run([str(tmp_path)], **kw)
+    assert rep.clean, [f.render() for f in rep.findings]
+    (tmp_path / "m.py").write_text(        # positive control: bare
+        common
+        + "def drive(q, x, exc):\n"
+        "    req = q.get()\n"
+        "    req.future.set_result(x)\n"
+        "    req.future.set_exception(exc)\n")
+    rep = run([str(tmp_path)], **kw)
+    assert [f.rule for f in rep.findings] == ["future-lifecycle"]
+    assert "SECOND time" in rep.findings[0].message

@@ -126,3 +126,30 @@ def test_plot_network_graphviz_or_skip():
     out = mx.sym.FullyConnected(data=data, num_hidden=4)
     dot = mx.visualization.plot_network(out, shape={"data": (1, 8)})
     assert dot is not None
+
+
+def test_scope_releases_span_when_annotation_fails():
+    """mxlife resource-release fix: if the device TraceAnnotation
+    fails to arm, the already-entered host span must close instead of
+    staying open forever (every entered span exits)."""
+    from mxnet_tpu import telemetry
+
+    class _BoomAnn:
+        def __enter__(self):
+            raise RuntimeError("annotation failed to arm")
+
+        def __exit__(self, *exc):
+            return False
+
+    telemetry.enable()
+    scope = mx.profiler.Scope("failing_region")
+    scope._ann = _BoomAnn()
+    before = telemetry.span_count("failing_region")
+    try:
+        scope.__enter__()
+    except RuntimeError:
+        pass
+    else:
+        raise AssertionError("the arm failure must propagate")
+    # the host span closed (one recorded sample), not leaked open
+    assert telemetry.span_count("failing_region") == before + 1

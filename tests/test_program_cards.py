@@ -184,6 +184,11 @@ def test_ledger_ndarray_lifecycle():
             "alive_bytes": 0, "alive_count": 0, "peak_bytes": 0,
             "tracked_total": 0, "tracked_bytes_total": 0}
 
+    # drain cyclic garbage EARLIER tests left alive on this shared
+    # context first: the collect below would otherwise reclaim their
+    # buffers mid-test and shift the deltas (order-dependent with the
+    # native build enabled, which runs more predecessors)
+    gc.collect()
     base = stats()
     a = mx.nd.zeros((64, 64))                        # 16 KiB fp32
     after_a = stats()

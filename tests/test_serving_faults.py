@@ -416,3 +416,92 @@ def test_close_resolves_inflight_then_fails_fast():
 def test_shed_errors_are_structured_mxnet_errors():
     for cls in (DeadlineExceeded, QueueOverflow, CircuitOpen):
         assert issubclass(cls, MXNetError)
+
+
+# ---------------------------------------------------------------------------
+# mxlife future-lifecycle regressions (ISSUE 14): failed requests keep
+# their span accounting, and a dying coalescer strands nothing
+# ---------------------------------------------------------------------------
+
+def test_failed_batch_still_records_request_spans():
+    """Requests failing through _fail_requests must still close their
+    serve_request/serve_wait spans — before the fix the latency
+    percentiles and the flight recorder silently excluded exactly the
+    interesting (failing) requests."""
+    from mxnet_tpu import telemetry
+    telemetry.enable()
+    eng = _engine(max_wait_ms=10000, retry_budget=0,
+                  breaker_threshold=0)
+    try:
+        before_req = telemetry.span_count("serve_request")
+        before_wait = telemetry.span_count("serve_wait")
+        faults.configure("dispatch:raise")
+        futs = [eng.submit(data=_req()) for _ in range(4)]
+        eng.flush()
+        for f in futs:
+            with pytest.raises(faults.InjectedFault):
+                f.result(timeout=10)
+        # the spans closed BEFORE each future resolved, so by now all
+        # four latency samples are banked on both span names
+        assert telemetry.span_count("serve_request") - before_req >= 4
+        assert telemetry.span_count("serve_wait") - before_wait >= 4
+    finally:
+        faults.clear()
+        eng.close()
+
+
+def test_coalescer_death_fails_queued_futures_not_hangs():
+    """The coalescer is the ONLY consumer of the admission queue: if
+    it dies on an unexpected exception, every queued future must
+    resolve with a structured error (and later submits fast-fail with
+    EngineClosed) instead of hanging forever — the zero-hung-futures
+    promise on the exception path the mxlife audit polices."""
+    eng = _engine(max_wait_ms=10000)
+    try:
+        def _boom(batch):
+            raise RuntimeError("seeded coalescer bug")
+
+        eng._launch = _boom
+        f = eng.submit(data=_req())
+        eng.flush()
+        with pytest.raises(MXNetError) as ei:
+            f.result(timeout=10)
+        assert "coalescer" in str(ei.value)
+        # the engine closed itself: no new request can queue into the
+        # dead queue
+        with pytest.raises(EngineClosed):
+            eng.submit(data=_req())
+        st = eng.stats()
+        assert st["shed_by_cause"].get("coalescer_death") == 1
+        assert st["queued_rows"] == 0
+        # the FIRST close() after a coalescer death keeps its full
+        # contract: pool shutdown + corpus/logger flush still run
+        # (only a completed close() makes later calls no-ops)
+        eng.close()
+        assert eng._pool._shutdown
+    finally:
+        eng.close()
+
+
+def test_coalescer_death_mid_launch_keeps_queue_accounting():
+    """A batch whose _launch died AFTER releasing its rows from the
+    admission queue is handed back for terminal cleanup — the rows
+    must be re-charged first, or the uniform cleanup decrement drives
+    queued_rows negative (corrupting the postmortem's engine
+    snapshot)."""
+    eng = _engine(max_wait_ms=10000)
+    try:
+        def _boom(reqs):
+            raise RuntimeError("seeded dispatch bug")
+
+        # die INSIDE _launch, after its queued-rows release
+        eng._dispatch = _boom
+        f = eng.submit(data=_req())
+        eng.flush()
+        with pytest.raises(MXNetError):
+            f.result(timeout=10)
+        st = eng.stats()
+        assert st["queued_rows"] == 0, st
+        assert st["shed_by_cause"].get("coalescer_death") == 1
+    finally:
+        eng.close()

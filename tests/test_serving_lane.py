@@ -166,3 +166,32 @@ def test_warm_smoke_lane():
     # deserialized executables compute the SAME function, bit for bit
     assert out["warm"]["probe_sum"] == out["cold"]["probe_sum"], out
     assert out["warm_vs_cold"] <= out["ratio_gate"], out
+
+
+def test_recalibrated_warm_gate_math():
+    """The in-run warm-gate recalibration (ISSUE 14): gate =
+    clamp(1.4 * (1 - compile_share), 0.25, 0.85) from the cold leg's
+    own span accounting; unusable accounting degrades to the cap
+    (only demand SOME win)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_serve_probe", os.path.join(ROOT, "tools", "serve_probe.py"))
+    sp = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sp)
+    gate = sp._recalibrated_warm_gate
+    # compile-dominated box: clamps to the old absolute strength
+    p, g = gate({"startup_s": 10.0, "jit_compile_s": 8.0,
+                 "jit_trace_s": 1.0})
+    assert p == 0.1 and g == sp.WARM_RATIO_FLOOR == 0.25
+    # share-throttled box (this one): the gate relaxes to what the
+    # box can actually show, with margin
+    p, g = gate({"startup_s": 1.335, "jit_compile_s": 0.6057,
+                 "jit_trace_s": 0.2191})
+    assert 0.35 < p < 0.42 and 0.5 < g < 0.6
+    # overhead-only box: caps — a warm leg must still show a real win
+    p, g = gate({"startup_s": 10.0, "jit_compile_s": 0.5,
+                 "jit_trace_s": 0.0})
+    assert g == sp.WARM_RATIO_CAP == 0.85
+    # no usable accounting: cap, never a crash
+    p, g = gate({"startup_s": 0.0})
+    assert p is None and g == sp.WARM_RATIO_CAP

@@ -14,6 +14,12 @@ Usage::
 Options:
     --rules a,b,...      run only these rule ids (default: all)
     --list-rules         print the rule ids and exit 0
+    --explain RULE       print the rule's documentation, its finding
+                         format and its fixture pair under
+                         tests/lint_fixtures/, then exit 0 (exit 2 on
+                         an unknown rule id) — the fast way for a new
+                         contributor to see what a rule polices and
+                         what compliant code looks like
     --baseline PATH      grandfather file (default:
                          tools/mxlint_baseline.json; 'none' disables)
     --update-baseline    rewrite the baseline from the current findings
@@ -129,6 +135,40 @@ def changed_files(base_ref=None):
     return sorted(f for f in files if f.endswith(".py")), None
 
 
+def explain_rule(rid):
+    """Print one rule's story: its module docstring (what it polices,
+    how to comply/suppress), the finding format, and the fixture pair
+    a contributor can read/run. Exit 0, or 2 on an unknown id."""
+    from mxnet_tpu.analysis.rules import rule_table
+    table = rule_table()
+    if rid not in table:
+        return usage("unknown rule %r (known: %s)"
+                     % (rid, ", ".join(ALL_RULE_IDS)))
+    rule = table[rid]
+    import inspect
+    doc = (inspect.getdoc(inspect.getmodule(type(rule)))
+           or "").strip()
+    print("rule: %s" % rid)
+    print("=" * (6 + len(rid)))
+    print(doc)
+    print()
+    print("finding format: <rule, path, line, col, message> — rendered")
+    print("as 'path:line:col: %s: <message>'; baseline identity is" % rid)
+    print("(rule, path, anchor) where anchor is the stripped finding")
+    print("line, so unrelated edits never invalidate an entry.")
+    print()
+    print("fixture pair (run them to see the rule fire / stay silent):")
+    for name in getattr(rule, "fixture_basenames", ()):
+        path = os.path.join("tests", "lint_fixtures", name)
+        kind = "violation" if "violation" in name else "compliant"
+        print("  %-10s %s" % (kind + ":", path))
+    print()
+    print("try: python tools/mxlint.py --baseline none --rules %s "
+          "tests/lint_fixtures/%s" % (
+              rid, getattr(rule, "fixture_basenames", ("", ))[0]))
+    return 0
+
+
 def main(argv):
     paths = []
     rules = None
@@ -149,6 +189,10 @@ def main(argv):
         if a == "--list-rules":
             print("\n".join(ALL_RULE_IDS))
             return 0
+        if a == "--explain":
+            if not args:
+                return usage("--explain needs a rule id")
+            return explain_rule(args.pop(0))
         if a == "--rules":
             if not args:
                 return usage("--rules needs a comma-separated id list")

@@ -9,12 +9,24 @@ cd "$(dirname "$0")/.."
 lint_stage() {
   echo "== mxlint (AST static analysis)"
   # replaces the old grep stanzas (raw jax.jit / raw dispatch_hook),
-  # which an aliased `from jax import jit` walked straight past. Six
-  # rules: jit-site, dispatch-hook, lock-discipline, host-sync,
-  # donation-safety, registry-consistency — zero unsuppressed findings
-  # over the runtime, the tools and the bench harness, against the
-  # committed grandfather file tools/mxlint_baseline.json.
+  # which an aliased `from jax import jit` walked straight past.
+  # Thirteen rules across four families — direct (jit-site,
+  # dispatch-hook, lock-discipline, host-sync, donation-safety,
+  # registry-consistency), mxflow interprocedural (lockset,
+  # trace-purity + transitive layers), mxsync concurrency
+  # (thread-race, collective-discipline) and mxlife lifecycle
+  # (future-lifecycle, resource-release, torn-state-on-raise) — all
+  # stdlib-only: this stage needs no jax import and no native build.
+  # Zero unsuppressed findings over the runtime, the tools and the
+  # bench harness, against the committed grandfather file
+  # tools/mxlint_baseline.json. `python tools/mxlint.py --explain
+  # <rule>` documents any rule that fires; the pre-commit loop is
+  # `python tools/mxlint.py --changed ...` (tools/pre-commit.sample).
   python tools/mxlint.py mxnet_tpu tools bench.py
+  # the rule registry itself stays consistent: 13 ids, each with a
+  # fixture pair (the meta-test enforces the pairing; this is the
+  # jax-free smoke that the CLI agrees)
+  test "$(python tools/mxlint.py --list-rules | wc -l)" -eq 13
 }
 
 if [ "${1:-}" = "lint" ]; then
