@@ -488,7 +488,8 @@ def _restore_pin(old):
         os.environ["MXNET_MODULE_FUSED_STEP"] = old
 
 
-def _module_fit_throughput(dev, contexts=None, kvstore="local"):
+def _module_fit_throughput(dev, contexts=None, kvstore="local",
+                           module_kwargs=None):
     """Throughput of the USER-FACING training path — Module.fit itself
     (symbolic ResNet-50, bf16 executor via the InferType pass, fp32
     master weights in the optimizer, metric updates included) — so
@@ -556,7 +557,7 @@ def _module_fit_throughput(dev, contexts=None, kvstore="local"):
             self.i += 1
             return self._batch
 
-    mod = mx.mod.Module(sym, context=contexts)
+    mod = mx.mod.Module(sym, context=contexts, **(module_kwargs or {}))
     opt_params = {"learning_rate": LR, "momentum": MOMENTUM,
                   "multi_precision": True}
     metric = mx.metric.Accuracy()
@@ -661,6 +662,113 @@ def dp_child():
         _restore_pin(old_pin)
     print(json.dumps(out), flush=True)
     _write_dp_artifact(dict(out, ok=True, skipped=False))
+
+
+def _mp_bench_rules(mp):
+    """ResNet partition rules for the mp A/B: shard conv/FC weight
+    output channels (and batch-norm scale/shift vectors) over ``mp``.
+    Non-divisible shapes downgrade to replicate (warned + counted) —
+    the point of the lane is the LAYOUT cost A/B, not rule surgery
+    per architecture."""
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel import PartitionRules
+    return PartitionRules([
+        (r"(conv\d*|fc\d*)_weight$", P("mp")),
+        (r"weight$", P("mp")),
+        (r"(gamma|beta|bias)$", P("mp")),
+    ])
+
+
+def _write_mp_artifact(obj):
+    """MULTICHIP artifact for the per-layout A/B (same schema stance as
+    the dp artifact: partial writes marked, final write ok=True)."""
+    art_dir = os.environ.get("MXTPU_ARTIFACT_DIR", "/tmp/mxtpu_artifacts")
+    try:
+        os.makedirs(art_dir, exist_ok=True)
+        with open(os.path.join(art_dir, "multichip_mp_ab.json"), "w") as f:
+            f.write(json.dumps(obj) + "\n")
+    except OSError as e:
+        print("bench: mp artifact write failed: %s" % e, file=sys.stderr)
+
+
+def mp_child():
+    """Partition-layout A/B child (ISSUE 15): Module.fit through the
+    fused SPMD step on the SAME devices and global batch under two
+    LAYOUTS — params replicated (plain dp over all devices) vs
+    rule-sharded over a dp x mp mesh — banking per-layout img/s,
+    telemetry and the per-layout PROGRAM CARDS (the card's
+    ``partition`` block names the layout, so the corpus rows stay
+    attributable). In smoke mode the mesh is the virtual 8-device CPU
+    host as 2x4; on a TPU slice the mp axis defaults to 4 (v5e-8 ->
+    2x4) or 2 when fewer chips answer. Partial results print per
+    layout, mirroring dp_child's salvage discipline."""
+    import jax
+    if SMOKE:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+    dev = _init_device(jax)
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    n_dev = len([d for d in jax.devices() if d.platform == dev.platform])
+    if n_dev < 2:
+        out = {"lane": "mp_ab", "skipped": True,
+               "reason": "mp A/B needs >=2 devices, found %d" % n_dev}
+        print(json.dumps(out), flush=True)
+        _write_mp_artifact(dict(out, ok=False))
+        return
+    mp = int(os.environ.get("MXTPU_BENCH_MP", "4"))
+    while mp > 1 and n_dev % mp:
+        mp //= 2
+    dp = n_dev // max(mp, 1)
+    mk_ctx = mx.tpu if dev.platform != "cpu" else mx.cpu
+    contexts = [mk_ctx(i) for i in range(n_dev)]
+    layouts = {
+        "replicated": None,
+        "dp%dxmp%d" % (dp, mp): {
+            "partition_rules": _mp_bench_rules(mp),
+            "mesh_axes": {"dp": dp, "mp": mp},
+        },
+    }
+    out = {"lane": "mp_ab", "device": dev.device_kind,
+           "n_devices": n_dev, "per_chip_batch": BATCH,
+           "mesh_axes": {"dp": dp, "mp": mp}, "layouts": {}}
+    old_pin = os.environ.get("MXNET_MODULE_FUSED_STEP")
+    try:
+        os.environ["MXNET_MODULE_FUSED_STEP"] = "1"
+        for name, kw in layouts.items():
+            _sampler_begin()
+            img_s, fallback = _module_fit_throughput(
+                dev, contexts=contexts, kvstore="device",
+                module_kwargs=kw)
+            entry = {"img_s": round(img_s, 2),
+                     "telemetry": _telemetry_summary(),
+                     "series": _series_window()}
+            if fallback is not None:
+                entry["fused_fallback"] = getattr(fallback, "code",
+                                                  str(fallback))
+            # the layout's train_step card: what this layout COSTS
+            # (FLOPs/bytes/peak HBM) plus its partition stamp
+            entry["program_cards"] = {
+                k: {kk: c.get(kk) for kk in
+                    ("kind", "flops", "bytes_accessed", "peak_bytes",
+                     "compile_ms", "dispatches", "partition")}
+                for k, c in telemetry.programs().items()
+                if c.get("kind") == "train_step" and c.get("dispatches")}
+            out["layouts"][name] = entry
+            print(json.dumps(dict(out, partial=True)), flush=True)
+            _write_mp_artifact(dict(out, ok=False, truncated=True))
+    finally:
+        _restore_pin(old_pin)
+    names = list(out["layouts"])
+    if len(names) == 2 and all(
+            out["layouts"][n].get("img_s") for n in names):
+        out["mp_vs_replicated"] = round(
+            out["layouts"][names[1]]["img_s"]
+            / out["layouts"][names[0]]["img_s"], 3)
+    print(json.dumps(out), flush=True)
+    _write_mp_artifact(dict(out, ok=True))
 
 
 def serve_child():
@@ -1097,6 +1205,8 @@ if __name__ == "__main__":
         module_child()
     elif "--dp-child" in _argv:
         dp_child()
+    elif "--mp-child" in _argv:
+        mp_child()
     elif "--serve-child" in _argv:
         serve_child()
     else:

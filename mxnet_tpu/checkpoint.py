@@ -210,6 +210,22 @@ class CheckpointManager:
             "optimizer_states": has_states,
             "ts": time.time(),
         }
+        # sharding-aware checkpoints: the params file always holds the
+        # HOST-GATHERED values (get_params gathers per-shard), and the
+        # meta records the layout they were trained under — restore
+        # re-shards onto whatever mesh the resuming process binds (a
+        # dp-only checkpoint restores onto a dp x mp mesh and vice
+        # versa; set_params / _sync_state re-commit to the NEW module's
+        # rule-derived placements), so the layout here is provenance,
+        # not a constraint
+        layout = getattr(module, "partition_summary", None)
+        if callable(layout):
+            try:
+                layout = layout()
+            except Exception:
+                layout = None
+            if layout:
+                meta["layout"] = layout
         optimizer = getattr(module, "_optimizer", None)
         if optimizer is not None:
             meta["update_counts"] = {

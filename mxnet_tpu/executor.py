@@ -913,7 +913,8 @@ class _GraphProgram:
         return self._jit_cache[key]
 
     def train_step_fn(self, update_names, add_names, input_dtypes, cache_key,
-                      build_update_fn, build_metric_fn, spmd=None):
+                      build_update_fn, build_metric_fn, spmd=None,
+                      build_shardings=None):
         """Whole-training-step program: forward + backward + optimizer
         update (+ metric accumulation when ``build_metric_fn`` is given)
         traced into ONE jitted XLA function, with the parameter,
@@ -943,6 +944,18 @@ class _GraphProgram:
         batch splitting: the global batch arrives via one sharded
         device_put). The replicated metric accumulator comes back already
         psummed across replicas, so fetching it needs no extra program.
+
+        ``build_shardings`` (rule-sharded dp x mp meshes — a spec whose
+        ``rules`` is a ``PartitionRules`` tree) is invoked on a cache
+        miss like ``build_update_fn`` and returns the PER-LEAF
+        NamedSharding pytrees ``{"params": {name: sh}, "states":
+        [tuple(sh, ...)], "aux": {name: sh}, "add_grads": {name: sh}}``
+        threaded into ``in_shardings`` — mp-sharded parameters and
+        their optimizer state stay sharded INSIDE the donated step
+        (never all-gathered), while GSPMD still reduces gradients over
+        ``dp`` only because each gradient carries its parameter's mp
+        placement. The batch inputs/step scalars keep the dp/replicated
+        layout above.
         """
         if self.node_devices:
             raise MXNetError("train_step_fn: grouped programs run eagerly "
@@ -1003,12 +1016,21 @@ class _GraphProgram:
         # repr rides in the graph key (a per-process repr degrades to a
         # quick-tier miss, never a false hit)
         def step_graph_key():
+            if spmd is None:
+                layout = None
+            else:
+                # mesh shape + rule-tree identity: two layouts over the
+                # same graph must key distinct persisted programs (the
+                # repr degrades to a quick-tier miss at worst, never a
+                # false hit)
+                layout = (spmd.num_devices,
+                          repr(sorted(dict(spmd.mesh.shape).items())),
+                          repr(getattr(spmd, "rules", None)))
             return self._entry_graph_key(
                 "train_step", tuple(update_names),
                 tuple(sorted(add_names)),
                 tuple("%s=%s" % (k, v) for k, v in
-                      sorted(input_dtypes.items())), cache_key,
-                None if spmd is None else spmd.num_devices)
+                      sorted(input_dtypes.items())), cache_key, layout)
         if spmd is None:
             fn = _InstrumentedProgram(
                 "train_step", step,
@@ -1020,18 +1042,63 @@ class _GraphProgram:
             # args: (params, opt_states, metric_acc, aux, inputs, rng,
             #        lrs, wds, ts, add_grads) — each entry is a pytree
             # PREFIX broadcast over its subtree. The batch-sharded inputs
-            # plus replicated params force GSPMD to insert the gradient
-            # all-reduce (psum over the dp axis) inside the step; output
-            # shardings are propagated (params/state/acc come out
-            # replicated, per-example outputs batch-sharded), which keeps
-            # donation buffer-compatible.
+            # plus replicated (or rule-sharded, below) params force GSPMD
+            # to insert the gradient all-reduce (psum over the dp axis)
+            # inside the step; output shardings are propagated (params/
+            # state/acc come out on their input placement, per-example
+            # outputs batch-sharded), which keeps donation
+            # buffer-compatible.
+            param_sh = state_sh = aux_sh = ag_sh = repl
+            meta = {"spmd_devices": spmd.num_devices}
+            if getattr(spmd, "rules", None) is not None \
+                    and build_shardings is not None:
+                shs = build_shardings()
+                param_sh, state_sh = shs["params"], shs["states"]
+                aux_sh, ag_sh = shs["aux"], shs["add_grads"]
+                base_step = step
+
+                def step(params, opt_states, metric_acc, aux, inputs,
+                         rng, lrs, wds, ts, add_grads):
+                    # pin the DONATED outputs to their declared input
+                    # placements: GSPMD would otherwise propagate
+                    # whatever layout the body implies (e.g. BatchNorm
+                    # moving stats derived from mp-sharded activations
+                    # drift to an mp sharding), and the NEXT call's
+                    # explicit in_shardings would reject the donated
+                    # buffer it just produced
+                    wsc = jax.lax.with_sharding_constraint
+                    new_params, new_states, new_acc, new_aux, outs, \
+                        grads_out = base_step(
+                            params, opt_states, metric_acc, aux,
+                            inputs, rng, lrs, wds, ts, add_grads)
+                    new_params = wsc(new_params, param_sh)
+                    new_states = [wsc(s, sh) for s, sh in
+                                  zip(new_states, state_sh)]
+                    if new_acc is not None:     # metric-less step
+                        new_acc = wsc(new_acc, repl)
+                    new_aux = wsc(new_aux, aux_sh)
+                    grads_out = {k: wsc(v, ag_sh[k])
+                                 for k, v in grads_out.items()}
+                    return (new_params, new_states, new_acc, new_aux,
+                            outs, grads_out)
+                n_sharded = sum(1 for s in param_sh.values()
+                                if tuple(s.spec))
+                meta["partition"] = {
+                    "mesh_axes": {str(k): int(v)
+                                  for k, v in spmd.mesh.shape.items()},
+                    "data_axis": spmd.data_axis,
+                    "sharded_params": n_sharded,
+                    "replicated_params": len(param_sh) - n_sharded,
+                    "rules": spmd.rules.describe(),
+                }
             fn = _InstrumentedProgram(
                 "train_step", step,
-                jit_kwargs={"in_shardings": (repl, repl, repl, repl, dsh,
-                                             repl, repl, repl, repl, repl),
+                jit_kwargs={"in_shardings": (param_sh, state_sh, repl,
+                                             aux_sh, dsh, repl, repl,
+                                             repl, repl, ag_sh),
                             "donate_argnums": (0, 1, 2, 3)},
                 argnames=step_argnames,
-                meta={"spmd_devices": spmd.num_devices},
+                meta=meta,
                 graph_key=step_graph_key)
         self._jit_cache[key] = fn
         return fn

@@ -71,14 +71,35 @@ _ACC_FUSED_JIT = None
 
 def _colocate(ref, x):
     """Reshard ``x`` to ``ref``'s placement (mesh-DP outputs are sharded
-    over the device mesh while labels arrive single-device)."""
+    over the device mesh while labels arrive single-device). A ``ref``
+    whose rank differs from ``x``'s (an mp-sharded prediction spec like
+    ``P('dp','mp')`` against a rank-1 label) cannot be applied verbatim
+    — ``x`` then shards over the leading dims the two share and
+    replicates the rest, landing on the SAME mesh so the jitted
+    accumulate accepts the pair."""
     import jax
+    sh = getattr(ref, "sharding", None)
+    if sh is None:
+        return x
     try:
-        if x.sharding != ref.sharding:
-            return jax.device_put(x, ref.sharding)
-    except (AttributeError, ValueError):
+        if getattr(x, "sharding", None) == sh:
+            return x
+    except ValueError:
         pass
-    return x
+    try:
+        return jax.device_put(x, sh)
+    except (TypeError, ValueError):
+        pass
+    mesh = getattr(sh, "mesh", None)
+    if mesh is None:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec
+    entries = tuple(sh.spec)[:x.ndim]
+    try:
+        return jax.device_put(x, NamedSharding(mesh,
+                                               PartitionSpec(*entries)))
+    except (TypeError, ValueError):
+        return jax.device_put(x, NamedSharding(mesh, PartitionSpec()))
 
 
 def check_label_shapes(labels, preds, shape=False):

@@ -36,7 +36,17 @@ class Module(BaseModule):
                  label_names=("softmax_label",), logger=logging, context=None,
                  work_load_list=None, fixed_param_names=None,
                  state_names=None, group2ctxs=None,
-                 compression_params=None):
+                 compression_params=None, partition_rules=None,
+                 mesh_axes=None):
+        """``partition_rules`` (a ``parallel.partition.PartitionRules``
+        tree) + ``mesh_axes`` (ordered ``{axis: size}``, e.g.
+        ``{"dp": 2, "mp": 4}``; one size may be -1) lay a multi-device
+        context list out as a rule-sharded dp x mp mesh: the batch
+        shards over ``dp``, each parameter takes its first-matching
+        rule's PartitionSpec (UNMATCHED policy: replicate or error),
+        and the fused train step runs ONE donated SPMD program with
+        gradients reduced over ``dp`` only and mp-sharded parameters
+        never gathered. Ignored on a single-context bind."""
         super().__init__(logger=logger)
         if context is None:
             context = current_context()
@@ -69,6 +79,8 @@ class Module(BaseModule):
         self._dp_spec = None
         self._data_sharding = None
         self._repl_sharding = None
+        self._partition_rules = partition_rules
+        self._mesh_axes = dict(mesh_axes) if mesh_axes else None
         self._fused_fallback_reason = None
         self._fused_plan = None
         # the dist tier (multi-process dist_* kvstore): a PROCESS-
@@ -189,18 +201,27 @@ class Module(BaseModule):
 
     # -- multi-device mesh (TPU-native DataParallelExecutorGroup) ----------
     def _init_mesh(self):
-        """N contexts = a dp mesh over N chips: the reference builds one
+        """N contexts = a mesh over N chips: the reference builds one
         executor per device and reduces grads through KVStore
         (executor_group.py:128, comm.h:102-720); here the SAME single
         program is GSPMD-sharded — batch over the ``dp`` axis, params
-        replicated — so XLA inserts the gradient all-reduce over ICI
-        inside the fused fwd+bwd step."""
+        replicated (or rule-sharded over ``mp`` when a
+        ``PartitionRules`` tree is bound) — so XLA inserts the gradient
+        all-reduce over ICI inside the fused fwd+bwd step. The batch
+        divisibility check is against the DP AXIS size, not the device
+        count: on a 2x4 dp x mp mesh a batch of 6 divides fine."""
         from ..parallel import mesh as _pmesh, spmd as _spmd
-        n = len(self._context)
+        if self._partition_rules is not None or self._mesh_axes:
+            mesh = _pmesh.mesh_from_contexts(
+                self._context, axes=self._mesh_axes or {_spmd.DP_AXIS: -1})
+            spec = _spmd.rule_spec(mesh, self._partition_rules)
+        else:
+            spec = _spmd.dp_spec(_pmesh.mesh_from_contexts(self._context))
         for d in self._data_shapes + self._label_shapes:
             if d.shape:
-                _spmd.check_batch_divisible(d.shape[0], n, "batch size")
-        spec = _spmd.dp_spec(_pmesh.mesh_from_contexts(self._context))
+                _spmd.check_batch_divisible(d.shape[0], spec.dp_size,
+                                            "batch size",
+                                            axis=spec.data_axis)
         self._dp_spec = spec
         self._mesh = spec.mesh
         self._data_sharding = spec.data_sharding
@@ -208,12 +229,29 @@ class Module(BaseModule):
         self._shard_exec_arrays()
 
     def _shard_exec_arrays(self):
-        """Commit shardings: data/label batch-sharded, params/grads/aux
-        replicated. GSPMD propagates from these committed placements."""
+        """Commit shardings: data/label batch-sharded over ``dp``;
+        params/grads/aux on their rule-resolved placement (replicated
+        without a rule tree). GSPMD propagates from these committed
+        placements."""
         from ..parallel import spmd as _spmd
         input_names = set(self._data_names) | set(self._label_names) \
             | set(self._state_names)
         _spmd.commit_dp_placements(self._exec, input_names, self._dp_spec)
+
+    def partition_summary(self):
+        """JSON-safe layout description of this module's mesh spec (or
+        None on a single-device bind): mesh axes, data axis, the rule
+        tree and the resolved sharded-parameter specs — recorded into
+        checkpoint meta, fused plans and program cards."""
+        if self._dp_spec is None:
+            return None
+        from ..parallel.partition import partition_summary as _summary
+        shapes = None
+        if self.binded and self._exec is not None:
+            arg_dict = self._exec.arg_dict
+            shapes = {n: arg_dict[n].shape for n in self._param_names
+                      if n in arg_dict}
+        return _summary(self._dp_spec, shapes)
 
     # -- multi-process dist mesh (the elastic dist_* tier) -----------------
     def _input_name_set(self):
@@ -237,6 +275,14 @@ class Module(BaseModule):
         if len(live) <= 1 or _dist.process_count() <= 1:
             self._dist_spec = None
             return
+        if self._partition_rules is not None:
+            # re-sharding a rule tree across worker processes is not
+            # wired yet (ROADMAP: multi-host mp); the dist tier keeps
+            # the replicated dp layout
+            raise MXNetError(
+                "partition_rules cannot be combined with a "
+                "multi-process dist_* kvstore yet; drop the rules or "
+                "run single-process")
         for d in self._data_shapes + self._label_shapes:
             if d.shape:
                 _spmd.check_batch_divisible(
@@ -554,8 +600,9 @@ class Module(BaseModule):
             raw = src._data if isinstance(src, NDArray) else np.asarray(src)
             if raw.shape:
                 _spmd.check_batch_divisible(raw.shape[0],
-                                            self._dp_spec.num_devices,
-                                            "batch size")
+                                            self._dp_spec.dp_size,
+                                            "batch size",
+                                            axis=self._dp_spec.data_axis)
             dt = dst._data.dtype
             if isinstance(raw, np.ndarray):
                 raw = _spmd.shard_put(raw.astype(dt, copy=False),
@@ -863,12 +910,43 @@ class Module(BaseModule):
         # the process-spanning mesh, cross-host psum compiled inside
         spmd_spec = self._dist_spec if self._dist_spec is not None \
             else self._dp_spec
+
+        build_shardings = None
+        if spmd_spec is not None \
+                and getattr(spmd_spec, "rules", None) is not None:
+            spec = spmd_spec
+            param_names = list(self._param_names)
+            aux_pairs = [(n, a.shape)
+                         for n, a in zip(ex._aux_names, ex.aux_arrays)]
+            state_shapes = [tuple(tuple(x.shape) for x in tup)
+                            for tup in packed]
+
+            def build_shardings():
+                # per-leaf NamedShardings from the rule tree: optimizer
+                # state the shape of its weight (momenta, fp32 masters)
+                # rides the weight's placement; any other leaf shape
+                # replicates on the same mesh
+                psh = {n: spec.param_sharding(n, arg_dict[n].shape)
+                       for n in param_names}
+                repl = spec.repl_sharding
+                ssh = []
+                for n, shapes in zip(update_names, state_shapes):
+                    wshape = tuple(arg_dict[n].shape)
+                    ssh.append(tuple(psh[n] if s == wshape else repl
+                                     for s in shapes))
+                return {
+                    "params": psh,
+                    "states": ssh,
+                    "aux": {n: spec.param_sharding(n, s)
+                            for n, s in aux_pairs},
+                    "add_grads": {n: psh[n] for n in add_names},
+                }
         fn = ex._prog.train_step_fn(
             update_names, add_names, input_dtypes, cache_key,
             build_update_fn=lambda: opt._make_batch_update(
                 kname, dict(statics), list(mp), list(inner_n)),
             build_metric_fn=build_metric_fn if kernel is not None else None,
-            spmd=spmd_spec)
+            spmd=spmd_spec, build_shardings=build_shardings)
         # a SUBSUMED update_on_kvstore store holds its own canonical
         # weight copies (push updates them, pull serves them); the fused
         # step keeps them coherent with zero-cost pointer swaps so a
@@ -891,6 +969,9 @@ class Module(BaseModule):
             "kernel": kernel, "fn": fn,
             "label_inputs": frozenset(label_inputs),
             "spmd_spec": spmd_spec,
+            # the resolved layout rides in the plan (and from there
+            # into checkpoint meta / the tuner's corpus records)
+            "layout": self.partition_summary(),
             # per-process gradient payload of the in-program psum (the
             # dist wire-bytes estimate bumped per spanning step)
             "dist_wire_bytes": sum(
@@ -950,8 +1031,8 @@ class Module(BaseModule):
                 # device receives its shard, no host-side splitting
                 if raw.shape:
                     _spmd.check_batch_divisible(
-                        raw.shape[0], spec.num_devices,
-                        "batch size")
+                        raw.shape[0], spec.dp_size, "batch size",
+                        axis=spec.data_axis)
                 raw = _spmd.shard_put(raw, sharding)
             else:
                 # batch arrays ride as jit arguments without a copy into

@@ -639,13 +639,25 @@ class Updater:
     def _sync_state(self, index, weight):
         """Host states from set_states -> NDArrays on the weight's context
         (parity: optimizer.Updater.sync_state_context). A weight that is
-        committed to a device mesh (the dp Module replicates params over
-        the ``dp`` axis) pulls the state onto the SAME placement —
-        loaded checkpoint states must not re-enter as single-device
-        arrays or the donated SPMD step / fused batch update would mix
-        mesh-committed and single-device operands."""
+        committed to a device mesh pulls the state onto the weight's
+        RULE-DERIVED placement — under the partition engine a parameter
+        may be mp-SHARDED, not just dp-replicated, and loaded checkpoint
+        states must re-enter on that same layout or the donated SPMD
+        step / fused batch update would mix placements (the old code
+        assumed the replicated dp layout and force-applied the weight's
+        sharding to every leaf — a shape-mismatched leaf, e.g. a scalar
+        schedule state, would be rejected by an mp sharding's rank).
+        A leaf the weight's shape rides the weight's exact sharding;
+        any other shape replicates onto the same mesh."""
         import jax
         sh = _nd._multi_device_sharding(weight._data)
+        repl = None
+        if sh is not None:
+            mesh = getattr(sh, "mesh", None)
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                repl = NamedSharding(mesh, PartitionSpec())
+        wshape = tuple(weight._data.shape)
 
         def _conv(s):
             if s is None:
@@ -658,7 +670,9 @@ class Updater:
                 out = _nd.array(np.asarray(s), ctx=weight.context,
                                 dtype=np.asarray(s).dtype)
             if sh is not None:
-                out._set_data(jax.device_put(out._data, sh))
+                target = sh if tuple(out._data.shape) == wshape \
+                    else (repl or sh)
+                out._set_data(jax.device_put(out._data, target))
             return out
         self.states[index] = _conv(self.states[index])
         self.states_synced[index] = True

@@ -17,7 +17,10 @@ from .mesh import make_mesh, default_mesh, mesh_from_contexts, barrier
 from .collectives import (all_reduce, all_gather, reduce_scatter, ppermute,
                           all_to_all)
 from .spmd import (SPMDTrainer, shard_params_rule, DataParallelSpec,
-                   dp_spec, check_batch_divisible, shard_put, DP_AXIS)
+                   dp_spec, rule_spec, check_batch_divisible, shard_put,
+                   DP_AXIS, MP_AXIS)
+from .partition import (PartitionRules, UNMATCHED_REPLICATE,
+                        UNMATCHED_ERROR, partition_summary)
 from .ring_attention import ring_attention, attention
 from .ulysses import ulysses_attention
 from .moe import moe_ffn

@@ -43,16 +43,40 @@ def default_mesh(data_axis="dp"):
     return make_mesh({data_axis: -1})
 
 
-def mesh_from_contexts(contexts, axis="dp"):
-    """One-axis Mesh over a Module-style context list — the TPU-native
-    reading of the reference's per-GPU context list (the devices that
+def mesh_from_contexts(contexts, axis="dp", axes=None):
+    """Mesh over a Module-style context list — the TPU-native reading
+    of the reference's per-GPU context list (the devices that
     DataParallelExecutorGroup would have bound one executor each on
-    become the ``dp`` axis of ONE program's mesh)."""
+    become the axes of ONE program's mesh).
+
+    Default: a one-axis ``(axis,)`` data-parallel mesh. ``axes`` (an
+    ordered ``{name: size}``, one size may be -1 to absorb the rest)
+    folds the SAME context list into a multi-axis form — e.g.
+    ``{"dp": 2, "mp": 4}`` lays 8 contexts out as a 2x4 dp x mp mesh
+    for the partition-rule engine. The product must cover the context
+    list exactly: the caller named these devices, so silently dropping
+    some would train on fewer chips than asked."""
     devs = [c.jax_device() for c in contexts]
     if len(set(devs)) != len(devs):
         raise MXNetError("duplicate devices in context list %s"
                          % (list(contexts),))
-    return Mesh(np.array(devs), (axis,))
+    if axes is None:
+        return Mesh(np.array(devs), (axis,))
+    names = list(axes.keys())
+    sizes = [int(s) for s in axes.values()]
+    if sizes.count(-1) > 1:
+        raise MXNetError("at most one mesh axis may be -1")
+    known = int(np.prod([s for s in sizes if s != -1]))
+    if -1 in sizes:
+        if known == 0 or len(devs) % known != 0:
+            raise MXNetError("context count %d not divisible by the "
+                             "fixed axes product %d" % (len(devs), known))
+        sizes[sizes.index(-1)] = len(devs) // known
+    if int(np.prod(sizes)) != len(devs):
+        raise MXNetError(
+            "mesh axes %s need %d devices, context list has %d"
+            % (dict(zip(names, sizes)), int(np.prod(sizes)), len(devs)))
+    return Mesh(np.array(devs).reshape(sizes), tuple(names))
 
 
 def barrier():

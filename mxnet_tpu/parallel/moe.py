@@ -25,7 +25,21 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .compat import shard_map
 
-__all__ = ["moe_ffn"]
+__all__ = ["moe_ffn", "PARTITION_RULES"]
+
+# The layer's layout as a partition-rule set the engine can apply
+# (``PartitionRules(PARTITION_RULES)``): the router is tiny and
+# replicated; expert weight stacks carry a leading expert axis sharded
+# over ``ep`` — one expert's MLP per device, exactly the placement
+# ``moe_ffn`` commits by hand below. Exporting it graduates the kernel
+# from a standalone demo to a layout any Module/InferenceEngine bind
+# can consume (name your expert stacks ``*_expert_w1``/``*_expert_w2``
+# and the rules light up).
+PARTITION_RULES = [
+    (r"router", P()),
+    (r"expert_w[12]$", P("ep")),
+    (r"expert", P("ep")),
+]
 
 
 def _local_moe(x, wr, w1, w2, axis_name, capacity):

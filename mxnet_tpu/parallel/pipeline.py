@@ -21,7 +21,19 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .compat import shard_map
 
-__all__ = ["pipeline_apply"]
+__all__ = ["pipeline_apply", "PARTITION_RULES"]
+
+# The GPipe layout as a partition-rule set: every stage-stacked
+# parameter (leading stage axis of size n, the shape
+# ``pipeline_apply`` requires) shards over ``pp`` — device i holds
+# stage i, the placement the kernel commits by hand below. Name
+# stage-stacked leaves ``*_stages`` (or match everything with a
+# catch-all when the whole tree is stage-stacked) and the rule engine
+# reproduces it.
+PARTITION_RULES = [
+    (r"stage", P("pp")),
+    (r".*", P("pp")),
+]
 
 
 def _pipe_local(params, x, stage_fn, axis_name, n_micro):

@@ -24,7 +24,17 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .compat import shard_map
 
-__all__ = ["attention", "ring_attention"]
+__all__ = ["attention", "ring_attention", "PARTITION_RULES"]
+
+# The ring layout as a partition-rule set: sequence parallelism shards
+# ACTIVATIONS (q/k/v along S over ``sp``); the projection parameters
+# feeding it stay fully replicated — each device runs the full
+# projection on its sequence slice. An explicit everything-replicates
+# rule (rather than relying on the UNMATCHED default) makes the layout
+# a statement the error policy can enforce.
+PARTITION_RULES = [
+    (r".*", P()),
+]
 
 
 def attention(q, k, v, causal=False, scale=None, q_offset=0, kv_offset=0):

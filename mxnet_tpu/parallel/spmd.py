@@ -22,36 +22,80 @@ from ..base import MXNetError
 from .. import telemetry
 
 __all__ = ["SPMDTrainer", "shard_params_rule", "DataParallelSpec",
-           "dp_spec", "dist_dp_spec", "is_process_spanning",
+           "dp_spec", "rule_spec", "dist_dp_spec", "is_process_spanning",
            "check_batch_divisible", "shard_put", "dist_shard_put",
            "put_replicated_local", "broadcast_from_zero", "local_value",
-           "commit_dp_placements", "DP_AXIS"]
+           "commit_dp_placements", "DP_AXIS", "MP_AXIS"]
 
 # the canonical data-parallel axis name shared by the Module mesh path,
 # the executor's SPMD train-step program and the bench/probe lanes
 DP_AXIS = "dp"
+# the canonical model-parallel axis name the partition-rule engine
+# shards parameters over on a 2-D (dp, mp) mesh
+MP_AXIS = "mp"
 
 
 class DataParallelSpec(
         collections.namedtuple("DataParallelSpec",
-                               ["mesh", "data_sharding", "repl_sharding"])):
-    """Hashable bundle describing one data-parallel mesh: the Mesh, the
-    batch sharding (dim 0 over the dp axis) and the replicated sharding
-    for params/optimizer state/metric accumulators. Hashability matters:
-    the spec rides in ``_GraphProgram.train_step_fn``'s jit-cache key, so
-    two Modules on the same mesh share one compiled SPMD step."""
+                               ["mesh", "data_sharding", "repl_sharding",
+                                "rules", "data_axis"],
+                               defaults=(None, DP_AXIS))):
+    """Hashable bundle describing one mesh layout: the Mesh, the batch
+    sharding (dim 0 over the dp axis), the replicated sharding for
+    step scalars/metric accumulators — and, for a rule-sharded 2-D
+    (dp, mp) mesh, the ``parallel.partition.PartitionRules`` tree that
+    resolves per-PARAMETER placements (``rules is None`` keeps the
+    original everything-replicated dp layout). Hashability matters:
+    the spec rides in ``_GraphProgram.train_step_fn``'s jit-cache key,
+    so two Modules on the same mesh + rule set share one compiled SPMD
+    step."""
     __slots__ = ()
 
     @property
     def num_devices(self):
         return self.mesh.devices.size
 
+    @property
+    def dp_size(self):
+        """Size of the data axis — what the batch dim must divide by
+        (NOT the device count: on a 2-D dp x mp mesh only dp splits
+        the batch)."""
+        return int(dict(self.mesh.shape).get(self.data_axis, 1))
+
+    @property
+    def mp_size(self):
+        """Product of the non-data axes (1 on a pure dp mesh)."""
+        return self.num_devices // max(self.dp_size, 1)
+
+    def param_sharding(self, name, shape):
+        """The rule-resolved ``NamedSharding`` for one parameter (the
+        replicated sharding when no rule tree is bound)."""
+        if self.rules is None:
+            return self.repl_sharding
+        from .partition import sharding_for
+        return sharding_for(self.mesh, name, shape,
+                            self.rules.spec_for(name, shape))
+
 
 def dp_spec(mesh, data_axis=DP_AXIS):
     """DataParallelSpec for a one-axis data-parallel mesh."""
     return DataParallelSpec(mesh,
                             NamedSharding(mesh, P(data_axis)),
-                            NamedSharding(mesh, P()))
+                            NamedSharding(mesh, P()),
+                            None, data_axis)
+
+
+def rule_spec(mesh, rules, data_axis=DP_AXIS):
+    """Spec for a rule-sharded (possibly 2-D dp x mp) mesh: batch over
+    ``data_axis``, parameters by the ``PartitionRules`` tree (None =
+    replicate everything — the plain dp layout on a reshaped mesh)."""
+    if data_axis not in mesh.axis_names:
+        raise MXNetError("rule_spec: mesh %s has no %r data axis"
+                         % (tuple(mesh.axis_names), data_axis))
+    return DataParallelSpec(mesh,
+                            NamedSharding(mesh, P(data_axis)),
+                            NamedSharding(mesh, P()),
+                            rules, data_axis)
 
 
 def is_process_spanning(mesh):
@@ -184,12 +228,23 @@ def local_value(garr):
     return np.concatenate([np.asarray(s.data) for s in shards], axis=0)   # mxlint: disable=host-sync -- same: local shard reads on the detach path
 
 
-def check_batch_divisible(batch_dim, n_devices, what="batch size"):
+def check_batch_divisible(batch_dim, n_devices, what="batch size",
+                          axis=None):
     """The ONE owner of the dp divisibility rule: bind-time shape checks
     (Module bind / executor-group construction) and per-step feeds (a
     variable-shape batch swapped in mid-training) raise the same clear
-    error instead of padding silently or dying inside XLA."""
+    error instead of padding silently or dying inside XLA.
+
+    ``axis`` names the mesh axis the batch divides over: on a 2-D
+    dp x mp mesh "batch 6 not divisible by 8 devices" would be WRONG —
+    the batch divides by ``dp``, not by the device count — so mesh
+    callers pass the axis and the error names it."""
     if batch_dim % n_devices != 0:
+        if axis is not None:
+            raise MXNetError(
+                "%s %d not divisible by the %r mesh axis (size %d; the "
+                "batch shards over %r only, not over every device)"
+                % (what, batch_dim, axis, n_devices, axis))
         raise MXNetError("%s %d not divisible by %d devices"
                          % (what, batch_dim, n_devices))
 
@@ -201,34 +256,44 @@ def shard_put(raw, sharding):
     loop, executor_group.py:266). Host-resident inputs count toward the
     telemetry h2d-bytes register; device-side reshards do not. Every
     sharded batch also enters the live device-buffer LEDGER under its
-    mesh's context key (global bytes; released when the buffer dies),
-    so an OOM mid-feed names the in-flight batches alongside the
-    executor's resident arrays."""
+    mesh's context key (released when the buffer dies), so an OOM
+    mid-feed names the in-flight batches alongside the executor's
+    resident arrays. The ledger charge is the summed PER-SHARD bytes
+    across the mesh (``partition.committed_nbytes``): an mp-sharded
+    parameter charges 1/mp of a replicated copy per device, not the
+    replicated global size."""
     with telemetry.span("shard_put"):
         if isinstance(raw, np.ndarray):
             telemetry.record_transfer(raw.nbytes)
         out = jax.device_put(raw, sharding)
         if telemetry.enabled():
+            from .partition import committed_nbytes
             try:
                 n_dev = len(sharding.device_set)
             except AttributeError:
                 n_dev = 0
             telemetry.ledger_track(
-                out, "mesh(%ddev)" % n_dev,
-                int(out.size) * out.dtype.itemsize,
+                out, "mesh(%ddev)" % n_dev, committed_nbytes(out),
                 shape=out.shape, dtype=out.dtype, kind="shard_put")
         return out
 
 
 def commit_dp_placements(executor, input_names, spec, sync=True,
                          gate=None):
-    """Commit the dp-mesh placements on ONE bound executor's storage:
+    """Commit the mesh placements on ONE bound executor's storage:
     batch-like inputs (data/labels/states, all batch-major) shard over
-    the data axis, params/grads/aux replicate. The ONE owner of the
-    placement rule — Module._shard_exec_arrays and the multi-context
+    the data axis; params/grads/aux take their RULE-resolved placement
+    (``spec.param_sharding`` — replicated on a plain dp spec, per-
+    parameter mp shards under a ``PartitionRules`` tree; a gradient
+    rides its parameter's placement, so the psum GSPMD inserts reduces
+    over ``dp`` only). The ONE owner of the placement rule —
+    Module._shard_exec_arrays and the multi-context
     DataParallelExecutorGroup facade both call this, so the two can
     never drift. GSPMD propagates from these committed placements for
-    every program the executor runs.
+    every program the executor runs. Committed parameters are charged
+    on the buffer ledger under the mesh context key at their summed
+    per-shard size (kind ``param``, replacing any prior commit charge)
+    — the figure the mp-smoke lane gates 1/mp savings on.
 
     ``gate``: the caller's pre-collective :class:`CollectiveGate`,
     crossed before the rank-0 sync broadcast on the process-spanning
@@ -237,14 +302,47 @@ def commit_dp_placements(executor, input_names, spec, sync=True,
     collective-discipline check drove this). In-process callers (the
     local dp facade) have no cross-process exchange and pass None."""
     if not is_process_spanning(spec.mesh):
+        from .partition import committed_nbytes
+        ctx_key = "mesh(%ddev)" % spec.num_devices
+        arg_names = list(executor.arg_dict)
+
+        def _track_param(arr):
+            if telemetry.enabled():
+                telemetry.ledger_track(
+                    arr, ctx_key, committed_nbytes(arr._data),
+                    shape=arr._data.shape, dtype=arr._data.dtype,
+                    kind="param", replace=True)
+
         for name, arr in executor.arg_dict.items():
-            sh = spec.data_sharding if name in input_names \
-                else spec.repl_sharding
-            arr._set_data(jax.device_put(arr._data, sh))
-        for arr in list(executor.grad_arrays) + list(executor.aux_arrays):
+            if name in input_names:
+                arr._set_data(jax.device_put(arr._data,
+                                             spec.data_sharding))
+            else:
+                arr._set_data(jax.device_put(
+                    arr._data, spec.param_sharding(name, arr.shape)))
+                _track_param(arr)
+        # a gradient lives where its parameter does (the optimizer step
+        # reads both; mismatched placements would reshard every step);
+        # input gradients (inputs_need_grad) are batch-major like their
+        # input
+        for name, arr in zip(arg_names, executor.grad_arrays):
             if arr is not None:
-                arr._set_data(jax.device_put(arr._data, spec.repl_sharding))
+                sh = spec.data_sharding if name in input_names \
+                    else spec.param_sharding(name, arr.shape)
+                arr._set_data(jax.device_put(arr._data, sh))
+        for name, arr in executor.aux_dict.items():
+            if arr is not None:
+                arr._set_data(jax.device_put(
+                    arr._data, spec.param_sharding(name, arr.shape)))
+                _track_param(arr)
         return
+    if spec.rules is not None:
+        # the dist tier commits replicated state via one rank-0
+        # broadcast; re-sharding rule trees across worker processes is
+        # not wired yet (ROADMAP: multi-host mp)
+        raise MXNetError("partition rules are not supported on a "
+                         "process-spanning mesh yet; use a dp-only "
+                         "dist spec")
     # process-spanning commit (the dist tier): replicated state is
     # synchronised from rank 0 in ONE host broadcast — parity with the
     # reference's kv.init-then-pull worker seeding, and the guarantee

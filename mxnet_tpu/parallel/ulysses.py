@@ -27,7 +27,19 @@ from .compat import shard_map
 
 from .ring_attention import attention as _plain_attention
 
-__all__ = ["ulysses_attention"]
+__all__ = ["ulysses_attention", "PARTITION_RULES"]
+
+# The Ulysses layout as a partition-rule set: attention runs with the
+# HEAD axis sharded over ``sp`` (the all_to_all re-shards activations
+# seq->heads), so head-major projection weights — (H*D, E) q/k/v
+# producers laid out head-major on dim 0 — shard over ``sp`` while the
+# output projection consumes head-major dim 1. Everything else
+# replicates.
+PARTITION_RULES = [
+    (r"(q|k|v)_proj.*weight$", P("sp", None)),
+    (r"out_proj.*weight$", P(None, "sp")),
+    (r".*", P()),
+]
 
 
 def _ulysses_local(q, k, v, axis_name, causal, scale, use_pallas):

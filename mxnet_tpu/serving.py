@@ -293,6 +293,19 @@ class InferenceEngine:
         the breaker into fast-fail (``CircuitOpen``); 0 disables
     breaker_reset_s : float — open-state cooldown before ONE half-open
         trial batch is allowed through (success closes the breaker)
+    partition_rules : optional ``parallel.partition.PartitionRules`` —
+        the SAME rule tree training uses: parameters commit
+        device-resident mp-SHARDED across every bucket (a model that
+        exceeds one chip's HBM serves from N chips without
+        replication), GSPMD inserting the collectives each bucket's
+        forward needs. Requires ``contexts``.
+    mesh_axes : optional ordered ``{axis: size}`` laying ``contexts``
+        out as the serving mesh (default ``{"dp": 1, "mp": -1}`` — all
+        serving devices model-parallel; a ``dp`` axis > 1 additionally
+        splits each bucket's batch, so every bucket size must divide
+        by it)
+    contexts : optional Context list backing the serving mesh (with
+        ``partition_rules``); defaults to the single ``ctx``
     """
 
     def __init__(self, symbol=None, params=None, input_shapes=None,
@@ -301,7 +314,8 @@ class InferenceEngine:
                  predictor=None, buckets=None, autotune=False,
                  max_queue_rows=None, deadline_ms=None, overload="shed",
                  retry_budget=2, retry_backoff_ms=5.0,
-                 breaker_threshold=5, breaker_reset_s=30.0):
+                 breaker_threshold=5, breaker_reset_s=30.0,
+                 partition_rules=None, mesh_axes=None, contexts=None):
         if predictor is None:
             if symbol is None or input_shapes is None:
                 raise MXNetError("InferenceEngine needs (symbol, params, "
@@ -319,6 +333,19 @@ class InferenceEngine:
         self._device = self._ctx.jax_device()
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_ms) / 1e3
+        # partition-rule serving: the SAME rule tree training uses
+        # commits the params mp-sharded over a serving mesh, shared by
+        # every bucket program (GSPMD inserts the per-bucket
+        # collectives); batches land via the spec's dp sharding. Built
+        # BEFORE the autotune plan load — the plan records the layout.
+        self._mesh_spec = None
+        if partition_rules is not None or mesh_axes:
+            from .parallel import mesh as _pmesh, spmd as _spmd
+            ctxs = list(contexts) if contexts else [self._ctx]
+            mesh = _pmesh.mesh_from_contexts(
+                ctxs, axes=dict(mesh_axes) if mesh_axes
+                else {_spmd.DP_AXIS: 1, _spmd.MP_AXIS: -1})
+            self._mesh_spec = _spmd.rule_spec(mesh, partition_rules)
         self._autotune_plan = None
         if autotune and buckets is None:
             plan = self._load_plan()
@@ -343,6 +370,26 @@ class InferenceEngine:
         self._param_raw = {n: a._data for n, a in ex.arg_dict.items()
                            if n not in self._input_names and n not in auto}
         self._aux_raw = {n: a._data for n, a in ex.aux_dict.items()}
+        # commit the shared device-resident params/aux onto the
+        # partition mesh (every bucket program reads these buffers)
+        if self._mesh_spec is not None:
+            from .parallel import spmd as _spmd
+            spec = self._mesh_spec
+            if spec.dp_size > 1:
+                bad = [b for b in self.buckets if b % spec.dp_size]
+                if bad:
+                    raise MXNetError(
+                        "serving: bucket size(s) %s not divisible by "
+                        "the %r mesh axis (size %d)"
+                        % (bad, spec.data_axis, spec.dp_size))
+            self._param_raw = {
+                n: _spmd.shard_put(
+                    r, spec.param_sharding(n, tuple(r.shape)))
+                for n, r in self._param_raw.items()}
+            self._aux_raw = {
+                n: _spmd.shard_put(
+                    r, spec.param_sharding(n, tuple(r.shape)))
+                for n, r in self._aux_raw.items()}
         # inference-time dummies (loss-layer labels) are batch-shaped:
         # one zero set per bucket, built lazily in _bucket_extras —
         # from the MAIN thread (warmup) and the coalescer/drain threads
@@ -408,6 +455,34 @@ class InferenceEngine:
         if warmup:
             self.warmup()
 
+    def _put_batch(self, buf):
+        """Commit one bucket-shaped host batch: sharded over the mesh
+        spec's dp axis (replicated over mp) on a partitioned engine,
+        plain single-device put otherwise."""
+        if self._mesh_spec is not None:
+            return jax.device_put(buf, self._mesh_spec.data_sharding)
+        return jax.device_put(buf, self._device)
+
+    def _put_extra(self, buf, batch_major):
+        """Commit one inference dummy: batch-major dummies ride the
+        batch placement, fixed-shape ones replicate on the mesh."""
+        if self._mesh_spec is None:
+            return jax.device_put(buf, self._device)
+        return jax.device_put(buf, self._mesh_spec.data_sharding
+                              if batch_major
+                              else self._mesh_spec.repl_sharding)
+
+    def partition_summary(self):
+        """JSON-safe layout description (None without rules) — what
+        the autotuner plan and the bucket program cards record."""
+        if self._mesh_spec is None:
+            return None
+        from .parallel.partition import partition_summary as _summary
+        params = getattr(self, "_param_raw", None)
+        return _summary(self._mesh_spec,
+                        {n: tuple(r.shape) for n, r in params.items()}
+                        if params else None)
+
     # -- program cache ------------------------------------------------------
     def _load_plan(self):
         """The autotuner plan for this engine's ``max_batch`` from the
@@ -421,7 +496,8 @@ class InferenceEngine:
             from .tuner import plan_serving
             records = compile_cache.corpus_records(kind="serving")
             return plan_serving(records, max_batch=self.max_batch,
-                                graph=self._prog.graph_fingerprint())
+                                graph=self._prog.graph_fingerprint(),
+                                layout=self.partition_summary())
         except Exception as e:
             from . import log as _log
             _log.get_logger("mxnet_tpu.serving").warning(
@@ -447,9 +523,9 @@ class InferenceEngine:
             for b in self.buckets:
                 args = dict(self._param_raw)
                 for n in self._input_names:
-                    args[n] = jax.device_put(
+                    args[n] = self._put_batch(
                         np.zeros((b,) + self._row_shapes[n],
-                                 self._in_dtypes[n]), self._device)
+                                 self._in_dtypes[n]))
                 args.update(self._bucket_extras(b))
                 if build is not None:
                     build(args, self._aux_raw, self._rng)
@@ -464,6 +540,13 @@ class InferenceEngine:
             for cid in self.program_cards():
                 telemetry.card_annotate(cid,
                                         autotune_plan=self._autotune_plan)
+        layout = self.partition_summary()
+        if layout is not None:
+            # per-bucket cards carry the layout the bucket ran under —
+            # a card corpus mixing replicated and mp-sharded rows stays
+            # attributable
+            for cid in self.program_cards():
+                telemetry.card_annotate(cid, partition=layout)
 
     def _infer_dummy_shapes(self, bucket):
         """{arg name: inferred shape} at one batch size."""
@@ -540,7 +623,8 @@ class InferenceEngine:
                         raise MXNetError("serving: cannot infer dummy "
                                          "shape for %r at bucket %d"
                                          % (n, bucket))
-                extras[n] = jax.device_put(np.zeros(shp, dt), self._device)
+                extras[n] = self._put_extra(np.zeros(shp, dt),
+                                            batch_major=row is not None)
         self._extras[bucket] = extras
         return extras
 
@@ -826,6 +910,10 @@ class InferenceEngine:
             "graph": self._prog.graph_fingerprint(),
             "max_batch": self.max_batch,
             "buckets": list(self.buckets),
+            # the layout this traffic was measured under: a corpus row
+            # banked from an mp-sharded engine must not plan a
+            # replicated one as if the step costs were comparable
+            "layout": self.partition_summary(),
             "max_inflight": self._max_inflight,
             "max_wait_ms": round(self.max_wait_s * 1e3, 3),
             "requests": st["requests"],
@@ -1175,7 +1263,7 @@ class InferenceEngine:
                     off += r.rows
                 pad_bytes += (bucket - rows) * buf[0].nbytes
                 telemetry.record_transfer(buf.nbytes)
-                args[n] = jax.device_put(buf, self._device)
+                args[n] = self._put_batch(buf)
             args.update(self._bucket_extras(bucket))
             attempt = 0
             while True:

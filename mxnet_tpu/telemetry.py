@@ -136,6 +136,7 @@ COUNTERS = (
     "flight.postmortem", "flight.postmortem_fail",
     "dispatch.*", "jit.*", "recompile.*",
     "fused_fallback.*",
+    "partition.replicated_fallback",
     "faults.injected", "faults.injected.*",
     "transfer.*", "host_sync.*",
     "kvstore.push", "kvstore.pull", "kvstore.wire_bytes",
@@ -212,7 +213,13 @@ _ledger = {}        # guarded by: _lock
                     # ctx key -> {alive_bytes, alive_count, peak_bytes,
                     #             tracked_total, tracked_bytes_total}
 _ledger_live = {}   # guarded by: _lock
-                    # token -> (ctx_key, nbytes, shape, dtype, kind)
+                    # token -> (ctx_key, nbytes, shape, dtype, kind,
+                    #           keyed_key_or_None)
+_ledger_keyed = {}  # guarded by: _lock
+                    # (id(obj), ctx_key, kind) -> token, for
+                    # replace=True re-tracking (a re-committed
+                    # parameter replaces its prior charge instead of
+                    # double-counting)
 _ledger_seq = itertools.count(1)
 # released tokens land here LOCK-FREE and are drained under _lock by
 # the next ledger operation. The finalize callback must NOT take
@@ -751,6 +758,25 @@ def _ledger_release(token):
         pass
 
 
+def _ledger_release_one_locked(token):
+    """Retire ONE live token's charge. Caller holds _lock."""
+    rec = _ledger_live.pop(token, None)
+    if rec is None:
+        return
+    st = _ledger.get(rec[0])
+    if st is not None:
+        st["alive_bytes"] -= rec[1]
+        st["alive_count"] -= 1
+        bk = st["by_kind"]
+        bk[rec[4]] = bk.get(rec[4], 0) - rec[1]
+    # a replace-keyed charge drops its reverse-map entry with it (only
+    # if the key still maps to THIS token — a re-track may already have
+    # claimed it for a newer charge)
+    kk = rec[5]
+    if kk is not None and _ledger_keyed.get(kk) == token:
+        del _ledger_keyed[kk]
+
+
 def _ledger_drain_locked():
     """Apply pending releases to the counters. Caller holds _lock."""
     while True:
@@ -758,24 +784,24 @@ def _ledger_drain_locked():
             token = _ledger_pending.popleft()
         except IndexError:
             return
-        rec = _ledger_live.pop(token, None)
-        if rec is None:
-            continue
-        st = _ledger.get(rec[0])
-        if st is not None:
-            st["alive_bytes"] -= rec[1]
-            st["alive_count"] -= 1
+        _ledger_release_one_locked(token)
 
 
 def ledger_track(obj, ctx_key, nbytes, shape=None, dtype=None,
-                 kind="ndarray"):
+                 kind="ndarray", replace=False):
     """Charge ``nbytes`` on context ``ctx_key`` until ``obj`` is
     garbage-collected (weakref.finalize releases the charge). Tracks
     the FRAMEWORK's view — aliasing wrappers (detach, shared _data)
     each count, so alive-bytes is an upper bound of framework-held
     device memory, reconciled against PJRT's own counters by
     ``Storage.ledger_report()``. No-op while disabled (but releases
-    always run, so toggling never corrupts the counters)."""
+    always run, so toggling never corrupts the counters).
+
+    ``replace=True`` keys the charge on ``(obj, ctx_key, kind)`` and
+    retires any prior live charge under the same key first — the
+    re-commit path (a parameter re-placed on its mesh after
+    init_params / a plan rebuild) updates its charge instead of
+    double-counting the same storage."""
     if not _state.enabled:
         return
     nbytes = int(nbytes)
@@ -790,23 +816,36 @@ def ledger_track(obj, ctx_key, nbytes, shape=None, dtype=None,
         if st is None:
             st = _ledger[ctx_key] = {
                 "alive_bytes": 0, "alive_count": 0, "peak_bytes": 0,
-                "tracked_total": 0, "tracked_bytes_total": 0}
+                "tracked_total": 0, "tracked_bytes_total": 0,
+                "by_kind": {}}
         st["tracked_total"] += 1
         st["tracked_bytes_total"] += nbytes
         if token is not None:
+            keyed_key = None
+            if replace:
+                keyed_key = (id(obj), ctx_key, kind)
+                prior = _ledger_keyed.pop(keyed_key, None)
+                if prior is not None:
+                    _ledger_release_one_locked(prior)
+                _ledger_keyed[keyed_key] = token
             st["alive_bytes"] += nbytes
             st["alive_count"] += 1
+            st["by_kind"][kind] = st["by_kind"].get(kind, 0) + nbytes
             if st["alive_bytes"] > st["peak_bytes"]:
                 st["peak_bytes"] = st["alive_bytes"]
-            _ledger_live[token] = (ctx_key, nbytes, shape, dtype, kind)
+            _ledger_live[token] = (ctx_key, nbytes, shape, dtype, kind,
+                                   keyed_key)
 
 
 def ledger():
     """{ctx: {alive_bytes, alive_count, peak_bytes, tracked_total,
-    tracked_bytes_total}} copy of the per-context ledger counters."""
+    tracked_bytes_total, by_kind}} copy of the per-context ledger
+    counters (``by_kind``: live bytes per track kind — e.g. committed
+    ``param`` bytes vs in-flight ``shard_put`` batches on a mesh)."""
     with _lock:
         _ledger_drain_locked()
-        return {k: dict(v) for k, v in _ledger.items()}
+        return {k: dict(v, by_kind=dict(v["by_kind"]))
+                for k, v in _ledger.items()}
 
 
 def ledger_top(n=8):

@@ -307,8 +307,25 @@ def _plan_inflight(records, default=2, cap=8):
     return max(1, min(int(cap), 1 + int(math.ceil(d2h_ms / batch_ms))))
 
 
+def _layout_key(layout):
+    """Comparable identity of one partition layout: mesh axes + data
+    axis + the RULE TREE, with the resolved ``sharded_params`` map
+    stripped — that field is derived from whichever parameter shapes
+    happened to be in hand when the summary was taken (an engine
+    summarising at plan-load time has none yet; a corpus row banked at
+    close has all of them), so keeping it would make identical layouts
+    compare unequal. None (no partitioning) is its own identity."""
+    if not isinstance(layout, dict):
+        return None
+    part = layout.get("partition")
+    if isinstance(part, dict):
+        part = {k: v for k, v in part.items() if k != "sharded_params"}
+    key = dict(layout, partition=part)
+    return json.dumps(key, sort_keys=True)
+
+
 def plan_serving(records, max_batch=None, max_buckets=6,
-                 default_inflight=2, graph=None):
+                 default_inflight=2, graph=None, layout=None):
     """Deterministic serving plan from ``kind == "serving"`` corpus
     records: the bucket set minimising expected padded batch cost over
     the observed coalesced-row histogram (measured per-bucket step-ms
@@ -318,7 +335,14 @@ def plan_serving(records, max_batch=None, max_buckets=6,
     ``graph`` (an engine's ``graph_fingerprint()``) restricts planning
     to records stamped with the SAME graph — corpora are shared per
     cache dir, and another model's traffic must not shape this one's
-    buckets.
+    buckets. ``layout`` (an engine's ``partition_summary()``) rides
+    into the returned plan AND restricts planning the same way: rows
+    measured under an mp-sharded layout carry different per-bucket
+    step costs than replicated rows of the same graph — the filter
+    ALWAYS applies (a replicated engine, ``layout=None``, only plans
+    from rows with no layout stamp), comparing via ``_layout_key`` so
+    the derived ``sharded_params`` map never splits identical
+    layouts.
 
     Returns a JSON-native dict (it round-trips through the JSONL
     corpus store unchanged) or None when the corpus holds no usable
@@ -329,6 +353,8 @@ def plan_serving(records, max_batch=None, max_buckets=6,
             if isinstance(r, dict) and r.get("kind") == "serving"]
     if graph is not None:
         recs = [r for r in recs if r.get("graph") == graph]
+    lkey = _layout_key(layout)
+    recs = [r for r in recs if _layout_key(r.get("layout")) == lkey]
     if max_batch is None:
         max_batch = max((int(r.get("max_batch") or 0) for r in recs),
                         default=0)
@@ -346,6 +372,7 @@ def plan_serving(records, max_batch=None, max_buckets=6,
         "kind": "autotune_plan",
         "version": 1,
         "graph": graph,
+        "layout": layout,
         "max_batch": max_batch,
         "buckets": [int(b) for b in buckets],
         "max_inflight": _plan_inflight(recs, default=default_inflight),

@@ -21,6 +21,14 @@ DP-smoke lane:      python tools/module_fit_probe.py --dp-smoke \
   the fused-SPMD data-parallel step vs the kvstore phase-split path;
   asserts dp-fused >= phase-split img/s and EXACTLY 1 jitted-program
   dispatch per batch via the mx.telemetry dispatch registry)
+MP-smoke lane:      python tools/module_fit_probe.py --mp-smoke \
+                        [--json-out PATH]
+  (tier-1 CI: the same MLP on the 8-device CPU mesh laid out as a 2x4
+  dp x mp mesh with every parameter rule-sharded over mp
+  (parallel.partition.PartitionRules): gates 1 fused dispatch/batch,
+  zero fused fallbacks, per-device committed param bytes ~ 1/mp of
+  the replicated layout per the buffer ledger, and fused >=
+  phase-split img/s)
 """
 import json
 import os
@@ -32,6 +40,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 SMOKE = os.environ.get("MXTPU_PROBE_SMOKE", "") == "1"
 FIT_SMOKE = "--fit-smoke" in sys.argv
 DP_SMOKE = "--dp-smoke" in sys.argv
+MP_SMOKE = "--mp-smoke" in sys.argv
 DIST_SMOKE = "--dist-smoke" in sys.argv
 DIST_CHILD = "--dist-child" in sys.argv
 # a dist child that dies on an injected fault exits THROUGH
@@ -44,7 +53,7 @@ BATCH = 8 if SMOKE else 128
 IMG = 32 if SMOKE else 224
 ITERS = 2 if SMOKE else 10
 
-if DP_SMOKE:
+if DP_SMOKE or MP_SMOKE:
     # the virtual mesh flag must land before the CPU backend initialises
     _flags = os.environ.get("XLA_FLAGS", "")
     if "--xla_force_host_platform_device_count" not in _flags:
@@ -56,7 +65,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-if SMOKE or FIT_SMOKE or DP_SMOKE or DIST_SMOKE or DIST_CHILD:
+if SMOKE or FIT_SMOKE or DP_SMOKE or MP_SMOKE or DIST_SMOKE or DIST_CHILD:
     jax.config.update("jax_platforms", "cpu")
 
 import mxnet_tpu as mx
@@ -152,7 +161,7 @@ def main():
 
 
 def _smoke_lane(lane, contexts, kvstore, rounds, nbatch, batch,
-                speed_key, extra=None, json_out=None):
+                speed_key, extra=None, json_out=None, module_kwargs=None):
     """The ONE tier-1 lane harness both smoke lanes share: tiny-MLP
     ``Module.fit``, fused whole-step program vs phase-split oracle, with
     jitted-program dispatch counts per batch AND per-phase host-span
@@ -216,7 +225,8 @@ def _smoke_lane(lane, contexts, kvstore, rounds, nbatch, batch,
 
     def setup(fused):
         os.environ["MXNET_MODULE_FUSED_STEP"] = "1" if fused else "0"
-        mod = mx.mod.Module(mlp(), context=contexts)
+        mod = mx.mod.Module(mlp(), context=contexts,
+                            **(module_kwargs or {}))
         metric = mx.metric.Accuracy()
         train = _PreslicedIter()
         # warm epoch: bind + init + compile land outside the timed window
@@ -422,6 +432,121 @@ def dp_smoke(json_out=None, nbatch=12, batch=32):
         if json_out:
             with open(json_out, "w") as f:
                 f.write(json.dumps(out) + "\n")
+
+
+def _mp_rules():
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel import PartitionRules
+    # every tensor of the lane MLP shards over mp (weights row-wise,
+    # biases element-wise) — the per-device parameter footprint drops
+    # to ~1/mp of the replicated layout, which the ledger gate below
+    # pins
+    return PartitionRules([
+        (r"fc\d+_weight$", P("mp", None)),
+        (r"fc\d+_bias$", P("mp")),
+    ])
+
+
+MP_AXES = {"dp": 2, "mp": 4}
+
+
+def _mp_ledger_param_bytes(module_kwargs, contexts, batch):
+    """Per-device committed parameter bytes of one freshly bound lane
+    module, per the buffer LEDGER (the ``param`` kind under the mesh
+    context key tracks summed per-shard bytes across devices)."""
+    import gc
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.io import DataDesc
+    d, c = 16, 4
+
+    def mlp():
+        data = mx.sym.Variable("data")
+        net = mx.sym.FullyConnected(data, num_hidden=64, name="fc1")
+        net = mx.sym.Activation(net, act_type="relu")
+        net = mx.sym.FullyConnected(net, num_hidden=c, name="fc2")
+        return mx.sym.SoftmaxOutput(net, name="softmax")
+
+    # collect any earlier module's parameter wrappers first: their live
+    # ledger charges under the same mesh key would pollute this reading
+    gc.collect()
+    telemetry.reset()
+    mod = mx.mod.Module(mlp(), context=contexts, **(module_kwargs or {}))
+    mod.bind(data_shapes=[DataDesc("data", (batch, d))],
+             label_shapes=[DataDesc("softmax_label", (batch,))])
+    mod.init_params(mx.initializer.Xavier())
+    led = telemetry.ledger().get("mesh(%ddev)" % len(contexts), {})
+    total = led.get("by_kind", {}).get("param", 0)
+    return total / max(len(contexts), 1)
+
+
+def mp_smoke(json_out=None, nbatch=12, batch=32):
+    """Tier-1 mp lane (ISSUE 15): tiny-MLP ``Module.fit`` on the
+    8-device CPU mesh laid out as a 2x4 dp x mp mesh with every
+    parameter rule-sharded over ``mp``, vs the kvstore phase-split
+    path on the same layout. Gates the four load-bearing dp x mp
+    properties:
+
+    - EXACTLY 1 fused dispatch per batch (the 2-D layout still ships
+      one donated SPMD program);
+    - ZERO fused fallbacks (the rules path never silently phase-splits
+      — the lane harness raises on any fused-leg fallback and the
+      dispatch-count gate re-checks the banked window);
+    - params-alive bytes per device ~ 1/mp of the replicated layout,
+      per the buffer ledger's committed ``param`` accounting;
+    - fused throughput >= the phase-split path."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+
+    n_dev = min(N_DEV, jax.device_count())
+    assert n_dev >= 8, "mp-smoke needs the 8-device virtual CPU mesh"
+    contexts = [mx.cpu(i) for i in range(n_dev)]
+    mp = MP_AXES["mp"]
+    module_kwargs = {"partition_rules": _mp_rules(),
+                     "mesh_axes": dict(MP_AXES)}
+    out, dispatch = _smoke_lane(
+        "module_fit_mp_smoke", contexts, "device", rounds=5,
+        nbatch=nbatch, batch=batch, speed_key="mp_speedup",
+        extra={"n_devices": n_dev, "mesh_axes": dict(MP_AXES)},
+        json_out=None, module_kwargs=module_kwargs)
+    # ledger leg: per-device committed param bytes, rules vs replicated
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    try:
+        per_dev_mp = _mp_ledger_param_bytes(module_kwargs, contexts,
+                                            batch)
+        per_dev_repl = _mp_ledger_param_bytes(None, contexts, batch)
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+    ratio = per_dev_mp / per_dev_repl if per_dev_repl else None
+    out["ledger"] = {
+        "param_bytes_per_device_mp": per_dev_mp,
+        "param_bytes_per_device_replicated": per_dev_repl,
+        "ratio": None if ratio is None else round(ratio, 4),
+        "mp": mp,
+    }
+    try:
+        # 1 dispatch/batch, and the banked fused window saw ONLY the
+        # fused program (zero fallbacks: a phase-split batch would add
+        # fwd_bwd/opt_update dispatches to the window)
+        assert dispatch[True] == {"train_step": nbatch}, dispatch[True]
+        assert out["fused"]["dispatches_per_batch"] == 1.0, out
+        # per-device param bytes ~ 1/mp of replicated (biases and the
+        # tiny fc2 rows leave a little slack above the exact 1/mp)
+        assert ratio is not None and ratio <= 1.5 / mp, out["ledger"]
+        assert out["fused"]["img_s"] >= out["phase_split"]["img_s"], out
+        out["gates_passed"] = True
+    except AssertionError:
+        out["gates_passed"] = False
+        raise
+    finally:
+        line = json.dumps(out)
+        print(line, flush=True)
+        if json_out:
+            with open(json_out, "w") as f:
+                f.write(line + "\n")
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -805,6 +930,8 @@ if __name__ == "__main__":
         _dist_child_main()
     elif DIST_SMOKE:
         dist_smoke(json_out=_json_out_arg())
+    elif MP_SMOKE:
+        mp_smoke(json_out=_json_out_arg())
     elif DP_SMOKE:
         dp_smoke(json_out=_json_out_arg())
     elif FIT_SMOKE:
