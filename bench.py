@@ -78,6 +78,9 @@ DP_TIMEOUT = 900       # the optional data-parallel fused-vs-kvstore A/B:
 SERVE_TIMEOUT = 420    # the optional serving sweep (bucketed engine vs
                        # sequential Predictor + open-loop offered-load
                        # ladder); partial emission per load point
+DECODE_TIMEOUT = 420   # the optional autoregressive-decode sweep
+                       # (continuous-batching slot engine vs static
+                       # whole-batch waves); partial emission per leg
 TOTAL_DEADLINE = float(os.environ.get("MXTPU_BENCH_DEADLINE", "1500"))
 # consecutive failed/timed-out probes before the supervisor stops
 # burning budget on a dead tunnel and emits the diagnostic immediately
@@ -955,6 +958,101 @@ def serve_child():
     print(json.dumps(out), flush=True)
 
 
+def decode_child():
+    """Continuous-batching decode sweep (mxnet_tpu/decode.py): the
+    slot-pool engine streaming an open-loop skewed-length workload vs
+    wave-synchronized static whole-batch decode of the same work
+    through the same programs, plus per-token latency percentiles from
+    the ``serve_decode_step`` spans (coordinated-omission-free: the
+    spans time the dispatch cadence itself, with all work queued up
+    front). Smoke mode shrinks the cell (harness-logic check on CPU);
+    a real accelerator round banks the decode tokens/s trajectory
+    PERF.md tracks."""
+    import numpy as np
+    import jax
+    dev = _init_device(jax)
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.decode import DecodeEngine, AttentionDecodeCell
+
+    rng = np.random.RandomState(0)
+    if SMOKE:
+        cell = AttentionDecodeCell(vocab=256, embed=64, heads=8,
+                                   head_dim=16, max_len=64)
+        slots, waves, short, long_ = 8, 4, 4, 32
+    else:
+        cell = AttentionDecodeCell(vocab=8192, embed=512, heads=8,
+                                   head_dim=64, max_len=512)
+        slots, waves, short, long_ = 16, 4, 16, 192
+    prompt_len = 4 if SMOKE else 16
+
+    out = {"lane": "decode", "device": dev.device_kind,
+           "slots": slots, "waves": waves,
+           "gen_short": short, "gen_long": long_}
+
+    def prompt():
+        return rng.randint(1, cell.vocab - 1, prompt_len) \
+            .astype(np.int32)
+
+    _sampler_begin()
+    t_eng = time.perf_counter()
+    engine = DecodeEngine(cell, cell.init_params(1), slots=slots,
+                          max_prompt_len=prompt_len * 2,
+                          max_new_tokens=long_)
+    out["engine_startup_s"] = round(time.perf_counter() - t_eng, 3)
+    out["program_cards"] = {
+        k: {kk: c.get(kk) for kk in
+            ("kind", "flops", "peak_bytes", "compile_ms", "dispatches")}
+        for k, c in engine.program_cards().items()}
+    out["kv_cache_bytes"] = engine.stats()["kv_cache_bytes"]
+    print(json.dumps(dict(out, partial=True)), flush=True)
+
+    plan = [[(prompt(), long_ if s == 0 else short)
+             for s in range(slots)] for _ in range(waves)]
+    total_tokens = sum(n for wave in plan for _, n in wave)
+    stream = sorted((seq for wave in plan for seq in wave),
+                    key=lambda s: -s[1])
+
+    # leg 1: static whole-batch (wave-synchronized — finished lanes
+    # idle until the wave's longest member completes)
+    telemetry.reset()
+    t0 = time.perf_counter()
+    for wave in plan:
+        futs = [engine.submit(p, max_new_tokens=n) for p, n in wave]
+        for f in futs:
+            f.result(timeout=600)
+    dt_static = time.perf_counter() - t0
+    out["static_tok_s"] = round(total_tokens / dt_static, 1)
+    print(json.dumps(dict(out, partial=True)), flush=True)
+
+    # leg 2: continuous — same work, open-loop, per-step admission
+    telemetry.reset()
+    t0 = time.perf_counter()
+    futs = [engine.submit(p, max_new_tokens=n) for p, n in stream]
+    for f in futs:
+        f.result(timeout=600)
+    dt_cont = time.perf_counter() - t0
+    snap = telemetry.snapshot()
+    lat = snap["spans"].get("serve_decode_step", {})
+    out.update({
+        "total_tokens": total_tokens,
+        "continuous_tok_s": round(total_tokens / dt_cont, 1),
+        "decode_speedup": round(dt_static / dt_cont, 2),
+        "token_latency_ms": {k: lat.get(k)
+                             for k in ("p50_ms", "p95_ms", "p99_ms")},
+        "jit_compiles_timed": snap["spans"].get(
+            "jit_compile", {}).get("count", 0),
+        "counters": {k: v for k, v in snap["counters"].items()
+                     if k.startswith("decode.")},
+    })
+    out["series"] = _series_window()
+    st = engine.stats()
+    out["stats"] = {k: st.get(k) for k in
+                    ("tokens", "steps", "slot_fill", "shed_requests",
+                     "retries", "dispatch_failures")}
+    engine.close()       # appends the decode corpus record when configured
+    print(json.dumps(out), flush=True)
+
+
 def _write_dp_artifact(obj):
     """MULTICHIP artifact schema superset: n_devices/ok/skipped plus the
     per-axis-size img/s table (ok=False+truncated=True until the sweep
@@ -1176,6 +1274,21 @@ def supervise():
             print("bench: serve phase yielded no number (raw result kept)",
                   file=sys.stderr, flush=True)
 
+    # autoregressive decode sweep (continuous-batching slot engine vs
+    # static whole-batch waves) — optional, banked as partials
+    if (os.environ.get("MXTPU_BENCH_DECODE", "1") == "1"
+            and remaining() > 120):
+        dc_out, _ = _run_phase("--decode-child",
+                               phase_budget(DECODE_TIMEOUT),
+                               env_extra=_phase_cache_env())
+        if dc_out and dc_out.get("lane") == "decode":
+            out["decode"] = {k: v for k, v in dc_out.items()
+                             if k not in ("lane", "partial")}
+            print(json.dumps(dict(out, partial=True)), flush=True)
+        else:
+            print("bench: decode phase yielded no number (raw result "
+                  "kept)", file=sys.stderr, flush=True)
+
     # opportunistic A/B of the fused BN-tail kernel (PERF.md: the
     # end-to-end number, not the isolated pass, decides the knob)
     if (os.environ.get("MXTPU_BENCH_AB", "1") == "1"
@@ -1209,5 +1322,7 @@ if __name__ == "__main__":
         mp_child()
     elif "--serve-child" in _argv:
         serve_child()
+    elif "--decode-child" in _argv:
+        decode_child()
     else:
         sys.exit(supervise())

@@ -67,6 +67,7 @@ from .kvstore import KVStore         # noqa: E402
 from . import gradient_compression  # noqa: E402
 from . import predictor              # noqa: E402
 from . import serving                # noqa: E402
+from . import decode                 # noqa: E402
 from . import callback               # noqa: E402
 from . import model                  # noqa: E402
 from . import module                 # noqa: E402

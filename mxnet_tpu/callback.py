@@ -117,6 +117,9 @@ class TelemetryLogger:
         self._seen_programs = set()
         self._last_serving = None
         self._last_serve_total = 0
+        self._last_decode = None
+        self._last_decode_ts = None
+        self._last_decode_total = 0
         self._last_series_ts = None
 
     def _rebase(self, count):
@@ -221,6 +224,79 @@ class TelemetryLogger:
         if retries:
             msg += "\tretries=%d" % retries
         trips = delta.get("serving.breaker_trips", 0)
+        if trips:
+            msg += "\tbreaker_trips=%d" % trips
+        self.logger.info(msg)
+
+    def log_decode(self, engine=None, force=False):
+        """One decode-window log line (tokens/s, active slots, slot-pool
+        fill, per-token p50/p95/p99): a running ``decode.DecodeEngine``
+        built with ``telemetry_logger=`` calls this after every decode
+        step; every ``frequent`` steps one line lands. ``force=True``
+        (the engine's close()) flushes a final partial window. Reads
+        the ``decode.*`` counters and ``serve_decode_step`` spans from
+        the same process-global registry as everything else; ``engine``
+        (when given) contributes the instantaneous slot occupancy."""
+        import time as _time
+        t = self._telemetry
+        cur = t.counters()
+        steps = cur.get("decode.steps", 0)
+        now = _time.monotonic()
+        if self._last_decode is None:
+            self._last_decode = cur
+            self._last_decode_ts = now
+            self._last_decode_total = t.span_count("serve_decode_step")
+            if not force:
+                return
+        last = self._last_decode
+        ns = steps - last.get("decode.steps", 0)
+        if ns < 0:          # someone reset() the registry mid-window
+            self._last_decode = cur
+            self._last_decode_ts = now
+            self._last_decode_total = t.span_count("serve_decode_step")
+            return
+        if not force and ns < self.frequent:
+            return
+        if ns == 0 and not force:
+            return
+        elapsed = max(now - (self._last_decode_ts or now), 1e-9)
+        self._last_decode = cur
+        self._last_decode_ts = now
+        delta = {k: v - last.get(k, 0) for k, v in cur.items()
+                 if k.startswith("decode.")}
+        if self._programs:
+            self._log_new_programs()
+        tokens = delta.get("decode.tokens", 0)
+        msg = ("decode: steps=%d tokens=%d tok/s=%.1f"
+               % (ns, tokens, tokens / elapsed))
+        # mean decode batch over the window = tokens per step; with the
+        # engine at hand the INSTANTANEOUS occupancy rides along too
+        if ns:
+            msg += "\tmean_batch=%.2f" % (tokens / float(ns))
+        if engine is not None:
+            ov = engine.overload_state()
+            slots = ov.get("slots") or 1
+            msg += "\tactive_slots=%d/%d fill=%.2f" % (
+                ov.get("active_slots", 0), slots,
+                ov.get("active_slots", 0) / float(slots))
+        # per-token percentiles over THIS window's step spans only
+        durs = t.span_durations("serve_decode_step")
+        total = t.span_count("serve_decode_step")
+        k = min(max(total - self._last_decode_total, 0), len(durs))
+        self._last_decode_total = total
+        window = sorted(durs[-k:]) if k else []
+        if window:
+            pct = t._percentile            # the ONE percentile rule
+            msg += "\ttok p50/p95/p99=%.2f/%.2f/%.2fms" % (
+                pct(window, 50) * 1e3, pct(window, 95) * 1e3,
+                pct(window, 99) * 1e3)
+        shed = delta.get("decode.shed", 0)
+        if shed:
+            msg += "\tshed=%d" % shed
+        retries = delta.get("decode.retries", 0)
+        if retries:
+            msg += "\tretries=%d" % retries
+        trips = delta.get("decode.breaker_trips", 0)
         if trips:
             msg += "\tbreaker_trips=%d" % trips
         self.logger.info(msg)

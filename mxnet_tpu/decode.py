@@ -1,0 +1,1148 @@
+"""Slot-based continuous batching for autoregressive decode.
+
+The serving tier (``serving.InferenceEngine``) batches STATELESS
+forwards: every request is one row, every dispatch forgets. The
+workload that actually melts production serving — autoregressive
+decode, where each live sequence owns device-resident state (an LSTM
+hidden/cell pair, a KV-cached attention history) and produces ONE
+token per model step — needs the opposite shape: state stays put,
+tokens stream, and the batch composition changes every step.
+
+``DecodeEngine`` runs a fixed pool of S SLOTS. Each slot holds one
+live sequence's state inside a cache PYTREE whose leaves are
+slot-major ``(S, ...)`` arrays. Sequences are admitted and retired
+PER STEP (continuous batching): a finishing sequence frees its slot
+at the step boundary and a queued prompt takes it immediately, so
+the decode batch stays full while a static whole-batch decoder would
+idle its finished lanes until the longest member completes.
+
+Two AOT program families compile through
+``executor._InstrumentedProgram`` (cards, ledger, compile-cache and
+the recompile diagnosis for free):
+
+* **prefill** — one program per bucketed PROMPT length: gathers one
+  slot's state, teacher-forces the padded prompt, writes the slot's
+  cache and returns the first generated token.
+* **decode** — one program per bucketed ACTIVE-SLOT count: gathers
+  the active slots by index, advances every one of them ONE token in
+  a single donated-buffer dispatch (the cache pool is donated in and
+  out — steady state allocates nothing), scatters the new state back
+  and returns the batch's next tokens. Padding lanes carry slot id S
+  (out of range): their gathers clip harmlessly and their scatters
+  ``mode="drop"``.
+
+Both families bucket their dynamic dimension (prompt length, active
+count) to powers of two, so a warmed engine's steady state records
+ZERO ``jit_compile`` spans — the decode-smoke lane gates on exactly
+that.
+
+The cache pytree is laid out by the SAME ``PartitionRules`` engine
+training and serving use (``ring_attention.DECODE_PARTITION_RULES``
+names the head-sharded attention layout): a model + cache exceeding
+one chip's HBM decodes from N chips without replication. Every cache
+leaf is charged per-shard to the buffer ledger under a ``kv_cache``
+kind via an engine-held anchor (``spmd.commit_state``) — donation
+rebinds the array wrapper every step, so the anchor is what keeps an
+OOM postmortem naming the cache instead of an anonymous buffer.
+
+Overload semantics follow serving's: bounded admission
+(shed/``QueueOverflow`` or block), per-sequence deadlines enforced
+while queued (shed at admission when the slot pool is saturated past
+deadline) and while decoding, a transient-failure retry budget, and
+a circuit breaker over consecutive dispatch failures. Each request's
+causal ``req_id`` rides the whole token stream: ``serve_wait`` →
+``serve_prefill`` → ``serve_decode_step`` × N → ``serve_detokenize``
+→ ``serve_request`` chain as flow arrows in the perfetto trace.
+"""
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .base import MXNetError
+from . import telemetry
+from . import flight
+from .executor import _InstrumentedProgram, record_dispatch
+from .serving import (DeadlineExceeded, QueueOverflow, CircuitOpen,
+                      EngineClosed, bucket_sizes, _quiet_recompile,
+                      _is_transient, _Request, _SHUTDOWN)
+from .parallel.ring_attention import attention, decode_attention
+
+__all__ = ["DecodeEngine", "DecodeResult", "AttentionDecodeCell",
+           "LSTMDecodeCell", "DeadlineExceeded", "QueueOverflow",
+           "CircuitOpen", "EngineClosed"]
+
+
+DecodeResult = collections.namedtuple("DecodeResult", ["tokens", "logits"])
+DecodeResult.__doc__ = """One finished sequence: ``tokens`` is the
+generated id list (prompt excluded, EOS included when hit);
+``logits`` is the per-token ``(len(tokens), vocab)`` array when the
+engine runs with ``keep_logits=True``, else None."""
+
+
+# -- decode cells -----------------------------------------------------------
+#
+# A cell is the pure per-sequence model the engine batches: it owns the
+# cache LAYOUT (slot-major leaf shapes + names — the names are what the
+# partition rules match) and two jit-pure functions over ONE slot's
+# state. The engine vmaps ``step`` over the gathered active slots.
+
+class AttentionDecodeCell:
+    """Single-layer KV-cached attention LM — the transformer-shaped
+    decode workload. Cache leaves ``cache/k``/``cache/v`` are
+    ``(S, H, T, D)``; under ``ring_attention.DECODE_PARTITION_RULES``
+    heads shard over ``mp`` together with the head-major projection
+    params, so per-token decode needs no resharding."""
+
+    def __init__(self, vocab, embed, heads, head_dim, max_len,
+                 dtype=np.float32):
+        self.vocab = int(vocab)
+        self.embed = int(embed)
+        self.heads = int(heads)
+        self.head_dim = int(head_dim)
+        self.max_len = int(max_len)
+        self.dtype = np.dtype(dtype)
+
+    def cache_spec(self, slots):
+        shp = (int(slots), self.heads, self.max_len, self.head_dim)
+        return {"cache/k": (shp, self.dtype), "cache/v": (shp, self.dtype)}
+
+    def init_params(self, seed=0):
+        """Deterministic host-side parameter draw (probes and tests)."""
+        rng = np.random.RandomState(seed)
+        E, H, D, V = self.embed, self.heads, self.head_dim, self.vocab
+
+        def u(fan, *shape):
+            s = 1.0 / np.sqrt(fan)
+            return rng.uniform(-s, s, shape).astype(self.dtype)
+
+        return {
+            "embed": (rng.standard_normal((V, E)) * 0.05).astype(self.dtype),
+            "wq": u(E, E, H, D), "wk": u(E, E, H, D), "wv": u(E, E, H, D),
+            "wo": u(H * D, H, D, E),
+            "head": u(E, E, V),
+        }
+
+    def prefill(self, params, state, tokens, length):
+        """Teacher-forced prompt pass over ONE slot: writes k/v for the
+        padded prompt positions ``[0, L)``, attends causally, returns
+        the new state and the logits at position ``length - 1`` (pad
+        positions past ``length`` are never attended — the causal mask
+        covers them during prefill, the running-length mask afterwards).
+        """
+        L = tokens.shape[0]
+        x = params["embed"][tokens]                        # (L, E)
+        q = jnp.einsum("le,ehd->lhd", x, params["wq"])
+        k = jnp.einsum("le,ehd->lhd", x, params["wk"])
+        v = jnp.einsum("le,ehd->lhd", x, params["wv"])
+        kh, vh = jnp.swapaxes(k, 0, 1), jnp.swapaxes(v, 0, 1)  # (H, L, D)
+        kc = state["cache/k"].at[:, :L].set(kh)
+        vc = state["cache/v"].at[:, :L].set(vh)
+        out = attention(jnp.swapaxes(q, 0, 1)[None], kh[None], vh[None],
+                        causal=True)[0]                    # (H, L, D)
+        o = lax.dynamic_index_in_dim(out, length - 1, axis=1,
+                                     keepdims=False)       # (H, D)
+        xl = lax.dynamic_index_in_dim(x, length - 1, axis=0,
+                                      keepdims=False)      # (E,)
+        h = xl + jnp.einsum("hd,hde->e", o, params["wo"])
+        return {"cache/k": kc, "cache/v": vc}, h @ params["head"]
+
+    def step(self, params, state, token, pos):
+        """One decode step for ONE slot: write this token's k/v at
+        ``pos``, attend over ``[0, pos]``, return new state + logits."""
+        x = params["embed"][token]                         # (E,)
+        q = jnp.einsum("e,ehd->hd", x, params["wq"])
+        k = jnp.einsum("e,ehd->hd", x, params["wk"])
+        v = jnp.einsum("e,ehd->hd", x, params["wv"])
+        kc = state["cache/k"].at[:, pos].set(k)
+        vc = state["cache/v"].at[:, pos].set(v)
+        att = decode_attention(q, kc, vc, pos + 1)         # (H, D)
+        h = x + jnp.einsum("hd,hde->e", att, params["wo"])
+        return {"cache/k": kc, "cache/v": vc}, h @ params["head"]
+
+
+class LSTMDecodeCell:
+    """Single-layer LSTM LM — the RNN-shaped decode workload. The
+    cache is just the hidden/cell pair per slot (``cache/h``,
+    ``cache/c``: ``(S, hidden)``); the step math is
+    ``rnn.rnn_cell.lstm_decode_step`` (same gate packing as the
+    symbolic ``LSTMCell``)."""
+
+    def __init__(self, vocab, embed, hidden, max_len,
+                 dtype=np.float32):
+        self.vocab = int(vocab)
+        self.embed = int(embed)
+        self.hidden = int(hidden)
+        self.max_len = int(max_len)
+        self.dtype = np.dtype(dtype)
+
+    def cache_spec(self, slots):
+        shp = (int(slots), self.hidden)
+        return {"cache/h": (shp, self.dtype), "cache/c": (shp, self.dtype)}
+
+    def init_params(self, seed=0):
+        rng = np.random.RandomState(seed)
+        E, Hid, V = self.embed, self.hidden, self.vocab
+
+        def u(fan, *shape):
+            s = 1.0 / np.sqrt(fan)
+            return rng.uniform(-s, s, shape).astype(self.dtype)
+
+        return {
+            "embed": (rng.standard_normal((V, E)) * 0.05).astype(self.dtype),
+            "wx": u(E, E, 4 * Hid), "wh": u(Hid, Hid, 4 * Hid),
+            "b": np.zeros(4 * Hid, self.dtype),
+            "head": u(Hid, Hid, V),
+        }
+
+    def step(self, params, state, token, pos):
+        from .rnn.rnn_cell import lstm_decode_step
+        x = params["embed"][token]
+        h, c = lstm_decode_step(x, state["cache/h"], state["cache/c"],
+                                params["wx"], params["wh"], params["b"])
+        return {"cache/h": h, "cache/c": c}, h @ params["head"]
+
+    def prefill(self, params, state, tokens, length):
+        """Scan the padded prompt; state updates freeze past ``length``
+        so pad tokens never touch the carried h/c; logits come from
+        position ``length - 1``. The incoming state is the slot's STALE
+        h/c from its previous occupant — unlike the KV cache (where the
+        running-length mask hides stale positions) an RNN carry has no
+        mask, so prefill must restart it from zero."""
+        state = jax.tree_util.tree_map(jnp.zeros_like, state)
+        L = tokens.shape[0]
+
+        def body(st, inp):
+            tok, i = inp
+            new_st, logits = self.step(params, st, tok, i)
+            keep = i < length
+            st = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(keep, n, o), new_st, st)
+            return st, logits
+
+        st, all_logits = lax.scan(body, state,
+                                  (tokens, jnp.arange(L, dtype=jnp.int32)))
+        logits = lax.dynamic_index_in_dim(all_logits, length - 1, axis=0,
+                                          keepdims=False)
+        return st, logits
+
+
+# -- the engine -------------------------------------------------------------
+
+class _Anchor:
+    """Engine-held ledger anchor: one per cache leaf. The kv_cache
+    charge is keyed on this object (``spmd.commit_state``) so it
+    survives the per-step donation rebinds and retires when the engine
+    is garbage-collected."""
+    __slots__ = ("__weakref__",)
+
+
+class _DecodeRequest(_Request):
+    """One live sequence. Reuses serving's ``_Request`` span/req-id
+    construction (serve_wait + serve_request entered at submit with the
+    causal ``req_id`` ctx) and adds the decode-side cursor: slot index,
+    next input token, its cache position, and the output accumulators.
+    """
+
+    __slots__ = ("prompt", "max_new", "eos_id", "slot", "next_token",
+                 "pos", "out_tokens", "out_logits")
+
+    def __init__(self, prompt, max_new, eos_id, deadline=None):
+        super().__init__(arrays=None, rows=1, deadline=deadline)
+        self.prompt = prompt             # np.int32 (len,)
+        self.max_new = max_new
+        self.eos_id = eos_id
+        self.slot = None                 # guarded by: engine._lock
+        self.next_token = None           # scheduler thread only
+        self.pos = None                  # scheduler thread only
+        self.out_tokens = []             # scheduler thread only
+        self.out_logits = []             # scheduler thread only
+
+    @property
+    def finished(self):
+        if len(self.out_tokens) >= self.max_new:
+            return True
+        return self.eos_id is not None and self.out_tokens \
+            and self.out_tokens[-1] == self.eos_id
+
+
+class DecodeEngine:
+    """Continuous-batching autoregressive decode over a slot pool.
+
+    Parameters
+    ----------
+    cell : decode cell (``AttentionDecodeCell`` / ``LSTMDecodeCell`` or
+        anything with ``cache_spec``/``prefill``/``step`` and a
+        ``max_len``) — the pure per-sequence model
+    params : dict name -> array — host parameters; committed
+        device-resident (rule-sharded under ``partition_rules``)
+    slots : int — the pool size S: max concurrently-decoding sequences
+    max_prompt_len : int — longest admissible prompt; prompt buckets
+        are the powers of two up to it (default: half the cell's
+        ``max_len``)
+    max_new_tokens : int — per-request generation cap default
+        (``submit(max_new_tokens=)`` overrides); prompt + new tokens
+        must fit the cell's ``max_len``
+    eos_id : int | None — default early-stop token id
+    ctx : Context — single device (default: current context)
+    partition_rules / mesh_axes / contexts : the rule-sharded mesh
+        wiring, exactly as ``InferenceEngine``: the cache pytree and
+        the params commit by first-match rules
+        (``ring_attention.DECODE_PARTITION_RULES`` names the
+        head-sharded attention layout)
+    max_queue : int | None — bounded admission: sequences allowed to
+        WAIT for a slot; ``None`` = unbounded
+    deadline_ms : float | None — engine-wide default deadline for one
+        sequence's whole submit→resolve life; enforced while queued
+        (saturated slot pool sheds past-deadline prompts at admission)
+        and at every decode step
+    overload : "shed" | "block" — full-queue policy, as serving
+    retry_budget / retry_backoff_ms — transient dispatch retries
+    breaker_threshold / breaker_reset_s — dispatch circuit breaker
+    warmup : bool — build every prefill/decode bucket program at
+        construction (zero steady-state compiles)
+    keep_logits : bool — fetch and return per-token logits rows (the
+        bit-exactness harness; costs one extra d2h per step)
+    telemetry_logger : optional ``callback.TelemetryLogger`` — the
+        engine calls ``log_decode`` each step window
+    """
+
+    def __init__(self, cell, params, slots=8, max_prompt_len=None,
+                 max_new_tokens=64, eos_id=None, ctx=None,
+                 partition_rules=None, mesh_axes=None, contexts=None,
+                 max_queue=None, deadline_ms=None, overload="shed",
+                 retry_budget=2, retry_backoff_ms=5.0,
+                 breaker_threshold=5, breaker_reset_s=30.0,
+                 warmup=True, keep_logits=False, telemetry_logger=None):
+        self.cell = cell
+        self.slots = int(slots)
+        if self.slots < 1:
+            raise MXNetError("decode: slots must be >= 1")
+        self.max_len = int(cell.max_len)
+        self.max_prompt_len = int(max_prompt_len) if max_prompt_len \
+            else max(1, self.max_len // 2)
+        if self.max_prompt_len > self.max_len:
+            raise MXNetError("decode: max_prompt_len %d exceeds the "
+                             "cell's max_len %d"
+                             % (self.max_prompt_len, self.max_len))
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.prompt_buckets = bucket_sizes(self.max_prompt_len)
+        self.slot_buckets = bucket_sizes(self.slots)
+        if overload not in ("shed", "block"):
+            raise MXNetError("decode: overload must be 'shed' or "
+                             "'block', got %r" % (overload,))
+        self.overload = overload
+        self.max_queue = None if max_queue is None else max(1,
+                                                            int(max_queue))
+        self.deadline_s = None if deadline_ms is None \
+            else float(deadline_ms) / 1e3
+        self._retry_budget = max(0, int(retry_budget))
+        self._retry_backoff_s = max(0.0, float(retry_backoff_ms)) / 1e3
+        self._breaker_threshold = max(0, int(breaker_threshold))
+        self._breaker_reset_s = float(breaker_reset_s)
+        self._keep_logits = bool(keep_logits)
+        self._logger = telemetry_logger
+
+        # device / mesh wiring — same shape as serving's
+        if ctx is None:
+            from .context import current_context
+            ctx = current_context()
+        self._ctx = ctx
+        self._device = ctx.jax_device()
+        self._mesh_spec = None
+        if partition_rules is not None or mesh_axes:
+            from .parallel import mesh as _pmesh, spmd as _spmd
+            from .parallel.partition import PartitionRules
+            if partition_rules is not None \
+                    and not isinstance(partition_rules, PartitionRules):
+                partition_rules = PartitionRules(partition_rules)
+            ctxs = list(contexts) if contexts else [self._ctx]
+            mesh = _pmesh.mesh_from_contexts(
+                ctxs, axes=dict(mesh_axes) if mesh_axes
+                else {_spmd.DP_AXIS: 1, _spmd.MP_AXIS: -1})
+            self._mesh_spec = _spmd.rule_spec(mesh, partition_rules)
+
+        # commit params (rule-sharded on a mesh, plain put otherwise)
+        self._params = {n: self._put_param(n, np.asarray(v))
+                        for n, v in params.items()}
+        # the cache pool: slot-major leaves, rule-sharded, charged to
+        # the ledger under kind="kv_cache" via per-leaf anchor objects
+        # that live as long as the engine (donation rebinds the array
+        # wrapper every step — a wrapper-keyed charge would vanish
+        # after the first step)
+        self._cache_anchors = {n: _Anchor()
+                               for n in cell.cache_spec(self.slots)}
+        self._cache = self._make_cache()
+
+        # the two AOT program families — the engine's only compile
+        # sites, both through the instrumented wrapper (jit-site rule)
+        self._prefill_prog = _InstrumentedProgram(
+            "decode_prefill", self._make_prefill_impl(),
+            jit_kwargs={"donate_argnums": (1,)},
+            argnames=("params", "cache", "slot", "tokens", "length"),
+            meta={"slots": self.slots, "cell": type(cell).__name__})
+        self._prefill_prog.on_compile = self._prefill_compiled
+        self._decode_prog = _InstrumentedProgram(
+            "decode_step", self._make_decode_impl(),
+            jit_kwargs={"donate_argnums": (1,)},
+            argnames=("params", "cache", "slot_ids", "tokens",
+                      "positions"),
+            meta={"slots": self.slots, "cell": type(cell).__name__})
+
+        self._lock = threading.Lock()
+        # admission backpressure + close wakeup: Condition over the
+        # SAME lock — ``with self._space:`` satisfies every
+        # ``guarded by: self._lock`` annotation here
+        self._space = threading.Condition(self._lock)
+        self._stats = collections.Counter()   # guarded by: self._lock
+        self._queued = 0                 # guarded by: self._lock
+        self._slot_table = [None] * self.slots   # guarded by: self._lock
+        self._breaker_open_at = None     # guarded by: self._lock
+        self._consecutive_failures = 0   # guarded by: self._lock
+        self._closed = False             # guarded by: self._lock
+        self._close_done = False         # guarded by: self._lock
+        self._q = queue.Queue()
+        self._thread = threading.Thread(target=self._decode_loop,
+                                        name="mxtpu-decode-sched",
+                                        daemon=True)
+        self._thread.start()
+        flight.register_engine(self)
+        if warmup:
+            self.warmup()
+
+    # -- placement ----------------------------------------------------------
+    def _put_param(self, name, raw):
+        if self._mesh_spec is not None:
+            from .parallel import spmd as _spmd
+            return _spmd.shard_put(
+                raw, self._mesh_spec.param_sharding(name, tuple(raw.shape)))
+        return jax.device_put(raw, self._device)
+
+    def _make_cache(self):
+        """Fresh zeroed slot pool, committed per the partition rules and
+        charged (per-shard bytes) to the ledger as ``kv_cache``. The
+        anchors' replace-keyed charges make a REBUILD (cache re-init
+        after a poisoned dispatch) update instead of double-count."""
+        from .parallel import spmd as _spmd
+        cache = {}
+        for name, (shape, dtype) in self.cell.cache_spec(self.slots).items():
+            raw = np.zeros(shape, dtype)
+            sharding = self._mesh_spec.param_sharding(name, shape) \
+                if self._mesh_spec is not None else self._device
+            cache[name] = _spmd.commit_state(
+                raw, sharding, self._cache_anchors[name], kind="kv_cache")
+        return cache
+
+    def partition_summary(self):
+        """JSON-safe layout description (None without a mesh) — stamped
+        onto every bucket program card at warmup."""
+        if self._mesh_spec is None:
+            return None
+        from .parallel.partition import partition_summary as _summary
+        shapes = {n: tuple(v.shape) for n, v in self._params.items()}
+        shapes.update({n: s for n, (s, _)
+                       in self.cell.cache_spec(self.slots).items()})
+        return _summary(self._mesh_spec, shapes)
+
+    # -- program bodies ------------------------------------------------------
+    def _make_prefill_impl(self):
+        cell = self.cell
+
+        def prefill_impl(params, cache, slot, tokens, length):
+            state = jax.tree_util.tree_map(lambda l: l[slot], cache)
+            state, logits = cell.prefill(params, state, tokens, length)
+            cache = jax.tree_util.tree_map(
+                lambda l, n: l.at[slot].set(n.astype(l.dtype)),
+                cache, state)
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return cache, tok, logits
+
+        return prefill_impl
+
+    def _make_decode_impl(self):
+        cell = self.cell
+
+        def decode_impl(params, cache, ids, toks, pos):
+            # padding lanes carry id == S: the gather clips to a live
+            # slot (read-only, harmless), the scatter drops out of
+            # bounds — one program per BUCKET, not per active set
+            state = jax.tree_util.tree_map(
+                lambda l: jnp.take(l, ids, axis=0, mode="clip"), cache)
+            state, logits = jax.vmap(
+                cell.step, in_axes=(None, 0, 0, 0))(params, state, toks,
+                                                    pos)
+            cache = jax.tree_util.tree_map(
+                lambda l, n: l.at[ids].set(n.astype(l.dtype),
+                                           mode="drop"),
+                cache, state)
+            toks_out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return cache, toks_out, logits
+
+        return decode_impl
+
+    def _prefill_compiled(self, card):
+        telemetry.counter_inc("decode.prefill_compiles")
+
+    # -- buckets / cards -----------------------------------------------------
+    def prompt_bucket_for(self, n):
+        for b in self.prompt_buckets:
+            if b >= n:
+                return b
+        raise MXNetError("decode: prompt length %d exceeds "
+                         "max_prompt_len=%d" % (n, self.max_prompt_len))
+
+    def slot_bucket_for(self, n):
+        for b in self.slot_buckets:
+            if b >= n:
+                return b
+        raise MXNetError("decode: %d active slots exceed the pool (%d)"
+                         % (n, self.slots))
+
+    def program_cards(self):
+        """{card_id: card} for BOTH of this engine's program families —
+        one card per (family, bucket) signature."""
+        out = {}
+        for prog in (self._prefill_prog, self._decode_prog):
+            entry = prog.entry
+            out.update({k: c for k, c in telemetry.programs().items()
+                        if k == entry or k.startswith(entry + "/")})
+        return out
+
+    def warmup(self):
+        """Build every (prompt bucket x prefill) and (slot bucket x
+        decode) program WITHOUT dispatching — after this, steady-state
+        traffic is all AOT cache hits and records zero ``jit_compile``
+        spans. Planned multi-signature compiles, so the recompile-storm
+        warning is quieted for the duration."""
+        zlen = np.int32(1)
+        zslot = np.int32(0)
+        with _quiet_recompile(self._prefill_prog):
+            for lb in self.prompt_buckets:
+                self._prefill_prog.build(
+                    self._params, self._cache, zslot,
+                    np.zeros(lb, np.int32), zlen)
+        with _quiet_recompile(self._decode_prog):
+            for sb in self.slot_buckets:
+                pad = np.full(sb, self.slots, np.int32)
+                z = np.zeros(sb, np.int32)
+                self._decode_prog.build(self._params, self._cache, pad,
+                                        z, z)
+        layout = self.partition_summary()
+        if layout is not None:
+            for cid in self.program_cards():
+                telemetry.card_annotate(cid, partition=layout)
+
+    # -- request surface -----------------------------------------------------
+    def submit(self, prompt, max_new_tokens=None, deadline_ms=None,
+               eos_id=None):   # mxlint: hot
+        """Enqueue one sequence; returns a Future resolving to a
+        ``DecodeResult``. ``prompt`` is a 1-D int token-id array
+        (1 <= len <= max_prompt_len; prompt + new tokens must fit the
+        cell's ``max_len``). ``deadline_ms`` bounds the whole
+        submit→resolve life: expired waiters shed at admission when
+        the slot pool is saturated, and a decoding sequence past its
+        deadline sheds at the step boundary (``DeadlineExceeded``).
+        A full bounded queue sheds (``QueueOverflow``) or blocks per
+        the ``overload`` policy; an open breaker fast-fails
+        (``CircuitOpen``)."""
+        if self._closed:   # mxlint: disable=lock-discipline -- lock-free fast path; re-checked under the lock before enqueue
+            raise EngineClosed("decode: engine is closed")
+        if self._breaker_tripped():
+            with self._lock:
+                self._stats["breaker_fastfail"] += 1
+                consecutive = self._consecutive_failures
+            telemetry.counter_inc("decode.breaker_fastfail")
+            raise CircuitOpen(
+                "decode: breaker open after %d consecutive dispatch "
+                "failures — fast-failing instead of queuing onto a "
+                "failing backend (retries again %.1fs after the trip)"
+                % (consecutive, self._breaker_reset_s))
+        prompt = np.ascontiguousarray(np.asarray(prompt, np.int32))
+        if prompt.ndim != 1 or not prompt.size:
+            raise MXNetError("decode: prompt must be a non-empty 1-D "
+                             "token-id array, got shape %s"
+                             % (prompt.shape,))
+        if prompt.size > self.max_prompt_len:
+            raise MXNetError("decode: prompt length %d exceeds "
+                             "max_prompt_len=%d"
+                             % (prompt.size, self.max_prompt_len))
+        max_new = self.max_new_tokens if max_new_tokens is None \
+            else int(max_new_tokens)
+        if max_new < 1:
+            raise MXNetError("decode: max_new_tokens must be >= 1")
+        if prompt.size + max_new > self.max_len:
+            raise MXNetError(
+                "decode: prompt (%d) + max_new_tokens (%d) exceed the "
+                "cell's max_len %d — the cache cannot hold the "
+                "sequence" % (prompt.size, max_new, self.max_len))
+        dl_s = self.deadline_s if deadline_ms is None \
+            else float(deadline_ms) / 1e3
+        deadline = None if dl_s is None else time.monotonic() + dl_s
+        req = _DecodeRequest(prompt, max_new,
+                             self.eos_id if eos_id is None else eos_id,
+                             deadline=deadline)
+
+        def _drop_locked(exc, shed=False, deadline_hit=False):
+            # admission-rejected: never enqueued, but the spans entered
+            # at construction must close and the shed must account.
+            # Caller holds self._lock (the _locked-suffix contract).
+            req.wait_span.__exit__(None, None, None)
+            req.req_span.__exit__(None, None, None)
+            if shed:
+                self._stats["shed_requests"] += 1
+                self._stats["shed.admission"] += 1
+                telemetry.counter_inc("decode.shed")
+                telemetry.counter_inc("decode.shed.admission")
+                telemetry.record_event("decode.shed", req_id=req.req_id,
+                                       cause="admission")
+                if deadline_hit:
+                    telemetry.counter_inc("decode.deadline_exceeded")
+            raise exc
+
+        with self._space:
+            if self._closed:
+                _drop_locked(EngineClosed("decode: engine is closed"))
+            while self.max_queue is not None \
+                    and self._queued + 1 > self.max_queue:
+                if self.overload == "shed":
+                    _drop_locked(QueueOverflow(
+                        "decode: admission queue full (%d sequences "
+                        "waiting for a slot, max_queue=%d) — shedding"
+                        % (self._queued, self.max_queue)), shed=True)
+                timeout = None if deadline is None \
+                    else deadline - time.monotonic()
+                if timeout is not None and timeout <= 0 \
+                        or not self._space.wait(timeout):
+                    _drop_locked(DeadlineExceeded(
+                        "decode: deadline expired while blocked on a "
+                        "full admission queue (max_queue=%d)"
+                        % self.max_queue), shed=True, deadline_hit=True)
+                if self._closed:
+                    _drop_locked(EngineClosed("decode: engine is "
+                                              "closed"))
+            self._stats["requests"] += 1
+            self._queued += 1
+            self._q.put(req)
+        telemetry.counter_inc("decode.requests")
+        return req.future
+
+    def generate(self, prompt, **kwargs):
+        """Blocking convenience: ``submit`` + ``result()``."""
+        return self.submit(prompt, **kwargs).result()
+
+    # -- stats / state -------------------------------------------------------
+    def stats(self):
+        """Engine counters + slot occupancy + the per-token latency
+        percentiles + the ledger's view of the cache — what a decode
+        health endpoint exports."""
+        with self._lock:
+            st = dict(self._stats)
+            queued = self._queued
+            active = sum(1 for s in self._slot_table if s is not None)
+            breaker_open = self._breaker_tripped()
+            consecutive = self._consecutive_failures
+        tok_lat = telemetry.span_stats("serve_decode_step").get(
+            "serve_decode_step", {})
+        req_lat = telemetry.span_stats("serve_request").get(
+            "serve_request", {})
+        kv_bytes = self.kv_cache_bytes()
+        return {
+            "requests": st.get("requests", 0),
+            "resolved": st.get("resolved", 0),
+            "failed_requests": st.get("failed_requests", 0),
+            "shed_requests": st.get("shed_requests", 0),
+            "shed_by_cause": {k[len("shed."):]: v for k, v in st.items()
+                              if k.startswith("shed.")},
+            "tokens": st.get("tokens", 0),
+            "steps": st.get("steps", 0),
+            "slot_admit": st.get("slot_admit", 0),
+            "slot_retire": st.get("slot_retire", 0),
+            "queued": queued,
+            "max_queue": self.max_queue,
+            "overload": self.overload,
+            "deadline_ms": None if self.deadline_s is None
+            else round(self.deadline_s * 1e3, 3),
+            "slots": self.slots,
+            "active_slots": active,
+            "slot_fill": round(active / self.slots, 4),
+            "retries": st.get("retries", 0),
+            "dispatch_failures": st.get("dispatch_failures", 0),
+            "breaker": {
+                "open": breaker_open,
+                "threshold": self._breaker_threshold,
+                "consecutive_failures": consecutive,
+                "trips": st.get("breaker_trips", 0),
+                "fastfail": st.get("breaker_fastfail", 0),
+            },
+            # the ledger interplay: the cache is a NAMED by-kind charge
+            # (an OOM postmortem's ledger_top names it, not an
+            # anonymous buffer), reported per slot here
+            "kv_cache_bytes": kv_bytes,
+            "kv_cache_bytes_per_slot": kv_bytes // self.slots,
+            "token_latency_ms": {k: tok_lat.get(k) for k in
+                                 ("p50_ms", "p95_ms", "p99_ms")}
+            if tok_lat else None,
+            "request_latency_ms": {k: req_lat.get(k) for k in
+                                   ("p50_ms", "p95_ms", "p99_ms")}
+            if req_lat else None,
+        }
+
+    def kv_cache_bytes(self):
+        """Committed device bytes of THIS engine's cache pool —
+        per-shard bytes summed over devices, so an mp-sharded pool
+        reads 1/mp of replicated. Identical to the figure the pool's
+        ``kv_cache`` ledger charge carries, but engine-local (the
+        per-context ledger aggregates every engine sharing a mesh)."""
+        from .parallel.partition import committed_nbytes
+        cache = self._cache        # one racy-but-atomic dict read: the
+        # scheduler rebinds the whole dict per step; metadata-only
+        # reads on a just-donated leaf are safe
+        return int(sum(committed_nbytes(l) for l in cache.values()))
+
+    def overload_state(self):
+        """Light lock-held view for the flight recorder's sampler (a
+        10 Hz tick must not pay ``stats()``'s percentile sorts)."""
+        with self._lock:
+            return {
+                "queued_rows": self._queued,
+                "max_queue_rows": self.max_queue,
+                "active_slots": sum(1 for s in self._slot_table
+                                    if s is not None),
+                "slots": self.slots,
+                "breaker_open": self._breaker_tripped(),
+                "consecutive_failures": self._consecutive_failures,
+                "closed": self._closed,
+            }
+
+    def corpus_record(self):
+        """One JSON-safe record of measured decode data for the
+        persisted card corpus (per-bucket decode cost is planner food).
+        None until at least one step ran."""
+        from . import compile_cache
+        st = self.stats()
+        if not st["steps"]:
+            return None
+        cards = {
+            k: {kk: c.get(kk) for kk in
+                ("kind", "flops", "bytes_accessed", "peak_bytes",
+                 "compile_ms", "deserialize_ms", "source", "dispatches")}
+            for k, c in self.program_cards().items()}
+        spans = {k: v for k, v in telemetry.span_stats().items()
+                 if k in telemetry.DECODE_SPANS}
+        return {
+            "kind": "decode",
+            "ts": time.time(),
+            "env": compile_cache.env_meta(),
+            "slots": self.slots,
+            "slot_buckets": list(self.slot_buckets),
+            "prompt_buckets": list(self.prompt_buckets),
+            "layout": self.partition_summary(),
+            "cell": type(self.cell).__name__,
+            "tokens": st["tokens"],
+            "steps": st["steps"],
+            "requests": st["requests"],
+            "kv_cache_bytes": st["kv_cache_bytes"],
+            "spans": spans,
+            "cards": cards,
+        }
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self):
+        """Drain and stop: every already-submitted sequence resolves
+        (finishes generating, or sheds on its own deadline) before
+        close() returns; later submits raise ``EngineClosed``."""
+        with self._space:
+            already = self._close_done
+            self._close_done = True
+            if not self._closed:
+                self._closed = True
+                self._q.put(_SHUTDOWN)
+                self._space.notify_all()
+        if already:
+            return
+        self._thread.join()
+        try:
+            from . import compile_cache
+            if compile_cache.corpus_path() is not None:
+                rec = self.corpus_record()
+                if rec is not None:
+                    compile_cache.corpus_append(rec)
+        except Exception:
+            pass
+        if self._logger is not None:
+            try:
+                self._logger.log_decode(self, force=True)
+            except Exception:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- breaker -------------------------------------------------------------
+    def _breaker_tripped(self):
+        opened = self._breaker_open_at   # mxlint: disable=lock-discipline -- GIL-atomic one-shot read on the submit fast path; stats() re-reads under the lock
+        if opened is None:
+            return False
+        return (time.monotonic() - opened) < self._breaker_reset_s
+
+    def reset_breaker(self):
+        """Force the breaker closed (operator override)."""
+        with self._lock:
+            self._breaker_open_at = None
+            self._consecutive_failures = 0
+
+    def _dispatch_failed(self):
+        with self._lock:
+            self._stats["dispatch_failures"] += 1
+            self._consecutive_failures += 1
+            consecutive = self._consecutive_failures
+            trip = (self._breaker_threshold > 0
+                    and consecutive >= self._breaker_threshold)
+            if trip:
+                self._breaker_open_at = time.monotonic()
+                self._stats["breaker_trips"] += 1
+        telemetry.counter_inc("decode.dispatch_failures")
+        if trip:
+            telemetry.counter_inc("decode.breaker_trips")
+            telemetry.record_event("decode.breaker_trip",
+                                   consecutive=consecutive)
+            flight.postmortem("breaker_trip",
+                              extra={"engine": self.overload_state(),
+                                     "consecutive": consecutive})
+
+    def _dispatch_succeeded(self):
+        with self._lock:
+            self._consecutive_failures = 0
+            self._breaker_open_at = None
+
+    # -- terminal request paths ---------------------------------------------
+    def _shed(self, req, cause, exc):
+        """Resolve one sequence's future with a structured shed error
+        and account it. The spans close on every terminal path (shed
+        time is real latency) and the future resolves exactly once."""
+        if req.future.done():
+            return
+        req.wait_span.__exit__(None, None, None)
+        req.req_span.__exit__(None, None, None)
+        req.future.set_exception(exc)
+        with self._lock:
+            self._stats["shed_requests"] += 1
+            self._stats["shed.%s" % cause] += 1
+        telemetry.counter_inc("decode.shed")
+        telemetry.counter_inc("decode.shed.%s" % cause)
+        telemetry.record_event("decode.shed", req_id=req.req_id,
+                               cause=cause)
+        if isinstance(exc, DeadlineExceeded):
+            telemetry.counter_inc("decode.deadline_exceeded")
+
+    def _fail_requests(self, reqs, exc):
+        """Resolve every still-pending member with ``exc``: a failed
+        sequence is neither resolved nor shed — without its own counter
+        the depth arithmetic would count it queued forever."""
+        failed = 0
+        for r in reqs:
+            if not r.future.done():
+                r.wait_span.__exit__(None, None, None)
+                r.req_span.__exit__(None, None, None)
+                r.future.set_exception(exc)
+                failed += 1
+        if failed:
+            with self._lock:
+                self._stats["failed_requests"] += failed
+            telemetry.counter_inc("decode.failed_requests", failed)
+
+    def _retire(self, req):
+        """One sequence finished: assemble the result on the host
+        (``serve_detokenize`` — the flow chain's terminal hop before
+        serve_request), free the slot, resolve the future."""
+        with telemetry.span("serve_detokenize",
+                            ctx={"req_id": req.req_id}):
+            logits = None
+            if self._keep_logits and req.out_logits:
+                logits = np.stack(req.out_logits)
+            result = DecodeResult(tokens=list(req.out_tokens),
+                                  logits=logits)
+        with self._space:
+            self._slot_table[req.slot] = None
+            self._stats["resolved"] += 1
+            self._stats["slot_retire"] += 1
+            self._space.notify_all()
+        telemetry.counter_inc("decode.resolved")
+        telemetry.counter_inc("decode.slot_retire")
+        telemetry.record_event("decode.retire", req_id=req.req_id,
+                               slot=req.slot, tokens=len(req.out_tokens))
+        req.req_span.__exit__(None, None, None)
+        if not req.future.done():
+            req.future.set_result(result)
+
+    def _shed_active(self, req, cause, exc):
+        """Shed a SLOTTED sequence (deadline hit mid-decode): free the
+        slot, resolve with the structured error."""
+        with self._space:
+            self._slot_table[req.slot] = None
+            self._stats["slot_retire"] += 1
+            self._space.notify_all()
+        telemetry.counter_inc("decode.slot_retire")
+        self._shed(req, cause, exc)
+
+    # -- scheduler -----------------------------------------------------------
+    def _decode_loop(self):   # mxlint: hot
+        """The scheduler thread: drain admissions, fill free slots
+        (prefill), advance every active slot one token (decode), retire
+        finishers — one iteration per generated token per slot. Owns
+        the slot cursor state; the slot TABLE itself is mutated under
+        the engine lock so stats()/sampler reads never tear."""
+        pending = []
+        shutting = False
+        try:
+            while True:
+                with self._lock:
+                    idle = not any(s is not None
+                                   for s in self._slot_table)
+                block = idle and not pending and not shutting
+                while True:
+                    try:
+                        item = self._q.get() if block \
+                            else self._q.get_nowait()
+                    except queue.Empty:
+                        break
+                    block = False
+                    if item is _SHUTDOWN:
+                        shutting = True
+                        continue
+                    pending.append(item)
+                self._admit(pending)
+                with self._lock:
+                    active = [s for s in self._slot_table
+                              if s is not None]
+                if shutting and not active and not pending:
+                    break
+                if active:
+                    self._step(active)
+        except BaseException as e:
+            self._scheduler_died(pending, e)
+            raise
+
+    def _admit(self, pending):
+        """Fill free slots from the waiting line, shedding expired
+        waiters: a saturated slot pool must not hold a prompt past its
+        deadline just to decode an answer nobody is waiting for."""
+        while pending:
+            now = time.monotonic()
+            with self._lock:
+                free = next((i for i, s in enumerate(self._slot_table)
+                             if s is None), None)
+            if free is None:
+                # pool saturated: shed the already-expired waiters now
+                for r in [r for r in pending if r.expired(now)]:
+                    pending.remove(r)
+                    with self._space:
+                        self._queued -= 1
+                        self._space.notify_all()
+                    self._shed(r, "slot_wait", DeadlineExceeded(
+                        "decode: deadline expired waiting for a slot "
+                        "(pool of %d saturated)" % self.slots))
+                return
+            req = pending.pop(0)
+            with self._space:
+                self._queued -= 1
+                self._space.notify_all()
+            if req.expired(now):
+                self._shed(req, "slot_wait", DeadlineExceeded(
+                    "decode: deadline expired waiting for a slot "
+                    "(pool of %d saturated)" % self.slots))
+                continue
+            self._prefill_one(req, free)   # mxlint: disable=future-lifecycle -- a raise escaping here hits _decode_loop's BaseException backstop, which resolves every slotted and pending future via _scheduler_died
+
+    def _prefill_one(self, req, slot):
+        """Admit one sequence into ``slot``: dispatch its prompt
+        bucket's prefill program (writes the slot's cache, returns the
+        first generated token) and install the cursor."""
+        req.wait_span.__exit__(None, None, None)
+        lp = int(req.prompt.size)
+        lb = self.prompt_bucket_for(lp)
+        toks = np.zeros(lb, np.int32)
+        toks[:lp] = req.prompt
+        attempt = 0
+        while True:
+            try:
+                record_dispatch("decode_prefill")
+                with telemetry.span("serve_prefill",
+                                    ctx={"req_id": req.req_id}):
+                    self._cache, tok0, logits0 = self._prefill_prog(   # mxlint: disable=thread-race -- the pool is scheduler-thread-owned after __init__; warmup's read happens before the first request can reach the queue
+                        self._params, self._cache, np.int32(slot), toks,
+                        np.int32(lp))   # mxlint: donates 1
+                    tok_host = int(np.asarray(tok0))   # mxlint: disable=host-sync -- the generated token IS the next step's input; the per-token d2h is decode's data dependency, not an avoidable stall
+                    row = None
+                    if self._keep_logits:
+                        row = np.asarray(logits0)   # mxlint: disable=host-sync -- fetched inside the span so per-token latency counts the real fetch
+                break
+            except Exception as e:
+                if attempt < self._retry_budget and _is_transient(e):
+                    attempt += 1
+                    with self._lock:
+                        self._stats["retries"] += 1
+                    telemetry.counter_inc("decode.retries")
+                    time.sleep(self._retry_backoff_s
+                               * (2 ** (attempt - 1)))
+                    continue
+                self._poisoned("prefill", req, e)
+                return
+        self._dispatch_succeeded()
+        req.slot = slot
+        req.next_token = tok_host
+        req.pos = lp                      # the next input's position
+        req.out_tokens.append(tok_host)
+        if row is not None:
+            req.out_logits.append(row)
+        with self._space:
+            self._slot_table[slot] = req
+            self._stats["slot_admit"] += 1
+            self._stats["tokens"] += 1
+        telemetry.counter_inc("decode.slot_admit")
+        telemetry.counter_inc("decode.tokens")
+        telemetry.record_event("decode.admit", req_id=req.req_id,
+                               slot=slot, prompt_len=lp)
+        if req.finished:                  # max_new == 1, or instant EOS
+            self._retire(req)
+
+    def _step(self, active):   # mxlint: hot
+        """ONE continuous-batching decode step: advance every active
+        slot one token in a single donated-buffer dispatch at the
+        smallest covering slot bucket, append the fetched tokens,
+        retire finishers and shed the deadline-expired."""
+        n = len(active)
+        bucket = self.slot_bucket_for(n)
+        ids = np.full(bucket, self.slots, np.int32)   # pad = out of range
+        toks = np.zeros(bucket, np.int32)
+        pos = np.zeros(bucket, np.int32)
+        for i, req in enumerate(active):
+            ids[i] = req.slot
+            toks[i] = req.next_token
+            pos[i] = req.pos
+        rids = [r.req_id for r in active]
+        attempt = 0
+        while True:
+            try:
+                record_dispatch("decode")
+                with telemetry.span("serve_decode_step",
+                                    ctx={"req_ids": rids}):
+                    self._cache, toks_out, logits_out = self._decode_prog(
+                        self._params, self._cache, ids, toks,
+                        pos)   # mxlint: donates 1
+                    toks_host = np.asarray(toks_out)   # mxlint: disable=host-sync -- the generated tokens ARE the next step's inputs; the per-step d2h is decode's data dependency, not an avoidable stall
+                    rows = None
+                    if self._keep_logits:
+                        rows = np.asarray(logits_out[:n])   # mxlint: disable=host-sync -- fetched inside the span so per-token latency counts the real fetch
+                break
+            except Exception as e:
+                if attempt < self._retry_budget and _is_transient(e):
+                    attempt += 1
+                    with self._lock:
+                        self._stats["retries"] += 1
+                    telemetry.counter_inc("decode.retries")
+                    time.sleep(self._retry_backoff_s
+                               * (2 ** (attempt - 1)))
+                    continue
+                self._poisoned("decode", None, e)
+                return
+        self._dispatch_succeeded()
+        with self._lock:
+            self._stats["steps"] += 1
+            self._stats["tokens"] += n
+        telemetry.counter_inc("decode.steps")
+        telemetry.counter_inc("decode.tokens", n)
+        now = time.monotonic()
+        for i, req in enumerate(active):
+            tok = int(toks_host[i])
+            req.out_tokens.append(tok)
+            if rows is not None:
+                req.out_logits.append(rows[i])
+            req.next_token = tok
+            req.pos += 1
+            if req.finished:
+                self._retire(req)
+            elif req.expired(now):
+                self._shed_active(req, "decode", DeadlineExceeded(
+                    "decode: deadline expired after %d tokens"
+                    % len(req.out_tokens)))
+        if self._logger is not None:
+            try:
+                self._logger.log_decode(self)
+            except Exception:
+                pass
+
+    def _poisoned(self, phase, req, exc):
+        """A prefill/decode dispatch failed for good. The cache pool
+        was DONATED into the failed call — its buffers may be consumed
+        — so every slotted sequence's state is unrecoverable: fail them
+        all, rebuild a zeroed pool, keep serving new admissions. Feeds
+        the breaker like any dispatch failure."""
+        self._dispatch_failed()
+        err = MXNetError(
+            "decode: %s dispatch failed (%s: %s) — the donated cache "
+            "pool is poisoned; every in-flight sequence failed and the "
+            "pool was rebuilt" % (phase, type(exc).__name__, exc))
+        with self._lock:
+            active = [s for s in self._slot_table if s is not None]
+            self._slot_table = [None] * self.slots
+        victims = list(active)
+        if req is not None:
+            victims.append(req)
+        self._fail_requests(victims, err)
+        with self._space:
+            self._space.notify_all()
+        self._cache = self._make_cache()
+        telemetry.record_event("decode.pool_rebuilt", phase=phase,
+                               failed=len(victims))
+        flight.postmortem("decode_dispatch_failure", exc=exc,
+                          extra={"engine": self.overload_state(),
+                                 "phase": phase,
+                                 "req_ids": [r.req_id for r in victims]})
+
+    def _scheduler_died(self, pending, exc):
+        """Terminal cleanup for a dying scheduler thread: every
+        pending, queued and slotted sequence resolves with a structured
+        error, blocked submitters wake into EngineClosed, and a
+        postmortem names the count (the mxlife guarantee: no admitted
+        sequence is ever left unresolved)."""
+        with self._space:
+            self._closed = True
+            self._space.notify_all()
+        left = list(pending)
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _SHUTDOWN:
+                left.append(item)
+        with self._space:
+            self._queued -= len(left)
+            active = [s for s in self._slot_table if s is not None]
+            self._slot_table = [None] * self.slots
+            self._space.notify_all()
+        err = MXNetError(
+            "decode: scheduler thread died (%s: %s) — the engine is "
+            "closed and this sequence was never completed"
+            % (type(exc).__name__, exc))
+        for r in left:
+            self._shed(r, "scheduler_death", err)
+        self._fail_requests(active, err)
+        flight.postmortem("decode_scheduler_death", exc=exc,
+                          extra={"engine": self.overload_state(),
+                                 "failed_requests": len(left)
+                                 + len(active)})

@@ -264,7 +264,7 @@ class _InstrumentedProgram:
 
     __slots__ = ("kind", "entry", "argnames", "_jitted", "_donate",
                  "_cache", "_card", "_meta", "_graph_key",
-                 "warn_recompile")
+                 "warn_recompile", "on_compile")
 
     def __init__(self, kind, fn, jit_kwargs=None, argnames=None,
                  meta=None, graph_key=None):
@@ -290,6 +290,12 @@ class _InstrumentedProgram:
         # planned compiles don't read as recompile storms in the log and
         # the recompile.* counters
         self.warn_recompile = True
+        # optional owner hook fired with the fresh card after every
+        # signature build (compile OR disk-cache load — the card's
+        # "source" field says which): engines that account their own
+        # planned compiles (decode counts prefill-bucket builds) attach
+        # here instead of re-deriving it from the card registry
+        self.on_compile = None
 
     # -- compile -----------------------------------------------------------
     def _signature_cards(self, args):
@@ -456,6 +462,11 @@ class _InstrumentedProgram:
             self._warn_recompile(card)
         self._card = card
         telemetry.record_program(card)
+        if self.on_compile is not None:
+            try:
+                self.on_compile(card)
+            except Exception:
+                pass      # an accounting hook must never break a build
         rec = [compiled if aot else self._jitted, card, aot]
         self._cache[sig] = rec
         return rec

@@ -194,6 +194,11 @@ def _build_sample(last, dt_s):
             "queued_rows": sum(e.get("queued_rows", 0) for e in engines),
             "breaker_open": any(e.get("breaker_open") for e in engines),
             "engines": len(engines),
+            # slot occupancy across registered decode engines (0 for a
+            # fleet of stateless engines — their overload_state carries
+            # no active_slots key)
+            "active_slots": sum(e.get("active_slots", 0)
+                                for e in engines),
         },
     }
     if reset:

@@ -166,6 +166,16 @@ class PartitionRules:
             self._cache[key] = spec
         return spec
 
+    def prepended(self, rules):
+        """A new ``PartitionRules`` with ``rules`` tried BEFORE this
+        set's, same unmatched policy — how an engine layers state-
+        specific rules (the decode engine's KV-cache leaves) over a
+        model's layout without mutating either rule set. First-match-
+        wins makes prepending the specificity override."""
+        norm = [(p, s) for p, s in rules]
+        return PartitionRules(tuple(norm) + self.rules,
+                              unmatched=self.unmatched)
+
     def apply(self, params):
         """{name: PartitionSpec} for a ``{name: array_or_shape}`` tree
         (arrays need only a ``.shape``; plain shape tuples work too)."""

@@ -24,7 +24,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .compat import shard_map
 
-__all__ = ["attention", "ring_attention", "PARTITION_RULES"]
+__all__ = ["attention", "decode_attention", "ring_attention",
+           "PARTITION_RULES", "DECODE_PARTITION_RULES"]
 
 # The ring layout as a partition-rule set: sequence parallelism shards
 # ACTIVATIONS (q/k/v along S over ``sp``); the projection parameters
@@ -33,6 +34,20 @@ __all__ = ["attention", "ring_attention", "PARTITION_RULES"]
 # rule (rather than relying on the UNMATCHED default) makes the layout
 # a statement the error policy can enforce.
 PARTITION_RULES = [
+    (r".*", P()),
+]
+
+# The autoregressive-decode layout (mxnet_tpu/decode.py): the KV cache
+# is just another rule-sharded leaf. Heads shard over ``mp`` — the
+# ulysses head-major convention — so the (S, H, T, D) cache pool, the
+# head-major q/k/v producers (E, H, D) and the head-major output
+# consumer (H, D, E) all split on the same axis and single-token decode
+# needs no resharding: each device attends its own heads and only the
+# output projection's psum crosses ``mp``.
+DECODE_PARTITION_RULES = [
+    (r"cache/(k|v)$", P(None, "mp", None, None)),
+    (r"w(q|k|v)$", P(None, "mp", None)),
+    (r"wo$", P("mp", None, None)),
     (r".*", P()),
 ]
 
@@ -57,6 +72,27 @@ def attention(q, k, v, causal=False, scale=None, q_offset=0, kv_offset=0):
         probs = jnp.where(jnp.isfinite(scores).any(-1, keepdims=True), probs,
                           0.0)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def decode_attention(q, k_cache, v_cache, length, scale=None):
+    """Single-query attention against a KV cache: one sequence, one new
+    token. ``q`` is (H, D); ``k_cache``/``v_cache`` are (H, T, D) with
+    positions ``[0, length)`` valid (the current token's k/v already
+    written at ``length - 1``); everything at or past ``length`` is
+    masked out. Returns (H, D). The decode engine vmaps this over the
+    gathered active slots.
+    """
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    scores = jnp.einsum("hd,htd->ht", q, k_cache) * scale
+    pos = jnp.arange(k_cache.shape[1])
+    scores = jnp.where(pos[None, :] < length, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # a fully masked row (length == 0, never live in practice) would
+    # produce NaN from softmax(-inf); zero it like ``attention`` does
+    probs = jnp.where(jnp.isfinite(scores).any(-1, keepdims=True), probs,
+                      0.0)
+    return jnp.einsum("ht,htd->hd", probs, v_cache)
 
 
 def _ring_attention_local(q, k, v, axis_name, causal, scale, use_pallas):

@@ -25,7 +25,7 @@ __all__ = ["SPMDTrainer", "shard_params_rule", "DataParallelSpec",
            "dp_spec", "rule_spec", "dist_dp_spec", "is_process_spanning",
            "check_batch_divisible", "shard_put", "dist_shard_put",
            "put_replicated_local", "broadcast_from_zero", "local_value",
-           "commit_dp_placements", "DP_AXIS", "MP_AXIS"]
+           "commit_dp_placements", "commit_state", "DP_AXIS", "MP_AXIS"]
 
 # the canonical data-parallel axis name shared by the Module mesh path,
 # the executor's SPMD train-step program and the bench/probe lanes
@@ -275,6 +275,36 @@ def shard_put(raw, sharding):
             telemetry.ledger_track(
                 out, "mesh(%ddev)" % n_dev, committed_nbytes(out),
                 shape=out.shape, dtype=out.dtype, kind="shard_put")
+        return out
+
+
+def commit_state(raw, sharding, anchor, kind="kv_cache"):
+    """Commit LONG-LIVED, donation-cycled device state (the decode
+    engine's KV-cache pool): ``device_put`` per the rule-resolved
+    sharding plus a DURABLE per-shard ledger charge under ``kind``.
+
+    The charge is keyed on ``anchor`` — an owner-held token object —
+    not on the array wrapper: donated dispatches rebind the wrapper
+    every step while the storage stays aliased, so a wrapper-keyed
+    charge (``shard_put``'s contract) would silently vanish after the
+    first decode step. ``replace=True`` makes a rebuild (cache re-init
+    after a poisoned dispatch) update the charge instead of
+    double-counting. The charge retires when the anchor dies with its
+    engine."""
+    with telemetry.span("shard_put"):
+        if isinstance(raw, np.ndarray):
+            telemetry.record_transfer(raw.nbytes)
+        out = jax.device_put(raw, sharding)
+        if telemetry.enabled():
+            from .partition import committed_nbytes
+            try:
+                n_dev = len(sharding.device_set)
+            except AttributeError:
+                n_dev = 1
+            telemetry.ledger_track(
+                anchor, "mesh(%ddev)" % n_dev, committed_nbytes(out),
+                shape=out.shape, dtype=out.dtype, kind=kind,
+                replace=True)
         return out
 
 

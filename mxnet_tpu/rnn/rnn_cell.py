@@ -13,7 +13,8 @@ from ..symbol import Symbol, Variable
 __all__ = ["BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell", "FusedRNNCell",
            "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
            "ZoneoutCell", "ResidualCell", "RNNParams", "ModifierCell",
-           "BaseConvRNNCell", "ConvRNNCell", "ConvLSTMCell", "ConvGRUCell"]
+           "BaseConvRNNCell", "ConvRNNCell", "ConvLSTMCell", "ConvGRUCell",
+           "lstm_decode_step"]
 
 
 class RNNParams:
@@ -682,3 +683,27 @@ class ConvGRUCell(BaseConvRNNCell):
                                           self._activation)
         next_h = (1.0 - update_gate) * next_h_tmp + update_gate * states[0]
         return next_h, [next_h]
+
+
+def lstm_decode_step(x, h, c, wx, wh, b):
+    """Pure-jax single-step LSTM: the decode-path counterpart of
+    ``LSTMCell`` for the slot-based decode engine (mxnet_tpu/decode.py),
+    which vmaps it over active slots. Same gate packing as ``LSTMCell``:
+    the fused (..., 4H) projection slices to in/forget/transform/out.
+
+    ``x`` (..., E) input, ``h``/``c`` (..., H) carried state,
+    ``wx`` (E, 4H), ``wh`` (H, 4H), ``b`` (4H,).
+    Returns ``(next_h, next_c)``.
+    """
+    # local import: the Symbol-graph cells above must stay importable
+    # without touching the jax numeric stack
+    import jax
+    import jax.numpy as jnp
+    gates = x @ wx + h @ wh + b
+    in_g, forget_g, transform, out_g = jnp.split(gates, 4, axis=-1)
+    in_g = jax.nn.sigmoid(in_g)
+    forget_g = jax.nn.sigmoid(forget_g)
+    out_g = jax.nn.sigmoid(out_g)
+    next_c = forget_g * c + in_g * jnp.tanh(transform)
+    next_h = out_g * jnp.tanh(next_c)
+    return next_h, next_c

@@ -864,10 +864,30 @@ class InferenceEngine:
             "bucket_ms": bucket_ms,
             "max_inflight": self._max_inflight,
             "autotune_plan": self._autotune_plan,
+            # the buffer ledger's by-kind view of this engine's mesh
+            # context: an OOM postmortem reads WHAT is resident (model
+            # weights vs a decode engine's kv_cache on the same mesh),
+            # not just how much
+            "device_bytes": self.device_bytes(),
             "latency_ms": {k: lat.get(k) for k in
                            ("p50_ms", "p95_ms", "p99_ms")}
             if lat else None,
         }
+
+    def device_bytes(self):
+        """Live per-shard device bytes on this engine's mesh context,
+        split by ledger kind (``{"total": n, "by_kind": {...}}``): the
+        figure capacity planning and OOM postmortems read. Single-
+        device engines report the plain device context."""
+        if self._mesh_spec is not None:
+            key = "mesh(%ddev)" % self._mesh_spec.num_devices
+        else:
+            key = str(self._device)
+        led = telemetry.ledger().get(key, {})
+        return {"context": key,
+                "total": int(led.get("alive_bytes", 0)),
+                "by_kind": {k: int(v) for k, v in
+                            led.get("by_kind", {}).items()}}
 
     def overload_state(self):
         """Light lock-held view of the queue/breaker state — what the

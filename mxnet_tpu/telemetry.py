@@ -83,7 +83,7 @@ __all__ = [
     "card_annotate",
     "set_peak_flops", "ledger_track", "ledger", "ledger_top",
     "SPAN_RING_SIZE", "EVENT_RING_SIZE", "FIT_PHASE_SPANS",
-    "SERVE_SPANS", "COMPILE_SPANS",
+    "SERVE_SPANS", "DECODE_SPANS", "COMPILE_SPANS",
     "MAX_PROGRAM_CARDS", "COUNTERS",
 ]
 
@@ -112,6 +112,14 @@ FIT_PHASE_SPANS = ("fit_batch", "feed", "step", "shard_put",
 # and the whole submit->resolve request latency whose p50/p95/p99 the
 # serving artifacts and TelemetryLogger report
 SERVE_SPANS = ("serve_wait", "serve_batch", "serve_d2h", "serve_request")
+
+# the decode-tier span names (mxnet_tpu/decode.py): one slot's prefill
+# dispatch, one batched decode step advancing every active slot a
+# token (its duration IS the per-token latency the decode artifacts
+# report), and the retire-time host assembly that resolves a finished
+# sequence. A decode request's flow chains serve_wait -> serve_prefill
+# -> serve_decode_step x N -> serve_detokenize -> serve_request.
+DECODE_SPANS = ("serve_prefill", "serve_decode_step", "serve_detokenize")
 
 # the program-build span names (executor._InstrumentedProgram /
 # compile_cache): tracing, an actual XLA compile, and a disk-cache
@@ -159,6 +167,12 @@ COUNTERS = (
     "serving.deadline_exceeded", "serving.retries",
     "serving.dispatch_failures", "serving.breaker_trips",
     "serving.breaker_fastfail",
+    "decode.requests", "decode.tokens", "decode.steps",
+    "decode.slot_admit", "decode.slot_retire",
+    "decode.shed", "decode.shed.*", "decode.deadline_exceeded",
+    "decode.prefill_compiles", "decode.resolved",
+    "decode.failed_requests", "decode.dispatch_failures",
+    "decode.retries", "decode.breaker_trips", "decode.breaker_fastfail",
 )
 
 
@@ -933,8 +947,11 @@ def _flow_ids(ctx):
 # order would get it wrong: serve_request is ENTERED at submit (same
 # instant as serve_wait), so by start time the chain would terminate at
 # serve_d2h and the "request resolved" terminus would never be drawn.
-_SERVE_FLOW_RANK = {"serve_wait": 0, "serve_batch": 1, "serve_d2h": 2,
-                    "serve_request": 3}
+_SERVE_FLOW_RANK = {"serve_wait": 0,
+                    "serve_prefill": 1,
+                    "serve_batch": 2, "serve_decode_step": 2,
+                    "serve_d2h": 3, "serve_detokenize": 3,
+                    "serve_request": 4}
 
 
 def chrome_events(pid=None, since_trace_start=True):

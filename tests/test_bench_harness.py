@@ -106,6 +106,7 @@ def _patched_supervise(monkeypatch, phases, deadline=30.0, smoke=False,
     # optional phases default OFF here; dedicated tests opt back in
     monkeypatch.setenv("MXTPU_BENCH_DP", "0")
     monkeypatch.setenv("MXTPU_BENCH_SERVE", "0")
+    monkeypatch.setenv("MXTPU_BENCH_DECODE", "0")
     monkeypatch.setattr(bench, "_run_phase", fake_phase)
     monkeypatch.setattr(bench, "TOTAL_DEADLINE", deadline)
     monkeypatch.setattr(bench, "SMOKE", smoke)
@@ -328,6 +329,7 @@ def test_supervise_dp_phase_merges(monkeypatch):
     monkeypatch.setenv("MXTPU_BENCH_MODULE", "0")
     monkeypatch.setenv("MXTPU_BENCH_DP", "1")
     monkeypatch.setenv("MXTPU_BENCH_SERVE", "0")
+    monkeypatch.setenv("MXTPU_BENCH_DECODE", "0")
     monkeypatch.setattr(bench, "_run_phase", fake_phase)
     monkeypatch.setattr(bench, "TOTAL_DEADLINE", 600.0)
     monkeypatch.setattr(bench, "SMOKE", False)
@@ -439,6 +441,7 @@ def test_supervise_serve_phase_merges(monkeypatch):
     monkeypatch.setenv("MXTPU_BENCH_MODULE", "0")
     monkeypatch.setenv("MXTPU_BENCH_DP", "0")
     monkeypatch.setenv("MXTPU_BENCH_SERVE", "1")
+    monkeypatch.setenv("MXTPU_BENCH_DECODE", "0")
     monkeypatch.setattr(bench, "_run_phase", fake_phase)
     monkeypatch.setattr(bench, "TOTAL_DEADLINE", 600.0)
     monkeypatch.setattr(bench, "SMOKE", False)
@@ -454,6 +457,49 @@ def test_supervise_serve_phase_merges(monkeypatch):
     assert out["serving"]["serve_speedup"] == 9.0
     assert out["serving"]["burst_latency_ms"]["p95_ms"] == 9.0
     assert "lane" not in out["serving"]
+
+
+def test_supervise_decode_phase_merges(monkeypatch):
+    """With budget left, the continuous-batching decode child runs and
+    its throughput/per-token-latency table merges into the final line
+    under "decode"."""
+    dc = {"lane": "decode", "static_tok_s": 7000.0,
+          "continuous_tok_s": 20000.0, "decode_speedup": 2.86,
+          "token_latency_ms": {"p50_ms": 0.21, "p95_ms": 0.26,
+                               "p99_ms": 0.32},
+          "jit_compiles_timed": 0, "kv_cache_bytes": 524288}
+
+    def fake_phase(mode, timeout, env_extra=None):
+        if mode == "--probe":
+            return {"device": "x"}, False
+        if mode == "--child":
+            return {"value": 500.0, "unit": "img/s"}, False
+        assert mode == "--decode-child", mode
+        return dict(dc), False
+
+    import io
+    from contextlib import redirect_stdout
+    monkeypatch.setenv("MXTPU_BENCH_AB", "0")
+    monkeypatch.setenv("MXTPU_BENCH_MODULE", "0")
+    monkeypatch.setenv("MXTPU_BENCH_DP", "0")
+    monkeypatch.setenv("MXTPU_BENCH_SERVE", "0")
+    monkeypatch.setenv("MXTPU_BENCH_DECODE", "1")
+    monkeypatch.setattr(bench, "_run_phase", fake_phase)
+    monkeypatch.setattr(bench, "TOTAL_DEADLINE", 600.0)
+    monkeypatch.setattr(bench, "SMOKE", False)
+    monkeypatch.setattr(bench, "PROBE_TIMEOUT", 1.0)
+    monkeypatch.setattr(bench, "PROBE_GAP", 0.0)
+    monkeypatch.setattr(bench, "RAW_MIN", 0.5)
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = bench.supervise()
+    assert rc == 0
+    out = bench._last_json_line(buf.getvalue())
+    assert out["value"] == 500.0
+    assert out["decode"]["decode_speedup"] == 2.86
+    assert out["decode"]["token_latency_ms"]["p99_ms"] == 0.32
+    assert out["decode"]["jit_compiles_timed"] == 0
+    assert "lane" not in out["decode"]
 
 
 def test_serve_child_smoke_sweep(monkeypatch):

@@ -1,0 +1,71 @@
+"""Tier-1 smoke lane for the continuous-batching decode engine.
+
+Runs ``tools/serve_probe.py --decode-smoke`` as a subprocess and pins
+the ISSUE 16 acceptance numbers:
+
+- slot-batched decode is BIT-EXACT (tokens and logits) against
+  one-at-a-time decode through the same engine;
+- the open-loop skewed-length stream through continuous batching
+  sustains >= 2x the tokens/s of wave-synchronized static whole-batch
+  decode of the same work;
+- ZERO ``jit_compile`` spans anywhere in the timed windows (warmup
+  built every prompt-length and slot-count bucket program up front);
+- the mp leg: under ``DECODE_PARTITION_RULES`` on the 1x8 CPU mesh the
+  KV-cache pool's committed ledger bytes read exactly 1/8 of the same
+  pool replicated onto that mesh.
+
+The probe's JSON banks as an artifact (``$MXTPU_ARTIFACT_DIR/
+decode_smoke.json``, default /tmp/mxtpu_artifacts) so the decode
+trajectory is recorded every round.
+"""
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_probe(art):
+    # the mp leg NEEDS the multi-device mesh: unlike the single-device
+    # serving lanes this one keeps (and pins) the forced device count
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "serve_probe.py"),
+         "--decode-smoke", "--json-out", art],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, timeout=900, env=env, cwd=ROOT)
+    assert proc.returncode == 0, proc.stdout[-2000:]
+    with open(art) as f:
+        return json.loads(f.read())
+
+
+def test_decode_smoke_lane():
+    art_dir = os.environ.get("MXTPU_ARTIFACT_DIR", "/tmp/mxtpu_artifacts")
+    os.makedirs(art_dir, exist_ok=True)
+    art = os.path.join(art_dir, "decode_smoke.json")
+    try:
+        out = _run_probe(art)
+    except AssertionError:
+        out = _run_probe(art)   # one retry under CI timing noise
+    assert out["lane"] == "decode_smoke"
+    assert out["gates_passed"] is True, out
+    # deterministic guards, independent of the timing gate
+    assert out["bit_exact"] is True
+    assert out["jit_compiles_timed"] == 0, out
+    assert out["devices"] >= 8
+    assert out["mp"]["ledger_ratio"] == 8.0, out["mp"]
+    assert out["mp"]["replicated_kv_bytes"] \
+        == 8 * out["mp"]["sharded_kv_bytes"], out["mp"]
+    # the steady-state schedule really was continuous: every decode
+    # dispatch advanced a full-or-draining pool, so the step count
+    # lands at ~tokens/slots, nowhere near static's waves x longest
+    c = out["telemetry"]["counters"]
+    assert c["decode.tokens"] == out["total_tokens"]
+    assert c["decode.steps"] <= out["total_tokens"] // out["slots"] \
+        + out["gen_long"], c
+    # per-token latency percentiles banked, coordinated-omission-free
+    assert out["token_latency_ms"]["p99_ms"] is not None
+    # the timing gate proper (retried once above under CI noise)
+    assert out["decode_speedup"] >= out["speedup_gate"], out

@@ -782,6 +782,237 @@ def postmortem_smoke(json_out=None, n_req=PM_N_REQ):
     return out
 
 
+# decode-smoke knobs: the continuous-batching decode engine
+# (mxnet_tpu/decode.py) vs wave-synchronized static whole-batch decode
+# through the SAME engine and programs. The workload skews generation
+# lengths (1 long per wave of 8) because that skew is WHY continuous
+# batching exists: static batching pays the longest member's steps for
+# every wave while finished lanes idle; slot-level admission keeps the
+# pool full. On the dispatch-dominated CPU backend the dispatch-count
+# ratio is the throughput ratio, so the 2x gate is conservative
+# (measured ~2.5-3x; a real accelerator with wide decode batches gains
+# more).
+DEC_SLOTS = 8
+DEC_WAVES = 6
+DEC_SHORT, DEC_LONG = 4, 40        # generated tokens per sequence kind
+DEC_PROMPT = 4
+DEC_ROUNDS = 3
+DECODE_SPEEDUP_GATE = 2.0
+DEC_MP = 8                         # mp-sharded KV-cache leg mesh width
+
+
+def _decode_cell(heads=8):
+    from mxnet_tpu.decode import AttentionDecodeCell
+    return AttentionDecodeCell(vocab=256, embed=64, heads=heads,
+                               head_dim=16, max_len=64)
+
+
+def decode_smoke(json_out=None):
+    """Continuous-batching decode acceptance lane (tier-1 CI).
+
+    Three legs, one artifact (``decode_smoke.json``):
+
+    * correctness — slot-batched decode is BIT-EXACT (tokens and
+      logits) against one-at-a-time decode through the same engine;
+    * throughput — open-loop skewed-length stream through the
+      continuous engine vs wave-synchronized static whole-batch
+      submission of the same work, interleaved best-of; gates
+      continuous >= 2x static tokens/s and ZERO ``jit_compile`` spans
+      anywhere in the timed windows (per-token p50/p95/p99 ride along,
+      coordinated-omission-free: the step spans time the dispatch
+      cadence itself, all work is queued up front, so a slow step
+      cannot hide follow-on latency);
+    * mp-sharded KV cache — under ``DECODE_PARTITION_RULES`` on a
+      1x{mp} mesh the cache pool's committed ledger bytes read exactly
+      1/mp of the same pool replicated onto that mesh.
+    """
+    from mxnet_tpu.decode import DecodeEngine
+    from mxnet_tpu.parallel.ring_attention import DECODE_PARTITION_RULES
+
+    art_dir = os.environ.get("MXTPU_ARTIFACT_DIR", "/tmp/mxtpu_artifacts")
+    os.environ.setdefault("MXNET_CARD_CORPUS",
+                          os.path.join(art_dir, "card_corpus.jsonl"))
+    rng = np.random.RandomState(0)
+    out = {
+        "lane": "decode_smoke",
+        "platform": jax.devices()[0].platform,
+        "devices": jax.device_count(),
+        "slots": DEC_SLOTS,
+        "waves": DEC_WAVES,
+        "gen_short": DEC_SHORT,
+        "gen_long": DEC_LONG,
+        "speedup_gate": DECODE_SPEEDUP_GATE,
+    }
+
+    def prompt():
+        return rng.randint(1, 255, DEC_PROMPT).astype(np.int32)
+
+    # -- leg 1: bit-exact slot-batched vs one-at-a-time ---------------------
+    cell = _decode_cell()
+    eng = DecodeEngine(cell, cell.init_params(1), slots=4,
+                       max_prompt_len=8, max_new_tokens=8,
+                       keep_logits=True)
+    probes = [prompt() for _ in range(4)]
+    serial = [eng.generate(p) for p in probes]
+    batched = [f.result(timeout=300)
+               for f in [eng.submit(p) for p in probes]]
+    bit_exact = all(
+        a.tokens == b.tokens and np.array_equal(a.logits, b.logits)
+        for a, b in zip(serial, batched))
+    out["bit_exact"] = bit_exact
+    eng.close()
+
+    # -- leg 2: continuous vs static whole-batch throughput -----------------
+    eng = DecodeEngine(cell, cell.init_params(1), slots=DEC_SLOTS,
+                       max_prompt_len=8, max_new_tokens=DEC_LONG)
+    # one wave = a slot pool's worth of sequences, one long member
+    waves = [[(prompt(), DEC_LONG if s == 0 else DEC_SHORT)
+              for s in range(DEC_SLOTS)] for _ in range(DEC_WAVES)]
+    total_tokens = sum(n for wave in waves for _, n in wave)
+    # continuous submission order: longs first, so their long tails
+    # overlap the short churn instead of trailing an empty pool
+    stream = sorted((seq for wave in waves for seq in wave),
+                    key=lambda s: -s[1])
+
+    def static_epoch():
+        """Wave-synchronized static whole-batch decode: the next wave
+        enters only when the whole previous wave finished — finished
+        lanes idle exactly as a slotless whole-batch decoder's would
+        (same dispatch count: the longest member's steps per wave)."""
+        t0 = time.perf_counter()
+        for wave in waves:
+            futs = [eng.submit(p, max_new_tokens=n) for p, n in wave]
+            for f in futs:
+                f.result(timeout=300)
+        return time.perf_counter() - t0
+
+    def continuous_epoch():
+        """Open-loop: every sequence queued up front; per-step slot
+        admission keeps the pool full until the work runs dry."""
+        t0 = time.perf_counter()
+        futs = [eng.submit(p, max_new_tokens=n) for p, n in stream]
+        for f in futs:
+            f.result(timeout=300)
+        return time.perf_counter() - t0
+
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    dt_st = dt_ct = float("inf")
+    jit_compiles = 0
+    window = {}
+    try:
+        for _ in range(DEC_ROUNDS):
+            telemetry.reset()
+            dt_st = min(dt_st, static_epoch())
+            jit_compiles += telemetry.span_stats().get(
+                "jit_compile", {}).get("count", 0)
+            telemetry.reset()
+            dt = continuous_epoch()
+            snap = telemetry.snapshot()
+            jit_compiles += snap["spans"].get(
+                "jit_compile", {}).get("count", 0)
+            if dt <= dt_ct:
+                dt_ct = dt
+                window = {
+                    "counters": {k: v for k, v in
+                                 snap["counters"].items()
+                                 if k.startswith("decode.")},
+                    "spans": {k: v for k, v in snap["spans"].items()
+                              if k in telemetry.DECODE_SPANS},
+                }
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+    stats = eng.stats()
+    eng.close()
+
+    tok_lat = window.get("spans", {}).get("serve_decode_step", {})
+    out.update({
+        "total_tokens": total_tokens,
+        "static_tok_s": round(total_tokens / dt_st, 1),
+        "continuous_tok_s": round(total_tokens / dt_ct, 1),
+        "decode_speedup": round(dt_st / dt_ct, 2),
+        "token_latency_ms": {k: tok_lat.get(k)
+                             for k in ("p50_ms", "p95_ms", "p99_ms")},
+        "jit_compiles_timed": jit_compiles,
+        "kv_cache_bytes": stats["kv_cache_bytes"],
+        "kv_cache_bytes_per_slot": stats["kv_cache_bytes_per_slot"],
+        "telemetry": window,
+    })
+
+    # -- leg 3: the mp-sharded KV cache on the rule engine -------------------
+    if jax.device_count() >= DEC_MP:
+        ctxs = [mx.context.cpu(i) for i in range(DEC_MP)]
+        axes = {"dp": 1, "mp": DEC_MP}
+        mp_cell = _decode_cell(heads=DEC_MP)
+        sharded = DecodeEngine(mp_cell, mp_cell.init_params(1),
+                               slots=4, max_prompt_len=8,
+                               max_new_tokens=8,
+                               partition_rules=DECODE_PARTITION_RULES,
+                               mesh_axes=axes, contexts=ctxs)
+        mp_tokens = sharded.generate(prompt()).tokens
+        sharded_bytes = sharded.stats()["kv_cache_bytes"]
+        sharded.close()
+        repl = DecodeEngine(mp_cell, mp_cell.init_params(1), slots=4,
+                            max_prompt_len=8, max_new_tokens=8,
+                            partition_rules=[], mesh_axes=axes,
+                            contexts=ctxs, warmup=False)
+        repl_bytes = repl.stats()["kv_cache_bytes"]
+        repl.close()
+        out["mp"] = {
+            "mesh": axes,
+            "sharded_kv_bytes": sharded_bytes,
+            "replicated_kv_bytes": repl_bytes,
+            "ledger_ratio": round(repl_bytes / sharded_bytes, 2)
+            if sharded_bytes else None,
+            "decoded_tokens": len(mp_tokens),
+        }
+    else:
+        out["mp"] = None
+
+    # the ISSUE 16 decode acceptance gates, all deterministic except
+    # the (conservative) throughput ratio:
+    try:
+        assert bit_exact, "slot-batched decode diverged from unbatched"
+        assert jit_compiles == 0, \
+            ("compiles inside the timed windows", jit_compiles)
+        assert out["decode_speedup"] >= DECODE_SPEEDUP_GATE, \
+            out["decode_speedup"]
+        assert out["mp"] is not None, "mp leg needs %d devices" % DEC_MP
+        assert out["mp"]["replicated_kv_bytes"] \
+            == DEC_MP * out["mp"]["sharded_kv_bytes"], out["mp"]
+        assert out["mp"]["decoded_tokens"] == 8, out["mp"]
+        out["gates_passed"] = True
+    except AssertionError:
+        out["gates_passed"] = False
+        raise
+    finally:
+        line = json.dumps(out)
+        print(line, flush=True)
+        if json_out:
+            with open(json_out, "w") as f:
+                f.write(line + "\n")
+    return out
+
+
+def _respawn_with_mesh(n):
+    """Re-exec this probe with an ``n``-device forced host platform.
+    The decode lane's mp leg needs the multi-device CPU mesh, and
+    XLA_FLAGS must be set BEFORE the jax backend initialises — which
+    module import already did — so a direct invocation without the
+    flag bounces through one child process. Returns the child's exit
+    code."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=%d"
+                        % n).strip()
+    env["MXTPU_PROBE_RESPAWNED"] = "1"
+    proc = subprocess.run([sys.executable,
+                           os.path.abspath(__file__)] + sys.argv[1:],
+                          env=env)
+    return proc.returncode
+
+
 def _json_out_arg():
     if "--json-out" not in sys.argv:
         return None
@@ -802,7 +1033,12 @@ if __name__ == "__main__":
         chaos_smoke(json_out=_json_out_arg())
     elif "--postmortem-smoke" in sys.argv:
         postmortem_smoke(json_out=_json_out_arg())
+    elif "--decode-smoke" in sys.argv:
+        if jax.device_count() < DEC_MP \
+                and not os.environ.get("MXTPU_PROBE_RESPAWNED"):
+            sys.exit(_respawn_with_mesh(DEC_MP))
+        decode_smoke(json_out=_json_out_arg())
     else:
         raise SystemExit("usage: serve_probe.py --serve-smoke|"
-                         "--warm-smoke|--chaos-smoke|--postmortem-smoke"
-                         " [--json-out PATH]")
+                         "--warm-smoke|--chaos-smoke|--postmortem-smoke|"
+                         "--decode-smoke [--json-out PATH]")
