@@ -339,6 +339,11 @@ def child():
         "layout": "NHWC",
         "precision": "bf16+fp32-master" if BF16 else "fp32",
     }
+    try:
+        from mxnet_tpu import telemetry as _tel
+        out["process"] = _tel.process_identity()
+    except Exception:                       # telemetry must never cost a run
+        pass
     peak = peak_flops_for(dev.device_kind)
     if step_flops:
         flops_s = step_flops * ITERS / dt
@@ -389,6 +394,9 @@ def _telemetry_summary():
     # read as "instrumentation off", not as a measured zero
     return {"enabled": snap["enabled"], "counters": snap["counters"],
             "spans": spans,
+            # rank/host identity: every banked bench JSON names the
+            # process that measured it (fleet artifacts share one dir)
+            "process": snap["process"],
             # per-leg program cards + the online FLOP/s estimate: what a
             # step COSTS, next to what it MEASURED
             "programs": snap["programs"], "online": snap["online"]}

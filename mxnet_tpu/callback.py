@@ -121,6 +121,19 @@ class TelemetryLogger:
         self._last_decode_ts = None
         self._last_decode_total = 0
         self._last_series_ts = None
+        self._tag = None
+
+    def _rank_tag(self):
+        """``"[r<N>] "`` prefix on every log line of a multi-process
+        run — N ranks tail into ONE launcher stream, and an unprefixed
+        "step p99 spiked" line is unattributable exactly when it
+        matters. Cached: identity is fixed for the process lifetime;
+        single-process runs stay untagged."""
+        if self._tag is None:
+            ident = self._telemetry.process_identity()
+            self._tag = ("[r%d] " % ident["rank"]
+                         if ident["num_processes"] > 1 else "")
+        return self._tag
 
     def _rebase(self, count):
         self._last_counters = self._telemetry.counters()
@@ -152,6 +165,7 @@ class TelemetryLogger:
             flops = card.get("flops")
             peak = card.get("peak_bytes")
             self.logger.info(
+                self._rank_tag() +
                 "program card %s: kind=%s trace=%.1fms compile=%.1fms "
                 "flops=%s peak_hbm=%s donated=%d",
                 key, card.get("kind"),
@@ -226,7 +240,7 @@ class TelemetryLogger:
         trips = delta.get("serving.breaker_trips", 0)
         if trips:
             msg += "\tbreaker_trips=%d" % trips
-        self.logger.info(msg)
+        self.logger.info(self._rank_tag() + msg)
 
     def log_decode(self, engine=None, force=False):
         """One decode-window log line (tokens/s, active slots, slot-pool
@@ -299,7 +313,7 @@ class TelemetryLogger:
         trips = delta.get("decode.breaker_trips", 0)
         if trips:
             msg += "\tbreaker_trips=%d" % trips
-        self.logger.info(msg)
+        self.logger.info(self._rank_tag() + msg)
 
     def log_series(self, force=False):
         """One RATE log line from the flight recorder's sampler ring
@@ -346,7 +360,7 @@ class TelemetryLogger:
             msg += "\tmfu=%.4g" % mfu
         if last.get("serving", {}).get("breaker_open"):
             msg += "\tbreaker=OPEN"
-        self.logger.info(msg)
+        self.logger.info(self._rank_tag() + msg)
 
     def __call__(self, param):
         if self._programs:
@@ -399,10 +413,17 @@ class TelemetryLogger:
         syncs = delta.get("host_sync.blocking", 0)
         if syncs:
             msg += "\tblocking_syncs=%d" % syncs
+        # collective gate wait this window (ISSUE 18): the per-rank
+        # view of fleet skew — a rank whose gate_wait/batch is high is
+        # WAITING on a straggler; the straggler's own is ~0
+        gate_ms = sum(v for k, v in delta.items()
+                      if k.startswith("heartbeat.gate_wait_ms."))
+        if gate_ms:
+            msg += "\tgate_wait=%.1fms/batch" % (gate_ms / n)
         if fallbacks:
             msg += "\tfused_fallbacks=%s" % (
                 ",".join("%s:%d" % kv for kv in sorted(fallbacks.items())))
-        self.logger.info(msg)
+        self.logger.info(self._rank_tag() + msg)
 
 
 class ProgressBar:

@@ -271,8 +271,27 @@ def abort(code=0):
     drags every surviving peer into a fatal abort ~15 s later; a
     SIGKILL'd or ``abort()``-ed worker does not). Flushes stdio
     first. Dist workers that crash should die THROUGH this; the
-    launcher treats any nonzero code as a member death."""
+    launcher treats any nonzero code as a member death.
+
+    ``os._exit`` skips atexit AND sys.excepthook, so a crashing worker
+    aborting here would die with its flight recorder unsaved — exactly
+    the rank whose last seconds the fleet postmortem needs (the
+    survivor's ``dead_worker`` view gathers peers' dumps from the
+    shared flight dir). Bank a ``worker_abort`` postmortem first on
+    any nonzero code; best-effort, a recorder failure must not stop
+    the exit."""
     import sys
+    if int(code) != 0:
+        try:
+            from . import flight as _flight
+            # called from inside an except block (the dist child's
+            # crash handler), sys.exc_info() carries the killing
+            # exception — the victim's dump should name its killer
+            _flight.postmortem("worker_abort", exc=sys.exc_info()[1],
+                               extra={"exit_code": int(code)},
+                               force=True)
+        except Exception:
+            pass
     try:
         sys.stdout.flush()
         sys.stderr.flush()

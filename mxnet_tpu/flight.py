@@ -55,7 +55,7 @@ from .checkpoint import atomic_write
 
 __all__ = [
     "configure", "flight_dir", "install", "installed",
-    "postmortem", "last_postmortem",
+    "postmortem", "last_postmortem", "gather_peer_postmortems",
     "sampler_start", "sampler_stop", "sampler_running",
     "series", "series_window", "series_dump",
     "metrics_http_start", "metrics_http_stop", "openmetrics_text",
@@ -184,6 +184,7 @@ def _build_sample(last, dt_s):
     sample = {
         "ts": round(time.time(), 3),
         "dt_ms": round(dt_s * 1e3, 1),
+        "rank": telemetry.process_identity()["rank"],
         "counters": deltas,
         "queue_depth": telemetry.serving_queue_depth(cum),
         "ledger_bytes": sum(st.get("alive_bytes", 0)
@@ -201,6 +202,23 @@ def _build_sample(last, dt_s):
                                 for e in engines),
         },
     }
+    try:
+        from . import heartbeat as _hb
+        gate = _hb.gate_stats()
+    except Exception:
+        gate = {}
+    if gate:
+        # per-channel gate-wait series (ISSUE 18): the straggler's
+        # signature — one rank's step time flat while every peer's
+        # gate_wait climbs — is only visible when the sampler folds
+        # the gate stats into the SAME timeline as MFU/queue depth
+        sample["gate"] = {
+            ch: {"crossings": s["crossings"],
+                 "wait_ms_total": round(s["wait_ms_total"], 3),
+                 "last_wait_ms": round(s["last_wait_ms"], 3),
+                 "last_rank": s["last_rank"],
+                 "last_excess_ms": round(s["last_excess_ms"], 3)}
+            for ch, s in gate.items()}
     if reset:
         sample["registry_reset"] = True
     return sample, cum
@@ -295,6 +313,7 @@ def series_window(n=_POSTMORTEM_SERIES):
     "samples"}`` — what bench banks next to its snapshot block."""
     samples = series(n)
     return {"interval_ms": sampler_interval_ms(),
+            "process": telemetry.process_identity(),
             "n": len(samples), "samples": samples}
 
 
@@ -335,13 +354,24 @@ def openmetrics_text():
     """The registry as OpenMetrics/Prometheus exposition text: every
     telemetry counter as a ``counter`` family (``_total`` samples),
     plus the derived gauges (queue depth, per-context ledger bytes,
-    online MFU, live-engine queued rows / breaker state)."""
+    online MFU, live-engine queued rows / breaker state). Every sample
+    carries ``rank``/``host`` labels (ISSUE 18) so one Prometheus
+    scraping a fleet can aggregate or slice per rank without relabel
+    rules."""
+    ident = telemetry.process_identity()
+    who = {"rank": ident["rank"], "host": ident["host"]}
+
+    def labels_tail(labels):
+        return "{%s}" % ",".join(
+            '%s="%s"' % (k, _escape_label(v))
+            for k, v in sorted(labels.items()))
+
     cum = telemetry.counters()
     lines = []
     for name in sorted(cum):
         m = _metric_name(name)
         lines.append("# TYPE %s counter" % m)
-        lines.append("%s_total %s" % (m, cum[name]))
+        lines.append("%s_total%s %s" % (m, labels_tail(who), cum[name]))
 
     typed = set()
 
@@ -354,10 +384,10 @@ def openmetrics_text():
         if name not in typed:
             typed.add(name)
             lines.append("# TYPE %s gauge" % name)
-        tail = "" if not labels else "{%s}" % ",".join(
-            '%s="%s"' % (k, _escape_label(v))
-            for k, v in sorted(labels.items()))
-        lines.append("%s%s %s" % (name, tail, value))
+        merged = dict(who)
+        if labels:
+            merged.update(labels)
+        lines.append("%s%s %s" % (name, labels_tail(merged), value))
 
     gauge("mxnet_tpu_serving_queue_depth",
           telemetry.serving_queue_depth(cum))
@@ -457,15 +487,10 @@ def _exc_record(exc):
 def _process_identity():
     """Which worker of a multi-process job wrote this dump (a pod-scale
     postmortem is read next to its peers' — "whose flight recorder is
-    this" must not require correlating pids with launcher logs). Cheap
-    and import-safe: env-only when the dist runtime is absent."""
-    try:
-        from . import dist as _dist
-        return {"rank": _dist.rank(),
-                "num_processes": _dist.process_count(),
-                "dead_ranks": list(_dist.dead_ranks())}
-    except Exception:
-        return {"rank": 0, "num_processes": 1, "dead_ranks": []}
+    this" must not require correlating pids with launcher logs). The
+    uniform block lives in telemetry (ISSUE 18) so snapshots, series
+    windows, bench artifacts and dumps all agree on its shape."""
+    return telemetry.process_identity()
 
 
 def _build_record(reason, exc=None, extra=None):
@@ -530,8 +555,17 @@ def postmortem(reason, exc=None, extra=None, path=None, force=False):
                 _seq += 1
                 seq = _seq
             throttled = True
-            target = os.path.join(d, "postmortem-%d-%03d-%s.json" % (
-                os.getpid(), seq, _safe_reason(reason)))
+            # rank-disambiguated filename: a fleet shares ONE
+            # MXNET_FLIGHT_DIR over NFS, where pids collide across
+            # hosts — two ranks dumping the same reason must land as
+            # two files, never clobber. (The per-reason throttle above
+            # is in-process state, so it is rank-local by
+            # construction — rank 0 dumping dead_worker never
+            # suppresses rank 2's.)
+            target = os.path.join(
+                d, "postmortem-r%d-%d-%03d-%s.json" % (
+                    telemetry.process_identity()["rank"], os.getpid(),
+                    seq, _safe_reason(reason)))
         rec = _build_record(reason, exc=exc, extra=extra)
         atomic_write(target, json.dumps(rec, sort_keys=True,
                                         default=str))
@@ -568,6 +602,68 @@ def last_postmortem():
         return _last_path
 
 
+_PM_RANK_RE = None      # compiled lazily; module stays regex-free otherwise
+
+
+def gather_peer_postmortems(directory=None, exclude_rank=None,
+                            max_events=8):
+    """Light summaries of OTHER ranks' newest postmortems in the shared
+    flight dir — the survivor's ``dead_worker`` dump embeds these so
+    the cluster view shows the victim's last seconds, not just the
+    survivor's keyhole. One entry per rank (its newest dump by mtime):
+    ``{"rank", "path", "reason", "ts", "exception", "events_tail"}``.
+    Best-effort end to end: a corrupt or half-written peer dump is
+    skipped, and nothing here ever raises — this runs inside elastic
+    recovery, where a second failure must not mask the first."""
+    global _PM_RANK_RE
+    try:
+        import re as _re
+        if _PM_RANK_RE is None:
+            _PM_RANK_RE = _re.compile(r"^postmortem-r(\d+)-.*\.json$")
+        d = directory or flight_dir()
+        if d is None:
+            return []
+        me = telemetry.process_identity()["rank"] \
+            if exclude_rank is None else int(exclude_rank)
+        newest = {}                 # rank -> (mtime, path)
+        for name in os.listdir(d):
+            m = _PM_RANK_RE.match(name)
+            if not m:
+                continue
+            rank = int(m.group(1))
+            if rank == me:
+                continue
+            path = os.path.join(d, name)
+            try:
+                mt = os.path.getmtime(path)
+            except OSError:
+                continue
+            if rank not in newest or mt > newest[rank][0]:
+                newest[rank] = (mt, path)
+        out = []
+        for rank in sorted(newest):
+            _mt, path = newest[rank]
+            try:
+                with open(path) as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                continue
+            exc = rec.get("exception")
+            out.append({
+                "rank": rank,
+                "path": path,
+                "reason": rec.get("reason"),
+                "ts": rec.get("ts"),
+                "exception": {"type": exc.get("type"),
+                              "message": exc.get("message")}
+                if isinstance(exc, dict) else None,
+                "events_tail": (rec.get("events") or [])[-max_events:],
+            })
+        return out
+    except Exception:
+        return []
+
+
 # ---------------------------------------------------------------------------
 # Process hooks
 # ---------------------------------------------------------------------------
@@ -596,8 +692,10 @@ def _atexit_flush():
     d = flight_dir()
     if d is not None and series(1):
         try:
-            series_dump(os.path.join(d, "flight-series-%d.jsonl"
-                                     % os.getpid()))
+            series_dump(os.path.join(
+                d, "flight-series-r%d-%d.jsonl" % (
+                    telemetry.process_identity()["rank"],
+                    os.getpid())))
         except Exception:
             pass
 

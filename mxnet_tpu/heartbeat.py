@@ -38,22 +38,38 @@ import os
 import re
 import threading
 import time
+import weakref
 
 from .base import MXNetError
 
 __all__ = ["start_heartbeat", "stop_heartbeat", "count_dead",
            "alive_ranks", "stale_ranks", "CollectiveGate",
-           "DeadWorkerError"]
+           "DeadWorkerError", "gate_stats"]
 
 ENV_DIR = "MXTPU_HEARTBEAT_DIR"
 ENV_INTERVAL = "MXTPU_HEARTBEAT_INTERVAL"
 ENV_TIMEOUT = "MXTPU_HEARTBEAT_TIMEOUT"
 ENV_GATE_TIMEOUT = "MXTPU_GATE_TIMEOUT"
+ENV_STRAGGLER_MS = "MXTPU_STRAGGLER_MS"
+ENV_STRAGGLER_K = "MXTPU_STRAGGLER_K"
 DEFAULT_INTERVAL = 1.0
 DEFAULT_TIMEOUT = 10.0
 # a peer missing from the gate whose heartbeat stays FRESH is slow
 # (compiling, GC pause), not dead — wait for it up to this hard cap
 DEFAULT_GATE_TIMEOUT = 300.0
+# straggler verdict: the last arriver is a straggler when its arrival
+# trails the fleet median by >= this many ms for K consecutive
+# crossings of the same channel (one slow step is noise; a streak is
+# a rank the planner should act on)
+DEFAULT_STRAGGLER_MS = 50.0
+DEFAULT_STRAGGLER_K = 3
+
+# every live gate, so the flight sampler can fold per-channel wait
+# series into its samples without threading gate handles through the
+# fit loop (weak: a gate dies with its owner, the registry must not
+# pin re-meshed gates alive)
+_gates_lock = threading.Lock()
+_gates = weakref.WeakSet()      # guarded by: _gates_lock
 
 _WORKER_RE = re.compile(r"^worker-(\d+)$")
 
@@ -321,11 +337,34 @@ class CollectiveGate:
         # slow joiner (still importing jax while we cross the first
         # gate) has no file yet and must not read as dead
         self._seen = set()      # guarded by: self._lock
+        self.straggler_ms = float(os.environ.get(
+            ENV_STRAGGLER_MS, DEFAULT_STRAGGLER_MS))
+        self.straggler_k = max(1, int(os.environ.get(
+            ENV_STRAGGLER_K, DEFAULT_STRAGGLER_K)))
+        # consecutive-crossing count for the CURRENT worst rank only —
+        # a different rank arriving last resets the streak (the verdict
+        # is "one rank is persistently slow", not "steps are slow")
+        self._streak = [None, 0]        # guarded by: self._lock
+        self._stats = {                 # guarded by: self._lock
+            "crossings": 0, "wait_ms_total": 0.0, "last_wait_ms": 0.0,
+            "last_rank": None, "last_excess_ms": 0.0, "stragglers": 0,
+        }
+        # step-time skew bookkeeping: wall time between crossings minus
+        # the waits the caller reported (note_wait) = this rank's OWN
+        # work, published in the gate file so every rank can compare
+        # self-times fleet-wide. A straggler whose slowness hides
+        # behind a synchronizing collective (peers absorb it in their
+        # completion await, arriving at the next gate together) is
+        # invisible to arrival order but NOT to self-time.
+        self._ext_wait_ms = 0.0         # guarded by: self._lock
+        self._last_return = None        # guarded by: self._lock
         self._dir = None
         if self.root:
             tag = "-".join(str(m) for m in self.members)
             self._dir = os.path.join(
                 self.root, "gate-%s-%s" % (self.channel, tag))
+        with _gates_lock:
+            _gates.add(self)
 
     @property
     def enabled(self):
@@ -337,13 +376,46 @@ class CollectiveGate:
     def _member_path(self, rank):
         return os.path.join(self._dir, "rank-%d" % int(rank))
 
-    def _publish(self, gen):
+    def note_wait(self, ms):
+        """Report time this rank spent WAITING between crossings (the
+        fit loop calls this with its collective-completion await). The
+        reported waits are subtracted from the inter-crossing wall time
+        so the self-time published at the next arrival reflects this
+        rank's OWN work only — a rank stalled waiting on a slow peer
+        must not itself read as slow."""
+        with self._lock:
+            self._ext_wait_ms += max(0.0, float(ms))
+
+    def _take_self_ms(self):
+        """Self-time for the crossing about to be published: wall time
+        since the previous crossing returned, minus the waits the
+        caller reported via :meth:`note_wait`. ``None`` on the first
+        crossing (no window yet). Resets the window."""
+        now = time.monotonic()
+        with self._lock:
+            last, ext = self._last_return, self._ext_wait_ms
+            self._ext_wait_ms = 0.0
+        if last is None:
+            return None
+        return max(0.0, (now - last) * 1e3 - ext)
+
+    def _publish(self, gen, self_ms=None):
         os.makedirs(self._dir, exist_ok=True)
         path = self._member_path(self.rank)
         tmp = path + ".tmp"
         try:
             with open(tmp, "w") as f:
-                f.write(str(int(gen)))
+                # "<gen> <local wall time> <self_ms>": the generation
+                # is the protocol; the timestamp is the informational
+                # half of the arrival record (arrival ORDER is judged
+                # by file mtimes — the shared filesystem's own clock,
+                # the only one comparable across hosts; see _fs_now);
+                # self_ms is this rank's own-work time since its last
+                # crossing ("-" on the first), the fleet-comparable
+                # step-time-skew signal every peer reads back
+                f.write("%d %.6f %s"
+                        % (int(gen), time.time(),
+                           "-" if self_ms is None else "%.3f" % self_ms))
             os.replace(tmp, path)
         except BaseException:
             # gate-publish failure is fatal to the crossing (the
@@ -355,9 +427,154 @@ class CollectiveGate:
     def _peer_gen(self, rank):
         try:
             with open(self._member_path(rank)) as f:
-                return int(f.read().strip() or 0)
+                head = f.read().split()
+                return int(head[0]) if head else 0
         except (OSError, ValueError):
             return -1
+
+    def _arrivals(self, gen):
+        """Arrival record for generation ``gen``, read back from the
+        gate files every rank just published: ``[(rank, mtime,
+        self_ms)]`` where ``mtime`` is the arrival time on the shared
+        filesystem's clock (cross-host comparable — the same clock
+        staleness is judged by) and ``self_ms`` is the own-work time
+        that rank published with its arrival (None when absent). A
+        member whose file already shows a LATER generation raced
+        ahead — it certainly arrived at ``gen`` before us, but its
+        mtime/self-time now reflect the later publish, so it carries
+        ``mtime=None`` and is excluded from timing verdicts."""
+        out = []
+        for m in self.members:
+            path = self._member_path(m)
+            try:
+                with open(path) as f:
+                    head = f.read().split()
+                g = int(head[0]) if head else 0
+                mt = os.path.getmtime(path)
+            except (OSError, ValueError):
+                continue
+            self_ms = None
+            if len(head) > 2 and head[2] != "-":
+                try:
+                    self_ms = float(head[2])
+                except ValueError:
+                    pass
+            if g == gen:
+                out.append((int(m), mt, self_ms))
+            elif g > gen:
+                out.append((int(m), None, None))
+        return out
+
+    def _record_crossing(self, gen, t0_ns, error=None):
+        """Attribute one finished (or aborted) crossing: a
+        ``gate_wait`` span whose ctx names who arrived last and by how
+        much, per-channel wait/crossing counters, running stats for the
+        flight sampler, and the streak machine behind the structured
+        ``dist.straggler`` event. Attribution must never take down a
+        step the barrier itself completed — any surprise here is
+        swallowed after stamping the stats.
+
+        TWO skew signals feed one verdict, because a straggler can hide
+        either way: (a) arrival-order excess — the last arrival's mtime
+        vs the fleet's lower-median arrival (catches slow input/compute
+        BEFORE the gate); (b) self-time excess — the max published
+        self-time vs its lower median (catches slowness a synchronizing
+        collective absorbed: peers blocked in the completion await
+        arrive at the next gate TOGETHER, so arrival order reads ~0
+        skew while the straggler's own-work time is the step's whole
+        budget). The verdict takes whichever signal shows the larger
+        excess."""
+        from . import telemetry
+        with self._lock:
+            # close the self-time window at the crossing's end, enabled
+            # or not: the next publish measures from here
+            self._last_return = time.monotonic()
+        if not telemetry.enabled():
+            return
+        t1_ns = time.perf_counter_ns()
+        wait_ms = (t1_ns - t0_ns) / 1e6
+        last_rank, excess_ms, order = None, 0.0, []
+        try:
+            arrivals = self._arrivals(gen)
+            timed = sorted((mt, r) for r, mt, _s in arrivals
+                           if mt is not None)
+            if timed:
+                first_mt = timed[0][0]
+                order = [[r, round((mt - first_mt) * 1e3, 3)]
+                         for mt, r in timed]
+                last_mt, last_rank = timed[-1]
+                # lower median of the (sorted) arrival times: with 2
+                # ranks the excess is simply last-vs-first; with more,
+                # one early outlier cannot inflate the verdict
+                mid = timed[(len(timed) - 1) // 2][0]
+                excess_ms = max(0.0, (last_mt - mid) * 1e3)
+            selfs = sorted((s, r) for r, mt, s in arrivals
+                           if mt is not None and s is not None)
+            self_map = {r: round(s, 3) for s, r in selfs}
+            if len(selfs) > 1:
+                slow_ms, slow_rank = selfs[-1]
+                skew_ms = max(
+                    0.0, slow_ms - selfs[(len(selfs) - 1) // 2][0])
+                if skew_ms > excess_ms:
+                    last_rank, excess_ms = slow_rank, skew_ms
+            if error is not None:
+                # the crossing never completed: the wait is the dead
+                # rank's fault in full — this is the pre-death spike
+                # fleet_view pins on the victim
+                last_rank = int(error.ranks[0])
+                excess_ms = wait_ms
+            ctx = dict(telemetry.current_causal() or {})
+            ctx.update({"channel": self.channel, "generation": gen,
+                        "wait_ms": round(wait_ms, 3)})
+            if last_rank is not None:
+                ctx["last_rank"] = last_rank
+                ctx["excess_ms"] = round(excess_ms, 3)
+            if order:
+                ctx["arrivals"] = order
+            if self_map:
+                ctx["self_ms"] = self_map
+            if error is not None:
+                ctx["dead_ranks"] = list(error.ranks)
+                ctx["timed_out"] = bool(error.timed_out)
+            telemetry.record_span("gate_wait", t0_ns, t1_ns, ctx)
+            telemetry.counter_inc(
+                "heartbeat.gate_crossings.%s" % self.channel)
+            telemetry.counter_inc(
+                "heartbeat.gate_wait_ms.%s" % self.channel,
+                round(wait_ms, 3))
+            emit = 0
+            with self._lock:
+                st = self._stats
+                st["crossings"] += 1
+                st["wait_ms_total"] += wait_ms
+                st["last_wait_ms"] = wait_ms
+                st["last_rank"] = last_rank
+                st["last_excess_ms"] = excess_ms
+                if error is None and last_rank is not None \
+                        and excess_ms >= self.straggler_ms:
+                    if self._streak[0] != last_rank:
+                        self._streak = [last_rank, 0]
+                    self._streak[1] += 1
+                    if self._streak[1] >= self.straggler_k:
+                        emit = self._streak[1]
+                        st["stragglers"] += 1
+                else:
+                    self._streak = [None, 0]
+            if emit:
+                telemetry.record_event(
+                    "dist.straggler", rank=last_rank,
+                    channel=self.channel, generation=gen,
+                    excess_ms=round(excess_ms, 3),
+                    wait_ms=round(wait_ms, 3), streak=emit)
+                telemetry.counter_inc("dist.straggler")
+        except Exception:
+            pass
+
+    def stats(self):
+        """Point-in-time copy of this gate's crossing stats (the
+        flight sampler's per-channel series source)."""
+        with self._lock:
+            return dict(self._stats)
 
     def arrive_and_wait(self):
         """Cross the gate for the next collective. Returns the
@@ -373,7 +590,8 @@ class CollectiveGate:
             gen = self.generation
         if not self.enabled:
             return gen
-        self._publish(gen)
+        self._publish(gen, self_ms=self._take_self_ms())
+        t0_ns = time.perf_counter_ns()
         deadline = time.monotonic() + self.gate_timeout
         peers = [m for m in self.members if m != self.rank]
         # liveness verdicts need a directory scan + probe write — keep
@@ -384,18 +602,23 @@ class CollectiveGate:
         while True:
             missing = [p for p in peers if self._peer_gen(p) < gen]
             if not missing:
+                self._record_crossing(gen, t0_ns)
                 return gen
             if time.monotonic() >= next_liveness:
                 next_liveness = time.monotonic() + liveness_every
                 dead = self._dead_among(missing)
                 if dead:
-                    raise DeadWorkerError([r for r, _ in dead],
+                    err = DeadWorkerError([r for r, _ in dead],
                                           channel=self.channel,
                                           generation=gen,
                                           evidence=dict(dead))
+                    self._record_crossing(gen, t0_ns, error=err)
+                    raise err
             if time.monotonic() > deadline:
-                raise DeadWorkerError(missing, channel=self.channel,
+                err = DeadWorkerError(missing, channel=self.channel,
                                       generation=gen, timed_out=True)
+                self._record_crossing(gen, t0_ns, error=err)
+                raise err
             time.sleep(self.poll)
 
     def _dead_among(self, ranks):
@@ -428,3 +651,35 @@ class CollectiveGate:
                 dead.append((int(r), "heartbeat file removed after "
                                      "being seen alive"))
         return dead
+
+
+def gate_stats():
+    """Per-channel crossing stats over every live gate in this
+    process — what the flight sampler folds into its series samples
+    (``gate.<channel>.*`` keys) and what the fleet summary reads off a
+    rank's dump. Two gates on one channel (a re-mesh in flight) merge
+    by summing totals and keeping the most-recently-crossed gate's
+    ``last_*`` verdicts."""
+    with _gates_lock:
+        gates = list(_gates)
+    out = {}
+    for g in gates:
+        s = g.stats()
+        if not s["crossings"]:
+            continue
+        cur = out.get(g.channel)
+        if cur is None:
+            out[g.channel] = s
+        else:
+            keep_last = s if s["crossings"] >= cur["crossings"] else cur
+            merged = {
+                "crossings": cur["crossings"] + s["crossings"],
+                "wait_ms_total": (cur["wait_ms_total"]
+                                  + s["wait_ms_total"]),
+                "stragglers": cur["stragglers"] + s["stragglers"],
+                "last_wait_ms": keep_last["last_wait_ms"],
+                "last_rank": keep_last["last_rank"],
+                "last_excess_ms": keep_last["last_excess_ms"],
+            }
+            out[g.channel] = merged
+    return out

@@ -515,7 +515,13 @@ class BaseModule:
                    "timed_out": bool(e.timed_out),
                    "survivor_rank": _dist.rank(),
                    "live_ranks": [r for r in _dist.live_ranks()
-                                  if r not in e.ranks]})
+                                  if r not in e.ranks],
+                   # every reachable peer's newest dump from the shared
+                   # flight dir — a dying rank banks a worker_abort on
+                   # its way through dist.abort, so the cluster view
+                   # shows the VICTIM's last seconds too, not just this
+                   # survivor's keyhole
+                   "peer_postmortems": _flight.gather_peer_postmortems()})
         from .. import log as _log
         logger = _log.get_logger("mxnet_tpu.module")
         if ckpt is None or ckpt.latest() is None:

@@ -312,27 +312,41 @@ class Module(BaseModule):
         Best-effort beyond that point: the wedged execution cannot be
         aborted runtime-side, so recovery may still require the
         launcher-level restart — but the death is named, postmortem'd
-        and bounded."""
-        if not hasattr(handle, "is_ready"):
-            import jax
-            jax.block_until_ready(handle)
-            return
+        and bounded.
+
+        The time spent here is WAIT, not work: it is reported to the
+        step gate (``note_wait``) so the self-time this rank publishes
+        at its next crossing excludes it — otherwise a fast rank
+        blocked on a slow peer's half of the collective would itself
+        read as a straggler in the fleet-wide skew comparison."""
         import time as _time
-        from .. import heartbeat
-        kv = self._kvstore
-        peers = [r for r in kv.live_ranks if r != kv.rank]
-        next_liveness = _time.monotonic() + 0.25
-        while not handle.is_ready():
-            if _time.monotonic() >= next_liveness:
-                next_liveness = _time.monotonic() + 0.25
-                dead = heartbeat.stale_ranks(peers)
-                if dead:
-                    raise heartbeat.DeadWorkerError(
-                        dead, channel="step-execution",
-                        generation=self._dist_gate().generation,
-                        evidence={r: "died with the collective "
-                                     "in flight" for r in dead})
-            _time.sleep(0.002)
+        t0 = _time.monotonic()
+        try:
+            if not hasattr(handle, "is_ready"):
+                import jax
+                jax.block_until_ready(handle)
+                return
+            from .. import heartbeat
+            kv = self._kvstore
+            peers = [r for r in kv.live_ranks if r != kv.rank]
+            next_liveness = _time.monotonic() + 0.25
+            while not handle.is_ready():
+                if _time.monotonic() >= next_liveness:
+                    next_liveness = _time.monotonic() + 0.25
+                    dead = heartbeat.stale_ranks(peers)
+                    if dead:
+                        raise heartbeat.DeadWorkerError(
+                            dead, channel="step-execution",
+                            generation=self._dist_gate().generation,
+                            evidence={r: "died with the collective "
+                                         "in flight" for r in dead})
+                _time.sleep(0.002)
+        finally:
+            try:
+                self._dist_gate().note_wait(
+                    (_time.monotonic() - t0) * 1e3)
+            except Exception:
+                pass
 
     def _ensure_dist_placement(self):
         """Commit the executor's storage onto the process-spanning mesh

@@ -824,6 +824,9 @@ class InferenceEngine:
             breaker_open = self._breaker_tripped()
             consecutive = self._consecutive_failures
         return {
+            # WHO is reporting: a fleet health-checker scraping N
+            # engine processes joins on this block (ISSUE 18)
+            "process": telemetry.process_identity(),
             "requests": st.get("requests", 0),
             "resolved": st.get("resolved", 0),
             "failed_requests": st.get("failed_requests", 0),

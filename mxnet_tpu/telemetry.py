@@ -66,16 +66,17 @@ from __future__ import annotations
 import collections
 import itertools
 import os
+import socket
 import threading
 import time
 import weakref
 
 __all__ = [
     "enabled", "enable", "disable", "reset",
-    "counter_inc", "counters", "snapshot", "span", "span_stats",
-    "span_count", "span_durations", "span_seconds",
+    "counter_inc", "counters", "snapshot", "span", "record_span",
+    "span_stats", "span_count", "span_durations", "span_seconds",
     "causal", "current_causal", "record_event", "events",
-    "recent_spans", "serving_queue_depth",
+    "recent_spans", "serving_queue_depth", "process_identity",
     "on_dispatch", "remove_dispatch", "dispatch_event",
     "record_jit", "record_fallback", "record_fault", "record_transfer",
     "record_host_sync", "chrome_events", "mark_trace_start",
@@ -173,6 +174,10 @@ COUNTERS = (
     "decode.prefill_compiles", "decode.resolved",
     "decode.failed_requests", "decode.dispatch_failures",
     "decode.retries", "decode.breaker_trips", "decode.breaker_fastfail",
+    # fleet observability (ISSUE 18): per-channel gate-wait attribution
+    # and the structured straggler verdicts the gate emits
+    "heartbeat.gate_wait_ms.*", "heartbeat.gate_crossings.*",
+    "dist.straggler",
 )
 
 
@@ -567,6 +572,17 @@ def span(name, ctx=None):
     return _Span(name, ctx)
 
 
+def record_span(name, t0_ns, t1_ns, ctx=None):
+    """Record an already-completed interval (``perf_counter_ns``
+    endpoints) retroactively — for callers that only learn a span's
+    identity AFTER it ended: the collective gate knows which rank it
+    waited on (and by how much) only once the wait resolves, yet the
+    ``gate_wait`` span must carry that attribution in its ctx."""
+    if not _state.enabled:
+        return
+    _record_span(name, int(t0_ns), int(t1_ns), dict(ctx) if ctx else None)
+
+
 def _record_span(name, t0_ns, t1_ns, ctx=None):
     # deque.append and dict reads are GIL-atomic so the ring/histogram
     # writes stay lock-free; the cumulative counter is a read-modify-
@@ -883,15 +899,42 @@ def online():
     return _online_stats()
 
 
+try:
+    _HOSTNAME = socket.gethostname()
+except OSError:
+    _HOSTNAME = "unknown"
+
+
+def process_identity():
+    """The uniform WHO-wrote-this block every banked JSON carries
+    (ISSUE 18): rank / process count / recorded-dead peers from the
+    dist runtime (env-only when it is absent — import-safe and never
+    raises), plus host and pid so artifacts from a shared
+    ``MXNET_FLIGHT_DIR`` are attributable without correlating launcher
+    logs. Embedded in :func:`snapshot`, flight postmortems, the flight
+    sampler's series window and the serving stats surface."""
+    try:
+        from . import dist as _dist
+        ident = {"rank": _dist.rank(),
+                 "num_processes": _dist.process_count(),
+                 "dead_ranks": list(_dist.dead_ranks())}
+    except Exception:
+        ident = {"rank": 0, "num_processes": 1, "dead_ranks": []}
+    ident["host"] = _HOSTNAME
+    ident["pid"] = os.getpid()
+    return ident
+
+
 def snapshot():
     """One self-describing dict: counters + span percentiles + program
-    cards + the online MFU estimate + the buffer ledger. This is what
-    ``Module.telemetry_snapshot()`` returns, what ``bench.py`` embeds
-    in the BENCH/MULTICHIP artifacts and what
-    ``callback.TelemetryLogger`` diffs per log line. Every value is
-    JSON-serializable end to end."""
+    cards + the online MFU estimate + the buffer ledger + the process
+    identity block. This is what ``Module.telemetry_snapshot()``
+    returns, what ``bench.py`` embeds in the BENCH/MULTICHIP artifacts
+    and what ``callback.TelemetryLogger`` diffs per log line. Every
+    value is JSON-serializable end to end."""
     return {
         "enabled": _state.enabled,
+        "process": process_identity(),
         "counters": counters(),
         "spans": span_stats(),
         "programs": programs(),
@@ -971,9 +1014,13 @@ def chrome_events(pid=None, since_trace_start=True):
     with _lock:
         spans = list(_spans)
     t0 = _trace_start_ns if since_trace_start else None
+    ident = process_identity()
+    track = "mxnet_tpu host"
+    if ident["num_processes"] > 1:
+        track = "mxnet_tpu %s (rank %d)" % (ident["host"], ident["rank"])
     events = [{
         "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
-        "args": {"name": "mxnet_tpu host"},
+        "args": {"name": track},
     }, {
         "ph": "M", "name": "process_sort_index", "pid": pid, "tid": 0,
         "args": {"sort_index": -1},

@@ -52,3 +52,19 @@ def test_dist_smoke_lane():
     assert out["oracle_max_abs_diff"] <= 1e-4
     assert out["chaos"]["survivor"]["elastic"]["elastic.resumed"] == 1
     assert out["chaos"]["postmortem_extra"]["dead_ranks"] == [1]
+    # the merged cluster view (ISSUE 18): fleet_view parsed both
+    # ranks' artifacts from the shared flight dir, named the killed
+    # rank, pinned the fleet-wide gate-wait blame and the
+    # dist.straggler verdicts on it, and solved clock offsets from
+    # matched gate crossings
+    fleet = out["chaos"]["fleet"]
+    assert fleet["n_ranks"] >= 2
+    assert fleet["dead_ranks"] == [1]
+    assert fleet["stragglers"][0]["rank"] == 1
+    assert fleet["stragglers"][0]["straggler_events"] > 0
+    assert fleet["clock"]["reference_rank"] == 0
+    # the survivor's dead_worker dump carries the victim's own last
+    # seconds, gathered from the shared dir at recovery time
+    peers = out["chaos"]["postmortem_extra"]["peer_postmortems"]
+    assert any(p["rank"] == 1 and p["reason"] == "worker_abort"
+               for p in peers)

@@ -246,15 +246,20 @@ def test_openmetrics_endpoint_loopback_scrape():
         body = urllib.request.urlopen(
             "http://127.0.0.1:%d/metrics" % port, timeout=10).read()
         text = body.decode()
+        # every sample carries the process identity labels (ISSUE 18):
+        # a fleet-scraping Prometheus can slice per rank without
+        # relabel rules
+        ident = telemetry.process_identity()
+        who = 'host="%s",rank="%d"' % (ident["host"], ident["rank"])
         assert "# TYPE mxnet_tpu_serving_requests counter" in text
-        assert "mxnet_tpu_serving_requests_total 7" in text
+        assert "mxnet_tpu_serving_requests_total{%s} 7" % who in text
         assert "mxnet_tpu_serving_queue_depth" in text
         assert text.count(
             "# TYPE mxnet_tpu_ledger_alive_bytes gauge") == 1
-        assert 'mxnet_tpu_ledger_alive_bytes{ctx="ledgertest(0)"} 64' \
-            in text
-        assert 'mxnet_tpu_ledger_alive_bytes{ctx="ledgertest(1)"} 128' \
-            in text
+        assert ('mxnet_tpu_ledger_alive_bytes{ctx="ledgertest(0)",%s}'
+                ' 64' % who) in text
+        assert ('mxnet_tpu_ledger_alive_bytes{ctx="ledgertest(1)",%s}'
+                ' 128' % who) in text
         assert text.rstrip().endswith("# EOF")
         with pytest.raises(urllib.error.HTTPError):
             urllib.request.urlopen(

@@ -305,3 +305,210 @@ def test_gate_publish_failure_cleans_tmp_and_raises(tmp_path,
         g._publish(1)
     # the crossing failed loudly AND left nothing for peers to scan
     assert not [n for n in os.listdir(root) if n.endswith(".tmp")]
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 18 tentpole: gate-wait straggler attribution — per-crossing
+# gate_wait spans with causal (channel, generation) ctx, arrival-order
+# read back from the gate files, the self-time skew signal, and the
+# streak machine behind the structured dist.straggler event
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def _telemetry():
+    from mxnet_tpu import telemetry
+    telemetry.enable()
+    telemetry.reset()
+    yield telemetry
+    telemetry.reset()
+
+
+def _cross_pair(g0, g1, delay1=0.0, self_work=None, n=1):
+    """Cross both gates n times from two threads; rank 1 sleeps
+    ``delay1`` seconds before each arrival. ``self_work`` optionally
+    maps rank -> per-crossing own-work seconds slept WITHOUT a
+    matching delay on the other side (the self-time skew case)."""
+    def run(gate, delay, work):
+        for _ in range(n):
+            if work:
+                time.sleep(work)
+            if delay:
+                time.sleep(delay)
+            gate.arrive_and_wait()
+    sw = self_work or {}
+    t = threading.Thread(target=run, args=(g1, delay1, sw.get(1)))
+    t.start()
+    run(g0, 0.0, sw.get(0))
+    t.join(10)
+
+
+def _gate_wait_spans(telemetry, channel=None):
+    return [s for s in telemetry.recent_spans()
+            if s["name"] == "gate_wait"
+            and (channel is None or s["ctx"].get("channel") == channel)]
+
+
+def test_gate_wait_span_attributes_last_arriver(tmp_path, _telemetry):
+    """Every completed crossing records a gate_wait span whose ctx
+    names the channel, generation, the last arriver (read back from
+    the gate files' mtimes — the shared filesystem's own clock) and
+    its excess over the fleet median arrival."""
+    root = str(tmp_path)
+    _fresh_worker(root, 0)
+    _fresh_worker(root, 1)
+    g0 = CollectiveGate(0, (0, 1), root=root, poll=0.01)
+    g1 = CollectiveGate(1, (0, 1), root=root, poll=0.01)
+    _cross_pair(g0, g1, delay1=0.08)
+    spans = _gate_wait_spans(_telemetry, "step")
+    assert len(spans) == 2              # one per rank
+    for s in spans:
+        c = s["ctx"]
+        assert c["generation"] == 1
+        assert c["last_rank"] == 1
+        assert c["excess_ms"] >= 50
+        # arrival order: rank 0 first at rel 0, rank 1 late
+        ranks = [r for r, _rel in c["arrivals"]]
+        assert ranks == [0, 1]
+    # the early rank actually WAITED; the late rank cleared instantly
+    by_wait = sorted(spans, key=lambda s: s["ctx"]["wait_ms"])
+    assert by_wait[-1]["ctx"]["wait_ms"] >= 50
+    cnt = _telemetry.counters()
+    assert cnt.get("heartbeat.gate_crossings.step") == 2
+    assert cnt.get("heartbeat.gate_wait_ms.step", 0) >= 50
+
+
+def test_gate_straggler_streak_emits_event(tmp_path, _telemetry):
+    """One slow crossing is noise; the SAME rank trailing the fleet
+    median by >= the threshold for K consecutive crossings is a
+    straggler — a structured dist.straggler event naming it, every
+    crossing the streak persists."""
+    root = str(tmp_path)
+    _fresh_worker(root, 0)
+    _fresh_worker(root, 1)
+    g0 = CollectiveGate(0, (0, 1), root=root, poll=0.01)
+    g1 = CollectiveGate(1, (0, 1), root=root, poll=0.01)
+    assert g0.straggler_k == 3          # default
+    _cross_pair(g0, g1, delay1=0.08, n=4)
+    evs = [e for e in _telemetry.events()
+           if e["kind"] == "dist.straggler"]
+    # streak hits K=3 at crossing 3 and persists through 4 — both
+    # ranks run the same verdict from the same files
+    assert len(evs) == 4
+    for e in evs:
+        d = e["data"]
+        assert d["rank"] == 1
+        assert d["channel"] == "step"
+        assert d["excess_ms"] >= 50
+        assert d["streak"] >= 3
+    assert _telemetry.counters().get("dist.straggler") == 4
+
+
+def test_gate_self_time_skew_names_hidden_straggler(tmp_path,
+                                                    _telemetry):
+    """A straggler whose slowness a synchronizing collective absorbs
+    (peers blocked in the completion await arrive at the next gate
+    TOGETHER) is invisible to arrival order — the self-time half of
+    the verdict catches it: each rank publishes own-work time (wall
+    window minus note_wait-reported waits) in its gate file, and the
+    rank whose self-time exceeds the fleet median by the threshold is
+    named."""
+    root = str(tmp_path)
+    _fresh_worker(root, 0)
+    _fresh_worker(root, 1)
+    g0 = CollectiveGate(0, (0, 1), root=root, poll=0.01)
+    g1 = CollectiveGate(1, (0, 1), root=root, poll=0.01)
+
+    def run0():
+        for _ in range(4):
+            time.sleep(0.005)           # own work
+            time.sleep(0.085)           # blocked on the "collective"
+            g0.note_wait(85.0)          # ...reported as WAIT
+            g0.arrive_and_wait()
+
+    def run1():
+        for _ in range(4):
+            time.sleep(0.090)           # all own work
+            g1.arrive_and_wait()
+
+    t = threading.Thread(target=run1)
+    t.start()
+    run0()
+    t.join(10)
+    evs = [e for e in _telemetry.events()
+           if e["kind"] == "dist.straggler"]
+    assert evs and all(e["data"]["rank"] == 1 for e in evs)
+    # the published self-times ride in the span ctx: rank 1's own-work
+    # dominates while the arrivals themselves are near-simultaneous
+    with_self = [s for s in _gate_wait_spans(_telemetry, "step")
+                 if "self_ms" in s["ctx"]]
+    assert with_self
+    c = with_self[-1]["ctx"]
+    assert c["self_ms"][1] - c["self_ms"][0] >= 50
+    assert c["excess_ms"] >= 50
+
+
+def test_gate_error_crossing_blames_dead_rank_no_streak(tmp_path,
+                                                        _telemetry):
+    """An aborted crossing (DeadWorkerError) attributes the FULL wait
+    to the dead rank — the pre-death spike the fleet view pins on the
+    victim — but never feeds the straggler streak (death is not
+    slowness)."""
+    root = str(tmp_path)
+    _fresh_worker(root, 0)
+    _fresh_worker(root, 1, age=100)     # peer heartbeat stale
+    g0 = CollectiveGate(0, (0, 1), root=root, timeout=10, poll=0.01)
+    with pytest.raises(DeadWorkerError):
+        g0.arrive_and_wait()
+    spans = _gate_wait_spans(_telemetry, "step")
+    assert len(spans) == 1
+    c = spans[0]["ctx"]
+    assert c["last_rank"] == 1
+    assert c["dead_ranks"] == [1]
+    assert c["timed_out"] is False
+    assert c["excess_ms"] == pytest.approx(c["wait_ms"])
+    assert not [e for e in _telemetry.events()
+                if e["kind"] == "dist.straggler"]
+
+
+def test_gate_stats_and_module_merge(tmp_path, _telemetry):
+    """Per-gate stats() feed gate_stats(), the per-channel merge the
+    flight sampler folds into its series samples."""
+    root = str(tmp_path)
+    _fresh_worker(root, 0)
+    _fresh_worker(root, 1)
+    # a channel name unique to this test: the process-global gate
+    # registry may still hold gates a prior test's exception pinned
+    g0 = CollectiveGate(0, (0, 1), root=root, poll=0.01,
+                        channel="mergetest")
+    g1 = CollectiveGate(1, (0, 1), root=root, poll=0.01,
+                        channel="mergetest")
+    _cross_pair(g0, g1, delay1=0.06, n=2)
+    st = g0.stats()
+    assert st["crossings"] == 2
+    assert st["last_rank"] == 1
+    assert st["wait_ms_total"] >= st["last_wait_ms"] > 0
+    merged = heartbeat.gate_stats()
+    assert "mergetest" in merged
+    # BOTH live gates on the channel merge: totals sum
+    assert merged["mergetest"]["crossings"] == 4
+
+
+def test_gate_attribution_disabled_with_telemetry_off(tmp_path):
+    """telemetry.disable() turns the whole attribution path off — no
+    spans, no counters, no events — while the barrier protocol itself
+    keeps working."""
+    from mxnet_tpu import telemetry
+    telemetry.reset()
+    telemetry.disable()
+    try:
+        root = str(tmp_path)
+        _fresh_worker(root, 0)
+        _fresh_worker(root, 1)
+        g0 = CollectiveGate(0, (0, 1), root=root, poll=0.01)
+        g1 = CollectiveGate(1, (0, 1), root=root, poll=0.01)
+        _cross_pair(g0, g1)
+    finally:
+        telemetry.enable()
+    assert not _gate_wait_spans(telemetry)
+    assert not telemetry.counters()
+    telemetry.reset()

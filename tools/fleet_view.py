@@ -1,0 +1,486 @@
+#!/usr/bin/env python3
+"""fleet_view: join N ranks' flight-recorder artifacts into ONE
+cluster view (ISSUE 18).
+
+Usage::
+
+    python tools/fleet_view.py FLIGHT_DIR [--json] [--trace OUT.json]
+
+A fleet shares one ``MXNET_FLIGHT_DIR``; every rank banks its own
+rank-stamped postmortem (``postmortem-r<rank>-<pid>-<seq>-<reason>
+.json``) and series JSONL there. This tool reads them all and answers
+the questions no single rank's dump can:
+
+* **who is dead** — union of every dump's recorded dead ranks, the
+  ``dead_worker`` extras, and any rank whose own newest dump is a
+  ``worker_abort``;
+* **who made everyone wait** — the straggler ranking: each rank's
+  ``gate_wait`` spans carry the attributed last-arriver in ctx, so the
+  fleet-wide blame table is a join, not a guess. ``dist.straggler``
+  events ride along as corroboration;
+* **one timebase** — per-rank clock offsets solved from matched gate
+  crossings: a (channel, generation) gate crossing is a SHARED event
+  every participating rank records within one gate-poll interval, so
+  ``offset[r] = median over matched crossings of (end_r - end_ref)``.
+  The reference is the lowest parsed rank;
+* **one trace** — ``--trace`` writes a merged chrome://tracing /
+  perfetto JSON with one process track per rank (offset-corrected),
+  instant markers for straggler/fault/elastic events, and cross-rank
+  flow arrows tying each gate generation's crossings together.
+
+``--json`` emits the machine-readable fleet summary
+(``mxnet_tpu.fleet/1``). Corrupt or half-written per-rank dumps
+degrade to a NAMED warning on stderr — exit 2 only when ZERO ranks
+parse. Stdlib-only, like flight_view (which it imports for the
+single-dump loader).
+"""
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import flight_view  # noqa: E402  (the single-dump loader/validator)
+
+FLEET_SCHEMA = "mxnet_tpu.fleet/1"
+
+_PM_RE = re.compile(r"^postmortem-r(\d+)-\d+-\d+-.*\.json$")
+_PM_LEGACY_RE = re.compile(r"^postmortem-\d+-\d+-.*\.json$")
+_SERIES_RE = re.compile(r"^flight-series-r(\d+)-\d+\.jsonl$")
+
+# events that become instant markers on the merged trace
+_MARKER_EVENTS = ("dist.straggler", "fault.injected", "flight.postmortem",
+                  "elastic.dead_worker", "elastic.resumed")
+
+
+def _percentile(sorted_vals, pct):
+    if not sorted_vals:
+        return None
+    k = (len(sorted_vals) - 1) * pct / 100.0
+    lo = int(k)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) * (k - lo)
+
+
+def discover(directory):
+    """Per-rank artifact paths: ``{rank: {"dumps": [paths newest
+    first], "series": [paths]}}``. Legacy unranked dumps (pre-fleet
+    ``postmortem-<pid>-...``) land under rank None and are resolved by
+    their embedded process block at load time."""
+    try:
+        names = os.listdir(directory)
+    except OSError as e:
+        raise flight_view.MalformedDump(
+            "cannot list %s: %s" % (directory, e))
+    out = {}
+
+    def slot(rank):
+        return out.setdefault(rank, {"dumps": [], "series": []})
+
+    for name in sorted(names):
+        path = os.path.join(directory, name)
+        m = _PM_RE.match(name)
+        if m:
+            slot(int(m.group(1)))["dumps"].append(path)
+            continue
+        if _PM_LEGACY_RE.match(name):
+            slot(None)["dumps"].append(path)
+            continue
+        m = _SERIES_RE.match(name)
+        if m:
+            slot(int(m.group(1)))["series"].append(path)
+    for rec in out.values():
+        rec["dumps"].sort(key=_mtime, reverse=True)
+    return out
+
+
+def _mtime(path):
+    try:
+        return os.path.getmtime(path)
+    except OSError:
+        return 0.0
+
+
+def load_fleet(directory):
+    """One primary (= newest parseable) dump per rank plus its series
+    samples: ``({rank: {...}}, warnings)``. Every malformed artifact
+    becomes a named warning; only a fleet with ZERO parseable ranks is
+    an error (the caller exits 2)."""
+    found = discover(directory)
+    ranks, warnings = {}, []
+    for rank, arts in sorted(found.items(),
+                             key=lambda kv: (kv[0] is None, kv[0])):
+        rec = None
+        for path in arts["dumps"]:
+            try:
+                rec = flight_view.load_dump(path)
+            except flight_view.MalformedDump as e:
+                warnings.append("skipping malformed dump: %s" % e)
+                continue
+            actual = rank
+            if actual is None:        # legacy name: ask the record
+                actual = (rec.get("process") or {}).get("rank", 0)
+            if actual in ranks:
+                rec = None            # a ranked dump already won
+                break
+            ranks[actual] = {"path": path, "rec": rec, "series": []}
+            break
+        if rec is None and not arts["dumps"] and arts["series"]:
+            # a rank can flush its series ring at exit without ever
+            # dumping a postmortem — still part of the fleet picture
+            ranks.setdefault(rank, {"path": None, "rec": None,
+                                    "series": []})
+    for rank, arts in found.items():
+        if rank is None or rank not in ranks:
+            continue
+        for path in arts["series"]:
+            try:
+                with open(path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if line:
+                            ranks[rank]["series"].append(json.loads(line))
+            except (OSError, ValueError) as e:
+                warnings.append("skipping malformed series %s: %s"
+                                % (path, e))
+    return ranks, warnings
+
+
+# ---------------------------------------------------------------------------
+# Clock-offset solve
+# ---------------------------------------------------------------------------
+
+def gate_crossings(rec):
+    """``{(channel, generation): end_epoch_s}`` from a dump's
+    ``gate_wait`` spans. The END of a crossing is the shared instant:
+    every rank leaves the gate within one poll interval of the last
+    arrival, while the start (= its own arrival) is exactly the skew
+    being measured."""
+    out = {}
+    for span in rec.get("spans") or []:
+        if span.get("name") != "gate_wait":
+            continue
+        ctx = span.get("ctx") or {}
+        ch, gen = ctx.get("channel"), ctx.get("generation")
+        if ch is None or gen is None or span.get("ts") is None:
+            continue
+        out[(str(ch), int(gen))] = (float(span["ts"])
+                                    + float(span.get("dur_ms") or 0.0)
+                                    / 1e3)
+    return out
+
+
+def solve_offsets(ranks):
+    """Per-rank clock offset (seconds to SUBTRACT from that rank's
+    timestamps to land on the reference rank's timebase) via the
+    median over matched gate crossings. Returns ``(reference_rank,
+    {rank: offset_s}, {rank: matched_count})``."""
+    crossings = {r: gate_crossings(d["rec"]) for r, d in ranks.items()
+                 if d["rec"] is not None}
+    parsed = sorted(crossings)
+    if not parsed:
+        return None, {}, {}
+    ref = parsed[0]
+    offsets, matched = {ref: 0.0}, {ref: len(crossings[ref])}
+    for r in parsed[1:]:
+        common = sorted(set(crossings[r]) & set(crossings[ref]))
+        matched[r] = len(common)
+        if not common:
+            offsets[r] = 0.0
+            continue
+        deltas = sorted(crossings[r][k] - crossings[ref][k]
+                        for k in common)
+        offsets[r] = _percentile(deltas, 50)
+    return ref, offsets, matched
+
+
+# ---------------------------------------------------------------------------
+# Fleet summary
+# ---------------------------------------------------------------------------
+
+def _rank_summary(rank, data):
+    rec = data["rec"]
+    out = {"rank": rank, "n_series_samples": len(data["series"]),
+           "dump": data["path"]}
+    if rec is None:
+        out.update({"reason": None, "host": None, "mfu": None,
+                    "step_p95_ms": None, "gate_wait_ms": {},
+                    "crossings": {}})
+        return out
+    proc = rec.get("process") or {}
+    counters = rec.get("counters") or {}
+    steps = sorted(s.get("dur_ms") or 0.0
+                   for s in rec.get("spans") or []
+                   if s.get("name") == "step")
+    gate_wait = {k[len("heartbeat.gate_wait_ms."):]: round(v, 3)
+                 for k, v in counters.items()
+                 if k.startswith("heartbeat.gate_wait_ms.")}
+    crossings = {k[len("heartbeat.gate_crossings."):]: v
+                 for k, v in counters.items()
+                 if k.startswith("heartbeat.gate_crossings.")}
+    out.update({
+        "reason": rec.get("reason"),
+        "ts": rec.get("ts"),
+        "host": proc.get("host"),
+        "pid": rec.get("pid"),
+        "mfu": (rec.get("online") or {}).get("mfu"),
+        "step_p95_ms": (round(_percentile(steps, 95), 3)
+                        if steps else None),
+        "gate_wait_ms": gate_wait,
+        "crossings": crossings,
+    })
+    return out
+
+
+def _dead_ranks(ranks):
+    dead = set()
+    for rank, data in ranks.items():
+        rec = data["rec"]
+        if rec is None:
+            continue
+        if rec.get("reason") == "worker_abort":
+            dead.add(rank)
+        dead.update((rec.get("process") or {}).get("dead_ranks") or [])
+        extra = rec.get("extra") or {}
+        if isinstance(extra, dict):
+            dead.update(extra.get("dead_ranks") or [])
+    return sorted(int(r) for r in dead)
+
+
+def straggler_ranking(ranks):
+    """Fleet-wide blame table: each recorded ``gate_wait`` span blames
+    its attributed last-arriver for the span's wait (self-waits — the
+    straggler observing its own ~0 wait — don't count), and
+    ``dist.straggler`` verdicts are tallied per named rank. Sorted
+    worst first."""
+    blame = {}
+
+    def slot(r):
+        return blame.setdefault(int(r), {
+            "rank": int(r), "blamed_wait_ms": 0.0,
+            "blamed_crossings": 0, "straggler_events": 0})
+
+    for rank, data in ranks.items():
+        rec = data["rec"]
+        if rec is None:
+            continue
+        for span in rec.get("spans") or []:
+            if span.get("name") != "gate_wait":
+                continue
+            ctx = span.get("ctx") or {}
+            last = ctx.get("last_rank")
+            if last is None or int(last) == int(rank):
+                continue
+            s = slot(last)
+            s["blamed_wait_ms"] += float(span.get("dur_ms") or 0.0)
+            s["blamed_crossings"] += 1
+        for ev in rec.get("events") or []:
+            if ev.get("kind") != "dist.straggler":
+                continue
+            named = (ev.get("data") or {}).get("rank")
+            if named is not None:
+                slot(named)["straggler_events"] += 1
+    out = sorted(blame.values(),
+                 key=lambda s: (-s["blamed_wait_ms"],
+                                -s["straggler_events"]))
+    for s in out:
+        s["blamed_wait_ms"] = round(s["blamed_wait_ms"], 3)
+    return out
+
+
+def summarize(ranks, warnings):
+    ref, offsets, matched = solve_offsets(ranks)
+    return {
+        "schema": FLEET_SCHEMA,
+        "n_ranks": len(ranks),
+        "ranks": {str(r): _rank_summary(r, d)
+                  for r, d in sorted(ranks.items())},
+        "dead_ranks": _dead_ranks(ranks),
+        "stragglers": straggler_ranking(ranks),
+        "clock": {
+            "reference_rank": ref,
+            "offsets_s": {str(r): round(o, 6)
+                          for r, o in sorted(offsets.items())},
+            "matched_crossings": {str(r): m
+                                  for r, m in sorted(matched.items())},
+        },
+        "warnings": warnings,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Merged trace
+# ---------------------------------------------------------------------------
+
+def merged_trace(ranks):
+    """One chrome://tracing JSON over every parsed rank: pid = rank
+    (its own track, offset-corrected onto the reference timebase),
+    span ctx preserved as args, instant markers for
+    straggler/fault/elastic events, and one flow arrow per gate
+    generation tying the ranks' crossings together."""
+    ref, offsets, _matched = solve_offsets(ranks)
+    events = []
+    gate_flow = {}          # (channel, gen) -> [(adj_end_us, rank, tid)]
+    for rank, data in sorted(ranks.items()):
+        rec = data["rec"]
+        if rec is None:
+            continue
+        proc = rec.get("process") or {}
+        off = offsets.get(rank, 0.0)
+        events.append({"ph": "M", "name": "process_name", "pid": rank,
+                       "tid": 0,
+                       "args": {"name": "rank %d (%s)%s" % (
+                           rank, proc.get("host", "?"),
+                           " [dead]" if rec.get("reason")
+                           == "worker_abort" else "")}})
+        events.append({"ph": "M", "name": "process_sort_index",
+                       "pid": rank, "tid": 0,
+                       "args": {"sort_index": rank}})
+        tids = set()
+        for span in rec.get("spans") or []:
+            ts = span.get("ts")
+            if ts is None:
+                continue
+            tid = span.get("tid") or 0
+            tids.add(tid)
+            ctx = span.get("ctx") or {}
+            start_us = (float(ts) - off) * 1e6
+            dur_us = float(span.get("dur_ms") or 0.0) * 1e3
+            ev = {"ph": "X", "name": span.get("name", "?"),
+                  "pid": rank, "tid": tid,
+                  "ts": start_us, "dur": dur_us}
+            if ctx:
+                ev["args"] = ctx
+            events.append(ev)
+            if span.get("name") == "gate_wait" \
+                    and ctx.get("channel") is not None \
+                    and ctx.get("generation") is not None:
+                key = (str(ctx["channel"]), int(ctx["generation"]))
+                gate_flow.setdefault(key, []).append(
+                    (start_us + dur_us, rank, tid))
+        for ev in rec.get("events") or []:
+            if ev.get("kind") not in _MARKER_EVENTS:
+                continue
+            events.append({"ph": "i", "name": ev["kind"], "pid": rank,
+                           "tid": 0, "s": "p",
+                           "ts": (float(ev.get("ts", 0.0)) - off) * 1e6,
+                           "args": ev.get("data") or {}})
+        for tid in sorted(tids):
+            events.append({"ph": "M", "name": "thread_name",
+                           "pid": rank, "tid": tid,
+                           "args": {"name": "host thread %d" % tid}})
+    for (channel, gen), ends in sorted(gate_flow.items()):
+        if len(ends) < 2:
+            continue
+        ends.sort()
+        fid = "gate:%s:%d" % (channel, gen)
+        first_us, first_rank, first_tid = ends[0]
+        events.append({"ph": "s", "cat": "gate", "name": "gate",
+                       "id": fid, "pid": first_rank, "tid": first_tid,
+                       "ts": first_us})
+        for i, (us, rank, tid) in enumerate(ends[1:]):
+            events.append({"ph": "f" if i == len(ends) - 2 else "t",
+                           "cat": "gate", "name": "gate", "id": fid,
+                           "pid": rank, "tid": tid, "ts": us,
+                           "bp": "e"})
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "metadata": {"schema": FLEET_SCHEMA,
+                         "reference_rank": ref}}
+
+
+# ---------------------------------------------------------------------------
+# Text render
+# ---------------------------------------------------------------------------
+
+def render(summary, out=sys.stdout):
+    w = out.write
+    w("fleet view: %d rank(s)\n" % summary["n_ranks"])
+    dead = summary["dead_ranks"]
+    w("  dead ranks: %s\n" % (dead if dead else "(none)"))
+    clock = summary["clock"]
+    w("  clock: reference rank %s; offsets (s): %s; matched "
+      "crossings: %s\n"
+      % (clock["reference_rank"], clock["offsets_s"],
+         clock["matched_crossings"]))
+    w("\nper-rank:\n")
+    w("  %4s %-12s %-16s %8s %10s %12s\n"
+      % ("rank", "host", "reason", "mfu", "step_p95", "gate_wait_ms"))
+    for _r, rs in sorted(summary["ranks"].items(),
+                         key=lambda kv: int(kv[0])):
+        w("  %4s %-12s %-16s %8s %10s %12s\n"
+          % (rs["rank"], rs.get("host") or "-",
+             (rs.get("reason") or "-")[:16],
+             "-" if rs.get("mfu") is None else "%.3f" % rs["mfu"],
+             "-" if rs.get("step_p95_ms") is None
+             else "%.1f" % rs["step_p95_ms"],
+             "-" if not rs.get("gate_wait_ms")
+             else ",".join("%s:%.0f" % kv
+                           for kv in sorted(rs["gate_wait_ms"]
+                                            .items()))))
+    stragglers = summary["stragglers"]
+    w("\nstraggler ranking (blamed gate wait, fleet-wide):\n")
+    for s in stragglers or []:
+        w("  rank %d: %.1f ms over %d crossings, %d dist.straggler "
+          "event(s)\n"
+          % (s["rank"], s["blamed_wait_ms"], s["blamed_crossings"],
+             s["straggler_events"]))
+    if not stragglers:
+        w("  (no attributed gate waits)\n")
+    for warning in summary["warnings"]:
+        w("warning: %s\n" % warning)
+    w("\n")
+
+
+def main(argv):
+    args, as_json, trace_path = [], False, None
+    it = iter(argv[1:])
+    for a in it:
+        if a == "--json":
+            as_json = True
+        elif a == "--trace":
+            trace_path = next(it, None)
+            if trace_path is None:
+                print("usage: fleet_view.py FLIGHT_DIR [--json] "
+                      "[--trace OUT.json]", file=sys.stderr)
+                return 2
+        elif a.startswith("--"):
+            print("fleet_view: unknown option %r" % a, file=sys.stderr)
+            return 2
+        else:
+            args.append(a)
+    if len(args) != 1:
+        print("usage: fleet_view.py FLIGHT_DIR [--json] "
+              "[--trace OUT.json]", file=sys.stderr)
+        return 2
+    try:
+        ranks, warnings = load_fleet(args[0])
+    except flight_view.MalformedDump as e:
+        print("fleet_view: %s" % e, file=sys.stderr)
+        return 2
+    for warning in warnings:
+        print("fleet_view: warning: %s" % warning, file=sys.stderr)
+    if not any(d["rec"] is not None for d in ranks.values()):
+        print("fleet_view: no parseable rank dumps in %s" % args[0],
+              file=sys.stderr)
+        return 2
+    if trace_path:
+        with open(trace_path, "w") as f:
+            json.dump(merged_trace(ranks), f)
+        print("fleet_view: wrote merged trace %s" % trace_path,
+              file=sys.stderr)
+    summary = summarize(ranks, warnings)
+    if as_json:
+        json.dump(summary, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        render(summary)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main(sys.argv))
+    except BrokenPipeError:
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+        sys.exit(0)

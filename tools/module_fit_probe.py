@@ -816,16 +816,36 @@ def dist_smoke(json_out=None):
         # the epoch-0-end checkpoint exists
         chaos_epochs = 3
         fault_n = 5 + nbatch + 3
-        flight = os.path.join(work, "flight0")
+        # ONE flight dir shared by both ranks (the fleet posture:
+        # rank-stamped filenames keep the artifacts apart) — rank 0's
+        # dead_worker dump, rank 1's worker_abort dump and the series
+        # JSONLs all land here for the merged cluster view
+        flight = os.path.join(work, "flight")
         os.makedirs(flight, exist_ok=True)
         ckpt = os.path.join(work, "ckpt")
         port = _free_port()
         epochs = chaos_epochs
         procs = [
             _spawn("chaos", 0, 2, port, ["--dist-ckpt", ckpt],
-                   {"MXNET_FLIGHT_DIR": flight}),
+                   {"MXNET_FLIGHT_DIR": flight,
+                    "MXNET_METRICS_INTERVAL_MS": "200"}),
+            # rank 1 is first a STRAGGLER (every dispatch delayed),
+            # then DIES at the deterministic crossing. A dispatch-side
+            # delay is INVISIBLE to gate arrival order — rank 0 absorbs
+            # it blocked in the previous step's completion await, so
+            # both ranks reach the next gate together — which is
+            # exactly what the self-time half of the verdict exists
+            # for: rank 1 publishes ~delay more own-work time per
+            # crossing and the streak machine must emit dist.straggler
+            # naming it. 250 ms keeps the published skew well clear of
+            # the 50 ms threshold even when rank 0 does epoch-boundary
+            # work (checkpoint, eval) inside the same window.
             _spawn("chaos", 1, 2, port, ["--dist-ckpt", ckpt],
-                   {"MXNET_FAULTS": "kv_collective:raise:n=%d" % fault_n}),
+                   {"MXNET_FLIGHT_DIR": flight,
+                    "MXNET_METRICS_INTERVAL_MS": "200",
+                    "MXNET_FAULTS":
+                        "dispatch:delay=250:first=50;"
+                        "kv_collective:raise:n=%d" % fault_n}),
         ]
         rcs_c, res_c = _leg("chaos", procs, 300)
         c0 = res_c[0]
@@ -840,6 +860,18 @@ def dist_smoke(json_out=None):
                 stdout=subprocess.PIPE, text=True, timeout=60, cwd=root)
             if view.returncode == 0:
                 pm_summary = json.loads(view.stdout)
+        # the merged cluster view: every rank's dump joined, clocks
+        # aligned from matched gate crossings, ONE artifact (ISSUE 18)
+        fleet_trace = os.path.join(work, "chaos-fleet-trace.json")
+        fleet = subprocess.run(
+            [sys.executable,
+             os.path.join(root, "tools", "fleet_view.py"),
+             flight, "--json", "--trace", fleet_trace],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            timeout=60, cwd=root)
+        fleet_summary = None
+        if fleet.returncode == 0:
+            fleet_summary = json.loads(fleet.stdout)
         out["chaos"] = {
             "rcs": rcs_c,
             "survivor": c0 and {
@@ -847,6 +879,13 @@ def dist_smoke(json_out=None):
                 "elastic": c0["dist_counters"]},
             "postmortems": pms,
             "postmortem_extra": pm_summary and pm_summary.get("extra"),
+            "fleet_rc": fleet.returncode,
+            "fleet": fleet_summary and {
+                "n_ranks": fleet_summary["n_ranks"],
+                "dead_ranks": fleet_summary["dead_ranks"],
+                "stragglers": fleet_summary["stragglers"],
+                "clock": fleet_summary["clock"],
+                "warnings": fleet_summary["warnings"]},
         }
 
         # -- gates ------------------------------------------------------
@@ -897,6 +936,32 @@ def dist_smoke(json_out=None):
             extra = pm_summary["extra"]
             assert extra["dead_ranks"] == [1], extra
             assert extra["epoch"] == 1 and extra["nbatch"] == 2, extra
+            # C (fleet): ONE merged cluster view over the shared
+            # flight dir — the killed rank is named dead, the
+            # pre-death gate-wait spike is attributed to IT (rank 0's
+            # dispatch ran undelayed, so every excess wait blames
+            # rank 1), clocks align to within one gate-poll interval
+            # (same box: the solved offset must be ~0), and the
+            # survivor's dump carries the victim's own postmortem
+            assert fleet.returncode == 0, fleet.stderr
+            assert fleet_summary["n_ranks"] >= 2, fleet_summary
+            assert fleet_summary["dead_ranks"] == [1], fleet_summary
+            stragglers = fleet_summary["stragglers"]
+            assert stragglers and stragglers[0]["rank"] == 1, stragglers
+            assert stragglers[0]["straggler_events"] > 0, stragglers
+            offs = fleet_summary["clock"]["offsets_s"]
+            assert all(abs(o) <= 0.25 for o in offs.values()), offs
+            assert any(int(m) > 0 for r, m in
+                       fleet_summary["clock"]["matched_crossings"]
+                       .items() if int(r) != 0), fleet_summary["clock"]
+            with open(fleet_trace) as f:
+                trace = json.load(f)
+            tracks = {e["pid"] for e in trace["traceEvents"]
+                      if e.get("name") == "process_name"}
+            assert tracks >= {0, 1}, tracks
+            peers = extra.get("peer_postmortems") or []
+            assert any(p["rank"] == 1 and p["reason"] == "worker_abort"
+                       for p in peers), peers
             out["gates_passed"] = True
         except AssertionError:
             out["gates_passed"] = False
